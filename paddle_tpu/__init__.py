@@ -1,0 +1,63 @@
+"""paddle_tpu — a TPU-native deep learning framework with PaddlePaddle's
+capabilities, built on JAX/XLA/Pallas/pjit.
+
+Public surface mirrors ``import paddle`` (reference: python/paddle/__init__.py):
+tensors + ~200 ops, nn, optimizer, amp, autograd, io, jit, distributed, with
+eager (dygraph) semantics over XLA and trace-to-HLO compilation replacing the
+static-graph/PIR/CINN stack.
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+import jax as _jax
+
+# Paddle's dtype surface includes int64/float64 as first-class (int64 is the
+# default index dtype); enable x64 so those dtypes exist. Perf-critical paths
+# use bf16/f32 explicitly, so TPU speed is unaffected.
+_jax.config.update("jax_enable_x64", True)
+
+from .core import autograd  # noqa: F401
+from .core.autograd import enable_grad, grad, is_grad_enabled, no_grad, set_grad_enabled  # noqa: F401
+from .core.device import (  # noqa: F401
+    CPUPlace, Place, TPUPlace, device_count, get_device, is_compiled_with_tpu,
+    set_device,
+)
+from .core.dtype import (  # noqa: F401
+    bfloat16, bool_ as bool8, complex64, complex128, float16, float32, float64,
+    get_default_dtype, int8, int16, int32, int64, set_default_dtype, uint8,
+)
+from .core.random import get_rng_state, seed, set_rng_state  # noqa: F401
+from .core.tensor import Parameter, Tensor, to_tensor  # noqa: F401
+from .ops import *  # noqa: F401,F403
+from .ops import einsum, one_hot  # noqa: F401
+
+from . import amp  # noqa: F401
+from . import autograd as autograd_ns  # noqa: F401
+from . import framework  # noqa: F401
+from . import io  # noqa: F401
+from . import jit  # noqa: F401
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from .framework.io import load, save  # noqa: F401
+
+# paddle aliases
+bool = bool8  # noqa: A001
+
+
+def disable_static(place=None):
+    return None
+
+
+def enable_static():
+    raise NotImplementedError(
+        "paddle_tpu is dygraph-first; use paddle_tpu.jit.to_static for "
+        "whole-graph XLA compilation (replaces the static graph executor).")
+
+
+def in_dynamic_mode():
+    return True
+
+
+def is_grad_enabled_():
+    return is_grad_enabled()

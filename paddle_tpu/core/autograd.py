@@ -1,0 +1,279 @@
+"""Tape-based eager autograd — TPU-native rebuild of the reference's eager engine.
+
+Reference: ``paddle/fluid/eager/backward.cc:428`` (``egr::Backward``) walks a graph of
+generated ``GradNode``s with per-node ``GradTensorHolder`` accumulation. Here every
+differentiable eager op records a :class:`TapeNode` holding the ``jax.vjp`` pullback of
+the op's jnp implementation — JAX's functional VJP replaces the reference's 26k LoC of
+generated grad nodes. Backward is a reverse walk over the (topologically ordered) tape.
+
+Works identically under ``jax.jit`` tracing: nodes then hold tracer residuals, so a
+whole train step (forward + backward + update) can be staged to XLA.
+"""
+from __future__ import annotations
+
+import threading
+import weakref
+from typing import Any, Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "no_grad", "enable_grad", "is_grad_enabled", "set_grad_enabled",
+    "TapeNode", "record_op", "backward", "grad",
+]
+
+
+class _GradState(threading.local):
+    def __init__(self):
+        self.enabled = True
+
+
+_state = _GradState()
+
+
+def is_grad_enabled() -> bool:
+    return _state.enabled
+
+
+def set_grad_enabled(mode: bool):
+    _state.enabled = bool(mode)
+
+
+class no_grad:
+    """Context manager + decorator, mirroring ``paddle.no_grad``."""
+
+    def __enter__(self):
+        self._prev = _state.enabled
+        _state.enabled = False
+        return self
+
+    def __exit__(self, *exc):
+        _state.enabled = self._prev
+        return False
+
+    def __call__(self, fn):
+        def wrapper(*args, **kwargs):
+            with no_grad():
+                return fn(*args, **kwargs)
+        wrapper.__name__ = getattr(fn, "__name__", "wrapped")
+        return wrapper
+
+
+class enable_grad:
+    def __enter__(self):
+        self._prev = _state.enabled
+        _state.enabled = True
+        return self
+
+    def __exit__(self, *exc):
+        _state.enabled = self._prev
+        return False
+
+
+class TapeNode:
+    """One recorded op: inputs that require grad, the vjp pullback, and the outputs.
+
+    Mirrors GradNodeBase (eager/grad_node_info.h) but holds a functional pullback
+    instead of a hand-written apply().
+    """
+
+    __slots__ = ("name", "inputs", "vjp_fn", "outputs", "out_avals", "n_outputs",
+                 "__weakref__")
+
+    def __init__(self, name: str, inputs: Sequence[Any], vjp_fn: Callable,
+                 outputs: Sequence[Any]):
+        self.name = name
+        self.inputs = list(inputs)          # Tensor objects (diff inputs only)
+        self.vjp_fn = vjp_fn                # pullback: (out_cts...) -> (in_cts...)
+        # weakrefs so dead intermediate tensors don't keep whole graphs alive;
+        # the node itself is kept alive by output tensors' grad_fn pointers.
+        self.outputs = [weakref.ref(o) for o in outputs]
+        self.out_avals = [(o.shape, o.dtype) for o in outputs]
+        self.n_outputs = len(outputs)
+
+    def __repr__(self):
+        return f"<TapeNode {self.name} ({len(self.inputs)} in, {self.n_outputs} out)>"
+
+
+def record_op(name: str, diff_inputs: Sequence[Any], vjp_fn: Callable,
+              outputs: Sequence[Any]) -> None:
+    """Attach a TapeNode to each output tensor (sets grad_fn / output_index)."""
+    node = TapeNode(name, diff_inputs, vjp_fn, outputs)
+    for i, o in enumerate(outputs):
+        o._grad_fn = node
+        o._output_index = i
+        o.stop_gradient = False
+
+
+def _toposort(roots) -> List[TapeNode]:
+    """Reverse-topological order of nodes reachable from root tensors' grad_fns."""
+    visited = set()
+    order: List[TapeNode] = []
+    stack = []
+    for r in roots:
+        if r._grad_fn is not None and id(r._grad_fn) not in visited:
+            stack.append((r._grad_fn, False))
+    while stack:
+        node, processed = stack.pop()
+        if processed:
+            order.append(node)
+            continue
+        if id(node) in visited:
+            continue
+        visited.add(id(node))
+        stack.append((node, True))
+        for t in node.inputs:
+            fn = t._grad_fn
+            if fn is not None and id(fn) not in visited:
+                stack.append((fn, False))
+    order.reverse()  # children (later ops) first
+    return order
+
+
+def _ones_like(data):
+    return jnp.ones_like(data)
+
+
+def _run_backward(root_tensors, root_grads, retain_graph=False,
+                  accumulate_into_grad=True, wanted=None):
+    """Core reverse pass. Returns {id(tensor): cotangent jax array} for ``wanted``
+    tensors (or all leaves if wanted is None and accumulate_into_grad)."""
+    # cotangent accumulator keyed by (node id, output index) and tensor id for leaves
+    grads: dict = {}
+    # id -> tensor registry for hook application / .grad assignment at the end
+    leaves: dict = {}
+
+    def add_grad(tensor, g):
+        key = id(tensor)
+        if key in grads:
+            grads[key] = grads[key] + g
+        else:
+            grads[key] = g
+        if tensor._grad_fn is None:
+            leaves[key] = tensor
+
+    for t, g in zip(root_tensors, root_grads):
+        add_grad(t, g)
+
+    order = _toposort(root_tensors)
+    wanted_ids = None if wanted is None else {id(t) for t in wanted}
+
+    for node in order:
+        # gather output cotangents (zeros where never produced / outputs dead)
+        cts = []
+        any_ct = False
+        for oref, (oshape, odtype) in zip(node.outputs, node.out_avals):
+            o = oref()
+            g = None if o is None else grads.get(id(o))
+            if g is None:
+                cts.append(jnp.zeros(oshape, odtype))
+                continue
+            any_ct = True
+            for hook in o._grad_hooks:
+                newg = hook(_wrap_hook_arg(o, g))
+                if newg is not None:
+                    g = _unwrap_hook_result(newg)
+            if wanted_ids is None or id(o) not in wanted_ids:
+                grads.pop(id(o), None)
+            cts.append(g)
+        if not any_ct:
+            continue
+        if node.vjp_fn is None:
+            raise RuntimeError(
+                f"Trying to backward through op '{node.name}' a second time; the "
+                "saved intermediates were freed. Pass retain_graph=True.")
+        in_cts = node.vjp_fn(tuple(cts) if node.n_outputs > 1 else cts[0])
+        if not isinstance(in_cts, (tuple, list)):
+            in_cts = (in_cts,)
+        for t, g in zip(node.inputs, in_cts):
+            if g is None:
+                continue
+            add_grad(t, g)
+        if not retain_graph:
+            node.vjp_fn = None  # free residuals
+
+    # write .grad on leaves (paddle semantics: accumulate across backward calls)
+    for tid, g in list(grads.items()):
+        t = leaves.get(tid)
+        if t is None:
+            continue
+        if accumulate_into_grad and not t.stop_gradient:
+            for hook in t._grad_hooks:
+                newg = hook(_wrap_hook_arg(t, g))
+                if newg is not None:
+                    g = _unwrap_hook_result(newg)
+            t._accumulate_grad(g)
+    return grads
+
+
+def _wrap_hook_arg(t, g):
+    from .tensor import Tensor
+    return Tensor(g, stop_gradient=True)
+
+
+def _unwrap_hook_result(r):
+    from .tensor import Tensor
+    return r._data if isinstance(r, Tensor) else r
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """paddle.autograd.backward — reference: eager/backward.cc:428."""
+    if not isinstance(tensors, (list, tuple)):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif not isinstance(grad_tensors, (list, tuple)):
+        grad_tensors = [grad_tensors]
+    roots, root_grads = [], []
+    for t, g in zip(tensors, grad_tensors):
+        if t.stop_gradient and t._grad_fn is None:
+            continue
+        roots.append(t)
+        root_grads.append(_ones_like(t._data) if g is None else g._data)
+    if not roots:
+        return
+    _run_backward(roots, root_grads, retain_graph=retain_graph)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None):
+    """Functional gradient, mirroring ``paddle.grad``.
+
+    create_graph (double grad) is not yet supported on the tape path; use
+    ``paddle_tpu.incubate.autograd`` / jax.grad composition for higher-order.
+    """
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True (double grad) is not supported by the eager tape "
+            "yet; compose jax.grad via paddle_tpu.jit for higher-order gradients.")
+    if not isinstance(outputs, (list, tuple)):
+        outputs = [outputs]
+    if not isinstance(inputs, (list, tuple)):
+        inputs = [inputs]
+    if grad_outputs is None:
+        grad_outputs = [None] * len(outputs)
+    elif not isinstance(grad_outputs, (list, tuple)):
+        grad_outputs = [grad_outputs]
+    # paddle semantics: retain_graph defaults to create_graph (False here)
+    retain = create_graph if retain_graph is None else retain_graph
+    roots, root_grads = [], []
+    for t, g in zip(outputs, grad_outputs):
+        roots.append(t)
+        root_grads.append(_ones_like(t._data) if g is None else g._data)
+    all_grads = _run_backward(roots, root_grads, retain_graph=retain,
+                              accumulate_into_grad=False, wanted=inputs)
+    from .tensor import Tensor
+    result = []
+    for t in inputs:
+        g = all_grads.get(id(t))
+        if g is None:
+            if not allow_unused:
+                raise RuntimeError(
+                    "One of the differentiated tensors appears to not have been "
+                    "used in the graph. Set allow_unused=True if this is desired.")
+            result.append(None)
+        else:
+            result.append(Tensor(g, stop_gradient=True))
+    return result

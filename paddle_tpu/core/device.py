@@ -1,0 +1,100 @@
+"""Device routing — TPU-native equivalent of ``paddle.device`` + ``phi::Place``.
+
+Reference: ``python/paddle/device/__init__.py:265`` (``set_device``) routes ops to a
+backend via DeviceContextPool; here a device string simply selects the jax default
+device, and everything downstream is XLA/PjRt. ``Place`` mirrors
+``paddle/phi/common/place.h`` as a lightweight value type.
+"""
+from __future__ import annotations
+
+import jax
+
+
+class Place:
+    """Value type mirroring phi::Place (paddle/phi/common/place.h)."""
+
+    def __init__(self, device_type: str, device_id: int = 0):
+        self.device_type = device_type
+        self.device_id = device_id
+
+    def __repr__(self):
+        return f"Place({self.device_type}:{self.device_id})"
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Place)
+            and self.device_type == other.device_type
+            and self.device_id == other.device_id
+        )
+
+    def is_tpu_place(self):
+        return self.device_type == "tpu"
+
+    def is_cpu_place(self):
+        return self.device_type == "cpu"
+
+
+TPUPlace = lambda idx=0: Place("tpu", idx)  # noqa: E731
+CPUPlace = lambda idx=0: Place("cpu", idx)  # noqa: E731
+
+_current_place = None
+
+
+def _platform_of(dev) -> str:
+    p = dev.platform
+    # jax reports the tpu platform under various names (tpu, and experimental
+    # tunneled platforms); normalize anything non-cpu/gpu-ish to "tpu".
+    if p in ("cpu", "gpu", "cuda", "rocm"):
+        return "cpu" if p == "cpu" else "gpu"
+    return "tpu"
+
+
+def get_all_devices():
+    return jax.devices()
+
+
+def set_device(device: str) -> Place:
+    """paddle.set_device('tpu') / 'tpu:0' / 'cpu'. Selects the jax default device."""
+    global _current_place
+    if ":" in device:
+        kind, idx = device.split(":")
+        idx = int(idx)
+    else:
+        kind, idx = device, 0
+    devs = jax.devices()
+    if kind in ("tpu", "xla"):
+        matching = [d for d in devs if _platform_of(d) == "tpu"] or devs
+    elif kind == "cpu":
+        try:
+            matching = jax.devices("cpu")
+        except RuntimeError:
+            matching = devs
+    else:
+        raise ValueError(
+            f"paddle_tpu supports 'tpu' and 'cpu' devices, got {device!r}")
+    dev = matching[min(idx, len(matching) - 1)]
+    jax.config.update("jax_default_device", dev)
+    _current_place = Place(kind, idx)
+    return _current_place
+
+
+def get_device() -> str:
+    if _current_place is None:
+        d = jax.devices()[0]
+        return f"{_platform_of(d)}:{d.id}"
+    return f"{_current_place.device_type}:{_current_place.device_id}"
+
+
+def get_place() -> Place:
+    if _current_place is None:
+        d = jax.devices()[0]
+        return Place(_platform_of(d), d.id)
+    return _current_place
+
+
+def device_count() -> int:
+    return len(jax.devices())
+
+
+def is_compiled_with_tpu() -> bool:
+    return True

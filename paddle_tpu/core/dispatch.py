@@ -1,0 +1,79 @@
+"""Eager op dispatch — the TPU-native replacement for the PHI dispatch path.
+
+Reference hot path (SURVEY §3.1): python → generated pybind → ad_func → kernel-key
+dispatch → PHI kernel (paddle/phi/api/lib/kernel_dispatch.h:53). Here an eager op is
+one :func:`apply` call: unwrap ``jax.Array``s, run the jnp/lax implementation (XLA
+dispatches to the current device — kernel selection, data transform, and the kernel
+registry of the reference all collapse into PjRt), and, when autograd is live, record
+the ``jax.vjp`` pullback on the tape (replacing generated GradNodes).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from . import autograd
+from .dtype import is_floating
+
+
+def _is_diff(t) -> bool:
+    from .tensor import Tensor
+    return (isinstance(t, Tensor) and not t.stop_gradient
+            and is_floating(t.dtype))
+
+
+def _unwrap(t):
+    from .tensor import Tensor
+    return t._data if isinstance(t, Tensor) else t
+
+
+def apply(name: str, fwd: Callable, inputs: Sequence[Any], nout: int = 1,
+          has_aux: bool = False):
+    """Execute an eager op through the autograd tape.
+
+    fwd operates on raw jax arrays. Convention:
+      - nout==1, has_aux=False: fwd returns one array
+      - nout>1,  has_aux=False: fwd returns a tuple of nout arrays (all differentiable)
+      - has_aux=True: fwd returns (primal_or_tuple, aux_list) where aux outputs are
+        non-differentiable (e.g. argmax indices).
+    Returns Tensor or tuple of Tensors (diff outputs first, then aux).
+    """
+    from .tensor import Tensor
+
+    arrs = [_unwrap(t) for t in inputs]
+    grad_on = autograd.is_grad_enabled()
+    diff_idx = [i for i, t in enumerate(inputs) if _is_diff(t)] if grad_on else []
+
+    if not diff_idx:
+        out = fwd(*arrs)
+        if has_aux:
+            primal, aux = out
+            primals = primal if isinstance(primal, tuple) else (primal,)
+            results = [Tensor(p, stop_gradient=True) for p in primals]
+            results += [Tensor(a, stop_gradient=True) for a in aux]
+            return results[0] if len(results) == 1 else tuple(results)
+        if nout == 1:
+            return Tensor(out, stop_gradient=True)
+        return tuple(Tensor(o, stop_gradient=True) for o in out)
+
+    def f(*diff_arrs):
+        merged = list(arrs)
+        for pos, a in zip(diff_idx, diff_arrs):
+            merged[pos] = a
+        return fwd(*merged)
+
+    diff_arrs = tuple(arrs[i] for i in diff_idx)
+    if has_aux:
+        primal, vjp_fn, aux = jax.vjp(f, *diff_arrs, has_aux=True)
+    else:
+        primal, vjp_fn = jax.vjp(f, *diff_arrs)
+        aux = ()
+
+    primals = primal if isinstance(primal, tuple) else (primal,)
+    diff_outputs = [Tensor(p, stop_gradient=False) for p in primals]
+    diff_tensors = [inputs[i] for i in diff_idx]
+    autograd.record_op(name, diff_tensors, vjp_fn, diff_outputs)
+    results = diff_outputs + [Tensor(a, stop_gradient=True) for a in aux]
+    return results[0] if len(results) == 1 else tuple(results)

@@ -1,0 +1,102 @@
+"""Dtype system for paddle_tpu.
+
+TPU-native equivalent of the reference's ``phi::DataType`` / dtype promotion
+(``paddle/phi/common/data_type.h``, ``paddle/fluid/eager/type_promotion_utils.h``):
+we lean on jax/numpy dtypes directly and expose paddle-style names
+(``paddle.float32`` etc.), with promotion delegated to jnp's weak-type aware
+``result_type`` so python scalars do not upcast arrays.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# Canonical dtype objects (numpy dtype instances; jax accepts these directly).
+bool_ = jnp.dtype("bool")
+uint8 = jnp.dtype("uint8")
+int8 = jnp.dtype("int8")
+int16 = jnp.dtype("int16")
+int32 = jnp.dtype("int32")
+int64 = jnp.dtype("int64")
+float16 = jnp.dtype("float16")
+bfloat16 = jnp.dtype(jnp.bfloat16)
+float32 = jnp.dtype("float32")
+float64 = jnp.dtype("float64")
+complex64 = jnp.dtype("complex64")
+complex128 = jnp.dtype("complex128")
+
+_NAME_TO_DTYPE = {
+    "bool": bool_,
+    "uint8": uint8,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int64": int64,
+    "float16": float16,
+    "bfloat16": bfloat16,
+    "float32": float32,
+    "float64": float64,
+    "complex64": complex64,
+    "complex128": complex128,
+}
+
+FLOATING = {float16, bfloat16, float32, float64}
+INTEGER = {uint8, int8, int16, int32, int64}
+COMPLEX = {complex64, complex128}
+
+_default_dtype = float32
+
+
+def set_default_dtype(d):
+    global _default_dtype
+    _default_dtype = convert_dtype(d)
+
+
+def get_default_dtype():
+    return _default_dtype
+
+
+def convert_dtype(d):
+    """Normalize any dtype-like (str, np.dtype, python type, paddle name) to np.dtype."""
+    if d is None:
+        return None
+    if isinstance(d, str):
+        name = d
+        if name.startswith("paddle."):
+            name = name[len("paddle."):]
+        if name in _NAME_TO_DTYPE:
+            return _NAME_TO_DTYPE[name]
+        return jnp.dtype(name)
+    if d is bool:
+        return bool_
+    if d is int:
+        return int64
+    if d is float:
+        return _default_dtype
+    return jnp.dtype(d)
+
+
+def is_floating(d) -> bool:
+    return convert_dtype(d) in FLOATING
+
+
+def is_integer(d) -> bool:
+    d = convert_dtype(d)
+    return d in INTEGER or d == bool_
+
+
+def is_complex(d) -> bool:
+    return convert_dtype(d) in COMPLEX
+
+
+def promote_types(a, b):
+    return jnp.promote_types(convert_dtype(a), convert_dtype(b))
+
+
+def np_to_default(x: np.ndarray) -> np.ndarray:
+    """Paddle-style defaulting: python floats / float64 numpy arrays become the
+    default float dtype (float32) on tensor creation, int stays int64->int32 on TPU?
+    Paddle keeps int64; we keep int32 for TPU friendliness unless explicitly asked."""
+    if x.dtype == np.float64:
+        return x.astype(_default_dtype)
+    return x

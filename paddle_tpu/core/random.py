@@ -1,0 +1,96 @@
+"""Seeded RNG — counter-based PRNG with paddle's global-seed surface.
+
+Reference: ``phi::Generator`` (paddle/phi/core/generator.h) + ``paddle.seed``
+(python/paddle/framework/random.py). TPU-native design: jax's counter-based
+threefry keys; the global generator folds a monotonically increasing counter into
+the seeded root key, so eager calls are deterministic given ``paddle.seed(n)``.
+
+Under ``jax.jit`` tracing (to_static / compiled train steps), eager stateful RNG
+would bake randomness into the compiled program. :func:`trace_key_scope` lets the
+compile layer inject a per-step key tensor; random ops then derive per-call-site
+keys by fold_in of a trace-local counter — deterministic per trace, fresh per step.
+This mirrors the TP-aware ``RNGStatesTracker`` (fleet/layers/mpu/random.py:34) needs.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+import jax.numpy as jnp
+
+
+class Generator:
+    def __init__(self, seed: int = 0):
+        self._seed = seed
+        self._counter = 0
+
+    def manual_seed(self, seed: int):
+        self._seed = int(seed)
+        self._counter = 0
+        return self
+
+    @property
+    def initial_seed(self):
+        return self._seed
+
+    def next_key(self):
+        key = jax.random.key(self._seed)
+        key = jax.random.fold_in(key, self._counter)
+        self._counter += 1
+        return key
+
+    def get_state(self):
+        return (self._seed, self._counter)
+
+    def set_state(self, state):
+        self._seed, self._counter = state
+
+
+class _TraceRNG(threading.local):
+    def __init__(self):
+        self.key = None
+        self.counter = 0
+
+
+_default_generator = Generator(0)
+_trace_rng = _TraceRNG()
+
+
+def default_generator() -> Generator:
+    return _default_generator
+
+
+def seed(value: int) -> Generator:
+    """paddle.seed"""
+    return _default_generator.manual_seed(value)
+
+
+def get_rng_state():
+    return _default_generator.get_state()
+
+
+def set_rng_state(state):
+    _default_generator.set_state(state)
+
+
+@contextlib.contextmanager
+def trace_key_scope(key):
+    """Route random ops to fold_in(key, callsite_counter) — used when staging
+    eager code under jax.jit so randomness stays an input, not a constant."""
+    prev_key, prev_counter = _trace_rng.key, _trace_rng.counter
+    _trace_rng.key = key
+    _trace_rng.counter = 0
+    try:
+        yield
+    finally:
+        _trace_rng.key, _trace_rng.counter = prev_key, prev_counter
+
+
+def next_key():
+    """Key for one random op call (eager or traced)."""
+    if _trace_rng.key is not None:
+        k = jax.random.fold_in(_trace_rng.key, _trace_rng.counter)
+        _trace_rng.counter += 1
+        return k
+    return _default_generator.next_key()

@@ -1,0 +1,3 @@
+from . import io  # noqa: F401
+from ..core.random import seed  # noqa: F401
+from .io import load, save  # noqa: F401

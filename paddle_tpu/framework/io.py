@@ -1,0 +1,63 @@
+"""paddle.save / paddle.load — pickle-based object persistence.
+
+Reference: python/paddle/framework/io.py:721 (save) / :960 (load). Tensors are
+serialized as numpy arrays + dtype tag (bfloat16 round-trips via uint16 view);
+nested dict/list state_dicts mirror paddle's format so checkpoints are portable.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Parameter, Tensor
+
+_BF16_TAG = "__paddle_tpu_bf16__"
+
+
+def _pack(obj):
+    if isinstance(obj, Tensor):
+        arr = np.asarray(obj._data)
+        if arr.dtype == jnp.bfloat16:
+            return {_BF16_TAG: True, "data": arr.view(np.uint16),
+                    "trainable": not obj.stop_gradient}
+        return {"__paddle_tpu_tensor__": True, "data": arr,
+                "trainable": not obj.stop_gradient}
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_pack(v) for v in obj)
+    return obj
+
+
+def _unpack(obj, return_numpy=False):
+    if isinstance(obj, dict):
+        if obj.get(_BF16_TAG):
+            arr = obj["data"].view(jnp.bfloat16)
+            return arr if return_numpy else Tensor(
+                arr, stop_gradient=not obj.get("trainable", False))
+        if obj.get("__paddle_tpu_tensor__"):
+            arr = obj["data"]
+            return arr if return_numpy else Tensor(
+                arr, stop_gradient=not obj.get("trainable", False))
+        return {k: _unpack(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_unpack(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_pack(obj), f, protocol=protocol)
+
+
+def load(path, return_numpy=False, **configs):
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _unpack(obj, return_numpy=return_numpy)

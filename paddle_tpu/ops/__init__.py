@@ -1,0 +1,77 @@
+"""Op registry + Tensor method binding.
+
+This is the TPU-native stand-in for the reference's codegen spine
+(paddle/phi/api/yaml/ops.yaml → generated C++ API + pybind methods, SURVEY §1):
+ops are plain python functions over jnp (VJPs come free from jax.vjp at dispatch
+time), and this module binds them onto both the ``paddle_tpu`` namespace and
+``Tensor`` methods — the equivalent of eager_op_function.cc + math op patches
+(python/paddle/base/dygraph/math_op_patch.py).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+from .creation import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+from .random_ops import *  # noqa: F401,F403
+
+from . import creation, linalg, logic, manipulation, math, random_ops, search
+
+
+def einsum(equation, *operands, name=None):
+    tensors = list(operands)
+    return apply("einsum", lambda *xs: jnp.einsum(equation, *xs), tensors)
+
+
+def one_hot(x, num_classes, name=None):
+    import jax
+    return Tensor(jax.nn.one_hot(x._data, num_classes))
+
+
+# Bind op functions as Tensor methods (the reference patches these via pybind
+# eager_method.cc + tensor_patch_methods.py).
+_METHOD_SOURCES = [math, manipulation, logic, linalg, search, creation]
+_NO_METHOD = {
+    "to_tensor", "zeros", "ones", "full", "arange", "linspace", "logspace",
+    "eye", "empty", "meshgrid", "tril_indices", "triu_indices", "assign",
+    "broadcast_tensors", "broadcast_shape", "is_tensor", "scatter_nd",
+    "complex", "polar",
+}
+
+
+def _bind():
+    import types
+    for mod in _METHOD_SOURCES:
+        for name, fn in vars(mod).items():
+            if name.startswith("_") or not isinstance(fn, types.FunctionType):
+                continue
+            if fn.__module__ != mod.__name__:  # skip imported helpers
+                continue
+            if name in _NO_METHOD or hasattr(Tensor, name):
+                continue
+            setattr(Tensor, name, fn)
+    # in-place aliases + a few extras paddle exposes as methods
+    Tensor.add_ = lambda self, y: self._inplace(add, y)
+    Tensor.subtract_ = lambda self, y: self._inplace(subtract, y)
+    Tensor.multiply_ = lambda self, y: self._inplace(multiply, y)
+    Tensor.divide_ = lambda self, y: self._inplace(divide, y)
+    Tensor.clip_ = lambda self, min=None, max=None: self._inplace(clip, min, max)
+    Tensor.scale_ = lambda self, s=1.0, bias=0.0, bias_after_scale=True: \
+        self._inplace(scale, s, bias, bias_after_scale)
+    Tensor.exp_ = lambda self: self._inplace(exp)
+    Tensor.sqrt_ = lambda self: self._inplace(sqrt)
+    Tensor.tanh_ = lambda self: self._inplace(tanh)
+    Tensor.floor_ = lambda self: self._inplace(floor)
+    Tensor.ceil_ = lambda self: self._inplace(ceil)
+    Tensor.round_ = lambda self: self._inplace(round)
+    Tensor.neg_ = lambda self: self._inplace(neg)
+    Tensor.reciprocal_ = lambda self: self._inplace(reciprocal)
+
+
+_bind()

@@ -1,0 +1,175 @@
+"""Tensor creation ops (reference: python/paddle/tensor/creation.py, PHI kernels
+``full``, ``arange`` etc.). All constructors produce Tensors on the current device
+via jnp; XLA handles placement."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dtype import convert_dtype, get_default_dtype
+from ..core.tensor import Tensor, to_tensor  # noqa: F401
+
+__all__ = [
+    "to_tensor", "zeros", "ones", "full", "zeros_like", "ones_like", "full_like",
+    "arange", "linspace", "logspace", "eye", "empty", "empty_like", "diag",
+    "diagflat", "meshgrid", "tril", "triu", "assign", "clone", "numel",
+    "tril_indices", "triu_indices", "diag_embed", "complex", "polar",
+]
+
+
+def _dt(dtype, default_float=True):
+    if dtype is None:
+        return get_default_dtype() if default_float else None
+    return convert_dtype(dtype)
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.numpy())
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s) for s in shape)
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape(shape), _dt(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape(shape), _dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    return Tensor(jnp.full(_shape(shape), fill_value, _dt(dtype)))
+
+
+def zeros_like(x, dtype=None, name=None):
+    return Tensor(jnp.zeros_like(x._data, dtype=convert_dtype(dtype)))
+
+
+def ones_like(x, dtype=None, name=None):
+    return Tensor(jnp.ones_like(x._data, dtype=convert_dtype(dtype)))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return Tensor(jnp.full_like(x._data, fill_value, dtype=convert_dtype(dtype)))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    def _v(x):
+        return x.item() if isinstance(x, Tensor) else x
+    start, end, step = _v(start), _v(end), _v(step)
+    if end is None:
+        start, end = 0, start
+    return Tensor(jnp.arange(start, end, step, dtype=convert_dtype(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    def _v(x):
+        return x.item() if isinstance(x, Tensor) else x
+    return Tensor(jnp.linspace(_v(start), _v(stop), int(_v(num)),
+                               dtype=convert_dtype(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return Tensor(jnp.logspace(start, stop, int(num), base=base,
+                               dtype=convert_dtype(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(num_rows, num_columns, dtype=_dt(dtype)))
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    from ..core.dispatch import apply
+    if x.ndim == 1 and padding_value != 0:
+        def f(a):
+            n = a.shape[0] + abs(offset)
+            out = jnp.full((n, n), padding_value, a.dtype)
+            return out + jnp.diag(a, k=offset) - jnp.diag(
+                jnp.full((a.shape[0],), padding_value, a.dtype), k=offset)
+        return apply("diag", f, [x])
+    return apply("diag", lambda a: jnp.diag(a, k=offset), [x])
+
+
+def diagflat(x, offset=0, name=None):
+    from ..core.dispatch import apply
+    return apply("diagflat", lambda a: jnp.diagflat(a, k=offset), [x])
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None):
+    from ..core.dispatch import apply
+
+    def f(a):
+        m = a.shape[-1] + abs(offset)
+        base = jnp.zeros(a.shape[:-1] + (m, m), a.dtype)
+        idx = jnp.arange(a.shape[-1])
+        r = idx + max(-offset, 0)
+        c = idx + max(offset, 0)
+        out = base.at[..., r, c].set(a)
+        if (dim1, dim2) not in ((-2, -1), (a.ndim - 1, a.ndim)):
+            out = jnp.moveaxis(out, (-2, -1), (dim1, dim2))
+        return out
+    return apply("diag_embed", f, [x])
+
+
+def meshgrid(*args, **kwargs):
+    arrs = args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args
+    outs = jnp.meshgrid(*[a._data for a in arrs], indexing="ij")
+    return [Tensor(o) for o in outs]
+
+
+def tril(x, diagonal=0, name=None):
+    from ..core.dispatch import apply
+    return apply("tril", lambda a: jnp.tril(a, k=diagonal), [x])
+
+
+def triu(x, diagonal=0, name=None):
+    from ..core.dispatch import apply
+    return apply("triu", lambda a: jnp.triu(a, k=diagonal), [x])
+
+
+def tril_indices(row, col, offset=0, dtype="int64"):
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=convert_dtype(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    r, c = np.triu_indices(row, offset, col if col is not None else row)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=convert_dtype(dtype)))
+
+
+def assign(x, output=None):
+    val = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    if output is not None:
+        output.set_value(val)
+        return output
+    return Tensor(val)
+
+
+def clone(x, name=None):
+    return x.clone()
+
+
+def numel(x, name=None):
+    return x.numel()
+
+
+def complex(real, imag, name=None):  # noqa: A001
+    from ..core.dispatch import apply
+    return apply("complex", lambda r, i: r + 1j * i, [real, imag])
+
+
+def polar(abs_, angle, name=None):
+    from ..core.dispatch import apply
+    return apply("polar", lambda a, t: a * jnp.exp(1j * t), [abs_, angle])

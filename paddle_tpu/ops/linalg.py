@@ -1,0 +1,200 @@
+"""Linear algebra ops (reference: python/paddle/tensor/linalg.py — matmul at
+linalg.py:151; PHI blas via funcs/blas). matmul maps straight to the MXU through
+XLA dot_general; bf16 inputs hit the systolic array natively."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    """Reference: python/paddle/tensor/linalg.py:151 → _C_ops.matmul."""
+    def f(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+    return apply("matmul", f, [x, y])
+
+
+def mm(x, y, name=None):
+    return matmul(x, y)
+
+
+def bmm(x, y, name=None):
+    return apply("bmm", jnp.matmul, [x, y])
+
+
+def mv(x, vec, name=None):
+    return apply("mv", jnp.matmul, [x, vec])
+
+
+def multi_dot(tensors, name=None):
+    return apply("multi_dot", lambda *xs: jnp.linalg.multi_dot(xs), list(tensors))
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    pp = p if p is not None else ("fro" if (ax is None or
+                                            isinstance(ax, tuple)) else 2)
+
+    def f(a):
+        if ax is None:
+            flat = a.reshape(-1)
+            if pp == "fro" or pp == 2:
+                return jnp.sqrt(jnp.sum(flat * flat))
+            if pp == np.inf:
+                return jnp.max(jnp.abs(flat))
+            if pp == -np.inf:
+                return jnp.min(jnp.abs(flat))
+            if pp == 1:
+                return jnp.sum(jnp.abs(flat))
+            if pp == 0:
+                return jnp.sum((flat != 0).astype(a.dtype))
+            return jnp.sum(jnp.abs(flat) ** pp) ** (1.0 / pp)
+        return jnp.linalg.norm(a, ord=pp, axis=ax, keepdims=keepdim)
+    return apply("norm", f, [x])
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return apply("vector_norm",
+                 lambda a: jnp.linalg.vector_norm(a, ord=p, axis=ax,
+                                                  keepdims=keepdim), [x])
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    ax = tuple(int(a) for a in axis)
+
+    def f(a):
+        moved = jnp.moveaxis(a, ax, (-2, -1))
+        out = jnp.linalg.matrix_norm(moved, ord=p, keepdims=keepdim)
+        if keepdim:
+            out = jnp.moveaxis(out, (-2, -1), ax)
+        return out
+    return apply("matrix_norm", f, [x])
+
+
+def dist(x, y, p=2, name=None):
+    def f(a, b):
+        return jnp.linalg.norm((a - b).reshape(-1), ord=p)
+    return apply("dist", f, [x, y])
+
+
+def cholesky(x, upper=False, name=None):
+    def f(a):
+        low = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(low, -1, -2) if upper else low
+    return apply("cholesky", f, [x])
+
+
+def inverse(x, name=None):
+    return apply("inverse", jnp.linalg.inv, [x])
+
+
+inv = inverse
+
+
+def det(x, name=None):
+    return apply("det", jnp.linalg.det, [x])
+
+
+def slogdet(x, name=None):
+    out = apply("slogdet", lambda a: tuple(jnp.linalg.slogdet(a)), [x], nout=2)
+    return out
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply("pinv", lambda a: jnp.linalg.pinv(a, rtol=rcond,
+                                                   hermitian=hermitian), [x])
+
+
+def solve(x, y, name=None):
+    return apply("solve", jnp.linalg.solve, [x, y])
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
+                     name=None):
+    from jax.scipy.linalg import solve_triangular
+
+    def f(a, b):
+        return solve_triangular(a, b, lower=not upper,
+                                trans=1 if transpose else 0,
+                                unit_diagonal=unitriangular)
+    return apply("triangular_solve", f, [x, y])
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    from jax.scipy.linalg import cho_solve
+
+    def f(b, L):
+        return cho_solve((L, not upper), b)
+    return apply("cholesky_solve", f, [x, y])
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    sol, res, rank, sv = np.linalg.lstsq(np.asarray(x._data),
+                                         np.asarray(y._data), rcond=rcond)
+    return (Tensor(sol), Tensor(res), Tensor(rank), Tensor(sv))
+
+
+def matrix_power(x, n, name=None):
+    return apply("matrix_power", lambda a: jnp.linalg.matrix_power(a, n), [x])
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return Tensor(jnp.linalg.matrix_rank(x._data, rtol=tol))
+
+
+def qr(x, mode="reduced", name=None):
+    out = apply("qr", lambda a: tuple(jnp.linalg.qr(a, mode=mode)), [x], nout=2)
+    return out
+
+
+def svd(x, full_matrices=False, name=None):
+    return apply("svd",
+                 lambda a: tuple(jnp.linalg.svd(a, full_matrices=full_matrices)),
+                 [x], nout=3)
+
+
+def eig(x, name=None):
+    w, v = np.linalg.eig(np.asarray(x._data))
+    return Tensor(w), Tensor(v)
+
+
+def eigh(x, UPLO="L", name=None):
+    return apply("eigh", lambda a: tuple(jnp.linalg.eigh(a, UPLO=UPLO)),
+                 [x], nout=2)
+
+
+def eigvals(x, name=None):
+    return Tensor(np.linalg.eigvals(np.asarray(x._data)))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return apply("eigvalsh", lambda a: jnp.linalg.eigvalsh(a, UPLO=UPLO), [x])
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return apply("cov", lambda a: jnp.cov(a, rowvar=rowvar,
+                                          ddof=1 if ddof else 0), [x])
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return apply("corrcoef", lambda a: jnp.corrcoef(a, rowvar=rowvar), [x])
+
+
+def histogram(x, bins=100, min=0, max=0, name=None):  # noqa: A002
+    rng = None if (min == 0 and max == 0) else (min, max)
+    hist, _ = jnp.histogram(x._data, bins=bins, range=rng)
+    return Tensor(hist.astype(jnp.int64))
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    w = weights._data if isinstance(weights, Tensor) else weights
+    return Tensor(jnp.bincount(x._data, weights=w, minlength=minlength,
+                               length=None))

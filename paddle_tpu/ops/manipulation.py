@@ -1,0 +1,442 @@
+"""Shape/layout manipulation ops (reference: python/paddle/tensor/manipulation.py,
+PHI kernels reshape/transpose/concat/...). Pure-metadata ops are free under XLA."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply
+from ..core.dtype import convert_dtype
+from ..core.tensor import Tensor
+
+
+def _ints(v):
+    if isinstance(v, Tensor):
+        return tuple(int(i) for i in v.numpy())
+    if isinstance(v, (int, np.integer)):
+        return int(v)
+    return tuple(int(i) if not isinstance(i, Tensor) else int(i.item()) for i in v)
+
+
+def cast(x, dtype):
+    return x.astype(dtype)
+
+
+def reshape(x, shape, name=None):
+    shape = _ints(shape)
+    return apply("reshape", lambda a: a.reshape(shape), [x])
+
+
+def reshape_(x, shape, name=None):
+    return x._inplace(reshape, shape)
+
+
+def transpose(x, perm, name=None):
+    perm = _ints(perm)
+    return apply("transpose", lambda a: jnp.transpose(a, perm), [x])
+
+
+def t(x, name=None):
+    if x.ndim < 2:
+        return x.clone()
+    return transpose(x, [1, 0])
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    nd = x.ndim
+    s = start_axis % nd if nd else 0
+    e = stop_axis % nd if nd else 0
+
+    def f(a):
+        shape = a.shape[:s] + (-1,) + a.shape[e + 1:]
+        return a.reshape(shape)
+    return apply("flatten", f, [x])
+
+
+def squeeze(x, axis=None, name=None):
+    if axis is None:
+        return apply("squeeze", lambda a: jnp.squeeze(a), [x])
+    ax = _ints(axis)
+    if isinstance(ax, int):
+        ax = (ax,)
+    ax = tuple(a for a in ax if x.shape[a] == 1)
+    return apply("squeeze", lambda a: jnp.squeeze(a, axis=ax), [x])
+
+
+def unsqueeze(x, axis, name=None):
+    ax = _ints(axis)
+    return apply("unsqueeze", lambda a: jnp.expand_dims(a, ax), [x])
+
+
+def squeeze_(x, axis=None, name=None):
+    return x._inplace(squeeze, axis)
+
+
+def unsqueeze_(x, axis, name=None):
+    return x._inplace(unsqueeze, axis)
+
+
+def concat(x, axis=0, name=None):
+    tensors = list(x)
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    return apply("concat", lambda *xs: jnp.concatenate(xs, axis=ax), tensors)
+
+
+def stack(x, axis=0, name=None):
+    tensors = list(x)
+    return apply("stack", lambda *xs: jnp.stack(xs, axis=axis), tensors)
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    dim = x.shape[ax]
+    if isinstance(num_or_sections, int):
+        sizes = [dim // num_or_sections] * num_or_sections
+    else:
+        sizes = [-1 if s in (-1, None) else int(s) for s in num_or_sections]
+        known = sum(s for s in sizes if s >= 0)
+        sizes = [s if s >= 0 else dim - known for s in sizes]
+    offsets = np.cumsum([0] + sizes)
+    out = apply("split", lambda a: tuple(jnp.split(a, offsets[1:-1].tolist(),
+                                                   axis=ax)), [x], nout=len(sizes))
+    return list(out) if isinstance(out, tuple) else [out]
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def unbind(x, axis=0, name=None):
+    n = x.shape[axis]
+    out = apply("unbind",
+                lambda a: tuple(jnp.squeeze(s, axis=axis)
+                                for s in jnp.split(a, n, axis=axis)),
+                [x], nout=n)
+    return list(out) if isinstance(out, tuple) else [out]
+
+
+def tile(x, repeat_times, name=None):
+    reps = _ints(repeat_times)
+    return apply("tile", lambda a: jnp.tile(a, reps), [x])
+
+
+def expand(x, shape, name=None):
+    shape = _ints(shape)
+    tgt = tuple(x.shape[i - (len(shape) - x.ndim)] if s in (-1, None) and
+                i >= len(shape) - x.ndim else s for i, s in enumerate(shape))
+    return apply("expand", lambda a: jnp.broadcast_to(a, tgt), [x])
+
+
+def expand_as(x, y, name=None):
+    return apply("expand_as", lambda a: jnp.broadcast_to(a, tuple(y.shape)), [x])
+
+
+def broadcast_to(x, shape, name=None):
+    return apply("broadcast_to",
+                 lambda a: jnp.broadcast_to(a, _ints(shape)), [x])
+
+
+def broadcast_tensors(inputs, name=None):
+    shapes = [tuple(t.shape) for t in inputs]
+    tgt = np.broadcast_shapes(*shapes)
+    return [broadcast_to(t, tgt) for t in inputs]
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def flip(x, axis, name=None):
+    ax = _ints(axis)
+    return apply("flip", lambda a: jnp.flip(a, axis=ax), [x])
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply("rot90", lambda a: jnp.rot90(a, k=k, axes=tuple(axes)), [x])
+
+
+def roll(x, shifts, axis=None, name=None):
+    sh = _ints(shifts)
+    ax = _ints(axis) if axis is not None else None
+    return apply("roll", lambda a: jnp.roll(a, sh, axis=ax), [x])
+
+
+def moveaxis(x, source, destination, name=None):
+    return apply("moveaxis",
+                 lambda a: jnp.moveaxis(a, _ints(source), _ints(destination)), [x])
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    return apply("swapaxes", lambda a: jnp.swapaxes(a, axis0, axis1), [x])
+
+
+def gather(x, index, axis=0, name=None):
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    idx = index._data if isinstance(index, Tensor) else jnp.asarray(index)
+    if idx.ndim > 1:
+        idx = idx.reshape(-1)
+    return apply("gather", lambda a: jnp.take(a, idx, axis=ax), [x])
+
+
+def gather_nd(x, index, name=None):
+    idx = index._data if isinstance(index, Tensor) else jnp.asarray(index)
+
+    def f(a):
+        ix = tuple(jnp.moveaxis(idx, -1, 0))
+        return a[ix]
+    return apply("gather_nd", f, [x])
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    idx = index._data if isinstance(index, Tensor) else jnp.asarray(index)
+    if idx.ndim == 2 and idx.shape[1] == 1:
+        idx = idx.reshape(-1)
+
+    def f(a, u):
+        if overwrite:
+            return a.at[idx].set(u)
+        # paddle semantics: non-overwrite zeroes target rows then accumulates
+        zeroed = a.at[idx].set(jnp.zeros_like(u))
+        return zeroed.at[idx].add(u)
+    return apply("scatter", f, [x, updates])
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    return x._inplace(scatter, index, updates, overwrite)
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    idx = index._data if isinstance(index, Tensor) else jnp.asarray(index)
+
+    def f(a, u):
+        ix = tuple(jnp.moveaxis(idx, -1, 0))
+        return a.at[ix].add(u)
+    return apply("scatter_nd_add", f, [x, updates])
+
+
+def scatter_nd(index, updates, shape, name=None):
+    from .creation import zeros
+    return scatter_nd_add(zeros(shape, dtype=updates.dtype), index, updates)
+
+
+def index_select(x, index, axis=0, name=None):
+    idx = index._data if isinstance(index, Tensor) else jnp.asarray(index)
+    return apply("index_select", lambda a: jnp.take(a, idx, axis=axis), [x])
+
+
+def index_sample(x, index, name=None):
+    idx = index._data if isinstance(index, Tensor) else jnp.asarray(index)
+    return apply("index_sample",
+                 lambda a: jnp.take_along_axis(a, idx, axis=1), [x])
+
+
+def index_add(x, index, axis, value, name=None):
+    idx = index._data if isinstance(index, Tensor) else jnp.asarray(index)
+
+    def f(a, v):
+        moved = jnp.moveaxis(a, axis, 0)
+        vmoved = jnp.moveaxis(v, axis, 0)
+        out = moved.at[idx].add(vmoved)
+        return jnp.moveaxis(out, 0, axis)
+    return apply("index_add", f, [x, value])
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    ix = tuple(i._data if isinstance(i, Tensor) else jnp.asarray(i)
+               for i in indices)
+
+    def f(a, v):
+        return a.at[ix].add(v) if accumulate else a.at[ix].set(v)
+    if isinstance(value, Tensor):
+        return apply("index_put", f, [x, value])
+    return apply("index_put", lambda a: f(a, value), [x])
+
+
+def masked_select(x, mask, name=None):
+    # Output shape is data-dependent: sync the mask to host for the index set,
+    # then gather differentiably so gradients still flow to x.
+    m = np.asarray(mask._data if isinstance(mask, Tensor) else mask)
+    m = np.broadcast_to(m, tuple(x.shape))
+    idx = jnp.asarray(np.flatnonzero(m))
+    return apply("masked_select", lambda a: jnp.take(a.reshape(-1), idx), [x])
+
+
+def masked_fill(x, mask, value, name=None):
+    m = mask._data if isinstance(mask, Tensor) else jnp.asarray(mask)
+    v = value.item() if isinstance(value, Tensor) and value.ndim == 0 else value
+    if isinstance(v, Tensor):
+        return apply("masked_fill", lambda a, b: jnp.where(m, b, a), [x, v])
+    return apply("masked_fill", lambda a: jnp.where(m, v, a), [x])
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        from .search import nonzero
+        return nonzero(condition, as_tuple=True)
+    cond = condition._data if isinstance(condition, Tensor) else jnp.asarray(
+        condition)
+    if not isinstance(x, Tensor) and not isinstance(y, Tensor):
+        return Tensor(jnp.where(cond, x, y))
+    if not isinstance(x, Tensor):
+        return apply("where", lambda b: jnp.where(cond, x, b), [y])
+    if not isinstance(y, Tensor):
+        return apply("where", lambda a: jnp.where(cond, a, y), [x])
+    return apply("where", lambda a, b: jnp.where(cond, a, b), [x, y])
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    idx = indices._data if isinstance(indices, Tensor) else jnp.asarray(indices)
+    return apply("take_along_axis",
+                 lambda a: jnp.take_along_axis(a, idx, axis=axis), [arr])
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):  # noqa: A002
+    idx = indices._data if isinstance(indices, Tensor) else jnp.asarray(indices)
+
+    def f(a, v):
+        v = jnp.broadcast_to(v, idx.shape).astype(a.dtype) \
+            if not hasattr(v, "shape") or v.shape != idx.shape else v.astype(a.dtype)
+        dims = list(range(a.ndim))
+        dims.remove(axis % a.ndim)
+        grids = jnp.meshgrid(*[jnp.arange(s) for s in idx.shape], indexing="ij")
+        full_idx = [None] * a.ndim
+        full_idx[axis % a.ndim] = idx
+        for d in dims:
+            full_idx[d] = grids[d]
+        ix = tuple(full_idx)
+        if reduce == "assign":
+            return a.at[ix].set(v)
+        if reduce == "add":
+            return a.at[ix].add(v)
+        if reduce in ("mul", "multiply"):
+            return a.at[ix].multiply(v)
+        raise ValueError(f"unknown reduce {reduce}")
+    if isinstance(values, Tensor):
+        return apply("put_along_axis", f, [arr, values])
+    return apply("put_along_axis", lambda a: f(a, jnp.asarray(values)), [arr])
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    if axis is None:
+        x = flatten(x)
+        axis = 0
+    if isinstance(repeats, Tensor):
+        reps = repeats._data
+        total = int(jnp.sum(reps))
+        return apply("repeat_interleave",
+                     lambda a: jnp.repeat(a, reps, axis=axis,
+                                          total_repeat_length=total), [x])
+    return apply("repeat_interleave",
+                 lambda a: jnp.repeat(a, int(repeats), axis=axis), [x])
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    res = np.unique(np.asarray(x._data), return_index=return_index,
+                    return_inverse=return_inverse, return_counts=return_counts,
+                    axis=axis)
+    if not (return_index or return_inverse or return_counts):
+        return Tensor(res)
+    out = [Tensor(r) for r in res]
+    return tuple(out)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
+                       dtype="int64", name=None):
+    arr = np.asarray(x._data)
+    if axis is None:
+        arr = arr.reshape(-1)
+        change = np.concatenate([[True], arr[1:] != arr[:-1]])
+    else:
+        moved = np.moveaxis(arr, axis, 0)
+        change = np.concatenate(
+            [[True], np.any(moved[1:] != moved[:-1],
+                            axis=tuple(range(1, moved.ndim)))])
+    idx = np.nonzero(change)[0]
+    vals = np.take(arr, idx, axis=axis or 0)
+    outs = [Tensor(vals)]
+    if return_inverse:
+        outs.append(Tensor(np.cumsum(change) - 1))
+    if return_counts:
+        counts = np.diff(np.append(idx, arr.shape[axis or 0]))
+        outs.append(Tensor(counts))
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+def slice(x, axes, starts, ends, name=None):  # noqa: A001
+    idx = [np.s_[:]] * x.ndim
+    for ax, s, e in zip(_ints(axes) if not isinstance(axes, int) else [axes],
+                        _ints(starts) if not isinstance(starts, int) else [starts],
+                        _ints(ends) if not isinstance(ends, int) else [ends]):
+        idx[ax] = np.s_[s:e]
+    idx = tuple(idx)
+    return apply("slice", lambda a: a[idx], [x])
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    idx = [np.s_[:]] * x.ndim
+    for ax, s, e, st in zip(axes, starts, ends, strides):
+        idx[ax] = np.s_[s:e:st]
+    idx = tuple(idx)
+    return apply("strided_slice", lambda a: a[idx], [x])
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    shape = _ints(shape)
+    offsets = _ints(offsets) if offsets is not None else (0,) * x.ndim
+    idx = tuple(np.s_[o:o + s if s != -1 else None]
+                for o, s in zip(offsets, shape))
+    return apply("crop", lambda a: a[idx], [x])
+
+
+def as_complex(x, name=None):
+    return apply("as_complex", lambda a: a[..., 0] + 1j * a[..., 1], [x])
+
+
+def as_real(x, name=None):
+    return apply("as_real",
+                 lambda a: jnp.stack([jnp.real(a), jnp.imag(a)], axis=-1), [x])
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    return Tensor(x._data.view(convert_dtype(shape_or_dtype)))
+
+
+def view_as(x, other, name=None):
+    return reshape(x, other.shape)
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [reshape(x, [1]) if x.ndim == 0 else x for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs, name=None):
+    outs = []
+    for x in inputs:
+        while x.ndim < 2:
+            x = unsqueeze(x, 0)
+        outs.append(x)
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs, name=None):
+    outs = []
+    for x in inputs:
+        while x.ndim < 3:
+            x = unsqueeze(x, -1) if x.ndim >= 1 else unsqueeze(x, 0)
+        outs.append(x)
+    return outs[0] if len(outs) == 1 else outs
+
+
+def tensordot(x, y, axes=2, name=None):
+    ax = axes
+    if isinstance(ax, Tensor):
+        ax = ax.tolist()
+    return apply("tensordot", lambda a, b: jnp.tensordot(a, b, axes=ax), [x, y])
+
+
+def tolist(x):
+    return x.tolist()

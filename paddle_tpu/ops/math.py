@@ -1,0 +1,331 @@
+"""Elementwise + reduction math ops (reference: python/paddle/tensor/math.py,
+PHI kernels under paddle/phi/kernels/{cpu,gpu}/). Each op is one jnp call through
+the autograd tape; XLA fuses chains of these into single kernels, which replaces
+the reference's hand-fused elementwise CUDA kernels."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.scipy import special as jsp
+
+from ..core.dispatch import apply
+from ..core.dtype import convert_dtype
+from ..core.tensor import Tensor
+
+
+def _binary(opname, jfn):
+    def op(x, y, name=None):
+        if not isinstance(x, Tensor) and not isinstance(y, Tensor):
+            x = Tensor(x)
+        return apply(opname, jfn, [x, y])
+    op.__name__ = opname
+    return op
+
+
+def _unary(opname, jfn):
+    def op(x, name=None):
+        return apply(opname, jfn, [x])
+    op.__name__ = opname
+    return op
+
+
+# ---- binary elementwise ----
+add = _binary("add", jnp.add)
+subtract = _binary("subtract", jnp.subtract)
+multiply = _binary("multiply", jnp.multiply)
+divide = _binary("divide", jnp.divide)
+floor_divide = _binary("floor_divide", jnp.floor_divide)
+remainder = _binary("remainder", jnp.remainder)
+mod = remainder
+floor_mod = remainder
+pow = _binary("pow", jnp.power)  # noqa: A001
+maximum = _binary("maximum", jnp.maximum)
+minimum = _binary("minimum", jnp.minimum)
+fmax = _binary("fmax", jnp.fmax)
+fmin = _binary("fmin", jnp.fmin)
+atan2 = _binary("atan2", jnp.arctan2)
+logaddexp = _binary("logaddexp", jnp.logaddexp)
+hypot = _binary("hypot", jnp.hypot)
+nextafter = _binary("nextafter", jnp.nextafter)
+copysign = _binary("copysign", jnp.copysign)
+gcd = _binary("gcd", jnp.gcd)
+lcm = _binary("lcm", jnp.lcm)
+heaviside = _binary("heaviside", jnp.heaviside)
+inner = _binary("inner", jnp.inner)
+outer = _binary("outer", jnp.outer)
+kron = _binary("kron", jnp.kron)
+
+# ---- unary elementwise ----
+abs = _unary("abs", jnp.abs)  # noqa: A001
+neg = _unary("neg", jnp.negative)
+exp = _unary("exp", jnp.exp)
+expm1 = _unary("expm1", jnp.expm1)
+log = _unary("log", jnp.log)
+log2 = _unary("log2", jnp.log2)
+log10 = _unary("log10", jnp.log10)
+log1p = _unary("log1p", jnp.log1p)
+sqrt = _unary("sqrt", jnp.sqrt)
+rsqrt = _unary("rsqrt", lax.rsqrt)
+square = _unary("square", jnp.square)
+sin = _unary("sin", jnp.sin)
+cos = _unary("cos", jnp.cos)
+tan = _unary("tan", jnp.tan)
+asin = _unary("asin", jnp.arcsin)
+acos = _unary("acos", jnp.arccos)
+atan = _unary("atan", jnp.arctan)
+sinh = _unary("sinh", jnp.sinh)
+cosh = _unary("cosh", jnp.cosh)
+tanh = _unary("tanh", jnp.tanh)
+asinh = _unary("asinh", jnp.arcsinh)
+acosh = _unary("acosh", jnp.arccosh)
+atanh = _unary("atanh", jnp.arctanh)
+ceil = _unary("ceil", jnp.ceil)
+floor = _unary("floor", jnp.floor)
+round = _unary("round", jnp.round)  # noqa: A001
+trunc = _unary("trunc", jnp.trunc)
+frac = _unary("frac", lambda a: a - jnp.trunc(a))
+sign = _unary("sign", jnp.sign)
+reciprocal = _unary("reciprocal", jnp.reciprocal)
+sigmoid = _unary("sigmoid", jax.nn.sigmoid)
+erf = _unary("erf", jsp.erf)
+erfinv = _unary("erfinv", jsp.erfinv)
+digamma = _unary("digamma", jsp.digamma)
+lgamma = _unary("lgamma", jsp.gammaln)
+i0 = _unary("i0", jsp.i0)
+i0e = _unary("i0e", jsp.i0e)
+i1 = _unary("i1", jsp.i1)
+i1e = _unary("i1e", jsp.i1e)
+logit = _unary("logit", lambda a: jnp.log(a / (1 - a)))
+angle = _unary("angle", jnp.angle)
+conj = _unary("conj", jnp.conj)
+real = _unary("real", jnp.real)
+imag = _unary("imag", jnp.imag)
+deg2rad = _unary("deg2rad", jnp.deg2rad)
+rad2deg = _unary("rad2deg", jnp.rad2deg)
+exponent = _unary("exponent", lambda a: jnp.frexp(a)[1].astype(a.dtype))
+
+
+def isnan(x, name=None):
+    return apply("isnan", jnp.isnan, [x.detach() if not x.stop_gradient else x])
+
+
+def isinf(x, name=None):
+    return apply("isinf", jnp.isinf, [x.detach() if not x.stop_gradient else x])
+
+
+def isfinite(x, name=None):
+    return apply("isfinite", jnp.isfinite,
+                 [x.detach() if not x.stop_gradient else x])
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return apply("nan_to_num",
+                 lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf,
+                                          neginf=neginf), [x])
+
+
+def clip(x, min=None, max=None, name=None):  # noqa: A002
+    mn = min.item() if isinstance(min, Tensor) else min
+    mx = max.item() if isinstance(max, Tensor) else max
+    return apply("clip", lambda a: jnp.clip(a, mn, mx), [x])
+
+
+def lerp(x, y, weight, name=None):
+    if isinstance(weight, Tensor):
+        return apply("lerp", lambda a, b, w: a + w * (b - a), [x, y, weight])
+    return apply("lerp", lambda a, b: a + weight * (b - a), [x, y])
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    s = scale.item() if isinstance(scale, Tensor) else scale
+    if bias_after_scale:
+        return apply("scale", lambda a: a * s + bias, [x])
+    return apply("scale", lambda a: (a + bias) * s, [x])
+
+
+def increment(x, value=1.0, name=None):
+    return x._inplace(lambda t: apply("increment", lambda a: a + value, [t]))
+
+
+def multiplex(inputs, index, name=None):
+    arrs = [t for t in inputs]
+
+    def f(idx, *xs):
+        stacked = jnp.stack(xs, axis=0)
+        return jnp.take_along_axis(
+            stacked, idx.reshape(1, -1, *([1] * (stacked.ndim - 2))), axis=0)[0]
+    return apply("multiplex", lambda *xs: f(index._data.reshape(-1), *xs), arrs)
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return apply("stanh", lambda a: scale_b * jnp.tanh(scale_a * a), [x])
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):  # noqa: A002
+    return apply("addmm", lambda i, a, b: beta * i + alpha * (a @ b),
+                 [input, x, y])
+
+
+# ---- reductions (reference: phi/kernels reduce_*; python/paddle/tensor/math.py) ----
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):  # noqa: A001
+    ax, dt = _axis(axis), convert_dtype(dtype)
+    return apply("sum", lambda a: jnp.sum(a, axis=ax, dtype=dt,
+                                          keepdims=keepdim), [x])
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return apply("mean", lambda a: jnp.mean(a, axis=ax, keepdims=keepdim), [x])
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    ax, dt = _axis(axis), convert_dtype(dtype)
+    return apply("prod", lambda a: jnp.prod(a, axis=ax, dtype=dt,
+                                            keepdims=keepdim), [x])
+
+
+def max(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    ax = _axis(axis)
+    return apply("max", lambda a: jnp.max(a, axis=ax, keepdims=keepdim), [x])
+
+
+def min(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    ax = _axis(axis)
+    return apply("min", lambda a: jnp.min(a, axis=ax, keepdims=keepdim), [x])
+
+
+amax = max
+amin = min
+
+
+def all(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    return Tensor(jnp.all(x._data, axis=_axis(axis), keepdims=keepdim))
+
+
+def any(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    return Tensor(jnp.any(x._data, axis=_axis(axis), keepdims=keepdim))
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    out = jnp.argmax(x._data, axis=_axis(axis), keepdims=keepdim)
+    return Tensor(out.astype(convert_dtype(dtype)))
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    out = jnp.argmin(x._data, axis=_axis(axis), keepdims=keepdim)
+    return Tensor(out.astype(convert_dtype(dtype)))
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    dt = convert_dtype(dtype)
+    if axis is None:
+        return apply("cumsum", lambda a: jnp.cumsum(a.reshape(-1), dtype=dt), [x])
+    return apply("cumsum", lambda a: jnp.cumsum(a, axis=int(axis), dtype=dt), [x])
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    dt = convert_dtype(dtype)
+    return apply("cumprod", lambda a: jnp.cumprod(a, axis=dim, dtype=dt), [x])
+
+
+def _cum_extreme(x, axis, dtype, combine, name):
+    if axis is None:
+        x = apply("flatten", lambda a: a.reshape(-1), [x])
+        axis = 0
+    ax = int(axis)
+
+    def f(a):
+        vals = lax.associative_scan(combine, a, axis=ax)
+        pos = jnp.arange(a.shape[ax]).reshape(
+            [-1 if i == (ax % a.ndim) else 1 for i in range(a.ndim)])
+        is_new = a == vals
+        inds = lax.associative_scan(
+            jnp.maximum, jnp.where(is_new, pos, -1), axis=ax)
+        return vals, [inds.astype(convert_dtype(dtype))]
+    return apply(name, f, [x], has_aux=True)
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    return _cum_extreme(x, axis, dtype, jnp.maximum, "cummax")
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    return _cum_extreme(x, axis, dtype, jnp.minimum, "cummin")
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return apply("logsumexp",
+                 lambda a: jsp.logsumexp(a, axis=ax, keepdims=keepdim), [x])
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    ax = _axis(axis)
+    return apply("median", lambda a: jnp.median(a, axis=ax, keepdims=keepdim), [x])
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return apply("nanmedian",
+                 lambda a: jnp.nanmedian(a, axis=ax, keepdims=keepdim), [x])
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return apply("nanmean", lambda a: jnp.nanmean(a, axis=ax, keepdims=keepdim), [x])
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    ax, dt = _axis(axis), convert_dtype(dtype)
+    return apply("nansum", lambda a: jnp.nansum(a, axis=ax, dtype=dt,
+                                                keepdims=keepdim), [x])
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = _axis(axis)
+    return apply("var", lambda a: jnp.var(a, axis=ax, ddof=1 if unbiased else 0,
+                                          keepdims=keepdim), [x])
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = _axis(axis)
+    return apply("std", lambda a: jnp.std(a, axis=ax, ddof=1 if unbiased else 0,
+                                          keepdims=keepdim), [x])
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return Tensor(jnp.count_nonzero(x._data, axis=_axis(axis), keepdims=keepdim)
+                  .astype(jnp.int64))
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply("trace", lambda a: jnp.trace(a, offset=offset, axis1=axis1,
+                                              axis2=axis2), [x])
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply("diagonal", lambda a: jnp.diagonal(a, offset=offset, axis1=axis1,
+                                                    axis2=axis2), [x])
+
+
+def dot(x, y, name=None):
+    def f(a, b):
+        return jnp.sum(a * b, axis=-1)
+    return apply("dot", f, [x, y])
+
+
+def cross(x, y, axis=9, name=None):
+    ax = axis if axis != 9 else (
+        next(i for i, s in enumerate(x.shape) if s == 3))
+    return apply("cross", lambda a, b: jnp.cross(a, b, axis=ax), [x, y])

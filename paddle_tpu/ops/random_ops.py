@@ -1,0 +1,131 @@
+"""Random sampling ops (reference: python/paddle/tensor/random.py, phi Generator).
+All draw keys from the counter-based global generator (core/random.py), so results
+are deterministic under paddle.seed and stay functional under jit tracing."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import random as prandom
+from ..core.dtype import convert_dtype, get_default_dtype
+from ..core.tensor import Tensor
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.numpy())
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(int(s.item()) if isinstance(s, Tensor) else int(s)
+                 for s in shape)
+
+
+def rand(shape, dtype=None, name=None):
+    dt = convert_dtype(dtype) or get_default_dtype()
+    return Tensor(jax.random.uniform(prandom.next_key(), _shape(shape), dt))
+
+
+def randn(shape, dtype=None, name=None):
+    dt = convert_dtype(dtype) or get_default_dtype()
+    return Tensor(jax.random.normal(prandom.next_key(), _shape(shape), dt))
+
+
+standard_normal = randn
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean._data if isinstance(mean, Tensor) else mean
+        s = std._data if isinstance(std, Tensor) else std
+        shp = jnp.broadcast_shapes(
+            getattr(m, "shape", ()), getattr(s, "shape", ()))
+        return Tensor(m + s * jax.random.normal(prandom.next_key(), shp,
+                                                get_default_dtype()))
+    shp = _shape(shape) if shape is not None else ()
+    return Tensor(mean + std * jax.random.normal(prandom.next_key(), shp,
+                                                 get_default_dtype()))
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    x._data = mean + std * jax.random.normal(prandom.next_key(),
+                                             tuple(x.shape), x.dtype)
+    return x
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):  # noqa: A002
+    dt = convert_dtype(dtype) or get_default_dtype()
+    return Tensor(jax.random.uniform(prandom.next_key(), _shape(shape), dt,
+                                     minval=min, maxval=max))
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):  # noqa: A002
+    x._data = jax.random.uniform(prandom.next_key(), tuple(x.shape), x.dtype,
+                                 minval=min, maxval=max)
+    return x
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    return Tensor(jax.random.randint(prandom.next_key(), _shape(shape), low, high,
+                                     convert_dtype(dtype)))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    dt = convert_dtype(dtype) or x.dtype
+    return Tensor(jax.random.randint(prandom.next_key(), tuple(x.shape), low,
+                                     high, dt))
+
+
+def randperm(n, dtype="int64", name=None):
+    return Tensor(jax.random.permutation(prandom.next_key(), n)
+                  .astype(convert_dtype(dtype)))
+
+
+def bernoulli(x, name=None):
+    return Tensor(jax.random.bernoulli(prandom.next_key(),
+                                       x._data).astype(x.dtype))
+
+
+def bernoulli_(x, p=0.5, name=None):
+    x._data = jax.random.bernoulli(prandom.next_key(), p,
+                                   tuple(x.shape)).astype(x.dtype)
+    return x
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    probs = x._data / jnp.sum(x._data, axis=-1, keepdims=True)
+    logits = jnp.log(probs)
+    if replacement:
+        out = jax.random.categorical(prandom.next_key(), logits,
+                                     shape=(*logits.shape[:-1], num_samples)
+                                     if logits.ndim > 1 else (num_samples,),
+                                     axis=-1)
+    else:
+        k = prandom.next_key()
+        g = jax.random.gumbel(k, logits.shape)
+        _, out = jax.lax.top_k(logits + g, num_samples)
+    return Tensor(out.astype(jnp.int64))
+
+
+def poisson(x, name=None):
+    return Tensor(jax.random.poisson(prandom.next_key(), x._data)
+                  .astype(x.dtype))
+
+
+def exponential_(x, lam=1.0, name=None):
+    x._data = (jax.random.exponential(prandom.next_key(), tuple(x.shape),
+                                      x.dtype) / lam)
+    return x
+
+
+def rand_like(x, dtype=None, name=None):
+    dt = convert_dtype(dtype) or x.dtype
+    return Tensor(jax.random.uniform(prandom.next_key(), tuple(x.shape), dt))
+
+
+def randn_like(x, dtype=None, name=None):
+    dt = convert_dtype(dtype) or x.dtype
+    return Tensor(jax.random.normal(prandom.next_key(), tuple(x.shape), dt))

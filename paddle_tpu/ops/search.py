@@ -1,0 +1,110 @@
+"""Search/sort ops (reference: python/paddle/tensor/search.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply
+from ..core.dtype import convert_dtype
+from ..core.tensor import Tensor
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    def f(a):
+        out = jnp.sort(a, axis=axis, stable=stable or descending)
+        return jnp.flip(out, axis=axis) if descending else out
+    return apply("sort", f, [x])
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    out = jnp.argsort(x._data, axis=axis, stable=stable or descending)
+    if descending:
+        out = jnp.flip(out, axis=axis)
+    return Tensor(out.astype(jnp.int64))
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):  # noqa: A002
+    kk = int(k.item()) if isinstance(k, Tensor) else int(k)
+    ax = -1 if axis is None else axis
+
+    def f(a):
+        arr = jnp.moveaxis(a, ax, -1) if ax not in (-1, a.ndim - 1) else a
+        if largest:
+            vals, idx = _jax_topk(arr, kk)
+        else:
+            vals, idx = _jax_topk(-arr, kk)
+            vals = -vals
+        if ax not in (-1, a.ndim - 1):
+            vals = jnp.moveaxis(vals, -1, ax)
+            idx = jnp.moveaxis(idx, -1, ax)
+        return vals, [idx.astype(jnp.int64)]
+    return apply("topk", f, [x], has_aux=True)
+
+
+def _jax_topk(a, k):
+    import jax.lax as lax
+    return lax.top_k(a, k)
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def f(a):
+        srt = jnp.sort(a, axis=axis)
+        idxs = jnp.argsort(a, axis=axis)
+        vals = jnp.take(srt, k - 1, axis=axis)
+        idx = jnp.take(idxs, k - 1, axis=axis)
+        if keepdim:
+            vals = jnp.expand_dims(vals, axis)
+            idx = jnp.expand_dims(idx, axis)
+        return vals, [idx.astype(jnp.int64)]
+    return apply("kthvalue", f, [x], has_aux=True)
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    arr = np.asarray(x._data)
+    moved = np.moveaxis(arr, axis, -1)
+    flat = moved.reshape(-1, moved.shape[-1])
+    vals = np.empty(flat.shape[0], dtype=arr.dtype)
+    idxs = np.empty(flat.shape[0], dtype=np.int64)
+    for i, row in enumerate(flat):
+        uniq, counts = np.unique(row, return_counts=True)
+        best = uniq[np.argmax(counts)]
+        vals[i] = best
+        idxs[i] = np.where(row == best)[0][-1]
+    shape = moved.shape[:-1]
+    vals = vals.reshape(shape)
+    idxs = idxs.reshape(shape)
+    if keepdim:
+        vals = np.expand_dims(vals, axis)
+        idxs = np.expand_dims(idxs, axis)
+    return Tensor(vals), Tensor(idxs)
+
+
+def nonzero(x, as_tuple=False, name=None):
+    arr = np.asarray(x._data)
+    nz = np.nonzero(arr)
+    if as_tuple:
+        return tuple(Tensor(n.astype(np.int64)) for n in nz)
+    return Tensor(np.stack(nz, axis=1).astype(np.int64))
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False,
+                 name=None):
+    side = "right" if right else "left"
+    out = jnp.searchsorted(sorted_sequence._data, values._data, side=side)
+    return Tensor(out.astype(jnp.int32 if out_int32 else jnp.int64))
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    side = "right" if right else "left"
+    out = jnp.searchsorted(sorted_sequence._data, x._data, side=side)
+    return Tensor(out.astype(jnp.int32 if out_int32 else jnp.int64))
+
+
+def index_fill(x, index, axis, value, name=None):
+    idx = index._data if isinstance(index, Tensor) else jnp.asarray(index)
+
+    def f(a):
+        moved = jnp.moveaxis(a, axis, 0)
+        out = moved.at[idx].set(value)
+        return jnp.moveaxis(out, 0, axis)
+    return apply("index_fill", f, [x])

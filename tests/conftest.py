@@ -1,0 +1,32 @@
+"""Test harness: run everything on a virtual 8-device CPU mesh so multi-chip
+sharding paths are exercised without TPU hardware (reference precedent: the
+fake custom-device plugin, SURVEY §4 'fake backends')."""
+import os
+
+# Force CPU: the session env presets JAX_PLATFORMS=axon (the real TPU tunnel)
+# and the axon plugin overrides the env var, so use jax.config directly.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+def pytest_configure(config):
+    assert jax.devices()[0].platform == "cpu", "tests must run on CPU mesh"
+    assert len(jax.devices()) == 8, "expected 8 virtual CPU devices"
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    import paddle_tpu as paddle
+    paddle.seed(2024)
+    np.random.seed(2024)
+    yield

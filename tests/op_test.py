@@ -1,0 +1,85 @@
+"""OpTest — numpy-golden op testing harness.
+
+TPU-native rebuild of the reference fixture ``test/legacy_test/op_test.py:420``:
+an op case declares inputs + a numpy reference; ``check_output`` compares the
+eager XLA result against numpy, and ``check_grad`` compares tape gradients
+against central finite differences — the same two invariants the reference
+enforces across every backend/place.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+
+
+def check_output(fn, np_ref, inputs, atol=1e-5, rtol=1e-5, kwargs=None):
+    """fn: op over Tensors; np_ref: same op over numpy arrays."""
+    kwargs = kwargs or {}
+    tin = [paddle.to_tensor(a, dtype=str(np.asarray(a).dtype)) for a in inputs]
+    out = fn(*tin, **kwargs)
+    ref = np_ref(*inputs, **kwargs)
+    outs = out if isinstance(out, (tuple, list)) else [out]
+    refs = ref if isinstance(ref, (tuple, list)) else [ref]
+    assert len(outs) == len(refs), f"{len(outs)} outputs vs {len(refs)} refs"
+    for o, r in zip(outs, refs):
+        o_np = o.numpy() if isinstance(o, Tensor) else np.asarray(o)
+        np.testing.assert_allclose(o_np, r, atol=atol, rtol=rtol,
+                                   err_msg=f"forward mismatch for {fn}")
+
+
+def numeric_grad(fn, inputs, wrt, eps=1e-3, kwargs=None):
+    """Central finite differences of sum(fn(inputs)) w.r.t. inputs[wrt]."""
+    kwargs = kwargs or {}
+
+    def loss(arrs):
+        tin = [paddle.to_tensor(a) for a in arrs]
+        out = fn(*tin, **kwargs)
+        outs = out if isinstance(out, (tuple, list)) else [out]
+        total = 0.0
+        for o in outs:
+            if isinstance(o, Tensor) and np.issubdtype(np.asarray(o.numpy()).dtype,
+                                                       np.floating):
+                total += float(np.sum(o.numpy()))
+        return total
+
+    base = [np.array(a, dtype=np.float64) for a in inputs]
+    g = np.zeros_like(base[wrt])
+    flat = base[wrt].reshape(-1)
+    gflat = g.reshape(-1)
+    for i in range(flat.size):
+        orig = flat[i]
+        flat[i] = orig + eps
+        up = loss(base)
+        flat[i] = orig - eps
+        down = loss(base)
+        flat[i] = orig
+        gflat[i] = (up - down) / (2 * eps)
+    return g
+
+
+def check_grad(fn, inputs, wrt=None, atol=5e-3, rtol=5e-3, eps=1e-3,
+               kwargs=None):
+    """Analytic (tape) gradient vs finite differences, float64 for stability."""
+    kwargs = kwargs or {}
+    arrs = [np.array(a, dtype=np.float64) for a in inputs]
+    wrt = range(len(inputs)) if wrt is None else wrt
+    tin = [paddle.to_tensor(a, dtype="float64", stop_gradient=False)
+           for a in arrs]
+    out = fn(*tin, **kwargs)
+    outs = out if isinstance(out, (tuple, list)) else [out]
+    total = None
+    for o in outs:
+        if isinstance(o, Tensor) and np.issubdtype(
+                np.asarray(o.numpy()).dtype, np.floating):
+            s = o.sum()
+            total = s if total is None else total + s
+    total.backward()
+    for i in wrt:
+        analytic = tin[i].grad
+        assert analytic is not None, f"no grad flowed to input {i}"
+        numeric = numeric_grad(fn, arrs, i, eps=eps, kwargs=kwargs)
+        np.testing.assert_allclose(analytic.numpy(), numeric, atol=atol,
+                                   rtol=rtol,
+                                   err_msg=f"grad mismatch for input {i}")

@@ -1,0 +1,156 @@
+"""Autograd semantics tests — mirrors reference eager engine behavior
+(paddle/fluid/eager/backward.cc, test/legacy_test/test_imperative_*)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_backward_accumulates():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    (x * 2).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
+    (x * 3).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0, 5.0])
+    x.clear_grad()
+    assert x.grad is None
+
+
+def test_shared_subexpression():
+    x = paddle.to_tensor(3.0, stop_gradient=False)
+    y = x * x
+    z = y + y
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 12.0)
+
+
+def test_stop_gradient_blocks():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = paddle.to_tensor([2.0])  # stop_gradient=True
+    z = (x * y).sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+    assert y.grad is None
+
+
+def test_detach():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = (x * 3).detach()
+    assert y.stop_gradient
+    z = x * y
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [6.0])
+
+
+def test_no_grad_context():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2
+    assert y.stop_gradient
+    assert y._grad_fn is None
+
+
+def test_no_grad_decorator():
+    @paddle.no_grad()
+    def f(t):
+        return t * 2
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    assert f(x).stop_gradient
+
+
+def test_hooks():
+    x = paddle.to_tensor([1.0, 1.0], stop_gradient=False)
+    seen = []
+
+    def hook(g):
+        seen.append(g.numpy().copy())
+        return g * 2
+
+    h = x.register_hook(hook)
+    (x * 3).sum().backward()
+    np.testing.assert_allclose(seen[0], [3.0, 3.0])
+    np.testing.assert_allclose(x.grad.numpy(), [6.0, 6.0])
+    h.remove()
+    x.clear_grad()
+    (x * 3).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [3.0, 3.0])
+
+
+def test_grad_api():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = paddle.to_tensor([3.0, 4.0], stop_gradient=False)
+    z = (x * y).sum()
+    gx, gy = paddle.grad(z, [x, y])
+    np.testing.assert_allclose(gx.numpy(), [3.0, 4.0])
+    np.testing.assert_allclose(gy.numpy(), [1.0, 2.0])
+    # grad() must not touch .grad
+    assert x.grad is None
+
+
+def test_grad_intermediate():
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    y = x * x
+    z = y * 3
+    gy, = paddle.grad(z, [y])
+    np.testing.assert_allclose(gy.numpy(), 3.0)
+
+
+def test_grad_unused():
+    x = paddle.to_tensor(1.0, stop_gradient=False)
+    y = paddle.to_tensor(1.0, stop_gradient=False)
+    z = x * 2
+    with pytest.raises(RuntimeError):
+        paddle.grad(z, [y])
+    z = x * 2  # grad() freed the previous graph (retain_graph defaults False)
+    gy, = paddle.grad(z, [y], allow_unused=True)
+    assert gy is None
+
+
+def test_second_backward_raises():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = paddle.exp(x)
+    y.backward()
+    with pytest.raises(RuntimeError):
+        y.backward()
+
+
+def test_retain_graph():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = paddle.exp(x)
+    y.backward(retain_graph=True)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 2 * np.exp([1.0]), rtol=1e-6)
+
+
+def test_backward_with_grad_tensor():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 2
+    y.backward(paddle.to_tensor([0.5, 0.25]))
+    np.testing.assert_allclose(x.grad.numpy(), [1.0, 0.5])
+
+
+def test_multi_output_partial_use():
+    x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3),
+                         stop_gradient=False)
+    p1, p2 = paddle.split(x, 2, axis=0)
+    p1.sum().backward()  # p2 unused
+    g = np.zeros((2, 3), np.float32)
+    g[0] = 1
+    np.testing.assert_allclose(x.grad.numpy(), g)
+
+
+def test_deep_chain():
+    x = paddle.to_tensor(1.0, stop_gradient=False)
+    y = x
+    for _ in range(50):
+        y = y * 1.01
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 1.01 ** 50, rtol=1e-5)
+
+
+def test_inplace_setitem_grad():
+    x = paddle.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+    y = x * 2
+    y[0] = 10.0
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [0.0, 2.0, 2.0])

@@ -1,0 +1,263 @@
+"""Golden tests for the op library — forward vs numpy, grads vs finite diffs.
+
+Mirrors the reference's per-op OpTest pattern (test/legacy_test/op_test.py:420).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import check_grad, check_output
+
+rng = np.random.RandomState(7)
+
+
+def a(*shape):
+    return rng.uniform(0.5, 2.0, size=shape).astype(np.float64)
+
+
+def b(*shape):
+    return rng.uniform(-2.0, 2.0, size=shape).astype(np.float64)
+
+
+BINARY_CASES = [
+    (paddle.add, np.add),
+    (paddle.subtract, np.subtract),
+    (paddle.multiply, np.multiply),
+    (paddle.divide, np.divide),
+    (paddle.maximum, np.maximum),
+    (paddle.minimum, np.minimum),
+    (paddle.pow, np.power),
+    (paddle.atan2, np.arctan2),
+]
+
+
+@pytest.mark.parametrize("pfn,nfn", BINARY_CASES,
+                         ids=[p.__name__ for p, _ in BINARY_CASES])
+def test_binary_forward_grad(pfn, nfn):
+    x, y = a(3, 4), a(3, 4)
+    check_output(pfn, nfn, [x, y])
+    check_grad(pfn, [x, y])
+
+
+def test_broadcast_binary():
+    x, y = a(3, 1, 4), a(5, 1)
+    check_output(paddle.add, np.add, [x, y])
+    check_grad(paddle.add, [x, y])
+    check_grad(paddle.multiply, [x, y])
+
+
+UNARY_CASES = [
+    (paddle.exp, np.exp), (paddle.log, np.log), (paddle.sqrt, np.sqrt),
+    (paddle.tanh, np.tanh), (paddle.sin, np.sin), (paddle.cos, np.cos),
+    (paddle.abs, np.abs), (paddle.square, np.square),
+    (paddle.reciprocal, np.reciprocal),
+    (paddle.rsqrt, lambda v: 1 / np.sqrt(v)),
+    (paddle.sigmoid, lambda v: 1 / (1 + np.exp(-v))),
+    (paddle.log1p, np.log1p), (paddle.expm1, np.expm1),
+    (paddle.atan, np.arctan), (paddle.sinh, np.sinh), (paddle.cosh, np.cosh),
+]
+
+
+@pytest.mark.parametrize("pfn,nfn", UNARY_CASES,
+                         ids=[p.__name__ for p, _ in UNARY_CASES])
+def test_unary_forward_grad(pfn, nfn):
+    x = a(4, 5)
+    check_output(pfn, nfn, [x])
+    check_grad(pfn, [x])
+
+
+def test_reductions():
+    x = b(3, 4, 5)
+    check_output(paddle.sum, np.sum, [x])
+    check_output(lambda t: paddle.sum(t, axis=1),
+                 lambda v: np.sum(v, axis=1), [x])
+    check_output(lambda t: paddle.mean(t, axis=[0, 2], keepdim=True),
+                 lambda v: np.mean(v, axis=(0, 2), keepdims=True), [x])
+    check_output(paddle.max, np.max, [x])
+    check_output(paddle.min, np.min, [x])
+    check_output(lambda t: paddle.prod(t, axis=2),
+                 lambda v: np.prod(v, axis=2), [x])
+    check_grad(lambda t: paddle.sum(t, axis=1), [x])
+    check_grad(lambda t: paddle.mean(t, axis=0), [x])
+    check_output(lambda t: paddle.logsumexp(t, axis=1),
+                 lambda v: np.log(np.sum(np.exp(v), axis=1)), [x])
+    check_grad(lambda t: paddle.logsumexp(t, axis=1), [x])
+    check_output(lambda t: paddle.std(t, axis=1),
+                 lambda v: np.std(v, axis=1, ddof=1), [x])
+    check_output(lambda t: paddle.var(t, axis=1, unbiased=False),
+                 lambda v: np.var(v, axis=1), [x])
+
+
+def test_argmax_cumsum():
+    x = b(3, 4)
+    check_output(lambda t: paddle.argmax(t, axis=1),
+                 lambda v: np.argmax(v, axis=1), [x])
+    check_output(lambda t: paddle.cumsum(t, axis=1),
+                 lambda v: np.cumsum(v, axis=1), [x])
+    check_grad(lambda t: paddle.cumsum(t, axis=0), [x])
+
+
+def test_matmul():
+    x, y = b(3, 4), b(4, 5)
+    check_output(paddle.matmul, np.matmul, [x, y])
+    check_grad(paddle.matmul, [x, y])
+    # batched + transpose flags
+    x2, y2 = b(2, 3, 4), b(2, 5, 4)
+    check_output(lambda p, q: paddle.matmul(p, q, transpose_y=True),
+                 lambda p, q: np.matmul(p, np.swapaxes(q, -1, -2)), [x2, y2])
+    check_grad(lambda p, q: paddle.matmul(p, q, transpose_y=True), [x2, y2])
+
+
+def test_manipulation():
+    x = b(2, 3, 4)
+    check_output(lambda t: paddle.reshape(t, [6, 4]),
+                 lambda v: v.reshape(6, 4), [x])
+    check_output(lambda t: paddle.transpose(t, [2, 0, 1]),
+                 lambda v: v.transpose(2, 0, 1), [x])
+    check_output(lambda t: paddle.flatten(t, 1),
+                 lambda v: v.reshape(2, 12), [x])
+    check_grad(lambda t: paddle.transpose(t, [1, 0, 2]), [x])
+    check_output(lambda t: paddle.squeeze(paddle.unsqueeze(t, 1), 1),
+                 lambda v: v, [x])
+    y = b(2, 3, 4)
+    check_output(lambda p, q: paddle.concat([p, q], axis=1),
+                 lambda p, q: np.concatenate([p, q], axis=1), [x, y])
+    check_grad(lambda p, q: paddle.concat([p, q], axis=1), [x, y])
+    check_output(lambda p, q: paddle.stack([p, q], axis=0),
+                 lambda p, q: np.stack([p, q]), [x, y])
+    parts = paddle.split(paddle.to_tensor(x), 3, axis=1)
+    assert [tuple(p.shape) for p in parts] == [(2, 1, 4)] * 3
+    np.testing.assert_allclose(np.concatenate([p.numpy() for p in parts], 1), x)
+
+
+def test_split_grad():
+    x = b(4, 6)
+
+    def f(t):
+        p1, p2 = paddle.split(t, [2, 4], axis=1)
+        return (p1 * 2).sum() + (p2 * 3).sum()
+    check_grad(f, [x])
+
+
+def test_gather_scatter():
+    x = b(5, 3)
+    idx = np.array([0, 2, 4])
+    check_output(lambda t: paddle.gather(t, paddle.to_tensor(idx)),
+                 lambda v: v[idx], [x])
+    check_grad(lambda t: paddle.gather(t, paddle.to_tensor(idx)), [x])
+    upd = b(3, 3)
+    check_grad(lambda t, u: paddle.scatter(t, paddle.to_tensor(idx), u),
+               [x, upd])
+    check_output(
+        lambda t: paddle.index_select(t, paddle.to_tensor(np.array([1, 0])), 1),
+        lambda v: v[:, [1, 0]], [x])
+
+
+def test_where_clip():
+    x, y = b(3, 4), b(3, 4)
+    cond = x > y
+    out = paddle.where(cond, paddle.to_tensor(x), paddle.to_tensor(y))
+    np.testing.assert_allclose(out.numpy(), np.where(x > y, x, y))
+    check_output(lambda t: paddle.clip(t, -0.5, 0.5),
+                 lambda v: np.clip(v, -0.5, 0.5), [x])
+    check_grad(lambda t: paddle.clip(t, -0.5, 0.5), [x])
+
+
+def test_indexing_and_setitem():
+    x = b(4, 5)
+    t = paddle.to_tensor(x, stop_gradient=False)
+    y = t[1:3, ::2]
+    np.testing.assert_allclose(y.numpy(), x[1:3, ::2])
+    y.sum().backward()
+    g = np.zeros_like(x)
+    g[1:3, ::2] = 1
+    np.testing.assert_allclose(t.grad.numpy(), g)
+
+    t2 = paddle.to_tensor(x.copy())
+    t2[0] = 7.0
+    ref = x.copy()
+    ref[0] = 7.0
+    np.testing.assert_allclose(t2.numpy(), ref)
+
+
+def test_sort_topk():
+    x = b(3, 6)
+    check_output(lambda t: paddle.sort(t, axis=1),
+                 lambda v: np.sort(v, axis=1), [x])
+    vals, idx = paddle.topk(paddle.to_tensor(x), 3, axis=1)
+    ref = np.sort(x, axis=1)[:, ::-1][:, :3]
+    np.testing.assert_allclose(vals.numpy(), ref)
+    check_grad(lambda t: paddle.topk(t, 3, axis=1)[0], [x])
+
+
+def test_tile_expand_pad():
+    x = b(2, 3)
+    check_output(lambda t: paddle.tile(t, [2, 2]),
+                 lambda v: np.tile(v, (2, 2)), [x])
+    check_grad(lambda t: paddle.tile(t, [2, 2]), [x])
+    check_output(lambda t: paddle.expand(paddle.unsqueeze(t, 0), [4, 2, 3]),
+                 lambda v: np.broadcast_to(v[None], (4, 2, 3)), [x])
+
+
+def test_linalg_extras():
+    x = b(4, 4) + 4 * np.eye(4)
+    check_output(paddle.inverse, np.linalg.inv, [x], atol=1e-4)
+    sym = x @ x.T + np.eye(4)
+    check_output(paddle.cholesky, np.linalg.cholesky, [sym], atol=1e-4)
+    check_output(paddle.det, np.linalg.det, [sym], rtol=1e-4)
+    check_output(lambda t: paddle.norm(t),
+                 lambda v: np.linalg.norm(v.reshape(-1)), [b(3, 4)])
+    check_grad(lambda t: paddle.norm(t), [b(3, 4)])
+
+
+def test_einsum():
+    x, y = b(3, 4), b(4, 5)
+    check_output(lambda p, q: paddle.einsum("ij,jk->ik", p, q),
+                 lambda p, q: np.einsum("ij,jk->ik", p, q), [x, y])
+    check_grad(lambda p, q: paddle.einsum("ij,jk->ik", p, q), [x, y])
+
+
+def test_cummax_unique():
+    x = np.array([[1.0, 3.0, 2.0], [2.0, 1.0, 5.0]])
+    vals, idx = paddle.cummax(paddle.to_tensor(x), axis=1)
+    np.testing.assert_allclose(vals.numpy(), np.maximum.accumulate(x, axis=1))
+    np.testing.assert_array_equal(idx.numpy(), [[0, 1, 1], [0, 0, 2]])
+    u = paddle.unique(paddle.to_tensor([3, 1, 2, 1, 3]))
+    np.testing.assert_array_equal(u.numpy(), [1, 2, 3])
+
+
+def test_logic_ops():
+    x, y = b(3, 3), b(3, 3)
+    tx, ty = paddle.to_tensor(x), paddle.to_tensor(y)
+    np.testing.assert_array_equal((tx > ty).numpy(), x > y)
+    np.testing.assert_array_equal(
+        paddle.logical_and(tx > 0, ty > 0).numpy(), (x > 0) & (y > 0))
+    assert paddle.allclose(tx, paddle.to_tensor(x + 1e-9)).item()
+    assert paddle.equal_all(tx, tx).item()
+
+
+def test_creation_ops():
+    assert paddle.zeros([2, 3]).numpy().sum() == 0
+    assert paddle.ones([2, 3], dtype="int32").dtype == np.dtype("int32")
+    np.testing.assert_array_equal(paddle.arange(5).numpy(), np.arange(5))
+    np.testing.assert_allclose(paddle.eye(3).numpy(), np.eye(3, dtype=np.float32))
+    np.testing.assert_allclose(
+        paddle.linspace(0, 1, 5).numpy(), np.linspace(0, 1, 5), rtol=1e-6)
+    f = paddle.full([2, 2], 3.5)
+    np.testing.assert_allclose(f.numpy(), np.full((2, 2), 3.5, np.float32))
+    t = paddle.tril(paddle.ones([3, 3]))
+    np.testing.assert_allclose(t.numpy(), np.tril(np.ones((3, 3))))
+
+
+def test_random_deterministic():
+    paddle.seed(42)
+    r1 = paddle.rand([4, 4]).numpy()
+    paddle.seed(42)
+    r2 = paddle.rand([4, 4]).numpy()
+    np.testing.assert_array_equal(r1, r2)
+    r3 = paddle.randn([1000]).numpy()
+    assert abs(r3.mean()) < 0.15
+    ri = paddle.randint(0, 10, [100]).numpy()
+    assert ri.min() >= 0 and ri.max() < 10
+    rp = paddle.randperm(16).numpy()
+    np.testing.assert_array_equal(np.sort(rp), np.arange(16))

@@ -1,0 +1,97 @@
+"""Tensor surface tests (reference: tensor_patch_methods, eager properties)."""
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+def test_creation_dtypes():
+    t = paddle.to_tensor([1.0, 2.0])
+    assert t.dtype == np.dtype("float32")  # float64 input defaults down
+    t64 = paddle.to_tensor([1.0], dtype="float64")
+    assert t64.dtype == np.dtype("float64")
+    ti = paddle.to_tensor([1, 2, 3])
+    assert ti.dtype == np.dtype("int64")
+    tb = paddle.to_tensor([True, False])
+    assert tb.dtype == np.dtype("bool")
+    tbf = paddle.to_tensor([1.0], dtype="bfloat16")
+    assert tbf.dtype == paddle.bfloat16
+
+
+def test_properties():
+    t = paddle.zeros([2, 3, 4])
+    assert t.shape == [2, 3, 4]
+    assert t.ndim == 3
+    assert t.size == 24
+    assert t.numel().item() == 24
+    assert len(t) == 2
+    assert t.is_leaf
+
+
+def test_item_conversions():
+    t = paddle.to_tensor(3.5)
+    assert float(t) == 3.5
+    assert paddle.to_tensor(2).item() == 2
+    assert bool(paddle.to_tensor(True))
+    assert paddle.to_tensor([[1, 2]]).tolist() == [[1, 2]]
+
+
+def test_astype_cast():
+    t = paddle.to_tensor([1.5, 2.5])
+    ti = t.astype("int32")
+    assert ti.dtype == np.dtype("int32")
+    np.testing.assert_array_equal(ti.numpy(), [1, 2])
+    assert t.cast("float64").dtype == np.dtype("float64")
+
+
+def test_numpy_protocol():
+    t = paddle.to_tensor([[1.0, 2.0]])
+    arr = np.asarray(t)
+    np.testing.assert_allclose(arr, [[1.0, 2.0]])
+
+
+def test_set_value_and_fill():
+    t = paddle.zeros([2, 2])
+    t.set_value(np.ones((2, 2)))
+    assert t.numpy().sum() == 4
+    t.fill_(3.0)
+    assert t.numpy().sum() == 12
+    t.zero_()
+    assert t.numpy().sum() == 0
+
+
+def test_clone_detach_independent():
+    t = paddle.to_tensor([1.0], stop_gradient=False)
+    c = t.clone()
+    assert not c.stop_gradient  # clone keeps grad chain
+    d = t.detach()
+    assert d.stop_gradient
+
+
+def test_parameter():
+    p = paddle.Parameter(np.ones((2, 2), np.float32))
+    assert not p.stop_gradient
+    assert p.trainable
+    assert p.persistable
+
+
+def test_save_load_roundtrip(tmp_path):
+    state = {
+        "w": paddle.to_tensor(np.random.randn(3, 3).astype(np.float32)),
+        "b": paddle.to_tensor([1.0], dtype="bfloat16"),
+        "step": 7,
+        "nested": {"lr": 0.1},
+    }
+    path = str(tmp_path / "model.pdparams")
+    paddle.save(state, path)
+    loaded = paddle.load(path)
+    np.testing.assert_allclose(loaded["w"].numpy(), state["w"].numpy())
+    assert loaded["b"].dtype == paddle.bfloat16
+    assert loaded["step"] == 7
+    assert loaded["nested"]["lr"] == 0.1
+
+
+def test_device_api():
+    place = paddle.set_device("cpu")
+    assert place.is_cpu_place()
+    assert paddle.device_count() >= 1
+    assert paddle.is_compiled_with_tpu()

@@ -17,7 +17,6 @@ import jax as _jax
 # use bf16/f32 explicitly, so TPU speed is unaffected.
 _jax.config.update("jax_enable_x64", True)
 
-from .core import autograd  # noqa: F401
 from .core.autograd import enable_grad, grad, is_grad_enabled, no_grad, set_grad_enabled  # noqa: F401
 from .core.device import (  # noqa: F401
     CPUPlace, Place, TPUPlace, device_count, get_device, is_compiled_with_tpu,
@@ -33,7 +32,7 @@ from .ops import *  # noqa: F401,F403
 from .ops import einsum, one_hot  # noqa: F401
 
 from . import amp  # noqa: F401
-from . import autograd as autograd_ns  # noqa: F401
+from . import autograd  # noqa: F401
 from . import framework  # noqa: F401
 from . import io  # noqa: F401
 from . import jit  # noqa: F401

@@ -1,0 +1,15 @@
+"""paddle_tpu.autograd — public autograd namespace.
+
+Reference: ``python/paddle/autograd`` (paddle.grad, PyLayer, no_grad, hooks).
+The engine lives in ``paddle_tpu.core.autograd`` (tape over jax.vjp); this
+package adds the user-facing PyLayer custom-op API.
+"""
+from ..core.autograd import (  # noqa: F401
+    backward, enable_grad, grad, is_grad_enabled, no_grad, set_grad_enabled,
+)
+from .py_layer import PyLayer, PyLayerContext  # noqa: F401
+
+__all__ = [
+    "backward", "grad", "no_grad", "enable_grad", "is_grad_enabled",
+    "set_grad_enabled", "PyLayer", "PyLayerContext",
+]

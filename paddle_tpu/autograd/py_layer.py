@@ -1,0 +1,137 @@
+"""PyLayer — user-defined differentiable ops with Python forward/backward.
+
+Reference: ``python/paddle/autograd/py_layer.py`` (class PyLayer + CPyLayerContext)
+over the eager engine's PyLayer grad node (``paddle/fluid/eager/pylayer/``).
+TPU-native design: PyLayer.apply runs the user forward under ``no_grad`` and
+records a single TapeNode whose pullback invokes the user backward; under
+``create_graph=True`` the user backward runs grad-enabled so its ops are taped,
+giving double-grad through PyLayer for free.
+"""
+from __future__ import annotations
+
+from ..core import autograd as engine
+from ..core.dispatch import _is_diff
+from ..core.dtype import is_floating
+from ..core.tensor import Tensor
+
+
+class PyLayerContext:
+    """Context passed to forward/backward (reference: PyLayerContext).
+
+    ``save_for_backward`` stores tensors for the backward pass;
+    ``saved_tensor`` returns them.
+    """
+
+    def __init__(self):
+        self.container = ()
+        self._non_differentiable = set()
+        self._materialize_grads = True
+
+    def save_for_backward(self, *tensors):
+        self.container = tensors
+
+    def saved_tensor(self):
+        return self.container
+
+    def mark_non_differentiable(self, *tensors):
+        self._non_differentiable |= {id(t) for t in tensors}
+
+    def set_materialize_grads(self, value: bool):
+        self._materialize_grads = bool(value)
+
+    def mark_not_inplace(self, *args):  # compatibility no-op (functional arrays)
+        pass
+
+
+class PyLayer:
+    """Base class for custom differentiable operations.
+
+    Usage mirrors the reference (python/paddle/autograd/py_layer.py)::
+
+        class Cube(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * x * x
+
+            @staticmethod
+            def backward(ctx, dy):
+                x, = ctx.saved_tensor()
+                return 3 * x * x * dy
+
+        y = Cube.apply(x)
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError(
+            "PyLayer subclasses must implement a forward staticmethod")
+
+    @staticmethod
+    def backward(ctx, *args):
+        raise NotImplementedError(
+            "PyLayer subclasses must implement a backward staticmethod")
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        tensor_args = [a for a in args if isinstance(a, Tensor)]
+        diff_inputs = [t for t in tensor_args if _is_diff(t)] \
+            if engine.is_grad_enabled() else []
+
+        with engine.no_grad():
+            outputs = cls.forward(ctx, *args, **kwargs)
+
+        single = not isinstance(outputs, (tuple, list))
+        out_list = [outputs] if single else list(outputs)
+        if not diff_inputs:
+            return outputs
+
+        # outputs eligible for taping: floating tensors not marked non-diff
+        taped = [o for o in out_list
+                 if isinstance(o, Tensor) and id(o) not in ctx._non_differentiable
+                 and is_floating(o.dtype)]
+        if not taped:
+            return outputs
+
+        def _select(res):
+            """Map user backward results onto the diff inputs."""
+            res = list(res) if isinstance(res, (tuple, list)) else [res]
+            if len(res) == len(diff_inputs):
+                pairs = zip(diff_inputs, res)
+            elif len(res) == len(tensor_args):
+                pairs = ((t, g) for t, g in zip(tensor_args, res)
+                         if any(t is d for d in diff_inputs))
+            else:
+                raise RuntimeError(
+                    f"{cls.__name__}.backward returned {len(res)} gradients but "
+                    f"forward has {len(tensor_args)} tensor inputs "
+                    f"({len(diff_inputs)} differentiable)")
+            return pairs
+
+        def raw_vjp(cts):
+            cts = cts if isinstance(cts, tuple) else (cts,)
+            ct_tensors = [Tensor(c, stop_gradient=True) for c in cts]
+            with engine.no_grad():
+                res = cls.backward(ctx, *ct_tensors)
+            out = [None] * len(diff_inputs)
+            for i, (t, g) in enumerate(_select(res)):
+                out[i] = g._data if isinstance(g, Tensor) else g
+            return tuple(out)
+
+        def tensor_vjp(ct_tensors):
+            res = cls.backward(ctx, *ct_tensors)
+            out = [None] * len(diff_inputs)
+            for i, (t, g) in enumerate(_select(res)):
+                out[i] = g
+            return out
+
+        engine.record_op(f"py_layer_{cls.__name__}", diff_inputs, raw_vjp,
+                         taped, tensor_vjp=tensor_vjp)
+        return outputs
+
+
+def once_differentiable(backward_fn):
+    """Decorator marking a backward as non-re-differentiable (compat shim)."""
+    return staticmethod(backward_fn) if not isinstance(
+        backward_fn, staticmethod) else backward_fn

@@ -75,14 +75,19 @@ class TapeNode:
     """One recorded op: inputs that require grad, the vjp pullback, and the outputs.
 
     Mirrors GradNodeBase (eager/grad_node_info.h) but holds a functional pullback
-    instead of a hand-written apply().
+    instead of a hand-written apply(). For ``create_graph=True`` (double grad,
+    reference ``eager/general_grad.h``) the node also keeps the op's forward and
+    its constant inputs so the backward step can itself be re-run *through the
+    tape* (recompute + re-vjp), making gradients differentiable.
     """
 
     __slots__ = ("name", "inputs", "vjp_fn", "outputs", "out_avals", "n_outputs",
+                 "fwd", "const_arrs", "diff_idx", "has_aux", "tensor_vjp",
                  "__weakref__")
 
     def __init__(self, name: str, inputs: Sequence[Any], vjp_fn: Callable,
-                 outputs: Sequence[Any]):
+                 outputs: Sequence[Any], fwd=None, const_arrs=None,
+                 diff_idx=None, has_aux=False, tensor_vjp=None):
         self.name = name
         self.inputs = list(inputs)          # Tensor objects (diff inputs only)
         self.vjp_fn = vjp_fn                # pullback: (out_cts...) -> (in_cts...)
@@ -91,15 +96,55 @@ class TapeNode:
         self.outputs = [weakref.ref(o) for o in outputs]
         self.out_avals = [(o.shape, o.dtype) for o in outputs]
         self.n_outputs = len(outputs)
+        self.fwd = fwd                      # raw-array forward (for create_graph)
+        self.const_arrs = const_arrs        # full raw input list (template)
+        self.diff_idx = diff_idx            # positions of diff inputs in const_arrs
+        self.has_aux = has_aux
+        self.tensor_vjp = tensor_vjp        # Tensor-level vjp (PyLayer create_graph)
 
     def __repr__(self):
         return f"<TapeNode {self.name} ({len(self.inputs)} in, {self.n_outputs} out)>"
 
+    def taped_vjp(self, ct_tensors):
+        """Run this node's backward through the tape (for create_graph=True).
+
+        Returns a list of Tensor cotangents, one per diff input, each carrying
+        grad history w.r.t. both the original inputs and the cotangents.
+        """
+        from .dispatch import apply
+        if self.tensor_vjp is not None:
+            res = self.tensor_vjp(ct_tensors)
+            return list(res) if isinstance(res, (tuple, list)) else [res]
+        if self.fwd is None:
+            raise RuntimeError(
+                f"op '{self.name}' does not support create_graph=True "
+                "(no recordable forward)")
+        node = self
+        n_diff = len(node.diff_idx)
+
+        def grad_fwd(*arrs):
+            diff_arrs, ct_arrs = arrs[:n_diff], arrs[n_diff:]
+
+            def f(*d):
+                merged = list(node.const_arrs)
+                for pos, a in zip(node.diff_idx, d):
+                    merged[pos] = a
+                out = node.fwd(*merged)
+                return out[0] if node.has_aux else out
+
+            _, vjp_fn = jax.vjp(f, *diff_arrs)
+            res = vjp_fn(tuple(ct_arrs) if node.n_outputs > 1 else ct_arrs[0])
+            return tuple(res) if n_diff > 1 else res[0]
+
+        out = apply(f"{self.name}_grad", grad_fwd,
+                    list(self.inputs) + list(ct_tensors), nout=n_diff)
+        return list(out) if isinstance(out, tuple) else [out]
+
 
 def record_op(name: str, diff_inputs: Sequence[Any], vjp_fn: Callable,
-              outputs: Sequence[Any]) -> None:
+              outputs: Sequence[Any], **node_kwargs) -> None:
     """Attach a TapeNode to each output tensor (sets grad_fn / output_index)."""
-    node = TapeNode(name, diff_inputs, vjp_fn, outputs)
+    node = TapeNode(name, diff_inputs, vjp_fn, outputs, **node_kwargs)
     for i, o in enumerate(outputs):
         o._grad_fn = node
         o._output_index = i
@@ -136,10 +181,18 @@ def _ones_like(data):
 
 
 def _run_backward(root_tensors, root_grads, retain_graph=False,
-                  accumulate_into_grad=True, wanted=None):
-    """Core reverse pass. Returns {id(tensor): cotangent jax array} for ``wanted``
-    tensors (or all leaves if wanted is None and accumulate_into_grad)."""
-    # cotangent accumulator keyed by (node id, output index) and tensor id for leaves
+                  accumulate_into_grad=True, wanted=None, create_graph=False,
+                  no_grad_ids=None):
+    """Core reverse pass.
+
+    Default mode accumulates raw jax arrays. With ``create_graph=True`` the
+    accumulator holds Tensors and each node's backward runs *through the tape*
+    (TapeNode.taped_vjp), so returned gradients are themselves differentiable —
+    the functional rebuild of the reference's double-grad engine
+    (eager/general_grad.h).
+
+    Returns {id(tensor): cotangent} for ``wanted`` tensors (or all leaves)."""
+    from .tensor import Tensor
     grads: dict = {}
     # id -> tensor registry for hook application / .grad assignment at the end
     leaves: dict = {}
@@ -158,6 +211,7 @@ def _run_backward(root_tensors, root_grads, retain_graph=False,
 
     order = _toposort(root_tensors)
     wanted_ids = None if wanted is None else {id(t) for t in wanted}
+    no_grad_ids = no_grad_ids or set()
 
     for node in order:
         # gather output cotangents (zeros where never produced / outputs dead)
@@ -167,30 +221,34 @@ def _run_backward(root_tensors, root_grads, retain_graph=False,
             o = oref()
             g = None if o is None else grads.get(id(o))
             if g is None:
-                cts.append(jnp.zeros(oshape, odtype))
+                z = jnp.zeros(oshape, odtype)
+                cts.append(Tensor(z, stop_gradient=True) if create_graph else z)
                 continue
             any_ct = True
             for hook in o._grad_hooks:
-                newg = hook(_wrap_hook_arg(o, g))
+                newg = hook(g if create_graph else _wrap_hook_arg(o, g))
                 if newg is not None:
-                    g = _unwrap_hook_result(newg)
+                    g = newg if create_graph else _unwrap_hook_result(newg)
             if wanted_ids is None or id(o) not in wanted_ids:
                 grads.pop(id(o), None)
             cts.append(g)
         if not any_ct:
             continue
-        if node.vjp_fn is None:
-            raise RuntimeError(
-                f"Trying to backward through op '{node.name}' a second time; the "
-                "saved intermediates were freed. Pass retain_graph=True.")
-        in_cts = node.vjp_fn(tuple(cts) if node.n_outputs > 1 else cts[0])
-        if not isinstance(in_cts, (tuple, list)):
-            in_cts = (in_cts,)
+        if create_graph:
+            in_cts = node.taped_vjp(cts)
+        else:
+            if node.vjp_fn is None:
+                raise RuntimeError(
+                    f"Trying to backward through op '{node.name}' a second time; "
+                    "the saved intermediates were freed. Pass retain_graph=True.")
+            in_cts = node.vjp_fn(tuple(cts) if node.n_outputs > 1 else cts[0])
+            if not isinstance(in_cts, (tuple, list)):
+                in_cts = (in_cts,)
         for t, g in zip(node.inputs, in_cts):
-            if g is None:
+            if g is None or id(t) in no_grad_ids:
                 continue
             add_grad(t, g)
-        if not retain_graph:
+        if not retain_graph and not create_graph:
             node.vjp_fn = None  # free residuals
 
     # write .grad on leaves (paddle semantics: accumulate across backward calls)
@@ -200,10 +258,10 @@ def _run_backward(root_tensors, root_grads, retain_graph=False,
             continue
         if accumulate_into_grad and not t.stop_gradient:
             for hook in t._grad_hooks:
-                newg = hook(_wrap_hook_arg(t, g))
+                newg = hook(g if create_graph else _wrap_hook_arg(t, g))
                 if newg is not None:
-                    g = _unwrap_hook_result(newg)
-            t._accumulate_grad(g)
+                    g = newg if create_graph else _unwrap_hook_result(newg)
+            t._accumulate_grad(g._data if create_graph else g)
     return grads
 
 
@@ -241,13 +299,11 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
          no_grad_vars=None):
     """Functional gradient, mirroring ``paddle.grad``.
 
-    create_graph (double grad) is not yet supported on the tape path; use
-    ``paddle_tpu.incubate.autograd`` / jax.grad composition for higher-order.
+    With ``create_graph=True`` the returned gradients carry grad history
+    (backward is re-run through the tape), enabling double grad — reference
+    ``eager/general_grad.h`` / ``paddle.grad(create_graph=True)``.
     """
-    if create_graph:
-        raise NotImplementedError(
-            "create_graph=True (double grad) is not supported by the eager tape "
-            "yet; compose jax.grad via paddle_tpu.jit for higher-order gradients.")
+    from .tensor import Tensor
     if not isinstance(outputs, (list, tuple)):
         outputs = [outputs]
     if not isinstance(inputs, (list, tuple)):
@@ -256,15 +312,20 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
         grad_outputs = [None] * len(outputs)
     elif not isinstance(grad_outputs, (list, tuple)):
         grad_outputs = [grad_outputs]
-    # paddle semantics: retain_graph defaults to create_graph (False here)
+    # paddle semantics: retain_graph defaults to create_graph
     retain = create_graph if retain_graph is None else retain_graph
+    no_grad_ids = {id(t) for t in (no_grad_vars or [])}
     roots, root_grads = [], []
     for t, g in zip(outputs, grad_outputs):
         roots.append(t)
-        root_grads.append(_ones_like(t._data) if g is None else g._data)
+        if create_graph:
+            root_grads.append(Tensor(_ones_like(t._data), stop_gradient=True)
+                              if g is None else g)
+        else:
+            root_grads.append(_ones_like(t._data) if g is None else g._data)
     all_grads = _run_backward(roots, root_grads, retain_graph=retain,
-                              accumulate_into_grad=False, wanted=inputs)
-    from .tensor import Tensor
+                              accumulate_into_grad=False, wanted=inputs,
+                              create_graph=create_graph, no_grad_ids=no_grad_ids)
     result = []
     for t in inputs:
         g = all_grads.get(id(t))
@@ -275,5 +336,5 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
                     "used in the graph. Set allow_unused=True if this is desired.")
             result.append(None)
         else:
-            result.append(Tensor(g, stop_gradient=True))
+            result.append(g if create_graph else Tensor(g, stop_gradient=True))
     return result

@@ -74,6 +74,8 @@ def apply(name: str, fwd: Callable, inputs: Sequence[Any], nout: int = 1,
     primals = primal if isinstance(primal, tuple) else (primal,)
     diff_outputs = [Tensor(p, stop_gradient=False) for p in primals]
     diff_tensors = [inputs[i] for i in diff_idx]
-    autograd.record_op(name, diff_tensors, vjp_fn, diff_outputs)
+    autograd.record_op(name, diff_tensors, vjp_fn, diff_outputs,
+                       fwd=fwd, const_arrs=arrs, diff_idx=diff_idx,
+                       has_aux=has_aux)
     results = diff_outputs + [Tensor(a, stop_gradient=True) for a in aux]
     return results[0] if len(results) == 1 else tuple(results)

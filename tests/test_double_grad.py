@@ -1,0 +1,116 @@
+"""Double grad (create_graph=True) + PyLayer tests.
+
+Reference precedents: test/legacy_test/test_imperative_double_grad.py,
+test/legacy_test/test_pylayer_op.py.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.autograd import PyLayer
+
+
+def test_grad_of_grad_scalar():
+    # y = x^3 → dy/dx = 3x^2 → d2y/dx2 = 6x
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    y = x * x * x
+    (dx,) = paddle.grad(y, x, create_graph=True)
+    assert not dx.stop_gradient
+    np.testing.assert_allclose(dx.numpy(), 12.0, rtol=1e-6)
+    (d2x,) = paddle.grad(dx, x)
+    np.testing.assert_allclose(d2x.numpy(), 12.0, rtol=1e-6)
+
+
+def test_grad_of_grad_matmul():
+    # f(x) = sum((x @ w)^2); check d/dw of dx matches jax
+    import jax
+    import jax.numpy as jnp
+
+    xv = np.random.randn(3, 4).astype(np.float32)
+    wv = np.random.randn(4, 5).astype(np.float32)
+
+    x = paddle.to_tensor(xv, stop_gradient=False)
+    w = paddle.to_tensor(wv, stop_gradient=False)
+    y = paddle.matmul(x, w)
+    loss = (y * y).sum()
+    (dx,) = paddle.grad(loss, x, create_graph=True)
+    g2 = paddle.grad(dx.sum(), w)[0]
+
+    def f(xa, wa):
+        return jnp.sum(jnp.matmul(xa, wa) ** 2)
+
+    expected = jax.grad(lambda wa: jnp.sum(jax.grad(f)(jnp.asarray(xv),
+                                                       wa)), argnums=0)(
+        jnp.asarray(wv))
+    np.testing.assert_allclose(g2.numpy(), np.asarray(expected), rtol=1e-4)
+
+
+def test_second_order_via_backward():
+    x = paddle.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+    y = (x * x * x).sum()
+    (dx,) = paddle.grad(y, x, create_graph=True)
+    dx.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), 6 * np.array([1.0, 2.0, 3.0]),
+                               rtol=1e-6)
+
+
+class _Cube(PyLayer):
+    @staticmethod
+    def forward(ctx, x):
+        ctx.save_for_backward(x)
+        return x * x * x
+
+    @staticmethod
+    def backward(ctx, dy):
+        (x,) = ctx.saved_tensor()
+        return 3 * x * x * dy
+
+
+def test_pylayer_forward_backward():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = _Cube.apply(x)
+    np.testing.assert_allclose(y.numpy(), [1.0, 8.0], rtol=1e-6)
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [3.0, 12.0], rtol=1e-6)
+
+
+def test_pylayer_multi_input_output():
+    class MulAdd(PyLayer):
+        @staticmethod
+        def forward(ctx, a, b):
+            ctx.save_for_backward(a, b)
+            return a * b, a + b
+
+        @staticmethod
+        def backward(ctx, d_mul, d_add):
+            a, b = ctx.saved_tensor()
+            return d_mul * b + d_add, d_mul * a + d_add
+
+    a = paddle.to_tensor(3.0, stop_gradient=False)
+    b = paddle.to_tensor(4.0, stop_gradient=False)
+    m, s = MulAdd.apply(a, b)
+    (m + s).backward()
+    np.testing.assert_allclose(a.grad.numpy(), 4.0 + 1.0)
+    np.testing.assert_allclose(b.grad.numpy(), 3.0 + 1.0)
+
+
+def test_pylayer_double_grad():
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    y = _Cube.apply(x)
+    (dx,) = paddle.grad(y, x, create_graph=True)
+    (d2x,) = paddle.grad(dx, x)
+    np.testing.assert_allclose(d2x.numpy(), 12.0, rtol=1e-6)
+
+
+def test_pylayer_no_grad_passthrough():
+    x = paddle.to_tensor([1.0, 2.0])  # stop_gradient=True
+    y = _Cube.apply(x)
+    assert y.stop_gradient
+
+
+def test_grad_no_grad_vars():
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    w = paddle.to_tensor(3.0, stop_gradient=False)
+    y = x * w
+    (dx,) = paddle.grad(y, x, no_grad_vars=[w])
+    np.testing.assert_allclose(dx.numpy(), 3.0)

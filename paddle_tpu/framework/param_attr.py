@@ -1,0 +1,38 @@
+"""ParamAttr — parameter property bundle (reference: python/paddle/base/param_attr.py).
+
+Carries name/initializer/learning_rate/regularizer/trainable/need_clip through
+Layer.create_parameter, exactly the role it plays in the reference's LayerHelper.
+"""
+from __future__ import annotations
+
+
+class ParamAttr:
+    def __init__(self, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True, do_model_average=True,
+                 need_clip=True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.do_model_average = do_model_average
+        self.need_clip = need_clip
+
+    @staticmethod
+    def _to_attr(arg):
+        """Normalize weight_attr/bias_attr arguments.
+
+        Accepts None (defaults), False (no parameter), a ParamAttr, an
+        Initializer instance, or a name string — reference semantics of
+        ParamAttr._to_attr.
+        """
+        if arg is None:
+            return ParamAttr()
+        if arg is False:
+            return False
+        if isinstance(arg, ParamAttr):
+            return arg
+        if isinstance(arg, str):
+            return ParamAttr(name=arg)
+        # assume initializer-like (callable (shape, dtype) -> array)
+        return ParamAttr(initializer=arg)

@@ -1,0 +1,13 @@
+"""paddle_tpu.nn — neural network layers and functional ops.
+
+Reference namespace: python/paddle/nn/__init__.py (Layer base
+nn/layer/layers.py:333, functional ops nn/functional/, initializers
+nn/initializer/, grad clip nn/clip.py).
+"""
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from .clip import (  # noqa: F401
+    ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue, clip_grad_norm_,
+)
+from .layer import *  # noqa: F401,F403
+from .layer import Layer  # noqa: F401

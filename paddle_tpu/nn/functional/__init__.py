@@ -1,0 +1,13 @@
+"""paddle_tpu.nn.functional — functional NN ops.
+
+Reference namespace: python/paddle/nn/functional/__init__.py.
+"""
+from ...ops import one_hot  # noqa: F401  (paddle exposes F.one_hot too)
+from .activation import *  # noqa: F401,F403
+from .common import *  # noqa: F401,F403
+from .conv import *  # noqa: F401,F403
+from .loss import *  # noqa: F401,F403
+from .norm import *  # noqa: F401,F403
+from .pooling import *  # noqa: F401,F403
+
+from . import activation, common, conv, loss, norm, pooling  # noqa: F401

@@ -1,0 +1,180 @@
+"""Activation functionals (reference: python/paddle/nn/functional/activation.py).
+
+All are single jnp expressions dispatched through the autograd tape; XLA fuses
+them into adjacent matmuls/convs on TPU, replacing the reference's per-op
+CUDA activation kernels (paddle/phi/kernels/gpu/activation_kernel.cu).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply
+
+__all__ = [
+    "relu", "relu_", "relu6", "gelu", "sigmoid", "silu", "swish", "tanh",
+    "leaky_relu", "elu", "selu", "celu", "hardtanh", "hardsigmoid",
+    "hardswish", "hardshrink", "softshrink", "tanhshrink", "softplus",
+    "softsign", "mish", "log_sigmoid", "prelu", "glu", "softmax",
+    "log_softmax", "gumbel_softmax",
+]
+
+
+def relu(x, name=None):
+    return apply("relu", lambda a: jnp.maximum(a, 0), [x])
+
+
+def relu_(x, name=None):
+    return x._inplace(relu)
+
+
+def relu6(x, name=None):
+    return apply("relu6", lambda a: jnp.clip(a, 0, 6), [x])
+
+
+def gelu(x, approximate=False, name=None):
+    return apply("gelu", lambda a: jax.nn.gelu(a, approximate=approximate), [x])
+
+
+def sigmoid(x, name=None):
+    return apply("sigmoid", jax.nn.sigmoid, [x])
+
+
+def silu(x, name=None):
+    return apply("silu", jax.nn.silu, [x])
+
+
+def swish(x, name=None):
+    return apply("swish", jax.nn.silu, [x])
+
+
+def tanh(x, name=None):
+    return apply("tanh", jnp.tanh, [x])
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply("leaky_relu",
+                 lambda a: jnp.where(a >= 0, a, negative_slope * a), [x])
+
+
+def elu(x, alpha=1.0, name=None):
+    return apply("elu", lambda a: jax.nn.elu(a, alpha), [x])
+
+
+def selu(x,
+         scale=1.0507009873554804934193349852946,
+         alpha=1.6732632423543772848170429916717,
+         name=None):
+    return apply("selu",
+                 lambda a: scale * jnp.where(a > 0, a,
+                                             alpha * jnp.expm1(a)), [x])
+
+
+def celu(x, alpha=1.0, name=None):
+    return apply("celu", lambda a: jax.nn.celu(a, alpha), [x])
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):  # noqa: A002
+    return apply("hardtanh", lambda a: jnp.clip(a, min, max), [x])
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return apply("hardsigmoid",
+                 lambda a: jnp.clip(a * slope + offset, 0.0, 1.0), [x])
+
+
+def hardswish(x, name=None):
+    return apply("hardswish",
+                 lambda a: a * jnp.clip(a + 3.0, 0.0, 6.0) / 6.0, [x])
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return apply("hardshrink",
+                 lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0), [x])
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return apply(
+        "softshrink",
+        lambda a: jnp.where(a > threshold, a - threshold,
+                            jnp.where(a < -threshold, a + threshold, 0.0)),
+        [x])
+
+
+def tanhshrink(x, name=None):
+    return apply("tanhshrink", lambda a: a - jnp.tanh(a), [x])
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return apply(
+        "softplus",
+        lambda a: jnp.where(a * beta > threshold, a,
+                            jnp.logaddexp(a * beta, 0.0) / beta), [x])
+
+
+def softsign(x, name=None):
+    return apply("softsign", lambda a: a / (1 + jnp.abs(a)), [x])
+
+
+def mish(x, name=None):
+    return apply("mish", lambda a: a * jnp.tanh(jax.nn.softplus(a)), [x])
+
+
+def log_sigmoid(x, name=None):
+    return apply("log_sigmoid", jax.nn.log_sigmoid, [x])
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def fwd(a, w):
+        if w.size == 1:
+            wb = w.reshape(())
+        else:
+            ch_axis = 1 if data_format[1] == "C" else len(a.shape) - 1
+            shape = [1] * a.ndim
+            shape[ch_axis] = w.size
+            wb = w.reshape(shape)
+        return jnp.where(a >= 0, a, wb * a)
+    return apply("prelu", fwd, [x, weight])
+
+
+def glu(x, axis=-1, name=None):
+    def fwd(a):
+        lhs, rhs = jnp.split(a, 2, axis=axis)
+        return lhs * jax.nn.sigmoid(rhs)
+    return apply("glu", fwd, [x])
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    def fwd(a):
+        if dtype is not None:
+            from ...core.dtype import convert_dtype
+            a = a.astype(convert_dtype(dtype))
+        return jax.nn.softmax(a, axis=axis)
+    return apply("softmax", fwd, [x])
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    def fwd(a):
+        if dtype is not None:
+            from ...core.dtype import convert_dtype
+            a = a.astype(convert_dtype(dtype))
+        return jax.nn.log_softmax(a, axis=axis)
+    return apply("log_softmax", fwd, [x])
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...core import random as _random
+    key = _random.next_key()
+
+    def fwd(a):
+        g = -jnp.log(-jnp.log(
+            jax.random.uniform(key, a.shape, jnp.float32, 1e-10, 1.0) + 1e-10))
+        y = jax.nn.softmax((a + g.astype(a.dtype)) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            onehot = jnp.zeros_like(y).at[...].set(0)
+            onehot = jnp.put_along_axis(onehot, idx, 1.0, axis=axis,
+                                        inplace=False)
+            y = onehot + y - jax.lax.stop_gradient(y)
+        return y
+    return apply("gumbel_softmax", fwd, [x])

@@ -1,0 +1,232 @@
+"""Common functionals: linear, dropout, pad, embedding, attention.
+
+Reference: python/paddle/nn/functional/{common,input}.py. linear keeps paddle's
+weight layout [in_features, out_features] (x @ W + b), which is already the
+MXU-friendly layout. Dropout draws from the framework RNG (core/random.py) so
+it is deterministic under paddle.seed and stageable under jit via
+trace_key_scope.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core import random as _random
+from ...core.dispatch import apply
+
+__all__ = [
+    "linear", "dropout", "dropout2d", "dropout3d", "pad", "embedding",
+    "cosine_similarity", "interpolate", "upsample", "unfold",
+    "scaled_dot_product_attention", "alpha_dropout", "label_smooth",
+]
+
+
+def linear(x, weight, bias=None, name=None):
+    """x @ W + b with W: [in, out] (reference: F.linear, weight NOT transposed)."""
+    def fwd(a, w, *b):
+        out = jnp.matmul(a, w)
+        if b:
+            out = out + b[0]
+        return out
+    ins = [x, weight] + ([bias] if bias is not None else [])
+    return apply("linear", fwd, ins)
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    """Reference: python/paddle/nn/functional/common.py:967 (dropout)."""
+    if p == 0.0 or (not training and mode == "upscale_in_train"):
+        return x * 1 if not x.stop_gradient else x
+    if p == 1.0 and training:
+        return x * 0
+    key = _random.next_key() if training else None
+
+    def fwd(a):
+        if not training:  # downscale_in_infer
+            return a * (1 - p)
+        shape = list(a.shape)
+        if axis is not None:
+            axes = [axis] if isinstance(axis, int) else list(axis)
+            shape = [s if i in axes else 1 for i, s in enumerate(shape)]
+        keep = jax.random.bernoulli(key, 1 - p, shape)
+        if mode == "upscale_in_train":
+            return jnp.where(keep, a / (1 - p), 0.0).astype(a.dtype)
+        return jnp.where(keep, a, 0.0).astype(a.dtype)
+
+    return apply("dropout", fwd, [x])
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0:
+        return x * 1 if not x.stop_gradient else x
+    alpha = -1.7580993408473766
+    key = _random.next_key()
+
+    def fwd(a):
+        keep = jax.random.bernoulli(key, 1 - p, a.shape)
+        q = 1 - p
+        a_scale = (q + alpha ** 2 * q * p) ** -0.5
+        b_shift = -a_scale * alpha * p
+        return (a_scale * jnp.where(keep, a, alpha) + b_shift).astype(a.dtype)
+    return apply("alpha_dropout", fwd, [x])
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):  # noqa: A002
+    """paddle F.pad: `pad` is [lo, hi] per spatial dim (last-dims order) when
+    len(pad) == 2*(ndim-2), else per-dim pairs for all dims."""
+    nd = x.ndim
+
+    def build_pairs():
+        p = list(int(v) for v in pad)
+        if len(p) == 2 * nd:  # all dims, flat
+            return [(p[2 * i], p[2 * i + 1]) for i in range(nd)]
+        n_sp = len(p) // 2
+        pairs = [(0, 0)] * nd
+        channel_last = data_format[-1] == "C"
+        sp_axes = list(range(1, 1 + n_sp)) if channel_last else \
+            list(range(nd - n_sp, nd))
+        # paddle order: last spatial dim first in `pad`? No: [left, right,
+        # top, bottom] pads W then H → reversed spatial order
+        for i, ax in enumerate(reversed(sp_axes)):
+            pairs[ax] = (p[2 * i], p[2 * i + 1])
+        return pairs
+
+    pairs = build_pairs()
+
+    def fwd(a):
+        if mode == "constant":
+            return jnp.pad(a, pairs, constant_values=value)
+        jmode = {"reflect": "reflect", "replicate": "edge",
+                 "circular": "wrap"}[mode]
+        return jnp.pad(a, pairs, mode=jmode)
+    return apply("pad", fwd, [x])
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    """Reference: python/paddle/nn/functional/input.py (embedding).
+    Gather rows of weight; padding_idx rows get zero gradient."""
+    def fwd(ids, w):
+        out = jnp.take(w, ids.astype(jnp.int32), axis=0)
+        if padding_idx is not None:
+            mask = (ids == padding_idx)[..., None]
+            out = jnp.where(mask, 0.0, out)
+        return out
+    return apply("embedding", fwd, [x, weight])
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    def fwd(a, b):
+        num = (a * b).sum(axis=axis)
+        na = jnp.sqrt((a * a).sum(axis=axis))
+        nb = jnp.sqrt((b * b).sum(axis=axis))
+        return num / jnp.maximum(na * nb, eps)
+    return apply("cosine_similarity", fwd, [x1, x2])
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW",
+                name=None):
+    assert data_format in ("NCHW", "NCL", "NCDHW"), data_format
+    n_sp = x.ndim - 2
+    in_sp = x.shape[2:]
+    if size is None:
+        sf = scale_factor if isinstance(scale_factor, (list, tuple)) else \
+            [scale_factor] * n_sp
+        size = [int(s * f) for s, f in zip(in_sp, sf)]
+    elif isinstance(size, int):
+        size = [size] * n_sp
+    size = [int(s) for s in size]
+    jmode = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
+             "trilinear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
+
+    def fwd(a):
+        out_shape = tuple(a.shape[:2]) + tuple(size)
+        return jax.image.resize(a, out_shape, method=jmode)
+    return apply("interpolate", fwd, [x])
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode,
+                       data_format, name)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """im2col (reference: F.unfold). Output [N, C*kh*kw, L]."""
+    kh, kw = (kernel_sizes, kernel_sizes) if isinstance(kernel_sizes, int) \
+        else kernel_sizes
+    sh, sw = (strides, strides) if isinstance(strides, int) else strides
+    ph, pw = (paddings, paddings) if isinstance(paddings, int) else paddings
+    dh, dw = (dilations, dilations) if isinstance(dilations, int) else dilations
+
+    def fwd(a):
+        n, c, h, w = a.shape
+        pads = [(0, 0), (0, 0), (ph, ph), (pw, pw)]
+        patches = jax.lax.conv_general_dilated_patches(
+            a, (kh, kw), (sh, sw), pads, rhs_dilation=(dh, dw),
+            dimension_numbers=jax.lax.conv_dimension_numbers(
+                a.shape, (1, c, kh, kw), ("NCHW", "OIHW", "NCHW")))
+        # patches: [N, C*kh*kw, oh, ow]
+        return patches.reshape(n, patches.shape[1], -1)
+    return apply("unfold", fwd, [x])
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def fwd(y, *p):
+        k = y.shape[-1]
+        if p:
+            return (1 - epsilon) * y + epsilon * p[0]
+        return (1 - epsilon) * y + epsilon / k
+    ins = [label] + ([prior_dist] if prior_dist is not None else [])
+    return apply("label_smooth", fwd, ins)
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, name=None):
+    """Reference: paddle.nn.functional.scaled_dot_product_attention
+    (flash_attn kernel, phi/kernels/gpu/flash_attn_kernel.cu). Layout
+    [batch, seq, heads, head_dim]. XLA fuses this chain on TPU; a Pallas
+    flash-attention kernel backs the long-context path (see
+    paddle_tpu.incubate.flash_attention)."""
+    dk = _random.next_key() if (dropout_p > 0 and training) else None
+
+    def fwd(q, k, v, *m):
+        qf = q.astype(jnp.float32)
+        kf = k.astype(jnp.float32)
+        scale = 1.0 / np.sqrt(q.shape[-1])
+        # [B, S, H, D] -> [B, H, S, D]
+        qt = jnp.swapaxes(qf, 1, 2)
+        kt = jnp.swapaxes(kf, 1, 2)
+        vt = jnp.swapaxes(v.astype(jnp.float32), 1, 2)
+        scores = jnp.einsum("bhsd,bhtd->bhst", qt, kt) * scale
+        if is_causal:
+            s, t = scores.shape[-2], scores.shape[-1]
+            causal = jnp.tril(jnp.ones((s, t), bool))
+            scores = jnp.where(causal, scores, -1e30)
+        if m:
+            mask = m[0]
+            if mask.dtype == jnp.bool_:
+                scores = jnp.where(mask, scores, -1e30)
+            else:
+                scores = scores + mask.astype(jnp.float32)
+        probs = jax.nn.softmax(scores, axis=-1)
+        if dk is not None:
+            keep = jax.random.bernoulli(dk, 1 - dropout_p, probs.shape)
+            probs = jnp.where(keep, probs / (1 - dropout_p), 0.0)
+        out = jnp.einsum("bhst,bhtd->bhsd", probs, vt)
+        return jnp.swapaxes(out, 1, 2).astype(q.dtype)
+
+    ins = [query, key, value] + ([attn_mask] if attn_mask is not None else [])
+    return apply("scaled_dot_product_attention", fwd, ins)

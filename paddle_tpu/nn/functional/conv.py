@@ -1,0 +1,177 @@
+"""Convolution functionals over lax.conv_general_dilated.
+
+Reference: python/paddle/nn/functional/conv.py (conv2d → phi conv kernels /
+cudnn). TPU-native: one XLA convolution primitive covers all cases; XLA lowers
+it onto the MXU. Weight layouts match paddle: conv = [out_c, in_c/groups, *k],
+conv_transpose = [in_c, out_c/groups, *k].
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dispatch import apply
+
+__all__ = ["conv1d", "conv2d", "conv3d", "conv1d_transpose",
+           "conv2d_transpose", "conv3d_transpose"]
+
+
+def _ntuple(v, n):
+    if isinstance(v, (list, tuple)):
+        if len(v) == 1:
+            return tuple(v) * n
+        assert len(v) == n, f"expected {n} values, got {v}"
+        return tuple(int(x) for x in v)
+    return (int(v),) * n
+
+
+def _norm_padding(padding, n):
+    """Return lax-style [(lo, hi)] * n or the string 'SAME'/'VALID'."""
+    if isinstance(padding, str):
+        p = padding.upper()
+        assert p in ("SAME", "VALID"), f"bad padding {padding}"
+        return p
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if all(isinstance(p, int) for p in padding):
+        if len(padding) == n:
+            return [(p, p) for p in padding]
+        if len(padding) == 2 * n:  # [lo0, hi0, lo1, hi1 ...] paddle flat form
+            return [(padding[2 * i], padding[2 * i + 1]) for i in range(n)]
+    # [[lo, hi], ...] possibly including batch/channel dims (paddle allows 4x2)
+    pairs = [tuple(p) for p in padding]
+    if len(pairs) == n + 2:
+        pairs = pairs[2:]
+    assert len(pairs) == n
+    return pairs
+
+
+def _dim_numbers(nd, channel_last):
+    sp = "".join(chr(ord("0") + i) for i in range(nd))  # spatial dim labels
+    lhs = ("N" + sp + "C") if channel_last else ("NC" + sp)
+    out = lhs
+    rhs = "OI" + sp
+    return jax.lax.conv_dimension_numbers((0,) * (nd + 2), (0,) * (nd + 2),
+                                          (lhs, rhs, out))
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, data_format, nd,
+          name):
+    channel_last = data_format[-1] == "C"
+    stride = _ntuple(stride, nd)
+    dilation = _ntuple(dilation, nd)
+    pad = _norm_padding(padding, nd)
+    dn = _dim_numbers(nd, channel_last)
+
+    def fwd(a, w, *b):
+        out = jax.lax.conv_general_dilated(
+            a, w, window_strides=stride, padding=pad,
+            rhs_dilation=dilation, dimension_numbers=dn,
+            feature_group_count=groups,
+            preferred_element_type=jnp.float32
+            if a.dtype == jnp.bfloat16 else None)
+        out = out.astype(a.dtype)
+        if b:
+            bshape = [1] * out.ndim
+            bshape[-1 if channel_last else 1] = b[0].size
+            out = out + b[0].reshape(bshape)
+        return out
+
+    ins = [x, weight] + ([bias] if bias is not None else [])
+    return apply(f"conv{nd}d", fwd, ins)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups,
+                 data_format, 1, name)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    """Reference: python/paddle/nn/functional/conv.py (conv2d)."""
+    return _conv(x, weight, bias, stride, padding, dilation, groups,
+                 data_format, 2, name)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups,
+                 data_format, 3, name)
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding, groups,
+                    dilation, data_format, nd, output_size, name):
+    """Transposed conv as an input-dilated forward conv (the standard
+    grad-of-conv identity), so XLA sees one fused convolution.
+
+    paddle weight layout [in_c, out_c/groups, *k] is rearranged to the forward
+    layout with spatial flip.
+    """
+    channel_last = data_format[-1] == "C"
+    stride = _ntuple(stride, nd)
+    dilation = _ntuple(dilation, nd)
+    if isinstance(padding, str):
+        raise NotImplementedError(
+            "string padding for conv_transpose is not supported; pass ints")
+    pad = _norm_padding(padding, nd)
+    out_padding = _ntuple(output_padding, nd) if output_padding else (0,) * nd
+    dn = _dim_numbers(nd, channel_last)
+    in_c = weight.shape[0]
+    out_cg = weight.shape[1]  # out_c // groups
+
+    def fwd(a, w, *b):
+        # [in_c, out_c/g, *k] -> flip spatial -> [out_c, in_c/g, *k]
+        wf = jnp.flip(w, axis=tuple(range(2, 2 + nd)))
+        wf = wf.reshape((groups, in_c // groups, out_cg) + w.shape[2:])
+        wf = jnp.moveaxis(wf, 2, 1)  # [g, out_c/g, in_c/g, *k]
+        wf = wf.reshape((groups * out_cg, in_c // groups) + w.shape[2:])
+        tpad = []
+        for i in range(nd):
+            k_eff = dilation[i] * (w.shape[2 + i] - 1)
+            lo, hi = pad[i]
+            tpad.append((k_eff - lo, k_eff - hi + out_padding[i]))
+        out = jax.lax.conv_general_dilated(
+            a, wf, window_strides=(1,) * nd, padding=tpad,
+            lhs_dilation=stride, rhs_dilation=dilation,
+            dimension_numbers=dn, feature_group_count=groups)
+        out = out.astype(a.dtype)
+        if b:
+            bshape = [1] * out.ndim
+            bshape[-1 if channel_last else 1] = b[0].size
+            out = out + b[0].reshape(bshape)
+        return out
+
+    ins = [x, weight] + ([bias] if bias is not None else [])
+    out = apply(f"conv{nd}d_transpose", fwd, ins)
+    if output_size is not None:
+        want = _ntuple(output_size, nd)
+        have = out.shape[2:] if not channel_last else out.shape[1:-1]
+        if tuple(have) != want:
+            raise ValueError(
+                f"conv_transpose produced spatial shape {tuple(have)}, but "
+                f"output_size={want}; adjust output_padding")
+    return out
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCL", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           groups, dilation, data_format, 1, output_size, name)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, data_format="NCHW",
+                     output_size=None, name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           groups, dilation, data_format, 2, output_size, name)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     data_format="NCDHW", output_size=None, name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           groups, dilation, data_format, 3, output_size, name)

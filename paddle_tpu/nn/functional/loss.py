@@ -1,0 +1,191 @@
+"""Loss functionals (reference: python/paddle/nn/functional/loss.py).
+
+cross_entropy matches the reference semantics: fused softmax+NLL
+(use_softmax=True), hard or soft labels, class weights, ignore_index and
+label_smoothing, computed in f32 for bf16 inputs.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply
+
+__all__ = [
+    "cross_entropy", "softmax_with_cross_entropy", "mse_loss", "l1_loss",
+    "nll_loss", "binary_cross_entropy", "binary_cross_entropy_with_logits",
+    "smooth_l1_loss", "kl_div", "margin_ranking_loss", "square_error_cost",
+]
+
+
+def _reduce(val, reduction):
+    if reduction == "mean":
+        return val.mean()
+    if reduction == "sum":
+        return val.sum()
+    return val
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, label_smoothing=0.0, name=None):
+    """Reference: python/paddle/nn/functional/loss.py (cross_entropy)."""
+    n_classes = input.shape[axis]
+
+    def fwd(logits, lab, *w):
+        lf = logits.astype(jnp.float32)
+        logp = jax.nn.log_softmax(lf, axis=axis) if use_softmax else \
+            jnp.log(jnp.clip(lf, 1e-15, 1.0))
+        if soft_label:
+            soft = lab.astype(jnp.float32)
+            if label_smoothing > 0:
+                soft = soft * (1 - label_smoothing) + label_smoothing / n_classes
+            loss = -(soft * logp).sum(axis=axis)
+            if reduction == "mean":
+                return loss.mean()
+            return _reduce(loss, reduction)
+        li = lab
+        if li.ndim == logp.ndim:  # [N, 1] style labels
+            li = li.squeeze(axis)
+        valid = li != ignore_index
+        li_safe = jnp.where(valid, li, 0).astype(jnp.int32)
+        picked = jnp.take_along_axis(
+            logp, jnp.expand_dims(li_safe, axis), axis=axis).squeeze(axis)
+        if label_smoothing > 0:
+            smooth_term = logp.mean(axis=axis)
+            picked = (1 - label_smoothing) * picked \
+                + label_smoothing * smooth_term
+        loss = -picked
+        if w:
+            wc = w[0].astype(jnp.float32)[li_safe]
+            loss = loss * wc
+        loss = jnp.where(valid, loss, 0.0)
+        if reduction == "mean":
+            if w:
+                denom = jnp.where(valid, w[0].astype(jnp.float32)[li_safe],
+                                  0.0).sum()
+            else:
+                denom = valid.sum().astype(jnp.float32)
+            return loss.sum() / jnp.maximum(denom, 1e-12)
+        return _reduce(loss, reduction)
+
+    ins = [input, label] + ([weight] if weight is not None else [])
+    return apply("cross_entropy", fwd, ins)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none",
+                         axis=axis)
+    # paddle keeps the reduced axis as size-1
+    loss = loss.unsqueeze(axis) if hasattr(loss, "unsqueeze") else loss
+    if return_softmax:
+        from .activation import softmax as _softmax
+        return loss, _softmax(logits, axis=axis)
+    return loss
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    def fwd(a, b):
+        d = (a - b).astype(jnp.float32)
+        return _reduce(d * d, reduction)
+    return apply("mse_loss", fwd, [input, label])
+
+
+def square_error_cost(input, label):
+    def fwd(a, b):
+        d = a - b
+        return d * d
+    return apply("square_error_cost", fwd, [input, label])
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    def fwd(a, b):
+        return _reduce(jnp.abs(a - b), reduction)
+    return apply("l1_loss", fwd, [input, label])
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None):
+    def fwd(logp, lab, *w):
+        valid = lab != ignore_index
+        li = jnp.where(valid, lab, 0).astype(jnp.int32)
+        picked = jnp.take_along_axis(logp, jnp.expand_dims(li, 1),
+                                     axis=1).squeeze(1)
+        loss = -picked
+        if w:
+            wc = w[0][li]
+            loss = loss * wc
+        loss = jnp.where(valid, loss, 0.0)
+        if reduction == "mean":
+            denom = (w[0][li] * valid).sum() if w else valid.sum()
+            return loss.sum() / jnp.maximum(denom.astype(jnp.float32), 1e-12)
+        return _reduce(loss, reduction)
+    ins = [input, label] + ([weight] if weight is not None else [])
+    return apply("nll_loss", fwd, ins)
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean",
+                         name=None):
+    def fwd(p, y, *w):
+        pf = jnp.clip(p.astype(jnp.float32), 1e-12, 1 - 1e-12)
+        loss = -(y * jnp.log(pf) + (1 - y) * jnp.log1p(-pf))
+        if w:
+            loss = loss * w[0]
+        return _reduce(loss, reduction)
+    ins = [input, label] + ([weight] if weight is not None else [])
+    return apply("bce", fwd, ins)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None,
+                                     name=None):
+    def fwd(z, y, *extra):
+        zf = z.astype(jnp.float32)
+        yf = y.astype(jnp.float32)
+        # numerically stable: max(z,0) - z*y + log(1+exp(-|z|))
+        base = jnp.maximum(zf, 0) - zf * yf + jnp.log1p(jnp.exp(-jnp.abs(zf)))
+        i = 0
+        if pos_weight is not None:
+            pw = extra[i]
+            i += 1
+            # standard reweighting of the positive term
+            log_sig = jax.nn.log_sigmoid(zf)
+            log_one_minus = jax.nn.log_sigmoid(-zf)
+            base = -(pw * yf * log_sig + (1 - yf) * log_one_minus)
+        if weight is not None:
+            base = base * extra[i]
+        return _reduce(base, reduction)
+    ins = [logit, label]
+    if pos_weight is not None:
+        ins.append(pos_weight)
+    if weight is not None:
+        ins.append(weight)
+    return apply("bce_with_logits", fwd, ins)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def fwd(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+        return _reduce(loss, reduction)
+    return apply("smooth_l1", fwd, [input, label])
+
+
+def kl_div(input, label, reduction="mean", name=None):
+    def fwd(logp, y):
+        loss = y * (jnp.log(jnp.clip(y, 1e-12, None)) - logp)
+        if reduction == "batchmean":
+            return loss.sum() / logp.shape[0]
+        return _reduce(loss, reduction)
+    return apply("kl_div", fwd, [input, label])
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",
+                        name=None):
+    def fwd(a, b, y):
+        loss = jnp.maximum(0.0, -y * (a - b) + margin)
+        return _reduce(loss, reduction)
+    return apply("margin_ranking", fwd, [input, other, label])

@@ -1,0 +1,169 @@
+"""Normalization functionals (reference: python/paddle/nn/functional/norm.py).
+
+XLA fuses the mean/var/scale chain into one kernel on TPU, replacing the
+reference's fused CUDA kernels (phi/kernels/gpu/batch_norm_kernel.cu,
+fusion/gpu/fused_layernorm_kernel.cu). Statistics are computed in f32 even for
+bf16 inputs (TPU numerics practice).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core.dispatch import apply
+
+__all__ = ["layer_norm", "batch_norm", "group_norm", "instance_norm",
+           "rms_norm", "normalize"]
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
+               name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    n_axes = len(normalized_shape)
+    axes = tuple(range(-n_axes, 0))
+
+    def fwd(a, *wb):
+        af = a.astype(jnp.float32)
+        mean = af.mean(axis=axes, keepdims=True)
+        var = af.var(axis=axes, keepdims=True)
+        out = (af - mean) / jnp.sqrt(var + epsilon)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].astype(jnp.float32)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].astype(jnp.float32)
+        return out.astype(a.dtype)
+
+    ins = [x] + [t for t in (weight, bias) if t is not None]
+    return apply("layer_norm", fwd, ins)
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    """RMSNorm over the last axis (reference analog:
+    python/paddle/incubate/nn/functional/fused_rms_norm.py)."""
+    def fwd(a, *w):
+        af = a.astype(jnp.float32)
+        ms = jnp.mean(af * af, axis=-1, keepdims=True)
+        out = af / jnp.sqrt(ms + epsilon)
+        if w:
+            out = out * w[0].astype(jnp.float32)
+        return out.astype(a.dtype)
+    ins = [x] + ([weight] if weight is not None else [])
+    return apply("rms_norm", fwd, ins)
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5, data_format="NCHW",
+               use_global_stats=None, name=None):
+    """Reference: python/paddle/nn/functional/norm.py:124 (batch_norm).
+
+    paddle momentum semantics: running = momentum * running + (1-m) * batch.
+    Running stats are updated in place on the buffer tensors (outside the
+    tape), matching the reference's mutable mean/variance outputs.
+    """
+    ch_axis = 1 if data_format[1] == "C" or data_format in ("NC", "NCL") else -1
+    if data_format[-1] == "C" and len(data_format) > 2:
+        ch_axis = -1
+    red_axes = tuple(i for i in range(x.ndim) if i != (ch_axis % x.ndim))
+    use_batch_stats = training and not use_global_stats
+
+    if use_batch_stats:
+        xf = x._data.astype(jnp.float32)
+        batch_mean = xf.mean(axis=red_axes)
+        batch_var = xf.var(axis=red_axes)
+        # in-place running-stat update (no tape), paddle momentum convention
+        running_mean._data = (momentum * running_mean._data.astype(jnp.float32)
+                              + (1 - momentum) * batch_mean).astype(
+                                  running_mean._data.dtype)
+        running_var._data = (momentum * running_var._data.astype(jnp.float32)
+                             + (1 - momentum) * batch_var).astype(
+                                 running_var._data.dtype)
+
+    shape = [1] * x.ndim
+    shape[ch_axis] = x.shape[ch_axis % x.ndim]
+
+    def fwd(a, *wb):
+        af = a.astype(jnp.float32)
+        if use_batch_stats:
+            mean = af.mean(axis=red_axes)
+            var = af.var(axis=red_axes)
+        else:
+            mean = wb[-2].astype(jnp.float32)
+            var = wb[-1].astype(jnp.float32)
+        out = (af - mean.reshape(shape)) / jnp.sqrt(
+            var.reshape(shape) + epsilon)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].astype(jnp.float32).reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].astype(jnp.float32).reshape(shape)
+        return out.astype(a.dtype)
+
+    ins = [x] + [t for t in (weight, bias) if t is not None]
+    if not use_batch_stats:
+        ins += [running_mean, running_var]
+    return apply("batch_norm", fwd, ins)
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    assert data_format == "NCHW", "group_norm supports NCHW"
+    C = x.shape[1]
+    assert C % num_groups == 0
+
+    def fwd(a, *wb):
+        n = a.shape[0]
+        af = a.astype(jnp.float32).reshape((n, num_groups, C // num_groups)
+                                           + tuple(a.shape[2:]))
+        axes = tuple(range(2, af.ndim))
+        mean = af.mean(axis=axes, keepdims=True)
+        var = af.var(axis=axes, keepdims=True)
+        out = ((af - mean) / jnp.sqrt(var + epsilon)).reshape(a.shape)
+        shape = [1, C] + [1] * (a.ndim - 2)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].astype(jnp.float32).reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].astype(jnp.float32).reshape(shape)
+        return out.astype(a.dtype)
+
+    ins = [x] + [t for t in (weight, bias) if t is not None]
+    return apply("group_norm", fwd, ins)
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9, eps=1e-5,
+                  data_format="NCHW", name=None):
+    assert data_format == "NCHW"
+
+    def fwd(a, *wb):
+        af = a.astype(jnp.float32)
+        axes = tuple(range(2, a.ndim))
+        mean = af.mean(axis=axes, keepdims=True)
+        var = af.var(axis=axes, keepdims=True)
+        out = (af - mean) / jnp.sqrt(var + eps)
+        shape = [1, a.shape[1]] + [1] * (a.ndim - 2)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].astype(jnp.float32).reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].astype(jnp.float32).reshape(shape)
+        return out.astype(a.dtype)
+
+    ins = [x] + [t for t in (weight, bias) if t is not None]
+    return apply("instance_norm", fwd, ins)
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def fwd(a):
+        if p == 2:
+            norm = jnp.sqrt(jnp.sum(a * a, axis=axis, keepdims=True))
+        else:
+            norm = jnp.sum(jnp.abs(a) ** p, axis=axis,
+                           keepdims=True) ** (1.0 / p)
+        return a / jnp.maximum(norm, epsilon)
+    return apply("normalize", fwd, [x])

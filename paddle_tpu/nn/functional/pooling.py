@@ -1,0 +1,189 @@
+"""Pooling functionals over lax.reduce_window.
+
+Reference: python/paddle/nn/functional/pooling.py → phi pool kernels. On TPU a
+single reduce_window primitive covers max/avg pooling; adaptive pooling uses
+exact bucket means (integral-image free, static python loop over output cells
+is avoided via segment reductions when shapes divide evenly).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dispatch import apply
+
+__all__ = [
+    "max_pool1d", "max_pool2d", "max_pool3d", "avg_pool1d", "avg_pool2d",
+    "avg_pool3d", "adaptive_avg_pool1d", "adaptive_avg_pool2d",
+    "adaptive_max_pool1d", "adaptive_max_pool2d",
+]
+
+
+def _ntuple(v, n):
+    if isinstance(v, (list, tuple)):
+        if len(v) == 1:
+            return tuple(int(x) for x in v) * n
+        assert len(v) == n
+        return tuple(int(x) for x in v)
+    return (int(v),) * n
+
+
+def _pool(x, nd, kind, kernel_size, stride, padding, ceil_mode, exclusive,
+          data_format):
+    channel_last = data_format[-1] == "C"
+    ks = _ntuple(kernel_size, nd)
+    st = _ntuple(stride if stride is not None else kernel_size, nd)
+    pd = _ntuple(padding, nd)
+
+    sp_axes = list(range(1, 1 + nd)) if channel_last else list(range(2, 2 + nd))
+    ndim = nd + 2
+    window = [1] * ndim
+    strides = [1] * ndim
+    pads = [(0, 0)] * ndim
+    for i, ax in enumerate(sp_axes):
+        window[ax] = ks[i]
+        strides[ax] = st[i]
+        lo = hi = pd[i]
+        if ceil_mode:
+            # extend high padding so the last partial window is included
+            size = x.shape[ax]
+            out = -(-(size + 2 * pd[i] - ks[i]) // st[i]) + 1  # ceil
+            needed = (out - 1) * st[i] + ks[i] - size - pd[i]
+            hi = max(hi, needed)
+        pads[ax] = (lo, hi)
+
+    if kind == "max":
+        def fwd(a):
+            init = -jnp.inf if jnp.issubdtype(a.dtype, jnp.floating) else \
+                jnp.iinfo(a.dtype).min
+            return jax.lax.reduce_window(a, init, jax.lax.max, window, strides,
+                                         pads)
+        return apply(f"max_pool{nd}d", fwd, [x])
+
+    def fwd(a):
+        s = jax.lax.reduce_window(a.astype(jnp.float32), 0.0, jax.lax.add,
+                                  window, strides, pads)
+        if exclusive and (any(p[0] or p[1] for p in pads)):
+            ones = jnp.ones(a.shape, jnp.float32)
+            cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window,
+                                        strides, pads)
+            return (s / cnt).astype(a.dtype)
+        return (s / float(np.prod(ks))).astype(a.dtype)
+    return apply(f"avg_pool{nd}d", fwd, [x])
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    assert not return_mask, "return_mask is not supported on TPU yet"
+    return _pool(x, 1, "max", kernel_size, stride, padding, ceil_mode, True,
+                 data_format)
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    """Reference: python/paddle/nn/functional/pooling.py (max_pool2d)."""
+    assert not return_mask, "return_mask is not supported on TPU yet"
+    return _pool(x, 2, "max", kernel_size, stride, padding, ceil_mode, True,
+                 data_format)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    assert not return_mask, "return_mask is not supported on TPU yet"
+    return _pool(x, 3, "max", kernel_size, stride, padding, ceil_mode, True,
+                 data_format)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _pool(x, 1, "avg", kernel_size, stride, padding, ceil_mode,
+                 exclusive, data_format)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    assert divisor_override is None, "divisor_override not supported"
+    return _pool(x, 2, "avg", kernel_size, stride, padding, ceil_mode,
+                 exclusive, data_format)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    assert divisor_override is None, "divisor_override not supported"
+    return _pool(x, 3, "avg", kernel_size, stride, padding, ceil_mode,
+                 exclusive, data_format)
+
+
+def _adaptive_starts_ends(in_size, out_size):
+    starts = [(i * in_size) // out_size for i in range(out_size)]
+    ends = [-(-((i + 1) * in_size) // out_size) for i in range(out_size)]
+    return starts, ends
+
+
+def _adaptive_pool(x, output_size, nd, kind, data_format):
+    channel_last = data_format[-1] == "C"
+    sp_axes = list(range(1, 1 + nd)) if channel_last else \
+        list(range(2, 2 + nd))
+    out = _ntuple(output_size, nd)
+    in_sizes = [x.shape[ax] for ax in sp_axes]
+
+    if all(i % o == 0 for i, o in zip(in_sizes, out)):
+        # evenly divisible: reshape + reduce (one XLA fusion)
+        def fwd(a):
+            shape = list(a.shape)
+            new_shape = []
+            red_axes = []
+            k = 0
+            for ax in range(a.ndim):
+                if ax in sp_axes:
+                    i = sp_axes.index(ax)
+                    new_shape += [out[i], in_sizes[i] // out[i]]
+                    red_axes.append(len(new_shape) - 1)
+                    k += 1
+                else:
+                    new_shape.append(shape[ax])
+            r = a.reshape(new_shape)
+            if kind == "avg":
+                return r.mean(axis=tuple(red_axes))
+            return r.max(axis=tuple(red_axes))
+        return apply(f"adaptive_{kind}_pool{nd}d", fwd, [x])
+
+    # general case: static loop over output cells (small out sizes in practice)
+    def fwd(a):
+        def pool_axis(arr, ax_pos, in_size, out_size):
+            starts, ends = _adaptive_starts_ends(in_size, out_size)
+            pieces = []
+            for s, e in zip(starts, ends):
+                sl = [slice(None)] * arr.ndim
+                sl[ax_pos] = slice(s, e)
+                seg = arr[tuple(sl)]
+                red = seg.mean(axis=ax_pos, keepdims=True) if kind == "avg" \
+                    else seg.max(axis=ax_pos, keepdims=True)
+                pieces.append(red)
+            return jnp.concatenate(pieces, axis=ax_pos)
+        r = a
+        for i, ax in enumerate(sp_axes):
+            r = pool_axis(r, ax, in_sizes[i], out[i])
+        return r
+    return apply(f"adaptive_{kind}_pool{nd}d", fwd, [x])
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_pool(x, output_size, 1, "avg", "NCL")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_pool(x, output_size, 2, "avg", data_format)
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    assert not return_mask
+    return _adaptive_pool(x, output_size, 1, "max", "NCL")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    assert not return_mask
+    return _adaptive_pool(x, output_size, 2, "max", "NCHW")

@@ -1,0 +1,214 @@
+"""Parameter initializers — TPU-native rebuild of python/paddle/nn/initializer.
+
+Each initializer is a callable ``(shape, dtype) -> jax array`` drawing from the
+framework's seeded counter-based RNG (core/random.py), replacing the reference's
+init ops (uniform_random/gaussian_random kernels). Fan computation matches
+``nn/initializer/initializer.py:74`` (_compute_fans).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core import random as _random
+from ...core.dtype import convert_dtype
+
+__all__ = [
+    "Initializer", "Constant", "Normal", "TruncatedNormal", "Uniform",
+    "XavierNormal", "XavierUniform", "KaimingNormal", "KaimingUniform",
+    "Assign", "Orthogonal", "calculate_gain", "set_global_initializer",
+]
+
+_global_weight_init = None
+_global_bias_init = None
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """paddle.nn.initializer.set_global_initializer"""
+    global _global_weight_init, _global_bias_init
+    _global_weight_init = weight_init
+    _global_bias_init = bias_init
+
+
+def _global_initializer(is_bias):
+    return _global_bias_init if is_bias else _global_weight_init
+
+
+def calculate_gain(nonlinearity, param=None):
+    """Mirrors paddle.nn.initializer.calculate_gain."""
+    recommended = {
+        "sigmoid": 1.0, "linear": 1.0, "conv1d": 1.0, "conv2d": 1.0,
+        "conv3d": 1.0, "conv1d_transpose": 1.0, "conv2d_transpose": 1.0,
+        "conv3d_transpose": 1.0, "tanh": 5.0 / 3,
+        "relu": math.sqrt(2.0), "selu": 3.0 / 4,
+    }
+    if nonlinearity == "leaky_relu":
+        a = 0.01 if param is None else param
+        return math.sqrt(2.0 / (1 + a ** 2))
+    if nonlinearity in recommended:
+        return recommended[nonlinearity]
+    raise ValueError(f"unsupported nonlinearity: {nonlinearity}")
+
+
+def _compute_fans(shape):
+    """Reference: nn/initializer/initializer.py:74."""
+    if not shape:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    receptive = int(np.prod(shape[2:]))
+    return shape[1] * receptive, shape[0] * receptive
+
+
+class Initializer:
+    def __call__(self, shape, dtype=None):
+        dtype = convert_dtype(dtype) or jnp.float32
+        return self._generate(tuple(int(s) for s in shape), dtype)
+
+    def _generate(self, shape, dtype):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def _generate(self, shape, dtype):
+        return jnp.full(shape, self.value, dtype=dtype)
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def _generate(self, shape, dtype):
+        k = _random.next_key()
+        return (self.mean + self.std
+                * jax.random.normal(k, shape, jnp.float32)).astype(dtype)
+
+
+class TruncatedNormal(Initializer):
+    """Normal truncated to [mean + a*std, mean + b*std] (default a=-2, b=2)."""
+
+    def __init__(self, mean=0.0, std=1.0, a=-2.0, b=2.0):
+        self.mean, self.std, self.a, self.b = mean, std, a, b
+
+    def _generate(self, shape, dtype):
+        k = _random.next_key()
+        base = jax.random.truncated_normal(k, self.a, self.b, shape, jnp.float32)
+        return (self.mean + self.std * base).astype(dtype)
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0):
+        self.low, self.high = low, high
+
+    def _generate(self, shape, dtype):
+        k = _random.next_key()
+        return jax.random.uniform(k, shape, jnp.float32, self.low,
+                                  self.high).astype(dtype)
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def _generate(self, shape, dtype):
+        f_in, f_out = _compute_fans(shape)
+        f_in = self.fan_in if self.fan_in is not None else f_in
+        f_out = self.fan_out if self.fan_out is not None else f_out
+        std = self.gain * math.sqrt(2.0 / (f_in + f_out))
+        k = _random.next_key()
+        return (std * jax.random.normal(k, shape, jnp.float32)).astype(dtype)
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def _generate(self, shape, dtype):
+        f_in, f_out = _compute_fans(shape)
+        f_in = self.fan_in if self.fan_in is not None else f_in
+        f_out = self.fan_out if self.fan_out is not None else f_out
+        limit = self.gain * math.sqrt(6.0 / (f_in + f_out))
+        k = _random.next_key()
+        return jax.random.uniform(k, shape, jnp.float32, -limit,
+                                  limit).astype(dtype)
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def _generate(self, shape, dtype):
+        f_in, _ = _compute_fans(shape)
+        f_in = self.fan_in if self.fan_in is not None else f_in
+        gain = calculate_gain(self.nonlinearity, self.negative_slope) \
+            if self.nonlinearity == "leaky_relu" else calculate_gain(
+                self.nonlinearity)
+        std = gain / math.sqrt(f_in)
+        k = _random.next_key()
+        return (std * jax.random.normal(k, shape, jnp.float32)).astype(dtype)
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def _generate(self, shape, dtype):
+        f_in, _ = _compute_fans(shape)
+        f_in = self.fan_in if self.fan_in is not None else f_in
+        gain = calculate_gain(self.nonlinearity, self.negative_slope) \
+            if self.nonlinearity == "leaky_relu" else calculate_gain(
+                self.nonlinearity)
+        limit = gain * math.sqrt(3.0 / f_in)
+        k = _random.next_key()
+        return jax.random.uniform(k, shape, jnp.float32, -limit,
+                                  limit).astype(dtype)
+
+
+class Assign(Initializer):
+    def __init__(self, value):
+        self.value = value
+
+    def _generate(self, shape, dtype):
+        from ...core.tensor import Tensor
+        v = self.value
+        if isinstance(v, Tensor):
+            v = v._data
+        arr = jnp.asarray(np.asarray(v), dtype=dtype)
+        if tuple(arr.shape) != shape:
+            arr = arr.reshape(shape)
+        return arr
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain=1.0):
+        self.gain = gain
+
+    def _generate(self, shape, dtype):
+        if len(shape) < 2:
+            raise ValueError("Orthogonal initializer needs >=2 dims")
+        rows, cols = shape[0], int(np.prod(shape[1:]))
+        k = _random.next_key()
+        a = jax.random.normal(k, (max(rows, cols), min(rows, cols)), jnp.float32)
+        q, r = jnp.linalg.qr(a)
+        q = q * jnp.sign(jnp.diagonal(r))
+        if rows < cols:
+            q = q.T
+        return (self.gain * q[:rows, :cols]).reshape(shape).astype(dtype)
+
+
+# paddle also exposes these under their op-style aliases
+ConstantInitializer = Constant
+NormalInitializer = Normal
+UniformInitializer = Uniform

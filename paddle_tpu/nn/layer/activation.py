@@ -1,0 +1,67 @@
+"""Activation layers (reference: python/paddle/nn/layer/activation.py)."""
+from __future__ import annotations
+
+from .. import functional as F
+from .. import initializer as I
+from .layers import Layer
+
+__all__ = ["ReLU", "ReLU6", "GELU", "Sigmoid", "Tanh", "Softmax", "LogSoftmax",
+           "LeakyReLU", "ELU", "SELU", "CELU", "Hardtanh", "Hardsigmoid",
+           "Hardswish", "Hardshrink", "Softshrink", "Tanhshrink", "Softplus",
+           "Softsign", "Mish", "LogSigmoid", "Silu", "Swish", "PReLU", "GLU"]
+
+
+def _simple(name, fn_name, **fixed):
+    def forward(self, x):
+        return getattr(F, fn_name)(x, **fixed, **self._kw)
+
+    def __init__(self, *args, name=None, **kw):  # noqa: N807
+        Layer.__init__(self)
+        # positional args map onto the functional's keyword order
+        self._kw = kw
+        if args:
+            import inspect
+            sig = list(inspect.signature(getattr(F, fn_name)).parameters)[1:]
+            for a, k in zip(args, sig):
+                self._kw[k] = a
+
+    return type(name, (Layer,), {"__init__": __init__, "forward": forward})
+
+
+ReLU = _simple("ReLU", "relu")
+ReLU6 = _simple("ReLU6", "relu6")
+GELU = _simple("GELU", "gelu")
+Sigmoid = _simple("Sigmoid", "sigmoid")
+Tanh = _simple("Tanh", "tanh")
+Softmax = _simple("Softmax", "softmax")
+LogSoftmax = _simple("LogSoftmax", "log_softmax")
+LeakyReLU = _simple("LeakyReLU", "leaky_relu")
+ELU = _simple("ELU", "elu")
+SELU = _simple("SELU", "selu")
+CELU = _simple("CELU", "celu")
+Hardtanh = _simple("Hardtanh", "hardtanh")
+Hardsigmoid = _simple("Hardsigmoid", "hardsigmoid")
+Hardswish = _simple("Hardswish", "hardswish")
+Hardshrink = _simple("Hardshrink", "hardshrink")
+Softshrink = _simple("Softshrink", "softshrink")
+Tanhshrink = _simple("Tanhshrink", "tanhshrink")
+Softplus = _simple("Softplus", "softplus")
+Softsign = _simple("Softsign", "softsign")
+Mish = _simple("Mish", "mish")
+LogSigmoid = _simple("LogSigmoid", "log_sigmoid")
+Silu = _simple("Silu", "silu")
+Swish = _simple("Swish", "swish")
+GLU = _simple("GLU", "glu")
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters=1, init=0.25, weight_attr=None,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        self._data_format = data_format
+        self.weight = self.create_parameter(
+            shape=[num_parameters], attr=weight_attr,
+            default_initializer=I.Constant(init))
+
+    def forward(self, x):
+        return F.prelu(x, self.weight, self._data_format)

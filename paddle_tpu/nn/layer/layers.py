@@ -1,0 +1,342 @@
+"""Layer — base class for all NN modules.
+
+Reference: python/paddle/nn/layer/layers.py:333 (class Layer): parameter/buffer/
+sublayer registries, hooks, state_dict round-trip, train/eval flags. TPU-native
+notes: parameters are eager Tensors over jax.Array; `to(dtype=...)` casts in
+place; everything is functionalization-friendly so jit.to_static can lift
+parameters/buffers into traced inputs.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+from ...core.dtype import convert_dtype, get_default_dtype, is_floating
+from ...core.tensor import Parameter, Tensor
+from ...framework.param_attr import ParamAttr
+from .. import initializer as I
+
+__all__ = ["Layer"]
+
+
+class HookRemoveHelper:
+    next_hook_id = [0]
+
+    def __init__(self, hooks):
+        self._hooks = hooks
+        HookRemoveHelper.next_hook_id[0] += 1
+        self._hook_id = HookRemoveHelper.next_hook_id[0]
+
+    def remove(self):
+        self._hooks.pop(self._hook_id, None)
+
+
+class Layer:
+    """Base network module (reference Layer, nn/layer/layers.py:333)."""
+
+    def __init__(self, name_scope=None, dtype=None):
+        self.training = True
+        self._dtype = convert_dtype(dtype) or get_default_dtype()
+        self._parameters: "OrderedDict[str, Parameter]" = OrderedDict()
+        self._sub_layers: "OrderedDict[str, Layer]" = OrderedDict()
+        self._buffers: "OrderedDict[str, Tensor]" = OrderedDict()
+        self._non_persistable_buffer_names_set = set()
+        self._forward_pre_hooks: "OrderedDict[int, callable]" = OrderedDict()
+        self._forward_post_hooks: "OrderedDict[int, callable]" = OrderedDict()
+        self._name = name_scope or self.__class__.__name__.lower()
+
+    # ---- forward plumbing ----
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError(
+            f"{type(self).__name__} must implement forward()")
+
+    def __call__(self, *inputs, **kwargs):
+        for hook in list(self._forward_pre_hooks.values()):
+            res = hook(self, inputs)
+            if res is not None:
+                inputs = res if isinstance(res, tuple) else (res,)
+        outputs = self.forward(*inputs, **kwargs)
+        for hook in list(self._forward_post_hooks.values()):
+            res = hook(self, inputs, outputs)
+            if res is not None:
+                outputs = res
+        return outputs
+
+    def register_forward_pre_hook(self, hook) -> HookRemoveHelper:
+        helper = HookRemoveHelper(self._forward_pre_hooks)
+        self._forward_pre_hooks[helper._hook_id] = hook
+        return helper
+
+    def register_forward_post_hook(self, hook) -> HookRemoveHelper:
+        helper = HookRemoveHelper(self._forward_post_hooks)
+        self._forward_post_hooks[helper._hook_id] = hook
+        return helper
+
+    # ---- train/eval ----
+    def train(self):
+        self.training = True
+        for layer in self.sublayers():
+            layer.training = True
+        return self
+
+    def eval(self):
+        self.training = False
+        for layer in self.sublayers():
+            layer.training = False
+        return self
+
+    # ---- parameter creation ----
+    def create_parameter(self, shape, attr=None, dtype=None, is_bias=False,
+                         default_initializer=None) -> Optional[Parameter]:
+        """Reference: Layer.create_parameter → LayerHelperBase.create_parameter.
+        Default initializers: XavierUniform for weights, Constant(0) for bias
+        (base/layer_helper_base.py), overridable per-layer or globally."""
+        attr = ParamAttr._to_attr(attr)
+        if attr is False:
+            return None
+        dtype = convert_dtype(dtype) or self._dtype
+        init = attr.initializer or I._global_initializer(is_bias) \
+            or default_initializer
+        if init is None:
+            init = I.Constant(0.0) if is_bias else I.XavierUniform()
+        data = init(shape, dtype) if callable(init) else init
+        p = Parameter(data, dtype=dtype, trainable=attr.trainable,
+                      name=attr.name)
+        p.optimize_attr = {"learning_rate": attr.learning_rate}
+        p.regularizer = attr.regularizer
+        p.need_clip = attr.need_clip
+        return p
+
+    def create_tensor(self, name=None, persistable=False, dtype=None):
+        t = Tensor(np.zeros([0], dtype="float32"),
+                   dtype=convert_dtype(dtype) or self._dtype, name=name)
+        t.persistable = persistable
+        return t
+
+    # ---- registration ----
+    def add_parameter(self, name, parameter) -> Optional[Parameter]:
+        if parameter is not None and not isinstance(parameter, Parameter):
+            raise TypeError(
+                f"add_parameter expects a Parameter, got {type(parameter)}")
+        self._parameters[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name, sublayer) -> "Layer":
+        if not isinstance(sublayer, Layer):
+            raise TypeError(
+                f"add_sublayer expects a Layer, got {type(sublayer)}")
+        self._sub_layers[str(name)] = sublayer
+        return sublayer
+
+    def register_buffer(self, name, tensor, persistable=True):
+        if tensor is not None and not isinstance(tensor, Tensor):
+            raise TypeError(
+                f"register_buffer expects a Tensor, got {type(tensor)}")
+        self._buffers[name] = tensor
+        if persistable:
+            self._non_persistable_buffer_names_set.discard(name)
+        else:
+            self._non_persistable_buffer_names_set.add(name)
+
+    # ---- attribute magic ----
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        layers = self.__dict__.get("_sub_layers")
+        buffers = self.__dict__.get("_buffers")
+        if isinstance(value, Parameter):
+            if params is None:
+                raise RuntimeError(
+                    "super().__init__() must be called before assigning "
+                    "parameters")
+            for registry in (layers, buffers):
+                if registry is not None:
+                    registry.pop(name, None)
+            params[name] = value
+        elif isinstance(value, Layer):
+            if layers is None:
+                raise RuntimeError(
+                    "super().__init__() must be called before assigning "
+                    "sublayers")
+            for registry in (params, buffers):
+                if registry is not None:
+                    registry.pop(name, None)
+            layers[name] = value
+        elif buffers is not None and name in buffers:
+            # assigning a Tensor over a registered buffer keeps buffer-ness
+            buffers[name] = value
+        else:
+            if params is not None:
+                params.pop(name, None)
+            if layers is not None:
+                layers.pop(name, None)
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        for registry in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(registry)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(
+            f"'{type(self).__name__}' object has no attribute '{name}'")
+
+    def __delattr__(self, name):
+        for registry in (self._parameters, self._sub_layers, self._buffers):
+            if name in registry:
+                del registry[name]
+                return
+        object.__delattr__(self, name)
+
+    def __dir__(self):
+        return list(super().__dir__()) + list(self._parameters) \
+            + list(self._sub_layers) + list(self._buffers)
+
+    # ---- traversal ----
+    def children(self) -> Iterator["Layer"]:
+        for _, layer in self.named_children():
+            yield layer
+
+    def named_children(self) -> Iterator[Tuple[str, "Layer"]]:
+        seen = set()
+        for name, layer in self._sub_layers.items():
+            if layer is not None and id(layer) not in seen:
+                seen.add(id(layer))
+                yield name, layer
+
+    def sublayers(self, include_self=False):
+        return [layer for _, layer in self.named_sublayers(
+            include_self=include_self)]
+
+    def named_sublayers(self, prefix="", include_self=False, layers_set=None):
+        if layers_set is None:
+            layers_set = set()
+        if include_self and id(self) not in layers_set:
+            layers_set.add(id(self))
+            yield prefix, self
+        for name, layer in self.named_children():
+            if layer is None or id(layer) in layers_set:
+                continue
+            layers_set.add(id(layer))
+            sub_prefix = f"{prefix}.{name}" if prefix else name
+            yield sub_prefix, layer
+            yield from layer.named_sublayers(prefix=sub_prefix,
+                                             include_self=False,
+                                             layers_set=layers_set)
+
+    def parameters(self, include_sublayers=True):
+        return [p for _, p in self.named_parameters(
+            include_sublayers=include_sublayers)]
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        seen = set()
+        layers = self.named_sublayers(prefix=prefix, include_self=True) \
+            if include_sublayers else [(prefix, self)]
+        for layer_prefix, layer in layers:
+            for name, p in layer._parameters.items():
+                if p is None or id(p) in seen:
+                    continue
+                seen.add(id(p))
+                yield (f"{layer_prefix}.{name}" if layer_prefix else name), p
+
+    def buffers(self, include_sublayers=True):
+        return [b for _, b in self.named_buffers(
+            include_sublayers=include_sublayers)]
+
+    def named_buffers(self, prefix="", include_sublayers=True):
+        seen = set()
+        layers = self.named_sublayers(prefix=prefix, include_self=True) \
+            if include_sublayers else [(prefix, self)]
+        for layer_prefix, layer in layers:
+            for name, b in layer._buffers.items():
+                if b is None or id(b) in seen:
+                    continue
+                seen.add(id(b))
+                yield (f"{layer_prefix}.{name}" if layer_prefix else name), b
+
+    def apply(self, fn):
+        for layer in self.children():
+            layer.apply(fn)
+        fn(self)
+        return self
+
+    # ---- state dict ----
+    def state_dict(self, destination=None, include_sublayers=True,
+                   structured_name_prefix="", use_hook=True):
+        """name → Tensor for all parameters + persistable buffers
+        (reference: Layer.state_dict)."""
+        dest = OrderedDict() if destination is None else destination
+        for name, p in self.named_parameters(prefix=structured_name_prefix,
+                                             include_sublayers=include_sublayers):
+            dest[name] = p
+        prefix = structured_name_prefix
+        layers = self.named_sublayers(prefix=prefix, include_self=True) \
+            if include_sublayers else [(prefix, self)]
+        for layer_prefix, layer in layers:
+            for name, b in layer._buffers.items():
+                if b is None or name in layer._non_persistable_buffer_names_set:
+                    continue
+                dest[f"{layer_prefix}.{name}" if layer_prefix else name] = b
+        return dest
+
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        """Load values into matching params/buffers; returns
+        (missing_keys, unexpected_keys) like the reference."""
+        own = self.state_dict()
+        missing, matched = [], set()
+        for name, target in own.items():
+            if name not in state_dict:
+                missing.append(name)
+                continue
+            src = state_dict[name]
+            src_arr = src.numpy() if isinstance(src, Tensor) else \
+                np.asarray(src)
+            if list(src_arr.shape) != list(target.shape):
+                raise ValueError(
+                    f"state_dict['{name}'] has shape {list(src_arr.shape)} "
+                    f"but expects {list(target.shape)}")
+            target.set_value(src_arr)
+            matched.add(name)
+        unexpected = [k for k in state_dict if k not in own]
+        return missing, unexpected
+
+    load_dict = set_state_dict
+
+    # ---- misc ----
+    def to(self, device=None, dtype=None, blocking=None):
+        if dtype is not None:
+            dtype = convert_dtype(dtype)
+            for p in self.parameters():
+                if is_floating(p.dtype):
+                    p._data = p._data.astype(dtype)
+            for b in self.buffers():
+                if b is not None and is_floating(b.dtype):
+                    b._data = b._data.astype(dtype)
+            for layer in self.sublayers(include_self=True):
+                layer._dtype = dtype
+        return self
+
+    def astype(self, dtype):
+        return self.to(dtype=dtype)
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_grad()
+
+    def full_name(self):
+        return self._name
+
+    def extra_repr(self):
+        return ""
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = []
+        for name, layer in self.named_children():
+            mod_str = repr(layer)
+            mod_str = "\n  ".join(mod_str.split("\n"))
+            lines.append(f"({name}): {mod_str}")
+        main = f"{type(self).__name__}({extra}"
+        if lines:
+            main += "\n  " + "\n  ".join(lines) + "\n"
+        return main + ")"

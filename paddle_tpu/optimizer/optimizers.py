@@ -1,0 +1,233 @@
+"""Concrete optimizers (reference: python/paddle/optimizer/{sgd,momentum,adam,
+adamw,adagrad,rmsprop,adadelta,adamax,lamb}.py).
+
+Each `_update` is a pure jnp expression; XLA fuses it into a single kernel per
+parameter (the reference needs hand-fused CUDA kernels for this —
+phi/kernels/gpu/fused_adam_kernel.cu).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .optimizer import Optimizer
+
+__all__ = ["SGD", "Momentum", "Adam", "AdamW", "Adagrad", "RMSProp",
+           "Adadelta", "Adamax", "Lamb"]
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+
+    def _update(self, p, w, g, lr, group):
+        return w - lr * g
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _update(self, p, w, g, lr, group):
+        v = self._get_accumulator("velocity", p)
+        v = self._momentum * v + g
+        self._set_accumulator("velocity", p, v)
+        if self._use_nesterov:
+            return w - lr * (g + self._momentum * v)
+        return w - lr * v
+
+
+class Adam(Optimizer):
+    """Reference: python/paddle/optimizer/adam.py."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 use_multi_tensor=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _update(self, p, w, g, lr, group):
+        m = self._get_accumulator("moment1", p)
+        v = self._get_accumulator("moment2", p)
+        t = self._get_accumulator("beta_pow", p,
+                                  init=jnp.zeros((), jnp.float32))
+        t = t + 1
+        m = self._beta1 * m + (1 - self._beta1) * g
+        v = self._beta2 * v + (1 - self._beta2) * g * g
+        self._set_accumulator("moment1", p, m)
+        self._set_accumulator("moment2", p, v)
+        self._set_accumulator("beta_pow", p, t)
+        mhat = m / (1 - self._beta1 ** t)
+        vhat = v / (1 - self._beta2 ** t)
+        return w - lr * mhat / (jnp.sqrt(vhat) + self._epsilon)
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference: python/paddle/optimizer/adamw.py):
+    w ← w - lr * coeff * w applied outside the adaptive update."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip, lazy_mode, multi_precision,
+                         name=name)
+        self._decay_coeff = float(weight_decay) if not hasattr(
+            weight_decay, "coeff") else weight_decay.coeff
+        self._apply_decay_param_fun = apply_decay_param_fun
+        self._lr_ratio = lr_ratio
+
+    def _coupled_decay_coeff(self, group):
+        return 0.0, None  # decay is decoupled
+
+    def _update(self, p, w, g, lr, group):
+        if self._lr_ratio is not None:
+            lr = lr * self._lr_ratio(p)
+        wd = group.get("weight_decay", self._decay_coeff)
+        wd = wd.coeff if hasattr(wd, "coeff") else float(wd)
+        if self._apply_decay_param_fun is not None and \
+                not self._apply_decay_param_fun(p.name):
+            wd = 0.0
+        w = w * (1.0 - lr * wd)
+        return super()._update(p, w, g, lr, group)
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, initial_accumulator_value
+                 =0.0, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._epsilon = epsilon
+        self._initial = initial_accumulator_value
+
+    def _update(self, p, w, g, lr, group):
+        acc = self._get_accumulator(
+            "moment", p, init=jnp.full(p._data.shape, self._initial,
+                                       jnp.float32 if self._use_master(p)
+                                       else p._data.dtype))
+        acc = acc + g * g
+        self._set_accumulator("moment", p, acc)
+        return w - lr * g / (jnp.sqrt(acc) + self._epsilon)
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._rho = rho
+        self._epsilon = epsilon
+        self._momentum = momentum
+        self._centered = centered
+
+    def _update(self, p, w, g, lr, group):
+        ms = self._get_accumulator("mean_square", p)
+        ms = self._rho * ms + (1 - self._rho) * g * g
+        self._set_accumulator("mean_square", p, ms)
+        if self._centered:
+            mg = self._get_accumulator("mean_grad", p)
+            mg = self._rho * mg + (1 - self._rho) * g
+            self._set_accumulator("mean_grad", p, mg)
+            denom = jnp.sqrt(ms - mg * mg + self._epsilon)
+        else:
+            denom = jnp.sqrt(ms + self._epsilon)
+        mom = self._get_accumulator("momentum", p)
+        mom = self._momentum * mom + lr * g / denom
+        self._set_accumulator("momentum", p, mom)
+        return w - mom
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._epsilon = epsilon
+        self._rho = rho
+
+    def _update(self, p, w, g, lr, group):
+        avg_sq = self._get_accumulator("avg_squared_grad", p)
+        avg_up = self._get_accumulator("avg_squared_update", p)
+        avg_sq = self._rho * avg_sq + (1 - self._rho) * g * g
+        update = jnp.sqrt(avg_up + self._epsilon) / \
+            jnp.sqrt(avg_sq + self._epsilon) * g
+        avg_up = self._rho * avg_up + (1 - self._rho) * update * update
+        self._set_accumulator("avg_squared_grad", p, avg_sq)
+        self._set_accumulator("avg_squared_update", p, avg_up)
+        return w - lr * update
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _update(self, p, w, g, lr, group):
+        m = self._get_accumulator("moment", p)
+        u = self._get_accumulator("inf_norm", p)
+        t = self._get_accumulator("beta1_pow", p,
+                                  init=jnp.ones((), jnp.float32))
+        t = t * self._beta1
+        m = self._beta1 * m + (1 - self._beta1) * g
+        u = jnp.maximum(self._beta2 * u, jnp.abs(g))
+        self._set_accumulator("moment", p, m)
+        self._set_accumulator("inf_norm", p, u)
+        self._set_accumulator("beta1_pow", p, t)
+        return w - lr / (1 - t) * m / (u + self._epsilon)
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip,
+                         multi_precision, name)
+        self._wd = lamb_weight_decay
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _update(self, p, w, g, lr, group):
+        m = self._get_accumulator("moment1", p)
+        v = self._get_accumulator("moment2", p)
+        t = self._get_accumulator("beta_pow", p,
+                                  init=jnp.zeros((), jnp.float32))
+        t = t + 1
+        m = self._beta1 * m + (1 - self._beta1) * g
+        v = self._beta2 * v + (1 - self._beta2) * g * g
+        self._set_accumulator("moment1", p, m)
+        self._set_accumulator("moment2", p, v)
+        self._set_accumulator("beta_pow", p, t)
+        mhat = m / (1 - self._beta1 ** t)
+        vhat = v / (1 - self._beta2 ** t)
+        r = mhat / (jnp.sqrt(vhat) + self._epsilon)
+        wd = 0.0 if (self._exclude_fn is not None and self._exclude_fn(p)) \
+            else self._wd
+        update = r + wd * w
+        w_norm = jnp.linalg.norm(w)
+        u_norm = jnp.linalg.norm(update)
+        trust = jnp.where(w_norm > 0, jnp.where(u_norm > 0, w_norm / u_norm,
+                                                1.0), 1.0)
+        return w - lr * trust * update

@@ -1,0 +1,123 @@
+"""Layer base-class behaviors + layer zoo (reference precedents:
+test/legacy_test/test_imperative_layers.py, test_state_dict coverage)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def test_layer_registration_and_traversal():
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(4, 8)
+            self.act = nn.ReLU()
+            self.fc2 = nn.Linear(8, 2)
+            self.scale = self.create_parameter([1],
+                                               default_initializer=nn.initializer.Constant(2.0))
+
+        def forward(self, x):
+            return self.fc2(self.act(self.fc1(x))) * self.scale
+
+    net = Net()
+    names = [n for n, _ in net.named_parameters()]
+    assert set(names) == {"fc1.weight", "fc1.bias", "fc2.weight", "fc2.bias",
+                          "scale"}
+    assert len(net.sublayers()) == 3
+    x = paddle.to_tensor(np.random.randn(2, 4).astype("float32"))
+    assert net(x).shape == [2, 2]
+
+
+def test_state_dict_roundtrip_with_buffers():
+    m = nn.Sequential(nn.Linear(3, 4), nn.BatchNorm1D(4))
+    x = paddle.to_tensor(np.random.randn(10, 3, 1).astype("float32") * 3)
+    m.train()
+    m(x.reshape([10, 3]).unsqueeze(-1).squeeze(-1)) if False else None
+    m2 = nn.Sequential(nn.Linear(3, 4), nn.BatchNorm1D(4))
+    sd = m.state_dict()
+    assert "1._mean" in sd and "1._variance" in sd  # paddle bn buffer names
+    m2.set_state_dict(sd)
+    for (k1, v1), (k2, v2) in zip(sorted(m.state_dict().items()),
+                                  sorted(m2.state_dict().items())):
+        np.testing.assert_allclose(v1.numpy(), v2.numpy())
+
+
+def test_state_dict_save_load_file(tmp_path):
+    m = nn.Linear(5, 3)
+    path = str(tmp_path / "model.pdparams")
+    paddle.save(m.state_dict(), path)
+    m2 = nn.Linear(5, 3)
+    missing, unexpected = m2.set_state_dict(paddle.load(path))
+    assert not missing and not unexpected
+    np.testing.assert_allclose(m.weight.numpy(), m2.weight.numpy())
+
+
+def test_train_eval_propagates():
+    m = nn.Sequential(nn.Linear(2, 2), nn.Dropout(0.5))
+    assert m.training and m[1].training
+    m.eval()
+    assert not m.training and not m[1].training
+    x = paddle.to_tensor(np.ones((4, 2), "float32"))
+    np.testing.assert_allclose(m[1](x).numpy(), np.ones((4, 2)))  # no dropout
+
+
+def test_forward_hooks():
+    m = nn.Linear(2, 2)
+    calls = []
+    h1 = m.register_forward_pre_hook(lambda layer, inp: calls.append("pre"))
+    h2 = m.register_forward_post_hook(
+        lambda layer, inp, out: calls.append("post"))
+    m(paddle.to_tensor(np.ones((1, 2), "float32")))
+    assert calls == ["pre", "post"]
+    h1.remove(); h2.remove()
+    calls.clear()
+    m(paddle.to_tensor(np.ones((1, 2), "float32")))
+    assert calls == []
+
+
+def test_layer_to_dtype():
+    m = nn.Linear(2, 2)
+    m.to(dtype="bfloat16")
+    assert str(m.weight.dtype) == "bfloat16"
+
+
+def test_sublayer_setattr_replacement():
+    m = nn.Sequential(nn.Linear(2, 2))
+    lin = nn.Linear(2, 3)
+    m.head = lin
+    assert ("head", lin) in list(m.named_children())
+    del m.head
+    assert "head" not in dict(m.named_children())
+
+
+def test_parameter_list_and_layer_list():
+    plist = nn.ParameterList([paddle.Parameter(np.zeros((2, 2), "float32"))])
+    assert len(list(plist.parameters())) == 1
+    llist = nn.LayerList([nn.Linear(2, 2), nn.Linear(2, 2)])
+    llist.append(nn.Linear(2, 2))
+    assert len(llist) == 3
+    assert len(list(llist.parameters())) == 6
+
+
+def test_multihead_attention_shapes():
+    mha = nn.MultiHeadAttention(16, 4, dropout=0.0)
+    x = paddle.to_tensor(np.random.randn(2, 5, 16).astype("float32"))
+    out = mha(x, x, x)
+    assert out.shape == [2, 5, 16]
+
+
+def test_transformer_encoder():
+    layer = nn.TransformerEncoderLayer(d_model=16, nhead=2,
+                                       dim_feedforward=32, dropout=0.0)
+    enc = nn.TransformerEncoder(layer, 2)
+    x = paddle.to_tensor(np.random.randn(2, 4, 16).astype("float32"))
+    assert enc(x).shape == [2, 4, 16]
+    # deepcopied layers must be independent parameters
+    p = list(enc.parameters())
+    assert len({id(t) for t in p}) == len(p)
+
+
+def test_embedding_layer_padding():
+    emb = nn.Embedding(10, 4, padding_idx=0)
+    assert np.all(emb.weight.numpy()[0] == 0)

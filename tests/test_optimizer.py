@@ -1,0 +1,219 @@
+"""Optimizer + LR scheduler + grad clip tests, ending in the LeNet/MNIST-style
+convergence test (BASELINE config 1; reference test/book/test_recognize_digits.py
+— synthetic digits stand in for the real MNIST download)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def _quad_problem():
+    paddle.seed(0)
+    w = paddle.to_tensor(np.array([3.0, -2.0], np.float32),
+                         stop_gradient=False)
+    w = paddle.Parameter(np.array([3.0, -2.0], np.float32))
+    return w
+
+
+def test_sgd_matches_manual():
+    p = paddle.Parameter(np.array([1.0, 2.0], np.float32))
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[p])
+    (p * p).sum().backward()
+    opt.step()
+    np.testing.assert_allclose(p.numpy(), [1 - 0.1 * 2, 2 - 0.1 * 4],
+                               rtol=1e-6)
+
+
+def test_momentum_matches_manual():
+    p = paddle.Parameter(np.array([1.0], np.float32))
+    opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                                    parameters=[p])
+    v = 0.0
+    x = 1.0
+    for _ in range(3):
+        (p * p).sum().backward()
+        opt.step(); opt.clear_grad()
+        g = 2 * x
+        v = 0.9 * v + g
+        x = x - 0.1 * v
+    np.testing.assert_allclose(p.numpy(), [x], rtol=1e-5)
+
+
+def test_adam_matches_reference_formula():
+    p = paddle.Parameter(np.array([0.5], np.float32))
+    opt = paddle.optimizer.Adam(learning_rate=0.01, parameters=[p])
+    m = v = 0.0
+    x = 0.5
+    for t in range(1, 4):
+        (p * p).sum().backward()
+        opt.step(); opt.clear_grad()
+        g = 2 * x
+        m = 0.9 * m + 0.1 * g
+        v = 0.999 * v + 0.001 * g * g
+        mh = m / (1 - 0.9 ** t)
+        vh = v / (1 - 0.999 ** t)
+        x = x - 0.01 * mh / (np.sqrt(vh) + 1e-8)
+    np.testing.assert_allclose(p.numpy(), [x], rtol=1e-5)
+
+
+def test_adamw_decoupled_decay():
+    # with zero gradient influence removed, AdamW still decays weights
+    p = paddle.Parameter(np.array([1.0], np.float32))
+    opt = paddle.optimizer.AdamW(learning_rate=0.1, weight_decay=0.5,
+                                 parameters=[p])
+    (p * 0.0).sum().backward()
+    opt.step()
+    # pure decay: w *= (1 - lr*wd) = 0.95; adam update of zero grad is 0
+    np.testing.assert_allclose(p.numpy(), [0.95], rtol=1e-5)
+
+
+def test_weight_decay_coupled_sgd():
+    p = paddle.Parameter(np.array([1.0], np.float32))
+    opt = paddle.optimizer.SGD(learning_rate=0.1, weight_decay=0.5,
+                               parameters=[p])
+    (p * 0.0).sum().backward()
+    opt.step()
+    # g = 0 + 0.5*w → w - 0.1*0.5 = 0.95
+    np.testing.assert_allclose(p.numpy(), [0.95], rtol=1e-5)
+
+
+def test_param_groups_lr():
+    p1 = paddle.Parameter(np.array([1.0], np.float32))
+    p2 = paddle.Parameter(np.array([1.0], np.float32))
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[
+        {"params": [p1]},
+        {"params": [p2], "learning_rate": 0.5},
+    ])
+    for p in (p1, p2):
+        (p * p).sum().backward()
+    opt.step()
+    np.testing.assert_allclose(p1.numpy(), [0.8], rtol=1e-5)
+    np.testing.assert_allclose(p2.numpy(), [1 - 0.1 * 0.5 * 2], rtol=1e-5)
+
+
+def test_grad_clip_global_norm():
+    p1 = paddle.Parameter(np.array([3.0], np.float32))
+    p2 = paddle.Parameter(np.array([4.0], np.float32))
+    clip = nn.ClipGradByGlobalNorm(1.0)
+    opt = paddle.optimizer.SGD(learning_rate=1.0, parameters=[p1, p2],
+                               grad_clip=clip)
+    (p1 * 1.0).sum().backward()  # g1 = 1
+    (p2 * 1.0).sum().backward()  # g2 = 1
+    p1._grad = np.float32(3.0) * p1._grad  # g1=3
+    p2._grad = np.float32(4.0) * p2._grad  # g2=4  → global norm 5
+    opt.step()
+    np.testing.assert_allclose(p1.numpy(), [3.0 - 3.0 / 5], rtol=1e-5)
+    np.testing.assert_allclose(p2.numpy(), [4.0 - 4.0 / 5], rtol=1e-5)
+
+
+def test_lr_schedulers():
+    lr = paddle.optimizer.lr.StepDecay(0.1, step_size=2, gamma=0.5)
+    vals = []
+    for _ in range(5):
+        vals.append(lr())
+        lr.step()
+    np.testing.assert_allclose(vals, [0.1, 0.1, 0.05, 0.05, 0.025], rtol=1e-6)
+
+    cos = paddle.optimizer.lr.CosineAnnealingDecay(1.0, T_max=10)
+    assert abs(cos() - 1.0) < 1e-6
+    cos.step(10)
+    assert abs(cos()) < 1e-6
+
+    warm = paddle.optimizer.lr.LinearWarmup(0.1, warmup_steps=10,
+                                            start_lr=0.0, end_lr=0.1)
+    warm.step(5)
+    np.testing.assert_allclose(warm(), 0.05, rtol=1e-6)
+
+
+def test_scheduler_with_optimizer_and_state():
+    p = paddle.Parameter(np.array([1.0], np.float32))
+    sched = paddle.optimizer.lr.ExponentialDecay(0.1, gamma=0.5)
+    opt = paddle.optimizer.SGD(learning_rate=sched, parameters=[p])
+    assert opt.get_lr() == 0.1
+    sched.step()
+    assert abs(opt.get_lr() - 0.05) < 1e-9
+    sd = opt.state_dict()
+    assert "LR_Scheduler" in sd
+
+
+def test_optimizer_state_roundtrip(tmp_path):
+    p = paddle.Parameter(np.random.randn(3, 3).astype("float32"))
+    opt = paddle.optimizer.Adam(learning_rate=0.01, parameters=[p])
+    for _ in range(3):
+        (p * p).sum().backward()
+        opt.step(); opt.clear_grad()
+    path = str(tmp_path / "opt.pdopt")
+    paddle.save(opt.state_dict(), path)
+    opt2 = paddle.optimizer.Adam(learning_rate=0.01, parameters=[p])
+    opt2.set_state_dict(paddle.load(path))
+    m1 = opt._accumulators["moment1"][id(p)]
+    m2 = opt2._accumulators["moment1"][id(p)]
+    np.testing.assert_allclose(np.asarray(m1), np.asarray(m2), rtol=1e-6)
+
+
+def test_multi_precision_master_weights():
+    p = paddle.Parameter(np.ones((4,), np.float32))
+    p._data = p._data.astype("bfloat16")
+    opt = paddle.optimizer.Adam(learning_rate=0.01, parameters=[p],
+                                multi_precision=True)
+    (p.astype("float32") * 1.0).sum().backward()
+    opt.step()
+    assert id(p) in opt._master_weights
+    import jax.numpy as jnp
+    assert opt._master_weights[id(p)].dtype == jnp.float32
+    assert str(p.dtype) == "bfloat16"
+
+
+# ---------------- LeNet convergence (BASELINE config 1) ----------------
+class LeNet(nn.Layer):
+    """Mirrors the reference LeNet (test/book/test_recognize_digits.py)."""
+
+    def __init__(self):
+        super().__init__()
+        self.features = nn.Sequential(
+            nn.Conv2D(1, 6, 5, padding=2), nn.ReLU(), nn.MaxPool2D(2, 2),
+            nn.Conv2D(6, 16, 5), nn.ReLU(), nn.MaxPool2D(2, 2))
+        self.fc = nn.Sequential(
+            nn.Linear(16 * 5 * 5, 120), nn.ReLU(),
+            nn.Linear(120, 84), nn.ReLU(),
+            nn.Linear(84, 10))
+
+    def forward(self, x):
+        x = self.features(x)
+        x = x.flatten(1)
+        return self.fc(x)
+
+
+def _synthetic_digits(n=512, seed=0):
+    """10 fixed random 28x28 templates + noise — a stand-in for MNIST that a
+    LeNet must fit to >97% train accuracy if conv/pool/softmax/CE/Adam all
+    work end-to-end."""
+    rng = np.random.RandomState(seed)
+    templates = rng.randn(10, 1, 28, 28).astype(np.float32)
+    labels = rng.randint(0, 10, size=n)
+    images = templates[labels] + 0.3 * rng.randn(n, 1, 28, 28).astype(
+        np.float32)
+    return images, labels.astype(np.int64)
+
+
+def test_lenet_converges():
+    paddle.seed(42)
+    images, labels = _synthetic_digits()
+    model = LeNet()
+    opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                parameters=model.parameters())
+    bs = 64
+    for epoch in range(3):
+        for i in range(0, len(images), bs):
+            xb = paddle.to_tensor(images[i:i + bs])
+            yb = paddle.to_tensor(labels[i:i + bs])
+            loss = F.cross_entropy(model(xb), yb)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+    model.eval()
+    logits = model(paddle.to_tensor(images))
+    acc = (logits.numpy().argmax(-1) == labels).mean()
+    assert acc > 0.97, f"LeNet failed to fit synthetic digits: acc={acc}"

@@ -1,0 +1,179 @@
+"""Benchmark harness — prints ONE JSON line for the driver.
+
+Headline metric: MFU of a compiled GPT train step (fwd+bwd+AdamW in one XLA
+program, bf16 autocast) on the single real TPU chip. vs_baseline is measured
+MFU / the 45% north-star target from BASELINE.json (no published reference
+numbers exist in-tree — BASELINE.md).
+
+Also measured: jitted LeNet/MNIST-shape steps/sec (BASELINE config 1 proxy),
+raw bf16 matmul MFU (MXU sanity ceiling), and eager per-op dispatch overhead
+(the dygraph hot path, SURVEY §3.1).
+"""
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.join(os.path.dirname(__file__), ".jax_cache"))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+jax.config.update("jax_compilation_cache_dir",
+                  os.environ["JAX_COMPILATION_CACHE_DIR"])
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.jit import to_static
+from paddle_tpu.models import GPTConfig, GPTForCausalLM, GPTPretrainingCriterion, LeNet
+
+
+def _peak_flops():
+    kind = jax.devices()[0].device_kind.lower()
+    if "v5 lite" in kind or "v5e" in kind:
+        return 197e12  # bf16 peak per v5e chip
+    if "v5p" in kind or "v5" in kind:
+        return 459e12
+    if "v4" in kind:
+        return 275e12
+    if "v6" in kind or "trillium" in kind:
+        return 918e12
+    return 197e12
+
+
+def _timeit(fn, iters, warmup=2):
+    for _ in range(warmup):
+        r = fn()
+    jax.block_until_ready(r if not hasattr(r, "_data") else r._data)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        r = fn()
+    jax.block_until_ready(r if not hasattr(r, "_data") else r._data)
+    return (time.perf_counter() - t0) / iters
+
+
+def bench_matmul(peak):
+    n = 4096
+    a = jnp.asarray(np.random.randn(n, n), jnp.bfloat16)
+    b = jnp.asarray(np.random.randn(n, n), jnp.bfloat16)
+    f = jax.jit(lambda x, y: x @ y)
+    t = _timeit(lambda: f(a, b), 20)
+    flops = 2 * n ** 3
+    return flops / t / peak * 100, t
+
+
+def bench_eager_dispatch():
+    x = paddle.to_tensor(np.random.randn(1024).astype("float32"),
+                         stop_gradient=False)
+    y = paddle.to_tensor(np.random.randn(1024).astype("float32"))
+
+    def op():
+        return (x * y)._data
+
+    t = _timeit(op, 200, warmup=5)
+    return t * 1e6  # µs per taped eager op
+
+
+def bench_lenet(peak):
+    paddle.seed(0)
+    model = LeNet()
+    opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                parameters=model.parameters())
+    bs = 64
+    xb = paddle.to_tensor(np.random.randn(bs, 1, 28, 28).astype("float32"))
+    yb = paddle.to_tensor(np.random.randint(0, 10, bs).astype("int64"))
+
+    def train_step(x, y):
+        loss = F.cross_entropy(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    step = to_static(train_step, capture=(model, opt))
+    t = _timeit(lambda: step(xb, yb), 30)
+    return 1.0 / t, t
+
+
+_FAST = bool(os.environ.get("PADDLE_TPU_BENCH_FAST"))  # plumbing validation
+
+
+def bench_gpt(peak):
+    paddle.seed(0)
+    if _FAST:
+        cfg = GPTConfig(vocab_size=512, hidden_size=128, num_layers=2,
+                        num_heads=4, max_seq_len=128, dropout=0.0)
+    else:
+        cfg = GPTConfig(vocab_size=8192, hidden_size=512, num_layers=8,
+                        num_heads=8, max_seq_len=512, dropout=0.0)
+    model = GPTForCausalLM(cfg)
+    crit = GPTPretrainingCriterion(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters())
+    B, S = (4, 128) if _FAST else (16, 512)
+    V = cfg.vocab_size
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(rng.randint(0, V, (B, S)).astype("int32"))
+    labels = paddle.to_tensor(rng.randint(0, V, (B, S)).astype("int32"))
+
+    def train_step(x, y):
+        with paddle.amp.auto_cast(level="O1", dtype="bfloat16"):
+            loss = crit(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    step = to_static(train_step, capture=(model, opt))
+    t = _timeit(lambda: step(ids, labels), 5 if _FAST else 20)
+
+    n_params = sum(p.size for p in model.parameters())
+    tokens = B * S
+    h, L = cfg.hidden_size, cfg.num_layers
+    flops = 6 * n_params * tokens + 6 * L * B * S * S * h  # causal attn incl.
+    mfu = flops / t / peak * 100
+    return mfu, t, tokens / t, n_params
+
+
+def _log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main():
+    peak = _peak_flops()
+    device = jax.devices()[0].device_kind
+    _log(f"[bench] device={device} peak={peak/1e12:.0f} TFLOP/s")
+    mm_mfu, mm_t = bench_matmul(peak)
+    _log(f"[bench] matmul done: {mm_mfu:.1f}% MFU")
+    eager_us = bench_eager_dispatch()
+    _log(f"[bench] eager dispatch done: {eager_us:.0f} us/op")
+    lenet_sps, lenet_t = bench_lenet(peak)
+    _log(f"[bench] lenet done: {lenet_sps:.1f} steps/s")
+    gpt_mfu, gpt_t, tok_s, n_params = bench_gpt(peak)
+    _log(f"[bench] gpt done: {gpt_mfu:.1f}% MFU")
+    result = {
+        "metric": "gpt_train_step_mfu",
+        "value": round(gpt_mfu, 2),
+        "unit": "%",
+        "vs_baseline": round(gpt_mfu / 45.0, 4),
+        "submetrics": {
+            "device": device,
+            "peak_flops_assumed": peak,
+            "gpt_step_ms": round(gpt_t * 1e3, 2),
+            "gpt_tokens_per_sec": round(tok_s),
+            "gpt_params": int(n_params),
+            "matmul_bf16_mfu_pct": round(mm_mfu, 1),
+            "matmul_4096_ms": round(mm_t * 1e3, 3),
+            "lenet_train_steps_per_sec": round(lenet_sps, 1),
+            "eager_dispatch_us_per_op": round(eager_us, 1),
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -38,7 +38,11 @@ from . import io  # noqa: F401
 from . import jit  # noqa: F401
 from . import nn  # noqa: F401
 from . import optimizer  # noqa: F401
+from . import regularizer  # noqa: F401
+from . import distributed  # noqa: F401
+from . import models  # noqa: F401
 from .framework.io import load, save  # noqa: F401
+from .framework.param_attr import ParamAttr  # noqa: F401
 
 # paddle aliases
 bool = bool8  # noqa: A001

@@ -1,0 +1,105 @@
+"""auto_cast — automatic mixed precision (reference:
+python/paddle/amp/auto_cast.py:273 amp_guard).
+
+TPU-native: bf16 is the native low precision (MXU); the autocast decision is
+made once per eager op inside core.dispatch.apply, mirroring the reference's
+eager_amp_auto_cast.h hook placement. O1 casts white-list ops to the amp dtype
+and black-list ops to f32; O2 casts everything except the black list.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax.numpy as jnp
+
+from ..core.dtype import convert_dtype
+from . import amp_lists
+
+__all__ = ["auto_cast", "amp_guard", "decorate", "amp_state"]
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.dtype = jnp.bfloat16
+        self.level = "O1"
+        self.white = amp_lists.WHITE_LIST
+        self.black = amp_lists.BLACK_LIST
+
+
+_state = _AmpState()
+
+
+def amp_state():
+    return _state
+
+
+_EXEMPT = {"cast", "clone", "getitem", "setitem", "assign"}
+
+
+def amp_dtype_for(op_name: str):
+    """Called by dispatch.apply: returns the target dtype for this op's
+    floating inputs, or None to leave them untouched."""
+    if not _state.enabled or op_name in _EXEMPT:
+        return None
+    if op_name in _state.black:
+        return jnp.float32
+    if _state.level == "O2":
+        return _state.dtype
+    if op_name in _state.white:
+        return _state.dtype
+    return None
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16", use_promote=True):
+    """Reference: paddle.amp.auto_cast (amp/auto_cast.py:273)."""
+    assert level in ("O0", "O1", "O2"), f"bad amp level {level}"
+    prev = (_state.enabled, _state.dtype, _state.level, _state.white,
+            _state.black)
+    _state.enabled = bool(enable) and level != "O0"
+    _state.dtype = convert_dtype(dtype)
+    _state.level = level
+    white = set(amp_lists.WHITE_LIST)
+    black = set(amp_lists.BLACK_LIST)
+    if custom_white_list:
+        white |= set(custom_white_list)
+        black -= set(custom_white_list)
+    if custom_black_list:
+        black |= set(custom_black_list)
+        white -= set(custom_black_list)
+    _state.white = white
+    _state.black = black
+    try:
+        yield
+    finally:
+        (_state.enabled, _state.dtype, _state.level, _state.white,
+         _state.black) = prev
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """O2 decoration (reference: paddle.amp.decorate): cast model params to
+    the amp dtype and turn on optimizer master weights."""
+    assert level in ("O1", "O2")
+    single_model = not isinstance(models, (list, tuple))
+    single_opt = optimizers is not None and not isinstance(
+        optimizers, (list, tuple))
+    model_list = [models] if single_model else list(models)
+    if level == "O2":
+        for m in model_list:
+            m.to(dtype=dtype)
+    if optimizers is not None:
+        opt_list = [optimizers] if single_opt else list(optimizers)
+        for opt in opt_list:
+            if master_weight is not False:
+                opt._multi_precision = True
+        if single_model and single_opt:
+            return models, optimizers
+        return model_list, opt_list
+    return models if single_model else model_list

@@ -29,6 +29,31 @@ def _unwrap(t):
     return t._data if isinstance(t, Tensor) else t
 
 
+_amp_dtype_for = None
+
+
+def _amp_cast(name, inputs):
+    """AMP autocast hook (reference: eager_amp_auto_cast.h placement in the
+    generated ad_func). Casts floating Tensor inputs per the active
+    auto_cast white/black lists."""
+    global _amp_dtype_for
+    if _amp_dtype_for is None:
+        from ..amp.auto_cast import amp_dtype_for as _f
+        _amp_dtype_for = _f
+    from .tensor import Tensor
+    target = _amp_dtype_for(name)
+    if target is None:
+        return inputs
+    out = []
+    for t in inputs:
+        if isinstance(t, Tensor) and is_floating(t.dtype) \
+                and t.dtype != target and t.dtype != jnp.float64:
+            out.append(t.astype(target))
+        else:
+            out.append(t)
+    return out
+
+
 def apply(name: str, fwd: Callable, inputs: Sequence[Any], nout: int = 1,
           has_aux: bool = False):
     """Execute an eager op through the autograd tape.
@@ -42,6 +67,7 @@ def apply(name: str, fwd: Callable, inputs: Sequence[Any], nout: int = 1,
     """
     from .tensor import Tensor
 
+    inputs = _amp_cast(name, inputs)
     arrs = [_unwrap(t) for t in inputs]
     grad_on = autograd.is_grad_enabled()
     diff_idx = [i for i, t in enumerate(inputs) if _is_diff(t)] if grad_on else []
@@ -54,7 +80,7 @@ def apply(name: str, fwd: Callable, inputs: Sequence[Any], nout: int = 1,
             results = [Tensor(p, stop_gradient=True) for p in primals]
             results += [Tensor(a, stop_gradient=True) for a in aux]
             return results[0] if len(results) == 1 else tuple(results)
-        if nout == 1:
+        if nout == 1 and not isinstance(out, tuple):
             return Tensor(out, stop_gradient=True)
         return tuple(Tensor(o, stop_gradient=True) for o in out)
 

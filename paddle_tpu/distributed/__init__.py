@@ -1,0 +1,24 @@
+"""paddle_tpu.distributed — mesh-based distributed training.
+
+Reference namespace: python/paddle/distributed/__init__.py. See SURVEY §2.3:
+collectives over XLA/ICI, 5-axis hybrid topology, DataParallel, TP layers
+(fleet.meta_parallel), sharding, and the DTensor/auto-parallel API.
+"""
+from . import fleet  # noqa: F401
+from .auto_parallel.api import (  # noqa: F401
+    ProcessMesh, Replicate, Shard, Partial, dtensor_from_local, reshard,
+    shard_layer, shard_tensor,
+)
+from .collective import (  # noqa: F401
+    Group, ReduceOp, all_gather, all_reduce, all_to_all, barrier, broadcast,
+    destroy_process_group, get_group, new_group, reduce, reduce_scatter,
+    scatter,
+)
+from .env import (  # noqa: F401
+    get_rank, get_world_size, init_parallel_env, is_initialized, world_mesh,
+)
+from .parallel import DataParallel, shard_batch  # noqa: F401
+from .topology import (  # noqa: F401
+    CommunicateTopology, HybridCommunicateGroup, build_mesh,
+    get_hybrid_communicate_group,
+)

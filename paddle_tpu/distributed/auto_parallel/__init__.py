@@ -1,0 +1,5 @@
+"""Auto-parallel (DTensor) API — reference: python/paddle/distributed/auto_parallel."""
+from .api import (  # noqa: F401
+    Partial, ProcessMesh, Replicate, Shard, dtensor_from_local, get_mesh,
+    reshard, set_mesh, shard_layer, shard_tensor,
+)

@@ -1,0 +1,210 @@
+"""Auto-parallel / DTensor API.
+
+Reference: python/paddle/distributed/auto_parallel/api.py (shard_tensor:117,
+dtensor_from_local:197, reshard:252, shard_layer:351) + placements
+(placement_types.h) + C++ DistTensor (phi/core/distributed/auto_parallel/
+dist_tensor.h:39).
+
+TPU-native: a DistTensor is simply an eager Tensor whose jax.Array carries a
+NamedSharding — GSPMD is the SPMD rule engine (replacing the hand-written
+infermeta/spmd_rules), and reshard is a device_put with a new sharding (the
+reshard function library r_to_s/s_to_r/p_to_r... collapses into XLA resharding
+collectives).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ...core.tensor import Tensor
+
+__all__ = ["ProcessMesh", "Shard", "Replicate", "Partial", "shard_tensor",
+           "dtensor_from_local", "reshard", "shard_layer", "get_mesh",
+           "set_mesh"]
+
+
+class Shard:
+    """Placement: shard tensor dim `dim` along the mesh axis it is paired
+    with (reference: paddle.distributed.Shard)."""
+
+    def __init__(self, dim):
+        self.dim = int(dim)
+
+    def is_shard(self, dim=None):
+        return dim is None or dim == self.dim
+
+    def is_replicated(self):
+        return False
+
+    def is_partial(self):
+        return False
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+    def __eq__(self, other):
+        return isinstance(other, Shard) and other.dim == self.dim
+
+
+class Replicate:
+    def is_shard(self, dim=None):
+        return False
+
+    def is_replicated(self):
+        return True
+
+    def is_partial(self):
+        return False
+
+    def __repr__(self):
+        return "Replicate()"
+
+    def __eq__(self, other):
+        return isinstance(other, Replicate)
+
+
+class Partial:
+    """Pending-reduction placement. jax has no user-visible partial arrays;
+    reshard(Partial → Replicate) performs the reduction eagerly, other
+    combinations raise (reference: Partial placement, reduce on reshard)."""
+
+    def __init__(self, reduce_type="sum"):
+        self.reduce_type = reduce_type
+
+    def is_shard(self, dim=None):
+        return False
+
+    def is_replicated(self):
+        return False
+
+    def is_partial(self):
+        return True
+
+    def __repr__(self):
+        return f"Partial({self.reduce_type})"
+
+
+class ProcessMesh:
+    """Reference: python/paddle/distributed/auto_parallel/process_mesh.py.
+    Wraps a jax.sharding.Mesh built from a process/device id array."""
+
+    def __init__(self, mesh=None, dim_names=None, shape=None, jax_mesh=None):
+        if jax_mesh is not None:
+            self._mesh = jax_mesh
+            self.shape = list(jax_mesh.devices.shape)
+            self.dim_names = list(jax_mesh.axis_names)
+            return
+        arr = np.asarray(mesh)
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(arr.ndim)]
+        devices = np.array(jax.devices())
+        assert arr.size <= devices.size, (
+            f"ProcessMesh wants {arr.size} devices, only {devices.size} "
+            "available")
+        dev_arr = devices[arr.reshape(-1)].reshape(arr.shape)
+        self._mesh = Mesh(dev_arr, axis_names=tuple(dim_names))
+        self.shape = list(arr.shape)
+        self.dim_names = list(dim_names)
+
+    @property
+    def jax_mesh(self) -> Mesh:
+        return self._mesh
+
+    @property
+    def process_ids(self):
+        return list(range(int(np.prod(self.shape))))
+
+    def get_dim_size(self, name):
+        return self.shape[self.dim_names.index(name)]
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self.shape}, dim_names={self.dim_names})"
+
+
+_global_mesh: ProcessMesh | None = None
+
+
+def set_mesh(mesh: ProcessMesh):
+    global _global_mesh
+    _global_mesh = mesh
+
+
+def get_mesh() -> ProcessMesh | None:
+    return _global_mesh
+
+
+def _spec_from_placements(ndim, mesh: ProcessMesh, placements):
+    """[Placement per mesh dim] → PartitionSpec per tensor dim."""
+    dims = [None] * ndim
+    for mesh_dim, placement in enumerate(placements):
+        if isinstance(placement, Shard):
+            d = placement.dim % ndim
+            axis = mesh.dim_names[mesh_dim]
+            if dims[d] is None:
+                dims[d] = axis
+            elif isinstance(dims[d], tuple):
+                dims[d] = dims[d] + (axis,)
+            else:
+                dims[d] = (dims[d], axis)
+        elif isinstance(placement, Partial):
+            raise NotImplementedError(
+                "Partial placement is only valid as a reshard source")
+    return P(*dims)
+
+
+def shard_tensor(data, mesh: ProcessMesh, placements, dtype=None,
+                 stop_gradient=None):
+    """Reference: auto_parallel/api.py:117. Returns a Tensor whose array is
+    committed to the mesh with the requested placements."""
+    t = data if isinstance(data, Tensor) else Tensor(data, dtype=dtype)
+    spec = _spec_from_placements(t._data.ndim, mesh, placements)
+    t._data = jax.device_put(t._data, NamedSharding(mesh.jax_mesh, spec))
+    if stop_gradient is not None:
+        t.stop_gradient = stop_gradient
+    return t
+
+
+def dtensor_from_local(local_tensor, mesh: ProcessMesh, placements):
+    """Reference: auto_parallel/api.py:197 — on a single controller the
+    'local' tensor is the per-device shard; stack along sharded dims is
+    implicit, so this equals shard_tensor of the already-global view."""
+    return shard_tensor(local_tensor, mesh, placements)
+
+
+def reshard(dist_tensor, mesh: ProcessMesh, placements):
+    """Reference: auto_parallel/api.py:252 + the reshard function library
+    (phi/core/distributed/auto_parallel/reshard/) — XLA emits the minimal
+    collective for any src→dst sharding change."""
+    spec = _spec_from_placements(dist_tensor._data.ndim, mesh, placements)
+    out = Tensor(jax.device_put(dist_tensor._data,
+                                NamedSharding(mesh.jax_mesh, spec)),
+                 stop_gradient=dist_tensor.stop_gradient)
+    return out
+
+
+def shard_layer(layer, process_mesh: ProcessMesh, shard_fn=None,
+                input_fn=None, output_fn=None):
+    """Reference: auto_parallel/api.py:351. Applies shard_fn(name, layer,
+    mesh) to every sublayer to place its parameters; defaults to replicated
+    placement."""
+    def default_shard_fn(name, sublayer, mesh):
+        for pname, param in sublayer._parameters.items():
+            if param is None:
+                continue
+            param._data = jax.device_put(
+                param._data,
+                NamedSharding(mesh.jax_mesh,
+                              P(*([None] * param._data.ndim))))
+
+    fn = shard_fn or default_shard_fn
+    for name, sub in layer.named_sublayers(include_self=True):
+        fn(name, sub, process_mesh)
+    if input_fn is not None:
+        layer.register_forward_pre_hook(
+            lambda lyr, inputs: input_fn(inputs, process_mesh))
+    if output_fn is not None:
+        layer.register_forward_post_hook(
+            lambda lyr, inputs, outputs: output_fn(outputs, process_mesh))
+    return layer

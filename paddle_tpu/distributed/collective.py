@@ -1,0 +1,264 @@
+"""Eager collective communication API.
+
+Reference: paddle/fluid/distributed/collective/process_group.h:47 (AllGather/
+AllReduce/AllToAll/Broadcast/Reduce/ReduceScatter/Scatter + Group python
+surface python/paddle/distributed/communication/group.py).
+
+TPU-native semantics: a "per-rank tensor" is the slice of a global array along
+its leading axis, sharded over the group's mesh axis (the local-view stack).
+Each collective is a shard_map-compiled XLA collective riding ICI — the
+eager-issued NCCL calls of the reference become compiled programs (cached per
+shape). Tensors that are not yet sharded are placed onto the group mesh first.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from ..core.tensor import Tensor
+
+__all__ = ["ReduceOp", "Group", "new_group", "get_group", "all_reduce",
+           "all_gather", "reduce", "broadcast", "scatter", "reduce_scatter",
+           "all_to_all", "barrier", "destroy_process_group"]
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class Group:
+    """Reference: communication/group.py Group — here a (mesh, axis) pair."""
+
+    _next_id = [0]
+
+    def __init__(self, mesh: Mesh, axis: str, ranks=None):
+        self.mesh = mesh
+        self.axis = axis
+        self.nranks = mesh.shape[axis]
+        self.ranks = list(ranks) if ranks is not None else \
+            list(range(self.nranks))
+        Group._next_id[0] += 1
+        self.id = Group._next_id[0]
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    def get_group_rank(self, rank):
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+    def __repr__(self):
+        return f"Group(axis={self.axis}, nranks={self.nranks})"
+
+
+_default_group: Group | None = None
+_groups: dict = {}
+
+
+def _get_default_group() -> Group:
+    global _default_group
+    if _default_group is None:
+        from .env import world_mesh
+        _default_group = Group(world_mesh(), "world")
+        _groups[0] = _default_group
+    return _default_group
+
+
+def get_group(gid=0) -> Group:
+    return _groups.get(gid, _get_default_group())
+
+
+def new_group(ranks=None, backend=None, timeout=None) -> Group:
+    """Subset groups become sub-meshes. With a contiguous device subset this
+    builds a dedicated 1-D mesh; full-world default otherwise."""
+    if not ranks:
+        return _get_default_group()
+    devs = np.array(jax.devices())[list(ranks)]
+    mesh = Mesh(devs, axis_names=("sub",))
+    g = Group(mesh, "sub", ranks)
+    _groups[g.id] = g
+    return g
+
+
+def destroy_process_group(group=None):
+    global _default_group
+    if group is None:
+        _default_group = None
+        _groups.clear()
+
+
+def _as_group(group):
+    return group if isinstance(group, Group) else _get_default_group()
+
+
+def _placed(arr, group):
+    """Commit the array onto the group mesh, leading axis sharded."""
+    spec = P(group.axis, *([None] * (arr.ndim - 1)))
+    return jax.device_put(arr, NamedSharding(group.mesh, spec))
+
+
+def _rankdim_op(group, per_shard_fn, arr, out_rank_sharded=True):
+    """shard_map over the leading (rank) axis: per_shard_fn sees the local
+    [1, ...] slice and the mesh axis name."""
+    spec_in = P(group.axis, *([None] * (arr.ndim - 1)))
+    out_spec = spec_in if out_rank_sharded else None
+    fn = shard_map(per_shard_fn, mesh=group.mesh, in_specs=(spec_in,),
+                   out_specs=out_spec if out_spec is not None else P(
+                       *([None] * arr.ndim)), check_vma=False)
+    return fn(arr)
+
+
+def _reduce_fn(op, axis):
+    if op in (ReduceOp.SUM, ReduceOp.AVG, "sum", "avg"):
+        return lambda x: jax.lax.psum(x, axis)
+    if op in (ReduceOp.MAX, "max"):
+        return lambda x: jax.lax.pmax(x, axis)
+    if op in (ReduceOp.MIN, "min"):
+        return lambda x: jax.lax.pmin(x, axis)
+    if op in (ReduceOp.PROD, "prod"):
+        return lambda x: jnp.exp(jax.lax.psum(jnp.log(x), axis))
+    raise ValueError(f"unsupported reduce op {op}")
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    """In-place all-reduce over the rank axis (leading dim).
+    Reference: communication/all_reduce.py."""
+    g = _as_group(group)
+    arr = _placed(tensor._data, g)
+    red = _reduce_fn(op, g.axis)
+
+    def f(x):
+        y = red(x)
+        if op in (ReduceOp.AVG, "avg"):
+            y = y / g.nranks
+        return y
+
+    out = _rankdim_op(g, f, arr)
+    tensor._data = out
+    return tensor
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True):
+    """Gather every rank's slice; fills tensor_list with the N slices
+    (replicated). Reference: communication/all_gather.py."""
+    g = _as_group(group)
+    arr = _placed(tensor._data, g)
+
+    def f(x):
+        return jax.lax.all_gather(x[0], g.axis)  # [N, ...] replicated
+
+    spec_in = P(g.axis, *([None] * (arr.ndim - 1)))
+    gathered = shard_map(f, mesh=g.mesh, in_specs=(spec_in,),
+                         out_specs=P(*([None] * arr.ndim)),
+                         check_vma=False)(arr)
+    if tensor_list is not None:
+        tensor_list.clear()
+        for i in range(g.nranks):
+            tensor_list.append(Tensor(gathered[i], stop_gradient=True))
+    return Tensor(gathered, stop_gradient=True)
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    """Reduce to rank dst; other slices keep their original value
+    (reference ProcessGroup::Reduce semantics leave non-dst undefined — we
+    keep input)."""
+    g = _as_group(group)
+    arr = _placed(tensor._data, g)
+    red = _reduce_fn(op, g.axis)
+
+    def f(x):
+        y = red(x)
+        idx = jax.lax.axis_index(g.axis)
+        return jnp.where(idx == dst, y, x)
+
+    tensor._data = _rankdim_op(g, f, arr)
+    return tensor
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    """Every rank slice becomes the src slice.
+    Reference: communication/broadcast.py."""
+    g = _as_group(group)
+    arr = _placed(tensor._data, g)
+
+    def f(x):
+        full = jax.lax.all_gather(x[0], g.axis)
+        return full[src][None]
+
+    tensor._data = _rankdim_op(g, f, arr)
+    return tensor
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    """Rank i receives tensor_list[i] (from src). With a single controller the
+    list is already global: stack + shard."""
+    g = _as_group(group)
+    stacked = jnp.stack([t._data if isinstance(t, Tensor) else jnp.asarray(t)
+                         for t in tensor_list])
+    tensor._data = _placed(stacked, g)
+    return tensor
+
+
+def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM, group=None,
+                   sync_op=True):
+    """Each rank gets one reduced chunk: input per-rank [N*c, ...] → output
+    per-rank [c, ...]. Reference: communication/reduce_scatter.py."""
+    g = _as_group(group)
+    src = tensor_or_tensor_list
+    if isinstance(src, (list, tuple)):
+        # list form: element i is rank i's full payload [N*c, ...]; stacking
+        # restores the global [N, N*c, ...] rank-leading layout
+        arr = jnp.stack([t._data for t in src])
+    else:
+        arr = src._data
+    # global layout: [N, N*c, ...] — leading rank axis + per-rank payload
+    g_arr = _placed(arr, g)
+
+    def f(x):
+        # x: [1, N*c, ...] local payload; psum_scatter over chunks
+        y = jax.lax.psum_scatter(x[0], g.axis, scatter_dimension=0,
+                                 tiled=True)
+        return y[None]
+
+    out = _rankdim_op(g, f, g_arr)
+    tensor._data = out
+    return tensor
+
+
+def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    """Rank i sends chunk j to rank j. Global view: [N, N, ...] transpose of
+    the two leading axes. Reference: communication/all_to_all.py."""
+    g = _as_group(group)
+    if isinstance(in_tensor_list, (list, tuple)):
+        arr = jnp.stack([t._data for t in in_tensor_list])
+    else:
+        arr = in_tensor_list._data
+    g_arr = _placed(arr, g)
+
+    def f(x):
+        # x: [1, N, ...] — chunk j of dim 1 goes to rank j (tiled keeps shape)
+        return jax.lax.all_to_all(x, g.axis, split_axis=1, concat_axis=1,
+                                  tiled=True)
+
+    out = _rankdim_op(g, f, g_arr)
+    if out_tensor_list is not None:
+        out_tensor_list.clear()
+        for i in range(out.shape[0]):
+            out_tensor_list.append(Tensor(out[i], stop_gradient=True))
+    return Tensor(out, stop_gradient=True)
+
+
+def barrier(group=None):
+    """Device-level barrier: a tiny psum forces a sync point."""
+    g = _as_group(group)
+    arr = _placed(jnp.ones((g.nranks, 1), jnp.float32), g)
+    _rankdim_op(g, lambda x: jax.lax.psum(x, g.axis), arr).block_until_ready()

@@ -1,0 +1,64 @@
+"""Distributed environment bootstrap.
+
+Reference: python/paddle/distributed/parallel.py:943 (init_parallel_env) —
+launcher env vars → TCPStore → NCCL process groups. TPU-native: a
+single-controller jax runtime already knows its devices; multi-host pods
+bootstrap through jax.distributed.initialize (PjRt's coordination service is
+the TCPStore equivalent). The "world" becomes a 1-D device mesh; collectives
+compile onto ICI/DCN.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+_initialized = False
+_world_mesh: Mesh | None = None
+
+
+def init_parallel_env():
+    """Reference: paddle.distributed.init_parallel_env (parallel.py:943)."""
+    global _initialized, _world_mesh
+    if _initialized:
+        return _default_group()
+    # multi-host: the launcher (paddle_tpu.distributed.launch analog) sets
+    # coordinator env vars; jax.distributed wires DCN coordination
+    if os.environ.get("PADDLE_TPU_COORDINATOR"):
+        jax.distributed.initialize(
+            coordinator_address=os.environ["PADDLE_TPU_COORDINATOR"],
+            num_processes=int(os.environ.get("PADDLE_TPU_NUM_PROCESSES", 1)),
+            process_id=int(os.environ.get("PADDLE_TPU_PROCESS_ID", 0)))
+    devices = np.array(jax.devices())
+    _world_mesh = Mesh(devices, axis_names=("world",))
+    _initialized = True
+    return _default_group()
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def world_mesh() -> Mesh:
+    if _world_mesh is None:
+        init_parallel_env()
+    return _world_mesh
+
+
+def get_world_size(group=None) -> int:
+    if group is not None:
+        return group.nranks
+    return jax.device_count()
+
+
+def get_rank(group=None) -> int:
+    """Process index. Single-controller SPMD has one python process per host;
+    per-device 'rank' lives inside compiled programs (lax.axis_index)."""
+    return jax.process_index()
+
+
+def _default_group():
+    from .collective import _get_default_group
+    return _get_default_group()

@@ -1,0 +1,103 @@
+"""fleet — hybrid-parallel facade.
+
+Reference: python/paddle/distributed/fleet/__init__.py (fleet.init:167,
+distributed_model fleet/model.py:32, distributed_optimizer fleet.py:1307,
+DistributedStrategy fleet/base/distributed_strategy.py).
+"""
+from __future__ import annotations
+
+from ..topology import HybridCommunicateGroup, _set_hcg, \
+    get_hybrid_communicate_group
+from .mp_layers import (  # noqa: F401
+    ColumnParallelLinear, ParallelCrossEntropy, RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from .sharding import DygraphShardingOptimizer, group_sharded_parallel  # noqa: F401
+
+__all__ = ["DistributedStrategy", "init", "distributed_model",
+           "distributed_optimizer", "get_hybrid_communicate_group",
+           "HybridParallelOptimizer", "ColumnParallelLinear",
+           "RowParallelLinear", "VocabParallelEmbedding",
+           "ParallelCrossEntropy", "DygraphShardingOptimizer",
+           "group_sharded_parallel"]
+
+
+class DistributedStrategy:
+    """Reference: fleet/base/distributed_strategy.py (proto-backed knobs).
+    Holds the hybrid degrees + common toggles as plain attributes."""
+
+    def __init__(self):
+        self.hybrid_configs = {
+            "dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
+            "sharding_degree": 1, "sep_degree": 1,
+        }
+        self.amp = False
+        self.amp_configs = {}
+        self.recompute = False
+        self.recompute_configs = {}
+        self.sharding = False
+        self.sharding_configs = {}
+        self.pipeline = False
+        self.pipeline_configs = {"accumulate_steps": 1,
+                                 "micro_batch_size": 1}
+        self.gradient_merge = False
+        self.gradient_merge_configs = {}
+        self.find_unused_parameters = False
+
+
+_fleet_initialized = False
+_strategy: DistributedStrategy | None = None
+
+
+def init(role_maker=None, is_collective=True, strategy=None, log_level="INFO"):
+    """Reference: fleet/fleet.py:167 — builds the hybrid topology mesh."""
+    global _fleet_initialized, _strategy
+    from ..env import init_parallel_env
+    init_parallel_env()
+    _strategy = strategy or DistributedStrategy()
+    hcg = HybridCommunicateGroup(strategy=_strategy)
+    _set_hcg(hcg)
+    _fleet_initialized = True
+    return hcg
+
+
+def is_initialized():
+    return _fleet_initialized
+
+
+def distributed_model(model):
+    """Reference: fleet/model.py:32. With mp/pp the parallel layers already
+    carry their shardings; pure-dp wraps in DataParallel."""
+    hcg = get_hybrid_communicate_group()
+    if hcg.get_model_parallel_world_size() == 1 and \
+            hcg.get_pipe_parallel_world_size() == 1:
+        from ..parallel import DataParallel
+        return DataParallel(model, group=hcg.get_data_parallel_group())
+    return model
+
+
+class HybridParallelOptimizer:
+    """Reference: dygraph_optimizer/hybrid_parallel_optimizer.py:254. Grad
+    sync and the cross-group global-norm clip are computed on global arrays
+    here, so the wrapper is a thin passthrough keeping the API."""
+
+    def __init__(self, optimizer, hcg=None, strategy=None):
+        self._inner_opt = optimizer
+
+    def __getattr__(self, name):
+        return getattr(self._inner_opt, name)
+
+    def step(self):
+        self._inner_opt.step()
+
+    def clear_grad(self, *a, **k):
+        self._inner_opt.clear_grad(*a, **k)
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    """Reference: fleet/fleet.py:1307."""
+    hcg = get_hybrid_communicate_group()
+    if _strategy is not None and _strategy.sharding:
+        return DygraphShardingOptimizer(
+            optimizer, group=hcg.get_sharding_parallel_group())
+    return HybridParallelOptimizer(optimizer, hcg, strategy)

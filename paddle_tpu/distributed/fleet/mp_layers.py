@@ -1,0 +1,146 @@
+"""Tensor-parallel (Megatron-style) layers.
+
+Reference: python/paddle/distributed/fleet/layers/mpu/mp_layers.py
+(VocabParallelEmbedding:47, ColumnParallelLinear:333, RowParallelLinear:540,
+ParallelCrossEntropy:741) and mp_ops.py (_c_identity/_c_concat/_c_split/
+_mp_allreduce autograd ops).
+
+TPU-native: the layer owns the FULL logical weight committed with a
+NamedSharding over the 'model' mesh axis; GSPMD partitions every op touching
+it and inserts the identity/all-reduce/all-gather collectives the reference
+writes by hand — including in the backward (the _c_identity-grad-is-allreduce
+trick is exactly GSPMD's partial-sum handling). The same layers therefore
+work eagerly, under jit.to_static, and inside the dryrun multi-chip mesh.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...core.dispatch import apply
+from ...nn import Layer, functional as F
+from ...nn import initializer as I
+from ..topology import get_hybrid_communicate_group
+
+__all__ = ["VocabParallelEmbedding", "ColumnParallelLinear",
+           "RowParallelLinear", "ParallelCrossEntropy"]
+
+
+def _mp_mesh(mp_group):
+    if mp_group is not None:
+        return mp_group.mesh, mp_group.axis
+    hcg = get_hybrid_communicate_group()
+    return hcg.mesh, "model"
+
+
+def _place(t, mesh, spec):
+    t._data = jax.device_put(t._data, NamedSharding(mesh, spec))
+    return t
+
+
+def _constrain(x, mesh, spec):
+    """Sharding constraint as a taped op (works eager and under jit)."""
+    return apply("sharding_constraint",
+                 lambda a: jax.lax.with_sharding_constraint(
+                     a, NamedSharding(mesh, spec)), [x])
+
+
+class VocabParallelEmbedding(Layer):
+    """Reference: mp_layers.py:47 — vocab dim sharded across the mp axis."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 mp_group=None, name=None):
+        super().__init__()
+        self._num_embeddings = num_embeddings
+        self._embedding_dim = embedding_dim
+        mesh, axis = _mp_mesh(mp_group)
+        self._mesh, self._axis = mesh, axis
+        self.weight = self.create_parameter(
+            shape=[num_embeddings, embedding_dim], attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        _place(self.weight, mesh, P(axis, None))
+
+    def forward(self, x):
+        out = F.embedding(x, self.weight)
+        return _constrain(out, self._mesh,
+                          P(*([None] * (x.ndim + 1))))
+
+
+class ColumnParallelLinear(Layer):
+    """Reference: mp_layers.py:333 — weight [in, out] sharded on out."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=None, gather_output=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        mesh, axis = _mp_mesh(mp_group)
+        self._mesh, self._axis = mesh, axis
+        self._gather_output = gather_output
+        self.weight = self.create_parameter(
+            shape=[in_features, out_features], attr=weight_attr)
+        _place(self.weight, mesh, P(None, axis))
+        has_bias = True if has_bias is None else has_bias
+        if has_bias:
+            self.bias = self.create_parameter(
+                shape=[out_features], attr=None, is_bias=True)
+            _place(self.bias, mesh, P(axis))
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        y = F.linear(x, self.weight, self.bias)
+        if self._gather_output:
+            return _constrain(y, self._mesh, P(*([None] * y.ndim)))
+        # keep output sharded on the last dim (feeds RowParallelLinear)
+        return _constrain(y, self._mesh,
+                          P(*([None] * (y.ndim - 1)), self._axis))
+
+
+class RowParallelLinear(Layer):
+    """Reference: mp_layers.py:540 — weight [in, out] sharded on in; the
+    matmul's contraction over the sharded dim yields partial sums that GSPMD
+    all-reduces (the reference's explicit mp_allreduce)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=False, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        mesh, axis = _mp_mesh(mp_group)
+        self._mesh, self._axis = mesh, axis
+        self._input_is_parallel = input_is_parallel
+        self.weight = self.create_parameter(
+            shape=[in_features, out_features], attr=weight_attr)
+        _place(self.weight, mesh, P(axis, None))
+        if has_bias:
+            self.bias = self.create_parameter(
+                shape=[out_features], attr=None, is_bias=True)
+            _place(self.bias, mesh, P(None))
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        if not self._input_is_parallel:
+            x = _constrain(x, self._mesh,
+                           P(*([None] * (x.ndim - 1)), self._axis))
+        y = F.linear(x, self.weight, self.bias)
+        return _constrain(y, self._mesh, P(*([None] * y.ndim)))
+
+
+class ParallelCrossEntropy(Layer):
+    """Reference: mp_layers.py:741 — softmax cross entropy over class-dim-
+    sharded logits; the log-sum-exp reduction over the sharded axis compiles
+    to an all-reduce."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        mesh, axis = _mp_mesh(mp_group)
+        self._mesh, self._axis = mesh, axis
+        self._ignore_index = ignore_index
+
+    def forward(self, input, label):
+        logits = _constrain(input, self._mesh,
+                            P(*([None] * (input.ndim - 1)), self._axis))
+        loss = F.cross_entropy(logits, label, reduction="none",
+                               ignore_index=self._ignore_index)
+        return loss

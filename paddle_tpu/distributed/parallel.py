@@ -1,0 +1,104 @@
+"""DataParallel (reference: python/paddle/distributed/parallel.py:202).
+
+TPU-native DP: the wrapper shards the input batch across the 'data' mesh axis
+and keeps parameters replicated. Every eager op then executes SPMD (GSPMD
+partitions the per-op programs), and the backward pullbacks produce replicated
+parameter gradients with XLA-inserted all-reduces — the reference's
+EagerReducer bucketing (collective/reducer.cc:478) collapses into compiler-
+fused collectives. ``no_sync`` is kept for API parity (grad sync is part of
+the compiled backward, so it is a no-op warning rather than a behavior).
+"""
+from __future__ import annotations
+
+import contextlib
+import warnings
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..core.tensor import Tensor
+from ..nn import Layer
+from .env import init_parallel_env, world_mesh
+
+__all__ = ["DataParallel", "shard_batch"]
+
+
+def _dp_mesh_axis(group=None):
+    if group is not None:
+        return group.mesh, group.axis
+    from .topology import _hcg
+    if _hcg is not None:
+        return _hcg.mesh, "data"
+    return world_mesh(), "world"
+
+
+def shard_batch(tensor, group=None):
+    """Place a batch tensor sharded on the data-parallel axis (dim 0)."""
+    mesh, axis = _dp_mesh_axis(group)
+    arr = tensor._data if isinstance(tensor, Tensor) else jnp.asarray(tensor)
+    spec = P(axis, *([None] * (arr.ndim - 1)))
+    placed = jax.device_put(arr, NamedSharding(mesh, spec))
+    if isinstance(tensor, Tensor):
+        tensor._data = placed
+        return tensor
+    return Tensor(placed)
+
+
+class DataParallel(Layer):
+    """Reference: paddle.DataParallel (distributed/parallel.py:202)."""
+
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        init_parallel_env()
+        self._layers = layers
+        self._group = group
+        mesh, axis = _dp_mesh_axis(group)
+        self._mesh, self._axis = mesh, axis
+        # replicate parameters/buffers across the dp axis (broadcast-at-init,
+        # reference behavior: sync_params_buffers)
+        replicated = NamedSharding(mesh, P(*([None])))
+        for t in list(layers.parameters()) + list(layers.buffers()):
+            if t is not None:
+                t._data = jax.device_put(t._data, NamedSharding(
+                    mesh, P(*([None] * t._data.ndim))))
+
+    def forward(self, *inputs, **kwargs):
+        sharded = [shard_batch(x, self._group) if isinstance(x, Tensor)
+                   else x for x in inputs]
+        return self._layers(*sharded, **kwargs)
+
+    @contextlib.contextmanager
+    def no_sync(self):
+        """Grad sync is fused into the compiled backward on TPU; kept for API
+        parity (reference: DataParallel.no_sync)."""
+        yield
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    # delegate traversal to the wrapped layer
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)
+
+    def sublayers(self, include_self=False):
+        return self._layers.sublayers(include_self)
+
+    def train(self):
+        self._layers.train()
+        return self
+
+    def eval(self):
+        self._layers.eval()
+        return self
+
+    def scale_loss(self, loss):
+        return loss

@@ -1,0 +1,183 @@
+"""Hybrid-parallel topology over a jax device mesh.
+
+Reference: python/paddle/distributed/fleet/base/topology.py:61
+(CommunicateTopology with axes ["data","pipe","sharding","sep","model"] and
+HybridCommunicateGroup:174 creating per-axis comm groups). TPU-native: the
+5-axis rank coordinate system IS a jax.sharding.Mesh; per-axis "groups" are
+(mesh, axis) pairs consumed by collectives, pjit shardings, and the TP/SP
+layers. Axis placement maps the innermost (fastest-varying) axis onto ICI
+neighbours — model parallel innermost, then sep, sharding, pipe, data — the
+layout GSPMD wants for ring collectives.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+from .collective import Group
+
+__all__ = ["CommunicateTopology", "HybridCommunicateGroup", "build_mesh"]
+
+_AXES = ["data", "pipe", "sharding", "sep", "model"]
+
+
+def build_mesh(dp=1, pp=1, sharding=1, sep=1, mp=1, devices=None) -> Mesh:
+    """Build the 5-axis mesh. Total degree must equal the device count
+    (padding axes with 1s)."""
+    devices = np.array(jax.devices() if devices is None else devices)
+    total = dp * pp * sharding * sep * mp
+    assert total == devices.size, (
+        f"product of parallel degrees {total} != device count {devices.size}")
+    arr = devices.reshape(dp, pp, sharding, sep, mp)
+    return Mesh(arr, axis_names=tuple(_AXES))
+
+
+class CommunicateTopology:
+    """Reference: fleet/base/topology.py:61."""
+
+    def __init__(self, hybrid_group_names=None, dims=None):
+        self._parallel_names = hybrid_group_names or list(_AXES)
+        self._dims = dims or [1] * len(self._parallel_names)
+        shape = tuple(self._dims)
+        self._world_size = int(np.prod(shape))
+        self._coords = {}
+        for rank, coord in enumerate(np.ndindex(shape)):
+            self._coords[rank] = coord
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self):
+        return self._world_size
+
+    def get_rank(self, **kwargs):
+        coord = tuple(kwargs[n] for n in self._parallel_names)
+        for rank, c in self._coords.items():
+            if c == coord:
+                return rank
+        raise ValueError(f"no rank at coordinate {kwargs}")
+
+    def get_coord(self, rank):
+        return self._coords[rank]
+
+    def get_axis_list(self, axis_name, index):
+        """Ranks whose coordinate on axis_name equals index."""
+        ax = self._parallel_names.index(axis_name)
+        return sorted(r for r, c in self._coords.items() if c[ax] == index)
+
+    def get_comm_list(self, axis_name):
+        """List of rank-groups along axis_name (reference semantics)."""
+        ax = self._parallel_names.index(axis_name)
+        groups = {}
+        for rank, coord in self._coords.items():
+            key = coord[:ax] + coord[ax + 1:]
+            groups.setdefault(key, []).append(rank)
+        return [sorted(v) for _, v in sorted(groups.items())]
+
+
+class HybridCommunicateGroup:
+    """Reference: fleet/base/topology.py:174. Holds the device mesh and hands
+    out per-axis Groups for dp/pp/sharding/sep/mp."""
+
+    def __init__(self, strategy=None, dp=1, pp=1, sharding=1, sep=1, mp=1):
+        if strategy is not None:
+            cfg = strategy.hybrid_configs
+            dp = cfg.get("dp_degree", 1)
+            pp = cfg.get("pp_degree", 1)
+            sharding = cfg.get("sharding_degree", 1)
+            sep = cfg.get("sep_degree", 1)
+            mp = cfg.get("mp_degree", 1)
+        n = jax.device_count()
+        known = pp * sharding * sep * mp
+        if dp * known != n and n % known == 0:
+            dp = n // known  # reference behavior: dp fills the remainder
+        self._dp_degree, self._pp_degree = dp, pp
+        self._sharding_degree, self._sep_degree, self._mp_degree = \
+            sharding, sep, mp
+        self.mesh = build_mesh(dp, pp, sharding, sep, mp)
+        self.topology = CommunicateTopology(list(_AXES),
+                                            [dp, pp, sharding, sep, mp])
+        self.global_rank = jax.process_index()
+
+    # -- degrees --
+    def get_data_parallel_world_size(self):
+        return self._dp_degree
+
+    def get_model_parallel_world_size(self):
+        return self._mp_degree
+
+    def get_pipe_parallel_world_size(self):
+        return self._pp_degree
+
+    def get_sharding_parallel_world_size(self):
+        return self._sharding_degree
+
+    def get_sep_parallel_world_size(self):
+        return self._sep_degree
+
+    # -- ranks (single-controller: coordinate of process 0's first device) --
+    def get_data_parallel_rank(self):
+        return 0
+
+    def get_model_parallel_rank(self):
+        return 0
+
+    def get_stage_id(self):
+        return 0
+
+    # -- groups --
+    def _group(self, axis):
+        return Group(self.mesh, axis)
+
+    def get_data_parallel_group(self):
+        return self._group("data")
+
+    def get_model_parallel_group(self):
+        return self._group("model")
+
+    def get_pipe_parallel_group(self):
+        return self._group("pipe")
+
+    def get_sharding_parallel_group(self):
+        return self._group("sharding")
+
+    def get_sep_parallel_group(self):
+        return self._group("sep")
+
+    def get_check_parallel_group(self, *a, **k):
+        return self._group("model")
+
+    def get_data_parallel_group_src_rank(self):
+        return 0
+
+    def get_model_parallel_group_src_rank(self):
+        return 0
+
+    def topology_description(self):
+        return (f"HybridCommunicateGroup(dp={self._dp_degree}, "
+                f"pp={self._pp_degree}, sharding={self._sharding_degree}, "
+                f"sep={self._sep_degree}, mp={self._mp_degree})")
+
+    __repr__ = topology_description
+
+
+_hcg: HybridCommunicateGroup | None = None
+
+
+def _set_hcg(hcg):
+    global _hcg
+    _hcg = hcg
+
+
+def get_hybrid_communicate_group() -> HybridCommunicateGroup:
+    global _hcg
+    if _hcg is None:
+        _hcg = HybridCommunicateGroup()
+    return _hcg

@@ -1,0 +1,13 @@
+"""paddle_tpu.io — datasets and data loading.
+
+Reference namespace: python/paddle/io/__init__.py.
+"""
+from .dataloader import DataLoader, default_collate_fn  # noqa: F401
+from .dataset import (  # noqa: F401
+    ChainDataset, ConcatDataset, Dataset, IterableDataset, Subset,
+    TensorDataset, random_split,
+)
+from .sampler import (  # noqa: F401
+    BatchSampler, DistributedBatchSampler, RandomSampler, Sampler,
+    SequenceSampler, WeightedRandomSampler,
+)

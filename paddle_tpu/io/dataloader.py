@@ -1,0 +1,212 @@
+"""DataLoader (reference: python/paddle/io/reader.py:216 DataLoader,
+io/dataloader/dataloader_iter.py multiprocess workers).
+
+TPU-native design: workers are host-side numpy pipelines (multiprocessing),
+batches collate to numpy in the worker and become device Tensors only in the
+main process — keeping jax/XLA out of forked children. Ordered reassembly with
+a bounded prefetch window replaces the reference's C++ BlockingQueue.
+"""
+from __future__ import annotations
+
+import itertools
+import multiprocessing as mp
+import queue as queue_mod
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from .dataset import Dataset, IterableDataset
+from .sampler import BatchSampler
+
+__all__ = ["DataLoader", "default_collate_fn"]
+
+
+def default_collate_fn(batch):
+    """Stack samples into batch arrays (reference:
+    io/dataloader/collate.py default_collate_fn)."""
+    sample = batch[0]
+    if isinstance(sample, Tensor):
+        return Tensor(np.stack([np.asarray(s._data) for s in batch]))
+    if isinstance(sample, np.ndarray):
+        return Tensor(np.stack(batch))
+    if isinstance(sample, (int, float, np.integer, np.floating)):
+        return Tensor(np.asarray(batch))
+    if isinstance(sample, (str, bytes)):
+        return list(batch)
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([s[k] for s in batch]) for k in sample}
+    if isinstance(sample, (list, tuple)):
+        transposed = list(zip(*batch))
+        return type(sample)(default_collate_fn(list(col))
+                            for col in transposed)
+    raise TypeError(f"cannot collate batch of {type(sample)}")
+
+
+def _np_collate(batch):
+    """Worker-side collate: like default_collate_fn but stays numpy."""
+    sample = batch[0]
+    if isinstance(sample, Tensor):
+        return np.stack([np.asarray(s._data) for s in batch])
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch)
+    if isinstance(sample, (int, float, np.integer, np.floating)):
+        return np.asarray(batch)
+    if isinstance(sample, (str, bytes)):
+        return list(batch)
+    if isinstance(sample, dict):
+        return {k: _np_collate([s[k] for s in batch]) for k in sample}
+    if isinstance(sample, (list, tuple)):
+        return type(sample)(_np_collate(list(col)) for col in zip(*batch))
+    return batch
+
+
+def _to_tensors(obj):
+    if isinstance(obj, np.ndarray):
+        return Tensor(obj)
+    if isinstance(obj, dict):
+        return {k: _to_tensors(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_to_tensors(v) for v in obj)
+    return obj
+
+
+def _worker_loop(dataset, index_queue, data_queue, collate_fn,
+                 worker_init_fn=None, worker_id=0):
+    """Reference: io/dataloader/worker.py _worker_loop."""
+    if worker_init_fn is not None:
+        worker_init_fn(worker_id)
+    while True:
+        item = index_queue.get()
+        if item is None:
+            break
+        seq, indices = item
+        try:
+            samples = [dataset[i] for i in indices]
+            batch = collate_fn(samples)
+            data_queue.put((seq, batch, None))
+        except Exception as e:  # propagate worker errors to the main process
+            data_queue.put((seq, None, f"{type(e).__name__}: {e}"))
+
+
+class _MultiprocessIter:
+    def __init__(self, loader):
+        self.loader = loader
+        ctx = mp.get_context("fork")
+        self.index_queue = ctx.Queue()
+        self.data_queue = ctx.Queue()
+        collate = loader._worker_collate
+        self.timeout = loader.timeout or 120
+        self.workers = []
+        for wid in range(loader.num_workers):
+            w = ctx.Process(target=_worker_loop,
+                            args=(loader.dataset, self.index_queue,
+                                  self.data_queue, collate,
+                                  loader.worker_init_fn, wid))
+            w.daemon = True
+            w.start()
+            self.workers.append(w)
+        self.batches = iter(loader.batch_sampler)
+        self.send_seq = 0
+        self.recv_seq = 0
+        self.reorder = {}
+        self.outstanding = 0
+        # prefill the pipeline
+        prefetch = loader.prefetch_factor * loader.num_workers
+        for _ in range(prefetch):
+            self._dispatch()
+
+    def _dispatch(self):
+        try:
+            indices = next(self.batches)
+        except StopIteration:
+            return
+        self.index_queue.put((self.send_seq, indices))
+        self.send_seq += 1
+        self.outstanding += 1
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self.outstanding == 0:
+            self._shutdown()
+            raise StopIteration
+        while self.recv_seq not in self.reorder:
+            seq, batch, err = self.data_queue.get(timeout=self.timeout)
+            self.reorder[seq] = (batch, err)
+        batch, err = self.reorder.pop(self.recv_seq)
+        self.recv_seq += 1
+        self.outstanding -= 1
+        self._dispatch()
+        if err is not None:
+            self._shutdown()
+            raise RuntimeError(f"DataLoader worker failed: {err}")
+        return _to_tensors(batch)
+
+    def _shutdown(self):
+        for _ in self.workers:
+            try:
+                self.index_queue.put(None)
+            except Exception:
+                pass
+        for w in self.workers:
+            w.join(timeout=5)
+            if w.is_alive():
+                w.terminate()
+        self.workers = []
+
+    def __del__(self):
+        self._shutdown()
+
+
+class DataLoader:
+    """Reference: python/paddle/io/reader.py:216."""
+
+    def __init__(self, dataset, feed_list=None, places=None,
+                 return_list=True, batch_sampler=None, batch_size=1,
+                 shuffle=False, drop_last=False, collate_fn=None,
+                 num_workers=0, use_buffer_reader=True, prefetch_factor=2,
+                 use_shared_memory=True, timeout=0, worker_init_fn=None):
+        self.dataset = dataset
+        self.num_workers = int(num_workers)
+        self.prefetch_factor = prefetch_factor
+        self.timeout = timeout
+        self.worker_init_fn = worker_init_fn
+        self._iterable_mode = isinstance(dataset, IterableDataset)
+        self.collate_fn = collate_fn or default_collate_fn
+        self._worker_collate = collate_fn or _np_collate
+        if self._iterable_mode:
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+        else:
+            self.batch_sampler = BatchSampler(dataset=dataset,
+                                              shuffle=shuffle,
+                                              batch_size=batch_size,
+                                              drop_last=drop_last)
+
+    def __len__(self):
+        if self._iterable_mode:
+            raise TypeError("IterableDataset DataLoader has no len()")
+        return len(self.batch_sampler)
+
+    def __iter__(self):
+        if self._iterable_mode:
+            return self._iter_stream()
+        if self.num_workers > 0:
+            return _MultiprocessIter(self)
+        return self._iter_single()
+
+    def _iter_single(self):
+        for indices in self.batch_sampler:
+            yield self.collate_fn([self.dataset[i] for i in indices])
+
+    def _iter_stream(self):
+        it = iter(self.dataset)
+        while True:
+            batch = list(itertools.islice(it, self.batch_size))
+            if not batch or (self.drop_last and len(batch) < self.batch_size):
+                return
+            yield self.collate_fn(batch)

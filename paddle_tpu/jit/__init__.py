@@ -1,0 +1,8 @@
+"""paddle_tpu.jit — trace-to-XLA compilation (replaces dy2static/SOT/PIR/CINN).
+
+Reference namespace: python/paddle/jit/__init__.py.
+"""
+from .api import (  # noqa: F401
+    InputSpec, StaticFunction, ignore_module, not_to_static, to_static,
+)
+from .save_load import TranslatedLayer, load, save  # noqa: F401

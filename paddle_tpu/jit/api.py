@@ -1,0 +1,369 @@
+"""jit.to_static — trace-to-XLA compilation.
+
+Reference: python/paddle/jit/api.py:171 (to_static). The reference lowers
+dygraph python to ProgramDesc/PIR via AST rewriting + SOT bytecode
+interception, then executes with the static executor and optionally CINN.
+TPU-native collapse (SURVEY §7 step 4): eager Tensors transparently hold jax
+tracers, so to_static simply re-runs the python function under jax.jit —
+parameters/buffers are lifted to traced inputs, the op tape records pullbacks
+on tracers, and XLA compiles the whole graph (this one mechanism replaces
+dy2static, SOT, PIR, CINN and the new executor).
+
+Two modes:
+- forward staging (default): the compiled function participates in the eager
+  autograd tape (its pullback is the compiled VJP), so ``loss.backward()``
+  outside still works — matching reference to_static training semantics.
+- whole-step staging (``capture=(model, optimizer)``): the function may call
+  ``backward()`` + ``optimizer.step()`` inside; parameters, buffers and
+  optimizer accumulators become donated inputs/outputs of one XLA program —
+  the max-performance train-step path (no reference analog; CINN whole-graph
+  fusion is the closest).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import random as _random
+from ..core.dispatch import apply
+from ..core.dtype import convert_dtype
+from ..core.tensor import Tensor
+
+__all__ = ["to_static", "not_to_static", "InputSpec", "StaticFunction",
+           "ignore_module"]
+
+
+class InputSpec:
+    """Reference: paddle.static.InputSpec — shape may contain None for
+    dynamic dims (compiled polymorphically via jax.export symbolic shapes
+    where supported; concrete shapes otherwise)."""
+
+    def __init__(self, shape, dtype="float32", name=None, stop_gradient=True):
+        self.shape = list(shape)
+        self.dtype = convert_dtype(dtype) or jnp.float32
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype})"
+
+
+def _tree_flatten(obj, tensors, rebuild_path):
+    """Flatten nested args: collect Tensors, return a skeleton rebuilder key."""
+    if isinstance(obj, Tensor):
+        tensors.append(obj)
+        return ("T", len(tensors) - 1)
+    if isinstance(obj, (list, tuple)):
+        return (type(obj).__name__,
+                tuple(_tree_flatten(o, tensors, rebuild_path) for o in obj))
+    if isinstance(obj, dict):
+        return ("dict", tuple(sorted(
+            (k, _tree_flatten(v, tensors, rebuild_path))
+            for k, v in obj.items())))
+    return ("C", obj)  # static constant (part of cache key)
+
+
+def _tree_rebuild(skel, arrays, wrap):
+    kind = skel[0]
+    if kind == "T":
+        return wrap(arrays[skel[1]])
+    if kind in ("list", "tuple"):
+        seq = [_tree_rebuild(s, arrays, wrap) for s in skel[1]]
+        return seq if kind == "list" else tuple(seq)
+    if kind == "dict":
+        return {k: _tree_rebuild(v, arrays, wrap) for k, v in skel[1]}
+    return skel[1]
+
+
+def _static_key(skel, tensors, extra):
+    shapes = tuple((tuple(t.shape), str(t.dtype)) for t in tensors)
+
+    def hashable(s):
+        kind = s[0]
+        if kind == "C":
+            try:
+                hash(s[1])
+                return s
+            except TypeError:
+                return ("C", repr(s[1]))
+        if kind in ("list", "tuple", "dict"):
+            return (kind, tuple(hashable(x) if not isinstance(x, str) else x
+                                for x in s[1]))
+        return s
+    return (hashable(skel), shapes, extra)
+
+
+class StaticFunction:
+    """Callable wrapper produced by to_static (reference:
+    jit/dy2static/program_translator.py ASTStaticFunction analog)."""
+
+    def __init__(self, function, input_spec=None, capture=None,
+                 build_strategy=None, backend=None, full_graph=True,
+                 donate_state=True):
+        from ..nn import Layer
+        self._raw_fn = function
+        self._input_spec = input_spec
+        self._capture = list(capture) if capture is not None else None
+        self._donate_state = donate_state
+        self._cache = {}
+        self._layer = None
+        if isinstance(function, Layer):
+            self._layer = function
+            self._fn = function.forward
+        else:
+            self._fn = function
+            owner = getattr(function, "__self__", None)
+            if isinstance(owner, Layer):
+                self._layer = owner
+
+    # -- state discovery --
+    def _state(self):
+        """(diff_params, buffers, opt_slots): every mutable tensor/array the
+        traced function can read or write."""
+        from ..nn import Layer
+        from ..optimizer import Optimizer
+        layers, opts = [], []
+        if self._layer is not None:
+            layers.append(self._layer)
+        for item in self._capture or []:
+            if isinstance(item, Layer):
+                layers.append(item)
+            elif isinstance(item, Optimizer):
+                opts.append(item)
+        params, buffers = [], []
+        seen = set()
+        for layer in layers:
+            for p in layer.parameters():
+                if id(p) not in seen:
+                    seen.add(id(p))
+                    params.append(p)
+            for b in layer.buffers():
+                if b is not None and id(b) not in seen:
+                    seen.add(id(b))
+                    buffers.append(b)
+        slots = []
+        for opt in opts:
+            slots.extend(opt._state_slots())
+        return params, buffers, slots, layers, opts
+
+    def __call__(self, *args, **kwargs):
+        if self._capture is not None:
+            return self._call_whole_step(args, kwargs)
+        return self._call_forward(args, kwargs)
+
+    # -- mode 1: compiled forward on the eager tape --
+    def _call_forward(self, args, kwargs):
+        params, buffers, _, layers, _ = self._state()
+        arg_tensors: list = []
+        skel = _tree_flatten((args, tuple(sorted(kwargs.items()))),
+                             arg_tensors, [])
+        training = tuple(layer.training for layer in layers)
+        key_extra = ("fwd", len(params), len(buffers), training)
+        cache_key = _static_key(skel, params + buffers + arg_tensors,
+                                key_extra)
+        entry = self._cache.get(cache_key)
+        if entry is None:
+            entry = self._build_forward(skel, params, buffers, len(arg_tensors))
+            self._cache[cache_key] = entry
+        jitted, n_buf, meta = entry
+        rng_key = _random.next_key()
+
+        ins = params + arg_tensors
+        if n_buf:
+            out = apply("to_static", lambda *arrs: jitted(
+                arrs[:len(params)],
+                [b._data for b in buffers],
+                arrs[len(params):], rng_key), ins, has_aux=True)
+            out = list(out) if isinstance(out, tuple) else [out]
+            # trailing aux outputs are the updated buffer values
+            new_bufs = out[-n_buf:]
+            outputs = out[:-n_buf]
+            for b, nb in zip(buffers, new_bufs):
+                b._data = nb._data
+            return _tree_rebuild(meta["out_skel"], outputs, lambda t: t)
+        out = apply("to_static", lambda *arrs: jitted(
+            arrs[:len(params)], [], arrs[len(params):], rng_key), ins)
+        outputs = list(out) if isinstance(out, tuple) else [out]
+        return _tree_rebuild(meta["out_skel"], outputs, lambda t: t)
+
+    def _build_forward(self, skel, params, buffers, n_args):
+        fn = self._fn
+        meta = {}  # per-cache-entry output skeleton (set during trace)
+
+        def pure(param_arrs, buf_arrs, arg_arrs, rng_key):
+            saved = [(t, t._data) for t in params + buffers]
+            saved_grads = [(t, t._grad) for t in params]
+            try:
+                for t, a in zip(params, param_arrs):
+                    t._data = a
+                for t, a in zip(buffers, buf_arrs):
+                    t._data = a
+                rebuilt_args, kw_items = _tree_rebuild(
+                    skel, list(arg_arrs),
+                    lambda a: Tensor(a, stop_gradient=True))
+                with _random.trace_key_scope(rng_key):
+                    out = fn(*rebuilt_args, **dict(kw_items))
+                out_tensors: list = []
+                meta["out_skel"] = _tree_flatten(out, out_tensors, [])
+                out_arrs = tuple(t._data for t in out_tensors)
+                new_bufs = tuple(b._data for b in buffers)
+            finally:
+                for t, a in saved:
+                    t._data = a
+                for t, g in saved_grads:
+                    t._grad = g
+            if buffers:
+                return out_arrs, list(new_bufs)
+            return out_arrs if len(out_arrs) > 1 else out_arrs[0]
+
+        # NOTE: jax.jit caching keys on shapes; our cache keys on structure.
+        return jax.jit(pure, static_argnums=()), len(buffers), meta
+
+    # -- mode 2: whole train step (fwd+bwd+update) in one XLA program --
+    def _call_whole_step(self, args, kwargs):
+        params, buffers, slots, layers, opts = self._state()
+        if not getattr(self, "_materialized", False):
+            # accumulators are created lazily — materialize each optimizer's
+            # state up front so the whole step stages without an eager warmup
+            for opt in opts:
+                if not opt._state_slots():
+                    opt.materialize()
+            self._materialized = True
+            params, buffers, slots, layers, opts = self._state()
+        arg_tensors: list = []
+        skel = _tree_flatten((args, tuple(sorted(kwargs.items()))),
+                             arg_tensors, [])
+        training = tuple(layer.training for layer in layers)
+        # lr is a traced input (scalar array), so it is NOT part of the key
+        key_extra = ("step", len(params), len(buffers), len(slots), training)
+        cache_key = _static_key(skel, params + buffers + arg_tensors,
+                                key_extra)
+        entry = self._cache.get(cache_key)
+        if entry is None:
+            entry = self._build_whole_step(skel, params, buffers, slots,
+                                           opts, len(arg_tensors))
+            self._cache[cache_key] = entry
+        jitted, meta = entry
+        rng_key = _random.next_key()
+        lrs = jnp.asarray([opt.get_lr() for opt in opts], jnp.float32)
+        state_in = [t._data for t in params] + [b._data for b in buffers] + \
+            [cont[k] for cont, k in slots]
+        out_arrs, new_state = jitted(state_in,
+                                     [t._data for t in arg_tensors],
+                                     rng_key, lrs)
+        if meta.get("unstaged_accumulators"):
+            raise RuntimeError(
+                "optimizer state was created during tracing and cannot be "
+                f"staged: {sorted(meta['unstaged_accumulators'])}. Implement "
+                "_materialize_param on the optimizer (see "
+                "paddle_tpu/optimizer/optimizers.py) so its accumulators "
+                "exist before compilation.")
+        n_p, n_b = len(params), len(buffers)
+        for t, a in zip(params, new_state[:n_p]):
+            t._data = a
+            t._grad = None
+        for b, a in zip(buffers, new_state[n_p:n_p + n_b]):
+            b._data = a
+        for (cont, k), a in zip(slots, new_state[n_p + n_b:]):
+            cont[k] = a
+        return _tree_rebuild(meta["out_skel"], [
+            Tensor(a, stop_gradient=True) for a in out_arrs], lambda t: t)
+
+    def _build_whole_step(self, skel, params, buffers, slots, opts, n_args):
+        fn = self._fn
+        meta = {}  # per-cache-entry output skeleton (set during trace)
+
+        def pure(state_arrs, arg_arrs, rng_key, lrs):
+            n_p, n_b = len(params), len(buffers)
+            saved = [(t, t._data, t._grad) for t in params] + \
+                [(b, b._data, None) for b in buffers]
+            saved_slots = [(cont, k, cont[k]) for cont, k in slots]
+            # snapshot accumulator keys so entries created DURING tracing
+            # (e.g. an optimizer without _materialize_param) can be purged —
+            # they would otherwise leak tracers into eager state
+            acc_keys_before = [
+                (opt, name, frozenset(per))
+                for opt in opts
+                for name, per in opt._accumulators.items()]
+            try:
+                for t, a in zip(params, state_arrs[:n_p]):
+                    t._data = a
+                    t._grad = None
+                for b, a in zip(buffers, state_arrs[n_p:n_p + n_b]):
+                    b._data = a
+                for (cont, k), a in zip(slots, state_arrs[n_p + n_b:]):
+                    cont[k] = a
+                for i, opt in enumerate(opts):
+                    opt._lr_override = lrs[i]
+                rebuilt_args, kw_items = _tree_rebuild(
+                    skel, list(arg_arrs),
+                    lambda a: Tensor(a, stop_gradient=True))
+                with _random.trace_key_scope(rng_key):
+                    out = fn(*rebuilt_args, **dict(kw_items))
+                out_tensors: list = []
+                meta["out_skel"] = _tree_flatten(out, out_tensors, [])
+                out_arrs = tuple(t._data for t in out_tensors)
+                new_state = [t._data for t in params] + \
+                    [b._data for b in buffers] + \
+                    [cont[k] for cont, k in slots]
+            finally:
+                for t, a, g in saved:
+                    t._data = a
+                    t._grad = g
+                for cont, k, v in saved_slots:
+                    cont[k] = v
+                for opt in opts:
+                    opt._lr_override = None
+                    for name, per in list(opt._accumulators.items()):
+                        before = next(
+                            (ks for o, n, ks in acc_keys_before
+                             if o is opt and n == name), frozenset())
+                        for k in list(per):
+                            if k not in before:
+                                del per[k]  # purge tracer created in trace
+                                meta.setdefault("unstaged_accumulators",
+                                                set()).add(
+                                    (type(opt).__name__, name))
+            return out_arrs, new_state
+
+        donate = (0,) if self._donate_state else ()
+        return jax.jit(pure, donate_argnums=donate), meta
+
+    @property
+    def code(self):
+        import inspect
+        try:
+            return inspect.getsource(self._fn)
+        except OSError:
+            return "<source unavailable>"
+
+    def concrete_program(self):  # reference-API stub for introspection
+        return self._cache
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, full_graph=True, capture=None, **kwargs):
+    """Reference: python/paddle/jit/api.py:171 (paddle.jit.to_static).
+
+    ``capture=(model, optimizer, ...)`` enables whole-train-step staging —
+    see module docstring."""
+    def decorate(fn):
+        from ..nn import Layer
+        if isinstance(fn, Layer):
+            static = StaticFunction(fn, input_spec, capture)
+            fn.forward = static
+            return fn
+        return StaticFunction(fn, input_spec, capture)
+
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+def not_to_static(fn):
+    fn._not_to_static = True
+    return fn
+
+
+def ignore_module(modules):
+    return None

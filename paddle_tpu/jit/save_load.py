@@ -1,0 +1,138 @@
+"""jit.save / jit.load — AOT export of compiled models.
+
+Reference: python/paddle/jit/api.py (jit.save) + translated_layer.py
+(jit.load). TPU-native: the traced forward is serialized as a StableHLO
+artifact via jax.export (the deployment story that replaces
+ProgramDesc+inference predictor); parameters ride alongside as a pickled
+state (``.pdiparams``), so the artifact is retrainable-free but reloadable
+anywhere jax runs (including the AOT serving path).
+
+Files written for path prefix P:
+  P.pdmodel    — serialized StableHLO (jax.export artifact)
+  P.pdiparams  — pickled parameter/buffer arrays (framework.io format)
+  P.pdmeta     — pickled structure metadata (output skeleton, input specs)
+"""
+from __future__ import annotations
+
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import export as jax_export
+
+from ..core import random as _random
+from ..core.tensor import Tensor
+from .api import InputSpec, StaticFunction, _tree_flatten, _tree_rebuild
+
+__all__ = ["save", "load", "TranslatedLayer"]
+
+
+def save(layer, path, input_spec=None, **configs):
+    """Reference: paddle.jit.save (jit/api.py)."""
+    from ..nn import Layer
+    if isinstance(layer, Layer):
+        fn = layer.forward
+        static = fn if isinstance(fn, StaticFunction) else \
+            StaticFunction(layer)
+    elif isinstance(layer, StaticFunction):
+        static = layer
+    else:
+        static = StaticFunction(layer)
+    if input_spec is None:
+        raise ValueError(
+            "jit.save requires input_spec=[InputSpec(...)] to define the "
+            "exported signature (reference: jit.save input_spec)")
+    specs = [s if isinstance(s, InputSpec) else InputSpec(
+        list(s.shape), s.dtype) for s in input_spec]
+
+    params, buffers, _, layers, _ = static._state()
+    state_tensors = params + buffers
+    was_training = [lyr.training for lyr in layers]
+    for lyr in layers:
+        lyr.eval()  # export inference graph (dropout off, BN in eval mode)
+    meta = {}
+    try:
+        fn = static._fn
+
+        def pure(state_arrs, arg_arrs):
+            saved = [(t, t._data) for t in state_tensors]
+            try:
+                for t, a in zip(state_tensors, state_arrs):
+                    t._data = a
+                args = [Tensor(a, stop_gradient=True) for a in arg_arrs]
+                with _random.trace_key_scope(jax.random.key(0)):
+                    out = fn(*args)
+                out_tensors: list = []
+                meta["out_skel"] = _tree_flatten(out, out_tensors, [])
+                return tuple(t._data for t in out_tensors)
+            finally:
+                for t, a in saved:
+                    t._data = a
+
+        state_shapes = [jax.ShapeDtypeStruct(tuple(t.shape), t._data.dtype)
+                        for t in state_tensors]
+        arg_shapes = [jax.ShapeDtypeStruct(tuple(int(d) for d in s.shape),
+                                           s.dtype) for s in specs]
+        exported = jax_export.export(jax.jit(pure))(state_shapes, arg_shapes)
+        blob = exported.serialize()
+    finally:
+        for lyr, tr in zip(layers, was_training):
+            lyr.training = tr
+            if tr:
+                lyr.train()
+
+    with open(path + ".pdmodel", "wb") as f:
+        f.write(blob)
+    state_np = [np.asarray(t._data) for t in state_tensors]
+    with open(path + ".pdiparams", "wb") as f:
+        pickle.dump(state_np, f, protocol=4)
+    meta.update({
+        "n_state": len(state_tensors),
+        "input_specs": [(s.shape, str(np.dtype(s.dtype))) for s in specs],
+    })
+    with open(path + ".pdmeta", "wb") as f:
+        pickle.dump(meta, f, protocol=4)
+
+
+class TranslatedLayer:
+    """Runnable deserialized model (reference: jit/translated_layer.py).
+    Behaves like an eval-mode Layer: call it with Tensors, get Tensors."""
+
+    def __init__(self, exported, state, meta):
+        self._exported = exported
+        self._state = state
+        self._meta = meta
+        self.training = False
+
+    def __call__(self, *args):
+        arg_arrs = [a._data if isinstance(a, Tensor) else jnp.asarray(a)
+                    for a in args]
+        out = self._exported.call(self._state, arg_arrs)
+        out = list(out) if isinstance(out, (tuple, list)) else [out]
+        return _tree_rebuild(self._meta["out_skel"],
+                             [Tensor(o, stop_gradient=True) for o in out],
+                             lambda t: t)
+
+    def forward(self, *args):
+        return self(*args)
+
+    def eval(self):
+        return self
+
+    def train(self):
+        raise RuntimeError(
+            "a jit.load-ed TranslatedLayer is an inference artifact; "
+            "re-train from the original Layer + state_dict instead")
+
+
+def load(path, **configs) -> TranslatedLayer:
+    """Reference: paddle.jit.load."""
+    with open(path + ".pdmodel", "rb") as f:
+        exported = jax_export.deserialize(f.read())
+    with open(path + ".pdiparams", "rb") as f:
+        state_np = pickle.load(f)
+    with open(path + ".pdmeta", "rb") as f:
+        meta = pickle.load(f)
+    state = [jnp.asarray(a) for a in state_np]
+    return TranslatedLayer(exported, state, meta)

@@ -1,0 +1,225 @@
+"""GPT — flagship decoder-only transformer with hybrid-parallel shardings.
+
+Reference: test/auto_parallel/get_gpt_model.py + the fleet GPT recipes the
+BASELINE configs 3/4 target (mp×pp×dp×sharding via
+fleet/meta_parallel/*). TPU-native: tensor parallel comes from the
+fleet TP layers (weights sharded over 'model'), sequence parallel from
+sharding constraints on the residual stream over 'sep', data parallel from
+batch sharding over 'data', ZeRO from optimizer-state sharding over
+'sharding' — all composed in one mesh, compiled by GSPMD into a single SPMD
+program per train step.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .. import nn
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+from ..nn import functional as F
+
+__all__ = ["GPTConfig", "GPTModel", "GPTForCausalLM",
+           "GPTPretrainingCriterion", "gpt_tiny", "gpt_small", "gpt_1p3b",
+           "gpt_13b"]
+
+
+class GPTConfig:
+    def __init__(self, vocab_size=50304, hidden_size=768, num_layers=12,
+                 num_heads=12, intermediate_size=None, max_seq_len=1024,
+                 dropout=0.1, layer_norm_epsilon=1e-5, tensor_parallel=False,
+                 sequence_parallel=False, use_rms_norm=False,
+                 tie_word_embeddings=True):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.intermediate_size = intermediate_size or 4 * hidden_size
+        self.max_seq_len = max_seq_len
+        self.dropout = dropout
+        self.layer_norm_epsilon = layer_norm_epsilon
+        self.tensor_parallel = tensor_parallel
+        self.sequence_parallel = sequence_parallel
+        self.use_rms_norm = use_rms_norm
+        self.tie_word_embeddings = tie_word_embeddings
+
+
+def gpt_tiny(**kw):
+    return GPTConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                     num_heads=4, max_seq_len=64, dropout=0.0, **kw)
+
+
+def gpt_small(**kw):
+    return GPTConfig(hidden_size=768, num_layers=12, num_heads=12, **kw)
+
+
+def gpt_1p3b(**kw):
+    return GPTConfig(hidden_size=2048, num_layers=24, num_heads=16, **kw)
+
+
+def gpt_13b(**kw):
+    return GPTConfig(hidden_size=5120, num_layers=40, num_heads=40, **kw)
+
+
+def _sp_constrain(x, sequence_parallel):
+    """Shard the [B, S, H] residual stream: batch over 'data', seq over
+    'sep' (sequence/context parallel; SURVEY §5 long-context)."""
+    if not sequence_parallel:
+        return x
+    from ..distributed.topology import get_hybrid_communicate_group
+    hcg = get_hybrid_communicate_group()
+    return apply("sp_constraint", lambda a: jax.lax.with_sharding_constraint(
+        a, NamedSharding(hcg.mesh, P("data", "sep", None))), [x])
+
+
+class GPTAttention(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.num_heads = config.num_heads
+        self.head_dim = config.hidden_size // config.num_heads
+        self.dropout = config.dropout
+        h = config.hidden_size
+        if config.tensor_parallel:
+            from ..distributed import fleet
+            self.qkv_proj = fleet.ColumnParallelLinear(h, 3 * h,
+                                                       gather_output=False)
+            self.out_proj = fleet.RowParallelLinear(h, h,
+                                                    input_is_parallel=True)
+        else:
+            self.qkv_proj = nn.Linear(h, 3 * h)
+            self.out_proj = nn.Linear(h, h)
+
+    def forward(self, x):
+        b, s, h = x.shape
+        qkv = self.qkv_proj(x)
+        qkv = qkv.reshape([b, s, 3, self.num_heads, self.head_dim])
+        q, k, v = qkv.unbind(2) if hasattr(qkv, "unbind") else (
+            qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2])
+        out = F.scaled_dot_product_attention(
+            q, k, v, is_causal=True, dropout_p=self.dropout,
+            training=self.training)
+        out = out.reshape([b, s, h])
+        return self.out_proj(out)
+
+
+class GPTMLP(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        h, ffn = config.hidden_size, config.intermediate_size
+        if config.tensor_parallel:
+            from ..distributed import fleet
+            self.fc1 = fleet.ColumnParallelLinear(h, ffn,
+                                                  gather_output=False)
+            self.fc2 = fleet.RowParallelLinear(ffn, h,
+                                               input_is_parallel=True)
+        else:
+            self.fc1 = nn.Linear(h, ffn)
+            self.fc2 = nn.Linear(ffn, h)
+        self.dropout = nn.Dropout(config.dropout)
+
+    def forward(self, x):
+        return self.dropout(self.fc2(F.gelu(self.fc1(x), approximate=True)))
+
+
+class GPTBlock(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        norm = nn.RMSNorm if config.use_rms_norm else nn.LayerNorm
+        self.ln_1 = norm(config.hidden_size,
+                         epsilon=config.layer_norm_epsilon)
+        self.attn = GPTAttention(config)
+        self.ln_2 = norm(config.hidden_size,
+                         epsilon=config.layer_norm_epsilon)
+        self.mlp = GPTMLP(config)
+        self.dropout = nn.Dropout(config.dropout)
+        self._sp = config.sequence_parallel
+
+    def forward(self, x):
+        x = _sp_constrain(x, self._sp)
+        x = x + self.dropout(self.attn(self.ln_1(x)))
+        x = x + self.mlp(self.ln_2(x))
+        return x
+
+
+class GPTModel(nn.Layer):
+    """Decoder stack → final norm (reference: get_gpt_model.py GPTModel)."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        if config.tensor_parallel:
+            from ..distributed import fleet
+            self.wte = fleet.VocabParallelEmbedding(config.vocab_size,
+                                                    config.hidden_size)
+        else:
+            self.wte = nn.Embedding(config.vocab_size, config.hidden_size)
+        self.wpe = nn.Embedding(config.max_seq_len, config.hidden_size)
+        self.drop = nn.Dropout(config.dropout)
+        self.h = nn.LayerList([GPTBlock(config)
+                               for _ in range(config.num_layers)])
+        norm = nn.RMSNorm if config.use_rms_norm else nn.LayerNorm
+        self.ln_f = norm(config.hidden_size,
+                         epsilon=config.layer_norm_epsilon)
+
+    def forward(self, input_ids):
+        b, s = input_ids.shape
+        from .. import ops
+        pos = ops.arange(0, s, dtype="int64").unsqueeze(0)
+        x = self.wte(input_ids) + self.wpe(pos)
+        x = self.drop(x)
+        for block in self.h:
+            x = block(x)
+        return self.ln_f(x)
+
+
+class GPTForCausalLM(nn.Layer):
+    """LM head (weight-tied by default, reference parity: GPTForPretraining)."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.gpt = GPTModel(config)
+        self.config = config
+        if not config.tie_word_embeddings:
+            self.lm_head = nn.Linear(config.hidden_size, config.vocab_size,
+                                     bias_attr=False)
+
+    def forward(self, input_ids):
+        hidden = self.gpt(input_ids)
+        if self.config.tie_word_embeddings:
+            w = self.gpt.wte.weight  # [vocab, hidden]
+            logits = apply("lm_head_tied",
+                           lambda hs, wt: jnp.einsum("bsh,vh->bsv", hs, wt),
+                           [hidden, w])
+        else:
+            logits = self.lm_head(hidden)
+        return logits
+
+
+class GPTPretrainingCriterion(nn.Layer):
+    """Masked LM loss (reference: gpt pretraining criterion; uses
+    ParallelCrossEntropy under mp)."""
+
+    def __init__(self, config: GPTConfig = None):
+        super().__init__()
+        self._tp = bool(config and config.tensor_parallel)
+        if self._tp:
+            from ..distributed import fleet
+            self.pce = fleet.ParallelCrossEntropy()
+
+    def forward(self, logits, labels, loss_mask=None):
+        b, s, v = logits.shape
+        flat_logits = logits.reshape([b * s, v])
+        flat_labels = labels.reshape([b * s])
+        if self._tp:
+            losses = self.pce(flat_logits, flat_labels)
+        else:
+            losses = F.cross_entropy(flat_logits, flat_labels,
+                                     reduction="none")
+        if loss_mask is not None:
+            m = loss_mask.reshape([b * s]).astype("float32")
+            return (losses * m).sum() / m.sum()
+        return losses.mean()

@@ -1,0 +1,27 @@
+"""LeNet — BASELINE config 1 model (reference:
+test/book/test_recognize_digits.py conv-pool network; also
+python/paddle/vision/models/lenet.py)."""
+from __future__ import annotations
+
+from .. import nn
+
+__all__ = ["LeNet"]
+
+
+class LeNet(nn.Layer):
+    def __init__(self, num_classes=10):
+        super().__init__()
+        self.features = nn.Sequential(
+            nn.Conv2D(1, 6, 5, padding=2), nn.ReLU(),
+            nn.MaxPool2D(2, 2),
+            nn.Conv2D(6, 16, 5), nn.ReLU(),
+            nn.MaxPool2D(2, 2))
+        self.fc = nn.Sequential(
+            nn.Linear(16 * 5 * 5, 120), nn.ReLU(),
+            nn.Linear(120, 84), nn.ReLU(),
+            nn.Linear(84, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        x = x.flatten(1)
+        return self.fc(x)

@@ -49,9 +49,12 @@ class Optimizer:
         self._master_weights: dict = {}  # pid -> f32 arr
         self._pid_to_param = {id(p): p for p in self._parameter_list}
         self._global_step = 0
+        self._lr_override = None  # set by jit whole-step staging (traced lr)
 
     # ---- learning rate ----
     def get_lr(self):
+        if self._lr_override is not None:
+            return self._lr_override
         if isinstance(self._learning_rate, LRScheduler):
             return self._learning_rate()
         return float(self._learning_rate)
@@ -210,6 +213,22 @@ class Optimizer:
             arr = value._data if isinstance(value, Tensor) else \
                 jnp.asarray(value)
             self._accumulators[acc_name][id(p)] = arr
+
+    # ---- state materialization (skip the eager warmup in jit staging) ----
+    def materialize(self):
+        """Create all accumulators (and master weights) up front so the
+        compiled whole-step program can stage them as inputs without an
+        eager first step."""
+        for group in self._param_groups:
+            for p in group["params"]:
+                if p.stop_gradient:
+                    continue
+                if self._use_master(p):
+                    self._master_of(p)
+                self._materialize_param(p)
+
+    def _materialize_param(self, p):
+        """Subclasses pre-create their accumulators for param p."""
 
     # ---- functionalization hooks for jit.to_static ----
     def _state_slots(self):

@@ -231,3 +231,48 @@ class Lamb(Optimizer):
         trust = jnp.where(w_norm > 0, jnp.where(u_norm > 0, w_norm / u_norm,
                                                 1.0), 1.0)
         return w - lr * trust * update
+
+
+# ---- accumulator pre-materialization (used by jit whole-step staging) ----
+def _mat_momentum(self, p):
+    self._get_accumulator("velocity", p)
+
+
+def _mat_adam(self, p):
+    self._get_accumulator("moment1", p)
+    self._get_accumulator("moment2", p)
+    self._get_accumulator("beta_pow", p, init=jnp.zeros((), jnp.float32))
+
+
+def _mat_adagrad(self, p):
+    self._get_accumulator(
+        "moment", p, init=jnp.full(p._data.shape, self._initial,
+                                   jnp.float32 if self._use_master(p)
+                                   else p._data.dtype))
+
+
+def _mat_rmsprop(self, p):
+    self._get_accumulator("mean_square", p)
+    self._get_accumulator("momentum", p)
+    if self._centered:
+        self._get_accumulator("mean_grad", p)
+
+
+def _mat_adadelta(self, p):
+    self._get_accumulator("avg_squared_grad", p)
+    self._get_accumulator("avg_squared_update", p)
+
+
+def _mat_adamax(self, p):
+    self._get_accumulator("moment", p)
+    self._get_accumulator("inf_norm", p)
+    self._get_accumulator("beta1_pow", p, init=jnp.ones((), jnp.float32))
+
+
+Momentum._materialize_param = _mat_momentum
+Adam._materialize_param = _mat_adam        # AdamW and Lamb share the layout
+Lamb._materialize_param = _mat_adam
+Adagrad._materialize_param = _mat_adagrad
+RMSProp._materialize_param = _mat_rmsprop
+Adadelta._materialize_param = _mat_adadelta
+Adamax._materialize_param = _mat_adamax

@@ -1,0 +1,121 @@
+"""AMP tests (reference precedents: test/amp/test_amp_api.py,
+test_grad_scaler.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def test_auto_cast_o1_white_black():
+    x = paddle.to_tensor(np.random.randn(4, 4).astype("float32"))
+    w = paddle.to_tensor(np.random.randn(4, 4).astype("float32"))
+    with paddle.amp.auto_cast(level="O1", dtype="bfloat16"):
+        y = paddle.matmul(x, w)          # white → bf16
+        assert str(y.dtype) == "bfloat16"
+        s = F.softmax(y)                 # black → f32
+        assert str(s.dtype) == "float32"
+        z = x + x                        # neither → untouched
+        assert str(z.dtype) == "float32"
+    y2 = paddle.matmul(x, w)
+    assert str(y2.dtype) == "float32"   # outside the scope
+
+
+def test_auto_cast_o2_casts_everything_but_black():
+    x = paddle.to_tensor(np.random.randn(4, 4).astype("float32"))
+    with paddle.amp.auto_cast(level="O2", dtype="bfloat16"):
+        z = x + x
+        assert str(z.dtype) == "bfloat16"
+        s = F.softmax(x)
+        assert str(s.dtype) == "float32"
+
+
+def test_auto_cast_custom_lists():
+    x = paddle.to_tensor(np.random.randn(4,).astype("float32"))
+    with paddle.amp.auto_cast(custom_white_list={"add"}, level="O1"):
+        z = x + x
+        assert str(z.dtype) == "bfloat16"
+
+
+def test_amp_training_loss_parity():
+    """bf16 O1 training tracks f32 training loss (reference precedent:
+    test/amp/test_model_cast_to_bf16.py)."""
+    def build():
+        paddle.seed(7)
+        m = nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 1))
+        opt = paddle.optimizer.SGD(learning_rate=0.05,
+                                   parameters=m.parameters())
+        return m, opt
+
+    np.random.seed(7)
+    X = np.random.randn(64, 8).astype("float32")
+    Y = (X[:, :1] * 1.5 - X[:, 1:2]).astype("float32")
+    xt, yt = paddle.to_tensor(X), paddle.to_tensor(Y)
+
+    losses_fp32, losses_amp = [], []
+    m, opt = build()
+    for _ in range(30):
+        loss = F.mse_loss(m(xt), yt)
+        loss.backward(); opt.step(); opt.clear_grad()
+        losses_fp32.append(float(loss.numpy()))
+
+    m, opt = build()
+    for _ in range(30):
+        with paddle.amp.auto_cast(level="O1", dtype="bfloat16"):
+            loss = F.mse_loss(m(xt), yt)
+        loss.backward(); opt.step(); opt.clear_grad()
+        losses_amp.append(float(loss.numpy()))
+
+    assert losses_amp[-1] < losses_fp32[0] * 0.2  # it trains
+    np.testing.assert_allclose(losses_amp[-1], losses_fp32[-1], rtol=0.25)
+
+
+def test_decorate_o2_master_weights():
+    m = nn.Linear(4, 4)
+    opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                parameters=m.parameters())
+    m, opt = paddle.amp.decorate(m, opt, level="O2", dtype="bfloat16")
+    assert str(m.weight.dtype) == "bfloat16"
+    assert opt._multi_precision
+    x = paddle.to_tensor(np.random.randn(2, 4).astype("float32"))
+    with paddle.amp.auto_cast(level="O2", dtype="bfloat16"):
+        out = m(x)
+    out.sum().backward()
+    opt.step()
+    # master weights exist in f32
+    import jax.numpy as jnp
+    assert all(v.dtype == jnp.float32 for v in opt._master_weights.values())
+
+
+def test_grad_scaler_scales_and_unscales():
+    p = paddle.Parameter(np.array([1.0], np.float32))
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[p])
+    scaler = paddle.amp.GradScaler(init_loss_scaling=128.0)
+    loss = (p * 2.0).sum()
+    scaler.scale(loss).backward()
+    np.testing.assert_allclose(np.asarray(p._grad), [256.0])  # scaled grad
+    scaler.step(opt)
+    np.testing.assert_allclose(p.numpy(), [1.0 - 0.1 * 2.0], rtol=1e-6)
+
+
+def test_grad_scaler_skips_on_inf_and_backs_off():
+    p = paddle.Parameter(np.array([1.0], np.float32))
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[p])
+    scaler = paddle.amp.GradScaler(init_loss_scaling=128.0,
+                                   decr_every_n_nan_or_inf=1)
+    loss = (p * np.inf).sum()
+    scaler.scale(loss).backward()
+    scaler.step(opt)
+    np.testing.assert_allclose(p.numpy(), [1.0])  # step skipped
+    assert scaler._scale == 64.0  # backed off
+
+
+def test_grad_scaler_disabled_passthrough():
+    p = paddle.Parameter(np.array([1.0], np.float32))
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[p])
+    scaler = paddle.amp.GradScaler(enable=False)
+    loss = scaler.scale((p * 2.0).sum())
+    loss.backward()
+    scaler.step(opt)
+    np.testing.assert_allclose(p.numpy(), [0.8], rtol=1e-6)

@@ -1,0 +1,157 @@
+"""io.DataLoader tests (reference precedents: test/legacy_test/
+test_multiprocess_dataloader_*.py, test_batch_sampler.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.io import (
+    BatchSampler, ConcatDataset, DataLoader, Dataset, DistributedBatchSampler,
+    IterableDataset, RandomSampler, SequenceSampler, Subset, TensorDataset,
+    random_split,
+)
+
+
+class SquareDataset(Dataset):
+    def __init__(self, n=20):
+        self.n = n
+
+    def __getitem__(self, i):
+        return (np.float32([i]), np.float32([i * i]))
+
+    def __len__(self):
+        return self.n
+
+
+def test_batch_sampler_shapes():
+    bs = BatchSampler(dataset=SquareDataset(10), batch_size=3)
+    batches = list(bs)
+    assert [len(b) for b in batches] == [3, 3, 3, 1]
+    bs = BatchSampler(dataset=SquareDataset(10), batch_size=3, drop_last=True)
+    assert [len(b) for b in list(bs)] == [3, 3, 3]
+    assert len(bs) == 3
+
+
+def test_dataloader_single_process():
+    dl = DataLoader(SquareDataset(10), batch_size=4)
+    batches = list(dl)
+    assert len(batches) == 3
+    x, y = batches[0]
+    assert isinstance(x, paddle.Tensor)
+    assert x.shape == [4, 1]
+    np.testing.assert_allclose(y.numpy().ravel(), [0, 1, 4, 9])
+
+
+def test_dataloader_shuffle_covers_all():
+    paddle.seed(3)
+    dl = DataLoader(SquareDataset(16), batch_size=4, shuffle=True)
+    seen = np.concatenate([x.numpy().ravel() for x, _ in dl])
+    assert sorted(seen.tolist()) == list(range(16))
+
+
+def test_dataloader_multiprocess_matches_single():
+    ds = SquareDataset(17)
+    single = [x.numpy() for x, _ in DataLoader(ds, batch_size=5)]
+    multi = [x.numpy() for x, _ in DataLoader(ds, batch_size=5,
+                                              num_workers=2)]
+    assert len(single) == len(multi)
+    for a, b in zip(single, multi):
+        np.testing.assert_allclose(a, b)  # order preserved across workers
+
+
+def test_dataloader_worker_error_propagates():
+    class Bad(Dataset):
+        def __getitem__(self, i):
+            raise ValueError("boom")
+
+        def __len__(self):
+            return 4
+
+    dl = DataLoader(Bad(), batch_size=2, num_workers=1)
+    with pytest.raises(RuntimeError, match="boom"):
+        list(dl)
+
+
+def test_iterable_dataset():
+    class Stream(IterableDataset):
+        def __iter__(self):
+            for i in range(7):
+                yield np.float32([i])
+
+    dl = DataLoader(Stream(), batch_size=3)
+    shapes = [b.shape for b in dl]
+    assert shapes == [[3, 1], [3, 1], [1, 1]]
+    dl = DataLoader(Stream(), batch_size=3, drop_last=True)
+    assert [b.shape for b in dl] == [[3, 1], [3, 1]]
+
+
+def test_tensor_dataset_and_transforms():
+    xs = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(6, 2))
+    ys = paddle.to_tensor(np.arange(6, dtype=np.int64))
+    ds = TensorDataset([xs, ys])
+    x0, y0 = ds[0]
+    assert x0.shape == [2]
+    dl = DataLoader(ds, batch_size=2)
+    xb, yb = next(iter(dl))
+    assert xb.shape == [2, 2] and yb.shape == [2]
+
+
+def test_concat_subset_split():
+    a, b = SquareDataset(4), SquareDataset(6)
+    cat = ConcatDataset([a, b])
+    assert len(cat) == 10
+    np.testing.assert_allclose(cat[5][0], [1.0])  # second dataset idx 1
+    sub = Subset(a, [2, 3])
+    assert len(sub) == 2
+    parts = random_split(SquareDataset(10), [7, 3])
+    assert [len(p) for p in parts] == [7, 3]
+
+
+def test_distributed_batch_sampler_partition():
+    ds = SquareDataset(12)
+    s0 = DistributedBatchSampler(ds, batch_size=2, num_replicas=4, rank=0)
+    s1 = DistributedBatchSampler(ds, batch_size=2, num_replicas=4, rank=1)
+    idx0 = [i for b in s0 for i in b]
+    idx1 = [i for b in s1 for i in b]
+    assert len(idx0) == len(idx1) == 3
+    assert not set(idx0) & set(idx1)  # disjoint shards
+
+
+def test_dict_collate():
+    class DictDs(Dataset):
+        def __getitem__(self, i):
+            return {"x": np.float32([i]), "y": i}
+
+        def __len__(self):
+            return 4
+
+    batch = next(iter(DataLoader(DictDs(), batch_size=4)))
+    assert batch["x"].shape == [4, 1]
+    assert batch["y"].shape == [4]
+
+
+def test_multiprocess_tensor_dataset_collate():
+    """Regression: worker-side collate must stack Tensor samples exactly like
+    the single-process path."""
+    xs = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(6, 2))
+    ys = paddle.to_tensor(np.arange(6, dtype=np.int64))
+    ds = TensorDataset([xs, ys])
+    single = [(a.numpy(), b.numpy()) for a, b in DataLoader(ds, batch_size=2)]
+    multi = [(a.numpy(), b.numpy())
+             for a, b in DataLoader(ds, batch_size=2, num_workers=2)]
+    for (a1, b1), (a2, b2) in zip(single, multi):
+        np.testing.assert_allclose(a1, a2)
+        np.testing.assert_allclose(b1, b2)
+
+
+def test_worker_init_fn_runs():
+    import multiprocessing as mp
+    flags = mp.get_context("fork").Queue()
+
+    def init_fn(worker_id):
+        flags.put(worker_id)
+
+    dl = DataLoader(SquareDataset(4), batch_size=2, num_workers=2,
+                    worker_init_fn=init_fn)
+    list(dl)
+    seen = {flags.get(timeout=10), flags.get(timeout=10)}
+    assert seen == {0, 1}

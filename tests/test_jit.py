@@ -1,0 +1,185 @@
+"""jit.to_static tests: compiled forward parity, gradient parity, whole-step
+staging parity, buffer (BN) updates under jit, jit.save/load round-trip.
+
+Reference precedents: test/dygraph_to_static/test_mnist.py,
+test_save_inference_model.py.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.jit import InputSpec, to_static
+
+
+def _mlp():
+    paddle.seed(11)
+    return nn.Sequential(nn.Linear(6, 16), nn.ReLU(), nn.Linear(16, 3))
+
+
+def test_to_static_forward_parity():
+    m = _mlp()
+    x = paddle.to_tensor(np.random.randn(4, 6).astype("float32"))
+    eager = m(x).numpy()
+    static_m = to_static(_copy_of(m))
+    out = static_m(x)
+    np.testing.assert_allclose(out.numpy(), eager, rtol=1e-5, atol=1e-6)
+    # second call hits the compile cache
+    np.testing.assert_allclose(static_m(x).numpy(), eager, rtol=1e-5,
+                               atol=1e-6)
+
+
+def _copy_of(m):
+    import copy
+    return copy.deepcopy(m)
+
+
+def test_to_static_backward_parity():
+    m1, m2 = _mlp(), None
+    m2 = _copy_of(m1)
+    x = paddle.to_tensor(np.random.randn(4, 6).astype("float32"))
+    y = paddle.to_tensor(np.random.randn(4, 3).astype("float32"))
+
+    loss1 = F.mse_loss(m1(x), y)
+    loss1.backward()
+
+    to_static(m2)
+    loss2 = F.mse_loss(m2(x), y)
+    loss2.backward()
+
+    np.testing.assert_allclose(loss1.numpy(), loss2.numpy(), rtol=1e-5)
+    for p1, p2 in zip(m1.parameters(), m2.parameters()):
+        np.testing.assert_allclose(p1.grad.numpy(), p2.grad.numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_to_static_function_decorator():
+    @to_static
+    def f(a, b):
+        return paddle.matmul(a, b) + 1.0
+
+    a = paddle.to_tensor(np.random.randn(2, 3).astype("float32"))
+    b = paddle.to_tensor(np.random.randn(3, 2).astype("float32"))
+    np.testing.assert_allclose(f(a, b).numpy(), a.numpy() @ b.numpy() + 1,
+                               rtol=1e-5)
+
+
+def test_to_static_batchnorm_buffer_updates():
+    m = nn.Sequential(nn.Linear(4, 4), nn.BatchNorm1D(4))
+    to_static(m)
+    bn = m[1]
+    before = bn._mean.numpy().copy()
+    x = paddle.to_tensor(np.random.randn(16, 4).astype("float32") + 3)
+    m(x)
+    after = bn._mean.numpy()
+    assert not np.allclose(before, after), "BN running mean must update"
+
+
+def test_to_static_training_flag_recompiles():
+    m = nn.Sequential(nn.Linear(4, 4), nn.Dropout(0.5))
+    to_static(m)
+    x = paddle.to_tensor(np.ones((8, 4), np.float32))
+    m.train()
+    out_train = m(x).numpy()
+    m.eval()
+    out_eval = m(x).numpy()
+    assert (out_train == 0).any()       # dropout active in train
+    assert not (out_eval == 0).any()    # disabled in eval
+
+
+def test_whole_step_staging_matches_eager():
+    paddle.seed(5)
+    np.random.seed(5)
+    X = np.random.randn(32, 6).astype("float32")
+    Y = np.random.randn(32, 3).astype("float32")
+
+    def run(compiled):
+        paddle.seed(9)
+        m = nn.Sequential(nn.Linear(6, 16), nn.Tanh(), nn.Linear(16, 3))
+        opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                    parameters=m.parameters())
+
+        def train_step(xb, yb):
+            loss = F.mse_loss(m(xb), yb)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        step = to_static(train_step, capture=(m, opt)) if compiled \
+            else train_step
+        losses = []
+        for i in range(8):
+            loss = step(paddle.to_tensor(X), paddle.to_tensor(Y))
+            losses.append(float(loss.numpy()))
+        return losses, m
+
+    eager_losses, m1 = run(False)
+    jit_losses, m2 = run(True)
+    np.testing.assert_allclose(eager_losses, jit_losses, rtol=1e-4,
+                               atol=1e-5)
+    for p1, p2 in zip(m1.parameters(), m2.parameters()):
+        np.testing.assert_allclose(p1.numpy(), p2.numpy(), rtol=1e-3,
+                                   atol=1e-4)
+
+
+def test_whole_step_with_lr_scheduler():
+    m = nn.Linear(4, 1)
+    sched = paddle.optimizer.lr.StepDecay(0.1, step_size=2, gamma=0.1)
+    opt = paddle.optimizer.SGD(learning_rate=sched,
+                               parameters=m.parameters())
+
+    def train_step(xb, yb):
+        loss = F.mse_loss(m(xb), yb)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    step = to_static(train_step, capture=(m, opt))
+    x = paddle.to_tensor(np.ones((4, 4), np.float32))
+    y = paddle.to_tensor(np.zeros((4, 1), np.float32))
+    w0 = m.weight.numpy().copy()
+    step(x, y)
+    w1 = m.weight.numpy().copy()
+    sched.step(); sched.step()  # lr drops 0.1 → 0.01
+    step(x, y)
+    w2 = m.weight.numpy()
+    d1 = np.abs(w1 - w0).mean()
+    d2 = np.abs(w2 - w1).mean()
+    assert d2 < d1 * 0.5, "compiled step must see the decayed lr as an input"
+
+
+def test_jit_save_load_roundtrip(tmp_path):
+    m = _mlp()
+    m.eval()
+    x = paddle.to_tensor(np.random.randn(4, 6).astype("float32"))
+    expected = m(x).numpy()
+    prefix = str(tmp_path / "model")
+    paddle.jit.save(m, prefix, input_spec=[InputSpec([4, 6], "float32")])
+    loaded = paddle.jit.load(prefix)
+    out = loaded(x)
+    np.testing.assert_allclose(out.numpy(), expected, rtol=1e-5, atol=1e-6)
+    with pytest.raises(RuntimeError):
+        loaded.train()
+
+
+def test_to_static_output_structure_per_cache_entry():
+    """Regression: output skeleton must live per cache entry, not on the
+    StaticFunction (alternating static args with different out structures)."""
+    @to_static
+    def f(a, return_aux=False):
+        if return_aux:
+            return a * 2, a + 1
+        return a * 2
+
+    x = paddle.to_tensor(np.ones((3,), np.float32))
+    single = f(x, return_aux=False)
+    pair = f(x, return_aux=True)
+    assert isinstance(pair, tuple) and len(pair) == 2
+    again = f(x, return_aux=False)  # cache hit on the first entry
+    assert not isinstance(again, tuple)
+    np.testing.assert_allclose(again.numpy(), [2, 2, 2])
+    pair2 = f(x, return_aux=True)
+    np.testing.assert_allclose(pair2[1].numpy(), [2, 2, 2])

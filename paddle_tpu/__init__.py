@@ -40,7 +40,12 @@ from . import nn  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import regularizer  # noqa: F401
 from . import distributed  # noqa: F401
+from . import distribution  # noqa: F401
+from . import hapi  # noqa: F401
+from . import metric  # noqa: F401
 from . import models  # noqa: F401
+from . import profiler  # noqa: F401
+from .hapi import Model  # noqa: F401
 from .framework.io import load, save  # noqa: F401
 from .framework.param_attr import ParamAttr  # noqa: F401
 
@@ -64,3 +69,6 @@ def in_dynamic_mode():
 
 def is_grad_enabled_():
     return is_grad_enabled()
+
+
+from .framework.flags import get_flags, set_flags  # noqa: F401,E402

@@ -72,30 +72,43 @@ def apply(name: str, fwd: Callable, inputs: Sequence[Any], nout: int = 1,
     grad_on = autograd.is_grad_enabled()
     diff_idx = [i for i, t in enumerate(inputs) if _is_diff(t)] if grad_on else []
 
-    if not diff_idx:
-        out = fwd(*arrs)
+    try:
+        if not diff_idx:
+            out = fwd(*arrs)
+            if has_aux:
+                primal, aux = out
+                primals = primal if isinstance(primal, tuple) else (primal,)
+                results = [Tensor(p, stop_gradient=True) for p in primals]
+                results += [Tensor(a, stop_gradient=True) for a in aux]
+                if _check_nan_inf:
+                    _nan_check(name, results)
+                return results[0] if len(results) == 1 else tuple(results)
+            if nout == 1 and not isinstance(out, tuple):
+                res = Tensor(out, stop_gradient=True)
+                if _check_nan_inf:
+                    _nan_check(name, [res])
+                return res
+            results = tuple(Tensor(o, stop_gradient=True) for o in out)
+            if _check_nan_inf:
+                _nan_check(name, results)
+            return results
+
+        def f(*diff_arrs):
+            merged = list(arrs)
+            for pos, a in zip(diff_idx, diff_arrs):
+                merged[pos] = a
+            return fwd(*merged)
+
+        diff_arrs = tuple(arrs[i] for i in diff_idx)
         if has_aux:
-            primal, aux = out
-            primals = primal if isinstance(primal, tuple) else (primal,)
-            results = [Tensor(p, stop_gradient=True) for p in primals]
-            results += [Tensor(a, stop_gradient=True) for a in aux]
-            return results[0] if len(results) == 1 else tuple(results)
-        if nout == 1 and not isinstance(out, tuple):
-            return Tensor(out, stop_gradient=True)
-        return tuple(Tensor(o, stop_gradient=True) for o in out)
-
-    def f(*diff_arrs):
-        merged = list(arrs)
-        for pos, a in zip(diff_idx, diff_arrs):
-            merged[pos] = a
-        return fwd(*merged)
-
-    diff_arrs = tuple(arrs[i] for i in diff_idx)
-    if has_aux:
-        primal, vjp_fn, aux = jax.vjp(f, *diff_arrs, has_aux=True)
-    else:
-        primal, vjp_fn = jax.vjp(f, *diff_arrs)
-        aux = ()
+            primal, vjp_fn, aux = jax.vjp(f, *diff_arrs, has_aux=True)
+        else:
+            primal, vjp_fn = jax.vjp(f, *diff_arrs)
+            aux = ()
+    except Exception as e:
+        if isinstance(e, _passthrough_errors()):
+            raise
+        raise _enrich_error(name, arrs, e) from e
 
     primals = primal if isinstance(primal, tuple) else (primal,)
     diff_outputs = [Tensor(p, stop_gradient=False) for p in primals]
@@ -104,4 +117,41 @@ def apply(name: str, fwd: Callable, inputs: Sequence[Any], nout: int = 1,
                        fwd=fwd, const_arrs=arrs, diff_idx=diff_idx,
                        has_aux=has_aux)
     results = diff_outputs + [Tensor(a, stop_gradient=True) for a in aux]
+    if _check_nan_inf:
+        _nan_check(name, results)
     return results[0] if len(results) == 1 else tuple(results)
+
+
+_check_nan_inf = False  # toggled by FLAGS_check_nan_inf (framework/flags.py)
+
+
+def _nan_check(name, tensors):
+    """Reference: FLAGS_check_nan_inf hook (eager/nan_inf_utils.h). Skipped
+    under tracing (tracers have no concrete values; use jax debug nans
+    for staged programs)."""
+    for t in tensors:
+        if isinstance(t._data, jax.core.Tracer):
+            return
+        if is_floating(t.dtype) and not bool(jnp.all(jnp.isfinite(t._data))):
+            raise FloatingPointError(
+                f"(NaN/Inf) op '{name}' produced non-finite values "
+                f"(shape {t.shape}, dtype {t.dtype}); set "
+                "FLAGS_check_nan_inf=False to disable this check")
+
+
+def _passthrough_errors():
+    from .enforce import InvalidArgumentError
+    return (InvalidArgumentError, FloatingPointError, KeyboardInterrupt)
+
+
+def _enrich_error(name, arrs, e):
+    """Wrap raw jax/XLA failures with op name + input signatures (the
+    dispatch-level slice of the reference's enforce error stack)."""
+    sigs = ", ".join(
+        f"{tuple(a.shape)}:{a.dtype}" if hasattr(a, "shape") else repr(a)[:40]
+        for a in arrs)
+    cls = type(e) if isinstance(e, (ValueError, TypeError)) else RuntimeError
+    try:
+        return cls(f"(op:{name}) {e}\n  inputs: [{sigs}]")
+    except Exception:
+        return RuntimeError(f"(op:{name}) {e}\n  inputs: [{sigs}]")

@@ -1,0 +1,129 @@
+"""Shape/dtype preflight checks with paddle-style error messages.
+
+Reference: PADDLE_ENFORCE_* (paddle/phi/core/enforce.h) + per-op InferMeta
+(paddle/phi/infermeta/binary.cc etc.). A wrong-shape call raises ONE
+actionable line before jax traces anything; everything else is caught by the
+dispatch-level error enricher in core/dispatch.py.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["InvalidArgumentError", "check_matmul", "check_linear",
+           "check_concat", "check_reshape", "check_conv2d",
+           "check_embedding", "check_cross_entropy"]
+
+
+class InvalidArgumentError(ValueError):
+    """Analog of phi::errors::InvalidArgument."""
+
+
+def _fail(op, msg):
+    raise InvalidArgumentError(f"(InvalidArgument) {op}: {msg}")
+
+
+def check_matmul(x_shape, y_shape, transpose_x=False, transpose_y=False):
+    """Reference: MatmulInferMeta (phi/infermeta/binary.cc)."""
+    if len(x_shape) == 0 or len(y_shape) == 0:
+        _fail("matmul", f"inputs must be at least 1-D, got x={list(x_shape)} "
+              f"y={list(y_shape)}")
+    kx = x_shape[-1] if not transpose_x or len(x_shape) == 1 else x_shape[-2]
+    ky = y_shape[0] if len(y_shape) == 1 else (
+        y_shape[-1] if transpose_y else y_shape[-2])
+    if kx != ky:
+        _fail("matmul",
+              f"inner dimensions must match, got x{list(x_shape)} "
+              f"(K={kx}) @ y{list(y_shape)} (K={ky}); "
+              f"transpose_x={transpose_x}, transpose_y={transpose_y}")
+
+
+def check_linear(x_shape, w_shape, b_shape=None):
+    if x_shape[-1] != w_shape[0]:
+        _fail("linear",
+              f"input's last dim ({x_shape[-1]}) must equal weight's first "
+              f"dim ({w_shape[0]}); weight layout is [in_features, "
+              f"out_features], x{list(x_shape)} w{list(w_shape)}")
+    if b_shape is not None and tuple(b_shape) != (w_shape[1],):
+        _fail("linear", f"bias shape {list(b_shape)} must be "
+              f"[{w_shape[1]}] (out_features)")
+
+
+def check_concat(shapes, axis):
+    if not shapes:
+        _fail("concat", "needs at least one input tensor")
+    rank = len(shapes[0])
+    ax = axis % rank if rank else 0
+    for i, s in enumerate(shapes[1:], 1):
+        if len(s) != rank:
+            _fail("concat", f"all inputs must have the same rank; input 0 "
+                  f"has rank {rank}, input {i} has rank {len(s)}")
+        for d in range(rank):
+            if d == ax:
+                continue
+            if s[d] != shapes[0][d]:
+                _fail("concat",
+                      f"non-concat dim {d} must match: input 0 is "
+                      f"{list(shapes[0])}, input {i} is {list(s)} "
+                      f"(axis={axis})")
+
+
+def check_reshape(shape, new_shape):
+    n = int(np.prod(shape)) if shape else 1
+    unknown = [i for i, d in enumerate(new_shape) if d == -1]
+    if len(unknown) > 1:
+        _fail("reshape", f"only one dim may be -1, got {list(new_shape)}")
+    known = int(np.prod([d for d in new_shape if d != -1])) \
+        if new_shape else 1
+    if unknown:
+        if known == 0 or n % known != 0:
+            _fail("reshape", f"cannot infer -1: {n} elements do not divide "
+                  f"into shape {list(new_shape)}")
+    elif known != n:
+        _fail("reshape", f"cannot reshape {n} elements (shape "
+              f"{list(shape)}) into {list(new_shape)} ({known} elements)")
+
+
+def check_conv2d(x_shape, w_shape, groups=1, data_format="NCHW"):
+    """Reference: ConvInferMeta."""
+    if len(x_shape) != 4:
+        _fail("conv2d", f"input must be 4-D {data_format}, got "
+              f"{list(x_shape)}")
+    if len(w_shape) != 4:
+        _fail("conv2d", f"weight must be 4-D [out_c, in_c/groups, kh, kw], "
+              f"got {list(w_shape)}")
+    c_in = x_shape[1] if data_format[1] == "C" else x_shape[-1]
+    if c_in != w_shape[1] * groups:
+        _fail("conv2d",
+              f"input channels ({c_in}) must equal weight's in_c/groups * "
+              f"groups ({w_shape[1]} * {groups}); x{list(x_shape)} "
+              f"w{list(w_shape)}")
+    if w_shape[0] % groups != 0:
+        _fail("conv2d", f"out_channels ({w_shape[0]}) must be divisible by "
+              f"groups ({groups})")
+
+
+def check_embedding(ids_dtype, w_shape):
+    if len(w_shape) != 2:
+        _fail("embedding", f"weight must be 2-D [num_embeddings, dim], got "
+              f"{list(w_shape)}")
+    if np.dtype(ids_dtype).kind not in "iu":
+        _fail("embedding", f"ids must be an integer tensor, got "
+              f"{ids_dtype}")
+
+
+def check_cross_entropy(logits_shape, label_shape, soft_label, axis):
+    if soft_label:
+        if list(logits_shape) != list(label_shape):
+            _fail("cross_entropy",
+                  f"with soft_label=True, label shape {list(label_shape)} "
+                  f"must equal logits shape {list(logits_shape)}")
+        return
+    rank = len(logits_shape)
+    ax = axis % rank
+    expect = [d for i, d in enumerate(logits_shape) if i != ax]
+    got = list(label_shape)
+    if got not in (expect, list(logits_shape[:ax]) + [1]
+                   + list(logits_shape[ax + 1:])):
+        _fail("cross_entropy",
+              f"hard labels must have shape {expect} (logits "
+              f"{list(logits_shape)} minus class axis {axis}), got {got}")

@@ -4,6 +4,7 @@ Reference namespace: python/paddle/distributed/__init__.py. See SURVEY §2.3:
 collectives over XLA/ICI, 5-axis hybrid topology, DataParallel, TP layers
 (fleet.meta_parallel), sharding, and the DTensor/auto-parallel API.
 """
+from . import checkpoint  # noqa: F401
 from . import fleet  # noqa: F401
 from .auto_parallel.api import (  # noqa: F401
     ProcessMesh, Replicate, Shard, Partial, dtensor_from_local, reshard,

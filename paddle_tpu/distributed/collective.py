@@ -125,7 +125,8 @@ def _reduce_fn(op, axis):
     if op in (ReduceOp.MIN, "min"):
         return lambda x: jax.lax.pmin(x, axis)
     if op in (ReduceOp.PROD, "prod"):
-        return lambda x: jnp.exp(jax.lax.psum(jnp.log(x), axis))
+        # sign-safe product: gather + prod (log trick NaNs on negatives)
+        return lambda x: jnp.prod(jax.lax.all_gather(x, axis), axis=0)
     raise ValueError(f"unsupported reduce op {op}")
 
 
@@ -177,6 +178,8 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
 
     def f(x):
         y = red(x)
+        if op in (ReduceOp.AVG, "avg"):
+            y = y / g.nranks
         idx = jax.lax.axis_index(g.axis)
         return jnp.where(idx == dst, y, x)
 
@@ -222,11 +225,21 @@ def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM, group=None,
         arr = src._data
     # global layout: [N, N*c, ...] — leading rank axis + per-rank payload
     g_arr = _placed(arr, g)
+    is_sum = op in (ReduceOp.SUM, ReduceOp.AVG, "sum", "avg")
 
     def f(x):
-        # x: [1, N*c, ...] local payload; psum_scatter over chunks
-        y = jax.lax.psum_scatter(x[0], g.axis, scatter_dimension=0,
-                                 tiled=True)
+        # x: [1, N*c, ...] local payload
+        if is_sum:
+            y = jax.lax.psum_scatter(x[0], g.axis, scatter_dimension=0,
+                                     tiled=True)
+            if op in (ReduceOp.AVG, "avg"):
+                y = y / g.nranks
+        else:
+            red = _reduce_fn(op, g.axis)
+            full = red(x[0])  # [N*c, ...] reduced, replicated
+            c = full.shape[0] // g.nranks
+            idx = jax.lax.axis_index(g.axis)
+            y = jax.lax.dynamic_slice_in_dim(full, idx * c, c, axis=0)
         return y[None]
 
     out = _rankdim_op(g, f, g_arr)

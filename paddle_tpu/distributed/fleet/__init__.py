@@ -12,6 +12,7 @@ from .mp_layers import (  # noqa: F401
     ColumnParallelLinear, ParallelCrossEntropy, RowParallelLinear,
     VocabParallelEmbedding,
 )
+from .recompute import recompute, recompute_sequential  # noqa: F401
 from .sharding import DygraphShardingOptimizer, group_sharded_parallel  # noqa: F401
 
 __all__ = ["DistributedStrategy", "init", "distributed_model",

@@ -1,0 +1,112 @@
+"""Activation recomputation (gradient checkpointing).
+
+Reference: python/paddle/distributed/fleet/recompute/recompute.py:108
+(RecomputeFunction PyLayer + :404 recompute, with TP RNG-state replay).
+
+TPU-native: the wrapped block is staged as a pure function of
+(params..., activations...) and wrapped in ``jax.checkpoint`` — XLA's
+rematerialization replaces the reference's hand-written save/replay PyLayer,
+and composes with jit.to_static whole-step staging (the compiled program
+recomputes the block in the backward pass, trading FLOPs for HBM — SURVEY §7
+step 7). RNG replay is free: the block's dropout keys are folded from the
+same traced key in forward and rematerialized backward.
+"""
+from __future__ import annotations
+
+import jax
+
+from ...core import random as _random
+from ...core.dispatch import apply
+from ...core.tensor import Tensor
+
+__all__ = ["recompute", "recompute_sequential"]
+
+
+def recompute(function, *args, use_reentrant=True, preserve_rng_state=True,
+              **kwargs):
+    """Reference: paddle.distributed.fleet.recompute (recompute.py:404)."""
+    from ...nn import Layer
+
+    if isinstance(function, Layer):
+        layer = function
+        fn = function.forward
+    else:
+        layer = getattr(function, "__self__", None)
+        layer = layer if isinstance(layer, Layer) else None
+        fn = function
+
+    params = []
+    if layer is not None:
+        params = [p for p in layer.parameters() if p is not None]
+
+    tensor_pos = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
+    tensor_args = [args[i] for i in tensor_pos]
+    rng_key = _random.next_key() if preserve_rng_state else None
+    out_meta = {}
+
+    def pure(*arrs):
+        p_arrs = arrs[:len(params)]
+        a_arrs = arrs[len(params):]
+        saved = [(p, p._data) for p in params]
+        try:
+            for p, a in zip(params, p_arrs):
+                p._data = a
+            call_args = list(args)
+            for pos, a in zip(tensor_pos, a_arrs):
+                call_args[pos] = Tensor(a, stop_gradient=True)
+            if rng_key is not None:
+                with _random.trace_key_scope(rng_key):
+                    out = fn(*call_args, **kwargs)
+            else:
+                out = fn(*call_args, **kwargs)
+            if isinstance(out, (tuple, list)):
+                out_meta["n"] = len(out)
+                return tuple(t._data for t in out)
+            out_meta["n"] = 1
+            return out._data
+        finally:
+            for p, a in saved:
+                p._data = a
+
+    ck = jax.checkpoint(pure)
+    n_out = None
+    result = apply("recompute", ck, params + tensor_args,
+                   nout=out_meta.get("n", 1))
+    return result
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """Reference: recompute.py:542 — checkpoint a Sequential in segments."""
+    segments = ctx.get("segments", 1) if isinstance(ctx, dict) else 1
+    layers = list(functions)
+    seg_size = max(1, len(layers) // segments)
+    out = args[0] if len(args) == 1 else args
+
+    def run_segment(start, end):
+        def seg_fn(x):
+            for lyr in layers[start:end]:
+                x = lyr(x)
+            return x
+        return seg_fn
+
+    i = 0
+    while i < len(layers):
+        end = min(i + seg_size, len(layers))
+        seg = run_segment(i, end)
+        # parameters of the segment's layers must be lifted for remat
+        from ...nn import Layer as _L
+
+        class _Seg(_L):
+            def __init__(self, sub):
+                super().__init__()
+                for j, s in enumerate(sub):
+                    self.add_sublayer(str(j), s)
+
+            def forward(self, x):
+                for s in self._sub_layers.values():
+                    x = s(x)
+                return x
+
+        out = recompute(_Seg(layers[i:end]), out)
+        i = end
+    return out

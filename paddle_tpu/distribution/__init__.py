@@ -1,0 +1,201 @@
+"""paddle_tpu.distribution — probability distributions.
+
+Reference: python/paddle/distribution/ (Distribution base, Normal, Uniform,
+Categorical, Bernoulli, kl_divergence). Sampling draws from the framework RNG
+(core/random.py) so results are deterministic under paddle.seed; log_prob /
+entropy go through the op tape and are differentiable.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import random as _random
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+
+__all__ = ["Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
+           "kl_divergence"]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(np.asarray(x, np.float32))
+
+
+class Distribution:
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def probs(self, value):
+        from .. import ops
+        return ops.exp(self.log_prob(value))
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+
+class Normal(Distribution):
+    """Reference: distribution/normal.py."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return self.scale * self.scale
+
+    def sample(self, shape=(), seed=0):
+        key = _random.next_key()
+        shape = tuple(shape)
+        full = shape + tuple(self.loc.shape)
+
+        def fwd(mu, sigma):
+            eps = jax.random.normal(key, full, jnp.float32)
+            return mu + sigma * eps
+        return apply("normal_sample", fwd, [self.loc, self.scale])
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def fwd(v, mu, sigma):
+            var = sigma * sigma
+            return -((v - mu) ** 2) / (2 * var) - jnp.log(sigma) \
+                - 0.5 * math.log(2 * math.pi)
+        return apply("normal_log_prob", fwd, [_t(value), self.loc,
+                                              self.scale])
+
+    def entropy(self):
+        def fwd(sigma):
+            return 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(sigma)
+        return apply("normal_entropy", fwd, [self.scale])
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _t(low)
+        self.high = _t(high)
+
+    def sample(self, shape=(), seed=0):
+        key = _random.next_key()
+        full = tuple(shape) + tuple(self.low.shape)
+
+        def fwd(lo, hi):
+            u = jax.random.uniform(key, full, jnp.float32)
+            return lo + (hi - lo) * u
+        return apply("uniform_sample", fwd, [self.low, self.high])
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def fwd(v, lo, hi):
+            inside = (v >= lo) & (v < hi)
+            return jnp.where(inside, -jnp.log(hi - lo), -jnp.inf)
+        return apply("uniform_log_prob", fwd, [_t(value), self.low,
+                                               self.high])
+
+    def entropy(self):
+        def fwd(lo, hi):
+            return jnp.log(hi - lo)
+        return apply("uniform_entropy", fwd, [self.low, self.high])
+
+
+class Categorical(Distribution):
+    """Reference: distribution/categorical.py — parameterized by logits."""
+
+    def __init__(self, logits, name=None):
+        self.logits = _t(logits)
+
+    def sample(self, shape=()):
+        key = _random.next_key()
+        shape = tuple(shape)
+
+        def fwd(lg):
+            return jax.random.categorical(key, lg, shape=shape
+                                          + lg.shape[:-1])
+        out = apply("categorical_sample", fwd, [self.logits.detach()])
+        return out
+
+    def log_prob(self, value):
+        def fwd(lg, v):
+            logp = jax.nn.log_softmax(lg, axis=-1)
+            return jnp.take_along_axis(
+                logp, v.astype(jnp.int32)[..., None], axis=-1)[..., 0]
+        return apply("categorical_log_prob", fwd, [self.logits, _t(value)])
+
+    def entropy(self):
+        def fwd(lg):
+            logp = jax.nn.log_softmax(lg, axis=-1)
+            return -(jnp.exp(logp) * logp).sum(-1)
+        return apply("categorical_entropy", fwd, [self.logits])
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs_param = _t(probs)
+
+    def sample(self, shape=()):
+        key = _random.next_key()
+        full = tuple(shape) + tuple(self.probs_param.shape)
+
+        def fwd(p):
+            return jax.random.bernoulli(key, p, full).astype(jnp.float32)
+        return apply("bernoulli_sample", fwd, [self.probs_param.detach()])
+
+    def log_prob(self, value):
+        def fwd(p, v):
+            p = jnp.clip(p, 1e-7, 1 - 1e-7)
+            return v * jnp.log(p) + (1 - v) * jnp.log1p(-p)
+        return apply("bernoulli_log_prob", fwd, [self.probs_param, _t(value)])
+
+    def entropy(self):
+        def fwd(p):
+            p = jnp.clip(p, 1e-7, 1 - 1e-7)
+            return -(p * jnp.log(p) + (1 - p) * jnp.log1p(-p))
+        return apply("bernoulli_entropy", fwd, [self.probs_param])
+
+
+def kl_divergence(p, q):
+    """Reference: distribution/kl.py."""
+    if isinstance(p, Normal) and isinstance(q, Normal):
+        def fwd(mu1, s1, mu2, s2):
+            var1, var2 = s1 * s1, s2 * s2
+            return (jnp.log(s2 / s1) + (var1 + (mu1 - mu2) ** 2)
+                    / (2 * var2) - 0.5)
+        return apply("kl_normal", fwd, [p.loc, p.scale, q.loc, q.scale])
+    if isinstance(p, Categorical) and isinstance(q, Categorical):
+        def fwd(l1, l2):
+            lp = jax.nn.log_softmax(l1, -1)
+            lq = jax.nn.log_softmax(l2, -1)
+            return (jnp.exp(lp) * (lp - lq)).sum(-1)
+        return apply("kl_categorical", fwd, [p.logits, q.logits])
+    if isinstance(p, Bernoulli) and isinstance(q, Bernoulli):
+        def fwd(p1, p2):
+            p1 = jnp.clip(p1, 1e-7, 1 - 1e-7)
+            p2 = jnp.clip(p2, 1e-7, 1 - 1e-7)
+            return p1 * (jnp.log(p1) - jnp.log(p2)) + (1 - p1) * (
+                jnp.log1p(-p1) - jnp.log1p(-p2))
+        return apply("kl_bernoulli", fwd, [p.probs_param, q.probs_param])
+    if isinstance(p, Uniform) and isinstance(q, Uniform):
+        def fwd(lo1, hi1, lo2, hi2):
+            return jnp.log((hi2 - lo2) / (hi1 - lo1))
+        return apply("kl_uniform", fwd, [p.low, p.high, q.low, q.high])
+    raise NotImplementedError(
+        f"kl_divergence({type(p).__name__}, {type(q).__name__}) "
+        "is not registered")

@@ -1,0 +1,107 @@
+"""Runtime flag registry — paddle.set_flags / get_flags + FLAGS_ env layer.
+
+Reference: paddle/phi/core/flags.cc (PHI_DEFINE_EXPORTED_* registry, ~104
+flags) exported to python via paddle.set_flags (SURVEY §5 config/flag
+system). TPU-native: flags map onto jax.config / XLA options where a direct
+equivalent exists; unknown FLAGS_* raise like the reference's enforce.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict
+
+__all__ = ["set_flags", "get_flags", "register_flag"]
+
+
+class _Flag:
+    def __init__(self, name, default, doc="", on_set: Callable | None = None):
+        self.name = name
+        self.value = default
+        self.doc = doc
+        self.on_set = on_set
+
+
+_registry: Dict[str, _Flag] = {}
+
+
+def register_flag(name, default, doc="", on_set=None):
+    _registry[name] = _Flag(name, default, doc, on_set)
+
+
+def _set_default_dtype_flag(v):
+    from ..core.dtype import set_default_dtype
+    set_default_dtype(v)
+
+
+def _set_check_nan_inf(v):
+    from ..core import dispatch
+    dispatch._check_nan_inf = bool(v)
+
+
+# ---- built-in flags (TPU-meaningful subset of the reference's set) ----
+register_flag("FLAGS_check_nan_inf", False,
+              "check every eager op output for NaN/Inf "
+              "(reference: eager/nan_inf_utils.h)", _set_check_nan_inf)
+register_flag("FLAGS_default_dtype", "float32",
+              "default floating dtype for tensor creation",
+              _set_default_dtype_flag)
+register_flag("FLAGS_benchmark", False, "sync after every op when timing")
+register_flag("FLAGS_allocator_strategy", "auto_growth",
+              "kept for API parity; XLA/PjRt owns device memory")
+register_flag("FLAGS_fraction_of_gpu_memory_to_use", 0.92,
+              "kept for API parity; use XLA_PYTHON_CLIENT_MEM_FRACTION")
+register_flag("FLAGS_cudnn_deterministic", False,
+              "XLA on TPU is deterministic by construction")
+register_flag("FLAGS_embedding_deterministic", False,
+              "XLA on TPU is deterministic by construction")
+register_flag("FLAGS_use_autotune", True, "XLA autotuning is always on")
+register_flag("FLAGS_use_flash_attention", True,
+              "route scaled_dot_product_attention through the Pallas "
+              "flash-attention kernel when eligible")
+
+
+def set_flags(flags: dict):
+    """paddle.set_flags (reference: python/paddle/base/framework.py)."""
+    for name, value in flags.items():
+        flag = _registry.get(name)
+        if flag is None:
+            raise ValueError(
+                f"unknown flag {name!r}; known flags: "
+                f"{sorted(_registry)}")
+        flag.value = value
+        if flag.on_set is not None:
+            flag.on_set(value)
+
+
+def get_flags(names):
+    """paddle.get_flags."""
+    if isinstance(names, str):
+        names = [names]
+    out = {}
+    for name in names:
+        flag = _registry.get(name)
+        if flag is None:
+            raise ValueError(f"unknown flag {name!r}")
+        out[name] = flag.value
+    return out
+
+
+def _load_env_flags():
+    """FLAGS_* environment variables override defaults at import (reference:
+    flags parsed at core init, pybind/pybind.cc)."""
+    for name, flag in _registry.items():
+        if name in os.environ:
+            raw = os.environ[name]
+            cur = flag.value
+            if isinstance(cur, bool):
+                value: Any = raw.lower() in ("1", "true", "yes")
+            elif isinstance(cur, int):
+                value = int(raw)
+            elif isinstance(cur, float):
+                value = float(raw)
+            else:
+                value = raw
+            set_flags({name: value})
+
+
+_load_env_flags()

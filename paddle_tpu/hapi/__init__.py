@@ -1,0 +1,8 @@
+"""paddle_tpu.hapi — high-level Model API.
+
+Reference: python/paddle/hapi/model.py:1054 (Model, fit:1756) with the
+dynamic-graph adapter. TPU-native: fit() compiles the whole train step via
+jit.to_static capture, so the Keras-style loop runs at staged-XLA speed.
+"""
+from .model import Model  # noqa: F401
+from . import callbacks  # noqa: F401

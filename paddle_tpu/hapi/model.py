@@ -1,0 +1,210 @@
+"""hapi.Model — Keras-style fit/evaluate/predict.
+
+Reference: python/paddle/hapi/model.py:1054 (Model), fit at :1756, dynamic
+adapter at :821. The train step is staged once via jit.to_static capture and
+reused across the whole fit loop.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..io import DataLoader, Dataset
+from ..jit.api import StaticFunction, to_static
+
+__all__ = ["Model"]
+
+
+def _as_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self._step_fn = None
+
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        """Reference: Model.prepare."""
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = _as_list(metrics)
+
+    # ---- single-batch entry points (reference: train_batch/eval_batch) ----
+    def _build_step(self):
+        net, loss_fn, opt = self.network, self._loss, self._optimizer
+
+        def train_step(x, y):
+            out = net(x)
+            loss = loss_fn(out, y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss, out
+
+        self._step_fn = to_static(train_step, capture=(net, opt))
+        return self._step_fn
+
+    def train_batch(self, inputs, labels=None, update=True):
+        x = inputs[0] if isinstance(inputs, (list, tuple)) else inputs
+        y = labels[0] if isinstance(labels, (list, tuple)) else labels
+        step = self._step_fn or self._build_step()
+        loss, out = step(x, y)
+        metrics = [float(loss.numpy())]
+        for m in self._metrics:
+            self._update_metric(m, out, y)
+        return metrics[0] if len(metrics) == 1 else metrics
+
+    @staticmethod
+    def _update_metric(m, out, y):
+        res = m.compute(out, y)
+        if isinstance(res, tuple):
+            m.update(*res)
+        else:
+            m.update(res)
+
+    def eval_batch(self, inputs, labels=None):
+        x = inputs[0] if isinstance(inputs, (list, tuple)) else inputs
+        y = labels[0] if isinstance(labels, (list, tuple)) else labels
+        out = self.network(x)
+        loss = self._loss(out, y) if self._loss else None
+        for m in self._metrics:
+            self._update_metric(m, out, y)
+        return float(loss.numpy()) if loss is not None else None
+
+    def predict_batch(self, inputs):
+        x = inputs[0] if isinstance(inputs, (list, tuple)) else inputs
+        return self.network(x)
+
+    # ---- loops ----
+    def _loader(self, data, batch_size, shuffle):
+        if isinstance(data, DataLoader):
+            return data
+        if isinstance(data, Dataset):
+            return DataLoader(data, batch_size=batch_size, shuffle=shuffle)
+        raise TypeError(f"expected Dataset or DataLoader, got {type(data)}")
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            num_iters=None):
+        """Reference: Model.fit (hapi/model.py:1756)."""
+        from .callbacks import Callback, ProgBarLogger
+        cbs = _as_list(callbacks)
+        if verbose and not any(isinstance(c, ProgBarLogger) for c in cbs):
+            cbs.append(ProgBarLogger(log_freq, verbose))
+        for c in cbs:
+            c.set_model(self)
+        loader = self._loader(train_data, batch_size, shuffle)
+        history = {"loss": []}
+        for c in cbs:
+            c.on_train_begin()
+        it = 0
+        done = False
+        for epoch in range(epochs):
+            if done:
+                break
+            self.network.train()
+            for c in cbs:
+                c.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            epoch_losses = []
+            for step, batch in enumerate(loader):
+                if num_iters is not None and it >= num_iters:
+                    done = True
+                    break
+                x, y = batch[0], batch[1]
+                loss = self.train_batch(x, y)
+                epoch_losses.append(loss)
+                logs = {"loss": loss}
+                for m in self._metrics:
+                    logs[m.name()] = m.accumulate()
+                for c in cbs:
+                    c.on_train_batch_end(step, logs)
+                it += 1
+            if not epoch_losses:
+                break
+            logs = {"loss": float(np.mean(epoch_losses))}
+            for m in self._metrics:
+                logs[m.name()] = m.accumulate()
+            history["loss"].append(logs["loss"])
+            if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self.evaluate(eval_data, batch_size=batch_size,
+                                          verbose=0)
+                logs.update({f"eval_{k}": v for k, v in eval_logs.items()})
+                for c in cbs:
+                    c.on_eval_end(logs)
+            for c in cbs:
+                c.on_epoch_end(epoch, logs)
+            if save_dir and (epoch + 1) % save_freq == 0:
+                self.save(f"{save_dir}/{epoch}")
+            if any(getattr(c, "stop_training", False) for c in cbs):
+                break
+        for c in cbs:
+            c.on_train_end()
+        return history
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_samples=None):
+        self.network.eval()
+        loader = self._loader(eval_data, batch_size, False)
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for batch in loader:
+            loss = self.eval_batch(batch[0], batch[1])
+            if loss is not None:
+                losses.append(loss)
+        logs = {}
+        if losses:
+            logs["loss"] = float(np.mean(losses))
+        for m in self._metrics:
+            logs[m.name()] = m.accumulate()
+        self.network.train()
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, verbose=1, callbacks=None):
+        self.network.eval()
+        loader = self._loader(test_data, batch_size, False)
+        outs = []
+        for batch in loader:
+            x = batch[0] if isinstance(batch, (list, tuple)) else batch
+            outs.append(self.predict_batch(x).numpy())
+        self.network.train()
+        if stack_outputs:
+            return [np.concatenate(outs, axis=0)]
+        return outs
+
+    # ---- persistence ----
+    def save(self, path, training=True):
+        from .. import save as _save
+        _save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            _save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from .. import load as _load
+        self.network.set_state_dict(_load(path + ".pdparams"))
+        import os
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(path + ".pdopt"):
+            self._optimizer.set_state_dict(_load(path + ".pdopt"))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        n_params = sum(p.size for p in self.network.parameters())
+        lines = [repr(self.network),
+                 f"Total params: {n_params:,}"]
+        out = "\n".join(lines)
+        print(out)
+        return {"total_params": n_params}

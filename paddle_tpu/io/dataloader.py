@@ -95,7 +95,8 @@ class _MultiprocessIter:
         self.index_queue = ctx.Queue()
         self.data_queue = ctx.Queue()
         collate = loader._worker_collate
-        self.timeout = loader.timeout or 120
+        # paddle semantics: timeout=0 waits indefinitely
+        self.timeout = loader.timeout if loader.timeout else None
         self.workers = []
         for wid in range(loader.num_workers):
             w = ctx.Process(target=_worker_loop,
@@ -132,7 +133,14 @@ class _MultiprocessIter:
             self._shutdown()
             raise StopIteration
         while self.recv_seq not in self.reorder:
-            seq, batch, err = self.data_queue.get(timeout=self.timeout)
+            try:
+                seq, batch, err = self.data_queue.get(timeout=self.timeout)
+            except queue_mod.Empty:
+                self._shutdown()
+                raise RuntimeError(
+                    f"DataLoader worker timed out after {self.timeout}s "
+                    "(set DataLoader(timeout=...) to wait longer, or 0 to "
+                    "wait forever)") from None
             self.reorder[seq] = (batch, err)
         batch, err = self.reorder.pop(self.recv_seq)
         self.recv_seq += 1

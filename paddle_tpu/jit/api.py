@@ -130,6 +130,15 @@ class StaticFunction:
                 layers.append(item)
             elif isinstance(item, Optimizer):
                 opts.append(item)
+            elif isinstance(getattr(item, "_inner", None), Optimizer):
+                opts.append(item._inner)  # sharding/hybrid wrappers
+            elif isinstance(getattr(item, "_inner_opt", None), Optimizer):
+                opts.append(item._inner_opt)
+            else:
+                raise TypeError(
+                    f"capture item {type(item).__name__} is neither a Layer "
+                    "nor an Optimizer (or optimizer wrapper); its state "
+                    "cannot be staged")
         params, buffers = [], []
         seen = set()
         for layer in layers:

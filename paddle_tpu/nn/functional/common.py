@@ -24,6 +24,10 @@ __all__ = [
 
 def linear(x, weight, bias=None, name=None):
     """x @ W + b with W: [in, out] (reference: F.linear, weight NOT transposed)."""
+    from ...core.enforce import check_linear
+    check_linear(x.shape, weight.shape,
+                 bias.shape if bias is not None else None)
+
     def fwd(a, w, *b):
         out = jnp.matmul(a, w)
         if b:
@@ -116,6 +120,9 @@ def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):  # n
 def embedding(x, weight, padding_idx=None, sparse=False, name=None):
     """Reference: python/paddle/nn/functional/input.py (embedding).
     Gather rows of weight; padding_idx rows get zero gradient."""
+    from ...core.enforce import check_embedding
+    check_embedding(x.dtype, weight.shape)
+
     def fwd(ids, w):
         out = jnp.take(w, ids.astype(jnp.int32), axis=0)
         if padding_idx is not None:
@@ -192,14 +199,41 @@ def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
     return apply("label_smooth", fwd, ins)
 
 
+def _flash_eligible(query, key, attn_mask, dropout_p, training, is_causal):
+    """Use the Pallas flash-attention kernel when the configuration maps onto
+    it: TPU device, no explicit mask, no dropout, head_dim ≤ 128 and (causal
+    or block-divisible keys)."""
+    from ...framework.flags import get_flags
+    if not get_flags("FLAGS_use_flash_attention")["FLAGS_use_flash_attention"]:
+        return False
+    if attn_mask is not None or (dropout_p > 0 and training):
+        return False
+    if query.shape[-1] > 128 or query.ndim != 4:
+        return False
+    import jax as _jax
+
+    from ...core.device import _platform_of
+    if _platform_of(_jax.devices()[0]) != "tpu":
+        return False
+    sk = key.shape[1]
+    if not is_causal and sk % min(128, max(sk, 8)) != 0:
+        return False
+    return True
+
+
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  dropout_p=0.0, is_causal=False,
                                  training=True, name=None):
     """Reference: paddle.nn.functional.scaled_dot_product_attention
     (flash_attn kernel, phi/kernels/gpu/flash_attn_kernel.cu). Layout
-    [batch, seq, heads, head_dim]. XLA fuses this chain on TPU; a Pallas
-    flash-attention kernel backs the long-context path (see
-    paddle_tpu.incubate.flash_attention)."""
+    [batch, seq, heads, head_dim]. The Pallas flash-attention kernel
+    (ops/pallas/flash_attention.py) backs the eligible cases; the XLA
+    fused chain is the fallback."""
+    if _flash_eligible(query, key, attn_mask, dropout_p, training, is_causal):
+        from ...ops.pallas.flash_attention import flash_attention_bshd
+        return apply("flash_attention",
+                     lambda q, k, v: flash_attention_bshd(
+                         q, k, v, causal=is_causal), [query, key, value])
     dk = _random.next_key() if (dropout_p > 0 and training) else None
 
     def fwd(q, k, v, *m):

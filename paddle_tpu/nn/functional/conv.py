@@ -92,6 +92,8 @@ def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
 def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
            data_format="NCHW", name=None):
     """Reference: python/paddle/nn/functional/conv.py (conv2d)."""
+    from ...core.enforce import check_conv2d
+    check_conv2d(x.shape, weight.shape, groups, data_format)
     return _conv(x, weight, bias, stride, padding, dilation, groups,
                  data_format, 2, name)
 

@@ -30,6 +30,8 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,
                   reduction="mean", soft_label=False, axis=-1,
                   use_softmax=True, label_smoothing=0.0, name=None):
     """Reference: python/paddle/nn/functional/loss.py (cross_entropy)."""
+    from ...core.enforce import check_cross_entropy
+    check_cross_entropy(input.shape, label.shape, soft_label, axis)
     n_classes = input.shape[axis]
 
     def fwd(logits, lab, *w):

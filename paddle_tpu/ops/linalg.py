@@ -12,6 +12,10 @@ from ..core.tensor import Tensor
 
 def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
     """Reference: python/paddle/tensor/linalg.py:151 → _C_ops.matmul."""
+    from ..core.enforce import check_matmul
+    check_matmul(x.shape, y.shape if hasattr(y, "shape") else
+                 list(jnp.shape(y)), transpose_x, transpose_y)
+
     def f(a, b):
         if transpose_x:
             a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a

@@ -23,7 +23,9 @@ def cast(x, dtype):
 
 
 def reshape(x, shape, name=None):
+    from ..core.enforce import check_reshape
     shape = _ints(shape)
+    check_reshape(x.shape, shape)
     return apply("reshape", lambda a: a.reshape(shape), [x])
 
 
@@ -77,8 +79,10 @@ def unsqueeze_(x, axis, name=None):
 
 
 def concat(x, axis=0, name=None):
+    from ..core.enforce import check_concat
     tensors = list(x)
     ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    check_concat([t.shape for t in tensors], ax)
     return apply("concat", lambda *xs: jnp.concatenate(xs, axis=ax), tensors)
 
 

@@ -1,0 +1,5 @@
+"""Pallas TPU kernels (SURVEY §7 step 9: the hot fused set).
+
+These back the functional layer transparently; each has an XLA fallback.
+"""
+from .flash_attention import flash_attention_bshd  # noqa: F401

@@ -1,0 +1,322 @@
+"""Flash attention — Pallas TPU kernel (forward + backward).
+
+Reference: paddle/phi/kernels/gpu/flash_attn_kernel.cu (FlashAttention-2 CUDA
+kernels, dynloaded from third_party/flashattn). TPU-native rebuild: online-
+softmax tiling in VMEM with the MXU doing the block matmuls; the backward
+recomputes P blockwise from the saved logsumexp (FA-2 style) instead of
+storing the S×S matrix — O(S) memory for any sequence length.
+
+Layout: [B, H, S, D] inside the kernels (the functional layer transposes from
+paddle's [B, S, H, D]). D ≤ 128; S must divide by the block size (the
+functional layer pads).
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+NEG_INF = -1e30
+
+
+# ---------------- forward ----------------
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                *, scale, causal, block_q, block_k):
+    i = pl.program_id(1)
+    kb = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    def compute():
+        q = q_ref[0].astype(jnp.float32)          # [BQ, D]
+        k = k_ref[0].astype(jnp.float32)          # [BK, D]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [BQ, BK]
+        if causal:
+            qpos = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(kpos <= qpos, s, NEG_INF)
+        m_prev = m_scr[:, 0]                       # [BQ]
+        m_new = jnp.maximum(m_prev, s.max(axis=1))
+        p = jnp.exp(s - m_new[:, None])            # [BQ, BK]
+        corr = jnp.exp(m_prev - m_new)             # [BQ]
+        l_new = corr * l_scr[:, 0] + p.sum(axis=1)
+        v = v_ref[0].astype(jnp.float32)           # [BK, D]
+        pv = jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)    # [BQ, D]
+        acc_scr[:] = corr[:, None] * acc_scr[:] + pv
+        m_scr[:] = m_new[:, None]
+        l_scr[:] = l_new[:, None]
+
+    if causal:
+        # skip fully-masked key blocks (they lie strictly above the diagonal)
+        @pl.when(kb * block_k <= i * block_q + block_q - 1)
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(kb == nk - 1)
+    def _final():
+        l = jnp.maximum(l_scr[:, 0], 1e-30)
+        o_ref[0] = (acc_scr[:] / l[:, None]).astype(o_ref.dtype)
+        lse_ref[0] = (m_scr[:, 0] + jnp.log(l)).astype(jnp.float32)
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    nq, nk = pl.cdiv(sq, block_q), pl.cdiv(sk, block_k)
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                               block_q=block_q, block_k=block_k)
+    out_shapes = (jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+                  jax.ShapeDtypeStruct((bh, sq), jnp.float32))
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, kb: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, kb: (b, kb, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, kb: (b, kb, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, block_q, d), lambda b, i, kb: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i, kb: (b, i)),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        out_shape=out_shapes,
+        interpret=interpret,
+    )(q, k, v)
+    return o, lse
+
+
+# ---------------- backward ----------------
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   dq_scr, *, scale, causal, block_q, block_k):
+    i = pl.program_id(1)
+    kb = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    def compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]                           # [BQ]
+        delta = delta_ref[0]                       # [BQ]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            qpos = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(kpos <= qpos, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])              # [BQ, BK]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)    # [BQ, BK]
+        ds = p * (dp - delta[:, None]) * scale
+        dq_scr[:] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when(kb * block_k <= i * block_q + block_q - 1)
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(kb == nk - 1)
+    def _final():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr, *, scale, causal,
+                    block_q, block_k):
+    kb = pl.program_id(1)
+    ib = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(ib == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    def compute():
+        q = q_ref[0].astype(jnp.float32)           # [BQ, D]
+        k = k_ref[0].astype(jnp.float32)           # [BK, D]
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]
+        delta = delta_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [BQ, BK]
+        if causal:
+            qpos = ib * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(kpos <= qpos, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])              # [BQ, BK]
+        dv_scr[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)    # [BK, D]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)    # [BQ, BK]
+        ds = p * (dp - delta[:, None]) * scale
+        dk_scr[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)    # [BK, D]
+
+    if causal:
+        # q blocks strictly above the diagonal contribute nothing
+        @pl.when(ib * block_q + block_q - 1 >= kb * block_k)
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(ib == nq - 1)
+    def _final():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd(res, g, scale, causal, block_q, block_k, interpret):
+    q, k, v, o, lse = res
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    do = g
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1)  # [BH, SQ]
+    nq, nk = pl.cdiv(sq, block_q), pl.cdiv(sk, block_k)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k),
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, kb: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, kb: (b, kb, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, kb: (b, kb, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, kb: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i, kb: (b, i)),
+            pl.BlockSpec((1, block_q), lambda b, i, kb: (b, i)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, kb: (b, i, 0)),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k),
+        grid=(bh, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, kb, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, kb, i: (b, kb, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, kb, i: (b, kb, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, kb, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, kb, i: (b, i)),
+            pl.BlockSpec((1, block_q), lambda b, kb, i: (b, i)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, block_k, d), lambda b, kb, i: (b, kb, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, kb, i: (b, kb, 0)),
+        ),
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        out_shape=(jax.ShapeDtypeStruct(k.shape, k.dtype),
+                   jax.ShapeDtypeStruct(v.shape, v.dtype)),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------- public entry ----------------
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_attention_bhsd(q, k, v, scale, causal, blocks, interpret):
+    o, _ = _flash_fwd(q, k, v, scale, causal, blocks[0], blocks[1],
+                      interpret)
+    return o
+
+
+def _fa_fwd(q, k, v, scale, causal, blocks, interpret):
+    o, lse = _flash_fwd(q, k, v, scale, causal, blocks[0], blocks[1],
+                        interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _fa_bwd(scale, causal, blocks, interpret, res, g):
+    return _flash_bwd(res, g, scale, causal, blocks[0], blocks[1], interpret)
+
+
+_flash_attention_bhsd.defvjp(_fa_fwd, _fa_bwd)
+
+
+def flash_attention_bshd(q, k, v, causal=True, scale=None, block_q=None,
+                         block_k=None, interpret=False):
+    """Flash attention on [B, S, H, D] arrays (paddle layout). Returns the
+    same layout. Pads S up to the block size when needed."""
+    b, s, h, d = q.shape
+    sk = k.shape[1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    block_q = block_q or min(DEFAULT_BLOCK_Q, max(s, 8))
+    block_k = block_k or min(DEFAULT_BLOCK_K, max(sk, 8))
+
+    def to_bhsd(x):
+        return jnp.swapaxes(x, 1, 2).reshape(b * h, x.shape[1], d)
+
+    qt, kt, vt = to_bhsd(q), to_bhsd(k), to_bhsd(v)
+    pad_q = (-s) % block_q
+    pad_k = (-sk) % block_k
+    if pad_q:
+        qt = jnp.pad(qt, ((0, 0), (0, pad_q), (0, 0)))
+    if pad_k:
+        kt = jnp.pad(kt, ((0, 0), (0, pad_k), (0, 0)))
+        vt = jnp.pad(vt, ((0, 0), (0, pad_k), (0, 0)))
+    o = _flash_attention_bhsd(qt, kt, vt, scale, causal,
+                              (block_q, block_k), interpret)
+    if pad_q:
+        o = o[:, :s]
+    return jnp.swapaxes(o.reshape(b, h, s, d), 1, 2)
+
+
+def is_supported(q_shape, k_shape, causal, on_tpu):
+    """Shape/placement gate used by F.scaled_dot_product_attention."""
+    b, s, h, d = q_shape
+    if d > 128:
+        return False
+    if not on_tpu:
+        return False
+    return True

@@ -1,0 +1,140 @@
+"""paddle_tpu.profiler — tracing/profiling over the jax/XLA profiler.
+
+Reference: python/paddle/profiler/profiler.py:346 (Profiler) over the C++
+host/CUPTI tracers (SURVEY §5 tracing). TPU-native: device timelines come
+from the XLA profiler (xplane → TensorBoard/Perfetto); ``RecordEvent`` user
+scopes map onto jax.profiler.TraceAnnotation so they appear inline in the
+device trace. ``benchmark``-style summaries are derived host-side.
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+
+import jax
+
+__all__ = ["Profiler", "RecordEvent", "ProfilerTarget", "profiler_guard",
+           "load_profiler_result"]
+
+
+class ProfilerTarget:
+    CPU = "cpu"
+    GPU = "gpu"
+    CUSTOM_DEVICE = "tpu"
+    TPU = "tpu"
+
+
+class RecordEvent:
+    """User-scope annotation (reference: profiler/utils.py RecordEvent).
+    Appears in the xplane trace and accumulates host-side timing."""
+
+    _stats: dict = {}
+
+    def __init__(self, name, event_type=None):
+        self.name = name
+        self._ann = jax.profiler.TraceAnnotation(name)
+        self._t0 = None
+
+    def begin(self):
+        self.__enter__()
+
+    def end(self):
+        self.__exit__(None, None, None)
+
+    def __enter__(self):
+        self._ann.__enter__()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        dt = time.perf_counter() - self._t0
+        total, count = RecordEvent._stats.get(self.name, (0.0, 0))
+        RecordEvent._stats[self.name] = (total + dt, count + 1)
+        self._ann.__exit__(*exc)
+        return False
+
+
+class Profiler:
+    """Reference: paddle.profiler.Profiler (profiler/profiler.py:346).
+
+    on_trace_ready/export write an XLA trace directory consumable by
+    TensorBoard (xplane) — the chrome-trace export of the reference.
+    """
+
+    def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
+                 timer_only=False, record_shapes=False, profile_memory=False,
+                 with_flops=False, log_dir="./profiler_log"):
+        self.log_dir = log_dir
+        self.timer_only = timer_only
+        self.on_trace_ready = on_trace_ready
+        self._running = False
+        self._step_times = []
+        self._last_step = None
+
+    def start(self):
+        if not self.timer_only:
+            jax.profiler.start_trace(self.log_dir)
+        self._running = True
+        self._last_step = time.perf_counter()
+        return self
+
+    def stop(self):
+        if self._running and not self.timer_only:
+            jax.profiler.stop_trace()
+        self._running = False
+        if self.on_trace_ready is not None:
+            self.on_trace_ready(self)
+
+    def step(self, num_samples=None):
+        now = time.perf_counter()
+        if self._last_step is not None:
+            self._step_times.append(now - self._last_step)
+        self._last_step = now
+
+    def step_info(self, unit=None):
+        if not self._step_times:
+            return "no steps recorded"
+        import numpy as np
+        arr = np.array(self._step_times)
+        return (f"steps: {len(arr)}  avg: {arr.mean()*1e3:.2f} ms  "
+                f"p50: {np.percentile(arr, 50)*1e3:.2f} ms  "
+                f"max: {arr.max()*1e3:.2f} ms")
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms"):
+        lines = ["---- paddle_tpu profiler summary (host scopes) ----"]
+        for name, (total, count) in sorted(RecordEvent._stats.items(),
+                                           key=lambda kv: -kv[1][0]):
+            lines.append(f"{name:40s} calls={count:6d} "
+                         f"total={total*1e3:10.2f} ms "
+                         f"avg={total/max(count,1)*1e3:8.3f} ms")
+        lines.append(self.step_info())
+        out = "\n".join(lines)
+        print(out)
+        return out
+
+    def export(self, path=None, format=None):  # noqa: A002
+        return self.log_dir
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+@contextlib.contextmanager
+def profiler_guard(**kwargs):
+    p = Profiler(**kwargs)
+    p.start()
+    try:
+        yield p
+    finally:
+        p.stop()
+
+
+def load_profiler_result(path):
+    raise NotImplementedError(
+        "open the exported trace directory with TensorBoard "
+        "(xplane format) instead")

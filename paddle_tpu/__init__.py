@@ -72,3 +72,5 @@ def is_grad_enabled_():
 
 
 from .framework.flags import get_flags, set_flags  # noqa: F401,E402
+from . import incubate  # noqa: F401,E402
+from . import vision  # noqa: F401,E402

@@ -109,12 +109,19 @@ def load_state_dict(state_dict, path, process_group=None,
         if os.path.exists(fpath):
             with np.load(fpath) as z:
                 shard_data.update({k: z[k] for k in z.files})
+    def _set_nested(d, dotted, value):
+        parts = dotted.split(".")
+        for k in parts[:-1]:
+            d = d[k] if k in d else d[int(k)]
+        d[parts[-1]] = value
+
     flat_target = _flatten(state_dict)
     for name, target in flat_target.items():
         entry = metadata["state"].get(name)
         if entry is None:
             raise KeyError(f"checkpoint at {path} has no entry for '{name}'")
         if entry["kind"] == "py":
+            _set_nested(state_dict, name, entry["value"])
             continue
         global_np = np.zeros(entry["global_shape"],
                              np.dtype("float32") if "bfloat16" in

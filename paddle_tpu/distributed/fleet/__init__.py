@@ -12,7 +12,11 @@ from .mp_layers import (  # noqa: F401
     ColumnParallelLinear, ParallelCrossEntropy, RowParallelLinear,
     VocabParallelEmbedding,
 )
+from .pipeline import (  # noqa: F401
+    LayerDesc, PipelineLayer, PipelineParallel, SharedLayerDesc,
+)
 from .recompute import recompute, recompute_sequential  # noqa: F401
+from . import sequence_parallel_utils  # noqa: F401
 from .sharding import DygraphShardingOptimizer, group_sharded_parallel  # noqa: F401
 
 __all__ = ["DistributedStrategy", "init", "distributed_model",

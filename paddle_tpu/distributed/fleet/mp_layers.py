@@ -46,6 +46,15 @@ def _constrain(x, mesh, spec):
                      a, NamedSharding(mesh, spec)), [x])
 
 
+_U = P.UNCONSTRAINED
+
+
+def _last_dim_spec(ndim, axis):
+    """Constrain only the last dim; leave the others to GSPMD (so dp/sep
+    shardings on batch/seq dims survive the TP boundary)."""
+    return P(*([_U] * (ndim - 1)), axis)
+
+
 class VocabParallelEmbedding(Layer):
     """Reference: mp_layers.py:47 — vocab dim sharded across the mp axis."""
 
@@ -64,7 +73,7 @@ class VocabParallelEmbedding(Layer):
     def forward(self, x):
         out = F.embedding(x, self.weight)
         return _constrain(out, self._mesh,
-                          P(*([None] * (x.ndim + 1))))
+                          _last_dim_spec(x.ndim + 1, None))
 
 
 class ColumnParallelLinear(Layer):
@@ -91,10 +100,9 @@ class ColumnParallelLinear(Layer):
     def forward(self, x):
         y = F.linear(x, self.weight, self.bias)
         if self._gather_output:
-            return _constrain(y, self._mesh, P(*([None] * y.ndim)))
+            return _constrain(y, self._mesh, _last_dim_spec(y.ndim, None))
         # keep output sharded on the last dim (feeds RowParallelLinear)
-        return _constrain(y, self._mesh,
-                          P(*([None] * (y.ndim - 1)), self._axis))
+        return _constrain(y, self._mesh, _last_dim_spec(y.ndim, self._axis))
 
 
 class RowParallelLinear(Layer):
@@ -122,9 +130,9 @@ class RowParallelLinear(Layer):
     def forward(self, x):
         if not self._input_is_parallel:
             x = _constrain(x, self._mesh,
-                           P(*([None] * (x.ndim - 1)), self._axis))
+                           _last_dim_spec(x.ndim, self._axis))
         y = F.linear(x, self.weight, self.bias)
-        return _constrain(y, self._mesh, P(*([None] * y.ndim)))
+        return _constrain(y, self._mesh, _last_dim_spec(y.ndim, None))
 
 
 class ParallelCrossEntropy(Layer):
@@ -140,7 +148,7 @@ class ParallelCrossEntropy(Layer):
 
     def forward(self, input, label):
         logits = _constrain(input, self._mesh,
-                            P(*([None] * (input.ndim - 1)), self._axis))
+                            _last_dim_spec(input.ndim, self._axis))
         loss = F.cross_entropy(logits, label, reduction="none",
                                ignore_index=self._ignore_index)
         return loss

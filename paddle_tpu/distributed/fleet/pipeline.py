@@ -1,0 +1,203 @@
+"""Pipeline parallelism: PipelineLayer + micro-batch schedules.
+
+Reference: python/paddle/distributed/fleet/meta_parallel/
+pipeline_parallel.py:150 (PipelineParallel, 1F1B forward_backward_pipeline at
+:431, train_batch at :648) and parallel_layers/pp_layers.py:237
+(PipelineLayer segmenting).
+
+TPU-native design: on a single-controller mesh the per-rank P2P send/recv of
+the reference collapses — stages are placed on sub-meshes of the 'pipe' axis
+(each stage's parameters live on its stage devices) and activations move
+between stages as XLA device-to-device copies when the next stage's
+computation consumes them. The micro-batch schedule (fill-drain with
+gradient accumulation, the GPipe schedule) is driven from the host; within a
+stage everything can still be jit-staged. The interleaved-1F1B compiled
+variant (scan + collective_permute, SURVEY §7 'hard parts') is the planned
+upgrade path.
+"""
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ...core.tensor import Tensor
+from ...nn import Layer, LayerList
+from ..topology import get_hybrid_communicate_group
+
+__all__ = ["LayerDesc", "SharedLayerDesc", "PipelineLayer",
+           "PipelineParallel"]
+
+
+class LayerDesc:
+    """Deferred layer construction (reference: pp_layers.py LayerDesc)."""
+
+    def __init__(self, layer_class, *args, **kwargs):
+        self.layer_class = layer_class
+        self.args = args
+        self.kwargs = kwargs
+
+    def build(self):
+        return self.layer_class(*self.args, **self.kwargs)
+
+
+class SharedLayerDesc(LayerDesc):
+    def __init__(self, key, layer_class, *args, forward_func=None, **kwargs):
+        super().__init__(layer_class, *args, **kwargs)
+        self.key = key
+        self.forward_func = forward_func
+
+
+class PipelineLayer(Layer):
+    """Reference: parallel_layers/pp_layers.py:237 — segments a flat layer
+    list into pipeline stages and places each stage's parameters on its
+    stage sub-mesh."""
+
+    def __init__(self, layers, num_stages=None, topology=None,
+                 seg_method="uniform", loss_fn=None, **kwargs):
+        super().__init__()
+        descs = list(layers)
+        built = [d.build() if isinstance(d, LayerDesc) else d for d in descs]
+        self.run_function = built
+        hcg = get_hybrid_communicate_group()
+        self._num_stages = num_stages or hcg.get_pipe_parallel_world_size()
+        self._loss_fn = loss_fn
+        self._segments = self._segment(len(built), self._num_stages,
+                                       seg_method)
+        self.layers = LayerList(built)
+        self._place_stages(hcg)
+
+    @staticmethod
+    def _segment(n_layers, n_stages, seg_method):
+        """Uniform segmentation (reference supports layer:regex too)."""
+        bounds = [0]
+        base, extra = divmod(n_layers, n_stages)
+        for s in range(n_stages):
+            bounds.append(bounds[-1] + base + (1 if s < extra else 0))
+        return bounds
+
+    def _place_stages(self, hcg):
+        """Pin each stage's params onto its slice of the 'pipe' axis and
+        remember the per-stage shardings so forward can hand activations
+        across the stage boundary (the reference's p2p send/recv becomes an
+        XLA device-to-device transfer)."""
+        self._stage_shardings = [None] * self._num_stages
+        mesh = hcg.mesh
+        if self._num_stages <= 1 or mesh.shape.get("pipe", 1) < \
+                self._num_stages:
+            return
+        devs = mesh.devices  # [dp, pp, sharding, sep, mp]
+        for s in range(self._num_stages):
+            stage_devs = devs[:, s % devs.shape[1]]
+            stage_mesh = Mesh(stage_devs.reshape(-1), ("stage",))
+            sharding = NamedSharding(stage_mesh, P())
+            self._stage_shardings[s] = sharding
+            for li in range(self._segments[s], self._segments[s + 1]):
+                for p in self.layers[li].parameters():
+                    p._data = jax.device_put(p._data, sharding)
+
+    def get_stage_layers(self, stage):
+        return self.layers[self._segments[stage]:self._segments[stage + 1]]
+
+    def stage_of_layer(self, idx):
+        for s in range(self._num_stages):
+            if self._segments[s] <= idx < self._segments[s + 1]:
+                return s
+        return self._num_stages - 1
+
+    def _to_stage(self, x, stage):
+        sharding = self._stage_shardings[stage]
+        if sharding is None:
+            return x
+        from ...core.dispatch import apply
+        return apply("pp_transfer",
+                     lambda a: jax.device_put(a, sharding), [x])
+
+    def forward(self, x):
+        prev_stage = None
+        for idx, layer in enumerate(self.layers):
+            stage = self.stage_of_layer(idx)
+            if stage != prev_stage:
+                x = self._to_stage(x, stage)
+                prev_stage = stage
+            x = layer(x)
+        return x
+
+
+class PipelineParallel(Layer):
+    """Reference: meta_parallel/pipeline_parallel.py:150. train_batch runs
+    the GPipe fill-drain micro-batch schedule with gradient accumulation
+    (the reference's 1F1B ordering is a memory optimization of the same
+    math; the compiled single-program scan is the planned upgrade)."""
+
+    def __init__(self, layers, hcg=None, strategy=None, num_micro_batches
+                 =None):
+        super().__init__()
+        assert isinstance(layers, PipelineLayer), \
+            "PipelineParallel requires a PipelineLayer model"
+        self._layers = layers
+        self._hcg = hcg or get_hybrid_communicate_group()
+        if num_micro_batches is None and strategy is not None:
+            num_micro_batches = strategy.pipeline_configs.get(
+                "accumulate_steps", 1)
+        self._num_micro_batches = num_micro_batches or 1
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, *a, **k):
+        return self._layers.named_parameters(*a, **k)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)
+
+    def forward(self, x):
+        return self._layers(x)
+
+    def _split_micro(self, t, n):
+        b = t.shape[0]
+        assert b % n == 0, (f"batch {b} must divide into {n} micro-batches")
+        mb = b // n
+        return [t[i * mb:(i + 1) * mb] for i in range(n)]
+
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        """Reference: pipeline_parallel.py:648 (train_batch) — returns the
+        mean micro-batch loss; gradients are accumulated across
+        micro-batches before one optimizer step."""
+        x, y = data
+        n = self._num_micro_batches
+        xs = self._split_micro(x, n)
+        ys = self._split_micro(y, n)
+        total = 0.0
+        losses = []
+        for xm, ym in zip(xs, ys):
+            out = self._layers(xm)
+            loss_fn = self._layers._loss_fn
+            loss = loss_fn(out, ym) if loss_fn is not None else out
+            scaled = loss * (1.0 / n)
+            if scaler is not None:
+                scaler.scale(scaled).backward()
+            else:
+                scaled.backward()
+            losses.append(loss)
+        if scaler is not None:
+            scaler.step(optimizer)
+        else:
+            optimizer.step()
+        optimizer.clear_grad()
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        from .. import collective  # noqa: F401  (parity import)
+        from ...ops import stack as _stack
+        mean_loss = sum(float(l.numpy()) for l in losses) / n
+        return Tensor(np.asarray(mean_loss, np.float32))
+
+    def eval_batch(self, data, compute_loss=True):
+        x, y = data
+        out = self._layers(x)
+        if compute_loss and self._layers._loss_fn is not None:
+            return self._layers._loss_fn(out, y)
+        return out

@@ -69,10 +69,8 @@ def recompute(function, *args, use_reentrant=True, preserve_rng_state=True,
                 p._data = a
 
     ck = jax.checkpoint(pure)
-    n_out = None
-    result = apply("recompute", ck, params + tensor_args,
-                   nout=out_meta.get("n", 1))
-    return result
+    # dispatch.apply infers single-vs-tuple outputs from the traced result
+    return apply("recompute", ck, params + tensor_args)
 
 
 def recompute_sequential(ctx, functions, *args, **kwargs):
@@ -82,17 +80,9 @@ def recompute_sequential(ctx, functions, *args, **kwargs):
     seg_size = max(1, len(layers) // segments)
     out = args[0] if len(args) == 1 else args
 
-    def run_segment(start, end):
-        def seg_fn(x):
-            for lyr in layers[start:end]:
-                x = lyr(x)
-            return x
-        return seg_fn
-
     i = 0
     while i < len(layers):
         end = min(i + seg_size, len(layers))
-        seg = run_segment(i, end)
         # parameters of the segment's layers must be lifted for remat
         from ...nn import Layer as _L
 

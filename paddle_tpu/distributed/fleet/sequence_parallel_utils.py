@@ -1,0 +1,83 @@
+"""Megatron-style sequence parallelism utilities.
+
+Reference: python/paddle/distributed/fleet/utils/sequence_parallel_utils.py
+(scatter/all_gather/reduce_scatter along the sequence dim bracketing TP
+blocks, ColumnSequenceParallelLinear / RowSequenceParallelLinear).
+
+TPU-native: "scatter along seq" = a sharding constraint putting the seq dim
+on the 'sep' axis; "all_gather" = constraint back to replicated. GSPMD then
+fuses the boundary collectives with the adjacent matmuls exactly as the
+hand-written Megatron-SP ops do — the layers below express the same
+placement contract with two constraints instead of four custom autograd ops.
+"""
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...core.dispatch import apply
+from ...nn import functional as F
+from ..topology import get_hybrid_communicate_group
+from .mp_layers import ColumnParallelLinear, RowParallelLinear
+
+__all__ = ["scatter", "all_gather", "mark_as_sequence_parallel_parameter",
+           "ColumnSequenceParallelLinear", "RowSequenceParallelLinear",
+           "GatherOp", "ScatterOp"]
+
+
+def _sep_mesh():
+    hcg = get_hybrid_communicate_group()
+    return hcg.mesh, "sep"
+
+
+def _constrain(x, spec_fn):
+    mesh, axis = _sep_mesh()
+    spec = spec_fn(axis, x.ndim)
+    return apply("sp_reshard", lambda a: jax.lax.with_sharding_constraint(
+        a, NamedSharding(mesh, spec)), [x])
+
+
+def scatter(x, group=None):
+    """Shard [B, S, H] activations on the seq dim over 'sep'
+    (reference: sequence_parallel_utils.py:38 scatter)."""
+    return _constrain(x, lambda ax, nd: P(None, ax, *([None] * (nd - 2))))
+
+
+def all_gather(x, group=None):
+    """Gather the seq dim back to replicated (reference: :54 all_gather)."""
+    return _constrain(x, lambda ax, nd: P(*([None] * nd)))
+
+
+ScatterOp = scatter
+GatherOp = all_gather
+
+
+def reduce_scatter(x, group=None):
+    """Partial-sum activations → seq-sharded (reference: :70). With GSPMD the
+    partial is internal; the constraint places the result."""
+    return scatter(x, group)
+
+
+def mark_as_sequence_parallel_parameter(param):
+    """Tag consumed by the hybrid optimizer in the reference; placement makes
+    it a no-op here (kept for API parity)."""
+    param.is_sequence_parallel = True
+    return param
+
+
+class ColumnSequenceParallelLinear(ColumnParallelLinear):
+    """Input arrives seq-sharded; gather → column-parallel matmul
+    (reference: ColumnSequenceParallelLinear)."""
+
+    def forward(self, x):
+        x = all_gather(x)
+        return super().forward(x)
+
+
+class RowSequenceParallelLinear(RowParallelLinear):
+    """Row-parallel matmul → reduce-scatter onto the seq dim
+    (reference: RowSequenceParallelLinear)."""
+
+    def forward(self, x):
+        out = super().forward(x)
+        return scatter(out)

@@ -1,0 +1,10 @@
+"""paddle_tpu.distributed.launch — multi-host training launcher.
+
+Reference: python/paddle/distributed/launch (launch/main.py:20, collective
+controller). TPU-native: one controller process per host (the jax
+multi-controller model); the launcher exports coordinator env vars consumed
+by env.init_parallel_env → jax.distributed.initialize (PjRt's coordination
+service replaces the reference's TCPStore bootstrap). Failed workers are
+relaunched up to --max_restarts (the elastic controller's restart loop).
+"""
+from .main import launch, main  # noqa: F401

@@ -1,0 +1,92 @@
+"""Launcher implementation (reference: launch/main.py + controllers/collective.py)."""
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import time
+
+__all__ = ["launch", "main"]
+
+
+def _parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="launch a multi-host paddle_tpu training job")
+    p.add_argument("--nnodes", type=int, default=1,
+                   help="number of hosts in the job")
+    p.add_argument("--node_rank", type=int,
+                   default=int(os.environ.get("PADDLE_TPU_NODE_RANK", 0)),
+                   help="rank of this host")
+    p.add_argument("--master", default=os.environ.get(
+        "PADDLE_TPU_COORDINATOR", "127.0.0.1:8476"),
+        help="coordinator address host:port (rank-0 host)")
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="processes per host (1 per host is the TPU model)")
+    p.add_argument("--log_dir", default="log", help="per-rank log directory")
+    p.add_argument("--max_restarts", type=int, default=0,
+                   help="relaunch failed workers up to N times (elastic)")
+    p.add_argument("training_script", help="script to run")
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def _spawn(args, local_rank, restart_count):
+    global_rank = args.node_rank * args.nproc_per_node + local_rank
+    world = args.nnodes * args.nproc_per_node
+    env = dict(os.environ)
+    env.update({
+        "PADDLE_TPU_COORDINATOR": args.master if world > 1 else "",
+        "PADDLE_TPU_NUM_PROCESSES": str(world),
+        "PADDLE_TPU_PROCESS_ID": str(global_rank),
+        # reference-compatible names (fleet env bootstrap)
+        "PADDLE_TRAINER_ID": str(global_rank),
+        "PADDLE_TRAINERS_NUM": str(world),
+    })
+    if not env["PADDLE_TPU_COORDINATOR"]:
+        env.pop("PADDLE_TPU_COORDINATOR")
+    os.makedirs(args.log_dir, exist_ok=True)
+    log_path = os.path.join(args.log_dir,
+                            f"workerlog.{global_rank}"
+                            + (f".restart{restart_count}" if restart_count
+                               else ""))
+    log_f = open(log_path, "w")
+    proc = subprocess.Popen(
+        [sys.executable, args.training_script] + args.training_script_args,
+        env=env, stdout=log_f, stderr=subprocess.STDOUT)
+    return proc, log_path
+
+
+def launch(argv=None):
+    args = _parse_args(argv)
+    restarts = 0
+    while True:
+        procs = [_spawn(args, lr, restarts)
+                 for lr in range(args.nproc_per_node)]
+        rcs = []
+        failed = False
+        for proc, log_path in procs:
+            rc = proc.wait()
+            rcs.append(rc)
+            if rc != 0:
+                print(f"[launch] worker failed (rc={rc}); log: {log_path}",
+                      file=sys.stderr)
+                failed = True
+        if not failed:
+            print(f"[launch] all {len(procs)} worker(s) finished")
+            return 0
+        if restarts >= args.max_restarts:
+            return max(rcs)
+        restarts += 1
+        print(f"[launch] restarting workers "
+              f"({restarts}/{args.max_restarts})", file=sys.stderr)
+        time.sleep(3)
+
+
+def main():
+    sys.exit(launch())
+
+
+if __name__ == "__main__":
+    main()

@@ -54,8 +54,14 @@ class Model:
     def train_batch(self, inputs, labels=None, update=True):
         x = inputs[0] if isinstance(inputs, (list, tuple)) else inputs
         y = labels[0] if isinstance(labels, (list, tuple)) else labels
-        step = self._step_fn or self._build_step()
-        loss, out = step(x, y)
+        if not update:
+            # gradient accumulation: eager fwd/bwd without the staged update
+            out = self.network(x)
+            loss = self._loss(out, y)
+            loss.backward()
+        else:
+            step = self._step_fn or self._build_step()
+            loss, out = step(x, y)
         metrics = [float(loss.numpy())]
         for m in self._metrics:
             self._update_metric(m, out, y)
@@ -140,7 +146,7 @@ class Model:
                                           verbose=0)
                 logs.update({f"eval_{k}": v for k, v in eval_logs.items()})
                 for c in cbs:
-                    c.on_eval_end(logs)
+                    c.on_eval_end(eval_logs)
             for c in cbs:
                 c.on_epoch_end(epoch, logs)
             if save_dir and (epoch + 1) % save_freq == 0:

@@ -1,0 +1,73 @@
+"""paddle_tpu.incubate — fused ops + MoE (reference: python/paddle/incubate).
+
+On TPU the "fused" ops are either XLA-fused automatically or backed by the
+Pallas kernels in ops/pallas; the incubate names are kept for API parity
+(reference: incubate/nn/functional/fused_*.py).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import apply
+from .moe import MoELayer, TopKGate  # noqa: F401
+
+__all__ = ["MoELayer", "TopKGate", "fused_rms_norm", "fused_layer_norm",
+           "fused_rotary_position_embedding", "flash_attention"]
+
+
+def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
+                   begin_norm_axis=-1):
+    """Reference: incubate/nn/functional/fused_rms_norm.py → Pallas/XLA."""
+    from ..nn.functional import rms_norm
+    out = rms_norm(x, norm_weight, epsilon)
+    if norm_bias is not None:
+        out = out + norm_bias
+    return out
+
+
+def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5,
+                     begin_norm_axis=-1):
+    from ..nn.functional import layer_norm
+    return layer_norm(x, x.shape[begin_norm_axis], norm_weight, norm_bias,
+                      epsilon)
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None, use_neox_rotary_style
+                                    =True):
+    """RoPE (reference: incubate/nn/functional/
+    fused_rotary_position_embedding.py). Layout [B, S, H, D]."""
+    import numpy as np
+
+    def rope_one(x, sin_a, cos_a):
+        def fwd(a, s, c):
+            # neox style: rotate halves
+            d = a.shape[-1]
+            a1, a2 = a[..., : d // 2], a[..., d // 2:]
+            rot = jnp.concatenate([-a2, a1], axis=-1)
+            return a * c + rot * s
+        return apply("rope", fwd, [x, sin_a, cos_a])
+
+    if sin is None or cos is None:
+        b, s, h, d = q.shape
+        inv = 1.0 / (10000 ** (np.arange(0, d, 2, dtype=np.float32) / d))
+        t = np.arange(s, dtype=np.float32)
+        freqs = np.outer(t, inv)                        # [S, D/2]
+        emb = np.concatenate([freqs, freqs], axis=-1)   # [S, D]
+        from ..core.tensor import Tensor
+        sin = Tensor(np.sin(emb)[None, :, None, :])
+        cos = Tensor(np.cos(emb)[None, :, None, :])
+    outs = [rope_one(x, sin, cos) if x is not None else None
+            for x in (q, k, v)]
+    return tuple(o for o in outs)
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, fixed_seed_offset=None, name=None):
+    """Reference: paddle.nn.functional.flash_attention.flash_attention."""
+    from ..nn.functional import scaled_dot_product_attention
+    out = scaled_dot_product_attention(query, key, value, dropout_p=dropout,
+                                       is_causal=causal)
+    if return_softmax:
+        return out, None
+    return out, None

@@ -1,0 +1,137 @@
+"""Mixture-of-Experts with expert parallelism.
+
+Reference: python/paddle/incubate/distributed/models/moe/moe_layer.py
+(GShard-style all-to-all MoE) + gates (gate/{naive,gshard,switch}_gate.py)
+and the dispatch kernels (assign_pos/limit_by_capacity).
+
+TPU-native: the classic scatter/gather dispatch becomes the GShard einsum
+formulation — dispatch/combine are one-hot matmuls over a capacity-limited
+[tokens, experts, capacity] mask, and the expert FFNs are ONE batched matmul
+over stacked weights [E, d, h] sharded on the expert axis. When the
+dispatched tensor's expert dim is sharded, GSPMD emits exactly the all-to-all
+the reference issues by hand — and it rides ICI.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+from .. import nn
+
+__all__ = ["MoELayer", "TopKGate"]
+
+
+class TopKGate(nn.Layer):
+    """top-1 (switch) / top-2 (gshard) softmax gate with load-balance loss.
+
+    Reference: moe/gate/gshard_gate.py, switch_gate.py.
+    """
+
+    def __init__(self, d_model, num_experts, top_k=2, capacity_factor=1.25):
+        super().__init__()
+        self.num_experts = num_experts
+        self.top_k = top_k
+        self.capacity_factor = capacity_factor
+        self.weight = self.create_parameter([d_model, num_experts])
+
+    def capacity(self, num_tokens):
+        return int(math.ceil(num_tokens / self.num_experts
+                             * self.capacity_factor * self.top_k))
+
+
+class MoELayer(nn.Layer):
+    """Reference: incubate/distributed/models/moe/moe_layer.py MoELayer.
+
+    Expert FFN: x → gelu(x @ wi[e]) @ wo[e]. Experts stacked on dim 0 and
+    sharded over the expert-parallel mesh axis (``moe_group``).
+    """
+
+    def __init__(self, d_model, d_hidden, num_experts, top_k=2,
+                 capacity_factor=1.25, moe_group=None, gate=None, name=None):
+        super().__init__()
+        self.d_model = d_model
+        self.num_experts = num_experts
+        self.top_k = top_k
+        self.gate = gate or TopKGate(d_model, num_experts, top_k,
+                                     capacity_factor)
+        self.wi = self.create_parameter([num_experts, d_model, d_hidden])
+        self.wo = self.create_parameter([num_experts, d_hidden, d_model])
+        self._group = moe_group
+        if moe_group is not None:
+            sharding = NamedSharding(moe_group.mesh,
+                                     P(moe_group.axis, None, None))
+            self.wi._data = jax.device_put(self.wi._data, sharding)
+            self.wo._data = jax.device_put(self.wo._data, sharding)
+        self.aux_loss = None
+
+    def forward(self, x):
+        """x: [..., d_model] → same shape. Sets self.aux_loss (load-balance,
+        GShard eq.4) as a taped scalar for the training loss."""
+        E = self.num_experts
+        lead_shape = x.shape[:-1]
+        n_tokens = int(np.prod(lead_shape))
+        C = self.gate.capacity(n_tokens)
+        top_k = self.top_k
+
+        def fwd(xa, wg, wi, wo):
+            xt = xa.reshape(n_tokens, self.d_model)
+            logits = jnp.matmul(xt.astype(jnp.float32),
+                                wg.astype(jnp.float32))      # [T, E]
+            probs = jax.nn.softmax(logits, axis=-1)
+
+            # top-k routing with capacity limiting (GShard)
+            combine = jnp.zeros((n_tokens, E, C), jnp.float32)
+            dispatch = jnp.zeros((n_tokens, E, C), bool)
+            remaining = probs
+            # position counters are built with cumsum per expert
+            used = jnp.zeros((E,), jnp.int32)
+            masks = []
+            gates_k = []
+            for _ in range(top_k):
+                idx = jnp.argmax(remaining, axis=-1)          # [T]
+                onehot = jax.nn.one_hot(idx, E, dtype=jnp.float32)
+                gates_k.append((remaining * onehot).sum(-1))  # [T]
+                masks.append(onehot)
+                remaining = remaining * (1 - onehot)
+            # renormalize the k gate values
+            denom = sum(gates_k) + 1e-9
+            gates_k = [g / denom for g in gates_k]
+
+            pos_base = jnp.zeros((E,), jnp.float32)
+            for onehot, gval in zip(masks, gates_k):
+                # position of each token within its expert's capacity
+                pos_in_expert = (jnp.cumsum(onehot, axis=0) - 1) \
+                    + pos_base[None, :]                        # [T, E]
+                keep = (pos_in_expert < C) & (onehot > 0)
+                pos = jnp.clip(pos_in_expert.astype(jnp.int32), 0, C - 1)
+                cap_onehot = jax.nn.one_hot(pos, C,
+                                            dtype=jnp.float32) * \
+                    keep[..., None]                            # [T, E, C]
+                combine = combine + cap_onehot * gval[:, None, None]
+                dispatch = dispatch | (cap_onehot > 0)
+                pos_base = pos_base + onehot.sum(axis=0)
+
+            # dispatch tokens: [E, C, M]
+            dispatched = jnp.einsum("tec,tm->ecm",
+                                    dispatch.astype(xt.dtype), xt)
+            h = jnp.einsum("ecm,emh->ech", dispatched, wi.astype(xt.dtype))
+            h = jax.nn.gelu(h)
+            eo = jnp.einsum("ech,ehm->ecm", h, wo.astype(xt.dtype))
+            out = jnp.einsum("tec,ecm->tm", combine.astype(xt.dtype), eo)
+
+            # load-balance aux loss (GShard): E * sum_e f_e * p_e
+            me = probs.mean(axis=0)                           # [E]
+            ce = masks[0].mean(axis=0)                        # top-1 fraction
+            aux = (me * ce).sum() * E
+            return out.reshape(xa.shape), aux
+
+        out, aux = apply("moe", fwd, [x, self.gate.weight, self.wi, self.wo],
+                         nout=2)
+        self.aux_loss = aux
+        return out

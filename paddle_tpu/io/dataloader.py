@@ -132,15 +132,29 @@ class _MultiprocessIter:
         if self.outstanding == 0:
             self._shutdown()
             raise StopIteration
+        waited = 0.0
         while self.recv_seq not in self.reorder:
+            # poll in short slices so dead workers are detected even with
+            # timeout=0 (wait forever) semantics
+            slice_t = 5.0 if self.timeout is None else min(5.0, self.timeout)
             try:
-                seq, batch, err = self.data_queue.get(timeout=self.timeout)
+                seq, batch, err = self.data_queue.get(timeout=slice_t)
             except queue_mod.Empty:
-                self._shutdown()
-                raise RuntimeError(
-                    f"DataLoader worker timed out after {self.timeout}s "
-                    "(set DataLoader(timeout=...) to wait longer, or 0 to "
-                    "wait forever)") from None
+                dead = [w for w in self.workers
+                        if not w.is_alive() and w.exitcode not in (0, None)]
+                if dead:
+                    self._shutdown()
+                    raise RuntimeError(
+                        f"DataLoader worker died (exitcode "
+                        f"{dead[0].exitcode}) before producing its batch")
+                waited += slice_t
+                if self.timeout is not None and waited >= self.timeout:
+                    self._shutdown()
+                    raise RuntimeError(
+                        f"DataLoader worker timed out after {self.timeout}s "
+                        "(set DataLoader(timeout=...) to wait longer, or 0 "
+                        "to wait forever)") from None
+                continue
             self.reorder[seq] = (batch, err)
         batch, err = self.reorder.pop(self.recv_seq)
         self.recv_seq += 1

@@ -149,7 +149,8 @@ class Auc(Metric):
         neg = np.cumsum(self._stat_neg[::-1])
         tpr = pos / tot_pos
         fpr = neg / tot_neg
-        return float(np.trapezoid(tpr, fpr))
+        trapz = getattr(np, 'trapezoid', None) or np.trapz
+        return float(trapz(tpr, fpr))
 
     def name(self):
         return self._name

@@ -1,8 +1,12 @@
 """paddle_tpu.models — model zoo for the BASELINE configs (reference:
 python/paddle/vision/models + test/auto_parallel/get_gpt_model.py)."""
 from .gpt import (  # noqa: F401
-    GPTConfig, GPTForCausalLM, GPTModel, GPTPretrainingCriterion, gpt_1p3b,
-    gpt_13b, gpt_small, gpt_tiny,
+    GPTConfig, GPTForCausalLM, GPTForCausalLMPipe, GPTModel,
+    GPTPretrainingCriterion, gpt_1p3b, gpt_13b, gpt_small, gpt_tiny,
+)
+from .bert import (  # noqa: F401
+    BertConfig, BertForMaskedLM, BertForSequenceClassification, BertModel,
+    bert_base, bert_tiny,
 )
 from .lenet import LeNet  # noqa: F401
 from .resnet import ResNet, resnet18, resnet34, resnet50  # noqa: F401

@@ -1,0 +1,117 @@
+"""BERT — encoder model for BASELINE config 5 (whole-graph compile).
+
+Reference analog: the BERT encoders used by the reference's dygraph-to-static
+tests (test/dygraph_to_static coverage) built from paddle.nn.TransformerEncoder.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import nn
+from ..nn import functional as F
+
+__all__ = ["BertConfig", "BertModel", "BertForSequenceClassification",
+           "BertForMaskedLM", "bert_base", "bert_tiny"]
+
+
+class BertConfig:
+    def __init__(self, vocab_size=30522, hidden_size=768, num_layers=12,
+                 num_heads=12, intermediate_size=3072, max_seq_len=512,
+                 type_vocab_size=2, dropout=0.1, layer_norm_epsilon=1e-12):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.intermediate_size = intermediate_size
+        self.max_seq_len = max_seq_len
+        self.type_vocab_size = type_vocab_size
+        self.dropout = dropout
+        self.layer_norm_epsilon = layer_norm_epsilon
+
+
+def bert_base():
+    return BertConfig()
+
+
+def bert_tiny():
+    return BertConfig(vocab_size=1024, hidden_size=64, num_layers=2,
+                      num_heads=4, intermediate_size=128, max_seq_len=128,
+                      dropout=0.0)
+
+
+class BertEmbeddings(nn.Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.word_embeddings = nn.Embedding(config.vocab_size,
+                                            config.hidden_size)
+        self.position_embeddings = nn.Embedding(config.max_seq_len,
+                                                config.hidden_size)
+        self.token_type_embeddings = nn.Embedding(config.type_vocab_size,
+                                                  config.hidden_size)
+        self.layer_norm = nn.LayerNorm(config.hidden_size,
+                                       epsilon=config.layer_norm_epsilon)
+        self.dropout = nn.Dropout(config.dropout)
+
+    def forward(self, input_ids, token_type_ids=None):
+        from .. import ops
+        s = input_ids.shape[1]
+        pos = ops.arange(0, s, dtype="int64").unsqueeze(0)
+        emb = self.word_embeddings(input_ids) \
+            + self.position_embeddings(pos)
+        if token_type_ids is not None:
+            emb = emb + self.token_type_embeddings(token_type_ids)
+        return self.dropout(self.layer_norm(emb))
+
+
+class BertModel(nn.Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        self.embeddings = BertEmbeddings(config)
+        enc_layer = nn.TransformerEncoderLayer(
+            config.hidden_size, config.num_heads, config.intermediate_size,
+            dropout=config.dropout, activation="gelu")
+        self.encoder = nn.TransformerEncoder(enc_layer, config.num_layers)
+        self.pooler = nn.Linear(config.hidden_size, config.hidden_size)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        x = self.embeddings(input_ids, token_type_ids)
+        mask = None
+        if attention_mask is not None:
+            # [B, S] 1/0 → additive [B, 1, S, S] broadcast mask
+            from ..core.dispatch import apply
+            import jax.numpy as jnp
+            mask = apply(
+                "bert_mask",
+                lambda m: (1.0 - m.astype(jnp.float32))[:, None, None, :]
+                * -1e30, [attention_mask])
+        sequence_output = self.encoder(x, src_mask=mask)
+        pooled = F.tanh(self.pooler(sequence_output[:, 0]))
+        return sequence_output, pooled
+
+
+class BertForSequenceClassification(nn.Layer):
+    def __init__(self, config: BertConfig, num_classes=2):
+        super().__init__()
+        self.bert = BertModel(config)
+        self.dropout = nn.Dropout(config.dropout)
+        self.classifier = nn.Linear(config.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        _, pooled = self.bert(input_ids, token_type_ids, attention_mask)
+        return self.classifier(self.dropout(pooled))
+
+
+class BertForMaskedLM(nn.Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.bert = BertModel(config)
+        self.transform = nn.Linear(config.hidden_size, config.hidden_size)
+        self.layer_norm = nn.LayerNorm(config.hidden_size,
+                                       epsilon=config.layer_norm_epsilon)
+        self.decoder = nn.Linear(config.hidden_size, config.vocab_size)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        seq, _ = self.bert(input_ids, token_type_ids, attention_mask)
+        h = self.layer_norm(F.gelu(self.transform(seq)))
+        return self.decoder(h)

@@ -223,3 +223,50 @@ class GPTPretrainingCriterion(nn.Layer):
             m = loss_mask.reshape([b * s]).astype("float32")
             return (losses * m).sum() / m.sum()
         return losses.mean()
+
+
+class _EmbeddingPipe(nn.Layer):
+    """Stage-0 pipeline block: token + position embedding."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.wte = nn.Embedding(config.vocab_size, config.hidden_size)
+        self.wpe = nn.Embedding(config.max_seq_len, config.hidden_size)
+        self.drop = nn.Dropout(config.dropout)
+
+    def forward(self, input_ids):
+        from .. import ops
+        s = input_ids.shape[1]
+        pos = ops.arange(0, s, dtype="int64").unsqueeze(0)
+        return self.drop(self.wte(input_ids) + self.wpe(pos))
+
+
+class _LMHeadPipe(nn.Layer):
+    """Last pipeline block: final norm + untied LM head."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        norm = nn.RMSNorm if config.use_rms_norm else nn.LayerNorm
+        self.ln_f = norm(config.hidden_size,
+                         epsilon=config.layer_norm_epsilon)
+        self.head = nn.Linear(config.hidden_size, config.vocab_size,
+                              bias_attr=False)
+
+    def forward(self, x):
+        return self.head(self.ln_f(x))
+
+
+def GPTForCausalLMPipe(config: GPTConfig, num_stages=None, loss_fn=None):
+    """Pipeline-parallel GPT built from LayerDescs (reference: the fleet
+    GPTForPretrainingPipe recipe over PipelineLayer, pp_layers.py:237)."""
+    from ..distributed.fleet import LayerDesc, PipelineLayer
+    descs = [LayerDesc(_EmbeddingPipe, config)]
+    descs += [LayerDesc(GPTBlock, config) for _ in range(config.num_layers)]
+    descs.append(LayerDesc(_LMHeadPipe, config))
+    if loss_fn is None:
+        crit = GPTPretrainingCriterion(config)
+
+        def loss_fn(logits, labels):
+            return crit(logits, labels)
+    return PipelineLayer(layers=descs, num_stages=num_stages,
+                         loss_fn=loss_fn)

@@ -215,9 +215,6 @@ def _flash_eligible(query, key, attn_mask, dropout_p, training, is_causal):
     from ...core.device import _platform_of
     if _platform_of(_jax.devices()[0]) != "tpu":
         return False
-    sk = key.shape[1]
-    if not is_causal and sk % min(128, max(sk, 8)) != 0:
-        return False
     return True
 
 

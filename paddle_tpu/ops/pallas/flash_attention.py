@@ -27,7 +27,7 @@ NEG_INF = -1e30
 
 # ---------------- forward ----------------
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-                *, scale, causal, block_q, block_k):
+                *, scale, causal, block_q, block_k, kv_len):
     i = pl.program_id(1)
     kb = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -44,12 +44,15 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # [BQ, BK]
-        if causal:
+        if causal or kv_len % block_k:
             qpos = i * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             kpos = kb * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(kpos <= qpos, s, NEG_INF)
+            valid = kpos < kv_len
+            if causal:
+                valid = valid & (kpos <= qpos)
+            s = jnp.where(valid, s, NEG_INF)
         m_prev = m_scr[:, 0]                       # [BQ]
         m_new = jnp.maximum(m_prev, s.max(axis=1))
         p = jnp.exp(s - m_new[:, None])            # [BQ, BK]
@@ -78,12 +81,15 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         lse_ref[0] = (m_scr[:, 0] + jnp.log(l)).astype(jnp.float32)
 
 
-def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret,
+               kv_len=None):
     bh, sq, d = q.shape
     sk = k.shape[1]
+    kv_len = kv_len if kv_len is not None else sk
     nq, nk = pl.cdiv(sq, block_q), pl.cdiv(sk, block_k)
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               block_q=block_q, block_k=block_k)
+                               block_q=block_q, block_k=block_k,
+                               kv_len=kv_len)
     out_shapes = (jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
                   jax.ShapeDtypeStruct((bh, sq), jnp.float32))
     o, lse = pl.pallas_call(
@@ -111,7 +117,7 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
 
 # ---------------- backward ----------------
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   dq_scr, *, scale, causal, block_q, block_k):
+                   dq_scr, *, scale, causal, block_q, block_k, kv_len):
     i = pl.program_id(1)
     kb = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -130,12 +136,15 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
-        if causal:
+        if causal or kv_len % block_k:
             qpos = i * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             kpos = kb * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(kpos <= qpos, s, NEG_INF)
+            valid = kpos < kv_len
+            if causal:
+                valid = valid & (kpos <= qpos)
+            s = jnp.where(valid, s, NEG_INF)
         p = jnp.exp(s - lse[:, None])              # [BQ, BK]
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
@@ -159,7 +168,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_scr, dv_scr, *, scale, causal,
-                    block_q, block_k):
+                    block_q, block_k, kv_len):
     kb = pl.program_id(1)
     ib = pl.program_id(2)
     nq = pl.num_programs(2)
@@ -179,12 +188,15 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # [BQ, BK]
-        if causal:
+        if causal or kv_len % block_k:
             qpos = ib * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             kpos = kb * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(kpos <= qpos, s, NEG_INF)
+            valid = kpos < kv_len
+            if causal:
+                valid = valid & (kpos <= qpos)
+            s = jnp.where(valid, s, NEG_INF)
         p = jnp.exp(s - lse[:, None])              # [BQ, BK]
         dv_scr[:] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
@@ -211,7 +223,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _flash_bwd(res, g, scale, causal, block_q, block_k, interpret):
+def _flash_bwd(res, g, scale, causal, block_q, block_k, interpret, kv_len):
     q, k, v, o, lse = res
     bh, sq, d = q.shape
     sk = k.shape[1]
@@ -222,7 +234,7 @@ def _flash_bwd(res, g, scale, causal, block_q, block_k, interpret):
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k),
+                          block_q=block_q, block_k=block_k, kv_len=kv_len),
         grid=(bh, nq, nk),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, kb: (b, i, 0)),
@@ -240,7 +252,7 @@ def _flash_bwd(res, g, scale, causal, block_q, block_k, interpret):
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k),
+                          block_q=block_q, block_k=block_k, kv_len=kv_len),
         grid=(bh, nk, nq),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, kb, i: (b, i, 0)),
@@ -267,18 +279,19 @@ def _flash_bwd(res, g, scale, causal, block_q, block_k, interpret):
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _flash_attention_bhsd(q, k, v, scale, causal, blocks, interpret):
     o, _ = _flash_fwd(q, k, v, scale, causal, blocks[0], blocks[1],
-                      interpret)
+                      interpret, kv_len=blocks[2])
     return o
 
 
 def _fa_fwd(q, k, v, scale, causal, blocks, interpret):
     o, lse = _flash_fwd(q, k, v, scale, causal, blocks[0], blocks[1],
-                        interpret)
+                        interpret, kv_len=blocks[2])
     return o, (q, k, v, o, lse)
 
 
 def _fa_bwd(scale, causal, blocks, interpret, res, g):
-    return _flash_bwd(res, g, scale, causal, blocks[0], blocks[1], interpret)
+    return _flash_bwd(res, g, scale, causal, blocks[0], blocks[1], interpret,
+                      kv_len=blocks[2])
 
 
 _flash_attention_bhsd.defvjp(_fa_fwd, _fa_bwd)
@@ -306,17 +319,8 @@ def flash_attention_bshd(q, k, v, causal=True, scale=None, block_q=None,
         kt = jnp.pad(kt, ((0, 0), (0, pad_k), (0, 0)))
         vt = jnp.pad(vt, ((0, 0), (0, pad_k), (0, 0)))
     o = _flash_attention_bhsd(qt, kt, vt, scale, causal,
-                              (block_q, block_k), interpret)
+                              (block_q, block_k, sk), interpret)
     if pad_q:
         o = o[:, :s]
     return jnp.swapaxes(o.reshape(b, h, s, d), 1, 2)
 
-
-def is_supported(q_shape, k_shape, causal, on_tpu):
-    """Shape/placement gate used by F.scaled_dot_product_attention."""
-    b, s, h, d = q_shape
-    if d > 128:
-        return False
-    if not on_tpu:
-        return False
-    return True

@@ -1,0 +1,117 @@
+"""Vision datasets (reference: python/paddle/vision/datasets/).
+
+This environment has no network egress, so ``download=True`` raises with
+instructions; the loaders read the standard on-disk formats (IDX for MNIST,
+pickled batches for CIFAR).
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100"]
+
+
+def _no_download(name):
+    raise RuntimeError(
+        f"{name}: automatic download is unavailable (no network egress); "
+        "place the standard dataset files locally and pass their paths")
+
+
+def _read_idx_images(path):
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+        assert magic == 2051, f"bad IDX image magic {magic}"
+        data = np.frombuffer(f.read(), np.uint8)
+    return data.reshape(n, rows, cols)
+
+
+def _read_idx_labels(path):
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic, n = struct.unpack(">II", f.read(8))
+        assert magic == 2049, f"bad IDX label magic {magic}"
+        return np.frombuffer(f.read(), np.uint8).astype(np.int64)
+
+
+class MNIST(Dataset):
+    """Reference: vision/datasets/mnist.py (IDX file format)."""
+
+    NAME = "MNIST"
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=False, backend=None):
+        if image_path is None or label_path is None:
+            if download:
+                _no_download(self.NAME)
+            raise ValueError(
+                f"{self.NAME} requires image_path and label_path to local "
+                "IDX files (train-images-idx3-ubyte[.gz] etc.)")
+        self.images = _read_idx_images(image_path)
+        self.labels = _read_idx_labels(label_path)
+        assert len(self.images) == len(self.labels)
+        self.transform = transform
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    NAME = "FashionMNIST"
+
+
+class _CifarBase(Dataset):
+    NAME = "Cifar10"
+    LABEL_KEY = b"labels"
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None):
+        if data_file is None:
+            if download:
+                _no_download(self.NAME)
+            raise ValueError(
+                f"{self.NAME} requires data_file pointing at the local "
+                "python-version batch file(s)")
+        files = data_file if isinstance(data_file, (list, tuple)) \
+            else [data_file]
+        xs, ys = [], []
+        for fp in files:
+            with open(fp, "rb") as f:
+                d = pickle.load(f, encoding="bytes")
+            xs.append(np.asarray(d[b"data"], np.uint8)
+                      .reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1))
+            ys.append(np.asarray(d[self.LABEL_KEY], np.int64))
+        self.images = np.concatenate(xs)
+        self.labels = np.concatenate(ys)
+        self.transform = transform
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+class Cifar10(_CifarBase):
+    pass
+
+
+class Cifar100(_CifarBase):
+    NAME = "Cifar100"
+    LABEL_KEY = b"fine_labels"

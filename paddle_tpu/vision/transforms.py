@@ -1,0 +1,195 @@
+"""Vision transforms (reference: python/paddle/vision/transforms/).
+
+Host-side numpy pipeline (runs in DataLoader workers) — images are HWC uint8
+or float arrays; ToTensor produces CHW float32 Tensors.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["Compose", "ToTensor", "Normalize", "Resize", "CenterCrop",
+           "RandomCrop", "RandomHorizontalFlip", "RandomVerticalFlip",
+           "Transpose", "BrightnessTransform", "to_tensor", "normalize",
+           "resize", "hflip", "center_crop"]
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def __call__(self, img):
+        for t in self.transforms:
+            img = t(img)
+        return img
+
+
+def to_tensor(img, data_format="CHW"):
+    arr = np.asarray(img)
+    if arr.dtype == np.uint8:
+        arr = arr.astype(np.float32) / 255.0
+    else:
+        arr = arr.astype(np.float32)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    if data_format == "CHW":
+        arr = np.transpose(arr, (2, 0, 1))
+    return Tensor(arr)
+
+
+class ToTensor:
+    def __init__(self, data_format="CHW"):
+        self.data_format = data_format
+
+    def __call__(self, img):
+        return to_tensor(img, self.data_format)
+
+
+def normalize(img, mean, std, data_format="CHW"):
+    arr = img.numpy() if isinstance(img, Tensor) else np.asarray(
+        img, np.float32)
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+    if data_format == "CHW":
+        arr = (arr - mean[:, None, None]) / std[:, None, None]
+    else:
+        arr = (arr - mean) / std
+    return Tensor(arr) if isinstance(img, Tensor) else arr
+
+
+class Normalize:
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False):
+        self.mean = [mean] if np.isscalar(mean) else list(mean)
+        self.std = [std] if np.isscalar(std) else list(std)
+        self.data_format = data_format
+
+    def __call__(self, img):
+        return normalize(img, self.mean, self.std, self.data_format)
+
+
+def resize(img, size, interpolation="bilinear"):
+    arr = np.asarray(img)
+    h, w = arr.shape[:2]
+    if isinstance(size, int):
+        if h < w:
+            oh, ow = size, int(size * w / h)
+        else:
+            oh, ow = int(size * h / w), size
+    else:
+        oh, ow = size
+    # nearest/bilinear resize without PIL: index-map (nearest) or lerp
+    yi = np.linspace(0, h - 1, oh)
+    xi = np.linspace(0, w - 1, ow)
+    if interpolation == "nearest":
+        out = arr[np.round(yi).astype(int)][:, np.round(xi).astype(int)]
+    else:
+        y0 = np.floor(yi).astype(int)
+        y1 = np.clip(y0 + 1, 0, h - 1)
+        x0 = np.floor(xi).astype(int)
+        x1 = np.clip(x0 + 1, 0, w - 1)
+        wy = (yi - y0)[:, None]
+        wx = (xi - x0)[None, :]
+        if arr.ndim == 3:
+            wy = wy[..., None]
+            wx = wx[..., None]
+        a = arr[y0][:, x0].astype(np.float32)
+        b = arr[y0][:, x1].astype(np.float32)
+        c = arr[y1][:, x0].astype(np.float32)
+        d = arr[y1][:, x1].astype(np.float32)
+        out = (a * (1 - wy) * (1 - wx) + b * (1 - wy) * wx
+               + c * wy * (1 - wx) + d * wy * wx)
+        if arr.dtype == np.uint8:
+            out = np.clip(np.round(out), 0, 255).astype(np.uint8)
+    return out
+
+
+class Resize:
+    def __init__(self, size, interpolation="bilinear"):
+        self.size = size
+        self.interpolation = interpolation
+
+    def __call__(self, img):
+        return resize(img, self.size, self.interpolation)
+
+
+def center_crop(img, output_size):
+    arr = np.asarray(img)
+    th, tw = (output_size, output_size) if isinstance(output_size, int) \
+        else output_size
+    h, w = arr.shape[:2]
+    i = max(0, (h - th) // 2)
+    j = max(0, (w - tw) // 2)
+    return arr[i:i + th, j:j + tw]
+
+
+class CenterCrop:
+    def __init__(self, size):
+        self.size = size
+
+    def __call__(self, img):
+        return center_crop(img, self.size)
+
+
+class RandomCrop:
+    def __init__(self, size, padding=0, pad_if_needed=False):
+        self.size = (size, size) if isinstance(size, int) else size
+        self.padding = padding
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        if self.padding:
+            pads = [(self.padding, self.padding),
+                    (self.padding, self.padding)] + \
+                ([(0, 0)] if arr.ndim == 3 else [])
+            arr = np.pad(arr, pads)
+        h, w = arr.shape[:2]
+        th, tw = self.size
+        i = np.random.randint(0, h - th + 1)
+        j = np.random.randint(0, w - tw + 1)
+        return arr[i:i + th, j:j + tw]
+
+
+def hflip(img):
+    return np.asarray(img)[:, ::-1].copy()
+
+
+class RandomHorizontalFlip:
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def __call__(self, img):
+        if np.random.rand() < self.prob:
+            return hflip(img)
+        return np.asarray(img)
+
+
+class RandomVerticalFlip:
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def __call__(self, img):
+        if np.random.rand() < self.prob:
+            return np.asarray(img)[::-1].copy()
+        return np.asarray(img)
+
+
+class Transpose:
+    def __init__(self, order=(2, 0, 1)):
+        self.order = order
+
+    def __call__(self, img):
+        return np.transpose(np.asarray(img), self.order)
+
+
+class BrightnessTransform:
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, img):
+        arr = np.asarray(img).astype(np.float32)
+        factor = 1 + np.random.uniform(-self.value, self.value)
+        out = arr * factor
+        if np.asarray(img).dtype == np.uint8:
+            return np.clip(out, 0, 255).astype(np.uint8)
+        return out

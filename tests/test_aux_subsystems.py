@@ -209,3 +209,51 @@ def test_distribution_log_prob_differentiable():
     lp = n.log_prob(paddle.to_tensor(1.0))
     lp.backward()
     np.testing.assert_allclose(loc.grad.numpy(), 0.5, rtol=1e-5)
+
+
+# ---------------- vision + launcher ----------------
+def test_vision_transforms_pipeline():
+    from paddle_tpu.vision import transforms as T
+    img = (np.random.rand(32, 32, 3) * 255).astype(np.uint8)
+    pipe = T.Compose([T.Resize(28), T.CenterCrop(24),
+                      T.RandomHorizontalFlip(0.0), T.ToTensor(),
+                      T.Normalize([0.5, 0.5, 0.5], [0.5, 0.5, 0.5])])
+    out = pipe(img)
+    assert out.shape == [3, 24, 24]
+    assert float(out.numpy().max()) <= 1.0
+
+
+def test_vision_mnist_reads_idx(tmp_path):
+    import gzip
+    import struct
+    from paddle_tpu.vision.datasets import MNIST
+    imgs = (np.random.rand(5, 28, 28) * 255).astype(np.uint8)
+    labels = np.arange(5).astype(np.uint8)
+    ip = str(tmp_path / "imgs.gz")
+    lp = str(tmp_path / "labels.gz")
+    with gzip.open(ip, "wb") as f:
+        f.write(struct.pack(">IIII", 2051, 5, 28, 28) + imgs.tobytes())
+    with gzip.open(lp, "wb") as f:
+        f.write(struct.pack(">II", 2049, 5) + labels.tobytes())
+    ds = MNIST(image_path=ip, label_path=lp)
+    assert len(ds) == 5
+    img, lab = ds[3]
+    assert img.shape == (28, 28) and lab == 3
+
+
+def test_launcher_spawns_and_sets_env(tmp_path):
+    import subprocess
+    import sys
+    script = tmp_path / "worker.py"
+    script.write_text(
+        "import os\n"
+        "assert os.environ['PADDLE_TRAINER_ID'] == '0'\n"
+        "assert os.environ['PADDLE_TPU_NUM_PROCESSES'] == '1'\n"
+        "print('worker ok')\n")
+    rc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--log_dir", str(tmp_path / "log"), str(script)],
+        capture_output=True, text=True, timeout=120)
+    assert rc.returncode == 0, rc.stderr
+    log = (tmp_path / "log" / "workerlog.0").read_text()
+    assert "worker ok" in log

@@ -90,3 +90,17 @@ def test_flash_bf16():
     np.testing.assert_allclose(np.asarray(out, np.float32),
                                np.asarray(ref, np.float32), rtol=5e-2,
                                atol=5e-2)
+
+
+def test_flash_forward_unaligned_seq_noncausal():
+    """Regression: padded key positions must be masked out of the softmax in
+    the non-causal path too."""
+    rng = np.random.RandomState(4)
+    shape = (1, 130, 2, 32)  # 130 % 128 != 0 → 126 padded keys
+    q = jnp.asarray(rng.randn(*shape), jnp.float32)
+    k = jnp.asarray(rng.randn(*shape), jnp.float32)
+    v = jnp.asarray(rng.randn(*shape), jnp.float32)
+    out = flash_attention_bshd(q, k, v, causal=False, interpret=True)
+    ref = _ref_attention(q, k, v, False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3,
+                               atol=2e-3)

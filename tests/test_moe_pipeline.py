@@ -1,0 +1,162 @@
+"""MoE (expert parallel), pipeline parallel, sequence-parallel utils.
+
+Reference precedents: test/collective/fleet/ moe + pipeline tests
+(hybrid_parallel_pp_layer.py, dygraph moe tests).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.distributed import fleet
+from paddle_tpu.incubate import MoELayer
+
+
+def _fleet(dp=1, mp=1, pp=1, sep=1, sharding=1):
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": dp, "mp_degree": mp,
+                               "pp_degree": pp, "sharding_degree": sharding,
+                               "sep_degree": sep}
+    return fleet.init(strategy=strategy)
+
+
+# ---------------- MoE ----------------
+def test_moe_forward_backward_and_balance():
+    paddle.seed(0)
+    _fleet(dp=8)
+    moe = MoELayer(d_model=16, d_hidden=32, num_experts=4, top_k=2,
+                   capacity_factor=2.0)
+    x = paddle.to_tensor(np.random.randn(8, 10, 16).astype("float32"),
+                         stop_gradient=False)
+    out = moe(x)
+    assert out.shape == [8, 10, 16]
+    assert moe.aux_loss is not None
+    loss = out.sum() + 0.01 * moe.aux_loss
+    loss.backward()
+    assert moe.wi.grad is not None
+    assert moe.gate.weight.grad is not None
+    assert x.grad is not None
+    assert np.isfinite(x.grad.numpy()).all()
+
+
+def test_moe_expert_parallel_sharding():
+    hcg = _fleet(dp=1, mp=8)
+    moe = MoELayer(d_model=16, d_hidden=32, num_experts=8, top_k=1,
+                   moe_group=hcg.get_model_parallel_group())
+    shard_shapes = {s.data.shape for s in moe.wi._data.addressable_shards}
+    assert (1, 16, 32) in shard_shapes  # one expert per device
+    x = paddle.to_tensor(np.random.randn(4, 6, 16).astype("float32"))
+    out = moe(x)
+    assert out.shape == [4, 6, 16]
+
+
+def test_moe_capacity_drops_overflow():
+    paddle.seed(1)
+    _fleet(dp=8)
+    # capacity_factor tiny → most tokens dropped → output mostly zero
+    moe = MoELayer(d_model=8, d_hidden=8, num_experts=2, top_k=1,
+                   capacity_factor=0.01)
+    x = paddle.to_tensor(np.random.randn(4, 8, 8).astype("float32"))
+    out = moe(x)
+    zero_frac = (np.abs(out.numpy()) < 1e-7).mean()
+    assert zero_frac > 0.5
+
+
+def test_moe_trains():
+    paddle.seed(2)
+    _fleet(dp=8)
+    moe = MoELayer(d_model=8, d_hidden=16, num_experts=2, top_k=2,
+                   capacity_factor=4.0)
+    head = nn.Linear(8, 1)
+    params = moe.parameters() + head.parameters()
+    opt = paddle.optimizer.Adam(learning_rate=0.01, parameters=params)
+    X = np.random.randn(16, 4, 8).astype("float32")
+    Y = X.sum(axis=-1, keepdims=True).astype("float32")
+    xt, yt = paddle.to_tensor(X), paddle.to_tensor(Y)
+    first = None
+    for _ in range(25):
+        loss = F.mse_loss(head(moe(xt)), yt) + 0.01 * moe.aux_loss
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        first = first or float(loss.numpy())
+    assert float(loss.numpy()) < first * 0.5
+
+
+# ---------------- pipeline ----------------
+def test_pipeline_layer_segmentation():
+    _fleet(dp=2, pp=4)
+    layers = [fleet.LayerDesc(nn.Linear, 8, 8) for _ in range(8)]
+    pipe = fleet.PipelineLayer(layers=layers, num_stages=4)
+    assert pipe._segments == [0, 2, 4, 6, 8]
+    assert len(list(pipe.get_stage_layers(0))) == 2
+    assert pipe.stage_of_layer(5) == 2
+
+
+def test_pipeline_train_batch_matches_plain():
+    """Micro-batched pipeline training must match single-batch training
+    (reference precedent: hybrid_parallel_pp_layer loss parity)."""
+    def build(pipe_mode):
+        paddle.seed(33)
+        _fleet(dp=1, pp=2 if pipe_mode else 1)
+        descs = [fleet.LayerDesc(nn.Linear, 6, 16),
+                 fleet.LayerDesc(nn.ReLU),
+                 fleet.LayerDesc(nn.Linear, 16, 4)]
+        model = fleet.PipelineLayer(
+            layers=descs, num_stages=2 if pipe_mode else 1,
+            loss_fn=nn.CrossEntropyLoss())
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=model.parameters())
+        return model, opt
+
+    np.random.seed(3)
+    X = np.random.randn(16, 6).astype("float32")
+    Y = np.random.randint(0, 4, 16)
+
+    # plain: whole batch at once
+    model1, opt1 = build(False)
+    losses1 = []
+    for _ in range(4):
+        loss = nn.CrossEntropyLoss()(model1(paddle.to_tensor(X)),
+                                     paddle.to_tensor(Y))
+        loss.backward()
+        opt1.step()
+        opt1.clear_grad()
+        losses1.append(float(loss.numpy()))
+
+    # pipelined: 4 micro-batches, grad accumulation
+    model2, opt2 = build(True)
+    pp = fleet.PipelineParallel(model2, num_micro_batches=4)
+    losses2 = []
+    for _ in range(4):
+        loss = pp.train_batch((paddle.to_tensor(X), paddle.to_tensor(Y)),
+                              opt2)
+        losses2.append(float(loss.numpy()))
+
+    np.testing.assert_allclose(losses1, losses2, rtol=1e-4, atol=1e-5)
+
+
+# ---------------- sequence parallel ----------------
+def test_sequence_parallel_linears_parity():
+    from paddle_tpu.distributed.fleet.sequence_parallel_utils import (
+        ColumnSequenceParallelLinear, RowSequenceParallelLinear, all_gather,
+        scatter,
+    )
+    _fleet(dp=1, mp=4, sep=2)
+    paddle.seed(44)
+    col = ColumnSequenceParallelLinear(16, 32, gather_output=False)
+    row = RowSequenceParallelLinear(32, 16, input_is_parallel=True)
+    ref1, ref2 = nn.Linear(16, 32), nn.Linear(32, 16)
+    ref1.weight.set_value(col.weight.numpy())
+    ref1.bias.set_value(col.bias.numpy())
+    ref2.weight.set_value(row.weight.numpy())
+    ref2.bias.set_value(row.bias.numpy())
+
+    x = paddle.to_tensor(np.random.randn(2, 8, 16).astype("float32"))
+    x_sp = scatter(x)
+    out = all_gather(row(F.relu(col(x_sp))))
+    want = ref2(F.relu(ref1(x)))
+    np.testing.assert_allclose(out.numpy(), want.numpy(), rtol=1e-4,
+                               atol=1e-5)

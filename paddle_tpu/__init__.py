@@ -74,3 +74,7 @@ def is_grad_enabled_():
 from .framework.flags import get_flags, set_flags  # noqa: F401,E402
 from . import incubate  # noqa: F401,E402
 from . import vision  # noqa: F401,E402
+from . import static  # noqa: F401,E402
+from . import onnx  # noqa: F401,E402
+from . import quantization  # noqa: F401,E402
+from . import sparse  # noqa: F401,E402

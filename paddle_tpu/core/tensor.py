@@ -183,12 +183,43 @@ class Tensor:
     def to(self, *args, **kwargs):
         # to(dtype) / to(device) / to(device, dtype)
         dtype = kwargs.get("dtype")
+        device = kwargs.get("device")
         for a in args:
-            if isinstance(a, str) and (a in ("cpu", "tpu") or ":" in a):
-                continue  # single-process device moves are handled by jax placement
+            if isinstance(a, str) and (a in ("cpu", "tpu", "gpu")
+                                       or ":" in a):
+                device = a
             else:
                 dtype = a
-        return self if dtype is None else self.astype(dtype)
+        out = self
+        if device is not None:
+            kind = device.split(":")[0]
+            from .device import _platform_of
+            if kind in ("tpu", "gpu", "cuda", "xla"):
+                want = "tpu"  # accelerator strings route to the TPU backend
+            elif kind == "cpu":
+                want = "cpu"
+            else:
+                raise ValueError(
+                    f"Tensor.to({device!r}): unknown device kind {kind!r} "
+                    "(supported: tpu/gpu/cuda/xla → TPU, cpu)")
+            targets = [d for d in jax.devices() if _platform_of(d) == want]
+            if not targets and want == "cpu":
+                try:
+                    targets = jax.devices("cpu")
+                except RuntimeError:
+                    targets = []
+            if not targets:
+                raise RuntimeError(
+                    f"Tensor.to({device!r}): no such device is attached "
+                    f"(available: {[d.platform for d in jax.devices()]})")
+            idx = int(device.split(":")[1]) if ":" in device else 0
+            if idx >= len(targets):
+                raise RuntimeError(
+                    f"Tensor.to({device!r}): device index {idx} out of "
+                    f"range — only {len(targets)} {want} device(s) attached")
+            out = Tensor(jax.device_put(out._data, targets[idx]),
+                         stop_gradient=out.stop_gradient)
+        return out if dtype is None else out.astype(dtype)
 
     def cpu(self):
         return Tensor(jax.device_get(self._data), stop_gradient=self.stop_gradient)

@@ -19,6 +19,7 @@ from .env import (  # noqa: F401
     get_rank, get_world_size, init_parallel_env, is_initialized, world_mesh,
 )
 from .parallel import DataParallel, shard_batch  # noqa: F401
+from .tcp_store import TCPStore, Watchdog  # noqa: F401
 from .topology import (  # noqa: F401
     CommunicateTopology, HybridCommunicateGroup, build_mesh,
     get_hybrid_communicate_group,

@@ -190,8 +190,6 @@ class PipelineParallel(Layer):
         optimizer.clear_grad()
         if lr_scheduler is not None:
             lr_scheduler.step()
-        from .. import collective  # noqa: F401  (parity import)
-        from ...ops import stack as _stack
         mean_loss = sum(float(l.numpy()) for l in losses) / n
         return Tensor(np.asarray(mean_loss, np.float32))
 

@@ -37,15 +37,19 @@ def _constrain(x, spec_fn):
         a, NamedSharding(mesh, spec)), [x])
 
 
+_U = P.UNCONSTRAINED
+
+
 def scatter(x, group=None):
-    """Shard [B, S, H] activations on the seq dim over 'sep'
-    (reference: sequence_parallel_utils.py:38 scatter)."""
-    return _constrain(x, lambda ax, nd: P(None, ax, *([None] * (nd - 2))))
+    """Shard [B, S, H] activations on the seq dim over 'sep'; other dims are
+    left to GSPMD so dp/batch shardings survive (reference:
+    sequence_parallel_utils.py:38 scatter)."""
+    return _constrain(x, lambda ax, nd: P(_U, ax, *([_U] * (nd - 2))))
 
 
 def all_gather(x, group=None):
-    """Gather the seq dim back to replicated (reference: :54 all_gather)."""
-    return _constrain(x, lambda ax, nd: P(*([None] * nd)))
+    """Gather the seq dim back to unsharded (reference: :54 all_gather)."""
+    return _constrain(x, lambda ax, nd: P(_U, None, *([_U] * (nd - 2))))
 
 
 ScatterOp = scatter

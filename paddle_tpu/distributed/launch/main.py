@@ -55,6 +55,7 @@ def _spawn(args, local_rank, restart_count):
     proc = subprocess.Popen(
         [sys.executable, args.training_script] + args.training_script_args,
         env=env, stdout=log_f, stderr=subprocess.STDOUT)
+    log_f.close()  # the child holds its own fd copy
     return proc, log_path
 
 

@@ -1,0 +1,176 @@
+"""TCPStore — python binding over the native C++ store.
+
+Reference: phi/core/distributed/store/tcp_store.h:121 exposed as
+``paddle.distributed.TCPStore``. The C++ implementation lives in
+core/native/tcp_store.cpp (built on demand with g++, cached as a .so);
+ctypes binds it — no pybind11 dependency. Also exposes the collective
+watchdog (CommTaskManager analog, comm_task_manager.cc:153).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+__all__ = ["TCPStore", "Watchdog"]
+
+_LIB = None
+_LIB_LOCK = threading.Lock()
+
+
+def _native_dir():
+    return os.path.join(os.path.dirname(os.path.dirname(__file__)), "core",
+                        "native")
+
+
+def _load_lib():
+    global _LIB
+    with _LIB_LOCK:
+        if _LIB is not None:
+            return _LIB
+        src = os.path.join(_native_dir(), "tcp_store.cpp")
+        build_dir = os.path.join(_native_dir(), "build")
+        os.makedirs(build_dir, exist_ok=True)
+        so = os.path.join(build_dir, "libpd_tcp_store.so")
+        if not os.path.exists(so) or \
+                os.path.getmtime(so) < os.path.getmtime(src):
+            cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+                   "-pthread", src, "-o", so + ".tmp"]
+            subprocess.run(cmd, check=True, capture_output=True)
+            os.replace(so + ".tmp", so)
+        lib = ctypes.CDLL(so)
+        lib.pd_store_server_start.restype = ctypes.c_void_p
+        lib.pd_store_server_start.argtypes = [ctypes.c_int]
+        lib.pd_store_server_stop.argtypes = [ctypes.c_void_p]
+        lib.pd_store_client_connect.restype = ctypes.c_void_p
+        lib.pd_store_client_connect.argtypes = [ctypes.c_char_p,
+                                                ctypes.c_int, ctypes.c_int]
+        lib.pd_store_client_close.argtypes = [ctypes.c_void_p]
+        lib.pd_store_set.restype = ctypes.c_int
+        lib.pd_store_set.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                     ctypes.c_char_p, ctypes.c_int64]
+        lib.pd_store_get.restype = ctypes.c_int64
+        lib.pd_store_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                     ctypes.c_int64, ctypes.c_char_p,
+                                     ctypes.c_int64]
+        lib.pd_store_add.restype = ctypes.c_int64
+        lib.pd_store_add.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                     ctypes.c_int64]
+        lib.pd_store_check.restype = ctypes.c_int
+        lib.pd_store_check.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.pd_store_delete.restype = ctypes.c_int
+        lib.pd_store_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.pd_watchdog_start.restype = ctypes.c_void_p
+        lib.pd_watchdog_start.argtypes = [ctypes.c_int64]
+        lib.pd_watchdog_beat.argtypes = [ctypes.c_void_p]
+        lib.pd_watchdog_tripped.restype = ctypes.c_int
+        lib.pd_watchdog_tripped.argtypes = [ctypes.c_void_p]
+        lib.pd_watchdog_stop.argtypes = [ctypes.c_void_p]
+        _LIB = lib
+        return lib
+
+
+class TCPStore:
+    """Reference API: paddle.distributed.TCPStore(host, port, is_master,
+    world_size, timeout)."""
+
+    def __init__(self, host="127.0.0.1", port=6170, is_master=False,
+                 world_size=1, timeout=900):
+        lib = _load_lib()
+        self._lib = lib
+        self._server = None
+        self._timeout_ms = int(timeout * 1000)
+        if is_master:
+            self._server = lib.pd_store_server_start(port)
+            if not self._server:
+                raise RuntimeError(f"TCPStore master failed to bind :{port}")
+        self._client = lib.pd_store_client_connect(
+            host.encode(), port, self._timeout_ms)
+        if not self._client:
+            if self._server:
+                lib.pd_store_server_stop(self._server)
+            raise RuntimeError(f"TCPStore could not connect {host}:{port}")
+
+    def set(self, key: str, value) -> None:
+        data = value if isinstance(value, bytes) else str(value).encode()
+        rc = self._lib.pd_store_set(self._client, key.encode(), data,
+                                    len(data))
+        if rc != 0:
+            raise RuntimeError(f"TCPStore.set({key!r}) failed")
+
+    def get(self, key: str) -> bytes:
+        cap = 1 << 20
+        buf = ctypes.create_string_buffer(cap)
+        n = self._lib.pd_store_get(self._client, key.encode(),
+                                   self._timeout_ms, buf, cap)
+        if n == -1:
+            raise RuntimeError(
+                f"TCPStore.get({key!r}) timed out after "
+                f"{self._timeout_ms} ms")
+        if n < 0:
+            raise RuntimeError(f"TCPStore.get({key!r}) failed ({n})")
+        return buf.raw[:n]
+
+    def add(self, key: str, amount: int = 1) -> int:
+        v = self._lib.pd_store_add(self._client, key.encode(), amount)
+        if v == -(2 ** 63):
+            raise RuntimeError(f"TCPStore.add({key!r}) failed")
+        return int(v)
+
+    def check(self, key: str) -> bool:
+        rc = self._lib.pd_store_check(self._client, key.encode())
+        if rc < 0:
+            raise RuntimeError(f"TCPStore.check({key!r}) failed")
+        return bool(rc)
+
+    def wait(self, keys, timeout=None):
+        keys = [keys] if isinstance(keys, str) else list(keys)
+        for k in keys:
+            self.get(k)  # blocking get IS the wait
+
+    def delete_key(self, key: str) -> bool:
+        return self._lib.pd_store_delete(self._client, key.encode()) == 0
+
+    def barrier(self, name: str, world_size: int):
+        """add+wait barrier (reference masterDaemon barrier pattern)."""
+        n = self.add(f"__barrier/{name}", 1)
+        if n >= world_size:
+            self.set(f"__barrier/{name}/done", b"1")
+        self.get(f"__barrier/{name}/done")
+
+    def __del__(self):
+        try:
+            if getattr(self, "_client", None):
+                self._lib.pd_store_client_close(self._client)
+            if getattr(self, "_server", None):
+                self._lib.pd_store_server_stop(self._server)
+        except Exception:
+            pass
+
+
+class Watchdog:
+    """Collective watchdog (reference: CommTaskManager,
+    comm_task_manager.cc:153): trip if no heartbeat within timeout."""
+
+    def __init__(self, timeout_seconds=1800.0):
+        self._lib = _load_lib()
+        self._h = self._lib.pd_watchdog_start(int(timeout_seconds * 1000))
+
+    def beat(self):
+        self._lib.pd_watchdog_beat(self._h)
+
+    @property
+    def tripped(self) -> bool:
+        return bool(self._lib.pd_watchdog_tripped(self._h))
+
+    def stop(self):
+        if self._h:
+            self._lib.pd_watchdog_stop(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.stop()
+        except Exception:
+            pass

@@ -10,9 +10,11 @@ import jax.numpy as jnp
 
 from ..core.dispatch import apply
 from .moe import MoELayer, TopKGate  # noqa: F401
+from .ring_attention import ring_attention  # noqa: F401
 
-__all__ = ["MoELayer", "TopKGate", "fused_rms_norm", "fused_layer_norm",
-           "fused_rotary_position_embedding", "flash_attention"]
+__all__ = ["MoELayer", "TopKGate", "ring_attention", "fused_rms_norm",
+           "fused_layer_norm", "fused_rotary_position_embedding",
+           "flash_attention"]
 
 
 def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
@@ -28,8 +30,10 @@ def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
 def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5,
                      begin_norm_axis=-1):
     from ..nn.functional import layer_norm
-    return layer_norm(x, x.shape[begin_norm_axis], norm_weight, norm_bias,
-                      epsilon)
+    # normalize over ALL dims from begin_norm_axis onward (reference
+    # fused_layer_norm begin_norm_axis semantics)
+    ax = begin_norm_axis % x.ndim
+    return layer_norm(x, list(x.shape[ax:]), norm_weight, norm_bias, epsilon)
 
 
 def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
@@ -66,8 +70,10 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
                     return_softmax=False, fixed_seed_offset=None, name=None):
     """Reference: paddle.nn.functional.flash_attention.flash_attention."""
     from ..nn.functional import scaled_dot_product_attention
+    if return_softmax:
+        raise NotImplementedError(
+            "return_softmax=True is not supported: the flash kernel never "
+            "materializes the softmax matrix (that is the point)")
     out = scaled_dot_product_attention(query, key, value, dropout_p=dropout,
                                        is_causal=causal)
-    if return_softmax:
-        return out, None
     return out, None

@@ -1,0 +1,122 @@
+"""Ring attention — context parallelism over the 'sep' mesh axis.
+
+Reference gap (SURVEY §5 long-context): the reference snapshot has Megatron-SP
+and the 'sep' axis but NO ring attention/blockwise CP; PAPERS.md directs the
+TPU rebuild to add it. Design: Q/K/V are sharded on the sequence dim across
+the ring; each step computes the local block's contribution with
+online-softmax accumulation (flash-attention math), then rotates K/V to the
+next neighbour with `jax.lax.ppermute` — the collective rides ICI neighbour
+links, overlapping compute with transfer. Memory per device is O(S/N), so
+context length scales linearly with ring size.
+
+Causal masking is handled per ring step at block granularity: the K/V block
+that originated on device j is fully visible to queries on device i when
+j < i, fully hidden when j > i, and diagonal (within-block causal) when
+j == i.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+
+__all__ = ["ring_attention"]
+
+
+def _ring_attention_local(q, k, v, *, axis, causal, scale):
+    """Per-device body (inside shard_map). q/k/v: [B, S_local, H, D]."""
+    n = jax.lax.psum(1, axis)
+    my_idx = jax.lax.axis_index(axis)
+    b, s_loc, h, d = q.shape
+    qf = jnp.swapaxes(q.astype(jnp.float32), 1, 2)  # [B, H, Sl, D]
+
+    def block(kv, src_idx):
+        """Attention stats of local Q against one K/V block."""
+        kb, vb = kv
+        kf = jnp.swapaxes(kb.astype(jnp.float32), 1, 2)
+        vf = jnp.swapaxes(vb.astype(jnp.float32), 1, 2)
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, kf) * scale
+        if causal:
+            qpos = my_idx * s_loc + jax.lax.broadcasted_iota(
+                jnp.int32, (s_loc, s_loc), 0)
+            kpos = src_idx * s_loc + jax.lax.broadcasted_iota(
+                jnp.int32, (s_loc, s_loc), 1)
+            s = jnp.where(kpos <= qpos, s, -1e30)
+        m = s.max(axis=-1)                                   # [B,H,Sl]
+        p = jnp.exp(s - m[..., None])
+        l = p.sum(axis=-1)
+        pv = jnp.einsum("bhqk,bhkd->bhqd", p, vf)
+        return m, l, pv
+
+    def accumulate(carry, i, rotate):
+        kb, vb, m_acc, l_acc, o_acc = carry
+        src_idx = (my_idx - i) % n  # who this K/V block belongs to
+        m_b, l_b, pv_b = block((kb, vb), src_idx)
+        m_new = jnp.maximum(m_acc, m_b)
+        c_old = jnp.exp(m_acc - m_new)
+        c_new = jnp.exp(m_b - m_new)
+        l_new = c_old * l_acc + c_new * l_b
+        o_new = c_old[..., None] * o_acc + c_new[..., None] * pv_b
+        if rotate:
+            # rotate K/V around the ring (device r sends to r+1)
+            perm = [(j, (j + 1) % n) for j in range(n)]
+            kb = jax.lax.ppermute(kb, axis, perm)
+            vb = jax.lax.ppermute(vb, axis, perm)
+        return (kb, vb, m_new, l_new, o_new)
+
+    m0 = jnp.full((b, h, s_loc), -1e30, jnp.float32)
+    l0 = jnp.zeros((b, h, s_loc), jnp.float32)
+    o0 = jnp.zeros((b, h, s_loc, d), jnp.float32)
+    carry = (k, v, m0, l0, o0)
+    if n > 1:
+        carry, _ = jax.lax.scan(
+            lambda c, i: (accumulate(c, i, rotate=True), None),
+            carry, jnp.arange(n - 1))
+    # final block: no trailing rotation (its result would be discarded)
+    _, _, m_f, l_f, o_f = accumulate(carry, n - 1, rotate=False)
+    out = o_f / jnp.maximum(l_f, 1e-30)[..., None]
+    return jnp.swapaxes(out, 1, 2).astype(q.dtype)          # [B, Sl, H, D]
+
+
+def ring_attention(query, key, value, is_causal=True, scale=None,
+                   sep_group=None, name=None):
+    """Context-parallel attention on [B, S, H, D] tensors whose seq dim is
+    (or will be) sharded across the sequence-parallel axis.
+
+    Drop-in for F.scaled_dot_product_attention when S exceeds one device's
+    memory; differentiable (the ppermute ring is traced through jax.vjp).
+    """
+    from ..distributed.topology import get_hybrid_communicate_group
+    if sep_group is not None:
+        mesh, axis = sep_group.mesh, sep_group.axis
+    else:
+        hcg = get_hybrid_communicate_group()
+        mesh, axis = hcg.mesh, "sep"
+    n = mesh.shape[axis]
+    d = query.shape[-1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    assert query.shape[1] % n == 0, (
+        f"seq len {query.shape[1]} must divide the ring size {n}")
+
+    local = functools.partial(_ring_attention_local, axis=axis,
+                              causal=is_causal, scale=scale)
+    spec = P(None, axis, None, None)
+
+    def fwd(q, k, v):
+        fn = shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_vma=False)
+        # commit seq-dim sharding so the ring actually distributes the work
+        q = jax.lax.with_sharding_constraint(q, NamedSharding(mesh, spec))
+        k = jax.lax.with_sharding_constraint(k, NamedSharding(mesh, spec))
+        v = jax.lax.with_sharding_constraint(v, NamedSharding(mesh, spec))
+        return fn(q, k, v)
+
+    return apply("ring_attention", fwd, [query, key, value])

@@ -1,0 +1,18 @@
+"""paddle_tpu.onnx (reference: python/paddle/onnx — delegates to paddle2onnx).
+
+The TPU-native deployment format is serialized StableHLO (paddle_tpu.jit.save
+via jax.export), which every XLA runtime consumes directly; ONNX export would
+require the external paddle2onnx-equivalent converter, which is unavailable
+in this environment.
+"""
+from __future__ import annotations
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    raise NotImplementedError(
+        "ONNX export is not available (no converter in this environment). "
+        "Use paddle_tpu.jit.save(layer, path, input_spec=...) — it emits a "
+        "portable serialized-StableHLO artifact, the TPU-native deployment "
+        "format.")

@@ -1,0 +1,108 @@
+"""paddle_tpu.quantization — QAT/PTQ (reference: python/paddle/quantization).
+
+TPU-native: fake-quant is a straight-through-estimator op (round in forward,
+identity gradient) that XLA fuses into the surrounding computation; int8
+deployment maps onto XLA int8 matmuls (and the Pallas quantization-kernel
+pattern in the guide).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+from .. import nn
+
+__all__ = ["quant_aware", "FakeQuanterWithAbsMax", "QuantConfig", "QAT",
+           "quantize", "dequantize"]
+
+
+def _ste_fake_quant(x, scale, bits):
+    """Round-through-STE fake quantization (reference:
+    quantization/quanters/abs_max.py FakeQuanterWithAbsMaxObserver)."""
+    qmax = 2 ** (bits - 1) - 1
+
+    def fwd(a, s):
+        s = jnp.maximum(s, 1e-9)
+        q = jnp.clip(jnp.round(a / s * qmax), -qmax, qmax)
+        deq = q * s / qmax
+        # straight-through: forward quantized, gradient identity
+        return a + jax.lax.stop_gradient(deq - a)
+
+    return apply("fake_quant", fwd, [x, scale])
+
+
+class FakeQuanterWithAbsMax(nn.Layer):
+    def __init__(self, bit_length=8, moving_rate=0.9, name=None):
+        super().__init__()
+        self.bits = bit_length
+        self.moving_rate = moving_rate
+        self.register_buffer("scale",
+                             Tensor(np.ones((), np.float32)))
+
+    def forward(self, x):
+        if self.training:
+            cur = float(jnp.max(jnp.abs(x._data)))
+            prev = float(self.scale.numpy())
+            self.scale._data = jnp.asarray(
+                self.moving_rate * prev + (1 - self.moving_rate) * cur,
+                jnp.float32)
+        return _ste_fake_quant(x, self.scale, self.bits)
+
+
+class QuantConfig:
+    """Reference: quantization/config.py QuantConfig (subset)."""
+
+    def __init__(self, activation=None, weight=None):
+        self.activation_bits = activation or 8
+        self.weight_bits = weight or 8
+
+
+class _QuantedLinear(nn.Layer):
+    def __init__(self, linear, config):
+        super().__init__()
+        self.inner = linear
+        self.act_q = FakeQuanterWithAbsMax(config.activation_bits)
+        self.w_q = FakeQuanterWithAbsMax(config.weight_bits)
+
+    def forward(self, x):
+        from ..nn import functional as F
+        xq = self.act_q(x)
+        wq = self.w_q(self.inner.weight)
+        return F.linear(xq, wq, self.inner.bias)
+
+
+class QAT:
+    """Reference: quantization/qat.py QAT — wraps quantizable layers with
+    fake quanters."""
+
+    def __init__(self, config: QuantConfig = None):
+        self.config = config or QuantConfig()
+
+    def quantize(self, model, inplace=True):
+        for name, sub in list(model._sub_layers.items()):
+            if isinstance(sub, nn.Linear):
+                model._sub_layers[name] = _QuantedLinear(sub, self.config)
+            else:
+                self.quantize(sub, inplace=True)
+        return model
+
+
+def quant_aware(model, config=None):
+    return QAT(config).quantize(model)
+
+
+def quantize(x, scale, bits=8):
+    qmax = 2 ** (bits - 1) - 1
+    arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    s = jnp.maximum(jnp.asarray(scale, jnp.float32), 1e-9)
+    return Tensor(jnp.clip(jnp.round(arr / s * qmax), -qmax,
+                           qmax).astype(jnp.int8))
+
+
+def dequantize(q, scale, bits=8):
+    qmax = 2 ** (bits - 1) - 1
+    arr = q._data if isinstance(q, Tensor) else jnp.asarray(q)
+    return Tensor(arr.astype(jnp.float32) * scale / qmax)

@@ -1,0 +1,117 @@
+"""paddle_tpu.sparse — COO/CSR sparse tensors.
+
+Reference: python/paddle/sparse (creation.py, unary/binary ops, sparse
+matmul). TPU-native: backed by jax.experimental.sparse BCOO/BCSR — XLA lowers
+sparse matmuls to gather/scatter+MXU programs. Dense bridges (`to_dense`)
+return regular Tensors so the rest of the framework composes.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+from ..core.tensor import Tensor
+
+__all__ = ["sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
+           "is_same_shape", "add", "matmul", "masked_matmul"]
+
+
+class SparseCooTensor:
+    """Thin COO wrapper over BCOO (reference: SparseCooTensor,
+    paddle/phi/core/sparse_coo_tensor.h)."""
+
+    def __init__(self, bcoo):
+        self._bcoo = bcoo
+
+    @property
+    def shape(self):
+        return list(self._bcoo.shape)
+
+    @property
+    def dtype(self):
+        return self._bcoo.dtype
+
+    def indices(self):
+        return Tensor(jnp.swapaxes(self._bcoo.indices, 0, 1))
+
+    def values(self):
+        return Tensor(self._bcoo.data)
+
+    def nnz(self):
+        return int(self._bcoo.nse)
+
+    def to_dense(self):
+        return Tensor(self._bcoo.todense())
+
+    def to_sparse_coo(self, sparse_dim=None):
+        return self
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype})")
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      stop_gradient=True):
+    """Reference: paddle.sparse.sparse_coo_tensor (sparse/creation.py)."""
+    idx = indices.numpy() if isinstance(indices, Tensor) else \
+        np.asarray(indices)
+    val = values.numpy() if isinstance(values, Tensor) else \
+        np.asarray(values, dtype or np.float32)
+    idx = np.asarray(idx, np.int32).T  # paddle: [ndim, nnz] → BCOO [nnz, ndim]
+    if shape is None:
+        shape = tuple(int(m) + 1 for m in idx.max(axis=0))
+    bcoo = jsparse.BCOO((jnp.asarray(val), jnp.asarray(idx)),
+                        shape=tuple(shape))
+    return SparseCooTensor(bcoo)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
+                      stop_gradient=True):
+    """Reference: paddle.sparse.sparse_csr_tensor — materialized through COO
+    (BCSR support in jax is narrower; semantics preserved)."""
+    crows = np.asarray(crows.numpy() if isinstance(crows, Tensor) else crows,
+                       np.int32)
+    cols = np.asarray(cols.numpy() if isinstance(cols, Tensor) else cols,
+                      np.int32)
+    values = np.asarray(values.numpy() if isinstance(values, Tensor)
+                        else values, dtype or np.float32)
+    rows = np.repeat(np.arange(len(crows) - 1), np.diff(crows))
+    return sparse_coo_tensor(np.stack([rows, cols]), values, shape)
+
+
+def is_same_shape(x, y):
+    return list(x.shape) == list(y.shape)
+
+
+def add(x, y):
+    if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
+        return SparseCooTensor(_coo_add(x._bcoo, y._bcoo))
+    raise TypeError("sparse.add expects two SparseCooTensor inputs")
+
+
+def _coo_add(a, b):
+    data = jnp.concatenate([a.data, b.data])
+    idx = jnp.concatenate([a.indices, b.indices])
+    out = jsparse.BCOO((data, idx), shape=a.shape)
+    return jsparse.bcoo_sum_duplicates(out)
+
+
+def matmul(x, y):
+    """sparse @ dense → dense (reference: paddle.sparse.matmul)."""
+    if isinstance(x, SparseCooTensor):
+        dense = y._data if isinstance(y, Tensor) else jnp.asarray(y)
+        return Tensor(x._bcoo @ dense)
+    raise TypeError("sparse.matmul expects (SparseCooTensor, Tensor)")
+
+
+def masked_matmul(x, y, mask):
+    """dense @ dense sampled at mask's sparsity (SDDMM)."""
+    xa = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    ya = y._data if isinstance(y, Tensor) else jnp.asarray(y)
+    full = xa @ ya
+    idx = mask._bcoo.indices
+    vals = full[idx[:, 0], idx[:, 1]]
+    return SparseCooTensor(jsparse.BCOO((vals, idx), shape=full.shape))

@@ -1,0 +1,44 @@
+"""paddle_tpu.static — compatibility shim over the jit compile story.
+
+Reference: python/paddle/static. The static-graph Program/Executor machinery
+is replaced by trace-to-HLO (SURVEY §7: layers 7b/7c/7d collapse into
+jit.to_static); this namespace keeps the commonly used entry points working
+on top of it: InputSpec and save/load_inference_model map onto the jax.export
+AOT path.
+"""
+from __future__ import annotations
+
+from ..jit.api import InputSpec  # noqa: F401
+from ..jit.save_load import load as _jit_load
+from ..jit.save_load import save as _jit_save
+
+__all__ = ["InputSpec", "save_inference_model", "load_inference_model"]
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         program=None, **kwargs):
+    """Reference: static/io.py save_inference_model. `fetch_vars` must carry
+    the layer via `.layer` or kwargs['layer'] (the dygraph-first rebuild has
+    no global default Program to capture)."""
+    layer = kwargs.get("layer")
+    if layer is None:
+        raise ValueError(
+            "paddle_tpu.static.save_inference_model requires layer=<Layer>: "
+            "the static Program is replaced by tracing a Layer "
+            "(use paddle_tpu.jit.save directly for the native API)")
+    specs = feed_vars if isinstance(feed_vars, (list, tuple)) else [feed_vars]
+    _jit_save(layer, path_prefix, input_spec=list(specs))
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    """Reference: static/io.py load_inference_model → TranslatedLayer."""
+    return _jit_load(path_prefix)
+
+
+def default_main_program():
+    raise NotImplementedError(
+        "paddle_tpu is dygraph-first: there is no global static Program. "
+        "Use jit.to_static to compile functions/Layers (SURVEY §7).")
+
+
+default_startup_program = default_main_program

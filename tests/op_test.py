@@ -14,19 +14,43 @@ import paddle_tpu as paddle
 from paddle_tpu.core.tensor import Tensor
 
 
-def check_output(fn, np_ref, inputs, atol=1e-5, rtol=1e-5, kwargs=None):
-    """fn: op over Tensors; np_ref: same op over numpy arrays."""
+# dtype sweep for forward checks: f32 is the TPU default, bf16 the training
+# dtype (reference OpTest iterates every registered place/dtype,
+# op_test.py:2751). Tolerances widen with precision.
+_DTYPE_TOLS = {
+    "float64": (1e-7, 1e-7),
+    "float32": (1e-5, 1e-5),
+    "bfloat16": (2e-2, 2e-2),
+}
+
+
+def check_output(fn, np_ref, inputs, atol=1e-5, rtol=1e-5, kwargs=None,
+                 dtypes=("float64", "float32", "bfloat16")):
+    """fn: op over Tensors; np_ref: same op over numpy arrays. Floating
+    inputs are swept over `dtypes` (non-float inputs pass through)."""
     kwargs = kwargs or {}
-    tin = [paddle.to_tensor(a, dtype=str(np.asarray(a).dtype)) for a in inputs]
-    out = fn(*tin, **kwargs)
     ref = np_ref(*inputs, **kwargs)
-    outs = out if isinstance(out, (tuple, list)) else [out]
     refs = ref if isinstance(ref, (tuple, list)) else [ref]
-    assert len(outs) == len(refs), f"{len(outs)} outputs vs {len(refs)} refs"
-    for o, r in zip(outs, refs):
-        o_np = o.numpy() if isinstance(o, Tensor) else np.asarray(o)
-        np.testing.assert_allclose(o_np, r, atol=atol, rtol=rtol,
-                                   err_msg=f"forward mismatch for {fn}")
+    for dtype in dtypes:
+        d_atol, d_rtol = _DTYPE_TOLS[dtype]
+        d_atol, d_rtol = max(d_atol, atol), max(d_rtol, rtol)
+        tin = []
+        for a in inputs:
+            arr = np.asarray(a)
+            if np.issubdtype(arr.dtype, np.floating):
+                tin.append(paddle.to_tensor(arr, dtype=dtype))
+            else:
+                tin.append(paddle.to_tensor(arr, dtype=str(arr.dtype)))
+        out = fn(*tin, **kwargs)
+        outs = out if isinstance(out, (tuple, list)) else [out]
+        assert len(outs) == len(refs), \
+            f"{len(outs)} outputs vs {len(refs)} refs"
+        for o, r in zip(outs, refs):
+            o_np = o.numpy() if isinstance(o, Tensor) else np.asarray(o)
+            np.testing.assert_allclose(
+                np.asarray(o_np, np.float64), np.asarray(r, np.float64),
+                atol=d_atol, rtol=d_rtol,
+                err_msg=f"forward mismatch for {fn} in {dtype}")
 
 
 def numeric_grad(fn, inputs, wrt, eps=1e-3, kwargs=None):

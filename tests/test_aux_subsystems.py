@@ -257,3 +257,61 @@ def test_launcher_spawns_and_sets_env(tmp_path):
     assert rc.returncode == 0, rc.stderr
     log = (tmp_path / "log" / "workerlog.0").read_text()
     assert "worker ok" in log
+
+
+# ---------------- sparse + quantization ----------------
+def test_sparse_coo_roundtrip_and_matmul():
+    import paddle_tpu.sparse as sparse
+    indices = [[0, 1, 2], [1, 2, 0]]
+    values = [1.0, 2.0, 3.0]
+    s = sparse.sparse_coo_tensor(indices, values, shape=[3, 3])
+    dense = s.to_dense().numpy()
+    want = np.zeros((3, 3), np.float32)
+    want[0, 1], want[1, 2], want[2, 0] = 1, 2, 3
+    np.testing.assert_allclose(dense, want)
+    assert s.nnz() == 3
+    y = paddle.to_tensor(np.eye(3, dtype=np.float32) * 2)
+    out = sparse.matmul(s, y)
+    np.testing.assert_allclose(out.numpy(), want @ (np.eye(3) * 2))
+    s2 = sparse.add(s, s)
+    np.testing.assert_allclose(s2.to_dense().numpy(), 2 * want)
+
+
+def test_sparse_csr():
+    import paddle_tpu.sparse as sparse
+    s = sparse.sparse_csr_tensor([0, 1, 2, 3], [1, 2, 0], [1.0, 2.0, 3.0],
+                                 shape=[3, 3])
+    want = np.zeros((3, 3), np.float32)
+    want[0, 1], want[1, 2], want[2, 0] = 1, 2, 3
+    np.testing.assert_allclose(s.to_dense().numpy(), want)
+
+
+def test_qat_fake_quant_trains():
+    from paddle_tpu.quantization import QAT, QuantConfig, dequantize, quantize
+    paddle.seed(0)
+    np.random.seed(0)
+    m = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 1))
+    m = QAT(QuantConfig()).quantize(m)
+    opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                parameters=m.parameters())
+    X = np.random.randn(64, 8).astype("float32")
+    Y = (X[:, :1] * 2).astype("float32")
+    xt, yt = paddle.to_tensor(X), paddle.to_tensor(Y)
+    first = None
+    for _ in range(40):
+        loss = F.mse_loss(m(xt), yt)
+        loss.backward()
+        opt.step(); opt.clear_grad()
+        first = first or float(loss.numpy())
+    assert float(loss.numpy()) < first * 0.3  # STE lets QAT train
+    # int8 round-trip keeps values within one quant step
+    x = paddle.to_tensor(np.linspace(-1, 1, 11).astype("float32"))
+    q = quantize(x, 1.0)
+    assert str(q.dtype) == "int8"
+    back = dequantize(q, 1.0)
+    np.testing.assert_allclose(back.numpy(), x.numpy(), atol=1 / 127 + 1e-6)
+
+
+def test_onnx_export_points_to_stablehlo():
+    with pytest.raises(NotImplementedError, match="StableHLO"):
+        paddle.onnx.export(nn.Linear(2, 2), "/tmp/x")

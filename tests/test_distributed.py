@@ -352,3 +352,85 @@ def test_collective_ops_variants():
     dist.reduce_scatter(out, paddle.to_tensor(per_rank),
                         op=dist.ReduceOp.MAX)
     np.testing.assert_allclose(out.numpy().ravel(), np.full(8, 7.0))
+
+
+# ---------------- native TCPStore + watchdog ----------------
+def test_tcp_store_native_roundtrip():
+    from paddle_tpu.distributed.tcp_store import TCPStore
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    master = TCPStore("127.0.0.1", port, is_master=True, world_size=2,
+                      timeout=10)
+    worker = TCPStore("127.0.0.1", port, is_master=False, world_size=2,
+                      timeout=10)
+    master.set("init/addr", b"10.0.0.1:1234")
+    assert worker.get("init/addr") == b"10.0.0.1:1234"
+    assert worker.add("ranks", 1) == 1
+    assert master.add("ranks", 1) == 2
+    assert worker.check("init/addr")
+    assert not worker.check("missing")
+    assert worker.delete_key("init/addr")
+    assert not worker.check("init/addr")
+
+
+def test_tcp_store_blocking_get_across_threads():
+    from paddle_tpu.distributed.tcp_store import TCPStore
+    import socket
+    import threading
+    import time
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    master = TCPStore("127.0.0.1", port, is_master=True, timeout=15)
+    worker = TCPStore("127.0.0.1", port, timeout=15)
+
+    def delayed_set():
+        time.sleep(0.3)
+        master.set("late_key", b"arrived")
+
+    t = threading.Thread(target=delayed_set)
+    t.start()
+    t0 = time.time()
+    assert worker.get("late_key") == b"arrived"  # blocks until set
+    assert time.time() - t0 >= 0.25
+    t.join()
+
+
+def test_tcp_store_barrier_two_processes():
+    """Real multi-process coordination through the native store
+    (reference precedent: test_dist_base spawning trainers)."""
+    import socket
+    import subprocess
+    import sys
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    worker_src = (
+        "import sys\n"
+        "from paddle_tpu.distributed.tcp_store import TCPStore\n"
+        f"st = TCPStore('127.0.0.1', {port}, timeout=20)\n"
+        "st.barrier('b0', 2)\n"
+        "print('worker through barrier')\n")
+    from paddle_tpu.distributed.tcp_store import TCPStore
+    master = TCPStore("127.0.0.1", port, is_master=True, timeout=20)
+    proc = subprocess.Popen([sys.executable, "-c", worker_src],
+                            stdout=subprocess.PIPE, text=True)
+    master.barrier("b0", 2)
+    out, _ = proc.communicate(timeout=60)
+    assert proc.returncode == 0
+    assert "worker through barrier" in out
+
+
+def test_watchdog_trips_and_recovers():
+    from paddle_tpu.distributed.tcp_store import Watchdog
+    import time
+    w = Watchdog(timeout_seconds=0.2)
+    w.beat()
+    assert not w.tripped
+    time.sleep(0.5)
+    assert w.tripped  # no heartbeat → tripped
+    w.beat()
+    assert not w.tripped  # recovered
+    w.stop()

@@ -75,6 +75,8 @@ from .framework.flags import get_flags, set_flags  # noqa: F401,E402
 from . import incubate  # noqa: F401,E402
 from . import vision  # noqa: F401,E402
 from . import static  # noqa: F401,E402
+from . import device  # noqa: F401,E402
+from . import text  # noqa: F401,E402
 from . import onnx  # noqa: F401,E402
 from . import quantization  # noqa: F401,E402
 from . import sparse  # noqa: F401,E402

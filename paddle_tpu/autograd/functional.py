@@ -1,0 +1,102 @@
+"""Functional higher-order autograd: jacobian / hessian / vjp / jvp.
+
+Reference: python/paddle/incubate/autograd/functional.py (paddle.incubate.
+autograd.Jacobian/Hessian) and paddle.autograd.jacobian. TPU-native: the
+user function (eager Tensor code) is staged into a pure array function —
+the op tape records through tracers — and jax.jacrev/jacfwd compute the
+derivative matrices in one compiled program each.
+"""
+from __future__ import annotations
+
+import jax
+
+from ..core.tensor import Tensor
+
+__all__ = ["jacobian", "hessian", "vjp", "jvp"]
+
+
+def _purify(func, n_in):
+    def pure(*arrs):
+        ts = [Tensor(a, stop_gradient=False) for a in arrs]
+        out = func(*ts)
+        if isinstance(out, (tuple, list)):
+            return tuple(o._data for o in out)
+        return out._data
+    return pure
+
+
+def _unpack(xs):
+    single = isinstance(xs, Tensor)
+    xs_list = [xs] if single else list(xs)
+    return single, xs_list, [t._data for t in xs_list]
+
+
+def jacobian(func, xs, create_graph=False, allow_unused=False):
+    """d func / d xs as full matrices (reference:
+    incubate/autograd/functional.py Jacobian). Reverse-mode."""
+    if create_graph:
+        raise NotImplementedError(
+            "jacobian(create_graph=True) is not supported here: the result "
+            "is computed in one staged jax program and is not on the eager "
+            "tape. Chain paddle.grad(..., create_graph=True) for "
+            "differentiable derivatives.")
+    single, xs_list, arrs = _unpack(xs)
+    pure = _purify(func, len(xs_list))
+    jac = jax.jacrev(pure, argnums=tuple(range(len(arrs))))(*arrs)
+    if not isinstance(jac, tuple):
+        jac = (jac,)
+    outs = [Tensor(j, stop_gradient=True) for j in jac]
+    return outs[0] if single else outs
+
+
+def hessian(func, xs, create_graph=False, allow_unused=False):
+    """d² func / d xs² (reference: Hessian). func must return a scalar."""
+    if create_graph:
+        raise NotImplementedError(
+            "hessian(create_graph=True) is not supported here — chain "
+            "paddle.grad(..., create_graph=True) instead.")
+    single, xs_list, arrs = _unpack(xs)
+    pure = _purify(func, len(xs_list))
+    hess = jax.hessian(pure, argnums=tuple(range(len(arrs))))(*arrs)
+    if single:
+        h = hess[0][0] if isinstance(hess, tuple) else hess
+        return Tensor(h, stop_gradient=True)
+    return [[Tensor(hess[i][j], stop_gradient=True)
+             for j in range(len(arrs))] for i in range(len(arrs))]
+
+
+def vjp(func, xs, v=None):
+    """(outputs, vjp_result) (reference: paddle.incubate.autograd.vjp)."""
+    single, xs_list, arrs = _unpack(xs)
+    pure = _purify(func, len(xs_list))
+    out, pullback = jax.vjp(pure, *arrs)
+    if v is None:
+        import jax.numpy as jnp
+        cot = jnp.ones_like(out) if not isinstance(out, tuple) else \
+            tuple(jnp.ones_like(o) for o in out)
+    else:
+        cot = v._data if isinstance(v, Tensor) else \
+            tuple(t._data for t in v)
+    grads = pullback(cot)
+    outs = Tensor(out) if not isinstance(out, tuple) else \
+        tuple(Tensor(o) for o in out)
+    gs = [Tensor(g) for g in grads]
+    return outs, (gs[0] if single else gs)
+
+
+def jvp(func, xs, v=None):
+    """(outputs, jvp_result) — forward mode (reference: jvp)."""
+    import jax.numpy as jnp
+    single, xs_list, arrs = _unpack(xs)
+    pure = _purify(func, len(xs_list))
+    if v is None:
+        tangents = tuple(jnp.ones_like(a) for a in arrs)
+    else:
+        vs = [v] if isinstance(v, Tensor) else list(v)
+        tangents = tuple(t._data for t in vs)
+    out, tangent_out = jax.jvp(pure, tuple(arrs), tangents)
+    outs = Tensor(out) if not isinstance(out, tuple) else \
+        tuple(Tensor(o) for o in out)
+    touts = Tensor(tangent_out) if not isinstance(tangent_out, tuple) else \
+        tuple(Tensor(t) for t in tangent_out)
+    return outs, touts

@@ -16,6 +16,7 @@
 // (paddle_tpu/distributed/tcp_store.py).
 
 #include <arpa/inet.h>
+#include <netdb.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <sys/socket.h>
@@ -158,6 +159,16 @@ void serve_client(Server* s, int fd) {
       break;
     }
   }
+  {
+    // deregister before closing so server_stop never shuts down a reused fd
+    std::lock_guard<std::mutex> lk(s->fds_mu);
+    for (auto it = s->client_fds.begin(); it != s->client_fds.end(); ++it) {
+      if (*it == fd) {
+        s->client_fds.erase(it);
+        break;
+      }
+    }
+  }
   ::close(fd);
 }
 
@@ -227,8 +238,18 @@ void* pd_store_client_connect(const char* host, int port, int timeout_ms) {
   addr.sin_family = AF_INET;
   addr.sin_port = htons(static_cast<uint16_t>(port));
   if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
-    ::close(fd);
-    return nullptr;
+    // not an IPv4 literal: resolve the hostname (reference tcp_utils.cc
+    // resolves via getaddrinfo too)
+    addrinfo hints{};
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    addrinfo* res = nullptr;
+    if (::getaddrinfo(host, nullptr, &hints, &res) != 0 || res == nullptr) {
+      ::close(fd);
+      return nullptr;
+    }
+    addr.sin_addr = reinterpret_cast<sockaddr_in*>(res->ai_addr)->sin_addr;
+    ::freeaddrinfo(res);
   }
   // retry-connect loop (master may start slightly later — reference
   // tcp_utils.cc connect-with-retry behavior)

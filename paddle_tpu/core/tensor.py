@@ -185,8 +185,8 @@ class Tensor:
         dtype = kwargs.get("dtype")
         device = kwargs.get("device")
         for a in args:
-            if isinstance(a, str) and (a in ("cpu", "tpu", "gpu")
-                                       or ":" in a):
+            if isinstance(a, str) and (a in ("cpu", "tpu", "gpu", "cuda",
+                                             "xla") or ":" in a):
                 device = a
             else:
                 dtype = a

@@ -104,6 +104,11 @@ class TCPStore:
         buf = ctypes.create_string_buffer(cap)
         n = self._lib.pd_store_get(self._client, key.encode(),
                                    self._timeout_ms, buf, cap)
+        if n == -3:  # value larger than the fast-path buffer: retry at the
+            cap = 64 << 20  # server's max accepted value size
+            buf = ctypes.create_string_buffer(cap)
+            n = self._lib.pd_store_get(self._client, key.encode(),
+                                       self._timeout_ms, buf, cap)
         if n == -1:
             raise RuntimeError(
                 f"TCPStore.get({key!r}) timed out after "

@@ -1,0 +1,82 @@
+"""paddle_tpu.text (reference: python/paddle/text — datasets + viterbi_decode).
+
+The dataset downloads need network egress (unavailable); the compute op
+(viterbi_decode) is implemented TPU-natively with lax.scan.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+from .. import nn
+
+__all__ = ["viterbi_decode", "ViterbiDecoder"]
+
+
+def viterbi_decode(potentials, transition_params, lengths=None,
+                   include_bos_eos_tag=True, name=None):
+    """CRF Viterbi decoding (reference: paddle.text.viterbi_decode →
+    phi viterbi_decode kernel). potentials: [B, T, N] emission scores;
+    transition_params: [N, N]. Returns (scores [B], paths [B, T]).
+
+    TPU-native: the per-step max-product recurrence is a lax.scan (compiled
+    control flow); backtracking is a reverse scan over the argmax pointers.
+    Variable-length batches (`lengths`) are not yet supported — pad-free
+    inputs only (loud error instead of silently wrong scores).
+    """
+    if lengths is not None:
+        raise NotImplementedError(
+            "viterbi_decode(lengths=...) is not supported yet; decode "
+            "unpadded sequences (or split the batch by length)")
+
+    def fwd(emis, trans):
+        b, t, n = emis.shape
+        ef = emis.astype(jnp.float32)
+        tf = trans.astype(jnp.float32)
+
+        def step(alpha, emit_t):
+            # scores[b, i, j] = alpha[b, i] + trans[i, j] + emit[b, j]
+            scores = alpha[:, :, None] + tf[None] + emit_t[:, None, :]
+            best_prev = jnp.argmax(scores, axis=1)          # [B, N]
+            alpha_new = jnp.max(scores, axis=1)
+            return alpha_new, best_prev
+
+        alpha0 = ef[:, 0]
+        alpha, pointers = jax.lax.scan(step, alpha0,
+                                       jnp.swapaxes(ef[:, 1:], 0, 1))
+        # pointers: [T-1, B, N]
+        last_tag = jnp.argmax(alpha, axis=-1)               # [B]
+        score = jnp.max(alpha, axis=-1)
+
+        def back(tag, ptr_t):
+            prev = jnp.take_along_axis(ptr_t, tag[:, None], axis=1)[:, 0]
+            return prev, tag
+
+        if t > 1:
+            first_tag, tags_rev = jax.lax.scan(back, last_tag, pointers,
+                                               reverse=True)
+            path = jnp.concatenate([first_tag[None], tags_rev], axis=0)
+        else:
+            path = last_tag[None]
+        return score, (jnp.swapaxes(path, 0, 1).astype(jnp.int64),)
+
+    out = apply("viterbi_decode", fwd, [potentials, transition_params],
+                has_aux=True)
+    score, path = out
+    return score, path
+
+
+class ViterbiDecoder(nn.Layer):
+    """Reference: paddle.text.ViterbiDecoder."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        super().__init__()
+        self.transitions = transitions if isinstance(transitions, Tensor) \
+            else Tensor(transitions)
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths=None):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

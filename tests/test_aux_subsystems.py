@@ -315,3 +315,36 @@ def test_qat_fake_quant_trains():
 def test_onnx_export_points_to_stablehlo():
     with pytest.raises(NotImplementedError, match="StableHLO"):
         paddle.onnx.export(nn.Linear(2, 2), "/tmp/x")
+
+
+def test_device_namespace_and_memory_stats():
+    stats = paddle.device.memory_stats()
+    assert isinstance(stats, dict)
+    paddle.device.synchronize()
+    s = paddle.device.cuda.Stream()
+    s.synchronize()
+    assert paddle.device.cuda.device_count() == 8
+    props = paddle.device.cuda.get_device_properties()
+    assert "platform" in props
+
+
+def test_viterbi_decode_matches_bruteforce():
+    import itertools
+    from paddle_tpu.text import ViterbiDecoder
+    rng = np.random.RandomState(0)
+    B, T, N = 2, 4, 3
+    emis = rng.randn(B, T, N).astype(np.float32)
+    trans = rng.randn(N, N).astype(np.float32)
+    dec = ViterbiDecoder(paddle.to_tensor(trans))
+    score, path = dec(paddle.to_tensor(emis))
+    # brute force over all tag sequences
+    for b in range(B):
+        best, best_path = -1e30, None
+        for seq in itertools.product(range(N), repeat=T):
+            sc = emis[b, 0, seq[0]] + sum(
+                trans[seq[i - 1], seq[i]] + emis[b, i, seq[i]]
+                for i in range(1, T))
+            if sc > best:
+                best, best_path = sc, seq
+        np.testing.assert_allclose(float(score.numpy()[b]), best, rtol=1e-5)
+        assert tuple(path.numpy()[b]) == best_path

@@ -114,3 +114,37 @@ def test_grad_no_grad_vars():
     y = x * w
     (dx,) = paddle.grad(y, x, no_grad_vars=[w])
     np.testing.assert_allclose(dx.numpy(), 3.0)
+
+
+def test_jacobian_and_hessian():
+    from paddle_tpu.autograd import hessian, jacobian
+    x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32),
+                         stop_gradient=False)
+
+    def f(a):
+        return (a * a).sum()
+
+    h = hessian(f, x)
+    np.testing.assert_allclose(h.numpy(), 2 * np.eye(3), rtol=1e-5)
+
+    def g(a):
+        return a * a  # vector → vector
+
+    j = jacobian(g, x)
+    np.testing.assert_allclose(j.numpy(), np.diag([2.0, 4.0, 6.0]),
+                               rtol=1e-5)
+
+
+def test_vjp_jvp():
+    from paddle_tpu.autograd import jvp, vjp
+    x = paddle.to_tensor(np.array([1.0, 2.0], np.float32),
+                         stop_gradient=False)
+
+    def f(a):
+        return (a ** 3).sum()
+
+    out, g = vjp(f, x)
+    np.testing.assert_allclose(g.numpy(), 3 * np.array([1.0, 4.0]),
+                               rtol=1e-5)
+    out, t = jvp(f, x, paddle.to_tensor(np.array([1.0, 0.0], np.float32)))
+    np.testing.assert_allclose(t.numpy(), 3.0, rtol=1e-5)

@@ -30,6 +30,7 @@ class GradScaler:
         self._bad_steps = 0
         self._found_inf = False
         self._unscaled = False
+        self._stepped = False
 
     def scale(self, var):
         if not self._enable:
@@ -54,22 +55,31 @@ class GradScaler:
         self._unscaled = True
 
     def step(self, optimizer):
-        """unscale + skip-on-inf + optimizer.step
-        (reference: GradScaler.step/minimize)."""
+        """unscale + skip-on-inf + optimizer.step. Like the reference
+        (python/paddle/amp/grad_scaler.py), step() does NOT update the loss
+        scale — the canonical pattern is ``scaler.step(opt);
+        scaler.update()``; use minimize() for the fused form."""
         if not self._enable:
             optimizer.step()
             return
+        if self._stepped:
+            raise RuntimeError(
+                "GradScaler.step() has already been called since the last "
+                "update(); call scaler.update() first.")
         self.unscale_(optimizer)
         if not self._found_inf:
             optimizer.step()
-        self.update()
+        self._stepped = True
 
     def minimize(self, optimizer, scaled_loss):
         self.step(optimizer)
+        self.update()
 
     def update(self):
         if not (self._enable and self._dynamic):
+            self._found_inf = False
             self._unscaled = False
+            self._stepped = False
             return
         if self._found_inf:
             self._bad_steps += 1
@@ -85,6 +95,7 @@ class GradScaler:
                 self._good_steps = 0
         self._found_inf = False
         self._unscaled = False
+        self._stepped = False
 
     def is_enable(self):
         return self._enable

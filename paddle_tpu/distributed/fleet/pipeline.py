@@ -185,6 +185,7 @@ class PipelineParallel(Layer):
             losses.append(loss)
         if scaler is not None:
             scaler.step(optimizer)
+            scaler.update()
         else:
             optimizer.step()
         optimizer.clear_grad()

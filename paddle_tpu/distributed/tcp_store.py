@@ -32,13 +32,21 @@ def _load_lib():
         src = os.path.join(_native_dir(), "tcp_store.cpp")
         build_dir = os.path.join(_native_dir(), "build")
         os.makedirs(build_dir, exist_ok=True)
-        so = os.path.join(build_dir, "libpd_tcp_store.so")
-        if not os.path.exists(so) or \
-                os.path.getmtime(so) < os.path.getmtime(src):
+        # Key the build artifact on the source content hash (mtimes are
+        # meaningless after a fresh clone), so the reviewed .cpp is always
+        # what gets dlopen'ed.
+        import hashlib
+        with open(src, "rb") as f:
+            digest = hashlib.sha256(f.read()).hexdigest()[:16]
+        so = os.path.join(build_dir, f"libpd_tcp_store-{digest}.so")
+        if not os.path.exists(so):
+            # per-process tmp name: ranks of a multi-process launch may all
+            # hit the cold-build path at once, and os.replace is atomic
+            tmp = f"{so}.{os.getpid()}.tmp"
             cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
-                   "-pthread", src, "-o", so + ".tmp"]
+                   "-pthread", src, "-o", tmp]
             subprocess.run(cmd, check=True, capture_output=True)
-            os.replace(so + ".tmp", so)
+            os.replace(tmp, so)
         lib = ctypes.CDLL(so)
         lib.pd_store_server_start.restype = ctypes.c_void_p
         lib.pd_store_server_start.argtypes = [ctypes.c_int]

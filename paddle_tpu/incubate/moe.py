@@ -99,9 +99,13 @@ class MoELayer(nn.Layer):
                 gates_k.append((remaining * onehot).sum(-1))  # [T]
                 masks.append(onehot)
                 remaining = remaining * (1 - onehot)
-            # renormalize the k gate values
-            denom = sum(gates_k) + 1e-9
-            gates_k = [g / denom for g in gates_k]
+            if top_k > 1:
+                # renormalize the k gate values (GShard)
+                denom = sum(gates_k) + 1e-9
+                gates_k = [g / denom for g in gates_k]
+            # top_k == 1 keeps the raw top-1 probability as the combine
+            # weight (reference switch_gate.py) so the gate gets gradient
+            # through the expert output, not only the aux loss.
 
             pos_base = jnp.zeros((E,), jnp.float32)
             for onehot, gval in zip(masks, gates_k):

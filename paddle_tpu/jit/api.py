@@ -75,6 +75,16 @@ def _tree_rebuild(skel, arrays, wrap):
     return skel[1]
 
 
+def _amp_key():
+    """AMP autocast decisions are baked in at trace time, so the compile
+    cache must be keyed on the active auto_cast state (ADVICE r2) —
+    including custom white/black op lists, which also steer amp_dtype_for."""
+    from ..amp.auto_cast import amp_state
+    st = amp_state()
+    return (st.enabled, str(st.dtype), st.level,
+            frozenset(st.white), frozenset(st.black))
+
+
 def _static_key(skel, tensors, extra):
     shapes = tuple((tuple(t.shape), str(t.dtype)) for t in tensors)
 
@@ -167,7 +177,8 @@ class StaticFunction:
         skel = _tree_flatten((args, tuple(sorted(kwargs.items()))),
                              arg_tensors, [])
         training = tuple(layer.training for layer in layers)
-        key_extra = ("fwd", len(params), len(buffers), training)
+        key_extra = ("fwd", len(params), len(buffers), training,
+                     _amp_key())
         cache_key = _static_key(skel, params + buffers + arg_tensors,
                                 key_extra)
         entry = self._cache.get(cache_key)
@@ -244,7 +255,8 @@ class StaticFunction:
                              arg_tensors, [])
         training = tuple(layer.training for layer in layers)
         # lr is a traced input (scalar array), so it is NOT part of the key
-        key_extra = ("step", len(params), len(buffers), len(slots), training)
+        key_extra = ("step", len(params), len(buffers), len(slots),
+                     training, _amp_key())
         cache_key = _static_key(skel, params + buffers + arg_tensors,
                                 key_extra)
         entry = self._cache.get(cache_key)

@@ -17,12 +17,34 @@ import math
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
-NEG_INF = -1e30
+# np.float32 constants: paddle_tpu enables jax_enable_x64, and a bare python
+# float inside the kernel materializes as an f64 constant that Mosaic cannot
+# legalize (tpu.truncf f64->f32).
+NEG_INF = np.float32(-1e30)
+
+
+def _no_x64():
+    """Mosaic cannot legalize the i64 index arithmetic jax_enable_x64
+    produces (even a trivial kernel fails func.return legalization), so every
+    pallas_call traces under an x64-disabled scope. Inputs/outputs are
+    explicit f32/bf16 arrays, so results are unaffected."""
+    return jax.enable_x64(False)
+# Mosaic requires the minor (lane) dim of every VMEM block to be 128-aligned
+# or equal to the array dim, so per-row stats (m/l/lse/delta) are carried
+# replicated across 128 lanes (same convention as
+# jax/experimental/pallas/ops/tpu/flash_attention.py MIN_BLOCK_SIZE).
+LANES = 128
+
+
+def _rows(x, block_q):
+    """Broadcast a [BQ] row-stat to the lane-replicated [BQ, LANES] layout."""
+    return jax.lax.broadcast_in_dim(x, (block_q, LANES), (0,))
 
 
 # ---------------- forward ----------------
@@ -53,18 +75,18 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
             if causal:
                 valid = valid & (kpos <= qpos)
             s = jnp.where(valid, s, NEG_INF)
-        m_prev = m_scr[:, 0]                       # [BQ]
-        m_new = jnp.maximum(m_prev, s.max(axis=1))
-        p = jnp.exp(s - m_new[:, None])            # [BQ, BK]
-        corr = jnp.exp(m_prev - m_new)             # [BQ]
-        l_new = corr * l_scr[:, 0] + p.sum(axis=1)
+        m_prev = m_scr[:]                          # [BQ, LANES]
+        m_new = jnp.maximum(m_prev, _rows(s.max(axis=1), block_q))
+        p = jnp.exp(s - m_new[:, :1])              # [BQ, BK]
+        corr = jnp.exp(m_prev - m_new)             # [BQ, LANES]
+        l_new = corr * l_scr[:] + _rows(p.sum(axis=1), block_q)
         v = v_ref[0].astype(jnp.float32)           # [BK, D]
         pv = jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)    # [BQ, D]
-        acc_scr[:] = corr[:, None] * acc_scr[:] + pv
-        m_scr[:] = m_new[:, None]
-        l_scr[:] = l_new[:, None]
+        acc_scr[:] = corr[:, :1] * acc_scr[:] + pv
+        m_scr[:] = m_new
+        l_scr[:] = l_new
 
     if causal:
         # skip fully-masked key blocks (they lie strictly above the diagonal)
@@ -76,9 +98,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
 
     @pl.when(kb == nk - 1)
     def _final():
-        l = jnp.maximum(l_scr[:, 0], 1e-30)
-        o_ref[0] = (acc_scr[:] / l[:, None]).astype(o_ref.dtype)
-        lse_ref[0] = (m_scr[:, 0] + jnp.log(l)).astype(jnp.float32)
+        l = jnp.maximum(l_scr[:], np.float32(1e-30))  # [BQ, LANES]
+        o_ref[0] = (acc_scr[:] / l[:, :1]).astype(o_ref.dtype)
+        lse_ref[0] = (m_scr[:] + jnp.log(l)).astype(jnp.float32)
 
 
 def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret,
@@ -87,31 +109,33 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret,
     sk = k.shape[1]
     kv_len = kv_len if kv_len is not None else sk
     nq, nk = pl.cdiv(sq, block_q), pl.cdiv(sk, block_k)
-    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+    kernel = functools.partial(_fwd_kernel, scale=np.float32(scale), causal=causal,
                                block_q=block_q, block_k=block_k,
                                kv_len=kv_len)
     out_shapes = (jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
-                  jax.ShapeDtypeStruct((bh, sq), jnp.float32))
-    o, lse = pl.pallas_call(
-        kernel,
-        grid=(bh, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, kb: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, kb: (b, kb, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, kb: (b, kb, 0)),
-        ],
-        out_specs=(
-            pl.BlockSpec((1, block_q, d), lambda b, i, kb: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i, kb: (b, i)),
-        ),
-        scratch_shapes=[
-            pltpu.VMEM((block_q, 1), jnp.float32),
-            pltpu.VMEM((block_q, 1), jnp.float32),
-            pltpu.VMEM((block_q, d), jnp.float32),
-        ],
-        out_shape=out_shapes,
-        interpret=interpret,
-    )(q, k, v)
+                  jax.ShapeDtypeStruct((bh, sq, LANES), jnp.float32))
+    with _no_x64():
+        o, lse = pl.pallas_call(
+            kernel,
+            grid=(bh, nq, nk),
+            in_specs=[
+                pl.BlockSpec((1, block_q, d), lambda b, i, kb: (b, i, 0)),
+                pl.BlockSpec((1, block_k, d), lambda b, i, kb: (b, kb, 0)),
+                pl.BlockSpec((1, block_k, d), lambda b, i, kb: (b, kb, 0)),
+            ],
+            out_specs=(
+                pl.BlockSpec((1, block_q, d), lambda b, i, kb: (b, i, 0)),
+                pl.BlockSpec((1, block_q, LANES),
+                             lambda b, i, kb: (b, i, 0)),
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((block_q, LANES), jnp.float32),
+                pltpu.VMEM((block_q, LANES), jnp.float32),
+                pltpu.VMEM((block_q, d), jnp.float32),
+            ],
+            out_shape=out_shapes,
+            interpret=interpret,
+        )(q, k, v)
     return o, lse
 
 
@@ -131,8 +155,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0]                           # [BQ]
-        delta = delta_ref[0]                       # [BQ]
+        lse = lse_ref[0]                           # [BQ, LANES]
+        delta = delta_ref[0]                       # [BQ, LANES]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
@@ -145,11 +169,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             if causal:
                 valid = valid & (kpos <= qpos)
             s = jnp.where(valid, s, NEG_INF)
-        p = jnp.exp(s - lse[:, None])              # [BQ, BK]
+        p = jnp.exp(s - lse[:, :1])                # [BQ, BK]
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)    # [BQ, BK]
-        ds = p * (dp - delta[:, None]) * scale
+        ds = p * (dp - delta[:, :1]) * scale
         dq_scr[:] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -183,8 +207,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         k = k_ref[0].astype(jnp.float32)           # [BK, D]
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0]
-        delta = delta_ref[0]
+        lse = lse_ref[0]                           # [BQ, LANES]
+        delta = delta_ref[0]                       # [BQ, LANES]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # [BQ, BK]
@@ -197,14 +221,14 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             if causal:
                 valid = valid & (kpos <= qpos)
             s = jnp.where(valid, s, NEG_INF)
-        p = jnp.exp(s - lse[:, None])              # [BQ, BK]
+        p = jnp.exp(s - lse[:, :1])                # [BQ, BK]
         dv_scr[:] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)    # [BK, D]
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)    # [BQ, BK]
-        ds = p * (dp - delta[:, None]) * scale
+        ds = p * (dp - delta[:, :1]) * scale
         dk_scr[:] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)    # [BK, D]
@@ -228,50 +252,61 @@ def _flash_bwd(res, g, scale, causal, block_q, block_k, interpret, kv_len):
     bh, sq, d = q.shape
     sk = k.shape[1]
     do = g
+    lse = jnp.broadcast_to(lse, (bh, sq, LANES))  # residual keeps one lane
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1)  # [BH, SQ]
+    delta = jnp.broadcast_to(delta[..., None], (bh, sq, LANES))
     nq, nk = pl.cdiv(sq, block_q), pl.cdiv(sk, block_k)
 
-    dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k, kv_len=kv_len),
-        grid=(bh, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, kb: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, kb: (b, kb, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, kb: (b, kb, 0)),
-            pl.BlockSpec((1, block_q, d), lambda b, i, kb: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i, kb: (b, i)),
-            pl.BlockSpec((1, block_q), lambda b, i, kb: (b, i)),
-        ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, kb: (b, i, 0)),
-        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
-        interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    with _no_x64():
+        dq = pl.pallas_call(
+            functools.partial(_bwd_dq_kernel, scale=np.float32(scale),
+                              causal=causal, block_q=block_q,
+                              block_k=block_k, kv_len=kv_len),
+            grid=(bh, nq, nk),
+            in_specs=[
+                pl.BlockSpec((1, block_q, d), lambda b, i, kb: (b, i, 0)),
+                pl.BlockSpec((1, block_k, d), lambda b, i, kb: (b, kb, 0)),
+                pl.BlockSpec((1, block_k, d), lambda b, i, kb: (b, kb, 0)),
+                pl.BlockSpec((1, block_q, d), lambda b, i, kb: (b, i, 0)),
+                pl.BlockSpec((1, block_q, LANES),
+                             lambda b, i, kb: (b, i, 0)),
+                pl.BlockSpec((1, block_q, LANES),
+                             lambda b, i, kb: (b, i, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, block_q, d),
+                                   lambda b, i, kb: (b, i, 0)),
+            scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+            out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+            interpret=interpret,
+        )(q, k, v, do, lse, delta)
 
-    dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k, kv_len=kv_len),
-        grid=(bh, nk, nq),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, kb, i: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, kb, i: (b, kb, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, kb, i: (b, kb, 0)),
-            pl.BlockSpec((1, block_q, d), lambda b, kb, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, kb, i: (b, i)),
-            pl.BlockSpec((1, block_q), lambda b, kb, i: (b, i)),
-        ],
-        out_specs=(
-            pl.BlockSpec((1, block_k, d), lambda b, kb, i: (b, kb, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, kb, i: (b, kb, 0)),
-        ),
-        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
-                        pltpu.VMEM((block_k, d), jnp.float32)],
-        out_shape=(jax.ShapeDtypeStruct(k.shape, k.dtype),
-                   jax.ShapeDtypeStruct(v.shape, v.dtype)),
-        interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    with _no_x64():
+        dk, dv = pl.pallas_call(
+            functools.partial(_bwd_dkv_kernel, scale=np.float32(scale),
+                              causal=causal, block_q=block_q,
+                              block_k=block_k, kv_len=kv_len),
+            grid=(bh, nk, nq),
+            in_specs=[
+                pl.BlockSpec((1, block_q, d), lambda b, kb, i: (b, i, 0)),
+                pl.BlockSpec((1, block_k, d), lambda b, kb, i: (b, kb, 0)),
+                pl.BlockSpec((1, block_k, d), lambda b, kb, i: (b, kb, 0)),
+                pl.BlockSpec((1, block_q, d), lambda b, kb, i: (b, i, 0)),
+                pl.BlockSpec((1, block_q, LANES),
+                             lambda b, kb, i: (b, i, 0)),
+                pl.BlockSpec((1, block_q, LANES),
+                             lambda b, kb, i: (b, i, 0)),
+            ],
+            out_specs=(
+                pl.BlockSpec((1, block_k, d), lambda b, kb, i: (b, kb, 0)),
+                pl.BlockSpec((1, block_k, d), lambda b, kb, i: (b, kb, 0)),
+            ),
+            scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                            pltpu.VMEM((block_k, d), jnp.float32)],
+            out_shape=(jax.ShapeDtypeStruct(k.shape, k.dtype),
+                       jax.ShapeDtypeStruct(v.shape, v.dtype)),
+            interpret=interpret,
+        )(q, k, v, do, lse, delta)
     return dq, dk, dv
 
 
@@ -286,7 +321,9 @@ def _flash_attention_bhsd(q, k, v, scale, causal, blocks, interpret):
 def _fa_fwd(q, k, v, scale, causal, blocks, interpret):
     o, lse = _flash_fwd(q, k, v, scale, causal, blocks[0], blocks[1],
                         interpret, kv_len=blocks[2])
-    return o, (q, k, v, o, lse)
+    # only lane 0 is meaningful — keep one lane in the fwd->bwd residual
+    # (128x less HBM held across the backward) and re-broadcast in _flash_bwd
+    return o, (q, k, v, o, lse[..., :1])
 
 
 def _fa_bwd(scale, causal, blocks, interpret, res, g):

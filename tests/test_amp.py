@@ -108,6 +108,7 @@ def test_grad_scaler_skips_on_inf_and_backs_off():
     scaler.scale(loss).backward()
     scaler.step(opt)
     np.testing.assert_allclose(p.numpy(), [1.0])  # step skipped
+    scaler.update()  # reference pattern: step() then update()
     assert scaler._scale == 64.0  # backed off
 
 

@@ -15,6 +15,7 @@ from .mp_layers import (  # noqa: F401
 from .pipeline import (  # noqa: F401
     LayerDesc, PipelineLayer, PipelineParallel, SharedLayerDesc,
 )
+from .pipeline_compiled import CompiledPipelineParallel  # noqa: F401
 from .recompute import recompute, recompute_sequential  # noqa: F401
 from . import sequence_parallel_utils  # noqa: F401
 from .sharding import DygraphShardingOptimizer, group_sharded_parallel  # noqa: F401

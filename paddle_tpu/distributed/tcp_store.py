@@ -40,6 +40,14 @@ def _load_lib():
             digest = hashlib.sha256(f.read()).hexdigest()[:16]
         so = os.path.join(build_dir, f"libpd_tcp_store-{digest}.so")
         if not os.path.exists(so):
+            # drop stale digests so build/ stays bounded across revisions
+            import glob
+            for old in glob.glob(
+                    os.path.join(build_dir, "libpd_tcp_store-*.so")):
+                try:
+                    os.unlink(old)
+                except OSError:
+                    pass
             # per-process tmp name: ranks of a multi-process launch may all
             # hit the cold-build path at once, and os.replace is atomic
             tmp = f"{so}.{os.getpid()}.tmp"

@@ -198,6 +198,75 @@ def bench_gpt(peak):
     return mfu, t, tokens / t, n_params
 
 
+def bench_fused_adamw():
+    """Pallas fused AdamW vs the jnp composition, 8M-param update
+    (reference capability: fused_adam_kernel.cu)."""
+    from paddle_tpu.ops.pallas.fused_adamw import fused_adamw
+
+    n, chain = 8 * 1024 * 1024, 10
+    rng = np.random.RandomState(0)
+    w = jnp.asarray(rng.randn(n), jnp.float32)
+    g = jnp.asarray(rng.randn(n), jnp.float32)
+    m = jnp.zeros(n, jnp.float32)
+    v = jnp.zeros(n, jnp.float32)
+    args = (1e-3, 0.9, 0.999, 1e-8, 0.01, 1.0 / (1 - 0.9),
+            1.0 / (1 - 0.999))
+
+    @jax.jit
+    def run_fused(w, g, m, v):
+        def body(i, c):
+            w, m, v = c
+            return fused_adamw(w, g, m, v, *args)
+        return jax.lax.fori_loop(0, chain, body, (w, m, v))
+
+    def jnp_update(w, g, m, v):
+        lr, b1, b2, eps, wd, bc1, bc2 = args
+        w = w * (1 - lr * wd)
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        return w - lr * (m * bc1) / (jnp.sqrt(v * bc2) + eps), m, v
+
+    @jax.jit
+    def run_jnp(w, g, m, v):
+        def body(i, c):
+            w, m, v = c
+            return jnp_update(w, g, m, v)
+        return jax.lax.fori_loop(0, chain, body, (w, m, v))
+
+    t_fused = _timeit(lambda: run_fused(w, g, m, v)[0], 5) / chain
+    t_jnp = _timeit(lambda: run_jnp(w, g, m, v)[0], 5) / chain
+    return t_fused * 1e3, t_jnp * 1e3
+
+
+def bench_rms_norm():
+    """Pallas fused RMSNorm vs the jnp composition, [4096, 4096] bf16."""
+    from paddle_tpu.ops.pallas.rms_norm import rms_norm
+
+    chain = 10
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(4096, 4096), jnp.bfloat16)
+    w = jnp.asarray(rng.randn(4096), jnp.float32)
+
+    @jax.jit
+    def run_pallas(x):
+        def body(i, x):
+            return rms_norm(x, w).astype(x.dtype)
+        return jax.lax.fori_loop(0, chain, body, x)
+
+    @jax.jit
+    def run_jnp(x):
+        def body(i, x):
+            xf = x.astype(jnp.float32)
+            inv = jax.lax.rsqrt(jnp.mean(xf * xf, -1, keepdims=True)
+                                + 1e-6)
+            return (xf * inv * w).astype(x.dtype)
+        return jax.lax.fori_loop(0, chain, body, x)
+
+    t_pallas = _timeit(lambda: run_pallas(x), 5) / chain
+    t_jnp = _timeit(lambda: run_jnp(x), 5) / chain
+    return t_pallas * 1e3, t_jnp * 1e3
+
+
 def _log(msg):
     print(msg, file=sys.stderr, flush=True)
 
@@ -231,6 +300,20 @@ def main():
         sub["lenet_train_steps_per_sec"] = round(lenet_sps, 1)
         _save_snapshot(snap)
         _log(f"[bench] lenet done: {lenet_sps:.1f} steps/s")
+
+        fa_ms, fa_jnp_ms = bench_fused_adamw()
+        sub["fused_adamw_pallas_ms"] = round(fa_ms, 3)
+        sub["fused_adamw_jnp_ms"] = round(fa_jnp_ms, 3)
+        _save_snapshot(snap)
+        _log(f"[bench] fused adamw: pallas {fa_ms:.3f}ms vs jnp "
+             f"{fa_jnp_ms:.3f}ms")
+
+        rn_ms, rn_jnp_ms = bench_rms_norm()
+        sub["rms_norm_pallas_ms"] = round(rn_ms, 3)
+        sub["rms_norm_jnp_ms"] = round(rn_jnp_ms, 3)
+        _save_snapshot(snap)
+        _log(f"[bench] rms norm: pallas {rn_ms:.3f}ms vs jnp "
+             f"{rn_jnp_ms:.3f}ms")
 
         gpt_mfu, gpt_t, tok_s, n_params = bench_gpt(peak)
         sub["gpt_step_ms"] = round(gpt_t * 1e3, 2)

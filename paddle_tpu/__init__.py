@@ -33,9 +33,11 @@ from .ops import einsum, one_hot  # noqa: F401
 
 from . import amp  # noqa: F401
 from . import autograd  # noqa: F401
+from . import fft  # noqa: F401
 from . import framework  # noqa: F401
 from . import io  # noqa: F401
 from . import jit  # noqa: F401
+from . import linalg  # noqa: F401
 from . import nn  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import regularizer  # noqa: F401

@@ -15,13 +15,15 @@ import jax
 import jax.numpy as jnp
 
 from . import autograd
-from .dtype import is_floating
+from .dtype import is_complex, is_floating
 
 
 def _is_diff(t) -> bool:
+    # complex counts: fft/complex-op chains carry gradients in the
+    # reference (jax.vjp handles the conjugate conventions)
     from .tensor import Tensor
     return (isinstance(t, Tensor) and not t.stop_gradient
-            and is_floating(t.dtype))
+            and (is_floating(t.dtype) or is_complex(t.dtype)))
 
 
 def _unwrap(t):

@@ -365,6 +365,7 @@ struct Watchdog {
   std::atomic<int64_t> last_beat_ms{0};
   std::atomic<bool> tripped{false};
   std::atomic<bool> running{true};
+  bool abort_on_trip{false};
   int64_t timeout_ms;
   std::thread th;
 };
@@ -375,18 +376,37 @@ static int64_t now_ms() {
       .count();
 }
 
-void* pd_watchdog_start(int64_t timeout_ms) {
+// abort_on_trip: a collective hung past the timeout cannot be unwound from
+// Python (the controller thread is blocked inside the runtime), so the
+// watchdog thread kills the process — the launcher's restart loop plus
+// checkpoint-resume is the recovery path (reference: comm_task_manager.cc
+// aborts comms and tears down, nccl_comm_task.cc:233).
+void* pd_watchdog_start2(int64_t timeout_ms, int abort_on_trip) {
   auto* w = new Watchdog();
   w->timeout_ms = timeout_ms;
+  w->abort_on_trip = abort_on_trip != 0;
   w->last_beat_ms = now_ms();
   w->th = std::thread([w] {
     while (w->running.load()) {
       std::this_thread::sleep_for(std::chrono::milliseconds(50));
-      if (now_ms() - w->last_beat_ms.load() > w->timeout_ms)
+      if (now_ms() - w->last_beat_ms.load() > w->timeout_ms) {
         w->tripped = true;
+        if (w->abort_on_trip) {
+          fprintf(stderr,
+                  "[pd_watchdog] no heartbeat within %lld ms - collective "
+                  "presumed hung, aborting process\n",
+                  (long long)w->timeout_ms);
+          fflush(stderr);
+          _exit(17);
+        }
+      }
     }
   });
   return w;
+}
+
+void* pd_watchdog_start(int64_t timeout_ms) {
+  return pd_watchdog_start2(timeout_ms, 0);
 }
 
 void pd_watchdog_beat(void* handle) {

@@ -20,6 +20,9 @@ from .env import (  # noqa: F401
 )
 from .parallel import DataParallel, shard_batch  # noqa: F401
 from .tcp_store import TCPStore, Watchdog  # noqa: F401
+from .watchdog import (  # noqa: F401
+    start_step_watchdog, stop_step_watchdog, get_step_watchdog,
+)
 from .topology import (  # noqa: F401
     CommunicateTopology, HybridCommunicateGroup, build_mesh,
     get_hybrid_communicate_group,

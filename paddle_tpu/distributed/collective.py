@@ -101,7 +101,20 @@ def _as_group(group):
 
 
 def _placed(arr, group):
-    """Commit the array onto the group mesh, leading axis sharded."""
+    """Commit the array onto the group mesh, leading axis sharded.
+
+    Single-controller only: this device_puts a host-global array, which is
+    impossible when ranks are separate processes (each process holds only
+    its addressable shard). Fail loudly rather than corrupt data —
+    multi-process eager collectives go through jit-compiled paths instead
+    (reference boundary: process_group_nccl.cc assumes per-rank tensors)."""
+    if jax.process_count() > 1:
+        raise NotImplementedError(
+            "eager paddle.distributed collectives are single-controller "
+            "only (they place host-global arrays); under multi-process "
+            "jax.distributed, run collectives inside compiled code — "
+            "jit/shard_map with lax.psum/all_gather, or a to_static train "
+            "step, as tests/workers/dp_worker.py does")
     spec = P(group.axis, *([None] * (arr.ndim - 1)))
     return jax.device_put(arr, NamedSharding(group.mesh, spec))
 

@@ -22,7 +22,8 @@ from .sharding import DygraphShardingOptimizer, group_sharded_parallel  # noqa: 
 
 __all__ = ["DistributedStrategy", "init", "distributed_model",
            "distributed_optimizer", "get_hybrid_communicate_group",
-           "HybridParallelOptimizer", "ColumnParallelLinear",
+           "HybridParallelOptimizer", "HybridParallelClipGrad",
+           "ColumnParallelLinear",
            "RowParallelLinear", "VocabParallelEmbedding",
            "ParallelCrossEntropy", "DygraphShardingOptimizer",
            "group_sharded_parallel"]
@@ -82,13 +83,57 @@ def distributed_model(model):
     return model
 
 
+class HybridParallelClipGrad:
+    """Reference: dygraph_optimizer/hybrid_parallel_optimizer.py:44.
+
+    The reference sums squared norms per rank and all-reduces across the
+    mp/pp/sharding groups because each rank holds only its shard. On the
+    single-controller mesh every parameter is a global (GSPMD-sharded)
+    array, so the cross-group reduction collapses into one fused global
+    norm — computed here in a single reduction over the whole parameter
+    set, honouring per-param ``need_clip`` and counting TP-duplicated
+    (replicated) parameters exactly once, which global arrays do by
+    construction."""
+
+    def __init__(self, clip, hcg=None):
+        self._clip = clip
+        self.clip_norm = getattr(clip, "clip_norm", None)
+        self._hcg = hcg
+
+    def __call__(self, params_grads):
+        import jax.numpy as jnp
+
+        from ...core.tensor import Tensor
+        sq = [jnp.sum(g._data.astype(jnp.float32) ** 2)
+              for p, g in params_grads
+              if g is not None and getattr(p, "need_clip", True)]
+        if not sq or self.clip_norm is None:
+            return params_grads
+        global_norm = jnp.sqrt(sum(sq))
+        scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            out.append((p, Tensor((g._data.astype(jnp.float32)
+                                   * scale).astype(g._data.dtype),
+                                  stop_gradient=True)))
+        return out
+
+
 class HybridParallelOptimizer:
-    """Reference: dygraph_optimizer/hybrid_parallel_optimizer.py:254. Grad
-    sync and the cross-group global-norm clip are computed on global arrays
-    here, so the wrapper is a thin passthrough keeping the API."""
+    """Reference: dygraph_optimizer/hybrid_parallel_optimizer.py:254.
+    Replaces an inner ClipGradByGlobalNorm with HybridParallelClipGrad
+    (reference behavior) so the clip norm is the true global norm across
+    every parallel group."""
 
     def __init__(self, optimizer, hcg=None, strategy=None):
         self._inner_opt = optimizer
+        from ...nn.clip import ClipGradByGlobalNorm
+        inner_clip = getattr(optimizer, "_grad_clip", None)
+        if isinstance(inner_clip, ClipGradByGlobalNorm):
+            optimizer._grad_clip = HybridParallelClipGrad(inner_clip, hcg)
 
     def __getattr__(self, name):
         return getattr(self._inner_opt, name)

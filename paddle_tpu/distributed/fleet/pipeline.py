@@ -167,6 +167,8 @@ class PipelineParallel(Layer):
         """Reference: pipeline_parallel.py:648 (train_batch) — returns the
         mean micro-batch loss; gradients are accumulated across
         micro-batches before one optimizer step."""
+        from .. import watchdog as _watchdog
+        _watchdog.beat()
         x, y = data
         n = self._num_micro_batches
         xs = self._split_micro(x, n)

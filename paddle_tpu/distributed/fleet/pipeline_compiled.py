@@ -303,6 +303,8 @@ class CompiledPipelineParallel(Layer):
 
     # ---- public API (mirrors PipelineParallel) ----
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        from .. import watchdog as _watchdog
+        _watchdog.beat()
         x, y = data
         M = self._num_micro
         key = ("train", tuple(x.shape), str(x.dtype), tuple(y.shape))

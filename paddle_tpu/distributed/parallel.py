@@ -34,11 +34,26 @@ def _dp_mesh_axis(group=None):
 
 
 def shard_batch(tensor, group=None):
-    """Place a batch tensor sharded on the data-parallel axis (dim 0)."""
+    """Place a batch tensor sharded on the data-parallel axis (dim 0).
+
+    The input is this process's local data (reference DataParallel
+    semantics: each rank loads its own shard via DistributedBatchSampler);
+    single-controller local == global. Under multi-process jax.distributed
+    the local shards are assembled into one global array."""
     mesh, axis = _dp_mesh_axis(group)
     arr = tensor._data if isinstance(tensor, Tensor) else jnp.asarray(tensor)
     spec = P(axis, *([None] * (arr.ndim - 1)))
-    placed = jax.device_put(arr, NamedSharding(mesh, spec))
+    sharding = NamedSharding(mesh, spec)
+    if isinstance(arr, jax.core.Tracer):
+        # under jit tracing the array is already global (placed by the
+        # caller before staging) — just pin the layout
+        placed = jax.lax.with_sharding_constraint(arr, sharding)
+    elif jax.process_count() > 1:
+        import numpy as _np
+        placed = jax.make_array_from_process_local_data(
+            sharding, _np.asarray(arr))
+    else:
+        placed = jax.device_put(arr, sharding)
     if isinstance(tensor, Tensor):
         tensor._data = placed
         return tensor
@@ -72,8 +87,21 @@ class DataParallel(Layer):
 
     @contextlib.contextmanager
     def no_sync(self):
-        """Grad sync is fused into the compiled backward on TPU; kept for API
-        parity (reference: DataParallel.no_sync)."""
+        """Reference semantics (DataParallel.no_sync): skip grad sync during
+        micro-batch accumulation. On the single-controller mesh the sync is
+        a psum GSPMD fuses into the compiled backward, and because the
+        all-reduce is linear, accumulating synced grads equals syncing
+        accumulated grads — numerically identical, so skipping it is purely
+        a (here unavailable) perf knob. Warn once so users know the
+        difference from the reference is performance, not math."""
+        import warnings
+        if not getattr(self, "_warned_no_sync", False):
+            warnings.warn(
+                "DataParallel.no_sync is a numerical no-op on the "
+                "single-controller TPU mesh: gradient sync is compiled into "
+                "the backward (and all-reduce is linear, so accumulation "
+                "math is unchanged).", stacklevel=2)
+            self._warned_no_sync = True
         yield
 
     def state_dict(self, *args, **kwargs):

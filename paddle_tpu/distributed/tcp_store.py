@@ -44,6 +44,8 @@ def _load_lib():
             import glob
             for old in glob.glob(
                     os.path.join(build_dir, "libpd_tcp_store-*.so")):
+                if old == so:
+                    continue  # another rank may have just built it
                 try:
                     os.unlink(old)
                 except OSError:
@@ -79,6 +81,8 @@ def _load_lib():
         lib.pd_store_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
         lib.pd_watchdog_start.restype = ctypes.c_void_p
         lib.pd_watchdog_start.argtypes = [ctypes.c_int64]
+        lib.pd_watchdog_start2.restype = ctypes.c_void_p
+        lib.pd_watchdog_start2.argtypes = [ctypes.c_int64, ctypes.c_int]
         lib.pd_watchdog_beat.argtypes = [ctypes.c_void_p]
         lib.pd_watchdog_tripped.restype = ctypes.c_int
         lib.pd_watchdog_tripped.argtypes = [ctypes.c_void_p]
@@ -174,9 +178,15 @@ class Watchdog:
     """Collective watchdog (reference: CommTaskManager,
     comm_task_manager.cc:153): trip if no heartbeat within timeout."""
 
-    def __init__(self, timeout_seconds=1800.0):
+    def __init__(self, timeout_seconds=1800.0, abort_on_trip=False):
+        """abort_on_trip: on timeout the native thread kills the process
+        (_exit(17)) — a hung collective blocks the controller thread, so
+        in-process recovery is impossible; the launcher restart loop +
+        checkpoint resume is the recovery path (reference:
+        comm_task_manager.cc:153 abort semantics)."""
         self._lib = _load_lib()
-        self._h = self._lib.pd_watchdog_start(int(timeout_seconds * 1000))
+        self._h = self._lib.pd_watchdog_start2(
+            int(timeout_seconds * 1000), 1 if abort_on_trip else 0)
 
     def beat(self):
         self._lib.pd_watchdog_beat(self._h)

@@ -122,15 +122,36 @@ class HybridCommunicateGroup:
     def get_sep_parallel_world_size(self):
         return self._sep_degree
 
-    # -- ranks (single-controller: coordinate of process 0's first device) --
+    # -- ranks: coordinates of this process's first local device in the
+    # mesh. Single-controller (one process owning every device) is rank 0
+    # on every axis by construction; under multi-process jax.distributed
+    # each process reads its own coordinates.
+    def _local_coords(self):
+        coords = getattr(self, "_coords_cache", None)
+        if coords is not None:
+            return coords
+        dev0 = jax.local_devices()[0]
+        import numpy as _np
+        pos = _np.argwhere(self.mesh.devices == dev0)
+        coords = dict(zip(_AXES, pos[0])) if len(pos) else \
+            {a: 0 for a in _AXES}
+        self._coords_cache = coords
+        return coords
+
     def get_data_parallel_rank(self):
-        return 0
+        return int(self._local_coords()["data"])
 
     def get_model_parallel_rank(self):
-        return 0
+        return int(self._local_coords()["model"])
+
+    def get_sharding_parallel_rank(self):
+        return int(self._local_coords()["sharding"])
+
+    def get_sep_parallel_rank(self):
+        return int(self._local_coords()["sep"])
 
     def get_stage_id(self):
-        return 0
+        return int(self._local_coords()["pipe"])
 
     # -- groups --
     def _group(self, axis):

@@ -18,8 +18,34 @@ __all__ = ["MoELayer", "TopKGate", "ring_attention", "fused_rms_norm",
 
 
 def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
-                   begin_norm_axis=-1):
-    """Reference: incubate/nn/functional/fused_rms_norm.py → Pallas/XLA."""
+                   begin_norm_axis=-1, use_pallas=None, interpret=False):
+    """Reference: incubate/nn/functional/fused_rms_norm.py
+    (fused_layernorm_kernel.cu rms path). On TPU the Pallas kernel
+    (ops/pallas/rms_norm.py) does the whole row-normalize in one VMEM pass;
+    elsewhere (or begin_norm_axis != -1) the jnp composition is used —
+    interpret=True runs the kernel in interpret mode for CPU parity tests.
+    """
+    import jax as _jax
+
+    from ..core.dispatch import apply
+    from ..core.tensor import Tensor
+
+    last_axis = begin_norm_axis in (-1, (x.ndim - 1 if hasattr(x, "ndim")
+                                         else None))
+    if use_pallas is None:
+        use_pallas = interpret or _jax.default_backend() == "tpu"
+    if use_pallas and last_axis:
+        from ..ops.pallas.rms_norm import rms_norm as _pallas_rms
+        ins = [x, norm_weight] + ([norm_bias] if norm_bias is not None
+                                  else [])
+
+        def fwd(*arrs):
+            xa, wa = arrs[0], arrs[1]
+            ba = arrs[2] if len(arrs) > 2 else None
+            return _pallas_rms(xa, wa, ba, eps=epsilon,
+                               interpret=interpret)
+
+        return apply("fused_rms_norm", fwd, ins)
     from ..nn.functional import rms_norm
     out = rms_norm(x, norm_weight, epsilon)
     if norm_bias is not None:

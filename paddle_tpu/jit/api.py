@@ -167,6 +167,8 @@ class StaticFunction:
 
     def __call__(self, *args, **kwargs):
         if self._capture is not None:
+            from ..distributed import watchdog as _watchdog
+            _watchdog.beat()  # collective-hang watchdog (if armed)
             return self._call_whole_step(args, kwargs)
         return self._call_forward(args, kwargs)
 
@@ -269,6 +271,24 @@ class StaticFunction:
         lrs = jnp.asarray([opt.get_lr() for opt in opts], jnp.float32)
         state_in = [t._data for t in params] + [b._data for b in buffers] + \
             [cont[k] for cont, k in slots]
+        # keep only avals for compiled_text() — retaining the concrete
+        # arrays would pin a full copy of model+optimizer state
+        def _aval(a):
+            # mesh shardings matter for SPMD lowering; single-device
+            # placements are left off (committed single-device avals would
+            # conflict with mesh-sharded peers at lower() time)
+            sh = getattr(a, "sharding", None)
+            if sh is not None and hasattr(sh, "mesh"):
+                try:
+                    return jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                                sharding=sh)
+                except Exception:
+                    pass
+            return jax.ShapeDtypeStruct(a.shape, a.dtype)
+
+        self._last_exec = (jitted, ([_aval(a) for a in state_in],
+                                    [_aval(t._data) for t in arg_tensors],
+                                    _aval(rng_key), _aval(lrs)))
         out_arrs, new_state = jitted(state_in,
                                      [t._data for t in arg_tensors],
                                      rng_key, lrs)
@@ -349,6 +369,17 @@ class StaticFunction:
 
         donate = (0,) if self._donate_state else ()
         return jax.jit(pure, donate_argnums=donate), meta
+
+    def compiled_text(self):
+        """Optimized-HLO text of the most recent whole-step call. Lets tests
+        assert on the collectives GSPMD actually inserted (reduce-scatter
+        for ZeRO-2 grads, all-gather-on-use for ZeRO-3 params, no weight
+        all-gather under TP) instead of trusting the sharding annotations."""
+        if not hasattr(self, "_last_exec"):
+            raise RuntimeError(
+                "call the to_static function once before compiled_text()")
+        jitted, args = self._last_exec
+        return jitted.lower(*args).compile().as_text()
 
     @property
     def code(self):

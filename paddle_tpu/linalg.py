@@ -1,0 +1,10 @@
+"""paddle.linalg — linear-algebra namespace.
+
+Reference: python/paddle/linalg.py (re-exports tensor/linalg.py ops). The op
+implementations live in ops/linalg.py (hand-written) and
+ops/generated_linalg.py (codegen spine, ops/ops.yaml).
+"""
+from .ops.linalg import *  # noqa: F401,F403
+from .ops.generated_linalg import *  # noqa: F401,F403
+from .ops.generated_linalg import lu, lu_unpack, cond, matrix_exp, \
+    householder_product  # noqa: F401

@@ -1,0 +1,416 @@
+"""Recurrent layers — SimpleRNN/LSTM/GRU cells and multi-layer wrappers.
+
+Reference: python/paddle/nn/layer/rnn.py (SimpleRNNCell:700, LSTMCell:876,
+GRUCell:1076, RNN:1290, BiRNN:1366, RNNBase:1457 and the SimpleRNN/LSTM/GRU
+user classes). The reference dispatches to a fused cuDNN rnn op on GPU and a
+python time loop elsewhere; the TPU-native design compiles the WHOLE
+sequence loop as one ``lax.scan`` inside a single dispatched op, so the tape
+records one node per (layer, direction) and XLA schedules the recurrence on
+device — the cuDNN-fused-RNN equivalent.
+
+Semantics matched: gate orders (LSTM [i,f,g,o]; GRU r,z,c with
+``h = z*h_prev + (1-z)*h~``), batch-major default with ``time_major``
+option, ``direction='bidirect'`` concatenation, ``sequence_length`` masking
+(outputs past a sequence's length are zeros; final states are taken at the
+last valid step), ``Uniform(-1/sqrt(hidden), +)`` default init.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dispatch import apply
+from ...core.tensor import Tensor
+from .. import initializer as I
+from .layers import Layer
+from .container import LayerList
+
+__all__ = ["SimpleRNNCell", "LSTMCell", "GRUCell", "RNN", "BiRNN",
+           "SimpleRNN", "LSTM", "GRU"]
+
+
+def _act(name):
+    return {"tanh": jnp.tanh, "relu": jax.nn.relu}[name]
+
+
+# ---------------- functional sequence kernels (one lax.scan per run) ----
+def _mask_step(t, seq_lens, new, prev, out):
+    """Apply sequence-length masking at step t: states freeze and outputs
+    zero once t passes a sequence's length."""
+    if seq_lens is None:
+        return new, out
+    valid = (t < seq_lens)[:, None]
+    frozen = tuple(jnp.where(valid, n, p) for n, p in zip(new, prev))
+    return frozen, jnp.where(valid, out, jnp.zeros_like(out))
+
+
+def _scan_rnn(cell_step, x_tm, init_states, seq_lens, reverse):
+    """x_tm: [T, B, I] time-major. Returns ([T, B, H], final_states)."""
+    T = x_tm.shape[0]
+    ts = jnp.arange(T)
+    if reverse:
+        x_tm = x_tm[::-1]
+        ts = ts[::-1]
+
+    def step(carry, xt):
+        x_t, t = xt
+        new = cell_step(carry, x_t)
+        out = new[0]
+        new, out = _mask_step(t, seq_lens, new, carry, out)
+        return new, out
+
+    final, outs = jax.lax.scan(step, init_states, (x_tm, ts))
+    if reverse:
+        outs = outs[::-1]
+    return outs, final
+
+
+def _simple_step(w_ih, w_hh, b_ih, b_hh, act):
+    def f(carry, x_t):
+        (h,) = carry
+        g = x_t @ w_ih.T + b_ih + h @ w_hh.T + b_hh
+        return (act(g),)
+    return f
+
+
+def _lstm_step(w_ih, w_hh, b_ih, b_hh):
+    H = w_hh.shape[1]
+
+    def f(carry, x_t):
+        h, c = carry
+        g = x_t @ w_ih.T + b_ih + h @ w_hh.T + b_hh
+        i, fg, cg, o = (g[:, :H], g[:, H:2 * H], g[:, 2 * H:3 * H],
+                        g[:, 3 * H:])
+        i, fg, o = jax.nn.sigmoid(i), jax.nn.sigmoid(fg), jax.nn.sigmoid(o)
+        c_new = fg * c + i * jnp.tanh(cg)
+        return (o * jnp.tanh(c_new), c_new)
+    return f
+
+
+def _gru_step(w_ih, w_hh, b_ih, b_hh):
+    H = w_hh.shape[1]
+
+    def f(carry, x_t):
+        (h,) = carry
+        gi = x_t @ w_ih.T + b_ih
+        gh = h @ w_hh.T + b_hh
+        r = jax.nn.sigmoid(gi[:, :H] + gh[:, :H])
+        z = jax.nn.sigmoid(gi[:, H:2 * H] + gh[:, H:2 * H])
+        hc = jnp.tanh(gi[:, 2 * H:] + r * gh[:, 2 * H:])
+        return (z * h + (1 - z) * hc,)
+    return f
+
+
+_MODES = {
+    "simple": (_simple_step, 1, 1),
+    "lstm": (_lstm_step, 4, 2),
+    "gru": (_gru_step, 3, 1),
+}
+
+
+# ---------------- cells ----------------
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        b = batch_ref.shape[batch_dim_idx]
+        n = self.state_components
+        zeros = [Tensor(jnp.full((b, self.hidden_size), init_value,
+                                 jnp.float32)) for _ in range(n)]
+        return zeros[0] if n == 1 else tuple(zeros)
+
+
+def _uniform_std(hidden_size):
+    std = 1.0 / math.sqrt(hidden_size)
+    return I.Uniform(-std, std)
+
+
+class _CellCommon(RNNCellBase):
+    mode = "simple"
+
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        _, gates, n_state = _MODES[self.mode]
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.state_components = n_state
+        self._activation = activation
+        init = _uniform_std(hidden_size)
+        self.weight_ih = self.create_parameter(
+            (gates * hidden_size, input_size), weight_ih_attr,
+            default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            (gates * hidden_size, hidden_size), weight_hh_attr,
+            default_initializer=init)
+        self.bias_ih = self.create_parameter(
+            (gates * hidden_size,), bias_ih_attr, is_bias=True,
+            default_initializer=init)
+        self.bias_hh = self.create_parameter(
+            (gates * hidden_size,), bias_hh_attr, is_bias=True,
+            default_initializer=init)
+
+    def _step_fn(self, w_ih, w_hh, b_ih, b_hh):
+        mk = _MODES[self.mode][0]
+        if self.mode == "simple":
+            return mk(w_ih, w_hh, b_ih, b_hh, _act(self._activation))
+        return mk(w_ih, w_hh, b_ih, b_hh)
+
+    def _states_tuple(self, states, batch_ref):
+        if states is None:
+            states = self.get_initial_states(batch_ref)
+        if isinstance(states, Tensor):
+            states = (states,)
+        return tuple(states)
+
+    def forward(self, inputs, states=None):
+        states = self._states_tuple(states, inputs)
+        ins = [inputs, *states, self.weight_ih, self.weight_hh,
+               self.bias_ih, self.bias_hh]
+        n_state = self.state_components
+
+        def fwd(x, *arrs):
+            st = arrs[:n_state]
+            w_ih, w_hh, b_ih, b_hh = arrs[n_state:]
+            new = self._step_fn(w_ih, w_hh, b_ih, b_hh)(st, x)
+            return tuple(new)
+
+        out = apply(f"{self.mode}_cell", fwd, ins, nout=n_state)
+        new = out if isinstance(out, (tuple, list)) else (out,)
+        if n_state == 1:
+            return new[0], new[0]
+        return new[0], tuple(new)
+
+
+class SimpleRNNCell(_CellCommon):
+    """Reference: nn/layer/rnn.py:700."""
+    mode = "simple"
+
+
+class LSTMCell(_CellCommon):
+    """Reference: nn/layer/rnn.py:876 (gate order i, f, g, o)."""
+    mode = "lstm"
+
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__(input_size, hidden_size, "tanh", weight_ih_attr,
+                         weight_hh_attr, bias_ih_attr, bias_hh_attr, name)
+
+
+class GRUCell(_CellCommon):
+    """Reference: nn/layer/rnn.py:1076 (h = z*h_prev + (1-z)*h_tilde)."""
+    mode = "gru"
+
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__(input_size, hidden_size, "tanh", weight_ih_attr,
+                         weight_hh_attr, bias_ih_attr, bias_hh_attr, name)
+
+
+# ---------------- sequence runners ----------------
+def _run_cell_sequence(cell, inputs, states, seq_lens, time_major, reverse):
+    """One dispatched op: whole-sequence scan for a single cell. Returns
+    (outputs Tensor, tuple-of-final-state Tensors)."""
+    if states is None:
+        b = inputs.shape[1 if time_major else 0]
+        zeros = [Tensor(jnp.zeros((b, cell.hidden_size), jnp.float32))
+                 for _ in range(cell.state_components)]
+        states = tuple(zeros)
+    elif isinstance(states, Tensor):
+        states = (states,)
+    else:
+        states = tuple(states)
+    n_state = cell.state_components
+    ins = [inputs, *states, cell.weight_ih, cell.weight_hh, cell.bias_ih,
+           cell.bias_hh]
+    if seq_lens is not None:
+        ins.append(seq_lens)
+
+    def fwd(x, *arrs):
+        st = tuple(arrs[:n_state])
+        w_ih, w_hh, b_ih, b_hh = arrs[n_state:n_state + 4]
+        lens = arrs[n_state + 4] if len(arrs) > n_state + 4 else None
+        x_tm = x if time_major else jnp.swapaxes(x, 0, 1)
+        step = cell._step_fn(w_ih, w_hh, b_ih, b_hh)
+        outs, final = _scan_rnn(step, x_tm, st, lens, reverse)
+        if not time_major:
+            outs = jnp.swapaxes(outs, 0, 1)
+        return (outs, *final)
+
+    out = apply(f"{cell.mode}_seq", fwd, ins, nout=1 + n_state)
+    outs = out[0]
+    final = tuple(out[1:])
+    return outs, final
+
+
+class RNN(Layer):
+    """Reference: nn/layer/rnn.py:1290 — wraps a cell into a sequence
+    runner."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        outs, final = _run_cell_sequence(
+            self.cell, inputs, initial_states, sequence_length,
+            self.time_major, self.is_reverse)
+        if self.cell.state_components == 1:
+            return outs, final[0]
+        return outs, final
+
+
+class BiRNN(Layer):
+    """Reference: nn/layer/rnn.py:1366."""
+
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.cell_fw, self.cell_bw = cell_fw, cell_bw
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        if initial_states is None:
+            st_fw = st_bw = None
+        else:
+            st_fw, st_bw = initial_states
+        out_fw, fin_fw = _run_cell_sequence(
+            self.cell_fw, inputs, st_fw, sequence_length, self.time_major,
+            False)
+        out_bw, fin_bw = _run_cell_sequence(
+            self.cell_bw, inputs, st_bw, sequence_length, self.time_major,
+            True)
+        outs = apply("concat", lambda a, b: jnp.concatenate([a, b], -1),
+                     [out_fw, out_bw])
+        return outs, (fin_fw, fin_bw)
+
+
+# ---------------- multi-layer user classes ----------------
+class _RNNBase(Layer):
+    """Reference: nn/layer/rnn.py:1457 RNNBase."""
+    mode = "simple"
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        if direction in ("bidirect", "bidirectional"):
+            self.num_directions = 2
+        elif direction == "forward":
+            self.num_directions = 1
+        else:
+            raise ValueError(f"unknown direction {direction!r} (use "
+                             "'forward' or 'bidirect')")
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.state_components = _MODES[self.mode][2]
+        cls = {"simple": SimpleRNNCell, "lstm": LSTMCell,
+               "gru": GRUCell}[self.mode]
+
+        def mk(in_sz):
+            kw = dict(weight_ih_attr=weight_ih_attr,
+                      weight_hh_attr=weight_hh_attr,
+                      bias_ih_attr=bias_ih_attr, bias_hh_attr=bias_hh_attr)
+            if self.mode == "simple":
+                return cls(in_sz, hidden_size, activation=activation, **kw)
+            return cls(in_sz, hidden_size, **kw)
+
+        fw, bw = [], []
+        for layer in range(num_layers):
+            in_sz = input_size if layer == 0 \
+                else hidden_size * self.num_directions
+            fw.append(mk(in_sz))
+            if self.num_directions == 2:
+                bw.append(mk(in_sz))
+        self._cells_fw = LayerList(fw)
+        self._cells_bw = LayerList(bw)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        D, L, n_state = (self.num_directions, self.num_layers,
+                         self.state_components)
+        batch_idx = 1 if self.time_major else 0
+        b = inputs.shape[batch_idx]
+        if initial_states is None:
+            per_layer = [None] * (L * D)
+        else:
+            # states: [L*D, B, H] per component (reference layout)
+            if n_state == 1:
+                comps = (initial_states,) if isinstance(
+                    initial_states, Tensor) else tuple(initial_states)
+            else:
+                comps = tuple(initial_states)
+            per_layer = []
+            for i in range(L * D):
+                per_layer.append(tuple(c[i] for c in comps))
+
+        x = inputs
+        finals = []  # (layer, dir) -> tuple of state comps
+        for layer in range(L):
+            out_fw, fin_fw = _run_cell_sequence(
+                self._cells_fw[layer], x, per_layer[layer * D],
+                sequence_length, self.time_major, False)
+            if D == 2:
+                out_bw, fin_bw = _run_cell_sequence(
+                    self._cells_bw[layer], x, per_layer[layer * D + 1],
+                    sequence_length, self.time_major, True)
+                x = apply("concat",
+                          lambda a, b: jnp.concatenate([a, b], -1),
+                          [out_fw, out_bw])
+                finals += [fin_fw, fin_bw]
+            else:
+                x = out_fw
+                finals.append(fin_fw)
+            if self.dropout and layer < L - 1 and self.training:
+                from .. import functional as F
+                x = F.dropout(x, p=self.dropout)
+
+        # stack finals: per component [L*D, B, H]
+        stacked = []
+        for comp in range(n_state):
+            comps = [f[comp] for f in finals]
+            stacked.append(apply(
+                "stack", lambda *arrs: jnp.stack(arrs), comps))
+        if n_state == 1:
+            return x, stacked[0]
+        return x, tuple(stacked)
+
+
+class SimpleRNN(_RNNBase):
+    """Reference: nn/layer/rnn.py SimpleRNN."""
+    mode = "simple"
+
+
+class LSTM(_RNNBase):
+    """Reference: nn/layer/rnn.py LSTM."""
+    mode = "lstm"
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__(input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, "tanh", weight_ih_attr,
+                         weight_hh_attr, bias_ih_attr, bias_hh_attr, name)
+
+
+class GRU(_RNNBase):
+    """Reference: nn/layer/rnn.py GRU."""
+    mode = "gru"
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__(input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, "tanh", weight_ih_attr,
+                         weight_hh_attr, bias_ih_attr, bias_hh_attr, name)

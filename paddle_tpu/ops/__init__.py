@@ -20,8 +20,10 @@ from .math import *  # noqa: F401,F403
 from .linalg import *  # noqa: F401,F403
 from .search import *  # noqa: F401,F403
 from .random_ops import *  # noqa: F401,F403
+from .generated_root import *  # noqa: F401,F403  (codegen spine, ops.yaml)
 
 from . import creation, linalg, logic, manipulation, math, random_ops, search
+from . import generated_root
 
 
 def einsum(equation, *operands, name=None):
@@ -36,7 +38,8 @@ def one_hot(x, num_classes, name=None):
 
 # Bind op functions as Tensor methods (the reference patches these via pybind
 # eager_method.cc + tensor_patch_methods.py).
-_METHOD_SOURCES = [math, manipulation, logic, linalg, search, creation]
+_METHOD_SOURCES = [math, manipulation, logic, linalg, search, creation,
+                   generated_root]
 _NO_METHOD = {
     "to_tensor", "zeros", "ones", "full", "arange", "linspace", "logspace",
     "eye", "empty", "meshgrid", "tril_indices", "triu_indices", "assign",
@@ -75,3 +78,8 @@ def _bind():
 
 
 _bind()
+
+# drop the submodule name so `from paddle_tpu.ops import *` does not shadow
+# the paddle_tpu.linalg NAMESPACE module with this implementation module
+# (the object stays reachable via _METHOD_SOURCES and sys.modules)
+del linalg

@@ -56,54 +56,8 @@ inner = _binary("inner", jnp.inner)
 outer = _binary("outer", jnp.outer)
 kron = _binary("kron", jnp.kron)
 
-# ---- unary elementwise ----
-abs = _unary("abs", jnp.abs)  # noqa: A001
-neg = _unary("neg", jnp.negative)
-exp = _unary("exp", jnp.exp)
-expm1 = _unary("expm1", jnp.expm1)
-log = _unary("log", jnp.log)
-log2 = _unary("log2", jnp.log2)
-log10 = _unary("log10", jnp.log10)
-log1p = _unary("log1p", jnp.log1p)
-sqrt = _unary("sqrt", jnp.sqrt)
-rsqrt = _unary("rsqrt", lax.rsqrt)
-square = _unary("square", jnp.square)
-sin = _unary("sin", jnp.sin)
-cos = _unary("cos", jnp.cos)
-tan = _unary("tan", jnp.tan)
-asin = _unary("asin", jnp.arcsin)
-acos = _unary("acos", jnp.arccos)
-atan = _unary("atan", jnp.arctan)
-sinh = _unary("sinh", jnp.sinh)
-cosh = _unary("cosh", jnp.cosh)
-tanh = _unary("tanh", jnp.tanh)
-asinh = _unary("asinh", jnp.arcsinh)
-acosh = _unary("acosh", jnp.arccosh)
-atanh = _unary("atanh", jnp.arctanh)
-ceil = _unary("ceil", jnp.ceil)
-floor = _unary("floor", jnp.floor)
-round = _unary("round", jnp.round)  # noqa: A001
-trunc = _unary("trunc", jnp.trunc)
-frac = _unary("frac", lambda a: a - jnp.trunc(a))
-sign = _unary("sign", jnp.sign)
-reciprocal = _unary("reciprocal", jnp.reciprocal)
-sigmoid = _unary("sigmoid", jax.nn.sigmoid)
-erf = _unary("erf", jsp.erf)
-erfinv = _unary("erfinv", jsp.erfinv)
-digamma = _unary("digamma", jsp.digamma)
-lgamma = _unary("lgamma", jsp.gammaln)
-i0 = _unary("i0", jsp.i0)
-i0e = _unary("i0e", jsp.i0e)
-i1 = _unary("i1", jsp.i1)
-i1e = _unary("i1e", jsp.i1e)
-logit = _unary("logit", lambda a: jnp.log(a / (1 - a)))
-angle = _unary("angle", jnp.angle)
-conj = _unary("conj", jnp.conj)
-real = _unary("real", jnp.real)
-imag = _unary("imag", jnp.imag)
-deg2rad = _unary("deg2rad", jnp.deg2rad)
-rad2deg = _unary("rad2deg", jnp.rad2deg)
-exponent = _unary("exponent", lambda a: jnp.frexp(a)[1].astype(a.dtype))
+# ---- unary elementwise: migrated to the codegen spine (ops.yaml ->
+# generated_root.py; see gen.py) ----
 
 
 def isnan(x, name=None):

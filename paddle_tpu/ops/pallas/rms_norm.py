@@ -1,0 +1,129 @@
+"""Fused RMSNorm — Pallas TPU kernel (forward; backward via custom_vjp).
+
+Reference: paddle/phi/kernels/fusion/gpu/fused_layernorm_kernel.cu (the
+rms-norm path). One VMEM pass per row-tile computes the mean-square,
+rsqrt, and scale in place of the jnp composition's multiple HBM passes.
+The backward is the (XLA-fused) jnp expression of the analytic gradient —
+one fused kernel either way, so Pallas is spent where it pays (the fwd).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu  # noqa: F401
+
+DEFAULT_BLOCK_ROWS = 256
+
+
+def _kernel(x_ref, w_ref, o_ref, *, eps):
+    x = x_ref[...].astype(jnp.float32)            # [BR, H]
+    ms = jnp.mean(x * x, axis=-1, keepdims=True)  # [BR, 1]
+    inv = jax.lax.rsqrt(ms + np.float32(eps))
+    o_ref[...] = (x * inv * w_ref[...].astype(jnp.float32)[None, :]) \
+        .astype(o_ref.dtype)
+
+
+def _kernel_bias(x_ref, w_ref, b_ref, o_ref, *, eps):
+    x = x_ref[...].astype(jnp.float32)
+    ms = jnp.mean(x * x, axis=-1, keepdims=True)
+    inv = jax.lax.rsqrt(ms + np.float32(eps))
+    o_ref[...] = (x * inv * w_ref[...].astype(jnp.float32)[None, :]
+                  + b_ref[...].astype(jnp.float32)[None, :]) \
+        .astype(o_ref.dtype)
+
+
+def _fwd_pallas(x2, w, b, eps, block_rows, interpret):
+    R, H = x2.shape
+    br = min(block_rows, R)
+    if R % br:
+        br = R
+    grid = (R // br,)
+    row_spec = pl.BlockSpec((br, H), lambda i: (i, 0))
+    vec_spec = pl.BlockSpec((H,), lambda i: (0,))
+    with jax.enable_x64(False):
+        if b is None:
+            return pl.pallas_call(
+                functools.partial(_kernel, eps=eps),
+                grid=grid,
+                in_specs=[row_spec, vec_spec],
+                out_specs=row_spec,
+                out_shape=jax.ShapeDtypeStruct((R, H), x2.dtype),
+                interpret=interpret,
+            )(x2, w)
+        return pl.pallas_call(
+            functools.partial(_kernel_bias, eps=eps),
+            grid=grid,
+            in_specs=[row_spec, vec_spec, vec_spec],
+            out_specs=row_spec,
+            out_shape=jax.ShapeDtypeStruct((R, H), x2.dtype),
+            interpret=interpret,
+        )(x2, w, b)
+
+
+def _bwd_math(x, w, ct, eps):
+    xf = x.astype(jnp.float32)
+    ctf = ct.astype(jnp.float32)
+    wf = w.astype(jnp.float32)
+    ms = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    inv = jax.lax.rsqrt(ms + eps)
+    xhat = xf * inv
+    ctw = ctf * wf
+    dx = inv * (ctw - xhat * jnp.mean(ctw * xhat, axis=-1, keepdims=True))
+    axes = tuple(range(x.ndim - 1))
+    dw = jnp.sum(ctf * xhat, axis=axes).astype(w.dtype)
+    return dx.astype(x.dtype), dw, ctf, axes
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def _rms_nb(x, w, eps, block_rows, interpret):
+    shape = x.shape
+    out = _fwd_pallas(x.reshape(-1, shape[-1]), w, None, eps, block_rows,
+                      interpret)
+    return out.reshape(shape)
+
+
+def _rms_nb_fwd(x, w, eps, block_rows, interpret):
+    return _rms_nb(x, w, eps, block_rows, interpret), (x, w)
+
+
+def _rms_nb_bwd(eps, block_rows, interpret, res, ct):
+    x, w = res
+    dx, dw, _, _ = _bwd_math(x, w, ct, eps)
+    return dx, dw
+
+
+_rms_nb.defvjp(_rms_nb_fwd, _rms_nb_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _rms_b(x, w, b, eps, block_rows, interpret):
+    shape = x.shape
+    out = _fwd_pallas(x.reshape(-1, shape[-1]), w, b, eps, block_rows,
+                      interpret)
+    return out.reshape(shape)
+
+
+def _rms_b_fwd(x, w, b, eps, block_rows, interpret):
+    return _rms_b(x, w, b, eps, block_rows, interpret), (x, w, b)
+
+
+def _rms_b_bwd(eps, block_rows, interpret, res, ct):
+    x, w, b = res
+    dx, dw, ctf, axes = _bwd_math(x, w, ct, eps)
+    db = jnp.sum(ctf, axis=axes).astype(b.dtype)
+    return dx, dw, db
+
+
+_rms_b.defvjp(_rms_b_fwd, _rms_b_bwd)
+
+
+def rms_norm(x, w, b=None, eps=1e-6, block_rows=DEFAULT_BLOCK_ROWS,
+             interpret=False):
+    """x: [..., H]; w/b: [H]. Returns x's shape/dtype. Differentiable."""
+    if b is None:
+        return _rms_nb(x, w, float(eps), block_rows, interpret)
+    return _rms_b(x, w, b, float(eps), block_rows, interpret)

@@ -50,6 +50,11 @@ class Optimizer:
         self._pid_to_param = {id(p): p for p in self._parameter_list}
         self._global_step = 0
         self._lr_override = None  # set by jit whole-step staging (traced lr)
+        # distributed hooks (set by DygraphShardingOptimizer): reshard the
+        # grad before the sharded accumulator update (ZeRO reduce-scatter)
+        # and the updated param after it (all-gather / keep-sharded)
+        self._dist_grad_hook = None
+        self._dist_out_hook = None
 
     # ---- learning rate ----
     def get_lr(self):
@@ -130,7 +135,11 @@ class Optimizer:
                     garr = garr + l1 * jnp.sign(w)
                 plr = lr * p.optimize_attr.get("learning_rate", 1.0) \
                     if isinstance(p, Parameter) and p.optimize_attr else lr
+                if self._dist_grad_hook is not None:
+                    garr = self._dist_grad_hook(p, garr)
                 new_w = self._update(p, w, garr, plr, group)
+                if self._dist_out_hook is not None:
+                    new_w = self._dist_out_hook(p, new_w)
                 if use_master:
                     self._master_weights[id(p)] = new_w
                     p._data = new_w.astype(p._data.dtype)

@@ -1,0 +1,55 @@
+"""Codegen spine integrity (VERDICT r2 #5: generator in-tree, generated ops
+byte-identical to committed output)."""
+import os
+
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+def test_generated_files_are_current():
+    from paddle_tpu.ops.gen import generate
+    outputs = generate(write=False)
+    for path, content in outputs.items():
+        with open(path) as f:
+            on_disk = f.read()
+        assert on_disk == content, (
+            f"{os.path.basename(path)} is stale — run "
+            "python -m paddle_tpu.ops.gen")
+
+
+def test_registry_covers_namespaces():
+    # migrated elementwise ops still reachable from the root namespace
+    for name in ("tanh", "sqrt", "sigmoid", "erf", "round"):
+        assert hasattr(paddle, name)
+    # and bound as Tensor methods
+    t = paddle.to_tensor(np.array([0.5, 1.0], np.float32))
+    np.testing.assert_allclose(t.tanh().numpy(), np.tanh([0.5, 1.0]),
+                               rtol=1e-6)
+    # new namespaces
+    assert hasattr(paddle.fft, "fft") and hasattr(paddle.fft, "fftfreq")
+    assert hasattr(paddle.linalg, "svd") and hasattr(paddle.linalg, "lu")
+
+
+def test_float_check_preflight():
+    import pytest
+    with pytest.raises(TypeError):
+        paddle.quantile(paddle.to_tensor(np.array([1, 2, 3])), 0.5)
+
+
+def test_generated_grad_flows():
+    x = paddle.to_tensor(np.array([0.3, 0.7], np.float32),
+                         stop_gradient=False)
+    y = paddle.tanh(x).sum()
+    y.backward()
+    np.testing.assert_allclose(np.asarray(x._grad),
+                               1 - np.tanh([0.3, 0.7]) ** 2, rtol=1e-5)
+
+
+def test_lu_roundtrip():
+    rng = np.random.RandomState(0)
+    a = rng.randn(4, 4).astype("float32") + np.eye(4, dtype="float32") * 2
+    lu, piv = paddle.linalg.lu(paddle.to_tensor(a))
+    P, L, U = paddle.linalg.lu_unpack(lu, piv)
+    np.testing.assert_allclose(P.numpy() @ L.numpy() @ U.numpy(), a,
+                               rtol=1e-4, atol=1e-4)

@@ -1,0 +1,84 @@
+"""Real multi-process execution path (VERDICT r2 #3).
+
+Reference precedent: test/legacy_test/test_dist_base.py:962 spawns trainer
+processes and compares losses vs single-process;
+test_parallel_dygraph_dataparallel.py:100 start_local_trainers. Here the
+launcher (paddle_tpu.distributed.launch) spawns 2 CPU processes wired by
+jax.distributed; DP losses must match the single-process run; a killed peer
+must trip the armed watchdog (abort, rc=17) instead of hanging forever.
+"""
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+WORKERS = os.path.join(os.path.dirname(__file__), "workers")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _clean_env(port):
+    env = dict(os.environ)
+    for k in list(env):
+        if k.startswith(("PADDLE_TPU_", "PADDLE_TRAINER")):
+            del env[k]
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PADDLE_TPU_MASTER"] = f"127.0.0.1:{port}"
+    return env
+
+
+def _parse_losses(text):
+    return {int(m.group(1)): float(m.group(2))
+            for m in re.finditer(r"LOSS (\d+) ([\d.eE+-]+)", text)}
+
+
+@pytest.mark.slow
+def test_launcher_dp_two_process_matches_single(tmp_path):
+    port = 29517
+    env = _clean_env(port)
+    # single process reference
+    single = subprocess.run(
+        [sys.executable, os.path.join(WORKERS, "dp_worker.py")],
+        env=env, capture_output=True, text=True, timeout=300, cwd=REPO)
+    assert single.returncode == 0, single.stdout + single.stderr
+    ref = _parse_losses(single.stdout)
+    assert len(ref) == 10
+
+    # two processes through the launcher
+    log_dir = str(tmp_path / "logs")
+    launched = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--master", f"127.0.0.1:{port}",
+         "--log_dir", log_dir,
+         os.path.join(WORKERS, "dp_worker.py")],
+        env=env, capture_output=True, text=True, timeout=600, cwd=REPO)
+    assert launched.returncode == 0, launched.stdout + launched.stderr
+    for rank in (0, 1):
+        with open(os.path.join(log_dir, f"workerlog.{rank}")) as f:
+            got = _parse_losses(f.read())
+        assert len(got) == 10, f"rank {rank} incomplete"
+        for i in ref:
+            assert abs(got[i] - ref[i]) < 1e-5, \
+                (f"rank {rank} step {i}: {got[i]} vs single {ref[i]}")
+
+
+@pytest.mark.slow
+def test_watchdog_aborts_on_dead_peer(tmp_path):
+    """Kill one worker mid-run: the survivor's collective hangs, the armed
+    watchdog aborts it (rc 17) instead of blocking forever."""
+    port = 29531
+    env = _clean_env(port)
+    log_dir = str(tmp_path / "logs")
+    launched = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--master", f"127.0.0.1:{port}",
+         "--log_dir", log_dir,
+         os.path.join(WORKERS, "hang_worker.py")],
+        env=env, capture_output=True, text=True, timeout=300, cwd=REPO)
+    assert launched.returncode != 0  # the job failed, it did not hang
+    with open(os.path.join(log_dir, "workerlog.0")) as f:
+        log0 = f.read()
+    assert "pd_watchdog" in log0, log0[-2000:]
+    assert "aborting process" in log0

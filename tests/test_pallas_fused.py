@@ -1,0 +1,142 @@
+"""Pallas fused AdamW + RMSNorm parity (VERDICT r2 #6).
+
+Interpret-mode kernels vs the jnp compositions (reference:
+fused_adam_kernel.cu, fusion/gpu/fused_layernorm_kernel.cu)."""
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.ops.pallas.fused_adamw import fused_adamw
+from paddle_tpu.ops.pallas.rms_norm import rms_norm
+
+
+def test_fused_adamw_matches_jnp_composition():
+    rng = np.random.RandomState(0)
+    n = 1000  # deliberately not lane-aligned: exercises padding
+    w = jnp.asarray(rng.randn(n), jnp.float32)
+    g = jnp.asarray(rng.randn(n), jnp.float32)
+    m = jnp.asarray(rng.randn(n) * 0.1, jnp.float32)
+    v = jnp.asarray(np.abs(rng.randn(n)) * 0.01, jnp.float32)
+    lr, b1, b2, eps, wd, t = 1e-3, 0.9, 0.999, 1e-8, 0.01, 7
+    bc1 = 1.0 / (1 - b1 ** t)
+    bc2 = 1.0 / (1 - b2 ** t)
+
+    w2, m2, v2 = fused_adamw(w, g, m, v, lr, b1, b2, eps, wd, bc1, bc2,
+                             interpret=True)
+
+    # f32 scalars, matching the kernel's SMEM operands (0.999 as f32 differs
+    # from the f64 python literal at the 1e-5 level)
+    lrf, b1f, b2f, epsf, wdf, bc1f, bc2f = (
+        np.float32(s) for s in (lr, b1, b2, eps, wd, bc1, bc2))
+    wref = w * (np.float32(1) - lrf * wdf)
+    mref = b1f * m + (np.float32(1) - b1f) * g
+    vref = b2f * v + (np.float32(1) - b2f) * g * g
+    wref = wref - lrf * (mref * bc1f) / (jnp.sqrt(vref * bc2f) + epsf)
+    np.testing.assert_allclose(np.asarray(m2), np.asarray(mref), rtol=1e-6,
+                               atol=1e-7)
+    np.testing.assert_allclose(np.asarray(v2), np.asarray(vref), rtol=1e-6,
+                               atol=1e-8)
+    np.testing.assert_allclose(np.asarray(w2), np.asarray(wref), rtol=1e-5,
+                               atol=1e-7)
+
+
+def test_fused_adamw_2d_bf16_param():
+    rng = np.random.RandomState(1)
+    w = jnp.asarray(rng.randn(48, 96), jnp.bfloat16)
+    g = jnp.asarray(rng.randn(48, 96), jnp.float32)
+    m = jnp.zeros((48, 96), jnp.float32)
+    v = jnp.zeros((48, 96), jnp.float32)
+    w2, m2, v2 = fused_adamw(w, g, m, v, 1e-2, 0.9, 0.999, 1e-8, 0.0,
+                             1.0 / (1 - 0.9), 1.0 / (1 - 0.999),
+                             interpret=True)
+    assert w2.dtype == jnp.bfloat16 and w2.shape == (48, 96)
+    mref = 0.1 * np.asarray(g, np.float32)
+    np.testing.assert_allclose(np.asarray(m2), mref, rtol=1e-5)
+
+
+def test_optimizer_fused_flag_matches_default():
+    """AdamW(use_fused=True) in interpret-capable (CPU) mode must produce
+    the same trajectory as the jnp path."""
+    rng = np.random.RandomState(2)
+    xw = rng.randn(64, 32).astype("float32")
+    yw = rng.randn(64, 8).astype("float32")
+
+    def run(fused):
+        import paddle_tpu.nn as nn
+        import paddle_tpu.nn.functional as F
+        paddle.seed(3)
+        mdl = nn.Linear(32, 8)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                     parameters=mdl.parameters(),
+                                     weight_decay=0.01)
+        # force the fused path through interpret mode by monkey flag
+        opt.use_fused = False if not fused else None
+        if fused:
+            # patch fused_adamw to interpret mode for the CPU test
+            from paddle_tpu.ops.pallas import fused_adamw as fa
+            orig = fa.fused_adamw
+            import functools
+            fa_patched = functools.partial(orig, interpret=True)
+            import paddle_tpu.optimizer.optimizers as om
+            opt.use_fused = True
+            opt._FUSED_MIN_SIZE = 1
+            import paddle_tpu.ops.pallas.fused_adamw as mod
+            mod_orig = mod.fused_adamw
+            mod.fused_adamw = fa_patched
+        try:
+            for _ in range(3):
+                loss = F.mse_loss(mdl(paddle.to_tensor(xw)),
+                                  paddle.to_tensor(yw))
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+        finally:
+            if fused:
+                mod.fused_adamw = mod_orig
+        return mdl.weight.numpy()
+
+    np.testing.assert_allclose(run(True), run(False), rtol=2e-5, atol=1e-6)
+
+
+def test_rms_norm_pallas_parity_and_grads():
+    import jax
+    rng = np.random.RandomState(4)
+    x = jnp.asarray(rng.randn(6, 256), jnp.float32)
+    w = jnp.asarray(rng.randn(256), jnp.float32)
+    b = jnp.asarray(rng.randn(256), jnp.float32)
+    eps = 1e-6
+    out = rms_norm(x, w, b, eps=eps, interpret=True)
+    ref = x / np.sqrt((np.asarray(x) ** 2).mean(-1, keepdims=True) + eps) \
+        * np.asarray(w) + np.asarray(b)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5, atol=1e-5)
+
+    # grads vs jax autodiff of the composition
+    def comp(x, w, b):
+        inv = 1.0 / jnp.sqrt(jnp.mean(x * x, -1, keepdims=True) + eps)
+        return jnp.sum((x * inv * w + b) ** 2)
+
+    gx, gw, gb = jax.grad(
+        lambda x, w, b: jnp.sum(
+            rms_norm(x, w, b, eps=eps, interpret=True) ** 2),
+        argnums=(0, 1, 2))(x, w, b)
+    rx, rw, rb = jax.grad(comp, argnums=(0, 1, 2))(x, w, b)
+    np.testing.assert_allclose(np.asarray(gx), np.asarray(rx), rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(gw), np.asarray(rw), rtol=1e-4,
+                               atol=1e-4)
+    np.testing.assert_allclose(np.asarray(gb), np.asarray(rb), rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_incubate_fused_rms_norm_pallas_path():
+    rng = np.random.RandomState(5)
+    x = paddle.to_tensor(rng.randn(4, 128).astype("float32"),
+                         stop_gradient=False)
+    w = paddle.to_tensor(rng.randn(128).astype("float32"),
+                         stop_gradient=False)
+    out = paddle.incubate.fused_rms_norm(x, w, interpret=True)
+    ref = paddle.incubate.fused_rms_norm(x, w, use_pallas=False)
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-5,
+                               atol=1e-5)
+    out.sum().backward()
+    assert x._grad is not None and w._grad is not None

@@ -1,0 +1,60 @@
+"""Per-rank DP trainer for the launcher integration test (reference:
+test/legacy_test/test_dist_base.py:962 spawns real trainer processes and
+compares losses against single-process).
+
+Run standalone (world=1) or under paddle_tpu.distributed.launch (world=2):
+each rank takes its shard of a deterministic dataset, trains a Linear model
+data-parallel, prints per-step losses as `LOSS <step> <value>`.
+"""
+import os
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=1")
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.distributed as dist
+from paddle_tpu.jit import to_static
+
+
+def main():
+    dist.init_parallel_env()
+    world = jax.process_count()
+    rank = dist.get_rank()
+    paddle.seed(0)
+
+    # deterministic global dataset; each rank owns a contiguous shard
+    X = np.random.RandomState(42).randn(32, 16).astype("float32")
+    Wt = np.random.RandomState(7).randn(16, 4).astype("float32")
+    Y = X @ Wt
+    n_local = 32 // world
+    Xl = X[rank * n_local:(rank + 1) * n_local]
+    Yl = Y[rank * n_local:(rank + 1) * n_local]
+
+    model = nn.Linear(16, 4)
+    model = dist.DataParallel(model)
+    opt = paddle.optimizer.SGD(learning_rate=0.05,
+                               parameters=model.parameters())
+
+    def train_step(xb, yb):
+        loss = F.mse_loss(model(xb), yb)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    step = to_static(train_step, capture=(model, opt))
+    for i in range(10):
+        xb = dist.shard_batch(paddle.to_tensor(Xl))
+        yb = dist.shard_batch(paddle.to_tensor(Yl))
+        loss = step(xb, yb)
+        print(f"LOSS {i} {float(loss.numpy()):.8f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

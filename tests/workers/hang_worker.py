@@ -1,0 +1,53 @@
+"""Worker for the watchdog kill-one-peer test: rank 1 exits mid-run; rank
+0's next cross-process collective hangs and the armed watchdog must abort
+the process with _exit(17) (reference: comm_task_manager.cc abort-on-hang).
+"""
+import os
+import sys
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=1")
+os.environ["PADDLE_TPU_WATCHDOG_TIMEOUT"] = "4"
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import paddle_tpu as paddle  # noqa: F401  (arms dispatch etc.)
+import paddle_tpu.distributed as dist
+
+
+def main():
+    dist.init_parallel_env()
+    rank = dist.get_rank()
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()), ("d",))
+    out_repl = NamedSharding(mesh, P())
+
+    def allsum(a):
+        return jax.jit(lambda x: jnp.sum(x), out_shardings=out_repl)(a)
+
+    dist.start_step_watchdog(4.0, abort_on_trip=True)
+    for i in range(100):
+        wd = dist.get_step_watchdog()
+        wd.beat()
+        if rank == 1 and i == 3:
+            # stay alive but stop participating: the peer's collective
+            # blocks (a closed socket would error fast; a silent peer is
+            # the true hang the watchdog exists for)
+            print("RANK1 STOPPED PARTICIPATING", flush=True)
+            import time
+            time.sleep(45)
+            os._exit(0)
+        local = np.full((2,), float(i + 1), np.float32)
+        arr = jax.make_array_from_process_local_data(
+            NamedSharding(mesh, P("d")), local)
+        s = float(np.asarray(allsum(arr)))
+        print(f"STEP {i} sum={s}", flush=True)
+    sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

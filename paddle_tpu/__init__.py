@@ -32,6 +32,7 @@ from .ops import *  # noqa: F401,F403
 from .ops import einsum, one_hot  # noqa: F401
 
 from . import amp  # noqa: F401
+from . import audio  # noqa: F401
 from . import autograd  # noqa: F401
 from . import fft  # noqa: F401
 from . import framework  # noqa: F401

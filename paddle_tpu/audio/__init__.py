@@ -1,0 +1,16 @@
+"""paddle.audio — audio feature extraction + IO.
+
+Reference namespace: python/paddle/audio/ (functional, features, backends,
+datasets). Datasets that require downloads raise with instructions (zero
+egress here); feature layers and IO are fully functional.
+"""
+from . import backends  # noqa: F401
+from . import features  # noqa: F401
+from . import functional  # noqa: F401
+from .backends import info, load, save  # noqa: F401
+from .features import (  # noqa: F401
+    LogMelSpectrogram, MelSpectrogram, MFCC, Spectrogram,
+)
+
+__all__ = ["functional", "features", "backends", "load", "save", "info",
+           "Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]

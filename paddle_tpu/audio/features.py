@@ -1,0 +1,122 @@
+"""paddle.audio.features — Spectrogram / MelSpectrogram / MFCC layers.
+
+Reference: python/paddle/audio/features/layers.py. The STFT is framing
+(strided gather) + window + rfft — all staged through the dispatch tape so
+feature extraction is differentiable and jit-stageable.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import fft as _fft
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+from ..nn import Layer
+from . import functional as F
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
+
+
+def _frame(x, frame_length, hop_length, center=True, pad_mode="reflect"):
+    """[..., T] -> [..., n_frames, frame_length]."""
+    def f(a):
+        if center:
+            pad = [(0, 0)] * (a.ndim - 1) + [(frame_length // 2,
+                                              frame_length // 2)]
+            a = jnp.pad(a, pad, mode=pad_mode)
+        T = a.shape[-1]
+        n = 1 + (T - frame_length) // hop_length
+        starts = jnp.arange(n) * hop_length
+        idx = starts[:, None] + jnp.arange(frame_length)[None, :]
+        return jnp.take(a, idx, axis=-1)
+    return apply("audio_frame", f, [x])
+
+
+class Spectrogram(Layer):
+    """Reference: audio/features/layers.py Spectrogram."""
+
+    def __init__(self, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 dtype="float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        w = F.get_window(window, self.win_length, dtype=dtype)
+        if self.win_length < n_fft:  # center-pad window to n_fft
+            lpad = (n_fft - self.win_length) // 2
+            w = Tensor(jnp.pad(w._data,
+                               (lpad, n_fft - self.win_length - lpad)))
+        self.register_buffer("window", w)
+
+    def forward(self, x):
+        frames = _frame(x, self.n_fft, self.hop_length, self.center,
+                        self.pad_mode)
+        windowed = apply("stft_window", lambda a, w: a * w,
+                         [frames, self.window])
+        spec = _fft.rfft(windowed, n=self.n_fft, axis=-1)
+        # [..., n_frames, n_fft//2+1] -> [..., freq, time]
+        mag = apply("spec_power",
+                    lambda s: jnp.abs(s) ** self.power
+                    if self.power != 1.0 else jnp.abs(s), [spec])
+        return apply("spec_transpose", lambda a: jnp.swapaxes(a, -1, -2),
+                     [mag])
+
+
+class MelSpectrogram(Layer):
+    """Reference: audio/features/layers.py MelSpectrogram."""
+
+    def __init__(self, sr=22050, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", dtype="float32"):
+        super().__init__()
+        self.spectrogram = Spectrogram(n_fft, hop_length, win_length,
+                                       window, power, center, pad_mode,
+                                       dtype)
+        fb = F.compute_fbank_matrix(sr, n_fft, n_mels, f_min, f_max, htk,
+                                    norm)
+        self.register_buffer("fbank_matrix", fb)
+
+    def forward(self, x):
+        spec = self.spectrogram(x)           # [..., freq, time]
+        return apply("mel_project", lambda fb, s: fb @ s,
+                     [self.fbank_matrix, spec])
+
+
+class LogMelSpectrogram(Layer):
+    """Reference: audio/features/layers.py LogMelSpectrogram."""
+
+    def __init__(self, sr=22050, ref_value=1.0, amin=1e-10, top_db=None,
+                 **kwargs):
+        super().__init__()
+        self.mel = MelSpectrogram(sr=sr, **kwargs)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        return F.power_to_db(self.mel(x), self.ref_value, self.amin,
+                             self.top_db)
+
+
+class MFCC(Layer):
+    """Reference: audio/features/layers.py MFCC."""
+
+    def __init__(self, sr=22050, n_mfcc=40, norm="ortho", **kwargs):
+        super().__init__()
+        self.log_mel = LogMelSpectrogram(sr=sr, top_db=80.0, **kwargs)
+        n_mels = self.log_mel.mel.fbank_matrix.shape[0]
+        self.register_buffer("dct_matrix", F.create_dct(n_mfcc, n_mels,
+                                                        norm))
+
+    def forward(self, x):
+        logmel = self.log_mel(x)             # [..., n_mels, time]
+        return apply("mfcc_dct", lambda d, s: jnp.swapaxes(
+            jnp.swapaxes(s, -1, -2) @ d, -1, -2),
+            [self.dct_matrix, logmel])

@@ -1,0 +1,141 @@
+"""paddle.audio.functional — windows, mel scales, spectrogram math.
+
+Reference: python/paddle/audio/functional/{window,functional}.py. All pure
+jnp through the dispatch tape; the STFT rides paddle.fft (XLA FFT HLO, with
+the CPU fallback where the runtime lacks it).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+
+__all__ = ["hz_to_mel", "mel_to_hz", "mel_frequencies", "fft_frequencies",
+           "compute_fbank_matrix", "power_to_db", "create_dct",
+           "get_window"]
+
+
+def _as_np(x):
+    return np.asarray(x.numpy() if isinstance(x, Tensor) else x)
+
+
+def hz_to_mel(freq, htk=False):
+    """Reference: audio/functional/functional.py hz_to_mel (slaney
+    default)."""
+    scalar = np.isscalar(freq)
+    f = _as_np(freq).astype(np.float32)
+    if htk:
+        m = 2595.0 * np.log10(1.0 + f / 700.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        m = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        m = np.where(f >= min_log_hz,
+                     min_log_mel + np.log(np.maximum(f, 1e-10)
+                                          / min_log_hz) / logstep, m)
+    return float(m) if scalar else Tensor(jnp.asarray(m))
+
+
+def mel_to_hz(mel, htk=False):
+    scalar = np.isscalar(mel)
+    m = _as_np(mel).astype(np.float32)
+    if htk:
+        f = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        f = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        f = np.where(m >= min_log_mel,
+                     min_log_hz * np.exp(logstep * (m - min_log_mel)), f)
+    return float(f) if scalar else Tensor(jnp.asarray(f))
+
+
+def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False):
+    lo = hz_to_mel(float(f_min), htk)
+    hi = hz_to_mel(float(f_max), htk)
+    mels = np.linspace(lo, hi, n_mels)
+    return Tensor(jnp.asarray(_as_np(mel_to_hz(mels, htk)), jnp.float32))
+
+def fft_frequencies(sr, n_fft):
+    return Tensor(jnp.linspace(0, sr / 2, 1 + n_fft // 2,
+                               dtype=jnp.float32))
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
+                         htk=False, norm="slaney"):
+    """Mel filterbank [n_mels, 1 + n_fft//2]."""
+    f_max = f_max if f_max is not None else sr / 2.0
+    fft_f = np.asarray(fft_frequencies(sr, n_fft).numpy())
+    mel_f = np.asarray(
+        mel_frequencies(n_mels + 2, f_min, f_max, htk).numpy())
+    fdiff = np.diff(mel_f)
+    ramps = mel_f[:, None] - fft_f[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    fb = np.maximum(0.0, np.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (mel_f[2:n_mels + 2] - mel_f[:n_mels])
+        fb *= enorm[:, None]
+    return Tensor(jnp.asarray(fb, jnp.float32))
+
+
+def power_to_db(spect, ref_value=1.0, amin=1e-10, top_db=80.0):
+    """Reference: audio/functional power_to_db."""
+    def f(s):
+        log_spec = 10.0 * jnp.log10(jnp.maximum(amin, s))
+        log_spec = log_spec - 10.0 * jnp.log10(
+            jnp.maximum(amin, ref_value))
+        if top_db is not None:
+            log_spec = jnp.maximum(log_spec, log_spec.max() - top_db)
+        return log_spec
+    return apply("power_to_db", f,
+                 [spect if isinstance(spect, Tensor) else Tensor(spect)])
+
+
+def create_dct(n_mfcc, n_mels, norm="ortho"):
+    """DCT-II matrix [n_mels, n_mfcc]."""
+    n = np.arange(n_mels, dtype=np.float64)
+    k = np.arange(n_mfcc, dtype=np.float64)[None, :]
+    dct = np.cos(math.pi / n_mels * (n[:, None] + 0.5) * k)
+    if norm == "ortho":
+        dct[:, 0] *= 1.0 / math.sqrt(2)
+        dct *= math.sqrt(2.0 / n_mels)
+    else:
+        dct *= 2.0
+    return Tensor(jnp.asarray(dct, jnp.float32))
+
+
+def get_window(window, win_length, fftbins=True, dtype="float32"):
+    """Reference: audio/functional/window.py get_window."""
+    N = win_length
+    M = N if not fftbins else N + 1  # periodic windows drop the last point
+    n = np.arange(M, dtype=np.float64)
+    if window in ("hann", "hanning"):
+        w = 0.5 - 0.5 * np.cos(2 * math.pi * n / (M - 1))
+    elif window == "hamming":
+        w = 0.54 - 0.46 * np.cos(2 * math.pi * n / (M - 1))
+    elif window == "blackman":
+        w = (0.42 - 0.5 * np.cos(2 * math.pi * n / (M - 1))
+             + 0.08 * np.cos(4 * math.pi * n / (M - 1)))
+    elif window in ("rect", "boxcar", "ones"):
+        w = np.ones(M)
+    elif window == "bartlett":
+        w = 1.0 - np.abs(2 * n / (M - 1) - 1.0)
+    elif window == "bohman":
+        x = np.abs(2 * n / (M - 1) - 1.0)
+        w = (1 - x) * np.cos(math.pi * x) + np.sin(math.pi * x) / math.pi
+    elif window == "cosine":
+        w = np.sin(math.pi * (n + 0.5) / M)
+    else:
+        raise ValueError(f"unsupported window {window!r}")
+    w = w[:N]
+    return Tensor(jnp.asarray(w, dtype))

@@ -56,8 +56,24 @@ def _amp_cast(name, inputs):
     return out
 
 
+_op_profiler = None  # set by paddle_tpu.profiler to record per-op timing
+
+
 def apply(name: str, fwd: Callable, inputs: Sequence[Any], nout: int = 1,
           has_aux: bool = False):
+    hook = _op_profiler
+    if hook is None:
+        return _apply_impl(name, fwd, inputs, nout, has_aux)
+    import time
+    t0 = time.perf_counter()
+    try:
+        return _apply_impl(name, fwd, inputs, nout, has_aux)
+    finally:
+        hook(name, t0, time.perf_counter(), inputs)
+
+
+def _apply_impl(name: str, fwd: Callable, inputs: Sequence[Any],
+                nout: int = 1, has_aux: bool = False):
     """Execute an eager op through the autograd tape.
 
     fwd operates on raw jax arrays. Convention:

@@ -101,25 +101,10 @@ class HybridParallelClipGrad:
         self._hcg = hcg
 
     def __call__(self, params_grads):
-        import jax.numpy as jnp
-
-        from ...core.tensor import Tensor
-        sq = [jnp.sum(g._data.astype(jnp.float32) ** 2)
-              for p, g in params_grads
-              if g is not None and getattr(p, "need_clip", True)]
-        if not sq or self.clip_norm is None:
-            return params_grads
-        global_norm = jnp.sqrt(sum(sq))
-        scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
-        out = []
-        for p, g in params_grads:
-            if g is None or not getattr(p, "need_clip", True):
-                out.append((p, g))
-                continue
-            out.append((p, Tensor((g._data.astype(jnp.float32)
-                                   * scale).astype(g._data.dtype),
-                                  stop_gradient=True)))
-        return out
+        # one global norm over global arrays IS the cross-group norm —
+        # delegate to the wrapped clip so the math lives in one place
+        # (nn/clip.py ClipGradByGlobalNorm)
+        return self._clip(params_grads)
 
 
 class HybridParallelOptimizer:

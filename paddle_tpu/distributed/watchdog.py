@@ -18,33 +18,43 @@ import os
 import threading
 
 _watchdog = None
+_disabled = False
 _lock = threading.Lock()
 
 
 def start_step_watchdog(timeout_seconds: float, abort_on_trip: bool = True):
     """Arm (or re-arm) the global per-step watchdog."""
-    global _watchdog
+    global _watchdog, _disabled
+    import atexit
+
     from .tcp_store import Watchdog
     with _lock:
         if _watchdog is not None:
             _watchdog.stop()
         _watchdog = Watchdog(timeout_seconds=timeout_seconds,
                              abort_on_trip=abort_on_trip)
+        _disabled = False
+        atexit.register(stop_step_watchdog)  # normal exit must disarm
     return _watchdog
 
 
 def stop_step_watchdog():
-    global _watchdog
+    """Disarm durably: beat()/get_step_watchdog() will NOT re-arm from the
+    env var afterwards (a finished train loop followed by slow eval or
+    checkpointing must not be shot by a stale timeout)."""
+    global _watchdog, _disabled
     with _lock:
         if _watchdog is not None:
             _watchdog.stop()
             _watchdog = None
+        _disabled = True
 
 
 def get_step_watchdog():
-    """The armed watchdog, auto-arming from PADDLE_TPU_WATCHDOG_TIMEOUT."""
+    """The armed watchdog, auto-arming from PADDLE_TPU_WATCHDOG_TIMEOUT
+    (unless durably stopped via stop_step_watchdog)."""
     global _watchdog
-    if _watchdog is None:
+    if _watchdog is None and not _disabled:
         t = os.environ.get("PADDLE_TPU_WATCHDOG_TIMEOUT")
         if t:
             start_step_watchdog(float(t))

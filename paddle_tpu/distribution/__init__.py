@@ -18,7 +18,12 @@ from ..core.dispatch import apply
 from ..core.tensor import Tensor
 
 __all__ = ["Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
-           "kl_divergence"]
+           "kl_divergence", "Beta", "Dirichlet", "Exponential", "Gamma",
+           "Geometric", "Gumbel", "Laplace", "LogNormal", "Multinomial",
+           "Poisson", "StudentT", "Transform", "AbsTransform",
+           "AffineTransform", "ExpTransform", "SigmoidTransform",
+           "TanhTransform", "PowerTransform", "ChainTransform",
+           "TransformedDistribution", "Independent"]
 
 
 def _t(x):
@@ -199,3 +204,12 @@ def kl_divergence(p, q):
     raise NotImplementedError(
         f"kl_divergence({type(p).__name__}, {type(q).__name__}) "
         "is not registered")
+
+
+from .extra import (  # noqa: E402,F401
+    AbsTransform, AffineTransform, Beta, ChainTransform, Dirichlet,
+    Exponential, ExpTransform, Gamma, Geometric, Gumbel, Independent,
+    Laplace, LogNormal, Multinomial, Poisson, PowerTransform,
+    SigmoidTransform, StudentT, TanhTransform, Transform,
+    TransformedDistribution,
+)

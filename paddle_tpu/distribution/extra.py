@@ -1,0 +1,597 @@
+"""Additional distributions + transforms.
+
+Reference: python/paddle/distribution/{beta,dirichlet,exponential,gamma,
+geometric,gumbel,laplace,lognormal,multinomial,poisson,transform,
+transformed_distribution}.py. Sampling draws framework RNG keys
+(core/random.py) so paddle.seed governs determinism; log_prob/entropy run
+through the dispatch tape and are differentiable.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import random as _random
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+
+__all__ = ["Beta", "Dirichlet", "Exponential", "Gamma", "Geometric",
+           "Gumbel", "Laplace", "LogNormal", "Multinomial", "Poisson",
+           "StudentT", "Transform", "AbsTransform", "AffineTransform",
+           "ExpTransform", "SigmoidTransform", "TanhTransform",
+           "PowerTransform", "ChainTransform", "TransformedDistribution",
+           "Independent"]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(np.asarray(x, np.float32))
+
+
+def _arr(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _key():
+    return _random.next_key()
+
+
+def _op(name, fn, ins):
+    return apply(name, fn, [t if isinstance(t, Tensor) else _t(t)
+                            for t in ins])
+
+
+from . import Distribution  # noqa: E402  (base class from the package root)
+
+
+class Exponential(Distribution):
+    """Reference: distribution/exponential.py. rate λ; pdf λ e^{-λx}."""
+
+    def __init__(self, rate):
+        self.rate = _t(rate)
+
+    @property
+    def mean(self):
+        return _op("div", lambda r: 1.0 / r, [self.rate])
+
+    @property
+    def variance(self):
+        return _op("var", lambda r: 1.0 / (r * r), [self.rate])
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + tuple(self.rate.shape)
+        u = jax.random.uniform(_key(), shape, jnp.float32, 1e-7, 1.0)
+        return Tensor(-jnp.log(u) / _arr(self.rate), stop_gradient=True)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        return _op("exp_lp",
+                   lambda r, v: jnp.log(r) - r * v, [self.rate, _t(value)])
+
+    def entropy(self):
+        return _op("exp_ent", lambda r: 1.0 - jnp.log(r), [self.rate])
+
+
+class Gamma(Distribution):
+    """Reference: distribution/gamma.py (concentration/rate)."""
+
+    def __init__(self, concentration, rate):
+        self.concentration = _t(concentration)
+        self.rate = _t(rate)
+
+    @property
+    def mean(self):
+        return _op("gmean", lambda a, r: a / r,
+                   [self.concentration, self.rate])
+
+    @property
+    def variance(self):
+        return _op("gvar", lambda a, r: a / (r * r),
+                   [self.concentration, self.rate])
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + tuple(self.concentration.shape)
+        g = jax.random.gamma(_key(), _arr(self.concentration), shape)
+        return Tensor(g / _arr(self.rate), stop_gradient=True)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+        return _op("glp", lambda a, r, v: a * jnp.log(r)
+                   + (a - 1) * jnp.log(v) - r * v - gammaln(a),
+                   [self.concentration, self.rate, _t(value)])
+
+    def entropy(self):
+        from jax.scipy.special import digamma, gammaln
+        return _op("gent", lambda a, r: a - jnp.log(r) + gammaln(a)
+                   + (1 - a) * digamma(a),
+                   [self.concentration, self.rate])
+
+
+class Beta(Distribution):
+    """Reference: distribution/beta.py."""
+
+    def __init__(self, alpha, beta):
+        self.alpha = _t(alpha)
+        self.beta = _t(beta)
+
+    @property
+    def mean(self):
+        return _op("bmean", lambda a, b: a / (a + b),
+                   [self.alpha, self.beta])
+
+    @property
+    def variance(self):
+        return _op("bvar",
+                   lambda a, b: a * b / ((a + b) ** 2 * (a + b + 1)),
+                   [self.alpha, self.beta])
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + tuple(self.alpha.shape)
+        s = jax.random.beta(_key(), _arr(self.alpha), _arr(self.beta),
+                            shape)
+        return Tensor(s, stop_gradient=True)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        from jax.scipy.special import betaln
+        return _op("blp", lambda a, b, v: (a - 1) * jnp.log(v)
+                   + (b - 1) * jnp.log1p(-v) - betaln(a, b),
+                   [self.alpha, self.beta, _t(value)])
+
+    def entropy(self):
+        from jax.scipy.special import betaln, digamma
+        return _op("bent", lambda a, b: betaln(a, b)
+                   - (a - 1) * digamma(a) - (b - 1) * digamma(b)
+                   + (a + b - 2) * digamma(a + b),
+                   [self.alpha, self.beta])
+
+
+class Dirichlet(Distribution):
+    """Reference: distribution/dirichlet.py."""
+
+    def __init__(self, concentration):
+        self.concentration = _t(concentration)
+
+    @property
+    def mean(self):
+        return _op("dmean", lambda c: c / jnp.sum(c, -1, keepdims=True),
+                   [self.concentration])
+
+    def sample(self, shape=()):
+        s = jax.random.dirichlet(_key(), _arr(self.concentration),
+                                 tuple(shape))
+        return Tensor(s, stop_gradient=True)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+        return _op("dlp", lambda c, v: jnp.sum((c - 1) * jnp.log(v), -1)
+                   + gammaln(jnp.sum(c, -1)) - jnp.sum(gammaln(c), -1),
+                   [self.concentration, _t(value)])
+
+    def entropy(self):
+        from jax.scipy.special import digamma, gammaln
+
+        def f(c):
+            a0 = jnp.sum(c, -1)
+            k = c.shape[-1]
+            lnB = jnp.sum(gammaln(c), -1) - gammaln(a0)
+            return lnB + (a0 - k) * digamma(a0) \
+                - jnp.sum((c - 1) * digamma(c), -1)
+        return _op("dent", f, [self.concentration])
+
+
+class Laplace(Distribution):
+    """Reference: distribution/laplace.py."""
+
+    def __init__(self, loc, scale):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return _op("lvar", lambda s: 2 * s * s, [self.scale])
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + tuple(jnp.broadcast_shapes(
+            self.loc.shape, self.scale.shape))
+        s = jax.random.laplace(_key(), shape, jnp.float32)
+        return Tensor(_arr(self.loc) + _arr(self.scale) * s,
+                      stop_gradient=True)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        return _op("llp", lambda m, s, v: -jnp.abs(v - m) / s
+                   - jnp.log(2 * s), [self.loc, self.scale, _t(value)])
+
+    def entropy(self):
+        return _op("lent", lambda s: 1 + jnp.log(2 * s), [self.scale])
+
+
+class Gumbel(Distribution):
+    """Reference: distribution/gumbel.py."""
+
+    def __init__(self, loc, scale):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+
+    @property
+    def mean(self):
+        g = np.float32(np.euler_gamma)
+        return _op("gumean", lambda m, s: m + g * s,
+                   [self.loc, self.scale])
+
+    @property
+    def variance(self):
+        c = np.float32(math.pi ** 2 / 6)
+        return _op("guvar", lambda s: c * s * s, [self.scale])
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + tuple(jnp.broadcast_shapes(
+            self.loc.shape, self.scale.shape))
+        s = jax.random.gumbel(_key(), shape, jnp.float32)
+        return Tensor(_arr(self.loc) + _arr(self.scale) * s,
+                      stop_gradient=True)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def f(m, s, v):
+            z = (v - m) / s
+            return -(z + jnp.exp(-z)) - jnp.log(s)
+        return _op("gulp", f, [self.loc, self.scale, _t(value)])
+
+    def entropy(self):
+        g = np.float32(np.euler_gamma)
+        return _op("guent", lambda s: jnp.log(s) + 1 + g, [self.scale])
+
+
+class Geometric(Distribution):
+    """Reference: distribution/geometric.py (k failures before success,
+    support {0, 1, ...})."""
+
+    def __init__(self, probs):
+        self.probs_param = _t(probs)
+
+    @property
+    def mean(self):
+        return _op("geomean", lambda p: (1 - p) / p, [self.probs_param])
+
+    @property
+    def variance(self):
+        return _op("geovar", lambda p: (1 - p) / (p * p),
+                   [self.probs_param])
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + tuple(self.probs_param.shape)
+        u = jax.random.uniform(_key(), shape, jnp.float32, 1e-7, 1.0)
+        p = _arr(self.probs_param)
+        return Tensor(jnp.floor(jnp.log(u) / jnp.log1p(-p)),
+                      stop_gradient=True)
+
+    def log_prob(self, value):
+        return _op("geolp", lambda p, k: k * jnp.log1p(-p) + jnp.log(p),
+                   [self.probs_param, _t(value)])
+
+    def entropy(self):
+        def f(p):
+            q = 1 - p
+            return -(q * jnp.log(q) + p * jnp.log(p)) / p
+        return _op("geoent", f, [self.probs_param])
+
+
+class LogNormal(Distribution):
+    """Reference: distribution/lognormal.py."""
+
+    def __init__(self, loc, scale):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+
+    @property
+    def mean(self):
+        return _op("lnmean", lambda m, s: jnp.exp(m + s * s / 2),
+                   [self.loc, self.scale])
+
+    @property
+    def variance(self):
+        return _op("lnvar",
+                   lambda m, s: (jnp.exp(s * s) - 1)
+                   * jnp.exp(2 * m + s * s), [self.loc, self.scale])
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + tuple(jnp.broadcast_shapes(
+            self.loc.shape, self.scale.shape))
+        z = jax.random.normal(_key(), shape, jnp.float32)
+        return Tensor(jnp.exp(_arr(self.loc) + _arr(self.scale) * z),
+                      stop_gradient=True)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        c = np.float32(0.5 * math.log(2 * math.pi))
+
+        def f(m, s, v):
+            lv = jnp.log(v)
+            return -((lv - m) ** 2) / (2 * s * s) - jnp.log(s) - lv - c
+        return _op("lnlp", f, [self.loc, self.scale, _t(value)])
+
+    def entropy(self):
+        c = np.float32(0.5 * math.log(2 * math.pi) + 0.5)
+        return _op("lnent", lambda m, s: m + jnp.log(s) + c,
+                   [self.loc, self.scale])
+
+
+class Multinomial(Distribution):
+    """Reference: distribution/multinomial.py."""
+
+    def __init__(self, total_count, probs):
+        self.total_count = int(total_count)
+        self.probs_param = _t(probs)
+
+    @property
+    def mean(self):
+        n = self.total_count
+        return _op("mnmean", lambda p: n * p, [self.probs_param])
+
+    def sample(self, shape=()):
+        p = _arr(self.probs_param)
+        shape = tuple(shape)
+        idx = jax.random.categorical(
+            _key(), jnp.log(p), axis=-1,
+            shape=shape + p.shape[:-1] + (self.total_count,))
+        counts = jax.nn.one_hot(idx, p.shape[-1]).sum(-2)
+        return Tensor(counts, stop_gradient=True)
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+
+        def f(p, v):
+            return gammaln(jnp.sum(v, -1) + 1) \
+                - jnp.sum(gammaln(v + 1), -1) \
+                + jnp.sum(v * jnp.log(p), -1)
+        return _op("mnlp", f, [self.probs_param, _t(value)])
+
+
+class Poisson(Distribution):
+    """Reference: distribution/poisson.py."""
+
+    def __init__(self, rate):
+        self.rate = _t(rate)
+
+    @property
+    def mean(self):
+        return self.rate
+
+    @property
+    def variance(self):
+        return self.rate
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + tuple(self.rate.shape)
+        s = jax.random.poisson(_key(), _arr(self.rate), shape)
+        return Tensor(s.astype(jnp.float32), stop_gradient=True)
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+        return _op("plp", lambda r, k: k * jnp.log(r) - r - gammaln(k + 1),
+                   [self.rate, _t(value)])
+
+
+class StudentT(Distribution):
+    """Reference: distribution/student_t.py."""
+
+    def __init__(self, df, loc=0.0, scale=1.0):
+        self.df = _t(df)
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+
+    @property
+    def mean(self):
+        return self.loc
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + tuple(jnp.broadcast_shapes(
+            self.df.shape, self.loc.shape, self.scale.shape))
+        s = jax.random.t(_key(), _arr(self.df), shape, jnp.float32)
+        return Tensor(_arr(self.loc) + _arr(self.scale) * s,
+                      stop_gradient=True)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+
+        def f(df, m, s, v):
+            z = (v - m) / s
+            return gammaln((df + 1) / 2) - gammaln(df / 2) \
+                - 0.5 * jnp.log(df * np.float32(math.pi)) - jnp.log(s) \
+                - (df + 1) / 2 * jnp.log1p(z * z / df)
+        return _op("stlp", f, [self.df, self.loc, self.scale, _t(value)])
+
+
+# ---------------- transforms ----------------
+class Transform:
+    """Reference: distribution/transform.py Transform base."""
+
+    def forward(self, x):
+        return _op(f"{type(self).__name__}_fwd", self._forward, [_t(x)])
+
+    def inverse(self, y):
+        return _op(f"{type(self).__name__}_inv", self._inverse, [_t(y)])
+
+    def forward_log_det_jacobian(self, x):
+        return _op(f"{type(self).__name__}_fldj", self._fldj, [_t(x)])
+
+    def inverse_log_det_jacobian(self, y):
+        inv = self.inverse(y)
+        fldj = self.forward_log_det_jacobian(inv)
+        from .. import ops
+        return ops.neg(fldj)
+
+    def __call__(self, x):
+        return self.forward(x)
+
+
+class ExpTransform(Transform):
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _fldj(self, x):
+        return x
+
+
+class AbsTransform(Transform):
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return y
+
+    def _fldj(self, x):
+        return jnp.zeros_like(x)
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+
+    def _forward(self, x):
+        return _arr(self.loc) + _arr(self.scale) * x
+
+    def _inverse(self, y):
+        return (y - _arr(self.loc)) / _arr(self.scale)
+
+    def _fldj(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(_arr(self.scale))),
+                                x.shape)
+
+
+class PowerTransform(Transform):
+    def __init__(self, power):
+        self.power = _t(power)
+
+    def _forward(self, x):
+        return jnp.power(x, _arr(self.power))
+
+    def _inverse(self, y):
+        return jnp.power(y, 1.0 / _arr(self.power))
+
+    def _fldj(self, x):
+        p = _arr(self.power)
+        return jnp.log(jnp.abs(p * jnp.power(x, p - 1)))
+
+
+class SigmoidTransform(Transform):
+    def _forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _fldj(self, x):
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+
+class TanhTransform(Transform):
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(y)
+
+    def _fldj(self, x):
+        return 2.0 * (jnp.log(jnp.float32(2.0)) - x
+                      - jax.nn.softplus(-2.0 * x))
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def forward(self, x):
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t.inverse(y)
+        return y
+
+    def forward_log_det_jacobian(self, x):
+        from .. import ops
+        total = None
+        for t in self.transforms:
+            ld = t.forward_log_det_jacobian(x)
+            total = ld if total is None else ops.add(total, ld)
+            x = t.forward(x)
+        return total
+
+
+class TransformedDistribution(Distribution):
+    """Reference: distribution/transformed_distribution.py."""
+
+    def __init__(self, base, transforms):
+        self.base = base
+        if isinstance(transforms, Transform):
+            transforms = [transforms]
+        self.transform = ChainTransform(transforms) \
+            if len(transforms) != 1 else transforms[0]
+
+    def sample(self, shape=()):
+        return self.transform.forward(self.base.sample(shape))
+
+    def rsample(self, shape=()):
+        return self.transform.forward(self.base.rsample(shape))
+
+    def log_prob(self, value):
+        from .. import ops
+        x = self.transform.inverse(value)
+        base_lp = self.base.log_prob(x)
+        ildj = self.transform.forward_log_det_jacobian(x)
+        return ops.subtract(base_lp, ildj)
+
+
+class Independent(Distribution):
+    """Reference: distribution/independent.py — reinterprets batch dims as
+    event dims (log_prob sums over them)."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self.base.rsample(shape)
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value)
+        from .. import ops
+        for _ in range(self.rank):
+            lp = ops.sum(lp, axis=-1)
+        return lp
+
+    def entropy(self):
+        ent = self.base.entropy()
+        from .. import ops
+        for _ in range(self.rank):
+            ent = ops.sum(ent, axis=-1)
+        return ent

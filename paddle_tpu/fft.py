@@ -89,6 +89,12 @@ def _cpu_fallback(fn):
     def wrapper(x, *args, **kwargs):
         if _device_fft_supported():
             return fn(x, *args, **kwargs)
+        if isinstance(x, Tensor) and isinstance(x._data, jax.core.Tracer):
+            raise RuntimeError(
+                "paddle.fft inside jit/to_static is unavailable on this "
+                "runtime (the device lacks the FFT HLO and the host "
+                "fallback cannot run under tracing); call the fft op "
+                "eagerly, outside the staged function")
         cpu = jax.local_devices(backend="cpu")[0]
         xc = x
         if isinstance(x, Tensor) and not _on_cpu(x):

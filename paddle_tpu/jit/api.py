@@ -272,7 +272,8 @@ class StaticFunction:
         state_in = [t._data for t in params] + [b._data for b in buffers] + \
             [cont[k] for cont, k in slots]
         # keep only avals for compiled_text() — retaining the concrete
-        # arrays would pin a full copy of model+optimizer state
+        # arrays would pin a full copy of model+optimizer state; shapes are
+        # fixed per cache entry, so build them once per jitted fn
         def _aval(a):
             # mesh shardings matter for SPMD lowering; single-device
             # placements are left off (committed single-device avals would
@@ -286,9 +287,12 @@ class StaticFunction:
                     pass
             return jax.ShapeDtypeStruct(a.shape, a.dtype)
 
-        self._last_exec = (jitted, ([_aval(a) for a in state_in],
-                                    [_aval(t._data) for t in arg_tensors],
-                                    _aval(rng_key), _aval(lrs)))
+        last = getattr(self, "_last_exec", None)
+        if last is None or last[0] is not jitted:
+            self._last_exec = (jitted, ([_aval(a) for a in state_in],
+                                        [_aval(t._data) for t in
+                                         arg_tensors],
+                                        _aval(rng_key), _aval(lrs)))
         out_arrs, new_state = jitted(state_in,
                                      [t._data for t in arg_tensors],
                                      rng_key, lrs)

@@ -10,3 +10,7 @@ from .bert import (  # noqa: F401
 )
 from .lenet import LeNet  # noqa: F401
 from .resnet import ResNet, resnet18, resnet34, resnet50  # noqa: F401
+from .vision_zoo import (  # noqa: F401
+    AlexNet, MobileNetV1, MobileNetV2, VGG, alexnet, vgg11, vgg13, vgg16,
+    vgg19,
+)

@@ -1,0 +1,224 @@
+"""Vision model zoo — VGG, MobileNetV1/V2, AlexNet.
+
+Reference: python/paddle/vision/models/{vgg,mobilenetv1,mobilenetv2,
+alexnet}.py. TPU notes: NCHW layouts (converted inside Conv2D), BatchNorm
+folded by XLA at inference, depthwise convs lower to grouped
+conv_general_dilated.
+"""
+from __future__ import annotations
+
+from .. import nn
+
+__all__ = ["VGG", "vgg11", "vgg13", "vgg16", "vgg19", "MobileNetV1",
+           "MobileNetV2", "AlexNet", "alexnet"]
+
+
+_VGG_CFGS = {
+    "A": [64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
+    "B": [64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M", 512,
+          512, "M"],
+    "D": [64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512,
+          "M", 512, 512, 512, "M"],
+    "E": [64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M", 512, 512,
+          512, 512, "M", 512, 512, 512, 512, "M"],
+}
+
+
+class VGG(nn.Layer):
+    """Reference: vision/models/vgg.py VGG."""
+
+    def __init__(self, features, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.features = features
+        self.with_pool = with_pool
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D((7, 7))
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Linear(512 * 7 * 7, 4096), nn.ReLU(), nn.Dropout(),
+                nn.Linear(4096, 4096), nn.ReLU(), nn.Dropout(),
+                nn.Linear(4096, num_classes))
+        self.num_classes = num_classes
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = x.flatten(1)
+            x = self.classifier(x)
+        return x
+
+
+def _vgg_features(cfg, batch_norm=False):
+    layers = []
+    in_c = 3
+    for v in _VGG_CFGS[cfg]:
+        if v == "M":
+            layers.append(nn.MaxPool2D(kernel_size=2, stride=2))
+        else:
+            layers.append(nn.Conv2D(in_c, v, 3, padding=1))
+            if batch_norm:
+                layers.append(nn.BatchNorm2D(v))
+            layers.append(nn.ReLU())
+            in_c = v
+    return nn.Sequential(*layers)
+
+
+def vgg11(pretrained=False, batch_norm=False, **kwargs):
+    assert not pretrained, "no pretrained weights in this environment"
+    return VGG(_vgg_features("A", batch_norm), **kwargs)
+
+
+def vgg13(pretrained=False, batch_norm=False, **kwargs):
+    assert not pretrained, "no pretrained weights in this environment"
+    return VGG(_vgg_features("B", batch_norm), **kwargs)
+
+
+def vgg16(pretrained=False, batch_norm=False, **kwargs):
+    assert not pretrained, "no pretrained weights in this environment"
+    return VGG(_vgg_features("D", batch_norm), **kwargs)
+
+
+def vgg19(pretrained=False, batch_norm=False, **kwargs):
+    assert not pretrained, "no pretrained weights in this environment"
+    return VGG(_vgg_features("E", batch_norm), **kwargs)
+
+
+class _ConvBNReLU(nn.Layer):
+    def __init__(self, in_c, out_c, k, stride=1, padding=0, groups=1,
+                 relu6=False):
+        super().__init__()
+        self.conv = nn.Conv2D(in_c, out_c, k, stride=stride, padding=padding,
+                              groups=groups, bias_attr=False)
+        self.bn = nn.BatchNorm2D(out_c)
+        self.act = nn.ReLU6() if relu6 else nn.ReLU()
+
+    def forward(self, x):
+        return self.act(self.bn(self.conv(x)))
+
+
+class MobileNetV1(nn.Layer):
+    """Reference: vision/models/mobilenetv1.py (depthwise separable)."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        def c(ch):
+            return max(int(ch * scale), 8)
+
+        cfg = [(32, 64, 1), (64, 128, 2), (128, 128, 1), (128, 256, 2),
+               (256, 256, 1), (256, 512, 2)] + [(512, 512, 1)] * 5 + \
+            [(512, 1024, 2), (1024, 1024, 1)]
+        layers = [_ConvBNReLU(3, c(32), 3, stride=2, padding=1)]
+        for in_ch, out_ch, s in cfg:
+            layers.append(_ConvBNReLU(c(in_ch), c(in_ch), 3, stride=s,
+                                      padding=1, groups=c(in_ch)))
+            layers.append(_ConvBNReLU(c(in_ch), c(out_ch), 1))
+        self.features = nn.Sequential(*layers)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(c(1024), num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = x.flatten(1)
+            x = self.fc(x)
+        return x
+
+
+class _InvertedResidual(nn.Layer):
+    def __init__(self, in_c, out_c, stride, expand):
+        super().__init__()
+        hidden = int(round(in_c * expand))
+        self.use_res = stride == 1 and in_c == out_c
+        layers = []
+        if expand != 1:
+            layers.append(_ConvBNReLU(in_c, hidden, 1, relu6=True))
+        layers += [
+            _ConvBNReLU(hidden, hidden, 3, stride=stride, padding=1,
+                        groups=hidden, relu6=True),
+            nn.Conv2D(hidden, out_c, 1, bias_attr=False),
+            nn.BatchNorm2D(out_c),
+        ]
+        self.conv = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.conv(x)
+        return x + out if self.use_res else out
+
+
+class MobileNetV2(nn.Layer):
+    """Reference: vision/models/mobilenetv2.py (inverted residuals)."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        def c(ch):
+            return max(int(ch * scale + 4) // 8 * 8, 8)
+
+        cfg = [(1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+               (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1)]
+        in_c = c(32)
+        layers = [_ConvBNReLU(3, in_c, 3, stride=2, padding=1, relu6=True)]
+        for t, ch, n, s in cfg:
+            out_c = c(ch)
+            for i in range(n):
+                layers.append(_InvertedResidual(
+                    in_c, out_c, s if i == 0 else 1, t))
+                in_c = out_c
+        last = c(1280) if scale > 1.0 else 1280
+        layers.append(_ConvBNReLU(in_c, last, 1, relu6=True))
+        self.features = nn.Sequential(*layers)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Sequential(nn.Dropout(0.2),
+                                            nn.Linear(last, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = x.flatten(1)
+            x = self.classifier(x)
+        return x
+
+
+class AlexNet(nn.Layer):
+    """Reference: vision/models/alexnet.py."""
+
+    def __init__(self, num_classes=1000):
+        super().__init__()
+        self.features = nn.Sequential(
+            nn.Conv2D(3, 64, 11, stride=4, padding=2), nn.ReLU(),
+            nn.MaxPool2D(3, stride=2),
+            nn.Conv2D(64, 192, 5, padding=2), nn.ReLU(),
+            nn.MaxPool2D(3, stride=2),
+            nn.Conv2D(192, 384, 3, padding=1), nn.ReLU(),
+            nn.Conv2D(384, 256, 3, padding=1), nn.ReLU(),
+            nn.Conv2D(256, 256, 3, padding=1), nn.ReLU(),
+            nn.MaxPool2D(3, stride=2))
+        self.classifier = nn.Sequential(
+            nn.Dropout(), nn.Linear(256 * 6 * 6, 4096), nn.ReLU(),
+            nn.Dropout(), nn.Linear(4096, 4096), nn.ReLU(),
+            nn.Linear(4096, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        x = x.flatten(1)
+        return self.classifier(x)
+
+
+def alexnet(pretrained=False, **kwargs):
+    assert not pretrained, "no pretrained weights in this environment"
+    return AlexNet(**kwargs)

@@ -9,12 +9,41 @@ device trace. ``benchmark``-style summaries are derived host-side.
 from __future__ import annotations
 
 import contextlib
+import json
+import os
+import threading
 import time
 
 import jax
 
 __all__ = ["Profiler", "RecordEvent", "ProfilerTarget", "profiler_guard",
            "load_profiler_result"]
+
+
+class _OpTracer:
+    """Host-side per-op tracer fed by the dispatch hook (reference: the
+    host tracer half of platform/profiler — op events with timestamps,
+    durations, call counts, and input signatures)."""
+
+    def __init__(self, record_shapes=False):
+        self.events = []          # (name, t0, t1, shapes)
+        self.record_shapes = record_shapes
+        self._lock = threading.Lock()
+
+    def __call__(self, name, t0, t1, inputs):
+        shapes = None
+        if self.record_shapes:
+            shapes = [tuple(getattr(t, "shape", ())) for t in inputs]
+        with self._lock:
+            self.events.append((name, t0, t1, shapes))
+
+    def op_table(self):
+        agg = {}
+        for name, t0, t1, _ in self.events:
+            total, count, mx = agg.get(name, (0.0, 0, 0.0))
+            dt = t1 - t0
+            agg[name] = (total + dt, count + 1, max(mx, dt))
+        return agg
 
 
 class ProfilerTarget:
@@ -70,15 +99,21 @@ class Profiler:
         self._running = False
         self._step_times = []
         self._last_step = None
+        self._op_tracer = _OpTracer(record_shapes=record_shapes)
 
     def start(self):
         if not self.timer_only:
             jax.profiler.start_trace(self.log_dir)
+        from ..core import dispatch as _dispatch
+        _dispatch._op_profiler = self._op_tracer
         self._running = True
         self._last_step = time.perf_counter()
         return self
 
     def stop(self):
+        from ..core import dispatch as _dispatch
+        if _dispatch._op_profiler is self._op_tracer:  # only clear our own
+            _dispatch._op_profiler = None
         if self._running and not self.timer_only:
             jax.profiler.stop_trace()
         self._running = False
@@ -102,18 +137,49 @@ class Profiler:
 
     def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
                 time_unit="ms"):
-        lines = ["---- paddle_tpu profiler summary (host scopes) ----"]
-        for name, (total, count) in sorted(RecordEvent._stats.items(),
-                                           key=lambda kv: -kv[1][0]):
-            lines.append(f"{name:40s} calls={count:6d} "
-                         f"total={total*1e3:10.2f} ms "
-                         f"avg={total/max(count,1)*1e3:8.3f} ms")
+        lines = ["---- paddle_tpu profiler summary ----"]
+        if op_detail and self._op_tracer.events:
+            lines.append("-- op-level (host dispatch) "
+                         "(reference: profiler_statistic.py op table) --")
+            lines.append(f"{'op':28s} {'calls':>7s} {'total ms':>10s} "
+                         f"{'avg ms':>9s} {'max ms':>9s}")
+            table = self._op_tracer.op_table()
+            for name, (total, count, mx) in sorted(
+                    table.items(), key=lambda kv: -kv[1][0]):
+                lines.append(f"{name:28s} {count:7d} {total*1e3:10.2f} "
+                             f"{total/count*1e3:9.3f} {mx*1e3:9.3f}")
+        if RecordEvent._stats:
+            lines.append("-- user scopes --")
+            for name, (total, count) in sorted(RecordEvent._stats.items(),
+                                               key=lambda kv: -kv[1][0]):
+                lines.append(f"{name:40s} calls={count:6d} "
+                             f"total={total*1e3:10.2f} ms "
+                             f"avg={total/max(count,1)*1e3:8.3f} ms")
         lines.append(self.step_info())
         out = "\n".join(lines)
         print(out)
         return out
 
     def export(self, path=None, format=None):  # noqa: A002
+        """format='chrome' (or a .json path) writes a chrome://tracing /
+        Perfetto-loadable trace of the host op events (reference:
+        chrometracing_logger.cc); otherwise returns the xplane log dir."""
+        if format == "chrome" or (path and str(path).endswith(".json")):
+            path = path or os.path.join(self.log_dir, "host_trace.json")
+            os.makedirs(os.path.dirname(os.path.abspath(path)),
+                        exist_ok=True)
+            events = []
+            for name, t0, t1, shapes in self._op_tracer.events:
+                ev = {"name": name, "ph": "X", "pid": 0, "tid": 0,
+                      "ts": t0 * 1e6, "dur": (t1 - t0) * 1e6,
+                      "cat": "op"}
+                if shapes:
+                    ev["args"] = {"input_shapes": [str(s) for s in shapes]}
+                events.append(ev)
+            with open(path, "w") as f:
+                json.dump({"traceEvents": events,
+                           "displayTimeUnit": "ms"}, f)
+            return path
         return self.log_dir
 
     def __enter__(self):
@@ -135,6 +201,10 @@ def profiler_guard(**kwargs):
 
 
 def load_profiler_result(path):
-    raise NotImplementedError(
-        "open the exported trace directory with TensorBoard "
-        "(xplane format) instead")
+    """Load a chrome-trace json exported by Profiler.export."""
+    if os.path.isfile(path):
+        with open(path) as f:
+            return json.load(f)
+    raise ValueError(
+        f"{path!r} is not a chrome-trace json; xplane directories are "
+        "viewed with TensorBoard instead")

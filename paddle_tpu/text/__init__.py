@@ -12,7 +12,11 @@ from ..core.dispatch import apply
 from ..core.tensor import Tensor
 from .. import nn
 
-__all__ = ["viterbi_decode", "ViterbiDecoder"]
+from . import datasets  # noqa: E402,F401
+from .datasets import Conll05st, Imdb, UCIHousing  # noqa: E402,F401
+
+__all__ = ["viterbi_decode", "ViterbiDecoder", "datasets", "Imdb",
+           "UCIHousing", "Conll05st"]
 
 
 def viterbi_decode(potentials, transition_params, lengths=None,

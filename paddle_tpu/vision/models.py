@@ -3,3 +3,7 @@ from ..models.lenet import LeNet  # noqa: F401
 from ..models.resnet import (  # noqa: F401
     BasicBlock, BottleneckBlock, ResNet, resnet18, resnet34, resnet50,
 )
+from ..models.vision_zoo import (  # noqa: F401
+    AlexNet, MobileNetV1, MobileNetV2, VGG, alexnet, vgg11, vgg13, vgg16,
+    vgg19,
+)

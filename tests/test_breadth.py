@@ -1,0 +1,181 @@
+"""Breadth namespaces (VERDICT r2 #8): vision zoo, distributions, audio,
+profiler op-table/chrome-trace, text datasets."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distribution as D
+import paddle_tpu.nn.functional as F
+
+
+# ---------------- vision zoo ----------------
+@pytest.mark.parametrize("builder,size", [
+    ("vgg11", 64), ("MobileNetV1", 64), ("MobileNetV2", 64),
+])
+def test_vision_zoo_forward(builder, size):
+    from paddle_tpu.vision import models as M
+    paddle.seed(0)
+    kw = {"num_classes": 10}
+    if builder.startswith("MobileNet"):
+        model = getattr(M, builder)(scale=0.25, **kw)
+    else:
+        model = getattr(M, builder)(**kw)
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(2, 3, size, size).astype("float32"))
+    out = model(x)
+    assert out.shape == [2, 10]
+
+
+def test_vision_zoo_trains():
+    from paddle_tpu.vision.models import MobileNetV2
+    paddle.seed(0)
+    m = MobileNetV2(scale=0.25, num_classes=4)
+    opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                parameters=m.parameters())
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(4, 3, 32, 32).astype("float32"))
+    y = paddle.to_tensor(rng.randint(0, 4, 4).astype("int64"))
+    w0 = np.asarray(m.features[0].conv.weight._data).copy()
+    losses = []
+    for _ in range(3):
+        loss = F.cross_entropy(m(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    # 3 steps of a BN net on batch 4 is noisy — assert training mechanics
+    # (finite losses, weights actually moving), not monotonicity
+    assert all(np.isfinite(losses))
+    assert np.abs(np.asarray(m.features[0].conv.weight._data)
+                  - w0).max() > 1e-6
+
+
+# ---------------- distributions ----------------
+def test_distribution_log_probs_golden():
+    """Closed-form checks (no scipy dependency)."""
+    v = 0.7
+    lp = float(D.Exponential(2.0).log_prob(
+        paddle.to_tensor(np.float32(v))).numpy())
+    assert abs(lp - (np.log(2.0) - 2.0 * v)) < 1e-5
+    lp = float(D.Laplace(0.0, 1.0).log_prob(
+        paddle.to_tensor(np.float32(v))).numpy())
+    assert abs(lp - (-abs(v) - np.log(2.0))) < 1e-5
+    lp = float(D.Poisson(3.0).log_prob(
+        paddle.to_tensor(np.float32(2.0))).numpy())
+    assert abs(lp - (2 * np.log(3.0) - 3.0 - np.log(2.0))) < 1e-5
+
+
+def test_transformed_distribution_lognormal_identity():
+    td = D.TransformedDistribution(D.Normal(0.1, 0.9), [D.ExpTransform()])
+    v = paddle.to_tensor(np.float32(1.2))
+    np.testing.assert_allclose(float(td.log_prob(v).numpy()),
+                               float(D.LogNormal(0.1, 0.9)
+                                     .log_prob(v).numpy()), rtol=1e-5)
+
+
+def test_distribution_sampling_moments():
+    paddle.seed(0)
+    s = D.Gamma(3.0, 2.0).sample((4000,))
+    assert abs(float(s.numpy().mean()) - 1.5) < 0.1  # a/r = 1.5
+    s = D.Dirichlet(paddle.to_tensor(
+        np.array([2.0, 3.0, 4.0], np.float32))).sample((100,))
+    np.testing.assert_allclose(s.numpy().sum(-1), 1.0, rtol=1e-5)
+    s = D.Multinomial(10, paddle.to_tensor(
+        np.array([0.2, 0.3, 0.5], np.float32))).sample((200,))
+    np.testing.assert_allclose(s.numpy().sum(-1), 10.0)
+    assert abs(s.numpy()[:, 2].mean() - 5.0) < 0.5
+
+
+def test_transforms_roundtrip():
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .randn(8).astype("float32"))
+    for t in [D.ExpTransform(), D.SigmoidTransform(), D.TanhTransform(),
+              D.AffineTransform(0.5, 2.0)]:
+        y = t.forward(x)
+        back = t.inverse(y)
+        np.testing.assert_allclose(back.numpy(), x.numpy(), rtol=1e-4,
+                                   atol=1e-5)
+
+
+# ---------------- audio ----------------
+def test_audio_spectrogram_peak_physics():
+    paddle.seed(0)
+    sr = 8000
+    t = np.arange(sr, dtype=np.float32) / sr
+    sig = np.sin(2 * np.pi * 500 * t).astype("float32")
+    spec = paddle.audio.Spectrogram(n_fft=256)(paddle.to_tensor(sig[None]))
+    peak = int(np.asarray(spec.numpy())[0].mean(-1).argmax())
+    assert abs(peak - round(500 / (sr / 256))) <= 1  # bin of the 500Hz tone
+
+
+def test_audio_mel_mfcc_shapes_and_grads():
+    paddle.seed(0)
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(2, 4000).astype("float32"),
+        stop_gradient=False)
+    mel = paddle.audio.MelSpectrogram(sr=8000, n_fft=256, n_mels=32)(x)
+    assert mel.shape[0] == 2 and mel.shape[1] == 32
+    mfcc = paddle.audio.MFCC(sr=8000, n_mfcc=13, n_fft=256, n_mels=32)(x)
+    assert mfcc.shape[1] == 13
+    mel.sum().backward()
+    assert x._grad is not None
+
+
+def test_audio_wav_roundtrip():
+    sr = 8000
+    sig = (np.sin(np.linspace(0, 100, sr)) * 0.5).astype("float32")
+    p = os.path.join(tempfile.mkdtemp(), "t.wav")
+    paddle.audio.save(p, paddle.to_tensor(sig[None]), sr)
+    meta = paddle.audio.info(p)
+    assert meta.sample_rate == sr and meta.num_channels == 1
+    y, sr2 = paddle.audio.load(p)
+    assert sr2 == sr
+    np.testing.assert_allclose(y.numpy()[0], sig, atol=1e-4)
+
+
+def test_audio_mel_scale_inverse():
+    f = paddle.audio.functional.mel_to_hz(
+        paddle.audio.functional.hz_to_mel(440.0))
+    assert abs(f - 440.0) < 1e-2
+
+
+# ---------------- profiler ----------------
+def test_profiler_op_table_and_chrome_export(tmp_path):
+    prof = paddle.profiler.Profiler(timer_only=True, record_shapes=True)
+    prof.start()
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .randn(16, 16).astype("float32"))
+    (x @ x).sum()
+    prof.step()
+    prof.stop()
+    out = prof.summary()
+    assert "matmul" in out
+    p = prof.export(path=str(tmp_path / "trace.json"), format="chrome")
+    d = paddle.profiler.load_profiler_result(p)
+    names = {e["name"] for e in d["traceEvents"]}
+    assert "matmul" in names
+    # the hook must be unhooked after stop
+    from paddle_tpu.core import dispatch
+    assert dispatch._op_profiler is None
+
+
+# ---------------- text ----------------
+def test_text_ucihousing_local_file(tmp_path):
+    rng = np.random.RandomState(0)
+    tbl = rng.rand(50, 14).astype("float32")
+    p = str(tmp_path / "housing.data")
+    np.savetxt(p, tbl)
+    ds = paddle.text.UCIHousing(data_file=p, mode="train")
+    assert len(ds) == 40
+    x, y = ds[0]
+    assert x.shape == (13,) and y.shape == (1,)
+    ds_t = paddle.text.UCIHousing(data_file=p, mode="test")
+    assert len(ds_t) == 10
+
+
+def test_text_imdb_requires_local_data():
+    with pytest.raises(RuntimeError, match="egress"):
+        paddle.text.Imdb()

@@ -36,6 +36,7 @@ from . import audio  # noqa: F401
 from . import autograd  # noqa: F401
 from . import fft  # noqa: F401
 from . import framework  # noqa: F401
+from . import inference  # noqa: F401
 from . import io  # noqa: F401
 from . import jit  # noqa: F401
 from . import linalg  # noqa: F401
@@ -49,6 +50,7 @@ from . import metric  # noqa: F401
 from . import models  # noqa: F401
 from . import profiler  # noqa: F401
 from .hapi import Model  # noqa: F401
+from .hapi.summary import summary  # noqa: F401
 from .framework.io import load, save  # noqa: F401
 from .framework.param_attr import ParamAttr  # noqa: F401
 

@@ -1,0 +1,144 @@
+"""Auto-tuner — search hybrid-parallel configs by trial measurement.
+
+Reference: python/paddle/distributed/auto_tuner/{tuner,search,prune}.py
+(AutoTuner.search_once over pruned dp/mp/pp/sharding/micro-batch grids,
+trials launched as real runs). TPU-native differences: candidate degrees
+factor the MESH size (reference: gpus-per-node), pruning knows TPU
+constraints (mp should divide attention heads and ride ICI; dp*sharding*
+mp*pp == n_devices exactly since GSPMD can't oversubscribe), and trials
+run in-process on the mesh (or any callable the user supplies) instead of
+re-launching the job.
+"""
+from __future__ import annotations
+
+import itertools
+import time
+
+__all__ = ["AutoTuner", "default_candidates", "prune_configs"]
+
+
+def _divisors(n):
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+def default_candidates(tuner_cfg):
+    """Reference: auto_tuner/utils.py default_candidates — divisor grids
+    bounded by the tuner config."""
+    n = tuner_cfg["num_devices"]
+    divs = _divisors(n)
+
+    def cand(key, default):
+        v = tuner_cfg.get(key, "auto")
+        return divs if v == "auto" else (v if isinstance(v, list) else [v]) \
+            if v is not None else default
+    return {
+        "dp_degree": cand("dp_degree", divs),
+        "mp_degree": cand("mp_degree", divs),
+        "pp_degree": cand("pp_degree", divs),
+        "sharding_degree": cand("sharding_degree", divs),
+        "micro_batch_size": tuner_cfg.get(
+            "micro_batch_size",
+            [1, 2, 4, 8, 16]) if tuner_cfg.get(
+            "micro_batch_size", "auto") == "auto" else
+            tuner_cfg.get("micro_batch_size"),
+    }
+
+
+def prune_configs(cfgs, tuner_cfg):
+    """Reference: auto_tuner/prune.py rule chain. Keeps configs that can
+    actually run on the mesh/model."""
+    n = tuner_cfg["num_devices"]
+    heads = tuner_cfg.get("num_attention_heads")
+    layers = tuner_cfg.get("num_layers")
+    gbs = tuner_cfg.get("global_batch_size")
+    out = []
+    for c in cfgs:
+        degrees = (c["dp_degree"] * c["mp_degree"] * c["pp_degree"]
+                   * c["sharding_degree"])
+        if degrees != n:
+            continue  # GSPMD mesh must be fully factored
+        if heads and heads % c["mp_degree"]:
+            continue  # mp must divide attention heads
+        if layers and c["pp_degree"] > 1 and layers % c["pp_degree"]:
+            continue  # stages need whole layer blocks
+        if gbs:
+            dp = c["dp_degree"] * c["sharding_degree"]
+            if gbs % dp:
+                continue
+            local = gbs // dp
+            if local % c["micro_batch_size"]:
+                continue
+        out.append(c)
+    return out
+
+
+class AutoTuner:
+    """Reference: auto_tuner/tuner.py AutoTuner (grid search + history).
+
+    Usage::
+
+        tuner = AutoTuner({"num_devices": 8, "num_attention_heads": 8,
+                           "num_layers": 4, "global_batch_size": 16})
+        while (cfg := tuner.search_once()) is not None:
+            metric = run_trial(cfg)          # tokens/s, steps/s, ...
+            tuner.add_cfg({**cfg, "metric": metric})
+        best = tuner.best_cfg()
+    """
+
+    def __init__(self, tuner_cfg):
+        self.tuner_cfg = dict(tuner_cfg)
+        self.task_limit = tuner_cfg.get("task_limit", 100)
+        self.max_time = tuner_cfg.get("max_time_per_task")
+        cands = default_candidates(self.tuner_cfg)
+        keys = ["dp_degree", "mp_degree", "pp_degree", "sharding_degree",
+                "micro_batch_size"]
+        grid = [dict(zip(keys, vals))
+                for vals in itertools.product(*(cands[k] for k in keys))]
+        self._pending = prune_configs(grid, self.tuner_cfg)
+        # wider mp/sharding first: memory-safe configs surface earlier
+        # (reference sorts by a memory-cost model; divisor count proxies it)
+        self._pending.sort(
+            key=lambda c: (-c["mp_degree"] - c["sharding_degree"],
+                           c["micro_batch_size"]))
+        self.history_cfgs = []
+        self.cur_task_id = 0
+
+    def search_once(self):
+        """Next config to trial, or None when exhausted."""
+        if self.cur_task_id >= min(self.task_limit, len(self._pending)):
+            return None
+        cfg = dict(self._pending[self.cur_task_id])
+        self.cur_task_id += 1
+        return cfg
+
+    def add_cfg(self, cfg):
+        self.history_cfgs.append(dict(cfg))
+
+    def best_cfg(self, key="metric", maximize=True):
+        scored = [c for c in self.history_cfgs
+                  if c.get(key) is not None]
+        if not scored:
+            return None
+        return (max if maximize else min)(scored, key=lambda c: c[key])
+
+    # -- convenience driver --
+    def tune(self, trial_fn, verbose=False):
+        """Run trial_fn(cfg) -> metric (higher better; raise or return None
+        for infeasible configs) over the pruned grid; returns the best cfg."""
+        while (cfg := self.search_once()) is not None:
+            t0 = time.time()
+            try:
+                metric = trial_fn(cfg)
+            except Exception as e:  # OOM/incompatible: record and move on
+                cfg["metric"] = None
+                cfg["error"] = f"{type(e).__name__}: {e}"
+                self.add_cfg(cfg)
+                continue
+            cfg["metric"] = metric
+            cfg["time"] = time.time() - t0
+            self.add_cfg(cfg)
+            if verbose:
+                print(f"[auto_tuner] {cfg}")
+            if self.max_time and cfg["time"] > self.max_time:
+                break
+        return self.best_cfg()

@@ -1,0 +1,146 @@
+"""Elastic training — TCPStore-backed membership + scale-aware relaunch.
+
+Reference: python/paddle/distributed/fleet/elastic/manager.py:126
+(ElasticManager: etcd membership, watch loop :598, scale in/out triggers
+relaunch). This environment has no etcd; the native C++ TCPStore
+(core/native/tcp_store.cpp) plays the registry: every worker heartbeats
+``elastic/host/<name> -> timestamp``; the manager scans for liveness, and a
+membership change inside [min_np, max_np] reports a scale event the
+launcher turns into a relaunch with the new world size (checkpoint-resume
+is the state story, reference recovery model).
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from .tcp_store import TCPStore
+
+__all__ = ["ElasticManager", "ElasticStatus"]
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class ElasticManager:
+    """Membership registry + watcher (reference: ElasticManager).
+
+    Manager side (rank 0 / launcher)::
+
+        em = ElasticManager(job_id, np="2:4", host="127.0.0.1", port=6379,
+                            is_master=True)
+        em.register(my_name)
+        status = em.watch(timeout=...)   # RESTART on scale event
+
+    Worker side: register + background heartbeat only.
+    """
+
+    def __init__(self, job_id, np, host="127.0.0.1", port=6379,
+                 is_master=False, ttl=10.0, timeout=900):
+        self.job_id = job_id
+        self.min_np, self.max_np = self._parse_np(np)
+        self.store = TCPStore(host=host, port=port, is_master=is_master,
+                              world_size=self.max_np, timeout=timeout)
+        self.ttl = float(ttl)
+        self._prefix = f"elastic/{job_id}"
+        self._name = None
+        self._beat_thread = None
+        self._stop = threading.Event()
+
+    @staticmethod
+    def _parse_np(np_spec):
+        """'N' or 'min:max' (reference manager.py _parse_np)."""
+        if isinstance(np_spec, int):
+            return np_spec, np_spec
+        s = str(np_spec)
+        if ":" in s:
+            lo, hi = s.split(":")
+            return int(lo), int(hi)
+        return int(s), int(s)
+
+    # -- membership --
+    def register(self, name=None):
+        self._name = name or f"{os.uname().nodename}-{os.getpid()}"
+        self.store.set(f"{self._prefix}/hosts/{self._name}",
+                       str(time.time()))
+        members = self.store.add(f"{self._prefix}/known", 0)  # touch
+        self._stop.clear()
+        self._beat_thread = threading.Thread(target=self._beat_loop,
+                                             daemon=True)
+        self._beat_thread.start()
+        return self._name
+
+    def _beat_loop(self):
+        while not self._stop.wait(self.ttl / 3):
+            try:
+                self.store.set(f"{self._prefix}/hosts/{self._name}",
+                               str(time.time()))
+            except Exception:
+                return
+
+    def deregister(self):
+        self._stop.set()
+        if self._name:
+            self.store.set(f"{self._prefix}/hosts/{self._name}", "0")
+
+    def hosts(self):
+        """Live members (heartbeat within ttl)."""
+        names = self.store.get(f"{self._prefix}/roster").decode() \
+            if self.store.check(f"{self._prefix}/roster") else ""
+        alive = []
+        now = time.time()
+        for name in filter(None, names.split(",")):
+            key = f"{self._prefix}/hosts/{name}"
+            if not self.store.check(key):
+                continue
+            try:
+                ts = float(self.store.get(key).decode())
+            except ValueError:
+                continue
+            if now - ts <= self.ttl:
+                alive.append(name)
+        return alive
+
+    def announce(self, names):
+        """Manager records the roster it is tracking."""
+        self.store.set(f"{self._prefix}/roster", ",".join(names))
+
+    # -- watch loop (manager) --
+    def watch(self, interval=1.0, max_wait=None):
+        """Block until membership differs from the ANNOUNCED roster or the
+        job completes.
+
+        Returns ElasticStatus.RESTART when the live set changed but stays
+        within [min_np, max_np]; EXIT when it fell below min_np for longer
+        than ttl; COMPLETED when the completion flag is set; HOLD when
+        max_wait elapses with no event."""
+        roster = self.store.get(f"{self._prefix}/roster").decode() \
+            if self.store.check(f"{self._prefix}/roster") else ""
+        baseline = set(filter(None, roster.split(",")))
+        waited = 0.0
+        below_since = None
+        while True:
+            if self.store.check(f"{self._prefix}/completed"):
+                return ElasticStatus.COMPLETED
+            live = set(self.hosts())
+            if live != baseline:
+                if len(live) >= self.min_np:
+                    return ElasticStatus.RESTART
+                below_since = below_since or time.time()
+                if time.time() - below_since > self.ttl:
+                    return ElasticStatus.EXIT
+            else:
+                below_since = None
+            time.sleep(interval)
+            waited += interval
+            if max_wait is not None and waited >= max_wait:
+                return ElasticStatus.HOLD
+
+    def complete(self):
+        self.store.set(f"{self._prefix}/completed", "1")

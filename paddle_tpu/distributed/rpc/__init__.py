@@ -1,0 +1,161 @@
+"""paddle.distributed.rpc — remote procedure calls between workers.
+
+Reference: python/paddle/distributed/rpc/rpc.py (init_rpc / rpc_sync /
+rpc_async / shutdown over the C++ brpc RpcAgent + python_rpc_handler).
+TPU-native replacement: the native TCPStore (core/native/tcp_store.cpp) is
+the service registry (name -> host:port) and barrier; calls are
+length-prefixed pickled (fn, args, kwargs) over raw sockets, executed in a
+worker thread pool. Like the reference's python handler, callables are
+pickled by reference — both sides must import the same code. Trust model
+matches the reference: cluster-internal, same-trust-domain workers only.
+"""
+from __future__ import annotations
+
+import concurrent.futures as _fut
+import os
+import pickle
+import socket
+import struct
+import threading
+
+from ..tcp_store import TCPStore
+
+__all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown",
+           "get_worker_info", "get_all_worker_infos", "WorkerInfo"]
+
+
+class WorkerInfo:
+    def __init__(self, name, rank, ip, port):
+        self.name = name
+        self.rank = rank
+        self.ip = ip
+        self.port = port
+
+    def __repr__(self):
+        return (f"WorkerInfo(name={self.name}, rank={self.rank}, "
+                f"ip={self.ip}, port={self.port})")
+
+
+_state: dict = {}
+
+
+def _send_msg(sock, payload: bytes):
+    sock.sendall(struct.pack("<Q", len(payload)) + payload)
+
+
+def _recv_msg(sock) -> bytes:
+    hdr = b""
+    while len(hdr) < 8:
+        chunk = sock.recv(8 - len(hdr))
+        if not chunk:
+            raise ConnectionError("rpc peer closed")
+        hdr += chunk
+    (n,) = struct.unpack("<Q", hdr)
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            raise ConnectionError("rpc peer closed mid-message")
+        buf += chunk
+    return bytes(buf)
+
+
+def _serve_loop(server_sock, pool):
+    while not _state.get("stopping"):
+        try:
+            conn, _ = server_sock.accept()
+        except OSError:
+            return
+
+        def handle(conn=conn):
+            try:
+                fn, args, kwargs = pickle.loads(_recv_msg(conn))
+                try:
+                    result = ("ok", fn(*args, **kwargs))
+                except Exception as e:  # ship the failure back to caller
+                    result = ("err", e)
+                _send_msg(conn, pickle.dumps(result, protocol=4))
+            except ConnectionError:
+                pass
+            finally:
+                conn.close()
+        pool.submit(handle)
+
+
+def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
+    """Reference: rpc.init_rpc — registers this worker and blocks until the
+    whole world is present."""
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", 0)) if rank is None \
+        else rank
+    world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", 1)) \
+        if world_size is None else world_size
+    master_endpoint = master_endpoint or os.environ.get(
+        "PADDLE_MASTER_ENDPOINT", "127.0.0.1:8813")
+    host, port = master_endpoint.rsplit(":", 1)
+    store = TCPStore(host=host, port=int(port), is_master=(rank == 0),
+                     world_size=world_size)
+
+    server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    server.bind(("127.0.0.1", 0))
+    server.listen(64)
+    my_port = server.getsockname()[1]
+    pool = _fut.ThreadPoolExecutor(max_workers=8)
+    thread = threading.Thread(target=_serve_loop, args=(server, pool),
+                              daemon=True)
+    thread.start()
+
+    store.set(f"rpc/worker/{name}", f"{rank},127.0.0.1,{my_port}")
+    store.set(f"rpc/rank/{rank}", name)
+    store.barrier("rpc_init", world_size)
+    workers = {}
+    for r in range(world_size):
+        wname = store.get(f"rpc/rank/{r}").decode()
+        rr, ip, p = store.get(f"rpc/worker/{wname}").decode().split(",")
+        workers[wname] = WorkerInfo(wname, int(rr), ip, int(p))
+    _state.update(name=name, rank=rank, world_size=world_size,
+                  store=store, server=server, pool=pool, thread=thread,
+                  workers=workers, stopping=False)
+
+
+def get_worker_info(name=None):
+    ws = _state["workers"]
+    return ws[name or _state["name"]]
+
+
+def get_all_worker_infos():
+    return list(_state["workers"].values())
+
+
+def rpc_sync(to, fn, args=None, kwargs=None, timeout=120):
+    """Run fn(*args, **kwargs) on worker `to`; blocks for the result."""
+    info = _state["workers"][to]
+    with socket.create_connection((info.ip, info.port),
+                                  timeout=timeout) as s:
+        s.settimeout(timeout)
+        _send_msg(s, pickle.dumps((fn, tuple(args or ()),
+                                   dict(kwargs or {})), protocol=4))
+        status, value = pickle.loads(_recv_msg(s))
+    if status == "err":
+        raise value
+    return value
+
+
+def rpc_async(to, fn, args=None, kwargs=None, timeout=120):
+    """Returns a Future (reference returns FutureWrapper with .wait())."""
+    fut = _state["pool"].submit(rpc_sync, to, fn, args, kwargs, timeout)
+    fut.wait = fut.result  # paddle API spells it .wait()
+    return fut
+
+
+def shutdown():
+    """Barrier, then stop serving (reference: rpc.shutdown graceful)."""
+    store = _state.get("store")
+    if store is not None:
+        store.barrier("rpc_shutdown", _state["world_size"])
+    _state["stopping"] = True
+    try:
+        _state["server"].close()
+    except Exception:
+        pass
+    _state["pool"].shutdown(wait=False)

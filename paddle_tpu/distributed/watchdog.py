@@ -19,6 +19,7 @@ import threading
 
 _watchdog = None
 _disabled = False
+_atexit_registered = False
 _lock = threading.Lock()
 
 
@@ -34,7 +35,10 @@ def start_step_watchdog(timeout_seconds: float, abort_on_trip: bool = True):
         _watchdog = Watchdog(timeout_seconds=timeout_seconds,
                              abort_on_trip=abort_on_trip)
         _disabled = False
-        atexit.register(stop_step_watchdog)  # normal exit must disarm
+        global _atexit_registered
+        if not _atexit_registered:
+            atexit.register(stop_step_watchdog)  # normal exit must disarm
+            _atexit_registered = True
     return _watchdog
 
 

@@ -65,7 +65,14 @@ class Exponential(Distribution):
         u = jax.random.uniform(_key(), shape, jnp.float32, 1e-7, 1.0)
         return Tensor(-jnp.log(u) / _arr(self.rate), stop_gradient=True)
 
-    rsample = sample
+    def rsample(self, shape=()):
+        """Pathwise/reparameterized: dispatched through the tape so
+        gradients flow to the rate."""
+        shape = tuple(shape) + tuple(self.rate.shape)
+        key = _key()
+        return _op("exp_rsample", lambda r: -jnp.log(
+            jax.random.uniform(key, shape, jnp.float32, 1e-7, 1.0)) / r,
+            [self.rate])
 
     def log_prob(self, value):
         return _op("exp_lp",
@@ -97,7 +104,15 @@ class Gamma(Distribution):
         g = jax.random.gamma(_key(), _arr(self.concentration), shape)
         return Tensor(g / _arr(self.rate), stop_gradient=True)
 
-    rsample = sample
+    def rsample(self, shape=()):
+        """jax.random.gamma is differentiable in the concentration
+        (implicit reparameterization), so the tape carries pathwise
+        gradients to both parameters."""
+        shape = tuple(shape) + tuple(self.concentration.shape)
+        key = _key()
+        return _op("gamma_rsample",
+                   lambda a, r: jax.random.gamma(key, a, shape) / r,
+                   [self.concentration, self.rate])
 
     def log_prob(self, value):
         from jax.scipy.special import gammaln
@@ -136,7 +151,12 @@ class Beta(Distribution):
                             shape)
         return Tensor(s, stop_gradient=True)
 
-    rsample = sample
+    def rsample(self, shape=()):
+        shape = tuple(shape) + tuple(self.alpha.shape)
+        key = _key()
+        return _op("beta_rsample",
+                   lambda a, b: jax.random.beta(key, a, b, shape),
+                   [self.alpha, self.beta])
 
     def log_prob(self, value):
         from jax.scipy.special import betaln
@@ -168,7 +188,12 @@ class Dirichlet(Distribution):
                                  tuple(shape))
         return Tensor(s, stop_gradient=True)
 
-    rsample = sample
+    def rsample(self, shape=()):
+        key = _key()
+        shp = tuple(shape)
+        return _op("dirichlet_rsample",
+                   lambda c: jax.random.dirichlet(key, c, shp),
+                   [self.concentration])
 
     def log_prob(self, value):
         from jax.scipy.special import gammaln
@@ -210,7 +235,13 @@ class Laplace(Distribution):
         return Tensor(_arr(self.loc) + _arr(self.scale) * s,
                       stop_gradient=True)
 
-    rsample = sample
+    def rsample(self, shape=()):
+        shape = tuple(shape) + tuple(jnp.broadcast_shapes(
+            self.loc.shape, self.scale.shape))
+        key = _key()
+        return _op("laplace_rsample", lambda m, s: m + s
+                   * jax.random.laplace(key, shape, jnp.float32),
+                   [self.loc, self.scale])
 
     def log_prob(self, value):
         return _op("llp", lambda m, s, v: -jnp.abs(v - m) / s
@@ -245,7 +276,13 @@ class Gumbel(Distribution):
         return Tensor(_arr(self.loc) + _arr(self.scale) * s,
                       stop_gradient=True)
 
-    rsample = sample
+    def rsample(self, shape=()):
+        shape = tuple(shape) + tuple(jnp.broadcast_shapes(
+            self.loc.shape, self.scale.shape))
+        key = _key()
+        return _op("gumbel_rsample", lambda m, s: m + s
+                   * jax.random.gumbel(key, shape, jnp.float32),
+                   [self.loc, self.scale])
 
     def log_prob(self, value):
         def f(m, s, v):
@@ -317,7 +354,13 @@ class LogNormal(Distribution):
         return Tensor(jnp.exp(_arr(self.loc) + _arr(self.scale) * z),
                       stop_gradient=True)
 
-    rsample = sample
+    def rsample(self, shape=()):
+        shape = tuple(shape) + tuple(jnp.broadcast_shapes(
+            self.loc.shape, self.scale.shape))
+        key = _key()
+        return _op("lognormal_rsample", lambda m, s: jnp.exp(
+            m + s * jax.random.normal(key, shape, jnp.float32)),
+            [self.loc, self.scale])
 
     def log_prob(self, value):
         c = np.float32(0.5 * math.log(2 * math.pi))
@@ -348,10 +391,12 @@ class Multinomial(Distribution):
     def sample(self, shape=()):
         p = _arr(self.probs_param)
         shape = tuple(shape)
+        # draw total_count iid categoricals with the batch dims right-
+        # aligned (jax.random.categorical broadcast rule), then histogram
         idx = jax.random.categorical(
-            _key(), jnp.log(p), axis=-1,
-            shape=shape + p.shape[:-1] + (self.total_count,))
-        counts = jax.nn.one_hot(idx, p.shape[-1]).sum(-2)
+            _key(), jnp.log(p),
+            shape=(self.total_count,) + shape + p.shape[:-1])
+        counts = jax.nn.one_hot(idx, p.shape[-1]).sum(0)
         return Tensor(counts, stop_gradient=True)
 
     def log_prob(self, value):
@@ -408,7 +453,13 @@ class StudentT(Distribution):
         return Tensor(_arr(self.loc) + _arr(self.scale) * s,
                       stop_gradient=True)
 
-    rsample = sample
+    def rsample(self, shape=()):
+        shape = tuple(shape) + tuple(jnp.broadcast_shapes(
+            self.df.shape, self.loc.shape, self.scale.shape))
+        key = _key()
+        return _op("studentt_rsample", lambda df, m, s: m + s
+                   * jax.random.t(key, df, shape, jnp.float32),
+                   [self.df, self.loc, self.scale])
 
     def log_prob(self, value):
         from jax.scipy.special import gammaln

@@ -116,3 +116,58 @@ class LRScheduler(Callback):
         s = self._sched()
         if s is not None and self.by_epoch:
             s.step()
+
+
+class ReduceLROnPlateau(Callback):
+    """Reference: hapi/callbacks.py ReduceLROnPlateau — shrink the optimizer
+    lr when the monitored metric stops improving."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10, mode="min",
+                 min_delta=1e-4, cooldown=0, min_lr=0.0, verbose=1):
+        self.monitor = monitor
+        self.factor = factor
+        self.patience = patience
+        self.mode = mode
+        self.min_delta = min_delta
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        self.verbose = verbose
+        self.best = None
+        self.wait = 0
+        self.cooldown_counter = 0
+
+    def _better(self, cur):
+        if self.best is None:
+            return True
+        if self.mode == "min":
+            return cur < self.best - self.min_delta
+        return cur > self.best + self.min_delta
+
+    def on_eval_end(self, logs=None):
+        logs = logs or {}
+        cur = logs.get(self.monitor)
+        if cur is None:
+            return
+        cur = float(cur[0] if isinstance(cur, (list, tuple)) else cur)
+        if self._better(cur):
+            self.best = cur
+            self.wait = 0
+            return
+        if self.cooldown_counter > 0:
+            # cooldown suppresses wait accrual entirely (Keras/reference)
+            self.cooldown_counter -= 1
+            self.wait = 0
+            return
+        self.wait += 1
+        if self.wait >= self.patience:
+            opt = getattr(self.model, "_optimizer", None)
+            if opt is not None:
+                lr = opt.get_lr()
+                new_lr = max(lr * self.factor, self.min_lr)
+                if new_lr < lr:
+                    opt.set_lr(new_lr)
+                    if self.verbose:
+                        print(f"ReduceLROnPlateau: lr {lr:.2e} -> "
+                              f"{new_lr:.2e}")
+            self.cooldown_counter = self.cooldown
+            self.wait = 0

@@ -24,6 +24,8 @@ def _as_list(x):
 class Model:
     def __init__(self, network, inputs=None, labels=None):
         self.network = network
+        self._inputs = list(inputs) if inputs is not None else None
+        self._labels = list(labels) if labels is not None else None
         self._optimizer = None
         self._loss = None
         self._metrics = []
@@ -191,9 +193,16 @@ class Model:
 
     # ---- persistence ----
     def save(self, path, training=True):
+        """training=True: params (+ optimizer) checkpoints; training=False:
+        AOT inference export via jit.save (StableHLO — the reference's
+        save_inference_model analog)."""
+        if not training:
+            from ..jit.save_load import save as _jit_save
+            _jit_save(self.network, path, input_spec=self._inputs)
+            return
         from .. import save as _save
         _save(self.network.state_dict(), path + ".pdparams")
-        if training and self._optimizer is not None:
+        if self._optimizer is not None:
             _save(self._optimizer.state_dict(), path + ".pdopt")
 
     def load(self, path, skip_mismatch=False, reset_optimizer=False):
@@ -208,9 +217,8 @@ class Model:
         return self.network.parameters(*args, **kwargs)
 
     def summary(self, input_size=None, dtype=None):
-        n_params = sum(p.size for p in self.network.parameters())
-        lines = [repr(self.network),
-                 f"Total params: {n_params:,}"]
-        out = "\n".join(lines)
-        print(out)
-        return {"total_params": n_params}
+        """Reference: hapi/model.py Model.summary → model_summary.summary."""
+        from .summary import summary as _summary
+        if input_size is None and self._inputs:
+            input_size = [tuple(s.shape) for s in self._inputs]
+        return _summary(self.network, input_size, dtypes=dtype)

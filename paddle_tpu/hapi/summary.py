@@ -1,0 +1,71 @@
+"""paddle.summary — layer-by-layer model summary.
+
+Reference: python/paddle/hapi/model_summary.py (summary walks sublayers
+with forward hooks, prints a table of output shapes and parameter counts).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["summary"]
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    """Print a per-layer table; returns {'total_params', 'trainable_params'}.
+
+    input_size: tuple (or list of tuples) INCLUDING the batch dim, with -1
+    meaning 1 (reference semantics)."""
+    import jax.numpy as jnp
+
+    if input is None:
+        assert input_size is not None, "input_size or input required"
+        sizes = [input_size] if isinstance(input_size[0], int) \
+            else list(input_size)
+        dts = dtypes if isinstance(dtypes, (list, tuple)) else \
+            [dtypes or "float32"] * len(sizes)
+        inputs = [Tensor(jnp.zeros([1 if d == -1 else d for d in s],
+                                   dt)) for s, dt in zip(sizes, dts)]
+    else:
+        inputs = [input] if isinstance(input, Tensor) else list(input)
+
+    rows = []
+    hooks = []
+
+    def mk_hook(name, layer):
+        def hook(lyr, ins, out):
+            shape = list(out.shape) if isinstance(out, Tensor) else \
+                [list(o.shape) for o in out if isinstance(o, Tensor)]
+            n_params = sum(int(np.prod(p.shape))
+                           for p in lyr.parameters(include_sublayers=False))
+            rows.append((f"{type(lyr).__name__}-{name}", shape, n_params))
+        return hook
+
+    for name, layer in net.named_sublayers():
+        if not list(layer.children()):  # leaves only, reference behavior
+            hooks.append(layer.register_forward_post_hook(
+                mk_hook(name, layer)))
+    was_training = net.training
+    net.eval()
+    try:
+        net(*inputs)
+    finally:
+        if was_training:
+            net.train()
+        for h in hooks:
+            h.remove()
+
+    header = f"{'Layer (type)':<28}{'Output Shape':<26}{'Param #':>12}"
+    sep = "=" * len(header)
+    lines = [sep, header, sep]
+    for name, shape, n in rows:
+        lines.append(f"{name:<28}{str(shape):<26}{n:>12,}")
+    total = sum(int(np.prod(p.shape)) for p in net.parameters())
+    trainable = sum(int(np.prod(p.shape)) for p in net.parameters()
+                    if not p.stop_gradient)
+    lines += [sep, f"Total params: {total:,}",
+              f"Trainable params: {trainable:,}",
+              f"Non-trainable params: {total - trainable:,}", sep]
+    print("\n".join(lines))
+    return {"total_params": total, "trainable_params": trainable}

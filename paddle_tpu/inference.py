@@ -1,0 +1,145 @@
+"""paddle.inference — the deployment Predictor API.
+
+Reference: paddle/fluid/inference/api/analysis_predictor.cc:392 +
+paddle_inference_api.h (Config / create_predictor / get_input_handle /
+run). The reference's analysis passes, IR fusion, and TensorRT subgraphs
+collapse into XLA AOT: the .pdmodel artifact written by paddle.jit.save is
+a serialized StableHLO executable, so a Predictor is a thin handle-based
+wrapper over jit.load — kernel fusion happened at export compile time.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from .core.tensor import Tensor
+from .jit.save_load import load as _jit_load
+
+__all__ = ["Config", "Predictor", "create_predictor", "PredictorTensor"]
+
+
+class Config:
+    """Reference: AnalysisConfig (paddle_analysis_config.h). Device/IR-pass
+    knobs that have XLA equivalents are accepted and recorded; pure
+    GPU/TensorRT toggles are accepted for API compatibility and ignored."""
+
+    def __init__(self, prog_file=None, params_file=None):
+        if prog_file and prog_file.endswith(".pdmodel"):
+            prog_file = prog_file[:-len(".pdmodel")]
+        self._path = prog_file
+        self._enable_memory_optim = True
+        self._device = "tpu"
+        self._ir_optim = True  # XLA optimizes at AOT-compile time
+
+    def set_prog_file(self, path):
+        self._path = path
+
+    def prog_file(self):
+        return (self._path or "") + ".pdmodel"
+
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        self._device = "tpu"  # accelerator routing is PjRt's job
+
+    def enable_xpu(self, *a, **k):
+        self._device = "tpu"
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def switch_ir_optim(self, flag=True):
+        self._ir_optim = flag
+
+    def enable_memory_optim(self, flag=True):
+        self._enable_memory_optim = flag
+
+    def enable_tensorrt_engine(self, *a, **k):
+        pass  # XLA AOT already fused/compiled the graph
+
+    def summary(self):
+        return (f"Config(path={self._path!r}, device={self._device}, "
+                "engine=XLA-AOT)")
+
+
+class PredictorTensor:
+    """Handle-based IO tensor (reference: ZeroCopyTensor)."""
+
+    def __init__(self, name):
+        self.name = name
+        self._value = None
+
+    def copy_from_cpu(self, arr):
+        self._value = np.ascontiguousarray(arr)
+
+    def reshape(self, shape):
+        pass  # shapes come from the data in copy_from_cpu
+
+    def copy_to_cpu(self):
+        return np.asarray(self._value)
+
+    def shape(self):
+        return list(self._value.shape) if self._value is not None else []
+
+
+class Predictor:
+    """Reference: AnalysisPredictor (analysis_predictor.cc:392 init, :1205
+    Run). Holds a loaded AOT executable + named IO handles."""
+
+    def __init__(self, config):
+        if isinstance(config, str):
+            config = Config(config)
+        self._config = config
+        path = config._path
+        if path is None or not os.path.exists(path + ".pdmodel"):
+            raise FileNotFoundError(
+                f"no exported model at {path!r}; produce one with "
+                "paddle.jit.save(layer, path, input_spec=[...])")
+        self._layer = _jit_load(path)
+        n_in = len(self._layer._meta.get("input_specs", []))
+        self._inputs = [PredictorTensor(f"input_{i}") for i in range(n_in)]
+        self._outputs: list = []
+
+    def get_input_names(self):
+        return [t.name for t in self._inputs]
+
+    def get_input_handle(self, name):
+        for t in self._inputs:
+            if t.name == name:
+                return t
+        raise KeyError(name)
+
+    def get_output_names(self):
+        return [t.name for t in self._outputs] or ["output_0"]
+
+    def get_output_handle(self, name):
+        for t in self._outputs:
+            if t.name == name:
+                return t
+        raise KeyError(name)
+
+    def run(self, inputs=None):
+        """Handle-style (None) or direct list-of-arrays call."""
+        if inputs is None:
+            arrs = [t._value for t in self._inputs]
+            if any(a is None for a in arrs):
+                missing = [t.name for t in self._inputs if t._value is None]
+                raise RuntimeError(f"inputs not set: {missing}")
+        else:
+            arrs = [a.numpy() if isinstance(a, Tensor) else np.asarray(a)
+                    for a in inputs]
+        outs = self._layer(*arrs)
+        outs = outs if isinstance(outs, (list, tuple)) else [outs]
+        self._outputs = []
+        results = []
+        for i, o in enumerate(outs):
+            h = PredictorTensor(f"output_{i}")
+            h._value = np.asarray(o.numpy() if isinstance(o, Tensor)
+                                  else o)
+            self._outputs.append(h)
+            results.append(h._value)
+        return results
+
+
+def create_predictor(config):
+    """Reference: paddle_infer::CreatePredictor."""
+    return Predictor(config)

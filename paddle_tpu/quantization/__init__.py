@@ -106,3 +106,9 @@ def dequantize(q, scale, bits=8):
     qmax = 2 ** (bits - 1) - 1
     arr = q._data if isinstance(q, Tensor) else jnp.asarray(q)
     return Tensor(arr.astype(jnp.float32) * scale / qmax)
+
+
+from .ptq import (  # noqa: E402,F401
+    AbsmaxObserver, BaseObserver, EMAObserver, HistObserver, KLObserver,
+    PTQ, QuantedLinearPTQ,
+)

@@ -1,0 +1,208 @@
+"""Post-training quantization — observer framework + PTQ driver.
+
+Reference: python/paddle/quantization/{ptq.py,observer.py,
+observers/abs_max.py} (PTQ.quantize inserts observers, sample data flows
+through, convert() folds observed scales into quantized layers). TPU notes:
+int8 inference math is emulated as fake-quant (quant-dequant) around
+matmuls — XLA folds the scales into fused kernels; true int8 matmul on TPU
+arrives via quantized HLO and keeps this same observer/scale interface.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import nn
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+
+__all__ = ["BaseObserver", "AbsmaxObserver", "EMAObserver",
+           "HistObserver", "KLObserver", "PTQ", "QuantedLinearPTQ"]
+
+
+class BaseObserver(nn.Layer):
+    """Reference: quantization/factory.py ObserverFactory product — an
+    observer watches activations flowing through and derives a scale."""
+
+    def __init__(self, quant_bits=8):
+        super().__init__()
+        self.quant_bits = quant_bits
+
+    def forward(self, x):
+        self._observe(np.asarray(jnp.abs(x._data).max()))
+        return x
+
+    def _observe(self, absmax):
+        raise NotImplementedError
+
+    def scale(self):
+        raise NotImplementedError
+
+    def quant_axis(self):
+        return -1
+
+
+class AbsmaxObserver(BaseObserver):
+    """Running max of |x| (reference: observers/abs_max.py)."""
+
+    def __init__(self, quant_bits=8):
+        super().__init__(quant_bits)
+        self._max = 0.0
+
+    def _observe(self, absmax):
+        self._max = max(self._max, float(absmax))
+
+    def scale(self):
+        return self._max / (2 ** (self.quant_bits - 1) - 1) or 1e-8
+
+
+class EMAObserver(BaseObserver):
+    """Exponential-moving-average absmax (reference: emd/mse family)."""
+
+    def __init__(self, quant_bits=8, decay=0.9):
+        super().__init__(quant_bits)
+        self.decay = decay
+        self._ema = None
+
+    def _observe(self, absmax):
+        a = float(absmax)
+        self._ema = a if self._ema is None else \
+            self.decay * self._ema + (1 - self.decay) * a
+
+    def scale(self):
+        return (self._ema or 0.0) / (2 ** (self.quant_bits - 1) - 1) \
+            or 1e-8
+
+
+class HistObserver(BaseObserver):
+    """Histogram percentile scale (reference: observers/hist.py)."""
+
+    def __init__(self, quant_bits=8, bins=2048, percent=0.999):
+        super().__init__(quant_bits)
+        self.bins = bins
+        self.percent = percent
+        self._samples: list = []
+
+    def forward(self, x):
+        self._samples.append(np.abs(np.asarray(x._data)).reshape(-1))
+        return x
+
+    def _observe(self, absmax):
+        pass
+
+    def scale(self):
+        if not self._samples:
+            return 1e-8
+        allv = np.concatenate(self._samples)
+        hist, edges = np.histogram(allv, bins=self.bins)
+        cum = np.cumsum(hist) / max(len(allv), 1)
+        idx = int(np.searchsorted(cum, self.percent))
+        vmax = edges[min(idx + 1, len(edges) - 1)]
+        return float(vmax) / (2 ** (self.quant_bits - 1) - 1) or 1e-8
+
+
+class KLObserver(HistObserver):
+    """KL-divergence calibration (reference: observers/kl.py): pick the
+    clip threshold whose quantized distribution diverges least."""
+
+    def scale(self):
+        if not self._samples:
+            return 1e-8
+        allv = np.concatenate(self._samples)
+        hist, edges = np.histogram(allv, bins=self.bins)
+        p_full = hist / max(hist.sum(), 1)
+        levels = 2 ** (self.quant_bits - 1)
+        best_kl, best_edge = np.inf, edges[-1]
+        for cut_idx in range(levels, self.bins + 1, self.bins // 32 or 1):
+            p = hist[:cut_idx].astype(np.float64).copy()
+            p[-1] += hist[cut_idx:].sum()  # clip mass into the last bin
+            # quantize the histogram into `levels` buckets and expand back
+            factor = cut_idx / levels
+            q = np.zeros_like(p)
+            for i in range(levels):
+                lo, hi = int(i * factor), max(int((i + 1) * factor),
+                                              int(i * factor) + 1)
+                q[lo:hi] = p[lo:hi].sum() / (hi - lo)
+            mask = p > 0
+            pm = p[mask] / p.sum()
+            qm = np.maximum(q[mask], 1e-12)
+            qm = qm / qm.sum()
+            kl = float((pm * np.log(pm / qm)).sum())
+            if kl < best_kl:
+                best_kl, best_edge = kl, edges[cut_idx]
+        return float(best_edge) / (2 ** (self.quant_bits - 1) - 1) or 1e-8
+
+
+class QuantedLinearPTQ(nn.Layer):
+    """Converted inference layer: weights stored int8 + scale, activations
+    fake-quantized with the observed scale."""
+
+    def __init__(self, linear, act_scale, quant_bits=8):
+        super().__init__()
+        w = linear.weight
+        qmax = 2 ** (quant_bits - 1) - 1
+        self.w_scale = float(np.abs(np.asarray(w._data)).max() / qmax) \
+            or 1e-8
+        wq = np.clip(np.round(np.asarray(w._data) / self.w_scale),
+                     -qmax - 1, qmax).astype(np.int8)
+        self.register_buffer("w_int8", Tensor(wq))
+        self.bias = linear.bias
+        self.act_scale = act_scale
+        self.quant_bits = quant_bits
+
+    def forward(self, x):
+        bits = self.quant_bits
+
+        def f(xa, wq, *rest):
+            s = self.act_scale
+            qmax = 2 ** (bits - 1) - 1
+            xq = jnp.clip(jnp.round(xa / s), -qmax - 1, qmax)
+            out = (xq * s) @ (wq.astype(jnp.float32) * self.w_scale)
+            if rest:
+                out = out + rest[0]
+            return out
+
+        ins = [x, self.w_int8] + ([self.bias] if self.bias is not None
+                                  else [])
+        return apply("quanted_linear", f, ins)
+
+
+class PTQ:
+    """Reference: quantization/ptq.py PTQ — quantize() inserts observers,
+    calibration data flows, convert() emits the quantized model."""
+
+    def __init__(self, config=None, observer_cls=AbsmaxObserver,
+                 quant_bits=8):
+        self.observer_cls = observer_cls
+        self.quant_bits = quant_bits
+
+    def quantize(self, model, inplace=False):
+        assert inplace, "pass inplace=True (functional copy not supported)"
+        self._observed = []
+        for name, layer in list(model.named_sublayers()):
+            if isinstance(layer, nn.Linear):
+                obs = self.observer_cls(self.quant_bits)
+                layer._ptq_observer = obs
+                hook = layer.register_forward_pre_hook(
+                    lambda lyr, ins, _o=obs: (_o(ins[0]),) + tuple(ins[1:]))
+                self._observed.append((model, name, layer, obs, hook))
+        return model
+
+    def convert(self, model, inplace=False):
+        assert inplace, "pass inplace=True"
+        for owner, name, layer, obs, hook in self._observed:
+            hook.remove()
+            quanted = QuantedLinearPTQ(layer, obs.scale(), self.quant_bits)
+            parent = owner
+            parts = name.split(".")
+            for p in parts[:-1]:
+                parent = parent._sub_layers[p] if p in \
+                    getattr(parent, "_sub_layers", {}) else getattr(parent,
+                                                                    p)
+            leaf = parts[-1]
+            if leaf in getattr(parent, "_sub_layers", {}):
+                parent._sub_layers[leaf] = quanted  # Sequential et al.
+            else:
+                setattr(parent, leaf, quanted)
+        self._observed = []
+        return model

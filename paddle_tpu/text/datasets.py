@@ -66,21 +66,28 @@ class Imdb(Dataset):
         if data_file is None:
             _no_download("Imdb", self.URL)
         pat = re.compile(rf"aclImdb/{mode}/(pos|neg)/.*\.txt$")
+        # the vocabulary always comes from the TRAIN split (reference
+        # imdb.py builds word_idx from train), so train/test instances
+        # agree on token ids
+        vocab_pat = re.compile(r"aclImdb/train/(pos|neg)/.*\.txt$")
         tok = re.compile(r"[A-Za-z0-9']+")
         docs, labels = [], []
         freq: dict = {}
         with tarfile.open(data_file) as tf:
             for member in tf.getmembers():
+                in_vocab = vocab_pat.match(member.name)
                 m = pat.match(member.name)
-                if not m:
+                if not (m or in_vocab):
                     continue
                 text = tf.extractfile(member).read().decode(
                     "utf-8", "ignore").lower()
                 words = tok.findall(text)
-                docs.append(words)
-                labels.append(0 if m.group(1) == "pos" else 1)
-                for w in words:
-                    freq[w] = freq.get(w, 0) + 1
+                if m:
+                    docs.append(words)
+                    labels.append(0 if m.group(1) == "pos" else 1)
+                if in_vocab:
+                    for w in words:
+                        freq[w] = freq.get(w, 0) + 1
         kept = [w for w, c in sorted(freq.items(),
                                      key=lambda kv: (-kv[1], kv[0]))
                 if c >= cutoff]

@@ -179,3 +179,33 @@ def test_text_ucihousing_local_file(tmp_path):
 def test_text_imdb_requires_local_data():
     with pytest.raises(RuntimeError, match="egress"):
         paddle.text.Imdb()
+
+
+# ---------------- hapi ----------------
+def test_summary_table():
+    import paddle_tpu.nn as nn
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 4))
+    info = paddle.summary(net, (1, 8))
+    assert info["total_params"] == 8 * 32 + 32 + 32 * 4 + 4
+    assert info["trainable_params"] == info["total_params"]
+
+
+def test_reduce_lr_on_plateau():
+    import paddle_tpu.nn as nn
+    paddle.seed(0)
+    net = nn.Linear(4, 2)
+    m = paddle.Model(net)
+    opt = paddle.optimizer.Adam(learning_rate=0.1,
+                                parameters=net.parameters())
+    m.prepare(optimizer=opt, loss=nn.MSELoss())
+    cb = paddle.hapi.callbacks.ReduceLROnPlateau(patience=2, factor=0.5,
+                                                 verbose=0)
+    cb.set_model(m)
+    cb.on_eval_end({"loss": 1.0})   # best
+    cb.on_eval_end({"loss": 1.0})   # wait 1
+    assert abs(opt.get_lr() - 0.1) < 1e-12
+    cb.on_eval_end({"loss": 1.0})   # wait 2 -> reduce
+    assert abs(opt.get_lr() - 0.05) < 1e-12
+    cb.on_eval_end({"loss": 0.5})   # improvement: no change
+    assert abs(opt.get_lr() - 0.05) < 1e-12

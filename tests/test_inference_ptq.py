@@ -1,0 +1,78 @@
+"""Inference Predictor API + PTQ observer framework.
+
+Reference: inference/api/analysis_predictor.cc deploy recipe;
+quantization/ptq.py + observers."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.jit import InputSpec, save_load
+from paddle_tpu.quantization import (
+    AbsmaxObserver, EMAObserver, HistObserver, KLObserver, PTQ,
+    QuantedLinearPTQ,
+)
+
+
+def _export(tmp_path):
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    path = str(tmp_path / "model")
+    save_load.save(net, path, input_spec=[InputSpec([2, 8], "float32")])
+    return net, path
+
+
+def test_predictor_handle_flow(tmp_path):
+    net, path = _export(tmp_path)
+    cfg = paddle.inference.Config(path)
+    pred = paddle.inference.create_predictor(cfg)
+    x = np.random.RandomState(0).randn(2, 8).astype("float32")
+    h = pred.get_input_handle(pred.get_input_names()[0])
+    h.copy_from_cpu(x)
+    pred.run()
+    out = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+    np.testing.assert_allclose(out, net(paddle.to_tensor(x)).numpy(),
+                               rtol=1e-5)
+
+
+def test_predictor_direct_run_and_missing_model(tmp_path):
+    net, path = _export(tmp_path)
+    pred = paddle.inference.create_predictor(paddle.inference.Config(path))
+    x = np.random.RandomState(1).randn(2, 8).astype("float32")
+    out = pred.run([x])[0]
+    np.testing.assert_allclose(out, net(paddle.to_tensor(x)).numpy(),
+                               rtol=1e-5)
+    with pytest.raises(FileNotFoundError):
+        paddle.inference.create_predictor(
+            paddle.inference.Config(str(tmp_path / "nope")))
+
+
+def test_ptq_end_to_end():
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+    rng = np.random.RandomState(0)
+    calib = [rng.randn(8, 16).astype("float32") for _ in range(10)]
+    xe = paddle.to_tensor(calib[0])
+    ref = net(xe).numpy()
+    ptq = PTQ()
+    ptq.quantize(net, inplace=True)
+    for b in calib:
+        net(paddle.to_tensor(b))
+    ptq.convert(net, inplace=True)
+    assert isinstance(net[0], QuantedLinearPTQ)
+    assert str(net[0].w_int8.dtype).endswith("int8")
+    out = net(xe).numpy()
+    err = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-8)
+    assert err < 0.1, err  # int8 noise bound
+
+
+@pytest.mark.parametrize("cls", [AbsmaxObserver, EMAObserver, HistObserver,
+                                 KLObserver])
+def test_observers_produce_sane_scales(cls):
+    rng = np.random.RandomState(3)
+    obs = cls()
+    for _ in range(8):
+        obs(paddle.to_tensor(rng.randn(64).astype("float32")))
+    s = obs.scale()
+    # |x| ~ N(0,1): absmax-family scales land in (absmax/127-ish) range
+    assert 1e-4 < s < 0.2, (cls.__name__, s)

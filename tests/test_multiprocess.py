@@ -82,3 +82,23 @@ def test_watchdog_aborts_on_dead_peer(tmp_path):
         log0 = f.read()
     assert "pd_watchdog" in log0, log0[-2000:]
     assert "aborting process" in log0
+
+
+@pytest.mark.slow
+def test_rpc_two_process(tmp_path):
+    """paddle.distributed.rpc across 2 real processes (reference:
+    distributed/rpc/rpc.py init_rpc/rpc_sync/rpc_async/shutdown)."""
+    port = 29653
+    env = _clean_env(port)
+    env["PADDLE_MASTER_ENDPOINT"] = f"127.0.0.1:{port}"
+    log_dir = str(tmp_path / "logs")
+    launched = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--master", f"127.0.0.1:{port+1}",
+         "--log_dir", log_dir,
+         os.path.join(WORKERS, "rpc_worker.py")],
+        env=env, capture_output=True, text=True, timeout=300, cwd=REPO)
+    assert launched.returncode == 0, launched.stdout + launched.stderr
+    for rank in (0, 1):
+        with open(os.path.join(log_dir, f"workerlog.{rank}")) as f:
+            assert f"RPC OK rank={rank}" in f.read()

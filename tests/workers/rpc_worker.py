@@ -1,0 +1,46 @@
+"""2-process RPC integration worker (reference: test/rpc test pattern)."""
+import os
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=1")
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import paddle_tpu.distributed.rpc as rpc
+
+
+def add(a, b):
+    return a + b
+
+
+def whoami():
+    import os
+    return int(os.environ.get("PADDLE_TRAINER_ID", -1))
+
+
+def boom():
+    raise ValueError("remote failure")
+
+
+def main():
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    rpc.init_rpc(f"worker{rank}",
+                 master_endpoint=os.environ["PADDLE_MASTER_ENDPOINT"])
+    other = f"worker{1 - rank}"
+    assert rpc.rpc_sync(other, add, args=(2, 3)) == 5
+    assert rpc.rpc_sync(other, whoami) == 1 - rank
+    fut = rpc.rpc_async(other, add, args=(10, 20))
+    assert fut.wait() == 30
+    try:
+        rpc.rpc_sync(other, boom)
+        print("ERROR: no remote exception")
+    except ValueError as e:
+        assert "remote failure" in str(e)
+    infos = rpc.get_all_worker_infos()
+    assert len(infos) == 2
+    print(f"RPC OK rank={rank}", flush=True)
+    rpc.shutdown()
+
+
+if __name__ == "__main__":
+    main()

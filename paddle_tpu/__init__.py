@@ -43,6 +43,7 @@ from . import linalg  # noqa: F401
 from . import nn  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import regularizer  # noqa: F401
+from . import signal  # noqa: F401
 from . import distributed  # noqa: F401
 from . import distribution  # noqa: F401
 from . import hapi  # noqa: F401

@@ -23,6 +23,7 @@ from .tcp_store import TCPStore, Watchdog  # noqa: F401
 from .watchdog import (  # noqa: F401
     start_step_watchdog, stop_step_watchdog, get_step_watchdog,
 )
+from . import ps  # noqa: F401
 from . import rpc  # noqa: F401
 from .auto_tuner import AutoTuner  # noqa: F401
 from .elastic import ElasticManager, ElasticStatus  # noqa: F401

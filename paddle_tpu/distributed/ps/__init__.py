@@ -1,0 +1,174 @@
+"""paddle.distributed.ps — parameter-server training for sparse models.
+
+Reference: paddle/fluid/distributed/ps/ (brpc PS: sparse/dense tables with
+pull/push, async SGD on the server — service/brpc_ps_client.h, table/) and
+python/paddle/distributed/ps/the_one_ps.py. TPU-native scope: the dense
+compute path belongs on the mesh; what a PS uniquely adds is storage and
+async update of HUGE sparse embedding tables that don't fit device HBM.
+This implementation keeps exactly that capability: server processes hold
+sharded sparse tables in host memory, workers pull rows / push gradients
+over paddle.distributed.rpc, updates apply server-side (async SGD with
+optional per-row learning rates), and the dense model trains on device as
+usual.
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from .. import rpc
+
+__all__ = ["SparseTable", "PSServer", "PSClient", "start_server",
+           "shard_for"]
+
+_tables: dict = {}
+
+
+class SparseTable:
+    """Server-side sparse table (reference: ps/table/memory_sparse_table).
+    Rows are created on first touch with the configured initializer."""
+
+    def __init__(self, name, dim, init_std=0.01, lr=0.1, seed=0):
+        self.name = name
+        self.dim = dim
+        self.lr = lr
+        self.init_std = init_std
+        self._rng = np.random.RandomState(seed)
+        self.rows: dict = {}
+        # the RPC server executes handlers on a thread pool: concurrent
+        # pushes from multiple workers must not lose updates
+        self._lock = threading.Lock()
+
+    def _row(self, rid):
+        r = self.rows.get(int(rid))
+        if r is None:
+            r = (self._rng.randn(self.dim) * self.init_std).astype(
+                np.float32)
+            self.rows[int(rid)] = r
+        return r
+
+    def pull(self, ids):
+        with self._lock:
+            return np.stack([self._row(i) for i in ids])
+
+    def push_grad(self, ids, grads, lr=None):
+        lr = self.lr if lr is None else lr
+        with self._lock:
+            for i, g in zip(ids, grads):
+                self._row(i)[:] -= lr * np.asarray(g, np.float32)
+
+    def state(self):
+        return {"n_rows": len(self.rows), "dim": self.dim}
+
+
+# ---- server-side RPC endpoints (run on the PS process) ----
+def _srv_create(name, dim, init_std, lr, seed):
+    _tables[name] = SparseTable(name, dim, init_std, lr, seed)
+    return True
+
+
+def _srv_pull(name, ids):
+    return _tables[name].pull(ids)
+
+
+def _srv_push(name, ids, grads, lr):
+    _tables[name].push_grad(ids, grads, lr)
+    return True
+
+
+def _srv_state(name):
+    return _tables[name].state()
+
+
+def _srv_save(name, path):
+    t = _tables[name]
+    np.savez(path, ids=np.array(list(t.rows.keys()), np.int64),
+             rows=np.stack(list(t.rows.values())) if t.rows
+             else np.zeros((0, t.dim), np.float32))
+    return True
+
+
+def _srv_load(name, path):
+    t = _tables[name]
+    data = np.load(path)
+    t.rows = {int(i): r.copy() for i, r in zip(data["ids"], data["rows"])}
+    return True
+
+
+def shard_for(ids, n_servers):
+    """id -> server assignment (reference: sharding by id hash)."""
+    return [int(i) % n_servers for i in ids]
+
+
+class PSServer:
+    """A PS process: init_rpc under a 'ps{k}' name, then serve forever
+    (the RPC server thread does the work; reference: BrpcPsServer)."""
+
+    @staticmethod
+    def run(name, master_endpoint):
+        rpc.init_rpc(name, master_endpoint=master_endpoint)
+        # rpc.shutdown() barrier keeps the process alive until all peers
+        # are done
+        rpc.shutdown()
+
+
+def start_server(name=None, master_endpoint=None):
+    PSServer.run(name or "ps0", master_endpoint)
+
+
+class PSClient:
+    """Worker handle (reference: BrpcPsClient): routes rows to servers by
+    id-hash shard, pulls embeddings, pushes gradients."""
+
+    def __init__(self, servers):
+        self.servers = list(servers)
+        self._dims: dict = {}
+
+    def create_table(self, name, dim, init_std=0.01, lr=0.1):
+        for k, s in enumerate(self.servers):
+            rpc.rpc_sync(s, _srv_create, args=(name, dim, init_std, lr, k))
+        self._dims[name] = dim
+
+    def pull(self, name, ids):
+        ids = np.asarray(ids, np.int64)
+        owner = np.asarray(shard_for(ids, len(self.servers)))
+        out = np.zeros((len(ids), self._dim(name)), np.float32)
+        for k, s in enumerate(self.servers):
+            mask = owner == k
+            if mask.any():
+                out[mask] = rpc.rpc_sync(s, _srv_pull,
+                                         args=(name, ids[mask].tolist()))
+        return out
+
+    def push(self, name, ids, grads, lr=None):
+        ids = np.asarray(ids, np.int64)
+        grads = np.asarray(grads, np.float32)
+        owner = np.asarray(shard_for(ids, len(self.servers)))
+        futs = []
+        for k, s in enumerate(self.servers):
+            mask = owner == k
+            if mask.any():
+                futs.append(rpc.rpc_async(
+                    s, _srv_push,
+                    args=(name, ids[mask].tolist(), grads[mask], lr)))
+        for f in futs:
+            f.wait()
+
+    def table_state(self, name):
+        return [rpc.rpc_sync(s, _srv_state, args=(name,))
+                for s in self.servers]
+
+    def save(self, name, path_prefix):
+        for k, s in enumerate(self.servers):
+            rpc.rpc_sync(s, _srv_save, args=(name, f"{path_prefix}.{k}.npz"))
+
+    def load(self, name, path_prefix):
+        for k, s in enumerate(self.servers):
+            rpc.rpc_sync(s, _srv_load, args=(name, f"{path_prefix}.{k}.npz"))
+
+    def _dim(self, name):
+        if name not in self._dims:  # table created by another client
+            self._dims[name] = rpc.rpc_sync(self.servers[0], _srv_state,
+                                            args=(name,))["dim"]
+        return self._dims[name]

@@ -1,0 +1,102 @@
+"""paddle.signal — stft / istft.
+
+Reference: python/paddle/signal.py (stft returns [..., n_fft//2+1 (or
+n_fft), n_frames] complex; istft inverts with overlap-add and window
+normalization). Built on the audio framing helper + paddle.fft (XLA FFT
+HLO with the host fallback where the runtime lacks it).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import fft as _fft
+from .audio.functional import get_window
+from .audio.features import _frame
+from .core.dispatch import apply
+from .core.tensor import Tensor
+
+__all__ = ["stft", "istft"]
+
+
+def _prep_window(window, win_length, n_fft, dtype="float32"):
+    if window is None:
+        w = Tensor(jnp.ones(win_length, dtype))
+    elif isinstance(window, str):
+        w = get_window(window, win_length, dtype=dtype)
+    else:
+        w = window if isinstance(window, Tensor) else Tensor(window)
+    if win_length < n_fft:
+        lpad = (n_fft - win_length) // 2
+        w = Tensor(jnp.pad(w._data, (lpad, n_fft - win_length - lpad)))
+    return w
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    """x: [..., T] real (or complex with onesided=False). Returns
+    [..., freq, n_frames] complex."""
+    hop_length = n_fft // 4 if hop_length is None else hop_length
+    win_length = n_fft if win_length is None else win_length
+    assert hop_length > 0 and win_length > 0, \
+        f"hop_length/win_length must be positive ({hop_length}, {win_length})"
+    w = _prep_window(window, win_length, n_fft)
+    frames = _frame(x, n_fft, hop_length, center, pad_mode)
+    windowed = apply("stft_win", lambda a, ww: a * ww, [frames, w])
+    if onesided:
+        spec = _fft.rfft(windowed, n=n_fft, axis=-1)
+    else:
+        spec = _fft.fft(windowed, n=n_fft, axis=-1)
+    if normalized:
+        spec = apply("stft_norm",
+                     lambda s: s * np.float32(1.0 / np.sqrt(n_fft)), [spec])
+    return apply("stft_T", lambda s: jnp.swapaxes(s, -1, -2), [spec])
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    """Inverse of stft: x [..., freq, n_frames] -> [..., T]."""
+    hop_length = n_fft // 4 if hop_length is None else hop_length
+    win_length = n_fft if win_length is None else win_length
+    assert hop_length > 0 and win_length > 0, \
+        f"hop_length/win_length must be positive ({hop_length}, {win_length})"
+    if onesided and return_complex:
+        raise ValueError(
+            "onesided=True implies a real signal; return_complex=True is "
+            "contradictory (reference paddle.signal.istft raises too)")
+    w = _prep_window(window, win_length, n_fft)
+    spec = apply("istft_T", lambda s: jnp.swapaxes(s, -1, -2), [x])
+    if normalized:
+        spec = apply("istft_norm",
+                     lambda s: s * np.float32(np.sqrt(n_fft)), [spec])
+    if onesided:
+        frames = _fft.irfft(spec, n=n_fft, axis=-1)
+    else:
+        frames = _fft.ifft(spec, n=n_fft, axis=-1)
+        if not return_complex:
+            frames = apply("istft_real", lambda f: jnp.real(f), [frames])
+
+    def overlap_add(fr, ww):
+        n_frames = fr.shape[-2]
+        T = n_fft + hop_length * (n_frames - 1)
+        fr = fr * ww  # window again for WOLA
+        batch = fr.shape[:-2]
+        out = jnp.zeros(batch + (T,), fr.dtype)
+        norm = jnp.zeros((T,), jnp.float32)
+        for i in range(n_frames):  # static python loop -> fused by XLA
+            sl = (Ellipsis, slice(i * hop_length, i * hop_length + n_fft))
+            out = out.at[sl].add(fr[..., i, :])
+            norm = norm.at[i * hop_length:i * hop_length + n_fft].add(
+                ww.astype(jnp.float32) ** 2)
+        out = out / jnp.maximum(norm, 1e-11)
+        if center:
+            out = out[..., n_fft // 2:T - n_fft // 2]
+        return out
+
+    out = apply("istft_ola", overlap_add, [frames, w])
+    if length is not None:
+        out = apply("istft_len", lambda o: o[..., :length], [out])
+    return out

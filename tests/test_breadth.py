@@ -209,3 +209,26 @@ def test_reduce_lr_on_plateau():
     assert abs(opt.get_lr() - 0.05) < 1e-12
     cb.on_eval_end({"loss": 0.5})   # improvement: no change
     assert abs(opt.get_lr() - 0.05) < 1e-12
+
+
+# ---------------- signal ----------------
+def test_stft_istft_roundtrip():
+    rng = np.random.RandomState(0)
+    sig = rng.randn(2, 2048).astype("float32")
+    x = paddle.to_tensor(sig, stop_gradient=False)
+    S = paddle.signal.stft(x, n_fft=256, window="hann")
+    assert list(S.shape) == [2, 129, 33] and "complex" in str(S.dtype)
+    back = paddle.signal.istft(S, n_fft=256, window="hann", length=2048)
+    np.testing.assert_allclose(back.numpy(), sig, atol=1e-4)
+    S.real().sum().backward()
+    assert x._grad is not None
+
+
+def test_stft_matches_numpy_spectrum():
+    rng = np.random.RandomState(1)
+    sig = rng.randn(512).astype("float32")
+    S = paddle.signal.stft(paddle.to_tensor(sig[None]), n_fft=128,
+                           hop_length=64, window=None, center=False)
+    ref = np.stack([np.fft.rfft(sig[i * 64:i * 64 + 128])
+                    for i in range(7)], axis=-1)
+    np.testing.assert_allclose(S.numpy()[0], ref, rtol=1e-3, atol=1e-3)

@@ -102,3 +102,22 @@ def test_rpc_two_process(tmp_path):
     for rank in (0, 1):
         with open(os.path.join(log_dir, f"workerlog.{rank}")) as f:
             assert f"RPC OK rank={rank}" in f.read()
+
+
+@pytest.mark.slow
+def test_parameter_server_three_process(tmp_path):
+    """2 PS + 1 worker: sparse table create/pull/push/save/load across
+    processes (reference: fluid/distributed/ps capability)."""
+    port = 29771
+    env = _clean_env(port)
+    env["PADDLE_MASTER_ENDPOINT"] = f"127.0.0.1:{port}"
+    log_dir = str(tmp_path / "logs")
+    launched = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "3", "--master", f"127.0.0.1:{port+1}",
+         "--log_dir", log_dir,
+         os.path.join(WORKERS, "ps_worker.py")],
+        env=env, capture_output=True, text=True, timeout=300, cwd=REPO)
+    assert launched.returncode == 0, launched.stdout + launched.stderr
+    with open(os.path.join(log_dir, "workerlog.0")) as f:
+        assert "PS OK" in f.read()

@@ -1,0 +1,62 @@
+"""3-process PS integration: ranks 1,2 are parameter servers, rank 0 is a
+worker training a sparse embedding + dense head through pull/push
+(reference: ps-mode trainer/pserver split, test_dist_base.py pserver
+pattern)."""
+import os
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=1")
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import paddle_tpu.distributed.rpc as rpc
+import paddle_tpu.distributed.ps as ps
+
+
+def main():
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    ep = os.environ["PADDLE_MASTER_ENDPOINT"]
+    name = f"worker{rank}" if rank == 0 else f"ps{rank}"
+    rpc.init_rpc(name, master_endpoint=ep)
+    if rank != 0:
+        rpc.shutdown()  # servers: serve until the shutdown barrier
+        return
+
+    client = ps.PSClient(["ps1", "ps2"])
+    client.create_table("emb", dim=8, lr=0.5)
+    rng = np.random.RandomState(0)
+    ids = np.array([2, 7, 11, 2], np.int64)  # ids hash to both servers
+    rows0 = client.pull("emb", ids)
+    assert rows0.shape == (4, 8)
+    assert np.allclose(rows0[0], rows0[3])  # same id -> same row
+
+    # async-SGD: push a known gradient, expect row -= lr * g
+    g = np.ones((4, 8), np.float32)
+    client.push("emb", ids, g)
+    rows1 = client.pull("emb", ids)
+    # id 3 appears twice -> two updates
+    np.testing.assert_allclose(rows1[1], rows0[1] - 0.5, atol=1e-5)
+    np.testing.assert_allclose(rows1[0], rows0[0] - 1.0, atol=1e-5)
+
+    # rows shard across both servers
+    states = client.table_state("emb")
+    assert sum(s["n_rows"] for s in states) == 3
+    assert all(s["n_rows"] > 0 for s in states)
+
+    # save / load roundtrip
+    import tempfile
+    prefix = tempfile.mkdtemp() + "/emb"
+    client.save("emb", prefix)
+    client.push("emb", ids, g)  # perturb
+    client.load("emb", prefix)
+    rows2 = client.pull("emb", ids)
+    np.testing.assert_allclose(rows2, rows1, atol=1e-6)
+
+    print("PS OK", flush=True)
+    rpc.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -83,8 +83,10 @@ def _srv_state(name):
 
 def _srv_save(name, path):
     t = _tables[name]
-    np.savez(path, ids=np.array(list(t.rows.keys()), np.int64),
-             rows=np.stack(list(t.rows.values())) if t.rows
+    with t._lock:  # atomic ids/rows snapshot vs concurrent pushes
+        items = list(t.rows.items())
+    np.savez(path, ids=np.array([i for i, _ in items], np.int64),
+             rows=np.stack([r for _, r in items]) if items
              else np.zeros((0, t.dim), np.float32))
     return True
 
@@ -92,13 +94,16 @@ def _srv_save(name, path):
 def _srv_load(name, path):
     t = _tables[name]
     data = np.load(path)
-    t.rows = {int(i): r.copy() for i, r in zip(data["ids"], data["rows"])}
+    new_rows = {int(i): r.copy()
+                for i, r in zip(data["ids"], data["rows"])}
+    with t._lock:  # swap under the lock so in-flight pushes can't strand
+        t.rows = new_rows
     return True
 
 
 def shard_for(ids, n_servers):
     """id -> server assignment (reference: sharding by id hash)."""
-    return [int(i) % n_servers for i in ids]
+    return np.asarray(ids, np.int64) % n_servers
 
 
 class PSServer:

@@ -84,13 +84,14 @@ def istft(x, n_fft, hop_length=None, win_length=None, window=None,
         T = n_fft + hop_length * (n_frames - 1)
         fr = fr * ww  # window again for WOLA
         batch = fr.shape[:-2]
-        out = jnp.zeros(batch + (T,), fr.dtype)
-        norm = jnp.zeros((T,), jnp.float32)
-        for i in range(n_frames):  # static python loop -> fused by XLA
-            sl = (Ellipsis, slice(i * hop_length, i * hop_length + n_fft))
-            out = out.at[sl].add(fr[..., i, :])
-            norm = norm.at[i * hop_length:i * hop_length + n_fft].add(
-                ww.astype(jnp.float32) ** 2)
+        # one scatter-add for all frames (an unrolled python loop emitted
+        # ~2 ops per frame — minutes of compile for long signals)
+        pos = (jnp.arange(n_frames)[:, None] * hop_length
+               + jnp.arange(n_fft)[None, :]).reshape(-1)
+        out = jnp.zeros(batch + (T,), fr.dtype) \
+            .at[..., pos].add(fr.reshape(batch + (-1,)))
+        norm = jnp.zeros((T,), jnp.float32).at[pos].add(
+            jnp.tile(ww.astype(jnp.float32) ** 2, n_frames))
         out = out / jnp.maximum(norm, 1e-11)
         if center:
             out = out[..., n_fft // 2:T - n_fft // 2]

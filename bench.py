@@ -358,7 +358,12 @@ def main():
             fn()
             _save_snapshot(snap)
         except Exception as e:
-            sub.setdefault("errors", {})[label] =                 f"{type(e).__name__}: {e}"[:200]
+            # mark the emitted line stale: a carried-over headline value
+            # must never read as a fresh measurement of this run
+            sub.setdefault("errors", {})[label] = \
+                f"{type(e).__name__}: {e}"[:200]
+            sub["stale"] = f"{label} failed this run"
+            _save_snapshot(snap)
             _log(f"[bench] {label} FAILED: {e}")
 
     def _matmul():

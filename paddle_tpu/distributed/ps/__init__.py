@@ -83,11 +83,11 @@ def _srv_state(name):
 
 def _srv_save(name, path):
     t = _tables[name]
-    with t._lock:  # atomic ids/rows snapshot vs concurrent pushes
-        items = list(t.rows.items())
-    np.savez(path, ids=np.array([i for i, _ in items], np.int64),
-             rows=np.stack([r for _, r in items]) if items
-             else np.zeros((0, t.dim), np.float32))
+    with t._lock:  # copy row CONTENTS under the lock: pushes mutate the
+        # live arrays in place, so holding references is not a snapshot
+        ids = np.array(list(t.rows.keys()), np.int64)
+        rows = np.stack([r.copy() for r in t.rows.values()]) if t.rows             else np.zeros((0, t.dim), np.float32)
+    np.savez(path, ids=ids, rows=rows)
     return True
 
 

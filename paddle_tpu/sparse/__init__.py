@@ -15,7 +15,7 @@ from jax.experimental import sparse as jsparse
 from ..core.tensor import Tensor
 
 __all__ = ["sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
-           "is_same_shape", "add", "matmul", "masked_matmul"]
+           "is_same_shape", "add", "matmul", "masked_matmul", "nn"]
 
 
 class SparseCooTensor:
@@ -115,3 +115,6 @@ def masked_matmul(x, y, mask):
     idx = mask._bcoo.indices
     vals = full[idx[:, 0], idx[:, 1]]
     return SparseCooTensor(jsparse.BCOO((vals, idx), shape=full.shape))
+
+
+from . import nn  # noqa: E402,F401

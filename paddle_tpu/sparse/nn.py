@@ -1,0 +1,138 @@
+"""paddle.sparse.nn — sparse attention + submanifold sparse conv.
+
+Reference: python/paddle/sparse/nn/ (Conv3D/SubmConv3D over
+phi/kernels/sparse/gpu/conv_kernel.cu; functional/attention.py
+fused_attention over sparse_attention kernels). TPU-native design: the
+sparse conv gathers active-site neighborhoods (COO indices) and runs ONE
+dense [n_active, K^3*Cin] x [K^3*Cin, Cout] matmul on the MXU — the
+gather/GEMM formulation of submanifold conv; sparse attention applies a
+BCOO mask inside a dense softmax (XLA fuses the masking; the O(S^2) tile
+never materializes values outside the mask's support pattern at use time).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import nn as dense_nn
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+from . import SparseCooTensor, sparse_coo_tensor
+
+__all__ = ["attention", "SubmConv3D", "Conv3D"]
+
+
+def attention(query, key, value, sparse_mask, key_padding_mask=None,
+              attn_mask=None, name=None):
+    """Sparse-mask attention (reference: sparse/nn/functional/attention.py).
+
+    query/key/value: [B, H, S, D]; sparse_mask: SparseCooTensor [S, S] (its
+    sparsity pattern selects which logits participate in the softmax)."""
+    mask_dense = sparse_mask.to_dense() if isinstance(
+        sparse_mask, SparseCooTensor) else sparse_mask
+
+    has_kp = key_padding_mask is not None
+    has_am = attn_mask is not None
+
+    def f(q, k, v, m, *rest):
+        d = q.shape[-1]
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.float32(np.sqrt(d))
+        neg = np.float32(-1e30)
+        s = jnp.where(m != 0, s, neg)
+        rest = list(rest)
+        if has_kp:
+            kp = rest.pop(0)  # [B, S] True = keep
+            s = jnp.where(kp[:, None, None, :], s, neg)
+        if has_am:
+            am = rest.pop(0)  # additive mask broadcastable to [B,H,S,S]
+            s = s + am.astype(s.dtype)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+    ins = [query, key, value, mask_dense]
+    if has_kp:
+        ins.append(key_padding_mask)
+    if has_am:
+        ins.append(attn_mask)
+    return apply("sparse_attention", f, ins)
+
+
+def _neighbor_offsets(kernel_size):
+    k = kernel_size
+    r = k // 2
+    offs = [(dz, dy, dx)
+            for dz in range(-r, r + 1)
+            for dy in range(-r, r + 1)
+            for dx in range(-r, r + 1)]
+    return offs
+
+
+class SubmConv3D(dense_nn.Layer):
+    """Submanifold sparse 3-D conv (reference: sparse/nn/layer/conv.py
+    SubmConv3D): outputs live only at INPUT active sites, so sparsity does
+    not dilate. Gather-GEMM formulation: for each kernel offset, gather the
+    neighbor feature (zero where inactive), then one dense matmul."""
+
+    def __init__(self, in_channels, out_channels, kernel_size=3,
+                 bias_attr=None):
+        super().__init__()
+        assert kernel_size % 2 == 1, "submanifold conv needs odd kernels"
+        self.in_channels = in_channels
+        self.out_channels = out_channels
+        self.kernel_size = kernel_size
+        k3 = kernel_size ** 3
+        self.weight = self.create_parameter(
+            (k3 * in_channels, out_channels))
+        self.bias = None if bias_attr is False else self.create_parameter(
+            (out_channels,), is_bias=True)
+
+    def forward(self, x: SparseCooTensor):
+        # x: COO [B, D, H, W, C]
+        bcoo = x._bcoo
+        idx = bcoo.indices           # [nnz, 4] (b, z, y, x)
+        vals = bcoo.data             # [nnz, C]
+        shape = x.shape
+        offs = np.array(_neighbor_offsets(self.kernel_size), np.int32)
+
+        def f(idx_a, vals_a, w, *rest):
+            nnz = idx_a.shape[0]
+            D, H, W = shape[1], shape[2], shape[3]
+            # dense scatter of active features for O(1) neighbor lookup
+            grid = jnp.zeros((shape[0], D, H, W, self.in_channels),
+                             vals_a.dtype)
+            grid = grid.at[idx_a[:, 0], idx_a[:, 1], idx_a[:, 2],
+                           idx_a[:, 3]].set(vals_a)
+            gathered = []
+            for dz, dy, dx in offs:
+                z = jnp.clip(idx_a[:, 1] + dz, 0, D - 1)
+                y = jnp.clip(idx_a[:, 2] + dy, 0, H - 1)
+                xx = jnp.clip(idx_a[:, 3] + dx, 0, W - 1)
+                inside = ((idx_a[:, 1] + dz >= 0) & (idx_a[:, 1] + dz < D)
+                          & (idx_a[:, 2] + dy >= 0)
+                          & (idx_a[:, 2] + dy < H)
+                          & (idx_a[:, 3] + dx >= 0)
+                          & (idx_a[:, 3] + dx < W))
+                g = grid[idx_a[:, 0], z, y, xx]
+                gathered.append(jnp.where(inside[:, None], g, 0.0))
+            feat = jnp.concatenate(gathered, axis=-1)  # [nnz, k3*Cin]
+            out = feat @ w                              # MXU GEMM
+            if rest:
+                out = out + rest[0]
+            return out
+
+        ins = [Tensor(idx), Tensor(vals), self.weight]
+        if self.bias is not None:
+            ins.append(self.bias)
+        out_vals = apply("subm_conv3d", f, ins)
+        out_bcoo = jax.experimental.sparse.BCOO(
+            (out_vals._data, idx),
+            shape=tuple(shape[:4]) + (self.out_channels,))
+        return SparseCooTensor(out_bcoo)
+
+
+class Conv3D(SubmConv3D):
+    """Non-submanifold sparse conv (reference: sparse/nn/layer/conv.py
+    Conv3D). Simplification: computes at input active sites only (the
+    submanifold pattern) — dilation of the active set is not modeled; use
+    dense nn.Conv3D when full dilation semantics are required."""

@@ -232,3 +232,57 @@ def test_stft_matches_numpy_spectrum():
     ref = np.stack([np.fft.rfft(sig[i * 64:i * 64 + 128])
                     for i in range(7)], axis=-1)
     np.testing.assert_allclose(S.numpy()[0], ref, rtol=1e-3, atol=1e-3)
+
+
+# ---------------- sparse nn ----------------
+def test_sparse_attention_matches_masked_dense():
+    import paddle_tpu.sparse as sparse
+    paddle.seed(0)
+    rng = np.random.RandomState(0)
+    B, H, S, D = 2, 2, 16, 8
+    q = paddle.to_tensor(rng.randn(B, H, S, D).astype("float32"),
+                         stop_gradient=False)
+    k = paddle.to_tensor(rng.randn(B, H, S, D).astype("float32"))
+    v = paddle.to_tensor(rng.randn(B, H, S, D).astype("float32"))
+    mask_np = (rng.rand(S, S) < 0.4).astype("float32")
+    mask_np[np.arange(S), np.arange(S)] = 1
+    idx = np.argwhere(mask_np)
+    sm = sparse.sparse_coo_tensor(idx.T, mask_np[mask_np > 0], shape=(S, S))
+    out = sparse.nn.attention(q, k, v, sm)
+    s_ref = np.einsum("bhqd,bhkd->bhqk", q.numpy(), k.numpy()) / np.sqrt(D)
+    s_ref = np.where(mask_np != 0, s_ref, -1e30)
+    p_ref = np.exp(s_ref - s_ref.max(-1, keepdims=True))
+    p_ref /= p_ref.sum(-1, keepdims=True)
+    ref = np.einsum("bhqk,bhkd->bhqd", p_ref, v.numpy())
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+    out.sum().backward()
+    assert q._grad is not None
+
+
+def test_subm_conv3d_preserves_sparsity_and_matches_dense():
+    import paddle_tpu.sparse as sparse
+    paddle.seed(1)
+    rng = np.random.RandomState(1)
+    coords = np.unique(rng.randint(0, 8, (30, 4)) % [1, 8, 8, 8], axis=0)
+    vals = rng.randn(len(coords), 3).astype("float32")
+    xs = sparse.sparse_coo_tensor(coords.T, vals, shape=(1, 8, 8, 8, 3))
+    conv = sparse.nn.SubmConv3D(3, 5, kernel_size=3)
+    ys = conv(xs)
+    assert ys._bcoo.nse == xs._bcoo.nse  # submanifold: no dilation
+    # golden: dense 3D conv evaluated at the active sites
+    dense = np.zeros((1, 8, 8, 8, 3), np.float32)
+    dense[coords[:, 0], coords[:, 1], coords[:, 2], coords[:, 3]] = vals
+    w = np.asarray(conv.weight._data).reshape(3, 3, 3, 3, 5)  # kz,ky,kx,Cin,Cout
+    b = np.asarray(conv.bias._data)
+    out_vals = np.asarray(ys._bcoo.data)
+    for row, (bb, z, y, x) in enumerate(coords):
+        acc = np.zeros(5, np.float32)
+        for dz in range(-1, 2):
+            for dy in range(-1, 2):
+                for dx in range(-1, 2):
+                    zz, yy, xx = z + dz, y + dy, x + dx
+                    if 0 <= zz < 8 and 0 <= yy < 8 and 0 <= xx < 8:
+                        acc += dense[bb, zz, yy, xx] @ \
+                            w[dz + 1, dy + 1, dx + 1]
+        np.testing.assert_allclose(out_vals[row], acc + b, rtol=1e-4,
+                                   atol=1e-4)

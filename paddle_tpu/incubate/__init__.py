@@ -14,7 +14,39 @@ from .ring_attention import ring_attention  # noqa: F401
 
 __all__ = ["MoELayer", "TopKGate", "ring_attention", "fused_rms_norm",
            "fused_layer_norm", "fused_rotary_position_embedding",
-           "flash_attention"]
+           "flash_attention", "paged_attention"]
+
+
+def paged_attention(q, k_cache, v_cache, block_tables, context_lens,
+                    scale=None, use_pallas=None, interpret=False):
+    """Decode-time attention over a paged KV cache (reference:
+    incubate block_multihead_attention / fusion
+    block_multi_head_attention_kernel.cu). q [B,H,D]; caches
+    [num_pages, page_size, H, D]; block_tables [B, max_pages];
+    context_lens [B]. The Pallas kernel streams pages via scalar-prefetched
+    index maps on TPU; the jnp gather path runs elsewhere. Differentiable
+    through the tape (decode-serving typically doesn't need grads, but the
+    gather formulation provides them)."""
+    import jax as _jax
+
+    from ..core.dispatch import apply
+
+    if use_pallas is None:
+        use_pallas = interpret or _jax.default_backend() == "tpu"
+
+    def f(qa, ka, va, bt, cl):
+        if use_pallas:
+            # trainable variant: pallas forward, reference-path backward
+            # (the scalar-prefetch grid has no JVP rule)
+            from ..ops.pallas.paged_attention import                 paged_attention_trainable
+            return paged_attention_trainable(qa, ka, va, bt, cl,
+                                             scale=scale,
+                                             interpret=interpret)
+        from ..ops.pallas.paged_attention import paged_attention_reference
+        return paged_attention_reference(qa, ka, va, bt, cl, scale=scale)
+
+    return apply("paged_attention", f,
+                 [q, k_cache, v_cache, block_tables, context_lens])
 
 
 def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,

@@ -93,15 +93,32 @@ class GPTAttention(nn.Layer):
             self.qkv_proj = nn.Linear(h, 3 * h)
             self.out_proj = nn.Linear(h, h)
 
-    def forward(self, x):
+    def forward(self, x, cache=None):
+        """cache (decode): dict with 'k'/'v' Tensors [B, T, H, Dh] that new
+        keys/values are appended to (reference: fused multi-head attention
+        cache_kv semantics)."""
         b, s, h = x.shape
         qkv = self.qkv_proj(x)
         qkv = qkv.reshape([b, s, 3, self.num_heads, self.head_dim])
         q, k, v = qkv.unbind(2) if hasattr(qkv, "unbind") else (
             qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2])
-        out = F.scaled_dot_product_attention(
-            q, k, v, is_causal=True, dropout_p=self.dropout,
-            training=self.training)
+        if cache is not None:
+            from .. import ops
+            if cache.get("k") is not None:
+                if s != 1:
+                    raise NotImplementedError(
+                        "cached attention appends one token at a time "
+                        "after the prefill pass")
+                k = ops.concat([cache["k"], k], axis=1)
+                v = ops.concat([cache["v"], v], axis=1)
+            cache["k"], cache["v"] = k, v
+            causal = s > 1  # prefill is causal; single-token decode
+            out = F.scaled_dot_product_attention(
+                q, k, v, is_causal=causal, dropout_p=0.0, training=False)
+        else:
+            out = F.scaled_dot_product_attention(
+                q, k, v, is_causal=True, dropout_p=self.dropout,
+                training=self.training)
         out = out.reshape([b, s, h])
         return self.out_proj(out)
 
@@ -138,9 +155,9 @@ class GPTBlock(nn.Layer):
         self.dropout = nn.Dropout(config.dropout)
         self._sp = config.sequence_parallel
 
-    def forward(self, x):
+    def forward(self, x, cache=None):
         x = _sp_constrain(x, self._sp)
-        x = x + self.dropout(self.attn(self.ln_1(x)))
+        x = x + self.dropout(self.attn(self.ln_1(x), cache=cache))
         x = x + self.mlp(self.ln_2(x))
         return x
 
@@ -165,14 +182,15 @@ class GPTModel(nn.Layer):
         self.ln_f = norm(config.hidden_size,
                          epsilon=config.layer_norm_epsilon)
 
-    def forward(self, input_ids):
+    def forward(self, input_ids, caches=None, pos_offset=0):
         b, s = input_ids.shape
         from .. import ops
-        pos = ops.arange(0, s, dtype="int64").unsqueeze(0)
+        pos = ops.arange(pos_offset, pos_offset + s,
+                         dtype="int64").unsqueeze(0)
         x = self.wte(input_ids) + self.wpe(pos)
         x = self.drop(x)
-        for block in self.h:
-            x = block(x)
+        for i, block in enumerate(self.h):
+            x = block(x, cache=None if caches is None else caches[i])
         return self.ln_f(x)
 
 
@@ -187,8 +205,8 @@ class GPTForCausalLM(nn.Layer):
             self.lm_head = nn.Linear(config.hidden_size, config.vocab_size,
                                      bias_attr=False)
 
-    def forward(self, input_ids):
-        hidden = self.gpt(input_ids)
+    def forward(self, input_ids, caches=None, pos_offset=0):
+        hidden = self.gpt(input_ids, caches=caches, pos_offset=pos_offset)
         if self.config.tie_word_embeddings:
             w = self.gpt.wte.weight  # [vocab, hidden]
             logits = apply("lm_head_tied",
@@ -197,6 +215,75 @@ class GPTForCausalLM(nn.Layer):
         else:
             logits = self.lm_head(hidden)
         return logits
+
+    def generate(self, input_ids, max_new_tokens=32, temperature=1.0,
+                 top_k=None, eos_token_id=None, use_cache=True):
+        """Autoregressive decoding with a per-layer KV cache (reference
+        capability: the generation loop over fused attention cache_kv /
+        block_multihead_attention). Greedy when temperature == 0; otherwise
+        temperature + optional top-k sampling from the framework RNG."""
+        from .. import ops
+        from ..core import random as _random
+        from ..core.autograd import no_grad
+
+        if input_ids.shape[1] + max_new_tokens > self.config.max_seq_len:
+            raise ValueError(
+                f"prompt ({input_ids.shape[1]}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds max_seq_len "
+                f"({self.config.max_seq_len}); positions past the table "
+                "would silently clamp")
+        was_training = self.training
+        self.eval()  # decode must be deterministic (dropout off) so the
+        # cached and full-recompute paths agree
+        try:
+            with no_grad():
+                caches = [{"k": None, "v": None}
+                          for _ in self.gpt.h] if use_cache else None
+                out_ids = input_ids
+                logits = self(input_ids, caches=caches)
+                cur_len = input_ids.shape[1]
+                finished = None  # [B, 1] rows that already emitted eos
+                for _ in range(max_new_tokens):
+                    last = logits[:, -1]                   # [B, V]
+                    if temperature == 0.0:
+                        nxt = ops.argmax(last, axis=-1, keepdim=True)
+                    else:
+                        arr = last._data / np.float32(max(temperature,
+                                                          1e-6))
+                        if top_k is not None:
+                            kth = jax.lax.top_k(arr, top_k)[0][..., -1:]
+                            arr = jnp.where(arr < kth, -jnp.inf, arr)
+                        nxt_arr = jax.random.categorical(
+                            _random.next_key(), arr, axis=-1)[:, None]
+                        from ..core.tensor import Tensor
+                        nxt = Tensor(nxt_arr, stop_gradient=True)
+                    nxt = nxt.astype(input_ids.dtype)
+                    if eos_token_id is not None:
+                        from ..core.tensor import Tensor
+                        is_eos = nxt._data == eos_token_id
+                        if finished is None:
+                            finished = is_eos
+                        else:
+                            # frozen rows keep emitting eos padding
+                            nxt = Tensor(jnp.where(
+                                finished, jnp.asarray(
+                                    eos_token_id, nxt._data.dtype),
+                                nxt._data), stop_gradient=True)
+                            finished = finished | is_eos
+                    out_ids = ops.concat([out_ids, nxt], axis=1)
+                    if finished is not None and bool(
+                            jnp.all(finished)):
+                        break
+                    if use_cache:
+                        logits = self(nxt, caches=caches,
+                                      pos_offset=cur_len)
+                    else:
+                        logits = self(out_ids)
+                    cur_len += 1
+                return out_ids
+        finally:
+            if was_training:
+                self.train()
 
 
 class GPTPretrainingCriterion(nn.Layer):

@@ -19,6 +19,7 @@ __all__ = [
     "linear", "dropout", "dropout2d", "dropout3d", "pad", "embedding",
     "cosine_similarity", "interpolate", "upsample", "unfold",
     "scaled_dot_product_attention", "alpha_dropout", "label_smooth",
+    "pixel_shuffle", "pixel_unshuffle", "affine_grid", "grid_sample",
 ]
 
 
@@ -261,3 +262,114 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
 
     ins = [query, key, value] + ([attn_mask] if attn_mask is not None else [])
     return apply("scaled_dot_product_attention", fwd, ins)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    """Reference: nn/functional/vision.py pixel_shuffle (phi
+    pixel_shuffle_kernel): rearranges [N, C*r^2, H, W] -> [N, C, H*r, W*r].
+    """
+    r = int(upscale_factor)
+
+    def f(a):
+        if data_format == "NHWC":
+            a = jnp.transpose(a, (0, 3, 1, 2))
+        n, c, h, w = a.shape
+        oc = c // (r * r)
+        a = a.reshape(n, oc, r, r, h, w)
+        a = jnp.transpose(a, (0, 1, 4, 2, 5, 3))
+        a = a.reshape(n, oc, h * r, w * r)
+        if data_format == "NHWC":
+            a = jnp.transpose(a, (0, 2, 3, 1))
+        return a
+
+    return apply("pixel_shuffle", f, [x])
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = int(downscale_factor)
+
+    def f(a):
+        if data_format == "NHWC":
+            a = jnp.transpose(a, (0, 3, 1, 2))
+        n, c, h, w = a.shape
+        a = a.reshape(n, c, h // r, r, w // r, r)
+        a = jnp.transpose(a, (0, 1, 3, 5, 2, 4))
+        a = a.reshape(n, c * r * r, h // r, w // r)
+        if data_format == "NHWC":
+            a = jnp.transpose(a, (0, 2, 3, 1))
+        return a
+
+    return apply("pixel_unshuffle", f, [x])
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """Reference: nn/functional/vision.py affine_grid. theta [N, 2, 3];
+    out_shape [N, C, H, W] -> grid [N, H, W, 2] (x, y in [-1, 1])."""
+    N, C, H, W = [int(d) for d in out_shape]
+
+    def f(th):
+        if align_corners:
+            xs = jnp.linspace(-1.0, 1.0, W)
+            ys = jnp.linspace(-1.0, 1.0, H)
+        else:
+            xs = (jnp.arange(W) * 2 + 1) / W - 1.0
+            ys = (jnp.arange(H) * 2 + 1) / H - 1.0
+        gx, gy = jnp.meshgrid(xs, ys)              # [H, W]
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones], axis=-1)  # [H, W, 3]
+        return jnp.einsum("hwk,nck->nhwc", base.astype(th.dtype), th)
+
+    return apply("affine_grid", f, [theta])
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """Reference: nn/functional/vision.py grid_sample (phi grid_sample).
+    x [N, C, H, W]; grid [N, Ho, Wo, 2] normalized coords."""
+
+    if mode not in ("bilinear", "nearest"):
+        raise NotImplementedError(f"grid_sample mode {mode!r} "
+                                  "(bilinear/nearest supported)")
+    if padding_mode not in ("zeros", "border"):
+        raise NotImplementedError(
+            f"grid_sample padding_mode {padding_mode!r} "
+            "(zeros/border supported)")
+
+    def f(a, g):
+        n, c, h, w = a.shape
+        gx, gy = g[..., 0], g[..., 1]
+        if align_corners:
+            fx = (gx + 1.0) * (w - 1) / 2.0
+            fy = (gy + 1.0) * (h - 1) / 2.0
+        else:
+            fx = ((gx + 1.0) * w - 1.0) / 2.0
+            fy = ((gy + 1.0) * h - 1.0) / 2.0
+
+        def gather(yy, xx):
+            """a[n, :, yy, xx] with out-of-bounds handling -> [N,Ho,Wo,C]"""
+            inside = ((xx >= 0) & (xx <= w - 1) & (yy >= 0)
+                      & (yy <= h - 1))
+            xc = jnp.clip(xx, 0, w - 1).astype(jnp.int32)
+            yc = jnp.clip(yy, 0, h - 1).astype(jnp.int32)
+            batch = jnp.arange(n)[:, None, None]
+            vals = a[batch, :, yc, xc]             # [N, Ho, Wo, C]
+            if padding_mode == "zeros":
+                vals = jnp.where(inside[..., None], vals, 0.0)
+            return vals
+
+        if mode == "nearest":
+            out = gather(jnp.round(fy), jnp.round(fx))
+        else:  # bilinear
+            x0, y0 = jnp.floor(fx), jnp.floor(fy)
+            x1, y1 = x0 + 1, y0 + 1
+            wa = (x1 - fx) * (y1 - fy)
+            wb = (fx - x0) * (y1 - fy)
+            wc = (x1 - fx) * (fy - y0)
+            wd = (fx - x0) * (fy - y0)
+            out = (gather(y0, x0) * wa[..., None]
+                   + gather(y0, x1) * wb[..., None]
+                   + gather(y1, x0) * wc[..., None]
+                   + gather(y1, x1) * wd[..., None])
+        return jnp.transpose(out, (0, 3, 1, 2))    # [N, C, Ho, Wo]
+
+    return apply("grid_sample", f, [x, grid])

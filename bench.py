@@ -332,6 +332,26 @@ def bench_gpt_large(peak):
     return flops / t / peak * 100, t, n_params
 
 
+def bench_generate():
+    """Serving decode throughput: KV-cache autoregressive generation
+    (tokens/s across the batch), eager per-token dispatch."""
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=8192, hidden_size=512, num_layers=8,
+                    num_heads=8, max_seq_len=512, dropout=0.0)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    rng = np.random.RandomState(0)
+    B, prompt, new = 8, 32, 32
+    ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (B, prompt))
+                           .astype("int64"))
+    model.generate(ids, max_new_tokens=4, temperature=0.0)  # warm caches
+    t0 = time.perf_counter()
+    out = model.generate(ids, max_new_tokens=new, temperature=0.0)
+    _sync(out)
+    dt = time.perf_counter() - t0
+    return B * new / dt
+
+
 def _log(msg):
     print(msg, file=sys.stderr, flush=True)
 
@@ -414,6 +434,11 @@ def main():
         sub["gpt_large_params"] = int(lg_params)
         _log(f"[bench] gpt-large done: {lg_mfu:.1f}% MFU")
 
+    def _generate():
+        tok_s = bench_generate()
+        sub["decode_tokens_per_sec"] = round(tok_s, 1)
+        _log(f"[bench] generate done: {tok_s:.1f} tokens/s")
+
     guarded("matmul", _matmul)
     guarded("eager_dispatch", _eager)
     guarded("lenet", _lenet)
@@ -423,6 +448,7 @@ def main():
     guarded("gpt", _gpt)
     if not _FAST and on_tpu:
         guarded("gpt_large", _gpt_large)
+        guarded("generate", _generate)
     if "value" not in snap:
         snap.update(metric="gpt_train_step_mfu", value=0.0, unit="%",
                     vs_baseline=0.0)

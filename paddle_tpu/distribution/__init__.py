@@ -201,6 +201,53 @@ def kl_divergence(p, q):
         def fwd(lo1, hi1, lo2, hi2):
             return jnp.log((hi2 - lo2) / (hi1 - lo1))
         return apply("kl_uniform", fwd, [p.low, p.high, q.low, q.high])
+    from .extra import (Beta, Dirichlet, Exponential, Gamma, Laplace,
+                        LogNormal)
+    if isinstance(p, Exponential) and isinstance(q, Exponential):
+        def fwd(r1, r2):
+            return jnp.log(r1 / r2) + r2 / r1 - 1.0
+        return apply("kl_exponential", fwd, [p.rate, q.rate])
+    if isinstance(p, LogNormal) and isinstance(q, LogNormal):
+        # same KL as the underlying Normals (exp is a bijection)
+        def fwd(mu1, s1, mu2, s2):
+            var1, var2 = s1 * s1, s2 * s2
+            return (jnp.log(s2 / s1) + (var1 + (mu1 - mu2) ** 2)
+                    / (2 * var2) - 0.5)
+        return apply("kl_lognormal", fwd, [p.loc, p.scale, q.loc, q.scale])
+    if isinstance(p, Gamma) and isinstance(q, Gamma):
+        from jax.scipy.special import digamma, gammaln
+
+        def fwd(a1, r1, a2, r2):
+            return ((a1 - a2) * digamma(a1) - gammaln(a1) + gammaln(a2)
+                    + a2 * (jnp.log(r1) - jnp.log(r2))
+                    + a1 * (r2 - r1) / r1)
+        return apply("kl_gamma", fwd,
+                     [p.concentration, p.rate, q.concentration, q.rate])
+    if isinstance(p, Beta) and isinstance(q, Beta):
+        from jax.scipy.special import betaln, digamma
+
+        def fwd(a1, b1, a2, b2):
+            return (betaln(a2, b2) - betaln(a1, b1)
+                    + (a1 - a2) * digamma(a1) + (b1 - b2) * digamma(b1)
+                    + (a2 - a1 + b2 - b1) * digamma(a1 + b1))
+        return apply("kl_beta", fwd, [p.alpha, p.beta, q.alpha, q.beta])
+    if isinstance(p, Dirichlet) and isinstance(q, Dirichlet):
+        from jax.scipy.special import digamma, gammaln
+
+        def fwd(c1, c2):
+            s1 = jnp.sum(c1, -1)
+            t = (gammaln(s1) - jnp.sum(gammaln(c1), -1)
+                 - gammaln(jnp.sum(c2, -1)) + jnp.sum(gammaln(c2), -1))
+            return t + jnp.sum(
+                (c1 - c2) * (digamma(c1) - digamma(s1)[..., None]), -1)
+        return apply("kl_dirichlet", fwd,
+                     [p.concentration, q.concentration])
+    if isinstance(p, Laplace) and isinstance(q, Laplace):
+        def fwd(m1, b1, m2, b2):
+            d = jnp.abs(m1 - m2)
+            return (jnp.log(b2 / b1) + d / b2
+                    + b1 / b2 * jnp.exp(-d / b1) - 1.0)
+        return apply("kl_laplace", fwd, [p.loc, p.scale, q.loc, q.scale])
     raise NotImplementedError(
         f"kl_divergence({type(p).__name__}, {type(q).__name__}) "
         "is not registered")

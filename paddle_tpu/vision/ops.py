@@ -1,0 +1,183 @@
+"""paddle.vision.ops — detection ops (nms, roi_align, box utilities).
+
+Reference: python/paddle/vision/ops.py (nms over phi nms_kernel, roi_align
+over roi_align_kernel). TPU notes: nms's data-dependent suppression loop is
+a lax.while-style fixed-point over a static-size score order (compiled
+control flow, no host sync); roi_align is a vectorized bilinear gather —
+XLA turns it into one fused gather/interpolate program.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+
+__all__ = ["nms", "roi_align", "box_area", "box_iou"]
+
+
+def _pairwise_iou(a, b):
+    """IoU matrix [len(a), len(b)] — the single source of the box math."""
+    ar1 = jnp.maximum(a[:, 2] - a[:, 0], 0) \
+        * jnp.maximum(a[:, 3] - a[:, 1], 0)
+    ar2 = jnp.maximum(b[:, 2] - b[:, 0], 0) \
+        * jnp.maximum(b[:, 3] - b[:, 1], 0)
+    ix1 = jnp.maximum(a[:, None, 0], b[None, :, 0])
+    iy1 = jnp.maximum(a[:, None, 1], b[None, :, 1])
+    ix2 = jnp.minimum(a[:, None, 2], b[None, :, 2])
+    iy2 = jnp.minimum(a[:, None, 3], b[None, :, 3])
+    inter = jnp.maximum(ix2 - ix1, 0) * jnp.maximum(iy2 - iy1, 0)
+    return inter / jnp.maximum(ar1[:, None] + ar2[None, :] - inter, 1e-10)
+
+
+def _iou_matrix(boxes):
+    return _pairwise_iou(boxes, boxes)
+
+
+def box_area(boxes):
+    return apply("box_area", lambda b: jnp.maximum(b[:, 2] - b[:, 0], 0)
+                 * jnp.maximum(b[:, 3] - b[:, 1], 0), [boxes])
+
+
+def box_iou(boxes1, boxes2):
+    return apply("box_iou", _pairwise_iou, [boxes1, boxes2])
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None, name=None):
+    """Reference: vision/ops.py nms. boxes [N, 4] (x1,y1,x2,y2); returns
+    kept indices sorted by score. Category-aware when category_idxs given
+    (boxes of different categories never suppress each other).
+
+    TPU-native: greedy suppression as a compiled sequential scan over the
+    score-sorted boxes (the dependency is inherently sequential; the IoU
+    matrix is computed once on the MXU-friendly vectorized path)."""
+    n = boxes.shape[0]
+
+    def f(b, *rest):
+        sc = rest[0] if scores is not None else jnp.arange(
+            n, 0, -1, dtype=jnp.float32)
+        order = jnp.argsort(-sc)
+        bs = b[order]
+        iou = _iou_matrix(bs)
+        if category_idxs is not None:
+            cat = rest[-1][order]
+            same = cat[:, None] == cat[None, :]
+            iou = jnp.where(same, iou, 0.0)
+
+        def step(keep, i):
+            # suppressed if any higher-scored KEPT box overlaps too much
+            over = (iou[i] > iou_threshold) & keep \
+                & (jnp.arange(n) < i)
+            ki = ~jnp.any(over)
+            return keep.at[i].set(ki), None
+
+        keep, _ = jax.lax.scan(step, jnp.zeros(n, bool), jnp.arange(n))
+        kept_sorted = jnp.where(keep, jnp.arange(n), n)
+        sel = jnp.sort(kept_sorted)  # positions in score order
+        return order[jnp.clip(sel, 0, n - 1)], jnp.sum(keep)
+
+    # selection indices are not differentiable — detach so the dispatch
+    # never tapes integer outputs (dispatch aux convention)
+    ins = [boxes.detach()]
+    if scores is not None:
+        ins.append(scores.detach())
+    if category_idxs is not None:
+        ins.append(category_idxs)
+    idxs, count = apply("nms", lambda *a: f(*a), ins, nout=2)
+    c = int(count.numpy())
+    out = idxs[:c]
+    if top_k is not None:
+        out = out[:min(top_k, c)]
+    return out
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """Reference: vision/ops.py roi_align (phi roi_align_kernel).
+    x [N, C, H, W]; boxes [R, 4] in input coords; boxes_num [N] rois per
+    image. Returns [R, C, out_h, out_w]."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+    if sampling_ratio <= 0:
+        # reference adapts per-roi (ceil(roi/output)); XLA needs a static
+        # count, so use the max over the (eager, concrete) rois — under
+        # tracing fall back to 2 samples/bin
+        try:
+            import numpy as _np
+            rnp = _np.asarray(boxes._data if isinstance(boxes, Tensor)
+                              else boxes)
+            mx = max(float((rnp[:, 2] - rnp[:, 0]).max()) / ow,
+                     float((rnp[:, 3] - rnp[:, 1]).max()) / oh)
+            sampling_ratio = max(1, int(np.ceil(mx * spatial_scale)))
+        except Exception:
+            sampling_ratio = 2
+
+    def f(feat, rois, rois_num):
+        N, C, H, W = feat.shape
+        R = rois.shape[0]
+        # map each roi to its image
+        img_idx = jnp.repeat(jnp.arange(N), rois_num, axis=0,
+                             total_repeat_length=R)
+        off = 0.5 if aligned else 0.0
+        x1 = rois[:, 0] * spatial_scale - off
+        y1 = rois[:, 1] * spatial_scale - off
+        x2 = rois[:, 2] * spatial_scale - off
+        y2 = rois[:, 3] * spatial_scale - off
+        rw = jnp.maximum(x2 - x1, 1e-3 if aligned else 1.0)
+        rh = jnp.maximum(y2 - y1, 1e-3 if aligned else 1.0)
+        bin_w = rw / ow
+        bin_h = rh / oh
+        sr = sampling_ratio
+        # sample grid: [R, oh, ow, sr, sr] bilinear points, averaged per bin
+        iy = jnp.arange(oh)
+        ix = jnp.arange(ow)
+        sy = (jnp.arange(sr) + 0.5) / sr
+        sx = (jnp.arange(sr) + 0.5) / sr
+        yy = (y1[:, None, None] + (iy[None, :, None] + sy[None, None, :])
+              * bin_h[:, None, None])                       # [R, oh, sr]
+        xx = (x1[:, None, None] + (ix[None, :, None] + sx[None, None, :])
+              * bin_w[:, None, None])                       # [R, ow, sr]
+
+        def bilinear(py, px):
+            # py [R, oh, sr] / px [R, ow, sr] -> [R, C, oh, sr, ow, sr]
+            y0 = jnp.floor(py)
+            x0 = jnp.floor(px)
+            wy1 = py - y0
+            wx1 = px - x0
+
+            def gath(yi, xi):
+                yc = jnp.clip(yi.astype(jnp.int32), 0, H - 1)
+                xc = jnp.clip(xi.astype(jnp.int32), 0, W - 1)
+                # feat[img, :, y, x] over broadcasted roi grids
+                return feat[img_idx[:, None, None, None, None], :,
+                            yc[:, :, :, None, None],
+                            xc[:, None, None, :, :]]
+
+            # gather corners: shapes [R, oh, sr, ow, sr, C]
+            g00 = gath(y0, x0)
+            g01 = gath(y0, x0 + 1)
+            g10 = gath(y0 + 1, x0)
+            g11 = gath(y0 + 1, x0 + 1)
+            wy1e = wy1[:, :, :, None, None, None]
+            wx1e = wx1[:, None, None, :, :, None]
+            return (g00 * (1 - wy1e) * (1 - wx1e)
+                    + g01 * (1 - wy1e) * wx1e
+                    + g10 * wy1e * (1 - wx1e)
+                    + g11 * wy1e * wx1e)
+
+        samples = bilinear(yy, xx)              # [R, oh, sr, ow, sr, C]
+        # reference kernel zeroes samples outside [-1, H] x [-1, W]
+        # (replicate-clamp only applies within one pixel of the border)
+        ok_y = (yy >= -1.0) & (yy <= H)
+        ok_x = (xx >= -1.0) & (xx <= W)
+        mask = (ok_y[:, :, :, None, None]
+                & ok_x[:, None, None, :, :])[..., None]
+        samples = jnp.where(mask, samples, 0.0)
+        pooled = samples.mean(axis=(2, 4))      # [R, oh, ow, C]
+        return jnp.transpose(pooled, (0, 3, 1, 2))
+
+    return apply("roi_align", f, [x, boxes, boxes_num])

@@ -305,3 +305,71 @@ def test_kl_divergence_new_families_vs_monte_carlo():
         mc = float((p.log_prob(s).numpy() - q.log_prob(s).numpy()).mean())
         assert abs(kl - mc) < max(0.05, 0.08 * abs(kl)), \
             (type(p).__name__, kl, mc)
+
+
+# ---------------- vision.ops ----------------
+def test_nms_greedy_suppression():
+    from paddle_tpu.vision import ops as vops
+    boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [20, 20, 30, 30],
+                      [21, 21, 29, 29], [50, 50, 60, 60]], np.float32)
+    scores = np.array([0.9, 0.8, 0.7, 0.95, 0.5], np.float32)
+    keep = vops.nms(paddle.to_tensor(boxes), iou_threshold=0.5,
+                    scores=paddle.to_tensor(scores)).numpy()
+    # greedy: 3 (0.95) kills 2; 0 (0.9) kills 1; 4 survives
+    assert set(keep.tolist()) == {3, 0, 4}
+    assert keep[0] == 3  # sorted by score
+    # category-aware: different categories never suppress each other
+    cats = np.array([0, 1, 0, 0, 0], np.int64)
+    keep_c = vops.nms(paddle.to_tensor(boxes), iou_threshold=0.5,
+                      scores=paddle.to_tensor(scores),
+                      category_idxs=paddle.to_tensor(cats)).numpy()
+    assert 1 in keep_c.tolist()  # box1 is its own category now
+
+
+def test_roi_align_matches_numpy_reference():
+    from paddle_tpu.vision import ops as vops
+    rng = np.random.RandomState(0)
+    feat = rng.randn(2, 3, 16, 16).astype("float32")
+    rois = np.array([[2, 2, 10, 10], [4, 4, 12, 12], [0, 0, 8, 8]],
+                    np.float32)
+    bn = np.array([2, 1], np.int32)
+    out = vops.roi_align(paddle.to_tensor(feat), paddle.to_tensor(rois),
+                         paddle.to_tensor(bn), 4, sampling_ratio=2).numpy()
+
+    def bil(img, y, x):
+        H, W = feat.shape[2:]
+        y0, x0 = int(np.floor(y)), int(np.floor(x))
+        wy, wx = y - y0, x - x0
+
+        def px(yy, xx):
+            return feat[img, :, min(max(yy, 0), H - 1),
+                        min(max(xx, 0), W - 1)]
+        return (px(y0, x0) * (1 - wy) * (1 - wx)
+                + px(y0, x0 + 1) * (1 - wy) * wx
+                + px(y0 + 1, x0) * wy * (1 - wx)
+                + px(y0 + 1, x0 + 1) * wy * wx)
+
+    img_idx = [0, 0, 1]
+    for r, (x1, y1, x2, y2) in enumerate(rois):
+        x1, y1, x2, y2 = x1 - 0.5, y1 - 0.5, x2 - 0.5, y2 - 0.5
+        bw, bh = max(x2 - x1, 1e-3) / 4, max(y2 - y1, 1e-3) / 4
+        for i in range(4):
+            for j in range(4):
+                acc = np.zeros(3, np.float32)
+                for a in range(2):
+                    for b in range(2):
+                        acc += bil(img_idx[r], y1 + (i + (a + .5) / 2) * bh,
+                                   x1 + (j + (b + .5) / 2) * bw)
+                np.testing.assert_allclose(out[r, :, i, j], acc / 4,
+                                           rtol=1e-4, atol=1e-4)
+
+
+def test_box_iou_and_area():
+    from paddle_tpu.vision import ops as vops
+    b1 = paddle.to_tensor(np.array([[0, 0, 10, 10]], np.float32))
+    b2 = paddle.to_tensor(np.array([[5, 5, 15, 15], [20, 20, 30, 30]],
+                                   np.float32))
+    iou = vops.box_iou(b1, b2).numpy()
+    np.testing.assert_allclose(iou[0, 0], 25.0 / 175.0, rtol=1e-5)
+    assert iou[0, 1] == 0.0
+    np.testing.assert_allclose(vops.box_area(b1).numpy(), [100.0])

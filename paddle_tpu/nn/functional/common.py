@@ -17,9 +17,10 @@ from ...core.dispatch import apply
 
 __all__ = [
     "linear", "dropout", "dropout2d", "dropout3d", "pad", "embedding",
-    "cosine_similarity", "interpolate", "upsample", "unfold",
+    "cosine_similarity", "interpolate", "upsample", "unfold", "fold",
     "scaled_dot_product_attention", "alpha_dropout", "label_smooth",
     "pixel_shuffle", "pixel_unshuffle", "affine_grid", "grid_sample",
+    "temporal_shift",
 ]
 
 
@@ -180,7 +181,7 @@ def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
 
     def fwd(a):
         n, c, h, w = a.shape
-        pads = [(0, 0), (0, 0), (ph, ph), (pw, pw)]
+        pads = [(ph, ph), (pw, pw)]  # spatial dims only
         patches = jax.lax.conv_general_dilated_patches(
             a, (kh, kw), (sh, sw), pads, rhs_dilation=(dh, dw),
             dimension_numbers=jax.lax.conv_dimension_numbers(
@@ -373,3 +374,75 @@ def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
         return jnp.transpose(out, (0, 3, 1, 2))    # [N, C, Ho, Wo]
 
     return apply("grid_sample", f, [x, grid])
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    """col2im, the inverse of unfold (reference: F.fold over phi
+    fold_kernel). x: [N, C*kh*kw, L] -> [N, C, out_h, out_w]; overlapping
+    patch contributions accumulate (one scatter-add, like istft's WOLA)."""
+    oh_, ow_ = (output_sizes, output_sizes) if isinstance(
+        output_sizes, int) else output_sizes
+    kh, kw = (kernel_sizes, kernel_sizes) if isinstance(kernel_sizes, int) \
+        else kernel_sizes
+    sh, sw = (strides, strides) if isinstance(strides, int) else strides
+    ph, pw = (paddings, paddings) if isinstance(paddings, int) else paddings
+    dh, dw = (dilations, dilations) if isinstance(dilations, int) \
+        else dilations
+
+    def fwd(a):
+        n, ckk, L = a.shape
+        c = ckk // (kh * kw)
+        nh = (oh_ + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+        nw = (ow_ + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+        assert nh * nw == L, (f"L={L} inconsistent with output_sizes "
+                              f"({nh}x{nw} patches expected)")
+        a = a.reshape(n, c, kh, kw, nh, nw)
+        # padded-canvas positions of every (patch, offset) sample
+        py = (jnp.arange(nh)[:, None] * sh
+              + jnp.arange(kh)[None, :] * dh)     # [nh, kh]
+        px = (jnp.arange(nw)[:, None] * sw
+              + jnp.arange(kw)[None, :] * dw)     # [nw, kw]
+        Hp, Wp = oh_ + 2 * ph, ow_ + 2 * pw
+        flat_pos = (py[:, :, None, None] * Wp
+                    + px[None, None, :, :])       # [nh, kh, nw, kw]
+        vals = jnp.transpose(a, (0, 1, 4, 2, 5, 3))  # [n, c, nh, kh, nw, kw]
+        out = jnp.zeros((n, c, Hp * Wp), a.dtype).at[
+            :, :, flat_pos.reshape(-1)].add(
+            vals.reshape(n, c, -1))
+        out = out.reshape(n, c, Hp, Wp)
+        return out[:, :, ph:ph + oh_, pw:pw + ow_]
+
+    return apply("fold", fwd, [x])
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
+                   name=None):
+    """TSM temporal shift (reference: F.temporal_shift over phi
+    temporal_shift_kernel): shift the first shift_ratio channels one step
+    back in time, the next block one step forward; zero-pad the ends."""
+
+    if shift_ratio > 0.5:
+        raise ValueError(
+            f"temporal_shift shift_ratio ({shift_ratio}) must be <= 0.5 "
+            "(back + forward shifted blocks cannot exceed the channels)")
+
+    def fwd(a):
+        if data_format == "NHWC":
+            a = jnp.transpose(a, (0, 3, 1, 2))
+        nt, c, h, w = a.shape
+        n = nt // seg_num
+        a = a.reshape(n, seg_num, c, h, w)
+        c1 = int(c * shift_ratio)
+        c2 = int(c * 2 * shift_ratio)
+        back = jnp.concatenate(
+            [a[:, 1:, :c1], jnp.zeros_like(a[:, :1, :c1])], axis=1)
+        fwd_ = jnp.concatenate(
+            [jnp.zeros_like(a[:, :1, c1:c2]), a[:, :-1, c1:c2]], axis=1)
+        out = jnp.concatenate([back, fwd_, a[:, :, c2:]], axis=2)
+        out = out.reshape(nt, c, h, w)
+        if data_format == "NHWC":
+            out = jnp.transpose(out, (0, 2, 3, 1))
+        return out
+
+    return apply("temporal_shift", fwd, [x])

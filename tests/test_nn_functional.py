@@ -284,3 +284,35 @@ def test_interpolate_nearest():
     assert out.shape == [1, 1, 8, 8]
     np.testing.assert_allclose(out.numpy()[0, 0, ::2, ::2], x[0, 0],
                                rtol=1e-6)
+
+
+def test_fold_inverts_unfold():
+    """col2im (reference: F.fold over phi fold_kernel)."""
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(2, 3, 8, 8).astype("float32"))
+    # non-overlapping: exact inverse
+    back = F.fold(F.unfold(x, 2, strides=2), 8, 2, strides=2)
+    np.testing.assert_allclose(back.numpy(), x.numpy(), rtol=1e-6)
+    # overlapping: divide by fold(unfold(ones)) normalizer
+    ones = paddle.to_tensor(np.ones((2, 3, 8, 8), np.float32))
+    norm = F.fold(F.unfold(ones, 2, strides=1), 8, 2, strides=1)
+    f2 = F.fold(F.unfold(x, 2, strides=1), 8, 2, strides=1)
+    np.testing.assert_allclose((f2 / norm).numpy(), x.numpy(), rtol=1e-5)
+    # padded path: value check via the ones-normalizer
+    norm3 = F.fold(F.unfold(ones, 3, strides=2, paddings=1), 8, 3,
+                   strides=2, paddings=1)
+    f3 = F.fold(F.unfold(x, 3, strides=2, paddings=1), 8, 3, strides=2,
+                paddings=1)
+    np.testing.assert_allclose((f3 / norm3).numpy(), x.numpy(), rtol=1e-5)
+
+
+def test_temporal_shift_semantics():
+    rng = np.random.RandomState(1)
+    xt = paddle.to_tensor(rng.randn(4, 8, 2, 2).astype("float32"))
+    out = F.temporal_shift(xt, seg_num=2, shift_ratio=0.25)
+    a = xt.numpy().reshape(2, 2, 8, 2, 2)
+    exp = np.concatenate([
+        np.concatenate([a[:, 1:, :2], np.zeros_like(a[:, :1, :2])], 1),
+        np.concatenate([np.zeros_like(a[:, :1, 2:4]), a[:, :-1, 2:4]], 1),
+        a[:, :, 4:]], axis=2).reshape(4, 8, 2, 2)
+    np.testing.assert_allclose(out.numpy(), exp)

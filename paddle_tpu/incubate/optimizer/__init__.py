@@ -1,0 +1,145 @@
+"""paddle.incubate.optimizer — LookAhead, ModelAverage.
+
+Reference: python/paddle/incubate/optimizer/{lookahead,modelaverage}.py.
+Pure optimizer-state logic over the framework arrays; each update is one
+fused XLA program per parameter.
+"""
+from __future__ import annotations
+
+import warnings
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["LookAhead", "ModelAverage"]
+
+
+class LookAhead:
+    """k steps forward, 1 step back (reference: lookahead.py LookAhead).
+
+    Wraps an inner optimizer; every k inner steps the slow weights move
+    alpha of the way toward the fast weights and the fast weights reset to
+    the slow ones. Slow weights are captured at construction (reference
+    behavior); call capture_slow() to re-capture later."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        assert 0.0 <= alpha <= 1.0 and k >= 1
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        self._step_num = 0
+        self._slow = {}
+        self.capture_slow()
+
+    @property
+    def _parameter_list(self):
+        return self.inner_optimizer._parameter_list
+
+    def capture_slow(self):
+        """Record current params as the slow weights."""
+        for p in self.inner_optimizer._parameter_list:
+            self._slow[id(p)] = p._data
+
+    def step(self):
+        self.inner_optimizer.step()
+        self._step_num += 1
+        if self._step_num % self.k:
+            return
+        a = np.float32(self.alpha)
+        for p in self.inner_optimizer._parameter_list:
+            slow = self._slow.get(id(p), p._data)
+            new_slow = slow + a * (p._data - slow)
+            self._slow[id(p)] = new_slow
+            p._data = new_slow.astype(p._data.dtype)
+
+    def clear_grad(self, *a, **k):
+        self.inner_optimizer.clear_grad(*a, **k)
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    def state_dict(self):
+        sd = self.inner_optimizer.state_dict()
+        sd["lookahead_step"] = self._step_num
+        # slow weights keyed by parameter position (ids don't survive a
+        # process restart)
+        sd["lookahead_slow"] = [
+            np.asarray(self._slow[id(p)])
+            if id(p) in self._slow else None
+            for p in self.inner_optimizer._parameter_list]
+        return sd
+
+    def set_state_dict(self, sd):
+        sd = dict(sd)  # don't mutate the caller's dict
+        self._step_num = sd.pop("lookahead_step", 0)
+        slows = sd.pop("lookahead_slow", None)
+        if slows is not None:
+            for p, s in zip(self.inner_optimizer._parameter_list, slows):
+                if s is not None:
+                    self._slow[id(p)] = jnp.asarray(s)
+        self.inner_optimizer.set_state_dict(sd)
+
+    def minimize(self, loss, **kw):
+        loss.backward()
+        self.step()
+        return None, None
+
+    def __getattr__(self, name):
+        if name == "inner_optimizer":  # guard: deepcopy/pickle probe attrs
+            raise AttributeError(name)  # before __init__ has run
+        return getattr(self.inner_optimizer, name)
+
+
+class ModelAverage:
+    """Running average of parameters applied at eval time (reference:
+    modelaverage.py). The averaging window grows with training:
+    window = clip(average_window_rate * num_updates,
+                  min_average_window, max_average_window); accumulation
+    restarts when the window is exceeded so early weights age out."""
+
+    def __init__(self, average_window_rate, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        self.rate = float(average_window_rate)
+        self.min_window = int(min_average_window)
+        self.max_window = int(max_average_window)
+        self._params = list(parameters or [])
+        self._sum = {id(p): jnp.zeros_like(p._data) for p in self._params}
+        self._count = 0
+        self._num_updates = 0
+        self._backup = None
+
+    def _window(self):
+        return int(min(max(self.rate * max(self._num_updates, 1),
+                           self.min_window), self.max_window))
+
+    def step(self):
+        """Accumulate after each optimizer step."""
+        self._num_updates += 1
+        self._count += 1
+        for p in self._params:
+            self._sum[id(p)] = self._sum[id(p)] + p._data
+        if self._count > self._window():
+            # restart the window: recent weights only (reference restart)
+            for p in self._params:
+                self._sum[id(p)] = p._data
+            self._count = 1
+
+    def apply(self, executor=None, need_restore=True):
+        """Swap in averaged params (reference apply()); with
+        need_restore=False the averaged weights become permanent."""
+        if self._count == 0:
+            warnings.warn("ModelAverage.apply() before any step(): "
+                          "parameters left unchanged")
+            return
+        self._backup = {id(p): p._data for p in self._params} \
+            if need_restore else None
+        c = np.float32(self._count)
+        for p in self._params:
+            p._data = (self._sum[id(p)] / c).astype(p._data.dtype)
+
+    def restore(self, executor=None):
+        if self._backup:
+            for p in self._params:
+                p._data = self._backup[id(p)]
+            self._backup = None

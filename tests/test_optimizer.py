@@ -217,3 +217,67 @@ def test_lenet_converges():
     logits = model(paddle.to_tensor(images))
     acc = (logits.numpy().argmax(-1) == labels).mean()
     assert acc > 0.97, f"LeNet failed to fit synthetic digits: acc={acc}"
+
+
+def test_lookahead_converges_and_syncs_slow_weights():
+    """Reference: incubate/optimizer/lookahead.py (k fast steps, then slow
+    weights move alpha toward fast)."""
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+    paddle.seed(0)
+    m = nn.Linear(8, 4)
+    inner = paddle.optimizer.SGD(learning_rate=0.1,
+                                 parameters=m.parameters())
+    la = paddle.incubate.LookAhead(inner, alpha=0.5, k=2)
+    rng = np.random.RandomState(0)
+    X = rng.randn(16, 8).astype("float32")
+    Y = rng.randn(16, 4).astype("float32")
+    losses = []
+    for _ in range(6):
+        loss = F.mse_loss(m(paddle.to_tensor(X)), paddle.to_tensor(Y))
+        loss.backward()
+        la.step()
+        la.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0]
+    assert la._step_num == 6 and la._slow  # slow weights synced
+    # checkpoint roundtrip keeps slow weights and step count
+    sd = la.state_dict()
+    m2 = nn.Linear(8, 4)
+    inner2 = paddle.optimizer.SGD(learning_rate=0.1,
+                                  parameters=m2.parameters())
+    la2 = paddle.incubate.LookAhead(inner2, alpha=0.5, k=2)
+    la2.set_state_dict(sd)
+    assert la2._step_num == 6
+    p2 = inner2._parameter_list[0]
+    np.testing.assert_allclose(
+        np.asarray(la2._slow[id(p2)]),
+        np.asarray(la._slow[id(inner._parameter_list[0])]))
+    assert "lookahead_step" in sd  # caller's dict not mutated
+
+
+def test_model_average_apply_restore():
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+    paddle.seed(1)
+    m = nn.Linear(4, 2)
+    opt = paddle.optimizer.SGD(learning_rate=0.2,
+                               parameters=m.parameters())
+    ma = paddle.incubate.ModelAverage(0.15, parameters=m.parameters())
+    rng = np.random.RandomState(1)
+    X = rng.randn(8, 4).astype("float32")
+    Y = rng.randn(8, 2).astype("float32")
+    snapshots = []
+    for _ in range(3):
+        loss = F.mse_loss(m(paddle.to_tensor(X)), paddle.to_tensor(Y))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        ma.step()
+        snapshots.append(np.asarray(m.weight._data).copy())
+    live = np.asarray(m.weight._data).copy()
+    ma.apply()
+    np.testing.assert_allclose(np.asarray(m.weight._data),
+                               np.mean(snapshots, axis=0), rtol=1e-5)
+    ma.restore()
+    np.testing.assert_allclose(np.asarray(m.weight._data), live)

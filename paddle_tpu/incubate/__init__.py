@@ -11,13 +11,14 @@ import jax.numpy as jnp
 from ..core.dispatch import apply
 from .moe import MoELayer, TopKGate  # noqa: F401
 from .ring_attention import ring_attention  # noqa: F401
+from . import asp  # noqa: F401
 from . import optimizer  # noqa: F401
 from .optimizer import LookAhead, ModelAverage  # noqa: F401
 
 __all__ = ["MoELayer", "TopKGate", "ring_attention", "fused_rms_norm",
            "fused_layer_norm", "fused_rotary_position_embedding",
            "flash_attention", "paged_attention", "LookAhead",
-           "ModelAverage", "optimizer"]
+           "ModelAverage", "optimizer", "asp"]
 
 
 def paged_attention(q, k_cache, v_cache, block_tables, context_lens,

@@ -1,0 +1,89 @@
+"""paddle.incubate.asp — automatic structured (n:m) sparsity.
+
+Reference: python/paddle/incubate/asp/ (prune_model computes n:m masks,
+ASPHelper re-applies masks after each optimizer step via decorate()).
+TPU note: n:m sparsity is a GPU sparse-tensor-core feature; on TPU the
+masks still deliver the regularization/compression semantics (weights stay
+masked through training), with dense MXU math underneath.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import nn
+
+__all__ = ["prune_model", "decorate", "reset_excluded_layers",
+           "set_excluded_layers", "calculate_density"]
+
+_masks: dict = {}           # id(param) -> mask array
+_excluded: set = set()      # layer full names excluded from pruning
+
+
+def set_excluded_layers(param_names, main_program=None):
+    _excluded.update(param_names)
+
+
+def reset_excluded_layers(main_program=None):
+    _excluded.clear()
+
+
+def calculate_density(x):
+    arr = np.asarray(x.numpy() if hasattr(x, "numpy") else x)
+    return float((arr != 0).sum() / arr.size)
+
+
+def _nm_mask(w, n=2, m=4):
+    """Keep the n largest-magnitude entries in every group of m along the
+    last axis (reference: asp/utils.py compute_valid_2d_patterns path,
+    collapsed to the magnitude rule)."""
+    shape = w.shape
+    flat = w.reshape(-1, m) if shape[-1] % m == 0 else None
+    if flat is None:
+        return jnp.ones_like(w)  # indivisible tail: leave dense
+    idx = jnp.argsort(-jnp.abs(flat), axis=-1)[:, :n]
+    mask = jnp.zeros_like(flat, bool)
+    rows = jnp.arange(flat.shape[0])[:, None]
+    mask = mask.at[rows, idx].set(True)
+    return mask.reshape(shape)
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """Compute and apply n:m masks to every prunable weight (2-D+ weights
+    of Linear/Conv layers; reference supported_layers)."""
+    pruned = 0
+    for name, layer in model.named_sublayers():
+        if name in _excluded or type(layer).__name__ in _excluded:
+            continue
+        w = getattr(layer, "weight", None)
+        if w is None or w._data.ndim < 2:
+            continue
+        mask = _nm_mask(w._data, n, m)
+        _masks[id(w)] = mask
+        w._data = jnp.where(mask, w._data, 0.0)
+        pruned += 1
+    return pruned
+
+
+class ASPOptimizerWrapper:
+    """Reference: asp/asp.py OptimizerWithSparsityGuarantee — after every
+    step, re-apply the masks so pruned weights stay zero."""
+
+    def __init__(self, optimizer):
+        self._inner = optimizer
+
+    def step(self):
+        self._inner.step()
+        for p in self._inner._parameter_list:
+            mask = _masks.get(id(p))
+            if mask is not None:
+                p._data = jnp.where(mask, p._data, 0.0)
+
+    def __getattr__(self, name):
+        if name == "_inner":
+            raise AttributeError(name)
+        return getattr(self._inner, name)
+
+
+def decorate(optimizer):
+    return ASPOptimizerWrapper(optimizer)

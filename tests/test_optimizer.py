@@ -281,3 +281,34 @@ def test_model_average_apply_restore():
                                np.mean(snapshots, axis=0), rtol=1e-5)
     ma.restore()
     np.testing.assert_allclose(np.asarray(m.weight._data), live)
+
+
+def test_asp_2_4_pruning_and_mask_guarantee():
+    """Reference: incubate/asp (prune_model + decorate keep n:m sparsity
+    through training)."""
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.incubate import asp
+    paddle.seed(0)
+    m = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+    n_pruned = asp.prune_model(m, n=2, m=4)
+    assert n_pruned == 2
+    w = np.asarray(m[0].weight._data)
+    # every group of 4 along the last axis has exactly 2 nonzeros
+    groups = w.reshape(-1, 4)
+    nz = (groups != 0).sum(axis=1)
+    assert (nz <= 2).all() and asp.calculate_density(m[0].weight) <= 0.5
+    opt = asp.decorate(paddle.optimizer.SGD(learning_rate=0.1,
+                                            parameters=m.parameters()))
+    rng = np.random.RandomState(0)
+    X = rng.randn(8, 16).astype("float32")
+    Y = rng.randn(8, 4).astype("float32")
+    for _ in range(3):
+        loss = F.mse_loss(m(paddle.to_tensor(X)), paddle.to_tensor(Y))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    w2 = np.asarray(m[0].weight._data)
+    assert ((w2.reshape(-1, 4) != 0).sum(axis=1) <= 2).all(), \
+        "mask not maintained through steps"
+    assert not np.allclose(w2, w)  # but unmasked weights trained

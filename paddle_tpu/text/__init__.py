@@ -13,10 +13,10 @@ from ..core.tensor import Tensor
 from .. import nn
 
 from . import datasets  # noqa: E402,F401
-from .datasets import Conll05st, Imdb, UCIHousing  # noqa: E402,F401
+from .datasets import Conll05st, Imdb, Movielens, UCIHousing  # noqa: E402,F401
 
 __all__ = ["viterbi_decode", "ViterbiDecoder", "datasets", "Imdb",
-           "UCIHousing", "Conll05st"]
+           "UCIHousing", "Conll05st", "Movielens"]
 
 
 def viterbi_decode(potentials, transition_params, lengths=None,

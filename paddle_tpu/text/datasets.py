@@ -15,7 +15,7 @@ import numpy as np
 
 from ..io import Dataset
 
-__all__ = ["Imdb", "UCIHousing", "Conll05st"]
+__all__ = ["Imdb", "UCIHousing", "Conll05st", "Movielens"]
 
 
 def _no_download(name, url):
@@ -123,3 +123,64 @@ class Conll05st(Dataset):
 
     def __len__(self):
         return 0
+
+
+class Movielens(Dataset):
+    """MovieLens-1M ratings (reference: text/datasets/movielens.py).
+    data_file: the ml-1m.zip archive (users.dat / movies.dat /
+    ratings.dat '::'-separated). Yields (user_feats, movie_feats, rating):
+    user = [id, gender, age, job], movie = [id, title-ids, category-ids].
+    """
+
+    URL = "https://files.grouplens.org/datasets/movielens/ml-1m.zip"
+
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1,
+                 rand_seed=0, download=False):
+        if data_file is None:
+            _no_download("Movielens", self.URL)
+        import zipfile
+        users, movies, ratings = {}, {}, []
+        with zipfile.ZipFile(data_file) as zf:
+            root = next(n for n in zf.namelist()
+                        if n.endswith("users.dat")).rsplit("/", 1)[0]
+
+            def lines(name):
+                with zf.open(f"{root}/{name}") as f:
+                    for ln in f.read().decode("latin-1").splitlines():
+                        if ln.strip():
+                            yield ln.split("::")
+
+            genders = {"M": 0, "F": 1}
+            ages = {}
+            jobs = {}
+            for uid, g, age, job, _zip in lines("users.dat"):
+                ages.setdefault(age, len(ages))
+                jobs.setdefault(job, len(jobs))
+                users[int(uid)] = np.array(
+                    [int(uid), genders[g], ages[age], jobs[job]], np.int64)
+            cats, words = {}, {}
+            for mid, title, cat in lines("movies.dat"):
+                cat_ids = [cats.setdefault(c, len(cats))
+                           for c in cat.split("|")]
+                title_ids = [words.setdefault(w.lower(), len(words))
+                             for w in title.split()]
+                movies[int(mid)] = (np.array([int(mid)], np.int64),
+                                    np.array(title_ids, np.int64),
+                                    np.array(cat_ids, np.int64))
+            for uid, mid, r, _ts in lines("ratings.dat"):
+                ratings.append((int(uid), int(mid), float(r)))
+        rng = np.random.RandomState(rand_seed)
+        order = rng.permutation(len(ratings))
+        n_test = int(len(ratings) * test_ratio)
+        sel = order[n_test:] if mode == "train" else order[:n_test]
+        self._users, self._movies = users, movies
+        self._samples = [ratings[i] for i in sel]
+
+    def __getitem__(self, idx):
+        uid, mid, r = self._samples[idx]
+        mid_arr, title, cat = self._movies[mid]
+        return (self._users[uid], mid_arr, title, cat,
+                np.array([r], np.float32))
+
+    def __len__(self):
+        return len(self._samples)

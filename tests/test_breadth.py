@@ -373,3 +373,23 @@ def test_box_iou_and_area():
     np.testing.assert_allclose(iou[0, 0], 25.0 / 175.0, rtol=1e-5)
     assert iou[0, 1] == 0.0
     np.testing.assert_allclose(vops.box_area(b1).numpy(), [100.0])
+
+
+def test_text_movielens_local_zip(tmp_path):
+    import zipfile
+    zp = str(tmp_path / "ml-1m.zip")
+    with zipfile.ZipFile(zp, "w") as zf:
+        zf.writestr("ml-1m/users.dat",
+                    "1::M::25::4::12345\n2::F::35::7::54321\n")
+        zf.writestr("ml-1m/movies.dat",
+                    "10::Toy Story (1995)::Animation|Comedy\n"
+                    "20::Heat (1995)::Action\n")
+        zf.writestr("ml-1m/ratings.dat",
+                    "1::10::5::100\n1::20::3::101\n"
+                    "2::10::4::102\n2::20::2::103\n")
+    ds = paddle.text.Movielens(data_file=zp, mode="train", test_ratio=0.25)
+    ds_t = paddle.text.Movielens(data_file=zp, mode="test",
+                                 test_ratio=0.25)
+    assert len(ds) == 3 and len(ds_t) == 1
+    u, mid, title, cat, r = ds[0]
+    assert u.shape == (4,) and mid.shape == (1,) and r.shape == (1,)

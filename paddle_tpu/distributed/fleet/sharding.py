@@ -58,11 +58,12 @@ class DygraphShardingOptimizer:
     'sharding' axis."""
 
     def __init__(self, optimizer: Optimizer, hcg=None, group=None,
-                 shard_params=False):
+                 shard_params=False, offload=False):
         self._inner = optimizer
         mesh, axis = _sharding_mesh(group)
         self._mesh, self._axis = mesh, axis
         self._shard_params = shard_params
+        self._offload = offload
 
         # ZeRO dataflow, made explicit so GSPMD emits the right collectives
         # (VERDICT r2 weak #9: without constraints the update degraded to
@@ -114,9 +115,45 @@ class DygraphShardingOptimizer:
         optimizer._dist_out_hook = out_hook
         orig_get = optimizer._get_accumulator
 
+        class _HostDict(dict):
+            """Host-memory state store for offload: every write lands as
+            numpy (trips loudly on tracers — offloaded state cannot be
+            staged with to_static(capture=...))."""
+
+            def __setitem__(self, k, v):
+                import jax.core as _jc
+                if isinstance(v, _jc.Tracer):
+                    raise RuntimeError(
+                        "offload=True keeps optimizer state in host memory "
+                        "and cannot be staged with to_static(capture=...); "
+                        "run the step eagerly")
+                if not isinstance(v, np.ndarray):
+                    v = np.asarray(v)
+                super().__setitem__(k, v)
+
+        if offload:
+            # accumulators AND master weights write through _HostDict, so
+            # Optimizer.step()'s direct assignments also land on host
+            for name, per in list(optimizer._accumulators.items()):
+                optimizer._accumulators[name] = _HostDict(per)
+            optimizer._accumulators.default_factory = _HostDict
+            optimizer._master_weights = _HostDict(
+                optimizer._master_weights)
+
         def sharded_get(name, p, init=None):
             created = id(p) not in optimizer._accumulators[name]
             arr = orig_get(name, p, init)
+            if offload:
+                # reference group_sharded offload: state lives in HOST
+                # memory; the per-step upload goes straight to the sharded
+                # layout (each device receives its 1/N slice)
+                if created or not isinstance(arr, np.ndarray):
+                    optimizer._accumulators[name][id(p)] = arr
+                    arr = optimizer._accumulators[name][id(p)]
+                if np.ndim(arr) > 0:
+                    return jax.device_put(arr, NamedSharding(
+                        mesh, _merged(p, arr.shape, True)))
+                return jnp.asarray(arr)
             if created and arr.ndim > 0:
                 # merge the ZeRO axis with the param's TP dims (see hooks)
                 arr = jax.device_put(arr, NamedSharding(
@@ -130,6 +167,16 @@ class DygraphShardingOptimizer:
         def sharded_master(p):
             created = id(p) not in optimizer._master_weights
             arr = orig_master(p)
+            if offload:
+                # fp32 masters are the DOMINANT optimizer-state cost —
+                # they must live on host too, uploaded sharded on use
+                if created or not isinstance(arr, np.ndarray):
+                    optimizer._master_weights[id(p)] = arr
+                    arr = optimizer._master_weights[id(p)]
+                if np.ndim(arr) > 0:
+                    return jax.device_put(arr, NamedSharding(
+                        mesh, _merged(p, arr.shape, True)))
+                return jnp.asarray(arr)
             if created and arr.ndim > 0:
                 arr = jax.device_put(arr, NamedSharding(
                     mesh, _merged(p, arr.shape, True)))
@@ -159,7 +206,8 @@ def group_sharded_parallel(model, optimizer, level, scaler=None, group=None,
     assert level in ("os", "os_g", "p_g_os"), f"bad sharding level {level}"
     mesh, axis = _sharding_mesh(group)
     optimizer = DygraphShardingOptimizer(optimizer, group=group,
-                                         shard_params=(level == "p_g_os"))
+                                         shard_params=(level == "p_g_os"),
+                                         offload=offload)
     if level == "p_g_os":
         for p in model.parameters():
             if p._data.ndim > 0:

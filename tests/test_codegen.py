@@ -53,3 +53,56 @@ def test_lu_roundtrip():
     P, L, U = paddle.linalg.lu_unpack(lu, piv)
     np.testing.assert_allclose(P.numpy() @ L.numpy() @ U.numpy(), a,
                                rtol=1e-4, atol=1e-4)
+
+
+UNARY_BF16_SWEEP = [
+    # (op, input builder) — bf16 in, compare vs f64 numpy golden at bf16 tol
+    ("tanh", lambda r: r.randn(4, 8)),
+    ("sigmoid", lambda r: r.randn(4, 8)),
+    ("exp", lambda r: r.randn(4, 8)),
+    ("log", lambda r: r.rand(4, 8) + 0.2),
+    ("sqrt", lambda r: r.rand(4, 8) + 0.1),
+    ("rsqrt", lambda r: r.rand(4, 8) + 0.2),
+    ("sin", lambda r: r.randn(4, 8)),
+    ("cos", lambda r: r.randn(4, 8)),
+    ("abs", lambda r: r.randn(4, 8)),
+    ("floor", lambda r: r.randn(4, 8) * 3),
+    ("ceil", lambda r: r.randn(4, 8) * 3),
+    ("sign", lambda r: r.randn(4, 8)),
+    ("square", lambda r: r.randn(4, 8)),
+    ("reciprocal", lambda r: r.rand(4, 8) + 0.5),
+    ("erf", lambda r: r.randn(4, 8)),
+    ("log1p", lambda r: r.rand(4, 8)),
+    ("expm1", lambda r: r.randn(4, 8)),
+    ("atan", lambda r: r.randn(4, 8)),
+    ("sinh", lambda r: r.randn(4, 8)),
+    ("cosh", lambda r: r.randn(4, 8)),
+]
+
+
+def test_generated_unary_ops_bf16_sweep():
+    """bf16 is the TPU compute dtype: every migrated elementwise op must
+    run in bf16 and stay within bf16 rounding of the f64 golden
+    (reference precedent: OpTest dtype sweeps, op_test.py check_output
+    over registered dtypes)."""
+    from scipy.special import erf as _erf
+    rng = np.random.RandomState(0)
+    golden = {"rsqrt": lambda x: 1.0 / np.sqrt(x),
+              "square": lambda x: x * x,
+              "reciprocal": lambda x: 1.0 / x,
+              "sigmoid": lambda x: 1 / (1 + np.exp(-x)),
+              "erf": _erf}
+    for name, build in UNARY_BF16_SWEEP:
+        x64 = build(rng).astype(np.float64)
+        t = paddle.to_tensor(x64.astype("float32")).astype("bfloat16")
+        out = getattr(paddle, name)(t)
+        assert str(out.dtype).endswith("bfloat16"), (name, out.dtype)
+        fn = golden.get(name, getattr(np, name, None))
+        assert fn is not None, name
+        # compare against the bf16-quantized input's golden at bf16 tol
+        got = np.asarray(out._data, np.float64)
+        xq = np.asarray(t._data, np.float64)
+        ref_q = fn(xq)
+        err = np.abs(got - ref_q)
+        tol = 0.04 * np.maximum(np.abs(ref_q), 1.0)
+        assert (err <= tol).all(), (name, float(err.max()))

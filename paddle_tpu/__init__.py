@@ -44,6 +44,9 @@ from . import nn  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import regularizer  # noqa: F401
 from . import signal  # noqa: F401
+from . import utils  # noqa: F401
+from . import version  # noqa: F401
+from .version import full_version as __version__  # noqa: F401
 from . import distributed  # noqa: F401
 from . import distribution  # noqa: F401
 from . import hapi  # noqa: F401

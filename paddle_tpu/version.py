@@ -1,0 +1,13 @@
+"""Version info (reference: python/paddle/version.py, generated at build).
+The rebuild tracks reference capability snapshot 2.6-dev."""
+full_version = "2.6.0+tpu"
+major = "2"
+minor = "6"
+patch = "0"
+rc = "0"
+commit = "tpu-native-rebuild"
+
+
+def show():
+    print(f"full_version: {full_version}")
+    print(f"commit: {commit}")

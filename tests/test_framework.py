@@ -118,3 +118,25 @@ def test_layer_params_are_float32():
     m = nn.Linear(3, 4)
     assert str(m.weight.dtype) == "float32"
     assert str(m.bias.dtype) == "float32"
+
+
+def test_utils_run_check_and_version():
+    """Reference: paddle.utils.run_check() install sanity entry."""
+    assert paddle.utils.run_check(verbose=False)
+    assert paddle.__version__.startswith("2.6")
+    name_a = paddle.utils.unique_name.generate("fc")
+    name_b = paddle.utils.unique_name.generate("fc")
+    assert name_a != name_b
+
+
+def test_utils_deprecated_warns():
+    import warnings
+
+    @paddle.utils.deprecated(update_to="paddle.new", since="2.6")
+    def old():
+        return 1
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert old() == 1
+        assert any("deprecated" in str(x.message) for x in w)

@@ -55,6 +55,7 @@ from . import models  # noqa: F401
 from . import profiler  # noqa: F401
 from .hapi import Model  # noqa: F401
 from .hapi.summary import summary  # noqa: F401
+from .hapi.dynamic_flops import flops  # noqa: F401
 from .framework.io import load, save  # noqa: F401
 from .framework.param_attr import ParamAttr  # noqa: F401
 

@@ -7,3 +7,4 @@ jit.to_static capture, so the Keras-style loop runs at staged-XLA speed.
 from .model import Model  # noqa: F401
 from . import callbacks  # noqa: F401
 from .summary import summary  # noqa: F401
+from .dynamic_flops import flops  # noqa: F401

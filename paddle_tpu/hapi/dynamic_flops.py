@@ -1,0 +1,100 @@
+"""paddle.flops — per-layer FLOPs estimation.
+
+Reference: python/paddle/hapi/dynamic_flops.py (flops(net, input_size)
+walks sublayers with hooks and a per-type FLOPs table)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["flops"]
+
+
+def _linear_flops(layer, x, y):
+    return int(np.prod(x.shape)) * layer.weight.shape[-1]
+
+
+def _conv_flops(layer, x, y):
+    kernel_ops = int(np.prod(layer.weight.shape[1:]))  # Cin/g * k...
+    bias_ops = 1 if getattr(layer, "bias", None) is not None else 0
+    return int(np.prod(y.shape)) * (kernel_ops + bias_ops)
+
+
+def _norm_flops(layer, x, y):
+    return 2 * int(np.prod(x.shape))
+
+
+def _act_flops(layer, x, y):
+    return int(np.prod(x.shape))
+
+
+def _pool_flops(layer, x, y):
+    return int(np.prod(y.shape))
+
+
+def _emb_flops(layer, x, y):
+    return int(np.prod(y.shape))
+
+
+_TABLE = {
+    "Linear": _linear_flops,
+    "Conv1D": _conv_flops, "Conv2D": _conv_flops, "Conv3D": _conv_flops,
+    "BatchNorm1D": _norm_flops, "BatchNorm2D": _norm_flops,
+    "BatchNorm3D": _norm_flops, "LayerNorm": _norm_flops,
+    "GroupNorm": _norm_flops, "RMSNorm": _norm_flops,
+    "ReLU": _act_flops, "ReLU6": _act_flops, "GELU": _act_flops,
+    "Sigmoid": _act_flops, "Tanh": _act_flops, "Softmax": _act_flops,
+    "MaxPool2D": _pool_flops, "AvgPool2D": _pool_flops,
+    "AdaptiveAvgPool2D": _pool_flops, "MaxPool1D": _pool_flops,
+    "MaxPool3D": _pool_flops,
+    "Embedding": _emb_flops,
+}
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """Total multiply-accumulate count for one forward pass at input_size
+    (INCLUDING the batch dim; -1 means 1). Returns an int."""
+    import jax.numpy as jnp
+
+    table = dict(_TABLE)
+    if custom_ops:
+        table.update({getattr(k, "__name__", str(k)): v
+                      for k, v in custom_ops.items()})
+    shape = [1 if d == -1 else int(d) for d in input_size]
+    x = Tensor(jnp.zeros(shape, jnp.float32))
+
+    rows = []
+    hooks = []
+
+    def mk(name, layer, fn):
+        def hook(lyr, ins, out):
+            o = out[0] if isinstance(out, (tuple, list)) else out
+            n = int(fn(lyr, ins[0], o))
+            params = sum(int(np.prod(p.shape)) for p in
+                         lyr.parameters(include_sublayers=False))
+            rows.append((f"{type(lyr).__name__}-{name}", params, n))
+        return hook
+
+    for name, layer in net.named_sublayers():
+        fn = table.get(type(layer).__name__)
+        if fn is not None and not list(layer.children()):
+            hooks.append(layer.register_forward_post_hook(
+                mk(name, layer, fn)))
+    was_training = net.training
+    net.eval()
+    try:
+        net(x)
+    finally:
+        if was_training:
+            net.train()
+        for h in hooks:
+            h.remove()
+
+    total = sum(r[2] for r in rows)
+    if print_detail:
+        print(f"{'Layer':<30}{'Params':>12}{'FLOPs':>16}")
+        for name, params, n in rows:
+            print(f"{name:<30}{params:>12,}{n:>16,}")
+        print(f"Total GFLOPs: {total / 1e9:.4f}")
+    return total

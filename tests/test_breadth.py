@@ -393,3 +393,14 @@ def test_text_movielens_local_zip(tmp_path):
     assert len(ds) == 3 and len(ds_t) == 1
     u, mid, title, cat, r = ds[0]
     assert u.shape == (4,) and mid.shape == (1,) and r.shape == (1,)
+
+
+def test_flops_counter():
+    """Reference: paddle.flops (hapi/dynamic_flops.py)."""
+    import paddle_tpu.nn as nn
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+    n = paddle.flops(net, (1, 16))
+    assert n == 16 * 32 + 32 + 32 * 4
+    from paddle_tpu.vision.models import LeNet
+    assert paddle.flops(LeNet(), (1, 1, 28, 28)) > 100000

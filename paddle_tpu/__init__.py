@@ -77,6 +77,51 @@ def in_dynamic_mode():
     return True
 
 
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """Reference: paddle.set_printoptions — forwards to numpy's print
+    options (Tensor repr renders through numpy)."""
+    import numpy as _np
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    _np.set_printoptions(**kw)
+
+
+def disable_signal_handler():
+    """Reference parity no-op: the jax runtime installs no paddle-style
+    signal handlers to disable."""
+    return None
+
+
+def is_compiled_with_cuda():
+    return False  # TPU-native build
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+def is_compiled_with_cinn():
+    return False  # XLA plays CINN's role
+
+
+def is_compiled_with_rocm():
+    return False
+
+
+def is_compiled_with_custom_device(device_type="tpu"):
+    return device_type in ("tpu", "axon")  # PjRt TPU is the device
+
+
 def is_grad_enabled_():
     return is_grad_enabled()
 

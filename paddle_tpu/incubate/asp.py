@@ -16,7 +16,9 @@ from .. import nn
 __all__ = ["prune_model", "decorate", "reset_excluded_layers",
            "set_excluded_layers", "calculate_density"]
 
-_masks: dict = {}           # id(param) -> mask array
+# keyed by id(param): masks hold no ref to the param, so if a pruned
+# param is GC'd a recycled id could alias — keep a ref alongside the mask
+_masks: dict = {}           # id(param) -> (param, mask array)
 _excluded: set = set()      # layer full names excluded from pruning
 
 
@@ -40,6 +42,11 @@ def _nm_mask(w, n=2, m=4):
     shape = w.shape
     flat = w.reshape(-1, m) if shape[-1] % m == 0 else None
     if flat is None:
+        import warnings
+        warnings.warn(
+            f"asp: weight last dim {shape[-1]} not divisible by m={m}; "
+            "layer left dense (not pruned) — calculate_density will "
+            "report 1.0 for it")
         return jnp.ones_like(w)  # indivisible tail: leave dense
     idx = jnp.argsort(-jnp.abs(flat), axis=-1)[:, :n]
     mask = jnp.zeros_like(flat, bool)
@@ -59,7 +66,7 @@ def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
         if w is None or w._data.ndim < 2:
             continue
         mask = _nm_mask(w._data, n, m)
-        _masks[id(w)] = mask
+        _masks[id(w)] = (w, mask)
         w._data = jnp.where(mask, w._data, 0.0)
         pruned += 1
     return pruned
@@ -75,9 +82,9 @@ class ASPOptimizerWrapper:
     def step(self):
         self._inner.step()
         for p in self._inner._parameter_list:
-            mask = _masks.get(id(p))
-            if mask is not None:
-                p._data = jnp.where(mask, p._data, 0.0)
+            ent = _masks.get(id(p))
+            if ent is not None and ent[0] is p:
+                p._data = jnp.where(ent[1], p._data, 0.0)
 
     def __getattr__(self, name):
         if name == "_inner":

@@ -37,6 +37,9 @@ def paged_attention_reference(q, k_cache, v_cache, block_tables,
     B, H, D = q.shape
     page_size = k_cache.shape[1]
     scale = np.float32(scale if scale is not None else 1.0 / np.sqrt(D))
+    # clamp sentinel-padded ids: OOB take fills NaN, and 0-weight * NaN
+    # would poison the output; clamped pages are masked by context_lens
+    block_tables = jnp.clip(block_tables, 0, k_cache.shape[0] - 1)
     # gather each sequence's pages: [B, max_pages, page_size, H, D]
     k = jnp.take(k_cache, block_tables, axis=0)
     v = jnp.take(v_cache, block_tables, axis=0)
@@ -48,8 +51,10 @@ def paged_attention_reference(q, k_cache, v_cache, block_tables,
     valid = jnp.arange(S)[None, :] < context_lens[:, None]
     s = jnp.where(valid[:, None, :], s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhs,bshd->bhd", p,
-                      v.astype(jnp.float32)).astype(q.dtype)
+    o = jnp.einsum("bhs,bshd->bhd", p, v.astype(jnp.float32))
+    # a fully-masked row softmaxes to uniform: zero it (context_len == 0)
+    o = jnp.where((context_lens > 0)[:, None, None], o, 0.0)
+    return o.astype(q.dtype)
 
 
 def _kernel(blk_ref, len_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr,
@@ -74,11 +79,16 @@ def _kernel(blk_ref, len_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr,
         preferred_element_type=jnp.float32)[:, 0, :] * scale  # [H, page]
     pos = i * page_size + jax.lax.broadcasted_iota(
         jnp.int32, s.shape, 1)
-    s = jnp.where(pos < len_ref[b], s, NEG_INF)
+    in_ctx = pos < len_ref[b]
+    s = jnp.where(in_ctx, s, NEG_INF)
     m_prev = m_scr[:]                              # [H, LANES]
     m_new = jnp.maximum(m_prev, jax.lax.broadcast_in_dim(
         s.max(axis=1), m_prev.shape, (0,)))
-    p = jnp.exp(s - m_new[:, :1])                  # [H, page]
+    # mask explicitly: when every position is masked m_new == NEG_INF and
+    # exp(s - m_new) == 1, which would average garbage V pages (a padded
+    # block table points anywhere) instead of contributing nothing
+    p = jnp.where(in_ctx, jnp.exp(s - m_new[:, :1]),
+                  np.float32(0.0))                 # [H, page]
     corr = jnp.exp(m_prev - m_new)
     l_scr[:] = corr * l_scr[:] + jax.lax.broadcast_in_dim(
         p.sum(axis=1), m_prev.shape, (0,))
@@ -101,9 +111,15 @@ def paged_attention(q, k_cache, v_cache, block_tables, context_lens,
     the scalar-prefetched page table, so the DMA streams each sequence's
     physical pages directly."""
     B, H, D = q.shape
-    page_size = k_cache.shape[1]
+    num_pages, page_size = k_cache.shape[0], k_cache.shape[1]
     max_pages = block_tables.shape[1]
     scale = np.float32(scale if scale is not None else 1.0 / np.sqrt(D))
+
+    def _page(b, i, blk, ln):
+        # clamp: tables padded past context_lens (sentinel -1 or any id)
+        # must not drive an out-of-bounds block DMA; the kernel's in_ctx
+        # mask already zeroes such pages' contribution
+        return (jnp.clip(blk[b, i], 0, num_pages - 1), 0, 0, 0)
 
     kernel = functools.partial(_kernel, scale=scale, page_size=page_size)
     grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -111,10 +127,8 @@ def paged_attention(q, k_cache, v_cache, block_tables, context_lens,
         grid=(B, max_pages),
         in_specs=[
             pl.BlockSpec((1, H, D), lambda b, i, blk, ln: (b, 0, 0)),
-            pl.BlockSpec((1, page_size, H, D),
-                         lambda b, i, blk, ln: (blk[b, i], 0, 0, 0)),
-            pl.BlockSpec((1, page_size, H, D),
-                         lambda b, i, blk, ln: (blk[b, i], 0, 0, 0)),
+            pl.BlockSpec((1, page_size, H, D), _page),
+            pl.BlockSpec((1, page_size, H, D), _page),
         ],
         out_specs=pl.BlockSpec((1, H, D), lambda b, i, blk, ln: (b, 0, 0)),
         scratch_shapes=[

@@ -98,7 +98,14 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
               sampling_ratio=-1, aligned=True, name=None):
     """Reference: vision/ops.py roi_align (phi roi_align_kernel).
     x [N, C, H, W]; boxes [R, 4] in input coords; boxes_num [N] rois per
-    image. Returns [R, C, out_h, out_w]."""
+    image. Returns [R, C, out_h, out_w].
+
+    Deviation from reference when sampling_ratio <= 0: the reference picks
+    a per-ROI adaptive sample count ceil(roi/output); XLA needs one static
+    count, so this uses the max over all (concrete) ROIs — small ROIs are
+    sampled denser than the reference, so their bin averages can differ by
+    O(1e-2) when ROI sizes vary. Pass an explicit sampling_ratio for exact
+    reference parity."""
     if isinstance(output_size, int):
         output_size = (output_size, output_size)
     oh, ow = output_size

@@ -203,3 +203,50 @@ def test_paged_attention_grads_flow():
     out.sum().backward()
     assert q._grad is not None and np.isfinite(np.asarray(q._grad)).all()
     assert kc._grad is not None
+
+
+def test_paged_attention_padded_table_and_zero_context():
+    """Advisor r3: sentinel-padded block tables (-1 / out-of-range ids)
+    must not read out-of-bounds pages, and context_len == 0 must yield
+    zeros (not an average of garbage V pages) on both paths."""
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas.paged_attention import (
+        paged_attention, paged_attention_reference,
+    )
+    rng = np.random.RandomState(3)
+    B, H, D, PS, NP, MP = 3, 4, 32, 8, 6, 4
+    q = jnp.asarray(rng.randn(B, H, D), jnp.float32)
+    kc = jnp.asarray(rng.randn(NP, PS, H, D), jnp.float32)
+    vc = jnp.asarray(rng.randn(NP, PS, H, D), jnp.float32)
+    # rows: valid ids then sentinel padding (-1 and >= num_pages)
+    bt = jnp.asarray([[0, 1, -1, -1],
+                      [2, 3, 99, 99],
+                      [4, -1, -1, -1]], jnp.int32)
+    cl = jnp.asarray([12, 16, 0], jnp.int32)
+    ref = paged_attention_reference(q, kc, vc, bt, cl)
+    out = paged_attention(q, kc, vc, bt, cl, interpret=True)
+    assert np.isfinite(np.asarray(out)).all()
+    np.testing.assert_allclose(np.asarray(out)[2], 0.0)  # empty sequence
+    np.testing.assert_allclose(np.asarray(ref)[2], 0.0)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+    # parity with an in-range-padded table (padding must not matter)
+    bt_safe = jnp.asarray([[0, 1, 0, 0],
+                           [2, 3, 0, 0],
+                           [4, 0, 0, 0]], jnp.int32)
+    out_safe = paged_attention(q, kc, vc, bt_safe, cl, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(out_safe),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_asp_indivisible_dim_warns():
+    """Advisor r3: non-divisible last dim silently skipped pruning."""
+    import warnings
+    import paddle_tpu.nn as nn
+    from paddle_tpu.incubate import asp
+
+    model = nn.Sequential(nn.Linear(6, 5))  # 5 % 4 != 0
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        asp.prune_model(model, n=2, m=4)
+    assert any("not divisible" in str(x.message) for x in w)

@@ -103,20 +103,25 @@ def _as_group(group):
 def _placed(arr, group):
     """Commit the array onto the group mesh, leading axis sharded.
 
-    Single-controller only: this device_puts a host-global array, which is
-    impossible when ranks are separate processes (each process holds only
-    its addressable shard). Fail loudly rather than corrupt data —
-    multi-process eager collectives go through jit-compiled paths instead
+    Multi-process (jax.distributed): a GLOBAL array — one whose sharding
+    already spans processes — reshards through a compiled device_put (XLA
+    collectives over ICI/DCN), so eager collectives compose with the
+    multi-controller SPMD path. Host-local data cannot be placed onto
+    devices other processes own: fail loudly rather than corrupt data
     (reference boundary: process_group_nccl.cc assumes per-rank tensors)."""
+    spec = P(group.axis, *([None] * (arr.ndim - 1)))
+    target = NamedSharding(group.mesh, spec)
     if jax.process_count() > 1:
+        if isinstance(arr, jax.Array) and not arr.is_fully_addressable:
+            return jax.device_put(arr, target)  # compiled global reshard
         raise NotImplementedError(
-            "eager paddle.distributed collectives are single-controller "
-            "only (they place host-global arrays); under multi-process "
-            "jax.distributed, run collectives inside compiled code — "
+            "eager paddle.distributed collectives on host-local data are "
+            "single-controller only; under multi-process jax.distributed "
+            "pass globally-sharded arrays (e.g. from shard_batch / a "
+            "compiled step), or run collectives inside compiled code — "
             "jit/shard_map with lax.psum/all_gather, or a to_static train "
             "step, as tests/workers/dp_worker.py does")
-    spec = P(group.axis, *([None] * (arr.ndim - 1)))
-    return jax.device_put(arr, NamedSharding(group.mesh, spec))
+    return jax.device_put(arr, target)
 
 
 def _rankdim_op(group, per_shard_fn, arr, out_rank_sharded=True):
@@ -284,7 +289,12 @@ def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
 
 
 def barrier(group=None):
-    """Device-level barrier: a tiny psum forces a sync point."""
+    """Device-level barrier: a tiny psum forces a sync point. The constant
+    payload is identical on every process, so it places globally under
+    multi-controller SPMD too."""
+    from .placement import place_global
     g = _as_group(group)
-    arr = _placed(jnp.ones((g.nranks, 1), jnp.float32), g)
+    spec = P(g.axis, *([None]))
+    arr = place_global(np.ones((g.nranks, 1), np.float32),
+                       NamedSharding(g.mesh, spec))
     _rankdim_op(g, lambda x: jax.lax.psum(x, g.axis), arr).block_until_ready()

@@ -35,7 +35,8 @@ def _mp_mesh(mp_group):
 
 
 def _place(t, mesh, spec):
-    t._data = jax.device_put(t._data, NamedSharding(mesh, spec))
+    from ..placement import place_global
+    t._data = place_global(t._data, NamedSharding(mesh, spec))
     return t
 
 

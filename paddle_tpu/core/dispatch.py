@@ -57,17 +57,24 @@ def _amp_cast(name, inputs):
 
 
 _op_profiler = None  # set by paddle_tpu.profiler to record per-op timing
+_cf_recorder = None  # set by jit.control_flow during branch discovery
 
 
 def apply(name: str, fwd: Callable, inputs: Sequence[Any], nout: int = 1,
           has_aux: bool = False):
     hook = _op_profiler
     if hook is None:
-        return _apply_impl(name, fwd, inputs, nout, has_aux)
+        result = _apply_impl(name, fwd, inputs, nout, has_aux)
+        if _cf_recorder is not None:
+            _cf_recorder.note(inputs, result)
+        return result
     import time
     t0 = time.perf_counter()
     try:
-        return _apply_impl(name, fwd, inputs, nout, has_aux)
+        result = _apply_impl(name, fwd, inputs, nout, has_aux)
+        if _cf_recorder is not None:
+            _cf_recorder.note(inputs, result)
+        return result
     finally:
         hook(name, t0, time.perf_counter(), inputs)
 

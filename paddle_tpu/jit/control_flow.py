@@ -1,0 +1,308 @@
+"""Tensor-dependent control flow: paddle.static.nn.cond / while_loop.
+
+Reference: python/paddle/static/nn/control_flow.py (cond:1153,
+while_loop:1384 build ConditionalBlock/While ops into the Program;
+jit/dy2static/convert_operators.py routes python if/while here when the
+predicate is a Variable). TPU-native collapse: under to_static tracing a
+Tensor holds a jax tracer, so tensor-dependent branching lowers directly
+onto XLA's native control flow — ``lax.cond`` / ``lax.while_loop`` —
+inside the same traced program; with a concrete predicate both are plain
+python (eager semantics, taped as usual).
+
+Autograd through ``cond``: the branch closures are discovered by running
+each branch once under a read-recorder (dispatch hook) to find every
+*external differentiable* Tensor they touch; those become explicit inputs
+of one taped ``apply`` whose array function is ``lax.cond``, so the tape's
+``jax.vjp`` differentiates the selected branch exactly like the
+reference's conditional_block_grad. Non-differentiable captures stay
+closure-captured (jax threads closed-over tracers automatically).
+
+``while_loop`` is forward-only under tracing (XLA's while has no
+reverse-mode); training loops with a static trip count should use
+``paddle.static.nn.scan_loop`` (bounded, differentiable, lax.scan).
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import autograd
+from ..core import dispatch as _dispatch
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+
+__all__ = ["cond", "while_loop", "scan_loop", "case", "switch_case"]
+
+
+class _ReadRecorder:
+    """Collects external differentiable Tensors read by a branch: an input
+    whose id was never produced by an op inside the recorded region."""
+
+    def __init__(self):
+        self.created: set = set()
+        self.reads: dict = {}
+
+    def note(self, inputs, results):
+        for t in inputs:
+            if isinstance(t, Tensor) and id(t) not in self.created \
+                    and _dispatch._is_diff(t):
+                self.reads.setdefault(id(t), t)
+        res = results if isinstance(results, (tuple, list)) else (results,)
+        for r in res:
+            if isinstance(r, Tensor):
+                self.created.add(id(r))
+
+
+@contextlib.contextmanager
+def _recording(rec):
+    prev = _dispatch._cf_recorder
+    _dispatch._cf_recorder = rec
+    try:
+        yield
+    finally:
+        _dispatch._cf_recorder = prev
+
+
+def _flatten(out):
+    """Flatten a branch output pytree into (tensors, skeleton)."""
+    from .api import _tree_flatten
+    tensors: list = []
+    skel = _tree_flatten(out, tensors, [])
+    return tensors, skel
+
+
+def _rebuild(skel, arrays, wrap=None):
+    from .api import _tree_rebuild
+    return _tree_rebuild(skel, list(arrays),
+                         wrap or (lambda a: Tensor(a)))
+
+
+def _skel_sig(skel, tensors):
+    return (repr(skel), tuple((tuple(t.shape), str(t.dtype))
+                              for t in tensors))
+
+
+def _is_traced(*vals):
+    for v in vals:
+        arr = v._data if isinstance(v, Tensor) else v
+        if isinstance(arr, jax.core.Tracer):
+            return True
+    return False
+
+
+def _scalar_pred(arr):
+    return jnp.reshape(arr.astype(jnp.bool_), ())
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None, return_names=None):
+    """Reference: paddle.static.nn.cond (static/nn/control_flow.py:1153).
+
+    Branches take no arguments and may close over any in-scope Tensor;
+    both must return the same structure (shapes/dtypes must match, as in
+    the reference and as XLA requires).
+    """
+    pred_arr = pred._data if isinstance(pred, Tensor) else jnp.asarray(pred)
+    if not _is_traced(pred):
+        taken = bool(np.asarray(pred_arr))
+        if taken:
+            return true_fn() if true_fn is not None else None
+        return false_fn() if false_fn is not None else None
+
+    if true_fn is None or false_fn is None:
+        raise ValueError(
+            "cond over a traced predicate requires both true_fn and "
+            "false_fn (XLA compiles both branches)")
+
+    # discovery pass: find external diff reads + output structure. Branch
+    # functions must be effect-free (mutating state other than their
+    # return value is undefined, matching lax.cond semantics).
+    rec = _ReadRecorder()
+    with _recording(rec), autograd.no_grad():
+        out_t = true_fn()
+        out_f = false_fn()
+    t_tensors, t_skel = _flatten(out_t)
+    f_tensors, f_skel = _flatten(out_f)
+    if _skel_sig(t_skel, t_tensors) != _skel_sig(f_skel, f_tensors):
+        raise ValueError(
+            "cond branches must return the same structure/shapes/dtypes: "
+            f"true_fn -> {_skel_sig(t_skel, t_tensors)}, "
+            f"false_fn -> {_skel_sig(f_skel, f_tensors)}")
+    reads = list(rec.reads.values())
+    n_out = len(t_tensors)
+
+    def fwd(pred_a, *read_arrs):
+        def make(branch_fn):
+            def run(read_vals):
+                saved = [(t, t._data) for t in reads]
+                try:
+                    for t, a in zip(reads, read_vals):
+                        t._data = a
+                    with autograd.no_grad():
+                        out = branch_fn()
+                    tensors, _ = _flatten(out)
+                    return tuple(x._data for x in tensors)
+                finally:
+                    for t, a in saved:
+                        t._data = a
+            return run
+
+        res = jax.lax.cond(_scalar_pred(pred_a), make(true_fn),
+                           make(false_fn), tuple(read_arrs))
+        return res if n_out != 1 else res[0]
+
+    ins = [Tensor(pred_arr, stop_gradient=True)] + reads
+    out = apply("cond", fwd, ins, nout=n_out)
+    out_tensors = list(out) if isinstance(out, tuple) else [out]
+    # rebuild with the apply-returned Tensors so tape linkage survives
+    return _rebuild(t_skel, out_tensors, wrap=lambda t: t)
+
+
+def _loop_state(loop_vars):
+    as_seq = isinstance(loop_vars, (list, tuple))
+    vars_list = list(loop_vars) if as_seq else [loop_vars]
+    tensors, skel = _flatten(vars_list)
+    return vars_list, tensors, skel, as_seq
+
+
+def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
+    """Reference: paddle.static.nn.while_loop (control_flow.py:1384).
+
+    cond_fn(*loop_vars) -> boolean Tensor; body_fn(*loop_vars) -> updated
+    loop_vars (same structure/shapes). Under tracing this lowers to
+    lax.while_loop — forward-only (use scan_loop for differentiable
+    bounded loops); eager it is a plain python loop (fully taped).
+    """
+    vars_list, tensors, skel, as_seq = _loop_state(loop_vars)
+
+    pred0 = cond_fn(*vars_list)
+    if not _is_traced(pred0, *tensors):
+        while bool(np.asarray(pred0._data if isinstance(pred0, Tensor)
+                              else pred0)):
+            out = body_fn(*vars_list)
+            vars_list, tensors, new_skel, _ = _loop_state(
+                out if isinstance(out, (list, tuple)) else [out])
+            pred0 = cond_fn(*vars_list)
+        return vars_list if as_seq else vars_list[0]
+
+    if autograd.is_grad_enabled() and any(_dispatch._is_diff(t)
+                                          for t in tensors):
+        raise RuntimeError(
+            "while_loop over traced tensors is forward-only (XLA's while "
+            "has no reverse-mode autodiff). Wrap in paddle.no_grad(), mark "
+            "loop vars stop_gradient, or use paddle.static.nn.scan_loop "
+            "(bounded, differentiable).")
+
+    def run(flat):
+        def c(flat_vals):
+            vs = _rebuild(skel, flat_vals)
+            with autograd.no_grad():
+                p = cond_fn(*vs)
+            return _scalar_pred(p._data if isinstance(p, Tensor) else p)
+
+        def b(flat_vals):
+            vs = _rebuild(skel, flat_vals)
+            with autograd.no_grad():
+                out = body_fn(*vs)
+            out_list = list(out) if isinstance(out, (list, tuple)) else [out]
+            new_tensors, new_skel = _flatten(out_list)
+            if _skel_sig(new_skel, new_tensors) != _skel_sig(skel, tensors):
+                raise ValueError(
+                    "while_loop body must return the same structure/shapes "
+                    f"as loop_vars: {_skel_sig(skel, tensors)} vs "
+                    f"{_skel_sig(new_skel, new_tensors)}")
+            return tuple(t._data for t in new_tensors)
+
+        return jax.lax.while_loop(c, b, tuple(flat))
+
+    res = run([t._data for t in tensors])
+    out_vars = _rebuild(skel, res)
+    for t in out_vars:
+        t.stop_gradient = True
+    return out_vars if as_seq else out_vars[0]
+
+
+def scan_loop(body_fn, loop_vars, n_steps, name=None):
+    """Bounded differentiable loop (no reference analog; the TPU answer to
+    backward-through-while): runs body_fn exactly n_steps times via
+    lax.scan through one taped apply, so gradients flow (reference
+    while_grad capability for static-trip-count loops).
+
+    body_fn(step, *loop_vars) -> updated loop_vars.
+    """
+    vars_list, tensors, skel, as_seq = _loop_state(loop_vars)
+    if not isinstance(n_steps, int):
+        raise TypeError("scan_loop needs a static python int n_steps")
+
+    rec = _ReadRecorder()
+    with _recording(rec), autograd.no_grad():
+        probe = body_fn(Tensor(jnp.asarray(0, jnp.int32)), *vars_list)
+    probe_list = list(probe) if isinstance(probe, (list, tuple)) else [probe]
+    p_tensors, p_skel = _flatten(probe_list)
+    if _skel_sig(p_skel, p_tensors) != _skel_sig(skel, tensors):
+        raise ValueError(
+            "scan_loop body must return the same structure/shapes as "
+            f"loop_vars: {_skel_sig(skel, tensors)} vs "
+            f"{_skel_sig(p_skel, p_tensors)}")
+    reads = [t for t in rec.reads.values()
+             if not any(t is v for v in tensors)]
+    n_state = len(tensors)
+
+    def fwd(*arrs):
+        state0 = tuple(arrs[:n_state])
+        read_arrs = arrs[n_state:]
+
+        def step(carry, i):
+            saved = [(t, t._data) for t in reads]
+            try:
+                for t, a in zip(reads, read_arrs):
+                    t._data = a
+                vs = _rebuild(skel, carry)
+                with autograd.no_grad():
+                    out = body_fn(Tensor(i), *vs)
+                out_list = list(out) if isinstance(out, (list, tuple)) \
+                    else [out]
+                new_tensors, _ = _flatten(out_list)
+                return tuple(t._data for t in new_tensors), None
+            finally:
+                for t, a in saved:
+                    t._data = a
+
+        final, _ = jax.lax.scan(step, state0,
+                                jnp.arange(n_steps, dtype=jnp.int32))
+        return final if n_state != 1 else final[0]
+
+    out = apply("scan_loop", fwd, tensors + reads, nout=n_state)
+    out_tensors = list(out) if isinstance(out, tuple) else [out]
+    out_vars = _rebuild(skel, out_tensors, wrap=lambda t: t)
+    return out_vars if as_seq else out_vars[0]
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """Reference: paddle.static.nn.case — first true predicate wins."""
+    if not pred_fn_pairs:
+        raise ValueError("case needs at least one (pred, fn) pair")
+    pred, fn = pred_fn_pairs[0]
+    rest = pred_fn_pairs[1:]
+    if not rest:
+        if default is None:
+            return cond(pred, fn, fn)
+        return cond(pred, fn, default)
+    return cond(pred, fn, lambda: case(rest, default))
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """Reference: paddle.static.nn.switch_case."""
+    if isinstance(branch_fns, dict):
+        pairs = sorted(branch_fns.items())
+    else:
+        pairs = list(enumerate(branch_fns))
+    idx = branch_index if isinstance(branch_index, Tensor) else \
+        Tensor(jnp.asarray(branch_index))
+    pred_fn_pairs = [(idx.equal(Tensor(jnp.asarray(i, idx._data.dtype))), fn)
+                     for i, fn in pairs]
+    if default is None:
+        default = pairs[-1][1]
+    return case(pred_fn_pairs, default)

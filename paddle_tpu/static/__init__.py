@@ -11,8 +11,10 @@ from __future__ import annotations
 from ..jit.api import InputSpec  # noqa: F401
 from ..jit.save_load import load as _jit_load
 from ..jit.save_load import save as _jit_save
+from . import nn  # noqa: F401
 
-__all__ = ["InputSpec", "save_inference_model", "load_inference_model"]
+__all__ = ["InputSpec", "nn", "save_inference_model",
+           "load_inference_model"]
 
 
 def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,

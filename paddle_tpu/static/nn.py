@@ -1,0 +1,13 @@
+"""paddle.static.nn — control flow + static-graph layer helpers.
+
+Reference: python/paddle/static/nn/__init__.py (control_flow.py,
+common.py). The control-flow ops lower onto lax.cond/lax.while_loop (see
+jit/control_flow.py); fc/embedding/batch_norm map onto the dygraph layers.
+"""
+from __future__ import annotations
+
+from ..jit.control_flow import (  # noqa: F401
+    case, cond, scan_loop, switch_case, while_loop,
+)
+
+__all__ = ["cond", "while_loop", "case", "switch_case", "scan_loop"]

@@ -166,7 +166,8 @@ def _nan_check(name, tensors):
 
 def _passthrough_errors():
     from .enforce import InvalidArgumentError
-    return (InvalidArgumentError, FloatingPointError, KeyboardInterrupt)
+    return (InvalidArgumentError, FloatingPointError, KeyboardInterrupt,
+            NotImplementedError)
 
 
 def _enrich_error(name, arrs, e):

@@ -57,9 +57,11 @@ def _tree_flatten(obj, tensors, rebuild_path):
         return (type(obj).__name__,
                 tuple(_tree_flatten(o, tensors, rebuild_path) for o in obj))
     if isinstance(obj, dict):
-        return ("dict", tuple(sorted(
-            (k, _tree_flatten(v, tensors, rebuild_path))
-            for k, v in obj.items())))
+        # flatten in sorted-key order so tensor indices are insertion-order
+        # independent (two dicts with equal keys flatten identically)
+        return ("dict", tuple(
+            (k, _tree_flatten(obj[k], tensors, rebuild_path))
+            for k in sorted(obj)))
     return ("C", obj)  # static constant (part of cache key)
 
 
@@ -103,13 +105,29 @@ def _static_key(skel, tensors, extra):
     return (hashable(skel), shapes, extra)
 
 
+def _convert_fn(fn):
+    """Dy2static AST pass: tensor-dependent python if/while/for(range)
+    lower onto lax control flow (reference: program_translator.py:773);
+    bound methods are converted on __func__ and re-bound."""
+    import inspect
+    import types
+
+    from .dy2static import convert_to_static
+    if inspect.ismethod(fn):
+        conv = convert_to_static(fn.__func__)
+        if conv is not fn.__func__:
+            return types.MethodType(conv, fn.__self__)
+        return fn
+    return convert_to_static(fn)
+
+
 class StaticFunction:
     """Callable wrapper produced by to_static (reference:
     jit/dy2static/program_translator.py ASTStaticFunction analog)."""
 
     def __init__(self, function, input_spec=None, capture=None,
                  build_strategy=None, backend=None, full_graph=True,
-                 donate_state=True):
+                 donate_state=True, convert_control_flow=True):
         from ..nn import Layer
         self._raw_fn = function
         self._input_spec = input_spec
@@ -125,6 +143,8 @@ class StaticFunction:
             owner = getattr(function, "__self__", None)
             if isinstance(owner, Layer):
                 self._layer = owner
+        if convert_control_flow:
+            self._fn = _convert_fn(self._fn)
 
     # -- state discovery --
     def _state(self):

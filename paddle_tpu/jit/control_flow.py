@@ -132,6 +132,11 @@ def cond(pred, true_fn=None, false_fn=None, name=None, return_names=None):
             f"false_fn -> {_skel_sig(f_skel, f_tensors)}")
     reads = list(rec.reads.values())
     n_out = len(t_tensors)
+    if n_out == 0:
+        raise ValueError(
+            "cond over a traced predicate requires the branches to return "
+            "at least one Tensor (side-effect-only branches cannot lower "
+            "to lax.cond)")
 
     def fwd(pred_a, *read_arrs):
         def make(branch_fn):
@@ -218,9 +223,8 @@ def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
         return jax.lax.while_loop(c, b, tuple(flat))
 
     res = run([t._data for t in tensors])
-    out_vars = _rebuild(skel, res)
-    for t in out_vars:
-        t.stop_gradient = True
+    out_vars = _rebuild(skel, res,
+                        wrap=lambda a: Tensor(a, stop_gradient=True))
     return out_vars if as_seq else out_vars[0]
 
 

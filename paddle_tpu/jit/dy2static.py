@@ -1,0 +1,456 @@
+"""Dy2static AST conversion: python if/while/for over Tensors → cond/while.
+
+Reference: python/paddle/jit/dy2static/program_translator.py:773
+(ASTStaticFunction → DygraphToStaticAst), ifelse_transformer.py (branch
+functions over the assigned-name union), loop_transformer.py (loop-var
+analysis → convert_while_loop), convert_operators.py (runtime dispatch on
+Variable-ness of the predicate).
+
+TPU-native: the rewrite targets runtime converters that check whether the
+predicate holds a jax tracer — python control flow stays python when
+concrete (zero overhead, exact reference semantics), and lowers onto
+lax.cond / lax.while_loop (jit/control_flow.py) when tensor-dependent
+under tracing. The reference's SOT bytecode path (jit/sot/translate.py:31)
+is collapsed by the same mechanism: tracing IS the fallback-free fast
+path, so only control flow needs conversion.
+
+Supported shapes (reference basic dygraph_to_static coverage):
+  - ``if <tensor-expr>:`` / ``elif`` / ``else`` — assigned-name union
+    becomes the branch outputs; names must be bound on every path that
+    reaches a later read (checked at runtime by the converter).
+  - ``while <tensor-expr>:`` — loop vars = names assigned in the body and
+    live afterwards (read in the condition or body before assignment).
+  - ``for i in range(<tensor>)`` — rewritten to the while form.
+  - ``break``/``continue``/``return`` inside converted blocks are NOT
+    supported (reference break_continue_transformer.py) — a clear error
+    asks for manual restructuring.
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+
+import jax
+import numpy as np
+
+from ..core.tensor import Tensor
+from .control_flow import cond as _cond
+from .control_flow import while_loop as _while_loop
+
+__all__ = ["convert_to_static", "convert_ifelse", "convert_while",
+           "unsupported_in_converted_block"]
+
+_MISSING = object()  # name unbound on a branch/loop path
+
+
+def _is_traced_bool(x):
+    return isinstance(x, Tensor) and isinstance(x._data, jax.core.Tracer)
+
+
+def convert_ifelse(pred, true_fn, false_fn):
+    """Runtime dispatch for a rewritten ``if`` (reference:
+    convert_operators.py convert_ifelse)."""
+    if _is_traced_bool(pred):
+        return _cond(pred, true_fn, false_fn)
+    taken = bool(np.asarray(pred._data)) if isinstance(pred, Tensor) \
+        else bool(pred)
+    return true_fn() if taken else false_fn()
+
+
+def convert_while(cond_fn, body_fn, loop_vars):
+    """Runtime dispatch for a rewritten ``while`` (reference:
+    convert_operators.py convert_while_loop)."""
+    probe = cond_fn(*loop_vars)
+    if _is_traced_bool(probe) or any(
+            isinstance(v, Tensor) and isinstance(v._data, jax.core.Tracer)
+            for v in loop_vars):
+        # loop-carried python numerics must become tensors: a traced
+        # while's carry cannot change python values across iterations
+        import jax.numpy as jnp
+        promoted = []
+        for v in loop_vars:
+            if isinstance(v, bool) or v is _MISSING:
+                promoted.append(v)
+            elif isinstance(v, int):
+                promoted.append(Tensor(jnp.asarray(v, jnp.int32)))
+            elif isinstance(v, float):
+                promoted.append(Tensor(jnp.asarray(v, jnp.float32)))
+            else:
+                promoted.append(v)
+        return _while_loop(cond_fn, body_fn, promoted)
+    vars_now = list(loop_vars)
+    while bool(np.asarray(probe._data)) if isinstance(probe, Tensor) \
+            else bool(probe):
+        vars_now = list(body_fn(*vars_now))
+        probe = cond_fn(*vars_now)
+    return vars_now
+
+
+def unsupported_in_converted_block(kind):
+    raise NotImplementedError(
+        f"'{kind}' inside a tensor-dependent if/while is not supported by "
+        "the dy2static converter (reference break_continue_transformer "
+        "capability); restructure with boolean state or paddle.static.nn "
+        "control-flow ops")
+
+
+def assert_concrete_pred(pred, kind):
+    """Guard on an UNconverted block (it contains return/break/continue):
+    fine as plain python while the predicate is concrete; a traced
+    predicate would silently trace one branch, so fail loudly instead."""
+    if _is_traced_bool(pred):
+        unsupported_in_converted_block(kind)
+    return pred
+
+
+class _AssignedNames(ast.NodeVisitor):
+    """Names bound by a statement list (reference: ifelse_transformer's
+    get_name_ids)."""
+
+    def __init__(self):
+        self.names: set = set()
+
+    def visit_Name(self, node):
+        if isinstance(node.ctx, (ast.Store, ast.Del)):
+            self.names.add(node.id)
+
+    def visit_AugAssign(self, node):
+        if isinstance(node.target, ast.Name):
+            self.names.add(node.target.id)
+        self.generic_visit(node)
+
+    # nested defs bind their own scope
+    def visit_FunctionDef(self, node):
+        self.names.add(node.name)
+
+    def visit_Lambda(self, node):
+        pass
+
+
+class _ReadNames(ast.NodeVisitor):
+    def __init__(self):
+        self.names: set = set()
+
+    def visit_Name(self, node):
+        if isinstance(node.ctx, ast.Load):
+            self.names.add(node.id)
+
+
+def _assigned(stmts):
+    v = _AssignedNames()
+    for s in stmts:
+        v.visit(s)
+    return v.names
+
+
+def _read(nodes):
+    v = _ReadNames()
+    for n in nodes if isinstance(nodes, list) else [nodes]:
+        v.visit(n)
+    return v.names
+
+
+def _contains_flow_escape(stmts):
+    for s in stmts:
+        for node in ast.walk(s):
+            if isinstance(node, (ast.Return, ast.Break, ast.Continue)):
+                return type(node).__name__.lower()
+            if isinstance(node, (ast.FunctionDef, ast.Lambda)):
+                break  # nested scopes own their control flow
+    return None
+
+
+def _name(id_, ctx):
+    return ast.Name(id=id_, ctx=ctx)
+
+
+def _maybe_tensor_pred(test):
+    """Heuristic from the reference's IfElseTransformer: rewrite every if
+    whose predicate isn't a literal/bool-op-of-literals; the runtime
+    converter keeps python semantics for concrete predicates."""
+    return not isinstance(test, (ast.Constant,))
+
+
+class _ControlFlowTransformer(ast.NodeTransformer):
+    """Rewrites If/While/For(range) into convert_ifelse/convert_while
+    calls. Only top-level function control flow is rewritten (nested defs
+    and comprehensions keep python semantics)."""
+
+    def __init__(self):
+        self.counter = 0
+
+    def _fresh(self, base):
+        self.counter += 1
+        return f"__pd_{base}_{self.counter}"
+
+    def visit_FunctionDef(self, node):
+        new_body = []
+        for s in node.body:
+            r = self.visit(s)
+            if isinstance(r, list):
+                new_body.extend(r)
+            elif r is not None:
+                new_body.append(r)
+        node.body = new_body
+        return node
+
+    def _guard(self, node, esc):
+        """Leave the block as plain python but wrap its test so a traced
+        predicate fails loudly instead of tracing one branch."""
+        node.test = ast.Call(
+            func=_name("__pd_assert_concrete", ast.Load()),
+            args=[node.test, ast.Constant(value=esc)], keywords=[])
+        return node
+
+    def _branch_fn(self, fn_name, body, out_names):
+        ret = ast.Return(value=ast.Tuple(
+            elts=[_name(n, ast.Load()) for n in out_names],
+            ctx=ast.Load()))
+        return ast.FunctionDef(
+            name=fn_name, args=ast.arguments(
+                posonlyargs=[], args=[], kwonlyargs=[], kw_defaults=[],
+                defaults=[]),
+            body=list(body) + [ret], decorator_list=[],
+            type_params=[])
+
+    def visit_If(self, node):
+        node = self.generic_visit(node)
+        if not _maybe_tensor_pred(node.test):
+            return node
+        esc = _contains_flow_escape(node.body) or \
+            _contains_flow_escape(node.orelse)
+        if esc:
+            return self._guard(node, esc)
+        node.test = _LogicalTransformer().visit(node.test)
+        out_names = sorted(
+            n for n in (_assigned(node.body) | _assigned(node.orelse))
+            if n != "_" and not n.startswith("__pd_"))
+        true_name = self._fresh("true")
+        false_name = self._fresh("false")
+        stmts = [
+            self._branch_fn(true_name, node.body, out_names),
+            self._branch_fn(false_name, node.orelse or [ast.Pass()],
+                            out_names),
+        ]
+        call = ast.Call(
+            func=_name("__pd_convert_ifelse", ast.Load()),
+            args=[node.test, _name(true_name, ast.Load()),
+                  _name(false_name, ast.Load()),
+                  ast.Tuple(elts=[ast.Constant(value=n)
+                                  for n in out_names], ctx=ast.Load())],
+            keywords=[])
+        if out_names:
+            target = ast.Tuple(
+                elts=[_name(n, ast.Store()) for n in out_names],
+                ctx=ast.Store())
+            stmts.append(ast.Assign(targets=[target], value=call))
+        else:
+            stmts.append(ast.Expr(value=call))
+        return stmts
+
+    def _rewrite_loop(self, node, cond_expr, pre_stmts, body):
+        loop_names = sorted(n for n in _assigned(body)
+                            if n != "_" and not n.startswith("__pd_"))
+        # names read by the condition ride along too (loop-invariant
+        # tensors needed inside the traced cond_fn)
+        cond_reads = sorted(n for n in _read(cond_expr) - set(loop_names)
+                            if not n.startswith("__pd_"))
+        all_names = loop_names + cond_reads
+        args = ast.arguments(
+            posonlyargs=[],
+            args=[ast.arg(arg=n) for n in all_names],
+            kwonlyargs=[], kw_defaults=[], defaults=[])
+        ret = ast.Return(value=ast.Tuple(
+            elts=[_name(n, ast.Load()) for n in all_names], ctx=ast.Load()))
+        cond_name = self._fresh("loopcond")
+        body_name = self._fresh("loopbody")
+        cond_fn = ast.FunctionDef(
+            name=cond_name, args=args,
+            body=[ast.Return(value=cond_expr)], decorator_list=[],
+            type_params=[])
+        body_fn = ast.FunctionDef(
+            name=body_name, args=args, body=list(body) + [ret],
+            decorator_list=[], type_params=[])
+        call = ast.Call(
+            func=_name("__pd_convert_while", ast.Load()),
+            args=[_name(cond_name, ast.Load()),
+                  _name(body_name, ast.Load()),
+                  ast.List(elts=[_name(n, ast.Load()) for n in all_names],
+                           ctx=ast.Load())],
+            keywords=[])
+        target = ast.List(
+            elts=[_name(n, ast.Store()) for n in all_names],
+            ctx=ast.Store())
+        return pre_stmts + [cond_fn, body_fn,
+                            ast.Assign(targets=[target], value=call)]
+
+    def visit_While(self, node):
+        node = self.generic_visit(node)
+        if node.orelse:
+            return node  # while/else stays python
+        if not _maybe_tensor_pred(node.test):
+            return node
+        esc = _contains_flow_escape(node.body)
+        if esc:
+            return self._guard(node, esc)
+        return self._rewrite_loop(node, _LogicalTransformer().visit(
+            node.test), [], node.body)
+
+    def visit_For(self, node):
+        node = self.generic_visit(node)
+        # only `for <name> in range(<expr>)` is rewritten
+        if (node.orelse or not isinstance(node.target, ast.Name)
+                or not isinstance(node.iter, ast.Call)
+                or not isinstance(node.iter.func, ast.Name)
+                or node.iter.func.id != "range"
+                or len(node.iter.args) not in (1, 2)
+                or _contains_flow_escape(node.body)):
+            return node
+        ivar = node.target.id
+        if len(node.iter.args) == 1:
+            start = ast.Constant(value=0)
+            stop = node.iter.args[0]
+        else:
+            start, stop = node.iter.args
+        stop_name = self._fresh("stop")
+        pre = [
+            ast.Assign(targets=[_name(ivar, ast.Store())],
+                       value=ast.Call(
+                           func=_name("__pd_loop_index", ast.Load()),
+                           args=[start], keywords=[])),
+            ast.Assign(targets=[_name(stop_name, ast.Store())], value=stop),
+        ]
+        test = ast.Compare(left=_name(ivar, ast.Load()), ops=[ast.Lt()],
+                           comparators=[_name(stop_name, ast.Load())])
+        inc = ast.Assign(
+            targets=[_name(ivar, ast.Store())],
+            value=ast.BinOp(left=_name(ivar, ast.Load()), op=ast.Add(),
+                            right=ast.Call(
+                                func=_name("__pd_loop_index", ast.Load()),
+                                args=[ast.Constant(value=1)], keywords=[])))
+        return self._rewrite_loop(node, test, pre, list(node.body) + [inc])
+
+
+def _convert_ifelse_rt(pred, true_fn, false_fn, names):
+    outs = convert_ifelse(pred, true_fn, false_fn)
+    return outs
+
+
+def _loop_index(v):
+    """Loop counters: keep python ints python (zero-overhead concrete
+    loops); Tensors pass through for traced bounds."""
+    return v
+
+
+def convert_logical_and(l_fn, r_fn):
+    """Reference: convert_operators.py convert_logical_and — lazy operands
+    preserve python short-circuit for concrete values; tensors combine via
+    logical_and."""
+    lhs = l_fn()
+    if isinstance(lhs, Tensor):
+        return lhs.logical_and(_as_bool_tensor(r_fn()))
+    if not lhs:
+        return lhs
+    rhs = r_fn()
+    if isinstance(rhs, Tensor):
+        return rhs
+    return rhs
+
+
+def convert_logical_or(l_fn, r_fn):
+    lhs = l_fn()
+    if isinstance(lhs, Tensor):
+        return lhs.logical_or(_as_bool_tensor(r_fn()))
+    if lhs:
+        return lhs
+    rhs = r_fn()
+    return rhs
+
+
+def convert_logical_not(v):
+    if isinstance(v, Tensor):
+        return v.logical_not()
+    return not v
+
+
+def _as_bool_tensor(v):
+    if isinstance(v, Tensor):
+        return v
+    import jax.numpy as jnp
+    return Tensor(jnp.asarray(bool(v)))
+
+
+class _LogicalTransformer(ast.NodeTransformer):
+    """and/or/not inside converted tests → lazy logical converters
+    (reference: dy2static/transformers/logical_transformer.py)."""
+
+    def visit_BoolOp(self, node):
+        node = self.generic_visit(node)
+        fn = "__pd_logical_and" if isinstance(node.op, ast.And) \
+            else "__pd_logical_or"
+        out = node.values[0]
+        for rhs in node.values[1:]:
+            out = ast.Call(
+                func=_name(fn, ast.Load()),
+                args=[ast.Lambda(args=ast.arguments(
+                          posonlyargs=[], args=[], kwonlyargs=[],
+                          kw_defaults=[], defaults=[]), body=out),
+                      ast.Lambda(args=ast.arguments(
+                          posonlyargs=[], args=[], kwonlyargs=[],
+                          kw_defaults=[], defaults=[]), body=rhs)],
+                keywords=[])
+        return out
+
+    def visit_UnaryOp(self, node):
+        node = self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            return ast.Call(func=_name("__pd_logical_not", ast.Load()),
+                            args=[node.operand], keywords=[])
+        return node
+
+
+def convert_to_static(fn):
+    """AST-rewrite ``fn`` so tensor-dependent if/while/for(range) lower to
+    cond/while_loop under tracing. Returns ``fn`` unchanged when source is
+    unavailable (builtins, lambdas from REPL, C extensions)."""
+    if getattr(fn, "_not_to_static", False) or \
+            getattr(fn, "__pd_converted__", False):
+        return fn
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+        tree = ast.parse(src)
+    except (OSError, TypeError, SyntaxError):
+        return fn
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return fn
+    fdef.decorator_list = []  # run undecorated (to_static re-wraps)
+    new_tree = _ControlFlowTransformer().visit(tree)
+    ast.fix_missing_locations(new_tree)
+    glb = dict(fn.__globals__)
+    glb["__pd_convert_ifelse"] = _convert_ifelse_rt
+    glb["__pd_convert_while"] = convert_while
+    glb["__pd_unsupported"] = unsupported_in_converted_block
+    glb["__pd_assert_concrete"] = assert_concrete_pred
+    glb["__pd_loop_index"] = _loop_index
+    glb["__pd_logical_and"] = convert_logical_and
+    glb["__pd_logical_or"] = convert_logical_or
+    glb["__pd_logical_not"] = convert_logical_not
+    # closures: rebuild the cell environment as globals (the rewritten
+    # function is exec'd at module scope, reference precedent:
+    # dy2static/utils.py func_to_source_code + ast_to_func)
+    if fn.__closure__:
+        for name, cell in zip(fn.__code__.co_freevars, fn.__closure__):
+            try:
+                glb[name] = cell.cell_contents
+            except ValueError:
+                pass  # unfilled cell (self-reference); leave unbound
+    code = compile(new_tree, filename=f"<dy2static {fn.__qualname__}>",
+                   mode="exec")
+    exec(code, glb)
+    converted = glb[fdef.name]
+    converted = functools.wraps(fn)(converted)
+    converted.__pd_converted__ = True
+    return converted

@@ -186,3 +186,35 @@ def test_cond_in_whole_step_training():
     assert np.isfinite(l0) and np.isfinite(l1) and l1 < l0
     l2 = float(step(xb, yb, t(-1.0)).numpy())  # L1 branch also trains
     assert np.isfinite(l2)
+
+
+def test_generate_compiled_one_program_matches_eager():
+    """VERDICT r3 item 3 'Done' criterion: generate() compiles end-to-end —
+    fixed-shape KV cache + lax.while_loop decode, parity vs the eager
+    python loop, and the whole thing stages under to_static."""
+    from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+
+    paddle.seed(0)
+    m = GPTForCausalLM(gpt_tiny())
+    m.eval()
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(rng.randint(0, 256, (2, 8)).astype("int64"))
+    out_e = m.generate(ids, max_new_tokens=6, temperature=0.0,
+                       compiled=False)
+    out_c = m.generate(ids, max_new_tokens=6, temperature=0.0,
+                       compiled=True)
+    assert (out_e.numpy() == out_c.numpy()).all()
+
+    # eos: compiled pads finished rows with eos, prefix must agree
+    eos = int(out_e.numpy()[0, 9])
+    oe = m.generate(ids, max_new_tokens=6, temperature=0.0,
+                    eos_token_id=eos, compiled=False)
+    oc = m.generate(ids, max_new_tokens=6, temperature=0.0,
+                    eos_token_id=eos, compiled=True)
+    assert (oe.numpy() == oc.numpy()[:, :oe.shape[1]]).all()
+
+    # whole generate staged as ONE program via to_static
+    sf = to_static(lambda i: m.generate(i, max_new_tokens=6,
+                                        temperature=0.0, compiled=True))
+    out_s = sf(ids)
+    assert (out_s.numpy() == out_c.numpy()).all()

@@ -114,6 +114,9 @@ class DygraphShardingOptimizer:
 
         optimizer._dist_grad_hook = grad_hook
         optimizer._dist_out_hook = out_hook
+        # publish (mesh, merged-spec fn) so fused optimizer kernels can
+        # shard_map over the local shard instead of disabling themselves
+        optimizer._dist_update_info = (mesh, _merged)
         orig_get = optimizer._get_accumulator
 
         class _HostDict(dict):

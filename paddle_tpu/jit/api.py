@@ -394,6 +394,48 @@ class StaticFunction:
         donate = (0,) if self._donate_state else ()
         return jax.jit(pure, donate_argnums=donate), meta
 
+    def aot_compile(self, *args, **kwargs):
+        """Trace + XLA-compile the whole-step program for these example
+        inputs WITHOUT executing it. Returns the jax Compiled object —
+        ``.memory_analysis()`` gives per-device argument/temp/output bytes,
+        so an N-billion-param config's HBM footprint is checkable on a
+        virtual CPU mesh before any chip time (reference capability:
+        memory estimation tools, auto_parallel cost model memory pass)."""
+        if self._capture is None:
+            raise RuntimeError("aot_compile requires whole-step staging "
+                               "(capture=(model, optimizer))")
+        params, buffers, slots, layers, opts = self._state()
+        if not getattr(self, "_materialized", False):
+            for opt in opts:
+                if not opt._state_slots():
+                    opt.materialize()
+            self._materialized = True
+            params, buffers, slots, layers, opts = self._state()
+        arg_tensors: list = []
+        skel = _tree_flatten((args, tuple(sorted(kwargs.items()))),
+                             arg_tensors, [])
+        jitted, meta = self._build_whole_step(skel, params, buffers, slots,
+                                              opts, len(arg_tensors))
+
+        def _aval(a):
+            sh = getattr(a, "sharding", None)
+            if sh is not None and hasattr(sh, "mesh"):
+                try:
+                    return jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                                sharding=sh)
+                except Exception:
+                    pass
+            return jax.ShapeDtypeStruct(a.shape, a.dtype)
+
+        state_avals = [_aval(t._data) for t in params] + \
+            [_aval(b._data) for b in buffers] + \
+            [_aval(cont[k]) for cont, k in slots]
+        arg_avals = [_aval(t._data) for t in arg_tensors]
+        rng_aval = jax.eval_shape(lambda: _random.next_key())
+        lrs_aval = jax.ShapeDtypeStruct((max(len(opts), 1),), jnp.float32)
+        return jitted.lower(state_avals, arg_avals, rng_aval,
+                            lrs_aval).compile()
+
     def compiled_text(self):
         """Optimized-HLO text of the most recent whole-step call. Lets tests
         assert on the collectives GSPMD actually inserted (reduce-scatter

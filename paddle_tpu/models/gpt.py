@@ -33,7 +33,7 @@ class GPTConfig:
                  num_heads=12, intermediate_size=None, max_seq_len=1024,
                  dropout=0.1, layer_norm_epsilon=1e-5, tensor_parallel=False,
                  sequence_parallel=False, use_rms_norm=False,
-                 tie_word_embeddings=True):
+                 tie_word_embeddings=True, recompute=False):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -46,6 +46,7 @@ class GPTConfig:
         self.sequence_parallel = sequence_parallel
         self.use_rms_norm = use_rms_norm
         self.tie_word_embeddings = tie_word_embeddings
+        self.recompute = recompute
 
 
 def gpt_tiny(**kw):
@@ -235,8 +236,16 @@ class GPTModel(nn.Layer):
                              dtype="int64").unsqueeze(0)
         x = self.wte(input_ids) + self.wpe(pos)
         x = self.drop(x)
+        remat = self.config.recompute and self.training and caches is None
         for i, block in enumerate(self.h):
-            x = block(x, cache=None if caches is None else caches[i])
+            if remat:
+                # jax.checkpoint per block: backward rematerializes the
+                # block, bounding live activations to one layer
+                # (reference: fleet recompute granularity "full")
+                from ..distributed.fleet.recompute import recompute
+                x = recompute(block, x)
+            else:
+                x = block(x, cache=None if caches is None else caches[i])
         return self.ln_f(x)
 
 

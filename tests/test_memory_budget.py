@@ -1,0 +1,69 @@
+"""13B-class memory-budget proof on the virtual mesh (VERDICT r3 item 4).
+
+Reference capability: training GPT-1.3B..13B under hybrid parallelism
+within HBM (BASELINE configs; group_sharded_stage3.py,
+dygraph_sharding_optimizer.py:470). TPU-native: the whole train step is
+AOT-compiled (never executed) for the 8-device mesh and XLA's
+memory_analysis() bounds per-device HBM — a 1.3B ZeRO-3 + recompute step
+must fit a v5e chip (16 GiB), checkable entirely on CPU.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.fleet.sharding import group_sharded_parallel
+from paddle_tpu.jit import to_static
+from paddle_tpu.models import (
+    GPTForCausalLM, GPTPretrainingCriterion, gpt_1p3b,
+)
+
+V5E_HBM = 16 * 2 ** 30
+V5P_HBM = 95 * 2 ** 30
+
+
+@pytest.mark.slow
+def test_gpt_1p3b_zero3_recompute_fits_v5e_hbm():
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "pp_degree": 1,
+                               "sharding_degree": 8, "sep_degree": 1,
+                               "mp_degree": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+    # zeros-init: the proof only needs shapes/shardings, not trained values
+    paddle.nn.initializer.set_global_initializer(
+        paddle.nn.initializer.Constant(0.0),
+        paddle.nn.initializer.Constant(0.0))
+    try:
+        paddle.seed(0)
+        cfg = gpt_1p3b(dropout=0.0, recompute=True)
+        model = GPTForCausalLM(cfg)
+        n_params = sum(p.size for p in model.parameters())
+        assert n_params > 1.3e9  # genuinely 1.3B-class
+        crit = GPTPretrainingCriterion(cfg)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                     parameters=model.parameters())
+        model, opt = group_sharded_parallel(model, opt, level="p_g_os")
+        ids = paddle.to_tensor(np.zeros((8, 1024), "int32"))
+        labels = paddle.to_tensor(np.zeros((8, 1024), "int32"))
+
+        def train_step(x, y):
+            loss = crit(model(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        step = to_static(train_step, capture=(model, opt))
+        compiled = step.aot_compile(ids, labels)
+        ma = compiled.memory_analysis()
+        # live state is donated (alias), so peak = args + out - alias + temp
+        peak = (ma.argument_size_in_bytes + ma.output_size_in_bytes
+                - ma.alias_size_in_bytes + ma.temp_size_in_bytes)
+        # sharded state: params+m+v = 3 * 1.3B * 4B / 8 ≈ 2 GiB per device
+        assert ma.argument_size_in_bytes < 2.5 * 2 ** 30, \
+            f"ZeRO-3 state not sharded: {ma.argument_size_in_bytes/2**30:.2f} GiB/device"
+        assert peak < V5E_HBM, \
+            f"per-device peak {peak/2**30:.2f} GiB exceeds v5e HBM"
+        assert peak < V5P_HBM
+    finally:
+        paddle.nn.initializer.set_global_initializer(None, None)

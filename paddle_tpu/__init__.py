@@ -58,6 +58,15 @@ from .hapi.summary import summary  # noqa: F401
 from .hapi.dynamic_flops import flops  # noqa: F401
 from .framework.io import load, save  # noqa: F401
 from .framework.param_attr import ParamAttr  # noqa: F401
+from .framework.dtype_info import (  # noqa: F401
+    finfo, iinfo, is_complex, is_floating_point, is_integer,
+)
+from .framework.compat import (  # noqa: F401
+    LazyGuard, batch, check_shape, create_parameter, get_cuda_rng_state,
+    set_cuda_rng_state,
+)
+from . import geometric  # noqa: F401
+from . import hub  # noqa: F401
 
 # paddle aliases
 bool = bool8  # noqa: A001

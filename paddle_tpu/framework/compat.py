@@ -1,0 +1,74 @@
+"""Small framework-level compat shims.
+
+Reference: base/framework.py LazyGuard/_create_parameter helpers, reader
+decorator paddle.batch (python/paddle/reader/decorator.py:62),
+device.cuda rng-state accessors.
+"""
+from __future__ import annotations
+
+__all__ = ["LazyGuard", "create_parameter", "batch", "check_shape",
+           "get_cuda_rng_state", "set_cuda_rng_state"]
+
+
+class LazyGuard:
+    """Reference: paddle.LazyGuard — delays parameter materialisation.
+    XLA arrays are cheap to create eagerly on host, so this guard is a
+    transparent context (init happens immediately; semantics preserved
+    because paddle code only relies on params existing after exit)."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """Reference: paddle.create_parameter (static/nn/common.py) — a free
+    Parameter outside any Layer."""
+    from .. import nn
+    helper = nn.Layer()
+    return helper.create_parameter(shape, attr=attr, dtype=dtype,
+                                   is_bias=is_bias,
+                                   default_initializer=default_initializer)
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Reference: paddle.batch (reader/decorator.py:62) — group a sample
+    reader into a mini-batch reader."""
+    def batched():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+    return batched
+
+
+def check_shape(shape):
+    """Reference: paddle.check_shape — validate a shape argument."""
+    from ..core.tensor import Tensor
+    if isinstance(shape, Tensor):
+        return
+    for s in shape:
+        if not isinstance(s, (int,)) and not isinstance(s, Tensor):
+            raise TypeError(f"shape entries must be int/Tensor, got {s!r}")
+        if isinstance(s, int) and s < -1:
+            raise ValueError(f"invalid dim {s} in shape {shape}")
+
+
+def get_cuda_rng_state():
+    """Reference: paddle.get_cuda_rng_state — maps to the framework RNG
+    (no CUDA on TPU deployments; state round-trips with set_)."""
+    from ..core.random import get_rng_state
+    return [get_rng_state()]
+
+
+def set_cuda_rng_state(state_list):
+    from ..core.random import set_rng_state
+    if isinstance(state_list, (list, tuple)) and state_list:
+        set_rng_state(state_list[0])

@@ -1,0 +1,67 @@
+"""paddle.finfo/iinfo + dtype predicates.
+
+Reference: python/paddle/framework/dtype.py (finfo:109, iinfo:55 pybind
+wrappers over std::numeric_limits) and tensor/attribute.py
+is_complex/is_floating_point/is_integer.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..core.dtype import convert_dtype, is_complex as _dt_is_complex
+from ..core.dtype import is_floating as _dt_is_floating
+from ..core.tensor import Tensor
+
+__all__ = ["finfo", "iinfo", "is_complex", "is_floating_point",
+           "is_integer"]
+
+
+class finfo:
+    """Reference: paddle.finfo — float type limits."""
+
+    def __init__(self, dtype):
+        jd = convert_dtype(dtype)
+        info = jnp.finfo(jd)
+        self.dtype = str(np.dtype(info.dtype).name) if hasattr(
+            info, "dtype") else str(jd)
+        self.bits = info.bits
+        self.eps = float(info.eps)
+        self.min = float(info.min)
+        self.max = float(info.max)
+        self.tiny = float(info.tiny)
+        self.smallest_normal = float(info.tiny)
+        self.resolution = float(getattr(info, "resolution", info.eps))
+
+
+class iinfo:
+    """Reference: paddle.iinfo — integer type limits."""
+
+    def __init__(self, dtype):
+        jd = convert_dtype(dtype)
+        info = jnp.iinfo(jd)
+        self.dtype = str(np.dtype(info.dtype).name)
+        self.bits = info.bits
+        self.min = int(info.min)
+        self.max = int(info.max)
+
+
+def _dtype_of(x):
+    return x.dtype if isinstance(x, Tensor) else convert_dtype(x)
+
+
+def is_complex(x):
+    """Reference: paddle.is_complex."""
+    return _dt_is_complex(_dtype_of(x))
+
+
+def is_floating_point(x):
+    """Reference: paddle.is_floating_point."""
+    return _dt_is_floating(_dtype_of(x))
+
+
+def is_integer(x):
+    """Reference: paddle.is_integer."""
+    d = _dtype_of(x)
+    return jnp.issubdtype(d, jnp.integer)

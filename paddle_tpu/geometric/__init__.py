@@ -1,0 +1,198 @@
+"""paddle.geometric — graph learning primitives.
+
+Reference: python/paddle/geometric/ (math.py segment ops over
+phi segment_pool kernels; message_passing/send_recv.py graph_send_recv;
+reindex.py; sampling/neighbors.py). TPU-native: segment reductions map
+onto jax.ops.segment_* (XLA scatter-reduce, MXU-friendly dense gathers for
+message passing); graph sampling is host-side (numpy) exactly like the
+reference's CPU sampling kernels.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+
+__all__ = ["send_u_recv", "send_ue_recv", "send_uv", "segment_sum",
+           "segment_mean", "segment_min", "segment_max", "reindex_graph",
+           "sample_neighbors"]
+
+
+def _nseg(index, count):
+    if count is not None:
+        return int(count)
+    idx = index._data if isinstance(index, Tensor) else index
+    return int(jnp.max(idx)) + 1 if idx.size else 0
+
+
+def segment_sum(data, segment_ids, name=None):
+    """Reference: geometric/math.py segment_sum (phi segment_pool SUM)."""
+    n = _nseg(segment_ids, None)
+    return apply("segment_sum",
+                 lambda d, i: jax.ops.segment_sum(d, i, num_segments=n),
+                 [data, segment_ids])
+
+
+def segment_mean(data, segment_ids, name=None):
+    """Reference: geometric/math.py segment_mean."""
+    n = _nseg(segment_ids, None)
+
+    def fwd(d, i):
+        s = jax.ops.segment_sum(d, i, num_segments=n)
+        c = jax.ops.segment_sum(jnp.ones((d.shape[0],), d.dtype), i,
+                                num_segments=n)
+        shape = (n,) + (1,) * (d.ndim - 1)
+        return s / jnp.maximum(c.reshape(shape), 1)
+
+    return apply("segment_mean", fwd, [data, segment_ids])
+
+
+def segment_min(data, segment_ids, name=None):
+    """Reference: geometric/math.py segment_min (empty segments -> 0)."""
+    n = _nseg(segment_ids, None)
+
+    def fwd(d, i):
+        m = jax.ops.segment_min(d, i, num_segments=n)
+        filled = jax.ops.segment_sum(jnp.ones((d.shape[0],), jnp.float32),
+                                     i, num_segments=n) > 0
+        shape = (n,) + (1,) * (d.ndim - 1)
+        return jnp.where(filled.reshape(shape), m, 0).astype(d.dtype)
+
+    return apply("segment_min", fwd, [data, segment_ids])
+
+
+def segment_max(data, segment_ids, name=None):
+    """Reference: geometric/math.py segment_max (empty segments -> 0)."""
+    n = _nseg(segment_ids, None)
+
+    def fwd(d, i):
+        m = jax.ops.segment_max(d, i, num_segments=n)
+        filled = jax.ops.segment_sum(jnp.ones((d.shape[0],), jnp.float32),
+                                     i, num_segments=n) > 0
+        shape = (n,) + (1,) * (d.ndim - 1)
+        return jnp.where(filled.reshape(shape), m, 0).astype(d.dtype)
+
+    return apply("segment_max", fwd, [data, segment_ids])
+
+
+_POOLS = {"sum": jax.ops.segment_sum, "min": jax.ops.segment_min,
+          "max": jax.ops.segment_max}
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """Reference: geometric/message_passing/send_recv.py send_u_recv
+    (graph_send_recv kernel): gather x at src, reduce at dst."""
+    n = out_size if out_size is not None else \
+        (x.shape[0] if hasattr(x, "shape") else None)
+    n = int(n)
+    op = reduce_op.lower()
+
+    def fwd(xv, si, di):
+        msg = jnp.take(xv, si, axis=0)
+        if op == "mean":
+            s = jax.ops.segment_sum(msg, di, num_segments=n)
+            c = jax.ops.segment_sum(jnp.ones((msg.shape[0],), xv.dtype),
+                                    di, num_segments=n)
+            shape = (n,) + (1,) * (xv.ndim - 1)
+            return s / jnp.maximum(c.reshape(shape), 1)
+        out = _POOLS[op](msg, di, num_segments=n)
+        if op in ("min", "max"):
+            filled = jax.ops.segment_sum(
+                jnp.ones((msg.shape[0],), jnp.float32), di,
+                num_segments=n) > 0
+            shape = (n,) + (1,) * (xv.ndim - 1)
+            out = jnp.where(filled.reshape(shape), out, 0).astype(xv.dtype)
+        return out
+
+    return apply("send_u_recv", fwd, [x, src_index, dst_index])
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    """Reference: send_ue_recv — combine node features with edge features
+    (add/sub/mul/div) before the dst reduction."""
+    n = int(out_size if out_size is not None else x.shape[0])
+    mop = message_op.lower()
+    rop = reduce_op.lower()
+    combine = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+               "div": jnp.divide}[mop]
+
+    def fwd(xv, ev, si, di):
+        msg = combine(jnp.take(xv, si, axis=0), ev)
+        if rop == "mean":
+            s = jax.ops.segment_sum(msg, di, num_segments=n)
+            c = jax.ops.segment_sum(jnp.ones((msg.shape[0],), msg.dtype),
+                                    di, num_segments=n)
+            shape = (n,) + (1,) * (msg.ndim - 1)
+            return s / jnp.maximum(c.reshape(shape), 1)
+        out = _POOLS[rop](msg, di, num_segments=n)
+        if rop in ("min", "max"):
+            filled = jax.ops.segment_sum(
+                jnp.ones((msg.shape[0],), jnp.float32), di,
+                num_segments=n) > 0
+            shape = (n,) + (1,) * (msg.ndim - 1)
+            out = jnp.where(filled.reshape(shape), out, 0).astype(msg.dtype)
+        return out
+
+    return apply("send_ue_recv", fwd, [x, y, src_index, dst_index])
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """Reference: send_uv — per-edge combination of src and dst features."""
+    combine = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+               "div": jnp.divide}[message_op.lower()]
+    return apply("send_uv",
+                 lambda xv, yv, si, di: combine(jnp.take(xv, si, axis=0),
+                                                jnp.take(yv, di, axis=0)),
+                 [x, y, src_index, dst_index])
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    """Reference: geometric/reindex.py reindex_graph (CPU kernel): compact
+    the union of x and neighbors to local ids."""
+    xs = np.asarray(x._data if isinstance(x, Tensor) else x)
+    nb = np.asarray(neighbors._data if isinstance(neighbors, Tensor)
+                    else neighbors)
+    ct = np.asarray(count._data if isinstance(count, Tensor) else count)
+    mapping = {int(v): i for i, v in enumerate(xs)}
+    out_nodes = list(xs)
+    reindexed = np.empty_like(nb)
+    for i, v in enumerate(nb):
+        v = int(v)
+        if v not in mapping:
+            mapping[v] = len(out_nodes)
+            out_nodes.append(v)
+        reindexed[i] = mapping[v]
+    dst = np.repeat(np.arange(len(xs)), ct)
+    return (Tensor(jnp.asarray(reindexed), stop_gradient=True),
+            Tensor(jnp.asarray(dst), stop_gradient=True),
+            Tensor(jnp.asarray(np.array(out_nodes)), stop_gradient=True))
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1,
+                     eids=None, return_eids=False, perm_buffer=None,
+                     name=None):
+    """Reference: geometric/sampling/neighbors.py sample_neighbors (CSC
+    graph, CPU kernel; host-side sampling like the reference)."""
+    r = np.asarray(row._data if isinstance(row, Tensor) else row)
+    cp = np.asarray(colptr._data if isinstance(colptr, Tensor) else colptr)
+    nodes = np.asarray(input_nodes._data
+                       if isinstance(input_nodes, Tensor) else input_nodes)
+    rng = np.random.RandomState(0)
+    out, counts = [], []
+    for v in nodes:
+        beg, end = int(cp[int(v)]), int(cp[int(v) + 1])
+        neigh = r[beg:end]
+        if sample_size != -1 and len(neigh) > sample_size:
+            neigh = rng.choice(neigh, size=sample_size, replace=False)
+        out.append(neigh)
+        counts.append(len(neigh))
+    out = np.concatenate(out) if out else np.empty((0,), r.dtype)
+    return (Tensor(jnp.asarray(out), stop_gradient=True),
+            Tensor(jnp.asarray(np.array(counts, np.int32)),
+                   stop_gradient=True))

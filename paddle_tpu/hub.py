@@ -1,0 +1,72 @@
+"""paddle.hub — load models/entrypoints from a hubconf.py.
+
+Reference: python/paddle/hub.py (list/help/load over a github/gitee repo or
+local dir's hubconf.py). TPU-native environment has zero egress, so the
+'github'/'gitee' sources raise with guidance; 'local' source has full
+reference semantics (the reference uses the same _load_entry_from_local
+path).
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+__all__ = ["list", "help", "load"]
+
+_HUBCONF = "hubconf.py"
+
+
+def _load_local_hubconf(repo_dir):
+    path = os.path.join(repo_dir, _HUBCONF)
+    if not os.path.isfile(path):
+        raise FileNotFoundError(
+            f"no {_HUBCONF} found in {repo_dir} (reference: hub.py "
+            "_import_module)")
+    name = "paddle_tpu_hubconf_" + str(abs(hash(repo_dir)) % 10 ** 8)
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    sys.path.insert(0, repo_dir)
+    try:
+        spec.loader.exec_module(mod)
+    finally:
+        sys.path.remove(repo_dir)
+    return mod
+
+
+def _check_source(source):
+    if source not in ("local", "github", "gitee"):
+        raise ValueError(
+            f"unknown source {source!r}: expected 'local', 'github' or "
+            "'gitee'")
+    if source != "local":
+        raise RuntimeError(
+            f"source={source!r} needs network access, unavailable on this "
+            "deployment; clone the repo and use source='local'")
+
+
+def list(repo_dir, source="github", force_reload=False):
+    """Reference: paddle.hub.list — entrypoint names in hubconf.py."""
+    _check_source("local" if os.path.isdir(repo_dir) else source)
+    mod = _load_local_hubconf(repo_dir)
+    return [n for n in dir(mod)
+            if callable(getattr(mod, n)) and not n.startswith("_")]
+
+
+def help(repo_dir, model, source="github", force_reload=False):
+    """Reference: paddle.hub.help — the entrypoint's docstring."""
+    _check_source("local" if os.path.isdir(repo_dir) else source)
+    mod = _load_local_hubconf(repo_dir)
+    if not hasattr(mod, model):
+        raise RuntimeError(f"no entrypoint named {model!r} in {repo_dir}")
+    return getattr(mod, model).__doc__
+
+
+def load(repo_dir, model, source="github", force_reload=False, **kwargs):
+    """Reference: paddle.hub.load — call the entrypoint."""
+    _check_source("local" if os.path.isdir(repo_dir) else source)
+    mod = _load_local_hubconf(repo_dir)
+    if not hasattr(mod, model):
+        raise RuntimeError(f"no entrypoint named {model!r} in {repo_dir}")
+    return getattr(mod, model)(**kwargs)

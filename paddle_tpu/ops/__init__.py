@@ -21,9 +21,10 @@ from .linalg import *  # noqa: F401,F403
 from .search import *  # noqa: F401,F403
 from .random_ops import *  # noqa: F401,F403
 from .generated_root import *  # noqa: F401,F403  (codegen spine, ops.yaml)
+from .inplace import *  # noqa: F401,F403  (op_ in-place family)
 
 from . import creation, linalg, logic, manipulation, math, random_ops, search
-from . import generated_root
+from . import generated_root, inplace
 
 
 def einsum(equation, *operands, name=None):
@@ -39,7 +40,7 @@ def one_hot(x, num_classes, name=None):
 # Bind op functions as Tensor methods (the reference patches these via pybind
 # eager_method.cc + tensor_patch_methods.py).
 _METHOD_SOURCES = [math, manipulation, logic, linalg, search, creation,
-                   generated_root]
+                   generated_root, inplace]
 _NO_METHOD = {
     "to_tensor", "zeros", "ones", "full", "arange", "linspace", "logspace",
     "eye", "empty", "meshgrid", "tril_indices", "triu_indices", "assign",

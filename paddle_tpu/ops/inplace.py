@@ -1,0 +1,141 @@
+"""In-place op variants (``op_`` family).
+
+Reference: python/paddle/tensor/*.py — every ``foo_`` calls the inplace
+ad_func (`_C_ops.foo_`). TPU-native: XLA arrays are immutable, so inplace
+IS adopt-the-result (``Tensor._inplace``): the tensor object takes over the
+out-of-place result's value and grad history; leaf-with-grad raises, same
+as the reference eager engine.
+
+The wrappers are generated from the registry below — one line per op keeps
+the family auditable and the surface complete (~60 reference names).
+"""
+from __future__ import annotations
+
+import sys
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+# base-name registry: every entry emits `<name>_` delegating to the
+# out-of-place op resolved lazily from the paddle_tpu namespace (so
+# generated + handwritten ops are all reachable)
+_UNARY = """abs acos acosh asin asinh atan atanh ceil cos cosh digamma erf
+erfinv exp expm1 floor frac i0 lgamma log log10 log1p log2 logit neg
+polygamma reciprocal round rsqrt sigmoid sin sinh sqrt square tan tanh
+trunc nan_to_num sgn""".split()
+
+_BINARY = """add subtract multiply divide pow remainder mod floor_divide
+floor_mod gcd lcm hypot ldexp logical_and logical_or logical_xor
+bitwise_and bitwise_or bitwise_xor equal not_equal greater_equal
+greater_than less_equal less_than fmax fmin maximum minimum
+heaviside copysign nextafter""".split()
+
+_OTHER = """clip scale cast cumsum cumprod tril triu transpose t squeeze
+unsqueeze flatten index_add index_fill index_put
+masked_fill renorm multigammaln lerp logical_not bitwise_not""".split()
+
+__all__ = []
+
+
+def _resolve(name):
+    import paddle_tpu as _p
+    fn = getattr(_p, name, None)
+    if fn is None:
+        raise NotImplementedError(
+            f"in-place variant '{name}_' has no out-of-place base "
+            f"'paddle.{name}'")
+    return fn
+
+
+def _make(name):
+    def op_(x, *args, **kwargs):
+        return x._inplace(_resolve(name), *args, **kwargs)
+    op_.__name__ = name + "_"
+    op_.__qualname__ = name + "_"
+    op_.__doc__ = (f"In-place variant of paddle.{name} (reference: "
+                   f"python/paddle/tensor {name}_); adopts the "
+                   "out-of-place result, leaf-with-grad raises.")
+    return op_
+
+
+_mod = sys.modules[__name__]
+for _base in _UNARY + _BINARY + _OTHER:
+    _n = _base + "_"
+    if not hasattr(_mod, _n):
+        setattr(_mod, _n, _make(_base))
+        __all__.append(_n)
+
+
+def cauchy_(x, loc=0.0, scale=1.0, name=None):
+    """Reference: python/paddle/tensor/random.py cauchy_ — fill with
+    Cauchy(loc, scale) samples (no out-of-place counterpart)."""
+    from ..core import random as _random
+    import jax
+
+    def fill(a):
+        u = jax.random.uniform(_random.next_key(), a.shape,
+                               minval=1e-7, maxval=1.0 - 1e-7)
+        return (loc + scale * jnp.tan(jnp.pi * (u - 0.5))).astype(a.dtype)
+
+    return x._inplace(lambda t: Tensor(fill(t._data), stop_gradient=True))
+
+
+def geometric_(x, probs, name=None):
+    """Reference: python/paddle/tensor/random.py geometric_ — fill with
+    Geometric(probs) samples."""
+    from ..core import random as _random
+    import jax
+
+    def fill(a):
+        u = jax.random.uniform(_random.next_key(), a.shape,
+                               minval=1e-7, maxval=1.0 - 1e-7)
+        return jnp.ceil(jnp.log(u) / jnp.log1p(-probs)).astype(a.dtype)
+
+    return x._inplace(lambda t: Tensor(fill(t._data), stop_gradient=True))
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    """Reference: random.py uniform_ — refill with U(min, max)."""
+    from ..core import random as _random
+    import jax
+
+    def fill(a):
+        return jax.random.uniform(
+            _random.next_key(), a.shape, minval=min, maxval=max
+        ).astype(a.dtype)
+
+    return x._inplace(lambda t: Tensor(fill(t._data), stop_gradient=True))
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    """Reference: random.py normal_ — refill with N(mean, std)."""
+    from ..core import random as _random
+    import jax
+
+    def fill(a):
+        return (mean + std * jax.random.normal(
+            _random.next_key(), a.shape)).astype(a.dtype)
+
+    return x._inplace(lambda t: Tensor(fill(t._data), stop_gradient=True))
+
+
+def exponential_(x, lam=1.0, name=None):
+    """Reference: random.py exponential_ (re-exported from random_ops)."""
+    from .random_ops import exponential_ as _e
+    return _e(x, lam)
+
+
+def zero_(x, name=None):
+    """Reference: tensor.py zero_ — fill with zeros."""
+    return x._inplace(lambda t: t * 0)
+
+
+def where_(condition, x, y, name=None):
+    """Reference: where_ — in-place select on x."""
+    import paddle_tpu as _p
+    return x._inplace(lambda t: _p.where(condition, t, y))
+
+
+__all__ += ["cauchy_", "geometric_", "uniform_", "normal_",
+            "exponential_", "zero_", "where_"]

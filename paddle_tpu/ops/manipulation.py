@@ -444,3 +444,43 @@ def tensordot(x, y, axes=2, name=None):
 
 def tolist(x):
     return x.tolist()
+
+
+def unstack(x, axis=0, num=None, name=None):
+    """Reference: python/paddle/tensor/manipulation.py unstack — split along
+    `axis` into a list of tensors with that axis removed."""
+    axis = axis % x.ndim
+    n = x.shape[axis] if num is None else num
+    outs = apply("unstack",
+                 lambda a: tuple(jnp.squeeze(s, axis) for s in
+                                 jnp.split(a, n, axis=axis)),
+                 [x], nout=n)
+    return list(outs) if isinstance(outs, tuple) else [outs]
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    """Reference: python/paddle/tensor/manipulation.py as_strided — view
+    with explicit strides, realised as a gather of the flat buffer (XLA has
+    no aliasing views; numerics match)."""
+    import numpy as _np
+    grids = _np.indices(tuple(int(s) for s in shape))
+    flat_idx = offset + sum(g * int(st) for g, st in zip(grids, stride))
+    idx = jnp.asarray(flat_idx.reshape(-1), jnp.int32)
+    return apply("as_strided",
+                 lambda a: jnp.take(a.reshape(-1), idx).reshape(
+                     tuple(int(s) for s in shape)), [x])
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None,
+                name=None):
+    """Reference: python/paddle/tensor/linalg.py histogramdd. Returns
+    (hist, list_of_edges)."""
+    import numpy as _np
+    xs = _np.asarray(x._data if isinstance(x, Tensor) else x)
+    ws = None if weights is None else _np.asarray(
+        weights._data if isinstance(weights, Tensor) else weights)
+    hist, edges = _np.histogramdd(xs, bins=bins, range=ranges,
+                                  density=density, weights=ws)
+    from ..core.tensor import Tensor as _T2
+    return (_T2(jnp.asarray(hist), stop_gradient=True),
+            [_T2(jnp.asarray(e), stop_gradient=True) for e in edges])

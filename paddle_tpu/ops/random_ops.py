@@ -129,3 +129,24 @@ def rand_like(x, dtype=None, name=None):
 def randn_like(x, dtype=None, name=None):
     dt = convert_dtype(dtype) or x.dtype
     return Tensor(jax.random.normal(prandom.next_key(), tuple(x.shape), dt))
+
+
+def binomial(count, prob, name=None):
+    """Reference: python/paddle/tensor/random.py binomial — sample
+    Binomial(count, prob) elementwise."""
+    import jax as _jax
+    c = count._data if isinstance(count, Tensor) else jnp.asarray(count)
+    p = prob._data if isinstance(prob, Tensor) else jnp.asarray(prob)
+    shape = jnp.broadcast_shapes(jnp.shape(c), jnp.shape(p))
+    out = _jax.random.binomial(prandom.next_key(),
+                               jnp.broadcast_to(c, shape).astype(jnp.float32),
+                               jnp.broadcast_to(p, shape).astype(jnp.float32))
+    return Tensor(out.astype(jnp.int64), stop_gradient=True)
+
+
+def standard_gamma(alpha, name=None):
+    """Reference: python/paddle/tensor/random.py standard_gamma."""
+    import jax as _jax
+    a = alpha._data if isinstance(alpha, Tensor) else jnp.asarray(alpha)
+    out = _jax.random.gamma(prandom.next_key(), a.astype(jnp.float32))
+    return Tensor(out, stop_gradient=True)

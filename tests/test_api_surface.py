@@ -85,3 +85,58 @@ def test_namespaces_surface():
     _has(paddle.profiler, "Profiler RecordEvent load_profiler_result")
     _has(paddle.metric, "Accuracy Precision Recall Auc")
     _has(paddle.hapi, "Model summary callbacks")
+
+
+def test_geometric_surface():
+    _has(paddle.geometric, """send_u_recv send_ue_recv send_uv segment_sum
+        segment_mean segment_min segment_max reindex_graph
+        sample_neighbors""")
+
+
+def test_inplace_family_surface():
+    _has(paddle, """abs_ exp_ sqrt_ tanh_ sigmoid_ add_ subtract_ multiply_
+        divide_ pow_ remainder_ floor_divide_ clip_ scale_ cast_ cumsum_
+        tril_ triu_ transpose_ t_ squeeze_ unsqueeze_ flatten_ zero_
+        uniform_ normal_ cauchy_ geometric_ where_ masked_fill_
+        index_add_ lerp_ logical_and_ logical_not_ bitwise_and_""")
+
+
+def test_hub_surface():
+    import paddle_tpu.hub as hub
+    assert callable(hub.load) and callable(hub.list) and callable(hub.help)
+
+
+def test_total_public_op_surface_at_least_600():
+    """VERDICT r3 item 5 'Done' criterion: >=600 public callable names
+    across the op-carrying namespaces (reference: ~2000 across
+    python/paddle/tensor + namespaces; the measured set excludes classes
+    and submodule re-exports so growth tracks real op work)."""
+    import inspect
+
+    seen = set()
+
+    def count(mod, prefix):
+        n = 0
+        for name in dir(mod):
+            if name.startswith("_"):
+                continue
+            obj = getattr(mod, name)
+            if inspect.isfunction(obj) or inspect.isbuiltin(obj):
+                key = prefix + name
+                if key not in seen:
+                    seen.add(key)
+                    n += 1
+        return n
+
+    total = count(paddle, "")
+    for mod, p in [(paddle.linalg, "linalg."), (paddle.fft, "fft."),
+                   (paddle.signal, "signal."),
+                   (paddle.geometric, "geometric."),
+                   (paddle.nn.functional, "F."),
+                   (paddle.vision.ops, "vision.ops."),
+                   (paddle.sparse, "sparse."),
+                   (paddle.incubate, "incubate."),
+                   (paddle.distributed, "dist."),
+                   (paddle.audio.functional, "audio.F.")]:
+        total += count(mod, p)
+    assert total >= 600, f"public op surface shrank: {total} < 600"

@@ -454,3 +454,74 @@ def test_roi_align_adaptive_ratio_close_to_per_roi_reference():
                 # denser global sampling vs adaptive: close, not exact
                 np.testing.assert_allclose(out[r, :, i, j], acc / (srx * sry),
                                            atol=5e-2)
+
+
+def test_geometric_segment_and_message_passing():
+    """Reference: python/paddle/geometric/math.py +
+    message_passing/send_recv.py semantics."""
+    import paddle_tpu.geometric as G
+    x = paddle.to_tensor(np.array([1., 2., 3., 4.], "float32"))
+    ids = paddle.to_tensor(np.array([0, 0, 1, 1]))
+    np.testing.assert_allclose(G.segment_sum(x, ids).numpy(), [3, 7])
+    np.testing.assert_allclose(G.segment_mean(x, ids).numpy(), [1.5, 3.5])
+    np.testing.assert_allclose(G.segment_min(x, ids).numpy(), [1, 3])
+    np.testing.assert_allclose(G.segment_max(x, ids).numpy(), [2, 4])
+
+    feat = paddle.to_tensor(np.arange(8.0, dtype="float32").reshape(4, 2))
+    src = paddle.to_tensor(np.array([0, 1, 2, 3]))
+    dst = paddle.to_tensor(np.array([1, 1, 2, 2]))
+    out = G.send_u_recv(feat, src, dst, "sum")
+    np.testing.assert_allclose(out.numpy()[1],
+                               feat.numpy()[0] + feat.numpy()[1])
+    np.testing.assert_allclose(out.numpy()[0], [0, 0])  # empty dst
+    e = paddle.to_tensor(np.ones((4, 2), "float32"))
+    out2 = G.send_ue_recv(feat, e, src, dst, "add", "mean")
+    np.testing.assert_allclose(
+        out2.numpy()[2], (feat.numpy()[2] + feat.numpy()[3]) / 2 + 1)
+    uv = G.send_uv(feat, feat, src, dst, "mul")
+    np.testing.assert_allclose(uv.numpy()[0],
+                               feat.numpy()[0] * feat.numpy()[1])
+    # grads flow through the scatter-reduce
+    feat.stop_gradient = False
+    G.send_u_recv(feat, src, dst, "sum").sum().backward()
+    assert feat.grad is not None
+
+    # reindex + sampling (host-side, reference CPU kernels)
+    nodes = paddle.to_tensor(np.array([10, 20]))
+    neigh = paddle.to_tensor(np.array([30, 10, 40]))
+    cnt = paddle.to_tensor(np.array([2, 1]))
+    re_n, dst_i, out_nodes = G.reindex_graph(nodes, neigh, cnt)
+    assert out_nodes.numpy().tolist() == [10, 20, 30, 40]
+    assert re_n.numpy().tolist() == [2, 0, 3]
+    assert dst_i.numpy().tolist() == [0, 0, 1]
+
+
+def test_hub_local_load(tmp_path):
+    """Reference: python/paddle/hub.py list/help/load on a local repo."""
+    import paddle_tpu.hub as hub
+    (tmp_path / "hubconf.py").write_text(
+        "def tiny_model(scale=2):\n"
+        "    '''a tiny test entrypoint'''\n"
+        "    return {'scale': scale}\n")
+    names = hub.list(str(tmp_path), source="local")
+    assert "tiny_model" in names
+    assert "tiny" in hub.help(str(tmp_path), "tiny_model", source="local")
+    m = hub.load(str(tmp_path), "tiny_model", source="local", scale=5)
+    assert m == {"scale": 5}
+
+
+def test_inplace_variants_semantics():
+    """op_ family: value adoption + leaf-with-grad guard (reference eager
+    inplace semantics)."""
+    x = paddle.to_tensor(np.array([4.0, 9.0], "float32"))
+    y = x.sqrt_()
+    assert y is x
+    np.testing.assert_allclose(x.numpy(), [2, 3])
+    x.add_(paddle.to_tensor(np.array([1.0, 1.0], "float32")))
+    np.testing.assert_allclose(x.numpy(), [3, 4])
+    x.clip_(0.0, 3.5)
+    np.testing.assert_allclose(x.numpy(), [3, 3.5])
+    leaf = paddle.to_tensor(np.array([1.0]), stop_gradient=False)
+    import pytest as _pt
+    with _pt.raises(RuntimeError, match="leaf"):
+        leaf.exp_()

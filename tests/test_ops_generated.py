@@ -507,3 +507,107 @@ def test_fft_ifftshift_golden():
     expect = np.fft.ifftshift(x)
     np.testing.assert_allclose(_np(out), expect, rtol=1e-05, atol=1e-05)
 
+def test_root_add_n_golden():
+    rng = np.random.RandomState(0)
+    inputs = [np.asarray(_e) for _e in (rng.randn(2, 3), rng.randn(2, 3), rng.randn(2, 3))]
+    out = paddle.add_n([paddle.to_tensor(_e) for _e in inputs])
+    expect = inputs[0] + inputs[1] + inputs[2]
+    np.testing.assert_allclose(_np(out), expect, rtol=1e-05, atol=1e-05)
+
+def test_root_sgn_golden():
+    rng = np.random.RandomState(0)
+    x = np.asarray(rng.randn(3, 4))
+    out = paddle.sgn(paddle.to_tensor(x))
+    expect = np.sign(x)
+    np.testing.assert_allclose(_np(out), expect, rtol=1e-05, atol=1e-05)
+
+def test_root_unflatten_golden():
+    rng = np.random.RandomState(0)
+    x = np.asarray(rng.randn(4, 6))
+    out = paddle.unflatten(paddle.to_tensor(x), axis=1, shape=[2, 3])
+    expect = x.reshape(4, 2, 3)
+    np.testing.assert_allclose(_np(out), expect, rtol=1e-05, atol=1e-05)
+
+def test_root_reverse_golden():
+    rng = np.random.RandomState(0)
+    x = np.asarray(rng.randn(3, 4))
+    out = paddle.reverse(paddle.to_tensor(x), axis=0)
+    expect = x[::-1]
+    np.testing.assert_allclose(_np(out), expect, rtol=1e-05, atol=1e-05)
+
+def test_root_masked_scatter_golden():
+    rng = np.random.RandomState(0)
+    x = np.asarray(rng.randn(2, 3))
+    mask = np.asarray(np.array([[1, 0, 1], [0, 1, 0]], bool))
+    value = np.asarray(rng.randn(6))
+    out = paddle.masked_scatter(paddle.to_tensor(x), paddle.to_tensor(mask), paddle.to_tensor(value))
+    expect = np.where(mask, np.where(mask.ravel(), value[np.maximum(np.cumsum(mask.ravel())-1, 0)], 0).reshape(2, 3), x)
+    np.testing.assert_allclose(_np(out), expect, rtol=1e-05, atol=1e-05)
+
+def test_root_pdist_golden():
+    rng = np.random.RandomState(0)
+    x = np.asarray(rng.randn(4, 3))
+    out = paddle.pdist(paddle.to_tensor(x))
+    expect = np.array([np.linalg.norm(x[i]-x[j]) for i in range(4) for j in range(i+1, 4)])
+    np.testing.assert_allclose(_np(out), expect, rtol=1e-05, atol=1e-05)
+
+def test_root_shard_index_golden():
+    rng = np.random.RandomState(0)
+    input = np.asarray(np.array([[1], [6], [12], [19]], np.int64))
+    out = paddle.shard_index(paddle.to_tensor(input), index_num=20, nshards=2, shard_id=0)
+    expect = np.array([[1], [6], [-1], [-1]])
+    np.testing.assert_allclose(_np(out), expect, rtol=1e-05, atol=1e-05)
+
+def test_root_rank_golden():
+    rng = np.random.RandomState(0)
+    input = np.asarray(rng.randn(2, 3, 4))
+    out = paddle.rank(paddle.to_tensor(input))
+    expect = 3
+    np.testing.assert_allclose(_np(out), expect, rtol=1e-05, atol=1e-05)
+
+def test_root_shape_golden():
+    rng = np.random.RandomState(0)
+    input = np.asarray(rng.randn(2, 5))
+    out = paddle.shape(paddle.to_tensor(input))
+    expect = np.array([2, 5])
+    np.testing.assert_allclose(_np(out), expect, rtol=1e-05, atol=1e-05)
+
+def test_root_combinations_golden():
+    rng = np.random.RandomState(0)
+    x = np.asarray(np.arange(4.0))
+    out = paddle.combinations(paddle.to_tensor(x), r=2)
+    expect = np.array([[0, 1], [0, 2], [0, 3], [1, 2], [1, 3], [2, 3]], np.float64)
+    np.testing.assert_allclose(_np(out), expect, rtol=1e-05, atol=1e-05)
+
+def test_root_logaddexp2_golden():
+    rng = np.random.RandomState(0)
+    x = np.asarray(rng.randn(3, 4))
+    y = np.asarray(rng.randn(3, 4))
+    out = paddle.logaddexp2(paddle.to_tensor(x), paddle.to_tensor(y))
+    expect = np.logaddexp2(x, y)
+    np.testing.assert_allclose(_np(out), expect, rtol=1e-05, atol=1e-05)
+
+def test_root_float_power_golden():
+    rng = np.random.RandomState(0)
+    x = np.asarray(rng.rand(3, 4) + 0.5)
+    y = np.asarray(rng.randn(3, 4))
+    out = paddle.float_power(paddle.to_tensor(x), paddle.to_tensor(y))
+    expect = np.float_power(x, y)
+    np.testing.assert_allclose(_np(out), expect, rtol=1e-4, atol=1e-4)
+
+def test_linalg_cross_golden():
+    rng = np.random.RandomState(0)
+    x = np.asarray(rng.randn(4, 3))
+    y = np.asarray(rng.randn(4, 3))
+    out = paddle.linalg.cross(paddle.to_tensor(x), paddle.to_tensor(y))
+    expect = np.cross(x, y)
+    np.testing.assert_allclose(_np(out), expect, rtol=1e-05, atol=1e-05)
+
+def test_linalg_dot_golden():
+    rng = np.random.RandomState(0)
+    x = np.asarray(rng.randn(5))
+    y = np.asarray(rng.randn(5))
+    out = paddle.linalg.dot(paddle.to_tensor(x), paddle.to_tensor(y))
+    expect = np.dot(x, y)
+    np.testing.assert_allclose(_np(out), expect, rtol=1e-05, atol=1e-05)
+

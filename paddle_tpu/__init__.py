@@ -73,17 +73,22 @@ bool = bool8  # noqa: A001
 
 
 def disable_static(place=None):
+    from .static.program import disable_static_mode
+    disable_static_mode()
     return None
 
 
 def enable_static():
-    raise NotImplementedError(
-        "paddle_tpu is dygraph-first; use paddle_tpu.jit.to_static for "
-        "whole-graph XLA compilation (replaces the static graph executor).")
+    """Reference: paddle.enable_static — switch to Program recording.
+    Ops on paddle.static.data() variables append to the default main
+    Program; Executor.run(feed/fetch) evaluates it (static/program.py)."""
+    from .static.program import enable_static_mode
+    enable_static_mode()
 
 
 def in_dynamic_mode():
-    return True
+    from .static.program import in_static_mode
+    return not in_static_mode()
 
 
 def set_printoptions(precision=None, threshold=None, edgeitems=None,

@@ -58,10 +58,15 @@ def _amp_cast(name, inputs):
 
 _op_profiler = None  # set by paddle_tpu.profiler to record per-op timing
 _cf_recorder = None  # set by jit.control_flow during branch discovery
+_static_graph_hook = None  # set by static.program under enable_static
 
 
 def apply(name: str, fwd: Callable, inputs: Sequence[Any], nout: int = 1,
           has_aux: bool = False):
+    if _static_graph_hook is not None:
+        recorded = _static_graph_hook(name, fwd, inputs, nout, has_aux)
+        if recorded is not None:
+            return recorded
     hook = _op_profiler
     if hook is None:
         result = _apply_impl(name, fwd, inputs, nout, has_aux)

@@ -26,9 +26,14 @@ class Optimizer:
                  weight_decay=None, grad_clip=None, multi_precision=False,
                  name=None):
         if parameters is None:
-            raise ValueError(
-                "parameters is required in dygraph mode: pass "
-                "model.parameters() (reference: optimizer.py dygraph check)")
+            from ..static import program as _sp
+            if _sp.in_static_mode():
+                parameters = []  # filled by minimize from the Program
+            else:
+                raise ValueError(
+                    "parameters is required in dygraph mode: pass "
+                    "model.parameters() (reference: optimizer.py dygraph "
+                    "check)")
         parameters = list(parameters)
         if parameters and isinstance(parameters[0], dict):
             self._param_groups = []
@@ -163,6 +168,11 @@ class Optimizer:
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
+        from ..static import program as _sp
+        if _sp.in_static_mode() and isinstance(loss, _sp.Variable):
+            # static graph: record; Executor.run replays backward+step
+            loss._program.minimize_ops.append((self, loss))
+            return None, None
         self.backward(loss)
         self.step()
         return None, None

@@ -12,24 +12,66 @@ from ..jit.api import InputSpec  # noqa: F401
 from ..jit.save_load import load as _jit_load
 from ..jit.save_load import save as _jit_save
 from . import nn  # noqa: F401
+from .program import (  # noqa: F401
+    Executor, Program, Variable, data, default_main_program,
+    default_startup_program, global_scope, program_guard, scope_guard,
+)
 
 __all__ = ["InputSpec", "nn", "save_inference_model",
-           "load_inference_model"]
+           "load_inference_model", "Program", "Variable", "Executor",
+           "data", "program_guard", "default_main_program",
+           "default_startup_program", "global_scope", "scope_guard"]
 
 
 def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
                          program=None, **kwargs):
-    """Reference: static/io.py save_inference_model. `fetch_vars` must carry
-    the layer via `.layer` or kwargs['layer'] (the dygraph-first rebuild has
-    no global default Program to capture)."""
+    """Reference: static/io.py save_inference_model — reference signature:
+    feed/fetch are static Variables of the recorded Program; the DAG is
+    traced into a StableHLO AOT artifact (dynamic batch dims export with
+    batch=1; pass layer=<Layer> for the dygraph-native path)."""
     layer = kwargs.get("layer")
-    if layer is None:
-        raise ValueError(
-            "paddle_tpu.static.save_inference_model requires layer=<Layer>: "
-            "the static Program is replaced by tracing a Layer "
-            "(use paddle_tpu.jit.save directly for the native API)")
-    specs = feed_vars if isinstance(feed_vars, (list, tuple)) else [feed_vars]
-    _jit_save(layer, path_prefix, input_spec=list(specs))
+    if layer is not None:
+        specs = feed_vars if isinstance(feed_vars, (list, tuple)) \
+            else [feed_vars]
+        return _jit_save(layer, path_prefix, input_spec=list(specs))
+
+    from ..core.tensor import Parameter
+    from ..nn import Layer
+    from .program import Variable, _eval, disable_static_mode, \
+        enable_static_mode, in_static_mode
+
+    feeds = list(feed_vars) if isinstance(feed_vars, (list, tuple)) \
+        else [feed_vars]
+    fetches = list(fetch_vars) if isinstance(fetch_vars, (list, tuple)) \
+        else [fetch_vars]
+    if not all(isinstance(v, Variable) for v in feeds + fetches):
+        raise TypeError(
+            "save_inference_model expects static Variables (from "
+            "paddle.static.data / recorded ops), or layer=<Layer>")
+    prog = fetches[0]._program
+    params = prog.all_parameters()
+
+    class _ProgramModule(Layer):
+        def __init__(self):
+            super().__init__()
+            for i, p in enumerate(params):
+                self.add_parameter(f"p{i}", p if isinstance(p, Parameter)
+                                   else Parameter(p._data))
+
+        def forward(self, *args):
+            was = in_static_mode()
+            disable_static_mode()
+            try:
+                env = {id(v): a for v, a in zip(feeds, args)}
+                outs = [_eval(f, env) for f in fetches]
+                return outs[0] if len(outs) == 1 else tuple(outs)
+            finally:
+                if was:
+                    enable_static_mode()
+
+    specs = [InputSpec([1 if d is None else int(d) for d in v.shape],
+                       v.dtype) for v in feeds]
+    _jit_save(_ProgramModule(), path_prefix, input_spec=specs)
 
 
 def load_inference_model(path_prefix, executor=None, **kwargs):
@@ -37,10 +79,3 @@ def load_inference_model(path_prefix, executor=None, **kwargs):
     return _jit_load(path_prefix)
 
 
-def default_main_program():
-    raise NotImplementedError(
-        "paddle_tpu is dygraph-first: there is no global static Program. "
-        "Use jit.to_static to compile functions/Layers (SURVEY §7).")
-
-
-default_startup_program = default_main_program

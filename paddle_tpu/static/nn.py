@@ -10,4 +10,38 @@ from ..jit.control_flow import (  # noqa: F401
     case, cond, scan_loop, switch_case, while_loop,
 )
 
-__all__ = ["cond", "while_loop", "case", "switch_case", "scan_loop"]
+__all__ = ["cond", "while_loop", "case", "switch_case", "scan_loop",
+           "fc", "embedding"]
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    """Reference: paddle.static.nn.fc (static/nn/common.py:28) — a fully
+    connected layer on a static Variable; parameters are created (and
+    initialised) immediately, the matmul records into the Program."""
+    from .. import nn as _nn
+    in_features = 1
+    for s in x.shape[num_flatten_dims:]:
+        in_features *= int(s)
+    lin = _nn.Linear(in_features, size, weight_attr=weight_attr,
+                     bias_attr=bias_attr)
+    h = x
+    if len(x.shape) > num_flatten_dims + 1:
+        import paddle_tpu as _p
+        h = _p.reshape(h, [s if s is not None else -1
+                           for s in x.shape[:num_flatten_dims]]
+                       + [in_features])
+    out = lin(h)
+    if activation is not None:
+        from ..nn import functional as _F
+        out = getattr(_F, activation)(out)
+    return out
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None,
+              param_attr=None, dtype="float32"):
+    """Reference: paddle.static.nn.embedding."""
+    from .. import nn as _nn
+    emb = _nn.Embedding(size[0], size[1], padding_idx=padding_idx,
+                        weight_attr=param_attr)
+    return emb(input)

@@ -1,0 +1,329 @@
+"""Static-graph Program/Executor compatibility layer.
+
+Reference: python/paddle/base/framework.py (Program:6174, program_guard),
+base/executor.py:1608 (Executor.run feed/fetch),
+static/input.py data(). TPU-native collapse: a Program is a lazily
+recorded op DAG — under ``paddle.enable_static()`` every dispatch
+(`core/dispatch.apply`) on a static Variable appends a node instead of
+executing, and ``Executor.run(feed, fetch_list)`` evaluates the DAG with
+the eager tape live (so ``optimizer.minimize`` replays backward + update),
+op-dispatching onto XLA. Parameters are initialised at creation, so the
+startup program is a no-op run (reference semantics preserved: after
+``exe.run(startup_program)`` params are live).
+"""
+from __future__ import annotations
+
+import contextlib
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..core import dispatch as _dispatch
+from ..core.dtype import convert_dtype
+from ..core.tensor import Parameter, Tensor
+
+__all__ = ["Program", "Variable", "program_guard", "data",
+           "default_main_program", "default_startup_program", "Executor",
+           "enable_static_mode", "disable_static_mode", "in_static_mode",
+           "global_scope", "scope_guard"]
+
+_static_mode = [False]
+
+
+def in_static_mode():
+    return _static_mode[0]
+
+
+def enable_static_mode():
+    _static_mode[0] = True
+    _dispatch._static_graph_hook = _maybe_record
+
+
+def disable_static_mode():
+    _static_mode[0] = False
+    _dispatch._static_graph_hook = None
+
+
+class Variable(Tensor):
+    """A symbolic node in a Program (reference: framework.py Variable).
+    Holds no data; ``shape`` may contain None (batch) dims."""
+
+    def __init__(self, program, shape, dtype, name, op=None, ins=None,
+                 nout=1, out_idx=0, is_feed=False):
+        # deliberately NOT calling Tensor.__init__ — no data exists
+        self._data = None
+        self._grad = None
+        self._grad_fn = None
+        self.stop_gradient = True
+        self.name = name
+        self.persistable = False
+        self._program = program
+        self._declared_shape = list(shape)
+        self._declared_dtype = convert_dtype(dtype) or jnp.float32
+        self._op = op            # (op_name, fwd, nout) or None for feeds
+        self._ins = ins or []
+        self._nout = nout
+        self._out_idx = out_idx
+        self._is_feed = is_feed
+
+    @property
+    def shape(self):
+        return list(self._declared_shape)
+
+    @property
+    def dtype(self):
+        return self._declared_dtype
+
+    @property
+    def ndim(self):
+        return len(self._declared_shape)
+
+    def __repr__(self):
+        return (f"Variable(name={self.name}, shape={self._declared_shape}, "
+                f"dtype={self._declared_dtype})")
+
+
+class Program:
+    """Reference: base/framework.py Program — here the recorded DAG plus
+    the parameters and optimizer steps it reaches."""
+
+    _counter = [0]
+
+    def __init__(self):
+        Program._counter[0] += 1
+        self.id = Program._counter[0]
+        self.feeds: dict = {}          # name -> Variable
+        self.vars: list = []
+        self.minimize_ops: list = []   # (optimizer, loss_variable)
+        self.random_seed = None
+
+    def _new_name(self, base):
+        return f"{base}_{self.id}_{len(self.vars)}"
+
+    def global_block(self):
+        return self
+
+    def all_parameters(self):
+        seen, out = set(), []
+
+        def walk(v):
+            if isinstance(v, Variable):
+                for i in v._ins:
+                    walk(i)
+            elif isinstance(v, Parameter) and id(v) not in seen:
+                seen.add(id(v))
+                out.append(v)
+            elif isinstance(v, Tensor):
+                pass
+        for v in self.vars:
+            for i in v._ins:
+                walk(i)
+        for _, loss in self.minimize_ops:
+            walk(loss)
+        return out
+
+    def clone(self, for_test=False):
+        import copy
+        p = copy.copy(self)
+        if for_test:
+            p = copy.copy(self)
+            p.minimize_ops = []
+        return p
+
+    def __repr__(self):
+        return (f"Program(id={self.id}, vars={len(self.vars)}, "
+                f"feeds={sorted(self.feeds)})")
+
+
+_default_main = Program()
+_default_startup = Program()
+
+
+def default_main_program():
+    """Reference: paddle.static.default_main_program."""
+    return _default_main
+
+
+def default_startup_program():
+    return _default_startup
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    """Reference: paddle.static.program_guard."""
+    global _default_main, _default_startup
+    prev_m, prev_s = _default_main, _default_startup
+    _default_main = main_program
+    if startup_program is not None:
+        _default_startup = startup_program
+    try:
+        yield
+    finally:
+        _default_main, _default_startup = prev_m, prev_s
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """Reference: paddle.static.data (static/input.py)."""
+    prog = _default_main
+    v = Variable(prog, shape, dtype, name, is_feed=True)
+    prog.feeds[name] = v
+    prog.vars.append(v)
+    return v
+
+
+def _maybe_record(name, fwd, inputs, nout, has_aux):
+    """dispatch hook: when any input is a static Variable, record a DAG
+    node instead of executing. Returns None to fall through to eager."""
+    if not any(isinstance(t, Variable) for t in inputs):
+        return None
+    if has_aux:
+        raise NotImplementedError(
+            f"op '{name}' with aux outputs is not supported in static "
+            "graph recording yet; run in dygraph mode")
+    prog = None
+    for t in inputs:
+        if isinstance(t, Variable):
+            prog = t._program
+            break
+    # infer output shapes/dtypes by abstract evaluation
+    import jax
+
+    def shaped(t):
+        if isinstance(t, Variable):
+            shp = [1 if s is None else s for s in t._declared_shape]
+            return jax.ShapeDtypeStruct(tuple(shp), t._declared_dtype)
+        if isinstance(t, Tensor):
+            return jax.ShapeDtypeStruct(tuple(t._data.shape),
+                                        t._data.dtype)
+        return t
+
+    try:
+        out_aval = jax.eval_shape(fwd, *[shaped(t) for t in inputs])
+    except Exception as e:
+        raise RuntimeError(
+            f"static-graph shape inference failed for op '{name}': {e}")
+    avals = out_aval if isinstance(out_aval, tuple) else (out_aval,)
+    outs = []
+    batch_dims = {i for t in inputs if isinstance(t, Variable)
+                  for i, s in enumerate(t._declared_shape) if s is None}
+    op_rec = (name, fwd, nout)     # shared: siblings compare by identity
+    ins_rec = list(inputs)
+    for i, av in enumerate(avals):
+        shp = list(av.shape)
+        # propagate the None batch dim when it survives at dim 0
+        if 0 in batch_dims and shp and any(
+                isinstance(t, Variable) and t._declared_shape
+                and t._declared_shape[0] is None
+                and shp[0] == 1 for t in inputs):
+            shp[0] = None
+        v = Variable(prog, shp, av.dtype, prog._new_name(name),
+                     op=op_rec, ins=ins_rec, nout=len(avals), out_idx=i)
+        prog.vars.append(v)
+        outs.append(v)
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+class _Scope:
+    def __init__(self):
+        self._vars = {}
+
+    def var(self, name):
+        return self._vars.setdefault(name, None)
+
+    def find_var(self, name):
+        return self._vars.get(name)
+
+
+_scope = _Scope()
+
+
+def global_scope():
+    return _scope
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    global _scope
+    prev = _scope
+    _scope = scope
+    try:
+        yield
+    finally:
+        _scope = prev
+
+
+class Executor:
+    """Reference: base/executor.py:1608. ``run`` binds feeds, evaluates
+    the DAG with the autograd tape live, replays recorded minimize ops
+    (backward + optimizer update), and returns fetched numpy arrays."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None,
+            return_numpy=True, **kwargs):
+        prog = program if program is not None else _default_main
+        if prog is _default_startup or (isinstance(prog, Program)
+                                        and not prog.vars
+                                        and not prog.minimize_ops):
+            return []  # startup: params initialised at creation
+        feed = feed or {}
+        env: dict = {}
+        for name, arr in feed.items():
+            if name not in prog.feeds:
+                raise KeyError(f"feed '{name}' is not a data() var of "
+                               f"{prog}")
+            env[id(prog.feeds[name])] = Tensor(jnp.asarray(arr))
+
+        was_static = in_static_mode()
+        disable_static_mode()  # evaluation itself runs eagerly
+        try:
+            for opt, loss in prog.minimize_ops:
+                if not opt._parameter_list:
+                    # reference: parameters default to the program's
+                    # trainable vars. Extend IN PLACE — _param_groups[0]
+                    # aliases this list (optimizer.py ctor).
+                    found = prog.all_parameters()
+                    opt._parameter_list.extend(found)
+                    opt._pid_to_param.update(
+                        {id(p): p for p in found})
+                loss_t = _eval(loss, env)
+                loss_t.backward()
+                opt.step()
+                opt.clear_grad()
+            results = []
+            for f in (fetch_list or []):
+                t = _eval(f, env) if isinstance(f, Variable) else f
+                results.append(np.asarray(t._data) if return_numpy else t)
+            return results
+        finally:
+            if was_static:
+                enable_static_mode()
+
+    def close(self):
+        return None
+
+
+def _eval(v, env):
+    """Evaluate a Variable against bound feeds (memoized per run)."""
+    if not isinstance(v, Variable):
+        return v
+    if id(v) in env:
+        return env[id(v)]
+    if v._is_feed:
+        raise RuntimeError(
+            f"data variable '{v.name}' was not fed (feed={{...}})")
+    name, fwd, nout = v._op
+    from ..core.dispatch import apply
+    ins = [_eval(i, env) if isinstance(i, Variable) else i for i in v._ins]
+    out = apply(name, fwd, ins, nout=v._nout)
+    outs = out if isinstance(out, tuple) else (out,)
+    # cache every sibling output of this node
+    sibs = [s for s in v._program.vars
+            if isinstance(s, Variable) and s._op is not None
+            and s._op is v._op and s._ins is v._ins]
+    for s in sibs:
+        env[id(s)] = outs[s._out_idx]
+    env[id(v)] = outs[v._out_idx]
+    return env[id(v)]

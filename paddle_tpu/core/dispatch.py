@@ -75,13 +75,14 @@ def apply(name: str, fwd: Callable, inputs: Sequence[Any], nout: int = 1,
         return result
     import time
     t0 = time.perf_counter()
+    result = None
     try:
         result = _apply_impl(name, fwd, inputs, nout, has_aux)
         if _cf_recorder is not None:
             _cf_recorder.note(inputs, result)
         return result
     finally:
-        hook(name, t0, time.perf_counter(), inputs)
+        hook(name, t0, time.perf_counter(), inputs, result)
 
 
 def _apply_impl(name: str, fwd: Callable, inputs: Sequence[Any],

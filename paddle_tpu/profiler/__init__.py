@@ -23,23 +23,62 @@ __all__ = ["Profiler", "RecordEvent", "ProfilerTarget", "profiler_guard",
 class _OpTracer:
     """Host-side per-op tracer fed by the dispatch hook (reference: the
     host tracer half of platform/profiler — op events with timestamps,
-    durations, call counts, and input signatures)."""
+    durations, call counts, and input signatures).
 
-    def __init__(self, record_shapes=False):
-        self.events = []          # (name, t0, t1, shapes)
+    profile_memory: framework-level allocation accounting (reference:
+    platform/profiler/mem_tracing.h) — each op's output bytes count as an
+    allocation, a weakref finalizer on the output Tensor records the free,
+    and (live, peak) counters produce the memory timeline."""
+
+    def __init__(self, record_shapes=False, profile_memory=False):
+        self.events = []          # (name, t0, t1, shapes, out_bytes)
         self.record_shapes = record_shapes
+        self.profile_memory = profile_memory
+        self.live_bytes = 0
+        self.peak_bytes = 0
+        self.mem_events = []      # (ts, live_bytes)
+        self.mem_table: dict = {}  # op -> total allocated bytes
         self._lock = threading.Lock()
 
-    def __call__(self, name, t0, t1, inputs):
+    def _on_free(self, nbytes):
+        with self._lock:
+            self.live_bytes -= nbytes
+            self.mem_events.append((time.perf_counter(), self.live_bytes))
+
+    def _note_outputs(self, name, result):
+        import weakref
+
+        import jax as _jax
+        out_bytes = 0
+        res = result if isinstance(result, (tuple, list)) else (result,)
+        for t in res:
+            arr = getattr(t, "_data", None)
+            if arr is None or isinstance(arr, _jax.core.Tracer):
+                continue
+            nb = int(getattr(arr, "nbytes", 0) or 0)
+            if nb and t is not None:
+                out_bytes += nb
+                weakref.finalize(t, self._on_free, nb)
+        with self._lock:
+            self.live_bytes += out_bytes
+            self.peak_bytes = max(self.peak_bytes, self.live_bytes)
+            self.mem_events.append((time.perf_counter(), self.live_bytes))
+            self.mem_table[name] = self.mem_table.get(name, 0) + out_bytes
+        return out_bytes
+
+    def __call__(self, name, t0, t1, inputs, result=None):
         shapes = None
         if self.record_shapes:
             shapes = [tuple(getattr(t, "shape", ())) for t in inputs]
+        out_bytes = 0
+        if self.profile_memory and result is not None:
+            out_bytes = self._note_outputs(name, result)
         with self._lock:
-            self.events.append((name, t0, t1, shapes))
+            self.events.append((name, t0, t1, shapes, out_bytes))
 
     def op_table(self):
         agg = {}
-        for name, t0, t1, _ in self.events:
+        for name, t0, t1, _, _ in self.events:
             total, count, mx = agg.get(name, (0.0, 0, 0.0))
             dt = t1 - t0
             agg[name] = (total + dt, count + 1, max(mx, dt))
@@ -99,7 +138,10 @@ class Profiler:
         self._running = False
         self._step_times = []
         self._last_step = None
-        self._op_tracer = _OpTracer(record_shapes=record_shapes)
+        self.profile_memory = profile_memory
+        self._step_device_mem = []   # per-step device memory_stats rows
+        self._op_tracer = _OpTracer(record_shapes=record_shapes,
+                                    profile_memory=profile_memory)
 
     def start(self):
         if not self.timer_only:
@@ -125,6 +167,22 @@ class Profiler:
         if self._last_step is not None:
             self._step_times.append(now - self._last_step)
         self._last_step = now
+        if self.profile_memory:
+            # device truth when the runtime exposes it (TPU HBM), else the
+            # host-side live/peak accounting stands alone
+            stats = None
+            try:
+                stats = jax.devices()[0].memory_stats()
+            except Exception:
+                pass
+            self._step_device_mem.append({
+                "ts": now,
+                "tracked_live_bytes": self._op_tracer.live_bytes,
+                "tracked_peak_bytes": self._op_tracer.peak_bytes,
+                "device_bytes_in_use": (stats or {}).get("bytes_in_use"),
+                "device_peak_bytes_in_use":
+                    (stats or {}).get("peak_bytes_in_use"),
+            })
 
     def step_info(self, unit=None):
         if not self._step_times:
@@ -148,6 +206,20 @@ class Profiler:
                     table.items(), key=lambda kv: -kv[1][0]):
                 lines.append(f"{name:28s} {count:7d} {total*1e3:10.2f} "
                              f"{total/count*1e3:9.3f} {mx*1e3:9.3f}")
+        if self.profile_memory:
+            t = self._op_tracer
+            lines.append("-- memory (reference: mem_tracing.h) --")
+            lines.append(f"tracked peak: {t.peak_bytes/2**20:.2f} MB  "
+                         f"live: {t.live_bytes/2**20:.2f} MB  "
+                         f"alloc events: {len(t.mem_events)}")
+            for name, b in sorted(t.mem_table.items(),
+                                  key=lambda kv: -kv[1])[:15]:
+                lines.append(f"{name:28s} allocated {b/2**20:10.3f} MB")
+            for row in self._step_device_mem[-3:]:
+                if row["device_peak_bytes_in_use"] is not None:
+                    lines.append(
+                        f"device peak bytes in use: "
+                        f"{row['device_peak_bytes_in_use']/2**20:.2f} MB")
         if RecordEvent._stats:
             lines.append("-- user scopes --")
             for name, (total, count) in sorted(RecordEvent._stats.items(),
@@ -169,13 +241,24 @@ class Profiler:
             os.makedirs(os.path.dirname(os.path.abspath(path)),
                         exist_ok=True)
             events = []
-            for name, t0, t1, shapes in self._op_tracer.events:
+            for name, t0, t1, shapes, out_bytes in self._op_tracer.events:
                 ev = {"name": name, "ph": "X", "pid": 0, "tid": 0,
                       "ts": t0 * 1e6, "dur": (t1 - t0) * 1e6,
                       "cat": "op"}
+                args = {}
                 if shapes:
-                    ev["args"] = {"input_shapes": [str(s) for s in shapes]}
+                    args["input_shapes"] = [str(s) for s in shapes]
+                if out_bytes:
+                    args["output_bytes"] = out_bytes
+                if args:
+                    ev["args"] = args
                 events.append(ev)
+            # memory counter track (reference: mem_tracing allocation
+            # events in the chrome trace)
+            for ts, live in self._op_tracer.mem_events:
+                events.append({"name": "memory", "ph": "C", "pid": 0,
+                               "ts": ts * 1e6, "cat": "memory",
+                               "args": {"live_bytes": int(live)}})
             with open(path, "w") as f:
                 json.dump({"traceEvents": events,
                            "displayTimeUnit": "ms"}, f)

@@ -348,3 +348,34 @@ def test_viterbi_decode_matches_bruteforce():
                 best, best_path = sc, seq
         np.testing.assert_allclose(float(score.numpy()[b]), best, rtol=1e-5)
         assert tuple(path.numpy()[b]) == best_path
+
+
+def test_profiler_memory_tracing(tmp_path):
+    """VERDICT r3 item 8: per-op allocation accounting + live/peak memory
+    rows in summary and chrome trace (reference: mem_tracing.h)."""
+    import gc
+
+    prof = paddle.profiler.Profiler(timer_only=True, profile_memory=True)
+    prof.start()
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .randn(256, 256).astype("float32"))
+    y = x @ x
+    z = (y * 2.0).sum()
+    del y
+    gc.collect()
+    prof.step()
+    prof.stop()
+    out = prof.summary()
+    assert "memory" in out and "tracked peak" in out
+    t = prof._op_tracer
+    assert t.peak_bytes >= 256 * 256 * 4  # at least the matmul output
+    assert t.mem_table.get("matmul", 0) >= 256 * 256 * 4
+    assert len(t.mem_events) >= 2
+    # the freed matmul output must have decremented live
+    assert t.live_bytes < t.peak_bytes
+    p = prof.export(path=str(tmp_path / "mt.json"), format="chrome")
+    d = paddle.profiler.load_profiler_result(p)
+    mem_rows = [e for e in d["traceEvents"] if e.get("cat") == "memory"]
+    assert mem_rows and "live_bytes" in mem_rows[0]["args"]
+    per_step = prof._step_device_mem
+    assert per_step and per_step[0]["tracked_peak_bytes"] > 0

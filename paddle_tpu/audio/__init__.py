@@ -5,6 +5,7 @@ datasets). Datasets that require downloads raise with instructions (zero
 egress here); feature layers and IO are fully functional.
 """
 from . import backends  # noqa: F401
+from . import datasets  # noqa: F401
 from . import features  # noqa: F401
 from . import functional  # noqa: F401
 from .backends import info, load, save  # noqa: F401

@@ -1,0 +1,139 @@
+"""paddle.audio.datasets — ESC50 / TESS audio-classification datasets.
+
+Reference: python/paddle/audio/datasets/{dataset,esc50,tess}.py. Zero
+egress here, so ``archive`` downloads raise with instructions; the loaders
+read the standard on-disk layouts (ESC-50-master/meta/esc50.csv + audio/,
+TESS 'OAF_word_emotion.wav' files), with the reference feat_type options
+computed by paddle_tpu.audio.features.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["AudioClassificationDataset", "ESC50", "TESS"]
+
+
+class AudioClassificationDataset(Dataset):
+    """Reference: audio/datasets/dataset.py AudioClassificationDataset —
+    (waveform-or-feature, label) pairs from (files, labels)."""
+
+    _FEATS = ("raw", "spectrogram", "melspectrogram", "logmelspectrogram",
+              "mfcc")
+
+    def __init__(self, files, labels, feat_type="raw", sample_rate=None,
+                 **feat_config):
+        super().__init__()
+        if feat_type not in self._FEATS:
+            raise RuntimeError(
+                f"Unknown feat_type: {feat_type}, it must be one of "
+                f"{list(self._FEATS)}")
+        self.files = list(files)
+        self.labels = list(labels)
+        self.feat_type = feat_type
+        self.sample_rate = sample_rate
+        self.feat_config = feat_config
+
+    def _featurize(self, waveform, sr):
+        import paddle_tpu as paddle
+        from . import features as feats
+        x = paddle.to_tensor(waveform[None].astype("float32"))
+        if self.feat_type == "raw":
+            return x[0]
+        cfg = dict(self.feat_config)
+        if self.feat_type == "spectrogram":
+            return feats.Spectrogram(**cfg)(x)[0]
+        if self.feat_type == "melspectrogram":
+            return feats.MelSpectrogram(sr=sr, **cfg)(x)[0]
+        if self.feat_type == "logmelspectrogram":
+            return feats.LogMelSpectrogram(sr=sr, **cfg)(x)[0]
+        return feats.MFCC(sr=sr, **cfg)(x)[0]
+
+    def __getitem__(self, idx):
+        from . import backends
+        wav, sr = backends.load(self.files[idx])
+        w = np.asarray(wav.numpy() if hasattr(wav, "numpy") else wav)
+        if w.ndim == 2:
+            w = w[0]
+        self.sample_rate = sr
+        feat = self._featurize(w, sr)
+        return np.asarray(feat.numpy()), np.int64(self.labels[idx])
+
+    def __len__(self):
+        return len(self.files)
+
+
+def _no_download(name, url):
+    raise RuntimeError(
+        f"{name}: automatic download is unavailable (no network egress); "
+        f"fetch {url} elsewhere and pass data_dir=<extracted dir>")
+
+
+class ESC50(AudioClassificationDataset):
+    """Reference: audio/datasets/esc50.py — 2000 recordings, 50 classes,
+    5 folds; mode='train' takes folds != split, 'dev' takes fold == split.
+    data_dir must hold ESC-50-master/ (meta/esc50.csv + audio/*.wav)."""
+
+    URL = "https://github.com/karoldvl/ESC-50/archive/master.zip"
+    sample_rate = 44100
+    duration = 5
+
+    def __init__(self, mode="train", split=1, feat_type="raw",
+                 data_dir=None, archive=None, **kwargs):
+        if data_dir is None:
+            _no_download("ESC50", self.URL)
+        root = data_dir
+        if os.path.isdir(os.path.join(data_dir, "ESC-50-master")):
+            root = os.path.join(data_dir, "ESC-50-master")
+        meta = os.path.join(root, "meta", "esc50.csv")
+        audio_dir = os.path.join(root, "audio")
+        files, labels = [], []
+        with open(meta) as f:
+            rows = f.read().splitlines()[1:]  # header row
+        for row in rows:
+            filename, fold, target = row.split(",")[:3]
+            fold, target = int(fold), int(target)
+            keep = (fold != split) if mode == "train" else (fold == split)
+            if keep:
+                files.append(os.path.join(audio_dir, filename))
+                labels.append(target)
+        super().__init__(files, labels, feat_type=feat_type, **kwargs)
+
+
+class TESS(AudioClassificationDataset):
+    """Reference: audio/datasets/tess.py — Toronto emotional speech set:
+    2800 files '(OAF|YAF)_word_emotion.wav', 7 emotion classes; n_folds
+    cross-validation split like the reference."""
+
+    URL = ("https://tspace.library.utoronto.ca/bitstream/1807/24487/1/"
+           "TESS_Toronto_emotional_speech_set_data.zip")
+    label_list = ["angry", "disgust", "fear", "happy", "neutral", "ps",
+                  "sad"]
+
+    def __init__(self, mode="train", n_folds=5, split=1, feat_type="raw",
+                 data_dir=None, archive=None, **kwargs):
+        if data_dir is None:
+            _no_download("TESS", self.URL)
+        if not 1 <= split <= n_folds:
+            raise ValueError(f"split {split} out of 1..{n_folds}")
+        wavs = []
+        for dirpath, _, names in os.walk(data_dir):
+            for n in sorted(names):
+                if n.lower().endswith(".wav"):
+                    wavs.append(os.path.join(dirpath, n))
+        wavs.sort()
+        files, labels = [], []
+        for i, path in enumerate(wavs):
+            emotion = os.path.splitext(os.path.basename(path))[0] \
+                .split("_")[-1].lower()
+            if emotion not in self.label_list:
+                continue
+            fold = i % n_folds + 1
+            keep = (fold != split) if mode == "train" else (fold == split)
+            if keep:
+                files.append(path)
+                labels.append(self.label_list.index(emotion))
+        super().__init__(files, labels, feat_type=feat_type, **kwargs)

@@ -87,22 +87,27 @@ class DataParallel(Layer):
 
     @contextlib.contextmanager
     def no_sync(self):
-        """Reference semantics (DataParallel.no_sync): skip grad sync during
-        micro-batch accumulation. On the single-controller mesh the sync is
-        a psum GSPMD fuses into the compiled backward, and because the
-        all-reduce is linear, accumulating synced grads equals syncing
-        accumulated grads — numerically identical, so skipping it is purely
-        a (here unavailable) perf knob. Warn once so users know the
-        difference from the reference is performance, not math."""
-        import warnings
-        if not getattr(self, "_warned_no_sync", False):
-            warnings.warn(
-                "DataParallel.no_sync is a numerical no-op on the "
-                "single-controller TPU mesh: gradient sync is compiled into "
-                "the backward (and all-reduce is linear, so accumulation "
-                "math is unchanged).", stacklevel=2)
-            self._warned_no_sync = True
-        yield
+        """Reference semantics (DataParallel.no_sync, parallel.py:202):
+        skip grad sync during micro-batch accumulation, sync once at the
+        boundary step.
+
+        TPU-native: separate per-microbatch backwards each carry their own
+        gradient all-reduce (XLA does not reassociate sum-of-psums), but
+        because all-reduce is linear the result is numerically identical
+        to the reference's skip-then-sync — this context marks the
+        accumulation region so the contract is explicit. The pattern that
+        ACTUALLY eliminates the extra syncs on TPU is micro-batching
+        inside one backward — ``paddle.static.nn.scan_loop`` over
+        microbatches in the loss (one reduce per parameter total, HLO-
+        verified by tests/test_sharding_hlo.py::
+        test_grad_accumulation_adds_no_extra_sync) or
+        ``fleet.CompiledPipelineParallel``'s built-in micro-batching."""
+        prev = getattr(self, "_in_no_sync", False)
+        self._in_no_sync = True
+        try:
+            yield
+        finally:
+            self._in_no_sync = prev
 
     def state_dict(self, *args, **kwargs):
         return self._layers.state_dict(*args, **kwargs)

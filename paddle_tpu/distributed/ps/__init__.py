@@ -20,7 +20,7 @@ import numpy as np
 from .. import rpc
 
 __all__ = ["SparseTable", "PSServer", "PSClient", "start_server",
-           "shard_for"]
+           "shard_for", "GeoCommunicator"]
 
 _tables: dict = {}
 
@@ -177,3 +177,78 @@ class PSClient:
             self._dims[name] = rpc.rpc_sync(self.servers[0], _srv_state,
                                             args=(name,))["dim"]
         return self._dims[name]
+
+
+def _srv_apply_delta(name, ids, deltas):
+    """GeoSGD server op: param += delta (reference: the GEO mode of
+    ps/service/communicator — servers merge worker deltas instead of
+    applying gradients)."""
+    t = _tables[name]
+    deltas = np.asarray(deltas, np.float32)
+    for rid, d in zip(ids, deltas):
+        t._row(int(rid))
+        t.rows[int(rid)] = t.rows[int(rid)] + d
+    return True
+
+
+class GeoCommunicator:
+    """GeoSGD communicator (reference: fluid/distributed/ps/service/
+    communicator/communicator.h GeoCommunicator + fleet DistributedStrategy
+    a_sync_configs['geo_sgd_need_push_nums']).
+
+    Workers train on a LOCAL replica of the touched sparse rows; every
+    ``push_nums`` steps the accumulated delta (local - base) is pushed to
+    the servers (merged additively, so concurrent workers compose) and the
+    fresh global rows are pulled back. Between syncs there is zero
+    communication — the Geo tradeoff.
+    """
+
+    def __init__(self, client: PSClient, table: str, push_nums=4):
+        self.client = client
+        self.table = table
+        self.push_nums = int(push_nums)
+        self._local: dict = {}    # rid -> local np row
+        self._base: dict = {}     # rid -> value at last sync
+        self._step = 0
+
+    def pull(self, ids):
+        """Rows for this batch: local replica where trained, server rows
+        (cached as the new base) otherwise."""
+        ids = np.asarray(ids, np.int64)
+        missing = [int(i) for i in ids if int(i) not in self._local]
+        if missing:
+            fresh = self.client.pull(self.table, np.asarray(missing))
+            for rid, row in zip(missing, fresh):
+                self._local[rid] = row.copy()
+                self._base[rid] = row.copy()
+        return np.stack([self._local[int(i)] for i in ids])
+
+    def push_grad(self, ids, grads, lr=0.1):
+        """Local SGD update only — no communication until the Geo sync."""
+        ids = np.asarray(ids, np.int64)
+        grads = np.asarray(grads, np.float32)
+        for rid, g in zip(ids, grads):
+            rid = int(rid)
+            self._local[rid] = self._local[rid] - lr * g
+        self._step += 1
+        if self._step % self.push_nums == 0:
+            self.sync()
+
+    def sync(self):
+        """Push accumulated deltas, refresh the local replica."""
+        if not self._local:
+            return
+        ids = sorted(self._local)
+        deltas = np.stack([self._local[r] - self._base[r] for r in ids])
+        owner = np.asarray(shard_for(np.asarray(ids, np.int64),
+                                     len(self.client.servers)))
+        for k, s in enumerate(self.client.servers):
+            mask = owner == k
+            if mask.any():
+                sel = [ids[i] for i in np.nonzero(mask)[0]]
+                rpc.rpc_sync(s, _srv_apply_delta,
+                             args=(self.table, sel, deltas[mask]))
+        fresh = self.client.pull(self.table, np.asarray(ids, np.int64))
+        for rid, row in zip(ids, fresh):
+            self._local[rid] = row.copy()
+            self._base[rid] = row.copy()

@@ -204,6 +204,17 @@ class _ControlFlowTransformer(ast.NodeTransformer):
             args=[node.test, ast.Constant(value=esc)], keywords=[])
         return node
 
+    def _guard_unbound(self, names):
+        """Names assigned inside a converted block may be unbound before
+        it (reference: UndefinedVar) — bind them to the MISSING sentinel
+        so the generated functions can reference them."""
+        stmts = []
+        for n in names:
+            src = (f"try:\n    {n}\nexcept (NameError, "
+                   f"UnboundLocalError):\n    {n} = __pd_MISSING")
+            stmts.append(ast.parse(src).body[0])
+        return stmts
+
     def _branch_fn(self, fn_name, body, out_names):
         ret = ast.Return(value=ast.Tuple(
             elts=[_name(n, ast.Load()) for n in out_names],
@@ -229,7 +240,7 @@ class _ControlFlowTransformer(ast.NodeTransformer):
             if n != "_" and not n.startswith("__pd_"))
         true_name = self._fresh("true")
         false_name = self._fresh("false")
-        stmts = [
+        stmts = self._guard_unbound(out_names) + [
             self._branch_fn(true_name, node.body, out_names),
             self._branch_fn(false_name, node.orelse or [ast.Pass()],
                             out_names),
@@ -283,8 +294,8 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         target = ast.List(
             elts=[_name(n, ast.Store()) for n in all_names],
             ctx=ast.Store())
-        return pre_stmts + [cond_fn, body_fn,
-                            ast.Assign(targets=[target], value=call)]
+        return pre_stmts + self._guard_unbound(loop_names) + \
+            [cond_fn, body_fn, ast.Assign(targets=[target], value=call)]
 
     def visit_While(self, node):
         node = self.generic_visit(node)
@@ -438,6 +449,7 @@ def convert_to_static(fn):
     glb["__pd_logical_and"] = convert_logical_and
     glb["__pd_logical_or"] = convert_logical_or
     glb["__pd_logical_not"] = convert_logical_not
+    glb["__pd_MISSING"] = _MISSING
     # closures: rebuild the cell environment as globals (the rewritten
     # function is exec'd at module scope, reference precedent:
     # dy2static/utils.py func_to_source_code + ast_to_func)

@@ -1,18 +1,14 @@
-"""paddle_tpu.onnx (reference: python/paddle/onnx — delegates to paddle2onnx).
+"""paddle_tpu.onnx (reference: python/paddle/onnx — paddle2onnx export).
 
-The TPU-native deployment format is serialized StableHLO (paddle_tpu.jit.save
-via jax.export), which every XLA runtime consumes directly; ONNX export would
-require the external paddle2onnx-equivalent converter, which is unavailable
-in this environment.
+``export`` emits genuine ONNX ModelProto bytes (hand-written wire format,
+opset 13) for Sequential MLP/CNN models — see export.py for the supported
+layer set. The TPU-native deployment format remains serialized StableHLO
+(paddle_tpu.jit.save via jax.export), which every XLA runtime consumes
+directly; use it for arbitrary models.
 """
 from __future__ import annotations
 
-__all__ = ["export"]
+from . import proto  # noqa: F401
+from .export import export  # noqa: F401
 
-
-def export(layer, path, input_spec=None, opset_version=9, **configs):
-    raise NotImplementedError(
-        "ONNX export is not available (no converter in this environment). "
-        "Use paddle_tpu.jit.save(layer, path, input_spec=...) — it emits a "
-        "portable serialized-StableHLO artifact, the TPU-native deployment "
-        "format.")
+__all__ = ["export", "proto"]

@@ -1,0 +1,174 @@
+"""Structural ONNX exporter for sequential models.
+
+Reference: python/paddle/onnx/export.py (paddle.onnx.export via
+paddle2onnx). TPU-native context: the native deployment format remains
+serialized StableHLO (paddle_tpu.jit.save); this exporter emits genuine
+ONNX ModelProto bytes (opset 13) for the classic deployment shapes — MLP /
+CNN classifiers expressed as ``nn.Sequential`` chains (Linear, Conv2D,
+BatchNorm2D, LayerNorm, activations, pooling, Flatten, Dropout) — with
+weights as initializers. Models with bespoke forward() logic should export
+through jit.save, or be re-expressed as a Sequential for ONNX.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import proto
+
+__all__ = ["export"]
+
+_ACTS = {"ReLU": "Relu", "Sigmoid": "Sigmoid", "Tanh": "Tanh",
+         "Silu": "Silu", "Softplus": "Softplus", "Softsign": "Softsign",
+         "ELU": "Elu"}
+
+
+class _Emitter:
+    def __init__(self):
+        self.nodes = []
+        self.inits = []
+        self.count = 0
+
+    def name(self, base):
+        self.count += 1
+        return f"{base}_{self.count}"
+
+    def add_init(self, base, arr):
+        arr = np.ascontiguousarray(np.asarray(arr, np.float32))
+        nm = self.name(base)
+        self.inits.append(proto.tensor_proto(nm, arr.shape, proto.FLOAT,
+                                             arr.tobytes()))
+        return nm
+
+    def emit(self, op, inputs, attrs=()):
+        out = self.name(op.lower())
+        self.nodes.append(proto.node(op, inputs, [out],
+                                     name=self.name(op), attrs=attrs))
+        return out
+
+
+def _pair(v):
+    return list(v) if isinstance(v, (list, tuple)) else [int(v), int(v)]
+
+
+def _emit_layer(em, layer, cur):
+    from .. import nn
+    kind = type(layer).__name__
+    w = getattr(layer, "weight", None)
+    b = getattr(layer, "bias", None)
+    if isinstance(layer, nn.Linear):
+        # paddle keeps W as [in, out]: Gemm with transB=0
+        wn = em.add_init("weight", w._data)
+        ins = [cur, wn]
+        attrs = [proto.attribute("transB", i=0)]
+        if b is not None:
+            ins.append(em.add_init("bias", b._data))
+        return em.emit("Gemm", ins, attrs)
+    if isinstance(layer, nn.Conv2D):
+        wn = em.add_init("weight", w._data)
+        ins = [cur, wn]
+        if b is not None:
+            ins.append(em.add_init("bias", b._data))
+        pad = layer._padding
+        pads = _pair(pad) * 2 if not isinstance(pad, (list, tuple)) or \
+            len(_pair(pad)) == 2 else list(pad)
+        attrs = [proto.attribute("strides", ints=_pair(layer._stride)),
+                 proto.attribute("pads", ints=pads),
+                 proto.attribute("dilations", ints=_pair(layer._dilation)),
+                 proto.attribute("group", i=layer._groups)]
+        return em.emit("Conv", ins, attrs)
+    if kind in ("BatchNorm", "BatchNorm1D", "BatchNorm2D", "BatchNorm3D"):
+        ins = [cur,
+               em.add_init("gamma", layer.weight._data),
+               em.add_init("beta", layer.bias._data),
+               em.add_init("mean", layer._mean._data),
+               em.add_init("var", layer._variance._data)]
+        return em.emit("BatchNormalization", ins,
+                       [proto.attribute("epsilon",
+                                        f=float(layer._epsilon))])
+    if kind == "LayerNorm":
+        ins = [cur, em.add_init("gamma", layer.weight._data)]
+        if layer.bias is not None:
+            ins.append(em.add_init("beta", layer.bias._data))
+        return em.emit("LayerNormalization", ins,
+                       [proto.attribute("epsilon",
+                                        f=float(layer._epsilon))])
+    if kind in _ACTS:
+        return em.emit(_ACTS[kind], [cur])
+    if kind == "GELU":
+        return em.emit("Gelu", [cur])
+    if kind == "LeakyReLU":
+        return em.emit("LeakyRelu", [cur],
+                       [proto.attribute("alpha",
+                                        f=float(layer._negative_slope))])
+    if kind == "ReLU6":
+        return em.emit("Clip", [cur, em.add_init("min", np.float32(0)),
+                                em.add_init("max", np.float32(6))])
+    if kind == "Softmax":
+        return em.emit("Softmax", [cur],
+                       [proto.attribute("axis",
+                                        i=getattr(layer, "axis", -1))])
+    if kind == "MaxPool2D":
+        return em.emit("MaxPool", [cur], [
+            proto.attribute("kernel_shape", ints=_pair(layer.ksize)),
+            proto.attribute("strides",
+                            ints=_pair(layer.stride or layer.ksize)),
+            proto.attribute("pads", ints=_pair(layer.padding) * 2
+                            if len(_pair(layer.padding)) == 2
+                            else list(layer.padding)),
+            proto.attribute("ceil_mode", i=int(layer.ceil_mode))])
+    if kind == "AvgPool2D":
+        return em.emit("AveragePool", [cur], [
+            proto.attribute("kernel_shape", ints=_pair(layer.ksize)),
+            proto.attribute("strides",
+                            ints=_pair(layer.stride or layer.ksize)),
+            proto.attribute("pads", ints=_pair(layer.padding) * 2
+                            if len(_pair(layer.padding)) == 2
+                            else list(layer.padding)),
+            proto.attribute("ceil_mode", i=int(layer.ceil_mode))])
+    if kind == "AdaptiveAvgPool2D":
+        out_sz = layer.output_size
+        out_sz = _pair(out_sz)
+        if out_sz != [1, 1]:
+            raise NotImplementedError(
+                "ONNX export supports AdaptiveAvgPool2D(1) "
+                "(GlobalAveragePool) only")
+        return em.emit("GlobalAveragePool", [cur])
+    if kind == "Flatten":
+        return em.emit("Flatten", [cur], [proto.attribute(
+            "axis", i=getattr(layer, "start_axis", 1))])
+    if kind in ("Dropout", "Dropout2D", "Dropout3D", "AlphaDropout",
+                "Identity"):
+        return cur  # inference graph
+    if kind in ("Sequential", "LayerList"):
+        for sub in layer:
+            cur = _emit_layer(em, sub, cur)
+        return cur
+    raise NotImplementedError(
+        f"ONNX export does not support layer type {kind}; supported: "
+        "Sequential chains of Linear/Conv2D/BatchNorm*/LayerNorm/"
+        "activations/pooling/Flatten/Dropout. Use paddle_tpu.jit.save "
+        "(StableHLO) for arbitrary models.")
+
+
+def export(layer, path, input_spec=None, opset_version=13, **configs):
+    """Reference: paddle.onnx.export(layer, path, input_spec) — writes
+    ``path + '.onnx'``. input_spec: one InputSpec/shape for the single
+    graph input (None dims = dynamic batch)."""
+    if input_spec is None:
+        raise ValueError("paddle.onnx.export requires input_spec")
+    spec = input_spec[0] if isinstance(input_spec, (list, tuple)) \
+        else input_spec
+    shape = list(spec.shape) if hasattr(spec, "shape") else list(spec)
+
+    em = _Emitter()
+    out_name = _emit_layer(em, layer, "input")
+    # rename the graph output for a stable interface
+    g_inputs = [proto.value_info("input", proto.FLOAT, shape)]
+    g_outputs = [proto.value_info(out_name, proto.FLOAT, [None])]
+    g = proto.graph(em.nodes, "paddle_tpu_graph", em.inits, g_inputs,
+                    g_outputs)
+    blob = proto.model(g, opset=opset_version)
+    out_path = path if str(path).endswith(".onnx") else str(path) + ".onnx"
+    with open(out_path, "wb") as f:
+        f.write(blob)
+    return out_path

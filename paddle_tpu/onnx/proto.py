@@ -1,0 +1,277 @@
+"""Minimal protobuf wire-format writer/reader for ONNX messages.
+
+Reference capability: python/paddle/onnx/export.py (paddle2onnx emits
+ONNX ModelProto). No onnx/protobuf package exists in this environment, so
+the wire format (varint tags + length-delimited submessages — the stable
+protobuf encoding) is written directly against onnx.proto3's field
+numbers. The reader covers the same subset for round-trip verification.
+"""
+from __future__ import annotations
+
+import struct
+
+# onnx.proto3 field numbers (stable public schema)
+# ModelProto: ir_version=1 producer_name=2 graph=7 opset_import=8
+# GraphProto: node=1 name=2 initializer=5 input=11 output=12
+# NodeProto: input=1 output=2 name=3 op_type=4 attribute=5
+# AttributeProto: name=1 f=2 i=3 s=4 t=5 floats=7 ints=8 type=20
+# TensorProto: dims=1 data_type=2 name=8 raw_data=9
+# ValueInfoProto: name=1 type=2 ; TypeProto: tensor_type=1
+# TypeProto.Tensor: elem_type=1 shape=2
+# TensorShapeProto: dim=1 ; Dimension: dim_value=1 dim_param=2
+
+FLOAT, INT64 = 1, 7          # TensorProto.DataType
+ATTR_FLOAT, ATTR_INT, ATTR_STRING, ATTR_TENSOR = 1, 2, 3, 4
+ATTR_FLOATS, ATTR_INTS = 6, 7
+
+
+def _varint(n: int) -> bytes:
+    if n < 0:
+        n += 1 << 64
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def field_varint(num: int, value: int) -> bytes:
+    return _varint(num << 3) + _varint(int(value))
+
+
+def field_bytes(num: int, payload: bytes) -> bytes:
+    return _varint((num << 3) | 2) + _varint(len(payload)) + payload
+
+
+def field_string(num: int, s: str) -> bytes:
+    return field_bytes(num, s.encode())
+
+
+def tensor_proto(name, dims, data_type, raw: bytes) -> bytes:
+    out = b""
+    for d in dims:
+        out += field_varint(1, d)
+    out += field_varint(2, data_type)
+    out += field_string(8, name)
+    out += field_bytes(9, raw)
+    return out
+
+
+def attribute(name, *, i=None, f=None, s=None, ints=None, floats=None,
+              t=None) -> bytes:
+    out = field_string(1, name)
+    if i is not None:
+        out += field_varint(3, i) + field_varint(20, ATTR_INT)
+    elif f is not None:
+        out += _varint((2 << 3) | 5) + struct.pack("<f", f)
+        out += field_varint(20, ATTR_FLOAT)
+    elif s is not None:
+        out += field_bytes(4, s.encode()) + field_varint(20, ATTR_STRING)
+    elif ints is not None:
+        for v in ints:
+            out += field_varint(8, v)
+        out += field_varint(20, ATTR_INTS)
+    elif floats is not None:
+        for v in floats:
+            out += _varint((7 << 3) | 5) + struct.pack("<f", v)
+        out += field_varint(20, ATTR_FLOATS)
+    elif t is not None:
+        out += field_bytes(5, t) + field_varint(20, ATTR_TENSOR)
+    return out
+
+
+def node(op_type, inputs, outputs, name="", attrs=()) -> bytes:
+    out = b""
+    for x in inputs:
+        out += field_string(1, x)
+    for x in outputs:
+        out += field_string(2, x)
+    if name:
+        out += field_string(3, name)
+    out += field_string(4, op_type)
+    for a in attrs:
+        out += field_bytes(5, a)
+    return out
+
+
+def value_info(name, elem_type, shape) -> bytes:
+    dims = b""
+    for d in shape:
+        if d is None:
+            dims += field_bytes(1, field_string(2, "batch"))
+        else:
+            dims += field_bytes(1, field_varint(1, d))
+    ttype = field_varint(1, elem_type) + field_bytes(2, dims)
+    return field_string(1, name) + field_bytes(2, field_bytes(1, ttype))
+
+
+def graph(nodes, name, initializers, inputs, outputs) -> bytes:
+    out = b""
+    for n in nodes:
+        out += field_bytes(1, n)
+    out += field_string(2, name)
+    for t in initializers:
+        out += field_bytes(5, t)
+    for v in inputs:
+        out += field_bytes(11, v)
+    for v in outputs:
+        out += field_bytes(12, v)
+    return out
+
+
+def model(graph_bytes, opset=13, producer="paddle_tpu") -> bytes:
+    opset_b = field_string(1, "") + field_varint(2, opset)
+    return (field_varint(1, 8)              # ir_version 8
+            + field_string(2, producer)
+            + field_bytes(7, graph_bytes)
+            + field_bytes(8, opset_b))
+
+
+# ---------------- reader (round-trip verification) ----------------
+
+def _read_varint(buf, pos):
+    out = shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        out |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return out, pos
+        shift += 7
+
+
+def parse_fields(buf):
+    """Yield (field_number, wire_type, value) over a message buffer."""
+    pos, end = 0, len(buf)
+    while pos < end:
+        tag, pos = _read_varint(buf, pos)
+        num, wt = tag >> 3, tag & 7
+        if wt == 0:
+            val, pos = _read_varint(buf, pos)
+        elif wt == 2:
+            ln, pos = _read_varint(buf, pos)
+            val = buf[pos:pos + ln]
+            pos += ln
+        elif wt == 5:
+            val = buf[pos:pos + 4]
+            pos += 4
+        elif wt == 1:
+            val = buf[pos:pos + 8]
+            pos += 8
+        else:
+            raise ValueError(f"unsupported wire type {wt}")
+        yield num, wt, val
+
+
+def parse_model(buf):
+    """Decode the subset written above into plain dicts."""
+    m = {"opset": None, "producer": None, "graph": None}
+    for num, _, val in parse_fields(buf):
+        if num == 2:
+            m["producer"] = val.decode()
+        elif num == 7:
+            m["graph"] = _parse_graph(val)
+        elif num == 8:
+            for n2, _, v2 in parse_fields(val):
+                if n2 == 2:
+                    m["opset"] = v2
+    return m
+
+
+def _parse_graph(buf):
+    g = {"name": None, "nodes": [], "initializers": [], "inputs": [],
+         "outputs": []}
+    for num, _, val in parse_fields(buf):
+        if num == 1:
+            g["nodes"].append(_parse_node(val))
+        elif num == 2:
+            g["name"] = val.decode()
+        elif num == 5:
+            g["initializers"].append(_parse_tensor(val))
+        elif num == 11:
+            g["inputs"].append(_parse_value_info(val))
+        elif num == 12:
+            g["outputs"].append(_parse_value_info(val))
+    return g
+
+
+def _parse_node(buf):
+    n = {"op_type": None, "name": "", "inputs": [], "outputs": [],
+         "attrs": {}}
+    for num, _, val in parse_fields(buf):
+        if num == 1:
+            n["inputs"].append(val.decode())
+        elif num == 2:
+            n["outputs"].append(val.decode())
+        elif num == 3:
+            n["name"] = val.decode()
+        elif num == 4:
+            n["op_type"] = val.decode()
+        elif num == 5:
+            a = _parse_attr(val)
+            n["attrs"][a[0]] = a[1]
+    return n
+
+
+def _parse_attr(buf):
+    name, ints, floats, value = None, [], [], None
+    for num, wt, val in parse_fields(buf):
+        if num == 1:
+            name = val.decode()
+        elif num == 3:
+            value = val
+        elif num == 2:
+            value = struct.unpack("<f", val)[0]
+        elif num == 4:
+            value = val.decode()
+        elif num == 8:
+            ints.append(val)
+        elif num == 7:
+            floats.append(struct.unpack("<f", val)[0])
+    if ints:
+        value = ints
+    elif floats:
+        value = floats
+    return name, value
+
+
+def _parse_tensor(buf):
+    import numpy as np
+    t = {"name": None, "dims": [], "data_type": None, "array": None}
+    raw = b""
+    for num, _, val in parse_fields(buf):
+        if num == 1:
+            t["dims"].append(val)
+        elif num == 2:
+            t["data_type"] = val
+        elif num == 8:
+            t["name"] = val.decode()
+        elif num == 9:
+            raw = val
+    dt = np.float32 if t["data_type"] == FLOAT else np.int64
+    t["array"] = np.frombuffer(raw, dt).reshape(t["dims"])
+    return t
+
+
+def _parse_value_info(buf):
+    v = {"name": None, "shape": []}
+    for num, _, val in parse_fields(buf):
+        if num == 1:
+            v["name"] = val.decode()
+        elif num == 2:
+            for n2, _, v2 in parse_fields(val):
+                if n2 == 1:  # tensor_type
+                    for n3, _, v3 in parse_fields(v2):
+                        if n3 == 2:  # shape
+                            for n4, _, v4 in parse_fields(v3):
+                                if n4 == 1:  # dim
+                                    dim = None
+                                    for n5, _, v5 in parse_fields(v4):
+                                        if n5 == 1:
+                                            dim = v5
+                                    v["shape"].append(dim)
+    return v

@@ -136,3 +136,14 @@ class Conv3D(SubmConv3D):
     Conv3D). Simplification: computes at input active sites only (the
     submanifold pattern) — dilation of the active set is not modeled; use
     dense nn.Conv3D when full dilation semantics are required."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        import warnings
+        warnings.warn(
+            "paddle_tpu.sparse.nn.Conv3D computes outputs at INPUT active "
+            "sites only (submanifold semantics): the reference Conv3D "
+            "dilates the active set by the kernel footprint. Results "
+            "differ wherever dilation would activate new sites — use "
+            "dense nn.Conv3D for exact reference semantics.",
+            stacklevel=2)

@@ -106,23 +106,159 @@ class Imdb(Dataset):
 
 
 class Conll05st(Dataset):
-    """CoNLL-2005 SRL (reference: text/datasets/conll05.py). Requires the
-    licensed data locally; loads the reference's propbank-format test split
-    (wordsfile/propsfile: parallel whitespace-tokenized files)."""
+    """CoNLL-2005 SRL test split (reference: text/datasets/conll05.py).
+
+    data_file: the conll05st-tests.tar.gz archive (words/props .gz members)
+    or a directory holding ``test.wsj.words``/``test.wsj.props`` text files.
+    Each sample is the reference 9-tuple:
+    (word_idx, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2, pred_idx, mark,
+    label_idx) — one sample per (sentence, predicate) pair, labels
+    bracket-decoded to B-/I-/O tags.
+    """
 
     URL = "http://www.cs.upc.edu/~srlconll/conll05st-tests.tar.gz"
+    UNK_IDX = 0
+    _WORDS = "conll05st-release/test.wsj/words/test.wsj.words.gz"
+    _PROPS = "conll05st-release/test.wsj/props/test.wsj.props.gz"
 
     def __init__(self, data_file=None, word_dict_file=None,
-                 verb_dict_file=None, target_dict_file=None, download=False):
-        if data_file is None:
+                 verb_dict_file=None, target_dict_file=None, emb_file=None,
+                 download=False):
+        if data_file is None or word_dict_file is None or \
+                verb_dict_file is None or target_dict_file is None:
             _no_download("Conll05st", self.URL)
-        raise NotImplementedError(
-            "Conll05st parsing of the licensed archive is not implemented; "
-            "the reference's preprocessed format requires the original "
-            "CoNLL-05 distribution")
+        self.word_dict = self._read_dict(word_dict_file)
+        self.predicate_dict = self._read_dict(verb_dict_file)
+        self.label_dict = self._read_label_dict(target_dict_file)
+        self._emb_file = emb_file
+        words, props = self._read_streams(data_file)
+        self.sentences, self.predicates, self.labels = \
+            self._expand(words, props)
+
+    # -- file plumbing --
+    @staticmethod
+    def _read_dict(path):
+        with open(path) as f:
+            return {line.strip(): i for i, line in enumerate(f)}
+
+    @staticmethod
+    def _read_label_dict(path):
+        """Tags listed as B-/I- lines; index pairs per tag, 'O' last
+        (reference semantics: _load_label_dict)."""
+        tags = set()
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line[:2] in ("B-", "I-"):
+                    tags.add(line[2:])
+        d = {}
+        for tag in tags:           # reference iterates the set directly
+            d["B-" + tag] = len(d)
+            d["I-" + tag] = len(d)
+        d["O"] = len(d)
+        return d
+
+    def _read_streams(self, data_file):
+        import gzip
+        import io
+        if os.path.isdir(data_file):
+            wp = os.path.join(data_file, "test.wsj.words")
+            pp = os.path.join(data_file, "test.wsj.props")
+            return (open(wp).read().splitlines(),
+                    open(pp).read().splitlines())
+        with tarfile.open(data_file) as tf:
+            wz = gzip.decompress(tf.extractfile(self._WORDS).read())
+            pz = gzip.decompress(tf.extractfile(self._PROPS).read())
+        return (io.StringIO(wz.decode()).read().splitlines(),
+                io.StringIO(pz.decode()).read().splitlines())
+
+    # -- propbank bracket decoding --
+    @staticmethod
+    def _decode_props(col):
+        """One predicate column of '(A0*', '*', '*)' chunks -> BIO tags."""
+        seq, tag, inside = [], "O", False
+        for tok in col:
+            if tok == "*":
+                seq.append("I-" + tag if inside else "O")
+            elif tok == "*)":
+                seq.append("I-" + tag)
+                inside = False
+            elif "(" in tok:
+                tag = tok[1:tok.index("*")]
+                seq.append("B-" + tag)
+                inside = ")" not in tok
+            else:
+                raise ValueError(f"unexpected props token {tok!r}")
+        return seq
+
+    def _expand(self, word_lines, prop_lines):
+        sentences, predicates, labels = [], [], []
+        sent, cols = [], []
+
+        def flush():
+            if not cols:
+                return
+            verbs = [v for v in (r[0] for r in cols) if v != "-"]
+            n_pred = len(cols[0]) - 1
+            for k in range(n_pred):
+                col = [r[k + 1] for r in cols]
+                sentences.append(list(sent))
+                predicates.append(verbs[k])
+                labels.append(self._decode_props(col))
+            sent.clear()
+            cols.clear()
+
+        for wline, pline in zip(word_lines, prop_lines):
+            w = wline.strip()
+            parts = pline.strip().split()
+            if not parts:              # sentence boundary
+                flush()
+                continue
+            sent.append(w)
+            cols.append(parts)
+        flush()                        # EOF without trailing blank line
+        return sentences, predicates, labels
+
+    def get_dict(self):
+        """Reference API: (word_dict, verb_dict, label_dict)."""
+        return self.word_dict, self.predicate_dict, self.label_dict
+
+    def get_embedding(self):
+        if self._emb_file is None:
+            _no_download("Conll05st embedding", self.URL)
+        return np.loadtxt(self._emb_file, dtype=np.float32)
+
+    def __getitem__(self, idx):
+        sent = self.sentences[idx]
+        labels = self.labels[idx]
+        n = len(sent)
+        v = labels.index("B-V")
+        mark = np.zeros(n, np.int64)
+        ctx = {}
+        for off, name, fallback in [(-2, "ctx_n2", "bos"),
+                                    (-1, "ctx_n1", "bos"),
+                                    (0, "ctx_0", None),
+                                    (1, "ctx_p1", "eos"),
+                                    (2, "ctx_p2", "eos")]:
+            j = v + off
+            if 0 <= j < n:
+                ctx[name] = sent[j]
+                mark[j] = 1
+            else:
+                ctx[name] = fallback
+        wd = self.word_dict
+        word_idx = np.array([wd.get(w, self.UNK_IDX) for w in sent])
+        rows = [word_idx]
+        for name in ("ctx_n2", "ctx_n1", "ctx_0", "ctx_p1", "ctx_p2"):
+            rows.append(np.full(n, wd.get(ctx[name], self.UNK_IDX)))
+        rows.append(np.full(n, self.predicate_dict.get(
+            self.predicates[idx], 0)))
+        rows.append(mark)
+        rows.append(np.array([self.label_dict[t] for t in labels]))
+        return tuple(rows)
 
     def __len__(self):
-        return 0
+        return len(self.sentences)
 
 
 class Movielens(Dataset):

@@ -525,3 +525,86 @@ def test_inplace_variants_semantics():
     import pytest as _pt
     with _pt.raises(RuntimeError, match="leaf"):
         leaf.exp_()
+
+
+def test_audio_datasets_esc50_tess_local(tmp_path):
+    """Reference: audio/datasets/{esc50,tess}.py — local archive layouts,
+    fold splits, feat_type pipeline."""
+    sr = 8000
+    t = np.arange(sr // 4, dtype=np.float32) / sr
+
+    def wav(path, freq):
+        sig = np.sin(2 * np.pi * freq * t).astype("float32")
+        paddle.audio.save(str(path), paddle.to_tensor(sig[None]), sr)
+
+    # ESC-50 layout
+    root = tmp_path / "ESC-50-master"
+    (root / "meta").mkdir(parents=True)
+    (root / "audio").mkdir()
+    rows = ["filename,fold,target,category,esc10,src_file,take"]
+    for i in range(10):
+        name = f"1-{i}-A-{i % 3}.wav"
+        fold = i % 5 + 1
+        rows.append(f"{name},{fold},{i % 3},x,False,{i},A")
+        wav(root / "audio" / name, 300 + 50 * i)
+    (root / "meta" / "esc50.csv").write_text("\n".join(rows))
+    train = paddle.audio.datasets.ESC50(mode="train", split=1,
+                                        data_dir=str(tmp_path))
+    dev = paddle.audio.datasets.ESC50(mode="dev", split=1,
+                                      data_dir=str(tmp_path))
+    assert len(train) + len(dev) == 10 and len(dev) == 2
+    x, y = train[0]
+    assert x.ndim == 1 and 0 <= int(y) < 3
+    mel = paddle.audio.datasets.ESC50(mode="dev", split=1,
+                                      data_dir=str(tmp_path),
+                                      feat_type="melspectrogram",
+                                      n_fft=256, n_mels=16)
+    xm, _ = mel[0]
+    assert xm.shape[0] == 16
+
+    # TESS layout
+    tess = tmp_path / "tess"
+    tess.mkdir()
+    for i, emo in enumerate(["angry", "happy", "sad", "neutral", "fear"]):
+        wav(tess / f"OAF_word{i}_{emo}.wav", 200 + 40 * i)
+    ds = paddle.audio.datasets.TESS(mode="train", n_folds=5, split=1,
+                                    data_dir=str(tess))
+    dv = paddle.audio.datasets.TESS(mode="dev", n_folds=5, split=1,
+                                    data_dir=str(tess))
+    assert len(ds) + len(dv) == 5 and len(dv) == 1
+    xw, yw = ds[0]
+    assert xw.ndim == 1 and 0 <= int(yw) < 7
+
+
+def test_conll05st_parser(tmp_path):
+    """Reference: text/datasets/conll05.py — props bracket decoding, dicts,
+    9-tuple samples."""
+    words = ["The", "cat", "sat", "here", "", "Dogs", "bark", ""]
+    props = ["-\t*", "-\t*", "sit\t(V*)", "-\t(AM-LOC*)", "",
+             "-\t*", "bark\t(V*)", ""]
+    d = tmp_path
+    (d / "test.wsj.words").write_text("\n".join(words))
+    (d / "test.wsj.props").write_text(
+        "\n".join(p.replace("\t", " ") for p in props))
+    (d / "words.dict").write_text("\n".join(
+        ["<unk>", "the", "The", "cat", "sat", "here", "Dogs", "bark"]))
+    (d / "verbs.dict").write_text("sit\nbark\n")
+    (d / "targets.dict").write_text("B-V\nI-V\nB-AM-LOC\nI-AM-LOC\nO\n")
+    ds = paddle.text.Conll05st(data_file=str(d),
+                               word_dict_file=str(d / "words.dict"),
+                               verb_dict_file=str(d / "verbs.dict"),
+                               target_dict_file=str(d / "targets.dict"))
+    assert len(ds) == 2
+    wd, vd, ld = ds.get_dict()
+    assert vd == {"sit": 0, "bark": 1}
+    sample = ds[0]
+    assert len(sample) == 9
+    word_idx, *_ctx, pred_idx, mark, label_idx = sample
+    assert word_idx.shape == (4,)
+    assert pred_idx.tolist() == [0, 0, 0, 0]
+    # mark flags the predicate window (v=2: positions 0..3 < n)
+    assert mark.tolist() == [1, 1, 1, 1]
+    lab_names = {v: k for k, v in ld.items()}
+    decoded = [lab_names[i] for i in label_idx.tolist()]
+    assert decoded[2] == "B-V" and decoded[3] == "B-AM-LOC"
+    assert decoded[0] == "O"

@@ -54,7 +54,25 @@ def main():
     rows2 = client.pull("emb", ids)
     np.testing.assert_allclose(rows2, rows1, atol=1e-6)
 
+    # GeoSGD communicator: local-only training between syncs, delta push
+    # at the sync boundary (reference: ps/service/communicator GEO mode)
+    geo = ps.GeoCommunicator(client, "emb", push_nums=3)
+    gids = np.array([21, 22], np.int64)
+    base = geo.pull(gids).copy()
+    server_before = client.pull("emb", gids).copy()
+    for _ in range(2):
+        geo.push_grad(gids, np.ones((2, 8), np.float32), lr=0.5)
+    # 2 of 3 steps: server must be UNTOUCHED, local replica trained
+    np.testing.assert_allclose(client.pull("emb", gids), server_before,
+                               atol=1e-6)
+    np.testing.assert_allclose(geo.pull(gids), base - 1.0, atol=1e-5)
+    geo.push_grad(gids, np.ones((2, 8), np.float32), lr=0.5)  # 3rd -> sync
+    np.testing.assert_allclose(client.pull("emb", gids),
+                               server_before - 1.5, atol=1e-5)
+    np.testing.assert_allclose(geo.pull(gids), base - 1.5, atol=1e-5)
+
     print("PS OK", flush=True)
+    print("GEO OK", flush=True)
     rpc.shutdown()
 
 

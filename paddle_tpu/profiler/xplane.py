@@ -1,0 +1,111 @@
+"""XPlane analysis: compute/communication breakdown + overlap.
+
+Reference: the profiler statistic tables (profiler_statistic.py:
+Communication/Computation overlap summaries) and CrossStackProfiler. The
+jax profiler writes XLA's xplane.pb; comm ops (all-reduce / all-gather /
+reduce-scatter / collective-permute / all-to-all) and compute ops are
+classified by event name and their wall-clock intervals intersected —
+overlap% is how much collective time hides under compute, the number the
+allreduce_matmul_grad_overlapping pass optimizes for in the reference.
+"""
+from __future__ import annotations
+
+import glob
+import os
+
+__all__ = ["parse_xplane", "comm_compute_breakdown"]
+
+_COMM_MARKERS = ("all-reduce", "all-gather", "reduce-scatter",
+                 "collective-permute", "all-to-all", "psum",
+                 "rendezvous", "ncclKernel", "send", "recv")
+_SKIP = ("ThreadpoolListener", "ThunkExecutor", "Wait for",
+         "ExecuteHelper", "Handle inputs", "CreateOutputs",
+         "StartRegion", "StopRegion", "CollectGarbage", "end:")
+
+
+def _latest_xplane(logdir):
+    pbs = sorted(glob.glob(os.path.join(logdir, "**", "*.xplane.pb"),
+                           recursive=True), key=os.path.getmtime)
+    if not pbs:
+        raise FileNotFoundError(f"no xplane.pb under {logdir}")
+    return pbs[-1]
+
+
+def parse_xplane(path_or_logdir):
+    """-> list of (thread_line_name, event_name, start_ps, dur_ps) for the
+    device-execution lines of the newest trace."""
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    path = path_or_logdir
+    if os.path.isdir(path):
+        path = _latest_xplane(path)
+    xs = xplane_pb2.XSpace()
+    with open(path, "rb") as f:
+        xs.ParseFromString(f.read())
+    events = []
+    for plane in xs.planes:
+        meta = {k: v.name for k, v in plane.event_metadata.items()}
+        for line in plane.lines:
+            # device-execution lines: TPU streams or CPU client threads
+            is_dev = ("XLAPjRtCpuClient" in line.name
+                      or plane.name.startswith("/device:"))
+            if not is_dev:
+                continue
+            base_ps = line.timestamp_ns * 1000
+            for ev in line.events:
+                name = meta.get(ev.metadata_id, "")
+                if not name or any(s in name for s in _SKIP):
+                    continue
+                events.append((line.name, name,
+                               base_ps + ev.offset_ps, ev.duration_ps))
+    return events
+
+
+def _merge(intervals):
+    out = []
+    for s, e in sorted(intervals):
+        if out and s <= out[-1][1]:
+            out[-1] = (out[-1][0], max(out[-1][1], e))
+        else:
+            out.append((s, e))
+    return out
+
+
+def _total(intervals):
+    return sum(e - s for s, e in intervals)
+
+
+def _intersect(a, b):
+    out, i, j = [], 0, 0
+    while i < len(a) and j < len(b):
+        s = max(a[i][0], b[j][0])
+        e = min(a[i][1], b[j][1])
+        if s < e:
+            out.append((s, e))
+        if a[i][1] < b[j][1]:
+            i += 1
+        else:
+            j += 1
+    return out
+
+
+def comm_compute_breakdown(path_or_logdir):
+    """-> dict with compute_us, comm_us, overlap_us, comm_overlap_pct
+    (fraction of collective time hidden under concurrent compute)."""
+    events = parse_xplane(path_or_logdir)
+    comm, compute = [], []
+    for _line, name, start, dur in events:
+        lo = name.lower()
+        (comm if any(m in lo for m in _COMM_MARKERS)
+         else compute).append((start, start + dur))
+    comm_m = _merge(comm)
+    compute_m = _merge(compute)
+    overlap = _total(_intersect(comm_m, compute_m))
+    comm_t = _total(comm_m)
+    return {
+        "compute_us": _total(compute_m) / 1e6,
+        "comm_us": comm_t / 1e6,
+        "overlap_us": overlap / 1e6,
+        "comm_overlap_pct": (100.0 * overlap / comm_t) if comm_t else 0.0,
+        "n_events": len(events),
+    }

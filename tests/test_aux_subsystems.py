@@ -379,3 +379,37 @@ def test_profiler_memory_tracing(tmp_path):
     assert mem_rows and "live_bytes" in mem_rows[0]["args"]
     per_step = prof._step_device_mem
     assert per_step and per_step[0]["tracked_peak_bytes"] > 0
+
+
+def test_xplane_comm_compute_breakdown(tmp_path):
+    """VERDICT r3 item 7: compute/comm breakdown + overlap%% from a real
+    xplane trace of a DP step on the 8-device mesh (reference:
+    profiler_statistic.py overlap summaries)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from paddle_tpu.profiler.xplane import comm_compute_breakdown
+
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+    x = jax.device_put(jnp.ones((64, 128)), NamedSharding(mesh, P("dp")))
+    w = jax.device_put(jnp.ones((128, 128)), NamedSharding(mesh, P()))
+
+    @jax.jit
+    def step(x, w):
+        h = jnp.tanh(x @ w) @ w.T
+        return jnp.sum(h)  # cross-device reduce -> collective
+
+    step(x, w)  # compile outside the trace
+    logdir = str(tmp_path / "xp")
+    jax.profiler.start_trace(logdir)
+    for _ in range(5):
+        r = step(x, w)
+    np.asarray(r)
+    jax.profiler.stop_trace()
+
+    out = comm_compute_breakdown(logdir)
+    assert out["n_events"] > 0
+    assert out["compute_us"] > 0, out
+    assert out["comm_us"] > 0, out  # the psum showed up as a collective
+    assert 0.0 <= out["comm_overlap_pct"] <= 100.0

@@ -3,3 +3,6 @@ from .api import (  # noqa: F401
     Partial, ProcessMesh, Replicate, Shard, dtensor_from_local, get_mesh,
     reshard, set_mesh, shard_layer, shard_tensor,
 )
+from .engine import (  # noqa: F401
+    Cluster, CostModel, Engine, ModelStats, Planner,
+)

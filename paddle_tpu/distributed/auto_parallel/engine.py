@@ -1,0 +1,284 @@
+"""Auto-parallel static engine: cluster → cost model → planner → Engine.
+
+Reference: python/paddle/distributed/auto_parallel/static/engine.py:59
+(Engine.prepare/fit/evaluate/predict), planner_v2.py + tuner/parallel
+tuner (strategy search), cost/ (comp/comm cost models over a cluster
+description), completion.py (tensor-level dist-attr completion).
+
+TPU-native split: GSPMD performs completion (annotate few shardings, XLA
+completes every tensor), so what remains valuable is the PLANNING layer —
+an analytic cost model in the scaling-book style (compute time from
+MFU-discounted FLOPs; dp grad all-reduce, tp activation collectives and
+pp bubble from link bandwidths; HBM from params/optimizer/activations per
+parallel degree) and a planner that ranks legal (dp, mp, pp, sharding)
+meshes for a Cluster, feeding fleet.DistributedStrategy. The Engine wraps
+model+loss+optimizer into the whole-step compiled path on the planned
+mesh. The measured-trial complement is distributed/auto_tuner.py.
+"""
+from __future__ import annotations
+
+import math
+
+__all__ = ["Cluster", "ModelStats", "CostModel", "Planner", "Engine"]
+
+
+class Cluster:
+    """Reference: auto_parallel/static/cluster.py (machine/device/link
+    JSON). Chip-level description of a TPU slice."""
+
+    def __init__(self, n_devices, hbm_gb, peak_tflops, ici_gbps=400.0,
+                 dcn_gbps=25.0, devices_per_host=4, name="custom"):
+        self.n_devices = int(n_devices)
+        self.hbm_bytes = hbm_gb * 2 ** 30
+        self.peak_flops = peak_tflops * 1e12
+        self.ici_bps = ici_gbps * 1e9
+        self.dcn_bps = dcn_gbps * 1e9
+        self.devices_per_host = devices_per_host
+        self.name = name
+
+    @classmethod
+    def v5e(cls, n_devices=8):
+        return cls(n_devices, hbm_gb=16, peak_tflops=197, ici_gbps=400,
+                   name=f"v5e-{n_devices}")
+
+    @classmethod
+    def v5p(cls, n_devices=8):
+        return cls(n_devices, hbm_gb=95, peak_tflops=459, ici_gbps=1200,
+                   name=f"v5p-{n_devices}")
+
+    def __repr__(self):
+        return (f"Cluster({self.name}, n={self.n_devices}, "
+                f"hbm={self.hbm_bytes/2**30:.0f}GB)")
+
+
+class ModelStats:
+    """Transformer shape summary the cost model consumes."""
+
+    def __init__(self, n_params, n_layers, hidden, vocab=None, heads=None):
+        self.n_params = int(n_params)
+        self.n_layers = int(n_layers)
+        self.hidden = int(hidden)
+        self.vocab = vocab
+        self.heads = heads
+
+    @classmethod
+    def of_gpt(cls, cfg):
+        h, L, v, s = (cfg.hidden_size, cfg.num_layers, cfg.vocab_size,
+                      cfg.max_seq_len)
+        n = 12 * L * h * h + 2 * v * h + s * h
+        return cls(n, L, h, vocab=v, heads=cfg.num_heads)
+
+    @classmethod
+    def of_layer(cls, layer, n_layers=1, hidden=None):
+        n = sum(p.size for p in layer.parameters())
+        return cls(n, n_layers, hidden or int(math.sqrt(max(n, 1))))
+
+
+class CostModel:
+    """Analytic step-time + memory estimator (reference: static/cost/
+    comp_op_cost.py + comm_op_cost.py collapsed to chip-level terms)."""
+
+    MFU = 0.45           # achievable compute efficiency target
+    BW_EFF = 0.7         # achievable fraction of link bandwidth
+    ACT_BYTES_PER_TOKEN_LAYER = 16  # bf16 activations+workspace, remat'd
+
+    def __init__(self, cluster: Cluster):
+        self.cluster = cluster
+
+    def estimate(self, stats: ModelStats, cfg, global_batch, seq_len,
+                 micro_batches=None, bytes_per_param=4, remat=True):
+        """cfg: dict with dp/mp/pp/sharding degrees. Returns dict with
+        step_ms, per_device_mem, and the term breakdown."""
+        c = self.cluster
+        dp = cfg.get("dp_degree", 1)
+        mp = cfg.get("mp_degree", 1)
+        pp = cfg.get("pp_degree", 1)
+        sh = cfg.get("sharding_degree", 1)
+        n_dev = dp * mp * pp * sh
+        micro = micro_batches or max(2 * pp, 1)
+        tokens = global_batch * seq_len
+
+        # -- compute: 6ND fwd+bwd, spread over every device. The MXU needs
+        # wide per-device matmuls: TP slices hidden/mp below the systolic
+        # tile and efficiency falls off linearly (scaling-book roofline)
+        flops = 6 * stats.n_params * tokens \
+            + 6 * stats.n_layers * tokens * seq_len * stats.hidden
+        mxu_eff = min(1.0, (stats.hidden / mp) / 256.0)
+        t_comp = flops / (n_dev * c.peak_flops * self.MFU * max(
+            mxu_eff, 1e-3))
+
+        # -- dp/sharding grad sync: ring all-reduce 2(k-1)/k of the
+        # per-replica param bytes (grads in bf16 ~ half of fp32 params)
+        repl = dp * sh
+        param_bytes_replica = stats.n_params * bytes_per_param / (mp * pp)
+        t_dp = (2 * (repl - 1) / max(repl, 1)) * param_bytes_replica \
+            / (c.ici_bps * self.BW_EFF) if repl > 1 else 0.0
+        # ZeRO-3 adds a param all-gather per step of the same volume
+        t_zero = param_bytes_replica * (sh - 1) / max(sh, 1) \
+            / (c.ici_bps * self.BW_EFF) if sh > 1 else 0.0
+
+        # -- tp: 2 activation all-reduces per layer (fwd+bwd ~ x2)
+        act_bytes = tokens / dp * stats.hidden * 2  # bf16
+        t_tp = (4 * stats.n_layers * act_bytes * (mp - 1) / mp
+                / (c.ici_bps * self.BW_EFF)) if mp > 1 else 0.0
+
+        # -- pp bubble: (pp-1)/micro of the compute
+        bubble = (pp - 1) / micro if pp > 1 else 0.0
+        t_pp = t_comp * bubble
+
+        step_s = t_comp + t_dp + t_zero + t_tp + t_pp
+
+        # -- memory per device
+        p_local = stats.n_params * bytes_per_param / (mp * pp * sh)
+        opt_local = stats.n_params * 8 / (mp * pp * sh * max(dp, 1)) \
+            if sh > 1 else stats.n_params * 8 / (mp * pp)
+        act_per_layer = (tokens / (dp * max(pp, 1))
+                         * self.ACT_BYTES_PER_TOKEN_LAYER / mp)
+        act_local = act_per_layer * (1 if remat
+                                     else stats.n_layers / pp)
+        grads_local = stats.n_params * bytes_per_param / (mp * pp * sh)
+        mem = p_local + opt_local + act_local + grads_local
+
+        return {"step_ms": step_s * 1e3, "per_device_mem": mem,
+                "t_compute_ms": t_comp * 1e3, "t_dp_ms": t_dp * 1e3,
+                "t_tp_ms": t_tp * 1e3, "t_pp_ms": t_pp * 1e3,
+                "t_zero_ms": t_zero * 1e3,
+                "tokens_per_sec": tokens / step_s}
+
+
+class Planner:
+    """Reference: planner_v2.py / tuner's parallel tuner — enumerate legal
+    meshes, prune by HBM, rank by modeled step time."""
+
+    def __init__(self, cluster: Cluster, cost_model: CostModel = None):
+        self.cluster = cluster
+        self.cost = cost_model or CostModel(cluster)
+
+    def _divisors(self, n):
+        return [d for d in range(1, n + 1) if n % d == 0]
+
+    def candidates(self, max_pp=8):
+        n = self.cluster.n_devices
+        out = []
+        for mp in self._divisors(n):
+            for pp in self._divisors(n // mp):
+                if pp > max_pp:
+                    continue
+                for sh in self._divisors(n // (mp * pp)):
+                    dp = n // (mp * pp * sh)
+                    out.append({"dp_degree": dp, "mp_degree": mp,
+                                "pp_degree": pp, "sharding_degree": sh})
+        return out
+
+    def plan(self, stats: ModelStats, global_batch, seq_len, top_k=5,
+             **kwargs):
+        """-> ranked [(cfg, estimate)] that fit HBM; raises if nothing
+        fits (the reference tuner's 'no feasible plan')."""
+        ranked = []
+        for cfg in self.candidates():
+            if global_batch % (cfg["dp_degree"]
+                               * cfg["sharding_degree"]):
+                continue
+            est = self.cost.estimate(stats, cfg, global_batch, seq_len,
+                                     **kwargs)
+            if est["per_device_mem"] <= self.cluster.hbm_bytes * 0.9:
+                ranked.append((cfg, est))
+        if not ranked:
+            raise RuntimeError(
+                f"no parallel config fits {self.cluster}: model "
+                f"{stats.n_params/1e9:.2f}B params needs more devices "
+                "or sharding")
+        ranked.sort(key=lambda ce: ce[1]["step_ms"])
+        return ranked[:top_k]
+
+    def best_strategy(self, stats, global_batch, seq_len, **kwargs):
+        from .. import fleet
+        cfg, est = self.plan(stats, global_batch, seq_len, **kwargs)[0]
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = dict(cfg)
+        return strategy, est
+
+
+class Engine:
+    """Reference: auto_parallel/static/engine.py Engine — model + loss +
+    optimizer planned onto the cluster and compiled as one train step."""
+
+    def __init__(self, model=None, loss=None, optimizer=None,
+                 metrics=None, cluster=None, strategy=None):
+        import jax
+        self.model = model
+        self.loss = loss
+        self.optimizer = optimizer
+        self.metrics = metrics
+        self.cluster = cluster or Cluster(
+            jax.device_count(), hbm_gb=16, peak_tflops=197)
+        self.strategy = strategy
+        self._step = None
+        self.plan_estimate = None
+
+    def prepare(self, stats=None, global_batch=8, seq_len=128, **kwargs):
+        """Plan the mesh (if no strategy given) and init fleet."""
+        from .. import fleet
+        if self.strategy is None:
+            if stats is None:
+                stats = ModelStats.of_layer(self.model)
+            planner = Planner(self.cluster)
+            self.strategy, self.plan_estimate = planner.best_strategy(
+                stats, global_batch, seq_len, **kwargs)
+        fleet.init(is_collective=True, strategy=self.strategy)
+        return self
+
+    def _build_step(self):
+        from ...jit import to_static
+
+        def train_step(*batch):
+            out = self.model(batch[0])
+            loss = self.loss(out, *batch[1:])
+            loss.backward()
+            self.optimizer.step()
+            self.optimizer.clear_grad()
+            return loss
+
+        self._step = to_static(train_step,
+                               capture=(self.model, self.optimizer))
+
+    def fit(self, train_data, epochs=1, batch_size=None, verbose=0,
+            steps_per_epoch=None):
+        import numpy as np
+        if self.strategy is None:
+            self.prepare()
+        if self._step is None:
+            self._build_step()
+        history = []
+        for _ in range(epochs):
+            for i, batch in enumerate(train_data):
+                if steps_per_epoch is not None and i >= steps_per_epoch:
+                    break
+                loss = self._step(*batch)
+                history.append(float(np.asarray(loss.numpy())))
+        return history
+
+    def evaluate(self, eval_data, steps=None):
+        import numpy as np
+
+        from ...core.autograd import no_grad
+        losses = []
+        with no_grad():
+            for i, batch in enumerate(eval_data):
+                if steps is not None and i >= steps:
+                    break
+                out = self.model(batch[0])
+                losses.append(float(np.asarray(
+                    self.loss(out, *batch[1:]).numpy())))
+        return {"loss": sum(losses) / max(len(losses), 1)}
+
+    def predict(self, data, steps=None):
+        from ...core.autograd import no_grad
+        outs = []
+        with no_grad():
+            for i, batch in enumerate(data):
+                if steps is not None and i >= steps:
+                    break
+                xb = batch[0] if isinstance(batch, (list, tuple)) else batch
+                outs.append(self.model(xb))
+        return outs

@@ -33,24 +33,48 @@ class Model:
 
     def prepare(self, optimizer=None, loss=None, metrics=None,
                 amp_configs=None):
-        """Reference: Model.prepare."""
+        """Reference: Model.prepare (hapi/model.py:2006) — including the
+        distributed adapter (:821): when the parallel env is initialized,
+        the network is wrapped in DataParallel so fit() trains
+        data-parallel, and amp_configs ('O1'/'O2' or {'level': ...})
+        stages the train step under auto_cast."""
         self._optimizer = optimizer
         self._loss = loss
         self._metrics = _as_list(metrics)
+        self._amp_level = None
+        if amp_configs is not None:
+            if isinstance(amp_configs, str):
+                self._amp_level = amp_configs
+            elif isinstance(amp_configs, dict):
+                self._amp_level = amp_configs.get("level", "O1")
+        from ..distributed import env as _denv
+        from ..distributed.parallel import DataParallel
+        if _denv.is_initialized() and _denv.get_world_size() > 1 and \
+                not isinstance(self.network, DataParallel):
+            self.network = DataParallel(self.network)
 
     # ---- single-batch entry points (reference: train_batch/eval_batch) ----
     def _build_step(self):
         net, loss_fn, opt = self.network, self._loss, self._optimizer
+        amp_level = getattr(self, "_amp_level", None)
 
         def train_step(x, y):
-            out = net(x)
-            loss = loss_fn(out, y)
+            if amp_level:
+                from ..amp import auto_cast
+                with auto_cast(level=amp_level, dtype="bfloat16"):
+                    out = net(x)
+                    loss = loss_fn(out, y)
+            else:
+                out = net(x)
+                loss = loss_fn(out, y)
             loss.backward()
             opt.step()
             opt.clear_grad()
             return loss, out
 
-        self._step_fn = to_static(train_step, capture=(net, opt))
+        from ..nn import Layer
+        capture_net = net if isinstance(net, Layer) else net._layers
+        self._step_fn = to_static(train_step, capture=(capture_net, opt))
         return self._step_fn
 
     def train_batch(self, inputs, labels=None, update=True):
@@ -95,6 +119,15 @@ class Model:
         if isinstance(data, DataLoader):
             return data
         if isinstance(data, Dataset):
+            from ..distributed import env as _denv
+            import jax as _jax
+            if _denv.is_initialized() and _jax.process_count() > 1:
+                # multi-controller: each process loads its own shard
+                # (reference: fit's DistributedBatchSampler path)
+                from ..io import DistributedBatchSampler
+                sampler = DistributedBatchSampler(
+                    data, batch_size=batch_size, shuffle=shuffle)
+                return DataLoader(data, batch_sampler=sampler)
             return DataLoader(data, batch_size=batch_size, shuffle=shuffle)
         raise TypeError(f"expected Dataset or DataLoader, got {type(data)}")
 

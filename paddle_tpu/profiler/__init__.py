@@ -17,7 +17,7 @@ import time
 import jax
 
 __all__ = ["Profiler", "RecordEvent", "ProfilerTarget", "profiler_guard",
-           "load_profiler_result"]
+           "load_profiler_result", "merge_profiler_results"]
 
 
 class _OpTracer:
@@ -291,3 +291,25 @@ def load_profiler_result(path):
     raise ValueError(
         f"{path!r} is not a chrome-trace json; xplane directories are "
         "viewed with TensorBoard instead")
+
+
+def merge_profiler_results(paths, out_path=None):
+    """Multi-rank trace merge (reference: CrossStackProfiler — the
+    multi-node profiler aggregation tool). Each input chrome trace (one
+    per rank, as exported by Profiler.export on that rank) lands on its
+    own pid lane, labeled rank_N; a process_name metadata event names the
+    lane. Returns the merged dict (and writes it when out_path given)."""
+    merged = {"traceEvents": [], "displayTimeUnit": "ms"}
+    for rank, p in enumerate(paths):
+        d = p if isinstance(p, dict) else load_profiler_result(p)
+        merged["traceEvents"].append({
+            "name": "process_name", "ph": "M", "pid": rank,
+            "args": {"name": f"rank_{rank}"}})
+        for ev in d.get("traceEvents", []):
+            ev = dict(ev)
+            ev["pid"] = rank
+            merged["traceEvents"].append(ev)
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(merged, f)
+    return merged

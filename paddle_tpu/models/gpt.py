@@ -89,13 +89,18 @@ def _ids_write(buf, new, col):
 
 def _sp_constrain(x, sequence_parallel):
     """Shard the [B, S, H] residual stream: batch over 'data', seq over
-    'sep' (sequence/context parallel; SURVEY §5 long-context)."""
+    'sep' (sequence/context parallel; SURVEY §5 long-context). Decode
+    steps (seq not divisible by the sep degree, e.g. one token) keep the
+    batch sharding only."""
     if not sequence_parallel:
         return x
     from ..distributed.topology import get_hybrid_communicate_group
     hcg = get_hybrid_communicate_group()
+    sep = hcg.mesh.shape.get("sep", 1)
+    spec = P("data", "sep", None) if x.shape[1] % sep == 0 else \
+        P("data", None, None)
     return apply("sp_constraint", lambda a: jax.lax.with_sharding_constraint(
-        a, NamedSharding(hcg.mesh, P("data", "sep", None))), [x])
+        a, NamedSharding(hcg.mesh, spec)), [x])
 
 
 class GPTAttention(nn.Layer):

@@ -29,4 +29,8 @@ def _seed():
     import paddle_tpu as paddle
     paddle.seed(2024)
     np.random.seed(2024)
+    # fleet topology is module-global state: a mesh left by one test must
+    # not leak into the next (tests that need one call fleet.init)
+    from paddle_tpu.distributed import topology as _topo
+    _topo._hcg = None
     yield

@@ -1,5 +1,5 @@
 """Concrete optimizers (reference: python/paddle/optimizer/{sgd,momentum,adam,
-adamw,adagrad,rmsprop,adadelta,adamax,lamb}.py).
+adamw,adagrad,rmsprop,adadelta,adamax,lamb,rprop,lbfgs}.py).
 
 Each `_update` is a pure jnp expression; XLA fuses it into a single kernel per
 parameter (the reference needs hand-fused CUDA kernels for this —
@@ -9,10 +9,12 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
+import numpy as np
+
 from .optimizer import Optimizer
 
 __all__ = ["SGD", "Momentum", "Adam", "AdamW", "Adagrad", "RMSProp",
-           "Adadelta", "Adamax", "Lamb"]
+           "Adadelta", "Adamax", "Lamb", "Rprop", "LBFGS"]
 
 
 class SGD(Optimizer):
@@ -355,3 +357,214 @@ Adagrad._materialize_param = _mat_adagrad
 RMSProp._materialize_param = _mat_rmsprop
 Adadelta._materialize_param = _mat_adadelta
 Adamax._materialize_param = _mat_adamax
+
+
+class Rprop(Optimizer):
+    """Reference: python/paddle/optimizer/rprop.py (phi rprop_kernel.cc):
+    per-element adaptive step sizes — sign agreement with the previous
+    grad grows the element's lr by eta+, disagreement shrinks it by eta-
+    and suppresses the step, always clipped to learning_rate_range."""
+
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50),
+                 parameters=None, etas=(0.5, 1.2), grad_clip=None,
+                 multi_precision=False, name=None):
+        if not (0.0 < learning_rate_range[0] <= learning_rate
+                <= learning_rate_range[1]):
+            raise ValueError(
+                "'0.0 < learning_rate_range[0] <= learning_rate <= "
+                "learning_rate_range[1]' must be true")
+        if not 0.0 < etas[0] < 1.0 < etas[1]:
+            raise ValueError("'0.0 < etas[0] < 1.0 < etas[1]' must be true")
+        super().__init__(learning_rate, parameters, None, grad_clip,
+                         multi_precision, name)
+        self._lr_min, self._lr_max = learning_rate_range
+        self._eta_minus, self._eta_plus = etas
+        self._initial_lr = learning_rate
+
+    def _update(self, p, w, g, lr, group):
+        prev = self._get_accumulator("prev", p)
+        lrs = self._get_accumulator(
+            "learning_rates", p,
+            init=jnp.full(p._data.shape, self._initial_lr, jnp.float32))
+        prod = g * prev
+        eta = jnp.where(prod > 0, self._eta_plus,
+                        jnp.where(prod < 0, self._eta_minus, 1.0))
+        g_eff = jnp.where(prod < 0, 0.0, g)
+        lrs = jnp.clip(lrs * eta, self._lr_min, self._lr_max)
+        self._set_accumulator("prev", p, g_eff)
+        self._set_accumulator("learning_rates", p, lrs)
+        return w - jnp.sign(g_eff) * lrs
+
+
+class LBFGS(Optimizer):
+    """Reference: python/paddle/optimizer/lbfgs.py — limited-memory BFGS
+    with closure-driven step() and optional strong-Wolfe line search.
+    Host-driven like the reference (the python loop IS the algorithm; the
+    closure's forward/backward runs on device)."""
+
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9,
+                 history_size=100, line_search_fn=None, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip, multi_precision, name)
+        self._max_iter = max_iter
+        self._max_eval = max_eval or max_iter * 5 // 4
+        self._tol_grad = tolerance_grad
+        self._tol_change = tolerance_change
+        self._history_size = history_size
+        if line_search_fn not in (None, "strong_wolfe"):
+            raise ValueError("line_search_fn must be None|'strong_wolfe'")
+        self._line_search = line_search_fn
+        self._state = {"old_dirs": [], "old_stps": [], "ro": [],
+                       "prev_flat_grad": None, "H_diag": 1.0,
+                       "n_evals": 0}
+
+    # -- flat-vector helpers --
+    def _gather(self, what):
+        parts = []
+        for p in self._parameter_list:
+            a = p._grad if what == "grad" else p._data
+            parts.append(jnp.ravel(jnp.asarray(
+                a if a is not None else jnp.zeros_like(p._data),
+                jnp.float32)))
+        return jnp.concatenate(parts) if parts else jnp.zeros((0,))
+
+    def _scatter_add(self, flat, alpha):
+        off = 0
+        for p in self._parameter_list:
+            n = p._data.size
+            upd = flat[off:off + n].reshape(p._data.shape)
+            p._data = (p._data.astype(jnp.float32)
+                       + alpha * upd).astype(p._data.dtype)
+            off += n
+
+    def _evaluate(self, closure, flat_x0, d, t):
+        self._scatter_add(d, t)
+        loss = float(np.asarray(closure()._data))
+        grad = self._gather("grad")
+        # restore x0 exactly
+        off = 0
+        for p in self._parameter_list:
+            n = p._data.size
+            p._data = flat_x0[off:off + n].reshape(p._data.shape) \
+                .astype(p._data.dtype)
+            off += n
+        self._state["n_evals"] += 1
+        return loss, grad
+
+    def step(self, closure=None):
+        if closure is None:
+            raise RuntimeError(
+                "LBFGS.step requires a closure that re-evaluates the "
+                "model, calls loss.backward() and returns the loss "
+                "(reference lbfgs.py)")
+        st = self._state
+        lr = self.get_lr()
+        loss = float(np.asarray(closure()._data))
+        flat_grad = self._gather("grad")
+        if float(jnp.abs(flat_grad).max()) <= self._tol_grad:
+            return loss
+
+        for _ in range(self._max_iter):
+            # -- direction: two-loop recursion over (s, y) history
+            if st["prev_flat_grad"] is None:
+                d = -flat_grad
+                st["H_diag"] = 1.0
+            else:
+                y = flat_grad - st["prev_flat_grad"]
+                s = st["last_step"]
+                ys = float(jnp.dot(y, s))
+                if ys > 1e-10:
+                    if len(st["old_dirs"]) >= self._history_size:
+                        st["old_dirs"].pop(0)
+                        st["old_stps"].pop(0)
+                        st["ro"].pop(0)
+                    st["old_dirs"].append(y)
+                    st["old_stps"].append(s)
+                    st["ro"].append(1.0 / ys)
+                    st["H_diag"] = ys / float(jnp.dot(y, y))
+                q = -flat_grad
+                al = []
+                for s_i, y_i, ro_i in zip(reversed(st["old_stps"]),
+                                          reversed(st["old_dirs"]),
+                                          reversed(st["ro"])):
+                    a_i = ro_i * float(jnp.dot(s_i, q))
+                    al.append(a_i)
+                    q = q - a_i * y_i
+                d = q * st["H_diag"]
+                for (s_i, y_i, ro_i), a_i in zip(
+                        zip(st["old_stps"], st["old_dirs"], st["ro"]),
+                        reversed(al)):
+                    b_i = ro_i * float(jnp.dot(y_i, d))
+                    d = d + s_i * (a_i - b_i)
+            st["prev_flat_grad"] = flat_grad
+
+            gtd = float(jnp.dot(flat_grad, d))
+            if gtd > -self._tol_change:
+                break
+            t = min(1.0, 1.0 / float(jnp.abs(flat_grad).sum())) * lr \
+                if not st["old_dirs"] else lr
+
+            if self._line_search == "strong_wolfe":
+                x0 = self._gather("param")
+                t, loss, flat_grad = self._strong_wolfe(
+                    closure, x0, t, d, loss, flat_grad, gtd)
+                self._scatter_add(d, t)
+            else:
+                self._scatter_add(d, t)
+                loss = float(np.asarray(closure()._data))
+                flat_grad = self._gather("grad")
+            st["last_step"] = d * t
+            if st["n_evals"] >= self._max_eval:
+                break
+            if float(jnp.abs(flat_grad).max()) <= self._tol_grad:
+                break
+            if float(jnp.abs(d * t).max()) <= self._tol_change:
+                break
+        self.clear_grad()
+        return loss
+
+    def _strong_wolfe(self, closure, x0, t, d, f0, g0, gtd0,
+                      c1=1e-4, c2=0.9, max_ls=25):
+        """Strong-Wolfe line search (reference lbfgs.py _strong_wolfe,
+        standard bracket + zoom)."""
+        f_prev, g_prev, t_prev = f0, g0, 0.0
+        f_new, g_new = self._evaluate(closure, x0, d, t)
+        for _ in range(max_ls):
+            gtd_new = float(jnp.dot(g_new, d))
+            if f_new > f0 + c1 * t * gtd0 or f_new >= f_prev:
+                return self._zoom(closure, x0, d, f0, gtd0, t_prev, t,
+                                  f_prev, f_new, c1, c2)
+            if abs(gtd_new) <= -c2 * gtd0:
+                return t, f_new, g_new
+            if gtd_new >= 0:
+                return self._zoom(closure, x0, d, f0, gtd0, t, t_prev,
+                                  f_new, f_prev, c1, c2)
+            t_prev, f_prev, g_prev = t, f_new, g_new
+            t = t * 2.0
+            f_new, g_new = self._evaluate(closure, x0, d, t)
+        return t, f_new, g_new
+
+    def _zoom(self, closure, x0, d, f0, gtd0, lo, hi, f_lo, f_hi, c1, c2,
+              max_zoom=25):
+        g_best = None
+        for _ in range(max_zoom):
+            t = 0.5 * (lo + hi)
+            f_new, g_new = self._evaluate(closure, x0, d, t)
+            if f_new > f0 + c1 * t * gtd0 or f_new >= f_lo:
+                hi, f_hi = t, f_new
+            else:
+                gtd_new = float(jnp.dot(g_new, d))
+                if abs(gtd_new) <= -c2 * gtd0:
+                    return t, f_new, g_new
+                if gtd_new * (hi - lo) >= 0:
+                    hi, f_hi = lo, f_lo
+                lo, f_lo, g_best = t, f_new, g_new
+            if abs(hi - lo) < 1e-9:
+                break
+        if g_best is None:
+            f_new, g_best = self._evaluate(closure, x0, d, lo)
+            return lo, f_new, g_best
+        return lo, f_lo, g_best

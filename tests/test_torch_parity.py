@@ -136,3 +136,95 @@ def test_staged_whole_step_matches_torch():
         topt.step()
         theirs.append(float(tloss))
     np.testing.assert_allclose(ours, theirs, rtol=1e-4, atol=1e-5)
+
+
+def test_rprop_matches_torch():
+    """Rprop elementwise step-size adaptation vs torch.optim.Rprop
+    (reference: optimizer/rprop.py, phi rprop_kernel.cc)."""
+    import torch
+
+    rng = np.random.RandomState(0)
+    w0 = rng.randn(6, 4).astype("float32")
+    X = rng.randn(16, 6).astype("float32")
+    Y = rng.randn(16, 4).astype("float32")
+
+    tw = torch.tensor(w0.copy(), requires_grad=True)
+    topt = torch.optim.Rprop([tw], lr=0.01, etas=(0.5, 1.2),
+                             step_sizes=(1e-5, 50.0))
+    pw = paddle.to_tensor(w0.copy(), stop_gradient=False)
+    popt = paddle.optimizer.Rprop(learning_rate=0.01,
+                                  learning_rate_range=(1e-5, 50.0),
+                                  parameters=[pw], etas=(0.5, 1.2))
+    for _ in range(5):
+        tloss = ((torch.tensor(X) @ tw - torch.tensor(Y)) ** 2).mean()
+        topt.zero_grad()
+        tloss.backward()
+        topt.step()
+        ploss = ((paddle.to_tensor(X).matmul(pw)
+                  - paddle.to_tensor(Y)) ** 2).mean()
+        ploss.backward()
+        popt.step()
+        popt.clear_grad()
+    np.testing.assert_allclose(pw.numpy(), tw.detach().numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_lbfgs_matches_torch_on_quadratic():
+    """LBFGS two-loop direction + closure protocol vs torch.optim.LBFGS
+    (reference: optimizer/lbfgs.py). Both solve the same least-squares
+    problem to high precision."""
+    import torch
+
+    rng = np.random.RandomState(1)
+    A = rng.randn(20, 5).astype("float32")
+    b = rng.randn(20).astype("float32")
+    x_star = np.linalg.lstsq(A, b, rcond=None)[0]
+
+    pw = paddle.to_tensor(np.zeros(5, "float32"), stop_gradient=False)
+    popt = paddle.optimizer.LBFGS(learning_rate=1.0, max_iter=30,
+                                  parameters=[pw])
+
+    def pclosure():
+        popt.clear_grad()
+        r = paddle.to_tensor(A).matmul(pw) - paddle.to_tensor(b)
+        loss = (r * r).sum()
+        loss.backward()
+        return loss
+
+    ploss = popt.step(pclosure)
+    np.testing.assert_allclose(pw.numpy(), x_star, rtol=1e-3, atol=1e-4)
+
+    tw = torch.zeros(5, requires_grad=True)
+    topt = torch.optim.LBFGS([tw], lr=1.0, max_iter=30)
+
+    def tclosure():
+        topt.zero_grad()
+        r = torch.tensor(A) @ tw - torch.tensor(b)
+        loss = (r * r).sum()
+        loss.backward()
+        return loss
+
+    topt.step(tclosure)
+    np.testing.assert_allclose(pw.numpy(), tw.detach().numpy(),
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_lbfgs_strong_wolfe_converges_rosenbrock():
+    """Strong-Wolfe line search on the classic Rosenbrock valley
+    (reference lbfgs.py _strong_wolfe)."""
+    pw = paddle.to_tensor(np.array([-1.2, 1.0], "float32"),
+                          stop_gradient=False)
+    popt = paddle.optimizer.LBFGS(learning_rate=1.0, max_iter=60,
+                                  line_search_fn="strong_wolfe",
+                                  parameters=[pw])
+
+    def closure():
+        popt.clear_grad()
+        x0, x1 = pw[0], pw[1]
+        loss = (1.0 - x0) ** 2 + 100.0 * (x1 - x0 * x0) ** 2
+        loss.backward()
+        return loss
+
+    for _ in range(4):
+        loss = popt.step(closure)
+    np.testing.assert_allclose(pw.numpy(), [1.0, 1.0], atol=1e-2)

@@ -1,0 +1,467 @@
+"""Long-tail functional ops: losses, activations, unpooling, CTC/RNNT.
+
+Reference: python/paddle/nn/functional/{loss,activation,pooling,common}.py
+long tail (poisson_nll_loss:..., ctc_loss over warpctc
+phi/kernels/impl/warpctc_kernel_impl.h, rnnt_loss over warprnnt,
+hsigmoid_loss over matrix_bit_code.h SimpleCode). TPU-native: the dynamic
+programs (CTC alpha recursion, RNNT lattice) run as lax.scan in log space
+— one fused XLA loop instead of the reference's CUDA warp kernels.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply
+from ...core.tensor import Tensor
+
+__all__ = [
+    "channel_shuffle", "maxout", "thresholded_relu", "rrelu", "zeropad2d",
+    "pairwise_distance", "poisson_nll_loss",
+    "multi_label_soft_margin_loss", "hinge_embedding_loss",
+    "cosine_embedding_loss", "multi_margin_loss", "triplet_margin_loss",
+    "triplet_margin_with_distance_loss", "soft_margin_loss",
+    "gaussian_nll_loss", "ctc_loss", "rnnt_loss", "hsigmoid_loss",
+    "bilinear", "adaptive_avg_pool3d", "adaptive_max_pool3d",
+    "max_unpool1d", "max_unpool2d", "max_unpool3d",
+]
+
+
+def _reduce(x, reduction):
+    if reduction == "mean":
+        return jnp.mean(x)
+    if reduction == "sum":
+        return jnp.sum(x)
+    return x
+
+
+# ---------------- activations / shapes ----------------
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    """Reference: functional/common.py channel_shuffle."""
+    def fwd(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            return a.reshape(n, groups, c // groups, h, w) \
+                .swapaxes(1, 2).reshape(n, c, h, w)
+        n, h, w, c = a.shape
+        return a.reshape(n, h, w, groups, c // groups) \
+            .swapaxes(3, 4).reshape(n, h, w, c)
+    return apply("channel_shuffle", fwd, [x])
+
+
+def maxout(x, groups, axis=1, name=None):
+    """Reference: functional/activation.py maxout."""
+    def fwd(a):
+        ax = axis % a.ndim
+        c = a.shape[ax]
+        new_shape = a.shape[:ax] + (c // groups, groups) + a.shape[ax + 1:]
+        return a.reshape(new_shape).max(axis=ax + 1)
+    return apply("maxout", fwd, [x])
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    """Reference: functional/activation.py thresholded_relu."""
+    return apply("thresholded_relu",
+                 lambda a: jnp.where(a > threshold, a, value), [x])
+
+
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=False, name=None):
+    """Reference: functional/activation.py rrelu — random slope in
+    training, mean slope in eval."""
+    from ...core import random as _random
+    if training:
+        key = _random.next_key()
+
+        def fwd(a):
+            slope = jax.random.uniform(key, a.shape, jnp.float32,
+                                       lower, upper).astype(a.dtype)
+            return jnp.where(a >= 0, a, slope * a)
+        return apply("rrelu", fwd, [x])
+    slope = (lower + upper) / 2.0
+    return apply("rrelu", lambda a: jnp.where(a >= 0, a, slope * a), [x])
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    """Reference: functional/common.py zeropad2d — pad (left, right, top,
+    bottom) with zeros."""
+    l, r, t, b = (padding if isinstance(padding, (list, tuple))
+                  else [padding] * 4)
+
+    def fwd(a):
+        if data_format == "NCHW":
+            return jnp.pad(a, ((0, 0), (0, 0), (t, b), (l, r)))
+        return jnp.pad(a, ((0, 0), (t, b), (l, r), (0, 0)))
+    return apply("zeropad2d", fwd, [x])
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    """Reference: functional/distance.py pairwise_distance."""
+    return apply(
+        "pairwise_distance",
+        lambda a, b: jnp.linalg.norm(a - b + epsilon, ord=p, axis=-1,
+                                     keepdims=keepdim), [x, y])
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    """Reference: functional/common.py bilinear — out[b,o] =
+    x1[b,i] W[o,i,j] x2[b,j] + bias."""
+    ins = [x1, x2, weight] + ([bias] if bias is not None else [])
+
+    def fwd(a, b, w, *bb):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if bb:
+            out = out + bb[0]
+        return out
+    return apply("bilinear", fwd, ins)
+
+
+# ---------------- losses ----------------
+
+def poisson_nll_loss(input, label, log_input=True, full=False,
+                     epsilon=1e-8, reduction="mean", name=None):
+    """Reference: functional/loss.py poisson_nll_loss."""
+    def fwd(x, y):
+        if log_input:
+            loss = jnp.exp(x) - y * x
+        else:
+            loss = x - y * jnp.log(x + epsilon)
+        if full:
+            stirling = y * jnp.log(y) - y + 0.5 * jnp.log(2 * jnp.pi * y)
+            loss = loss + jnp.where(y > 1, stirling, 0.0)
+        return _reduce(loss, reduction)
+    return apply("poisson_nll_loss", fwd, [input, label])
+
+
+def multi_label_soft_margin_loss(input, label, weight=None,
+                                 reduction="mean", name=None):
+    """Reference: functional/loss.py multi_label_soft_margin_loss."""
+    ins = [input, label] + ([weight] if weight is not None else [])
+
+    def fwd(x, y, *w):
+        term = y * jax.nn.log_sigmoid(x) + (1 - y) * jax.nn.log_sigmoid(-x)
+        if w:
+            term = term * w[0]
+        loss = -jnp.mean(term, axis=-1)
+        return _reduce(loss, reduction)
+    return apply("multi_label_soft_margin_loss", fwd, ins)
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean",
+                         name=None):
+    """Reference: functional/loss.py hinge_embedding_loss."""
+    def fwd(x, y):
+        loss = jnp.where(y == 1.0, x, jnp.maximum(0.0, margin - x))
+        return _reduce(loss, reduction)
+    return apply("hinge_embedding_loss", fwd, [input, label])
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0,
+                          reduction="mean", name=None):
+    """Reference: functional/loss.py cosine_embedding_loss."""
+    def fwd(a, b, y):
+        cos = jnp.sum(a * b, -1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1),
+            1e-12)
+        loss = jnp.where(y == 1, 1.0 - cos,
+                         jnp.maximum(0.0, cos - margin))
+        return _reduce(loss, reduction)
+    return apply("cosine_embedding_loss", fwd, [input1, input2, label])
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    """Reference: functional/loss.py multi_margin_loss."""
+    ins = [input, label] + ([weight] if weight is not None else [])
+
+    def fwd(x, y, *w):
+        n, c = x.shape
+        xy = jnp.take_along_axis(x, y[:, None].astype(jnp.int32), 1)
+        m = jnp.maximum(0.0, margin - xy + x) ** p
+        if w:
+            m = m * jnp.take(w[0], y.astype(jnp.int32))[:, None]
+        m = m * (1 - jax.nn.one_hot(y, c, dtype=m.dtype))
+        loss = jnp.sum(m, -1) / c
+        return _reduce(loss, reduction)
+    return apply("multi_margin_loss", fwd, ins)
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean",
+                        name=None):
+    """Reference: functional/loss.py triplet_margin_loss."""
+    def fwd(a, pos, neg):
+        d_ap = jnp.linalg.norm(a - pos + epsilon, ord=p, axis=-1)
+        d_an = jnp.linalg.norm(a - neg + epsilon, ord=p, axis=-1)
+        if swap:
+            d_pn = jnp.linalg.norm(pos - neg + epsilon, ord=p, axis=-1)
+            d_an = jnp.minimum(d_an, d_pn)
+        loss = jnp.maximum(0.0, d_ap - d_an + margin)
+        return _reduce(loss, reduction)
+    return apply("triplet_margin_loss", fwd, [input, positive, negative])
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean",
+                                      name=None):
+    """Reference: functional/loss.py triplet_margin_with_distance_loss."""
+    if distance_function is None:
+        return triplet_margin_loss(input, positive, negative,
+                                   margin=margin, swap=swap,
+                                   reduction=reduction)
+    d_ap = distance_function(input, positive)
+    d_an = distance_function(input, negative)
+    if swap:
+        d_pn = distance_function(positive, negative)
+        d_an = d_an.minimum(d_pn) if hasattr(d_an, "minimum") else d_an
+
+    def fwd(ap, an):
+        return _reduce(jnp.maximum(0.0, ap - an + margin), reduction)
+    return apply("triplet_margin_with_distance_loss", fwd, [d_ap, d_an])
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    """Reference: functional/loss.py soft_margin_loss."""
+    def fwd(x, y):
+        return _reduce(jnp.log1p(jnp.exp(-y * x)), reduction)
+    return apply("soft_margin_loss", fwd, [input, label])
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    """Reference: functional/loss.py gaussian_nll_loss."""
+    def fwd(x, y, var):
+        var = jnp.maximum(var, epsilon)
+        loss = 0.5 * (jnp.log(var) + (x - y) ** 2 / var)
+        if full:
+            loss = loss + 0.5 * jnp.log(jnp.asarray(2 * jnp.pi, x.dtype))
+        return _reduce(loss, reduction)
+    return apply("gaussian_nll_loss", fwd, [input, label, variance])
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False, name=None):
+    """Reference: functional/loss.py ctc_loss (warpctc_kernel_impl.h).
+    log_probs [T, B, C] (log-softmaxed inside, reference semantics),
+    labels [B, L]. The alpha recursion runs as one lax.scan over time in
+    log space."""
+    def fwd(lp, lab, in_len, lab_len):
+        T, B, C = lp.shape
+        L = lab.shape[1]
+        lp = jax.nn.log_softmax(lp.astype(jnp.float32), axis=-1)
+        S = 2 * L + 1
+        lab = lab.astype(jnp.int32)
+        # extended label sequence: blank, l1, blank, l2, ... blank
+        ext = jnp.full((B, S), blank, jnp.int32)
+        ext = ext.at[:, 1::2].set(lab)
+        # allow skip (s-2 -> s) where ext[s] != blank and != ext[s-2]
+        ext_prev2 = jnp.pad(ext, ((0, 0), (2, 0)),
+                            constant_values=-1)[:, :S]
+        can_skip = (ext != blank) & (ext != ext_prev2)
+        neg_inf = jnp.asarray(-1e30, jnp.float32)
+
+        def emit(t):
+            # [B, S] log prob of emitting ext symbol at time t
+            return jnp.take_along_axis(lp[t], ext, axis=1)
+
+        alpha0 = jnp.full((B, S), neg_inf)
+        alpha0 = alpha0.at[:, 0].set(emit(0)[:, 0])
+        alpha0 = alpha0.at[:, 1].set(jnp.where(
+            lab_len > 0, emit(0)[:, 1], neg_inf))
+
+        def step(alpha, t):
+            a1 = jnp.pad(alpha, ((0, 0), (1, 0)),
+                         constant_values=-1e30)[:, :S]
+            a2 = jnp.pad(alpha, ((0, 0), (2, 0)),
+                         constant_values=-1e30)[:, :S]
+            a2 = jnp.where(can_skip, a2, neg_inf)
+            merged = jnp.logaddexp(jnp.logaddexp(alpha, a1), a2)
+            new = merged + emit(t)
+            # past input_lengths, freeze alpha (emissions don't count)
+            new = jnp.where((t < in_len)[:, None], new, alpha)
+            return new, None
+
+        alpha, _ = jax.lax.scan(step, alpha0,
+                                jnp.arange(1, T, dtype=jnp.int32))
+        # final: logaddexp of positions S-1 and S-2 at s = 2*lab_len, -1
+        idx_last = 2 * lab_len
+        a_last = jnp.take_along_axis(alpha, idx_last[:, None].astype(
+            jnp.int32), 1)[:, 0]
+        a_prev = jnp.take_along_axis(
+            alpha, jnp.maximum(idx_last - 1, 0)[:, None].astype(jnp.int32),
+            1)[:, 0]
+        ll = jnp.logaddexp(a_last, jnp.where(lab_len > 0, a_prev, neg_inf))
+        loss = -ll
+        if norm_by_times:
+            loss = loss / jnp.maximum(in_len.astype(jnp.float32), 1.0)
+        if reduction == "mean":
+            # reference/torch semantics: mean of loss / label_length
+            return jnp.mean(loss / jnp.maximum(
+                lab_len.astype(jnp.float32), 1.0))
+        return _reduce(loss, reduction)
+
+    return apply("ctc_loss", fwd,
+                 [log_probs, labels, input_lengths, label_lengths])
+
+
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.001, reduction="mean", name=None):
+    """Reference: functional/loss.py rnnt_loss (warprnnt). input
+    [B, T, U+1, V] log-softmaxed inside; alpha over the (T, U) lattice via
+    scan over T with an inner scan over U."""
+    def fwd(logits, lab, in_len, lab_len):
+        B, T, U1, V = logits.shape
+        U = U1 - 1
+        lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        lab = lab.astype(jnp.int32)
+        neg_inf = jnp.asarray(-1e30, jnp.float32)
+        # emit[b, t, u] = lp[b, t, u, lab[b, u]] for u < U
+        emit = jnp.take_along_axis(
+            lp[:, :, :U, :], lab[:, None, :, None], axis=3)[..., 0]
+        blk = lp[..., blank]                       # [B, T, U+1]
+
+        def t_step(alpha_prev, t):
+            # alpha_prev: [B, U+1] at time t-1 (or init)
+            from_blank = alpha_prev + blk[:, t - 1, :]
+
+            def u_step(carry, u):
+                # carry: alpha[t, u-1]; emit step within same t
+                a = jnp.logaddexp(from_blank[:, u],
+                                  carry + emit[:, t, u - 1])
+                return a, a
+
+            a0 = from_blank[:, 0]
+            _, rest = jax.lax.scan(u_step, a0,
+                                   jnp.arange(1, U1, dtype=jnp.int32))
+            alpha_t = jnp.concatenate([a0[:, None], rest.T], axis=1)
+            alpha_t = jnp.where((t < in_len)[:, None], alpha_t,
+                                alpha_prev)
+            return alpha_t, None
+
+        # t = 0 row: only emissions along u
+        def u0_step(carry, u):
+            a = carry + emit[:, 0, u - 1]
+            return a, a
+
+        a00 = jnp.zeros((B,), jnp.float32)
+        _, rest0 = jax.lax.scan(u0_step, a00,
+                                jnp.arange(1, U1, dtype=jnp.int32))
+        alpha0 = jnp.concatenate([a00[:, None], rest0.T], axis=1)
+        alpha0 = jnp.where(
+            jnp.arange(U1)[None, :] <= lab_len[:, None], alpha0, neg_inf)
+
+        alpha, _ = jax.lax.scan(t_step, alpha0,
+                                jnp.arange(1, T, dtype=jnp.int32))
+        # ll = alpha[in_len-1, lab_len] + blank at (in_len-1, lab_len)
+        t_last = jnp.maximum(in_len - 1, 0).astype(jnp.int32)
+        a_fin = jnp.take_along_axis(
+            alpha, lab_len[:, None].astype(jnp.int32), 1)[:, 0]
+        blk_fin = blk[jnp.arange(B), t_last, lab_len.astype(jnp.int32)]
+        loss = -(a_fin + blk_fin)
+        if reduction == "mean":
+            return jnp.mean(loss)
+        return _reduce(loss, reduction)
+
+    return apply("rnnt_loss", fwd,
+                 [input, label, input_lengths, label_lengths])
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Reference: functional/loss.py hsigmoid_loss
+    (matrix_bit_code.h SimpleCode complete-binary-tree default):
+    node(j) = (label + num_classes) >> (j+1) - 1,
+    bit(j) = ((label + num_classes) >> j) & 1."""
+    if path_table is not None or path_code is not None:
+        raise NotImplementedError(
+            "custom-tree hsigmoid (path_table/path_code) is not "
+            "implemented; the default complete-binary-tree path is")
+    depth = int(np.ceil(np.log2(max(num_classes, 2))))
+    ins = [input, label, weight] + ([bias] if bias is not None else [])
+
+    def fwd(x, y, w, *bb):
+        y = y.astype(jnp.int32).reshape(-1)
+        code = y + num_classes
+        js = jnp.arange(depth, dtype=jnp.int32)
+        nodes = (code[:, None] >> (js + 1)[None, :]) - 1   # [B, D]
+        bits = (code[:, None] >> js[None, :]) & 1          # [B, D]
+        valid = nodes >= 0
+        nodes_c = jnp.maximum(nodes, 0)
+        wn = jnp.take(w, nodes_c, axis=0)                  # [B, D, in]
+        logits = jnp.einsum("bdi,bi->bd", wn, x)
+        if bb:
+            logits = logits + jnp.take(bb[0].reshape(-1), nodes_c)
+        # P(bit) via sigmoid: loss = sum BCE(bit, logit) over valid nodes
+        bce = jnp.maximum(logits, 0) - logits * bits.astype(jnp.float32) \
+            + jnp.log1p(jnp.exp(-jnp.abs(logits)))
+        return jnp.sum(jnp.where(valid, bce, 0.0), axis=1, keepdims=True)
+
+    return apply("hsigmoid_loss", fwd, ins)
+
+
+# ---------------- pooling ----------------
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    """Reference: functional/pooling.py adaptive_avg_pool3d."""
+    from .pooling import _adaptive_pool
+    return _adaptive_pool(x, output_size, 3, "avg", data_format)
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    from .pooling import _adaptive_pool
+    assert not return_mask
+    return _adaptive_pool(x, output_size, 3, "max", "NCDHW")
+
+
+def _max_unpool(x, indices, ndim, kernel_size, stride, padding,
+                output_size, data_format):
+    """Scatter each pooled value to its argmax position (indices flat over
+    the spatial dims, reference kernel semantics)."""
+    ks = kernel_size if isinstance(kernel_size, (list, tuple)) \
+        else [kernel_size] * ndim
+    st = stride if stride is not None else ks
+    st = st if isinstance(st, (list, tuple)) else [st] * ndim
+
+    def fwd(a, idx):
+        n, c = a.shape[0], a.shape[1]
+        in_sp = a.shape[2:]
+        if output_size is not None:
+            out_sp = tuple(output_size[-ndim:])
+        else:
+            out_sp = tuple((in_sp[d] - 1) * st[d] + ks[d]
+                           for d in range(ndim))
+        flat_len = int(np.prod(out_sp))
+        out = jnp.zeros((n, c, flat_len), a.dtype)
+        flat_v = a.reshape(n, c, -1)
+        flat_i = idx.reshape(n, c, -1).astype(jnp.int32)
+        bidx = jnp.arange(n)[:, None, None]
+        cidx = jnp.arange(c)[None, :, None]
+        out = out.at[bidx, cidx, flat_i].set(flat_v)
+        return out.reshape((n, c) + out_sp)
+
+    return apply(f"max_unpool{ndim}d", fwd, [x, indices])
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    """Reference: functional/pooling.py max_unpool1d."""
+    return _max_unpool(x, indices, 1, kernel_size, stride, padding,
+                       output_size, data_format)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    """Reference: functional/pooling.py max_unpool2d."""
+    return _max_unpool(x, indices, 2, kernel_size, stride, padding,
+                       output_size, data_format)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    """Reference: functional/pooling.py max_unpool3d."""
+    return _max_unpool(x, indices, 3, kernel_size, stride, padding,
+                       output_size, data_format)

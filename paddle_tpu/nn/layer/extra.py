@@ -1,0 +1,442 @@
+"""Long-tail nn layers (reference: python/paddle/nn/layer/{activation,
+common,distance,loss,norm,pooling,vision}.py remainder + rnn.py
+RNNCellBase / BeamSearchDecoder / dynamic_decode seq2seq API).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from .. import functional as F
+from .layers import Layer
+
+__all__ = [
+    "Bilinear", "Unfold", "Fold", "Maxout", "PairwiseDistance",
+    "Softmax2D", "ThresholdedReLU", "RReLU", "PixelShuffle",
+    "PixelUnshuffle", "ChannelShuffle", "ZeroPad2D", "Unflatten",
+    "InstanceNorm1D", "InstanceNorm3D", "AdaptiveAvgPool3D",
+    "AdaptiveMaxPool3D", "MaxUnPool1D", "MaxUnPool2D", "MaxUnPool3D",
+    "PoissonNLLLoss", "MultiLabelSoftMarginLoss", "HingeEmbeddingLoss",
+    "CosineEmbeddingLoss", "MultiMarginLoss", "TripletMarginLoss",
+    "TripletMarginWithDistanceLoss", "SoftMarginLoss", "GaussianNLLLoss",
+    "CTCLoss", "RNNTLoss", "HSigmoidLoss", "RNNCellBase",
+    "BeamSearchDecoder", "dynamic_decode",
+]
+
+
+class Bilinear(Layer):
+    """Reference: nn/layer/common.py Bilinear."""
+
+    def __init__(self, in1_features, in2_features, out_features,
+                 weight_attr=None, bias_attr=None, name=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            [out_features, in1_features, in2_features], attr=weight_attr)
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [out_features], attr=bias_attr, is_bias=True)
+
+    def forward(self, x1, x2):
+        return F.bilinear(x1, x2, self.weight, self.bias)
+
+
+class Unfold(Layer):
+    def __init__(self, kernel_sizes, strides=1, paddings=0, dilations=1,
+                 name=None):
+        super().__init__()
+        self._args = (kernel_sizes, strides, paddings, dilations)
+
+    def forward(self, x):
+        k, s, p, d = self._args
+        return F.unfold(x, k, strides=s, paddings=p, dilations=d)
+
+
+class Fold(Layer):
+    def __init__(self, output_sizes, kernel_sizes, strides=1, paddings=0,
+                 dilations=1, name=None):
+        super().__init__()
+        self._args = (output_sizes, kernel_sizes, strides, paddings,
+                      dilations)
+
+    def forward(self, x):
+        o, k, s, p, d = self._args
+        return F.fold(x, o, k, strides=s, paddings=p, dilations=d)
+
+
+class Maxout(Layer):
+    def __init__(self, groups, axis=1, name=None):
+        super().__init__()
+        self._groups, self._axis = groups, axis
+
+    def forward(self, x):
+        return F.maxout(x, self._groups, self._axis)
+
+
+class PairwiseDistance(Layer):
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self._args = (p, epsilon, keepdim)
+
+    def forward(self, x, y):
+        p, e, k = self._args
+        return F.pairwise_distance(x, y, p=p, epsilon=e, keepdim=k)
+
+
+class Softmax2D(Layer):
+    """Reference: nn/layer/activation.py Softmax2D — softmax over the
+    channel dim of NCHW."""
+
+    def forward(self, x):
+        return F.softmax(x, axis=-3)
+
+
+class ThresholdedReLU(Layer):
+    def __init__(self, threshold=1.0, value=0.0, name=None):
+        super().__init__()
+        self._threshold, self._value = threshold, value
+
+    def forward(self, x):
+        return F.thresholded_relu(x, self._threshold, self._value)
+
+
+class RReLU(Layer):
+    def __init__(self, lower=1.0 / 8.0, upper=1.0 / 3.0, name=None):
+        super().__init__()
+        self._lower, self._upper = lower, upper
+
+    def forward(self, x):
+        return F.rrelu(x, self._lower, self._upper,
+                       training=self.training)
+
+
+class PixelShuffle(Layer):
+    def __init__(self, upscale_factor, data_format="NCHW", name=None):
+        super().__init__()
+        self._args = (upscale_factor, data_format)
+
+    def forward(self, x):
+        return F.pixel_shuffle(x, *self._args)
+
+
+class PixelUnshuffle(Layer):
+    def __init__(self, downscale_factor, data_format="NCHW", name=None):
+        super().__init__()
+        self._args = (downscale_factor, data_format)
+
+    def forward(self, x):
+        return F.pixel_unshuffle(x, *self._args)
+
+
+class ChannelShuffle(Layer):
+    def __init__(self, groups, data_format="NCHW", name=None):
+        super().__init__()
+        self._args = (groups, data_format)
+
+    def forward(self, x):
+        return F.channel_shuffle(x, *self._args)
+
+
+class ZeroPad2D(Layer):
+    def __init__(self, padding, data_format="NCHW", name=None):
+        super().__init__()
+        self._args = (padding, data_format)
+
+    def forward(self, x):
+        return F.zeropad2d(x, *self._args)
+
+
+class Unflatten(Layer):
+    def __init__(self, axis, shape, name=None):
+        super().__init__()
+        self._axis, self._shape = axis, shape
+
+    def forward(self, x):
+        from ... import ops
+        return ops.unflatten(x, self._axis, self._shape)
+
+
+def _instance_norm_nd(nd):
+    class _InstanceNormND(Layer):
+        def __init__(self, num_features, epsilon=1e-5, momentum=0.9,
+                     weight_attr=None, bias_attr=None, data_format=None,
+                     name=None):
+            super().__init__()
+            self._epsilon = epsilon
+            self.weight = None if weight_attr is False else \
+                self.create_parameter(
+                    [num_features], attr=weight_attr,
+                    default_initializer=lambda s, d: jnp.ones(s, d))
+            self.bias = None if bias_attr is False else \
+                self.create_parameter([num_features], attr=bias_attr,
+                                      is_bias=True)
+
+        def forward(self, x):
+            return F.instance_norm(x, weight=self.weight, bias=self.bias,
+                                   eps=self._epsilon)
+
+    _InstanceNormND.__name__ = f"InstanceNorm{nd}D"
+    _InstanceNormND.__doc__ = (
+        f"Reference: nn/layer/norm.py InstanceNorm{nd}D.")
+    return _InstanceNormND
+
+
+InstanceNorm1D = _instance_norm_nd(1)
+InstanceNorm3D = _instance_norm_nd(3)
+
+
+class AdaptiveAvgPool3D(Layer):
+    def __init__(self, output_size, data_format="NCDHW", name=None):
+        super().__init__()
+        self._args = (output_size, data_format)
+
+    def forward(self, x):
+        return F.adaptive_avg_pool3d(x, *self._args)
+
+
+class AdaptiveMaxPool3D(Layer):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__()
+        self._output_size = output_size
+
+    def forward(self, x):
+        return F.adaptive_max_pool3d(x, self._output_size)
+
+
+class _MaxUnPoolND(Layer):
+    _fn = None
+    _nd = 0
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format=None, output_size=None, name=None):
+        super().__init__()
+        self._args = (kernel_size, stride, padding, output_size)
+
+    def forward(self, x, indices):
+        k, s, p, o = self._args
+        return getattr(F, f"max_unpool{self._nd}d")(
+            x, indices, k, stride=s, padding=p, output_size=o)
+
+
+class MaxUnPool1D(_MaxUnPoolND):
+    _nd = 1
+
+
+class MaxUnPool2D(_MaxUnPoolND):
+    _nd = 2
+
+
+class MaxUnPool3D(_MaxUnPoolND):
+    _nd = 3
+
+
+def _loss_layer(name, fn, arg_names, n_inputs=2, doc=""):
+    class _LossLayer(Layer):
+        def __init__(self, **kwargs):
+            super().__init__()
+            self._kwargs = {k: kwargs.get(k, v) for k, v in arg_names}
+
+        def forward(self, *inputs):
+            return fn(*inputs[:n_inputs], **self._kwargs)
+
+    _LossLayer.__name__ = name
+    _LossLayer.__doc__ = doc or f"Reference: nn/layer/loss.py {name}."
+    return _LossLayer
+
+
+PoissonNLLLoss = _loss_layer(
+    "PoissonNLLLoss", F.poisson_nll_loss,
+    [("log_input", True), ("full", False), ("epsilon", 1e-8),
+     ("reduction", "mean")])
+MultiLabelSoftMarginLoss = _loss_layer(
+    "MultiLabelSoftMarginLoss", F.multi_label_soft_margin_loss,
+    [("weight", None), ("reduction", "mean")])
+HingeEmbeddingLoss = _loss_layer(
+    "HingeEmbeddingLoss", F.hinge_embedding_loss,
+    [("margin", 1.0), ("reduction", "mean")])
+CosineEmbeddingLoss = _loss_layer(
+    "CosineEmbeddingLoss", F.cosine_embedding_loss,
+    [("margin", 0.0), ("reduction", "mean")], n_inputs=3)
+MultiMarginLoss = _loss_layer(
+    "MultiMarginLoss", F.multi_margin_loss,
+    [("p", 1), ("margin", 1.0), ("weight", None), ("reduction", "mean")])
+TripletMarginLoss = _loss_layer(
+    "TripletMarginLoss", F.triplet_margin_loss,
+    [("margin", 1.0), ("p", 2.0), ("epsilon", 1e-6), ("swap", False),
+     ("reduction", "mean")], n_inputs=3)
+TripletMarginWithDistanceLoss = _loss_layer(
+    "TripletMarginWithDistanceLoss", F.triplet_margin_with_distance_loss,
+    [("distance_function", None), ("margin", 1.0), ("swap", False),
+     ("reduction", "mean")], n_inputs=3)
+SoftMarginLoss = _loss_layer(
+    "SoftMarginLoss", F.soft_margin_loss, [("reduction", "mean")])
+GaussianNLLLoss = _loss_layer(
+    "GaussianNLLLoss", F.gaussian_nll_loss,
+    [("full", False), ("epsilon", 1e-6), ("reduction", "mean")],
+    n_inputs=3)
+
+
+class CTCLoss(Layer):
+    """Reference: nn/layer/loss.py CTCLoss."""
+
+    def __init__(self, blank=0, reduction="mean"):
+        super().__init__()
+        self._blank, self._reduction = blank, reduction
+
+    def forward(self, log_probs, labels, input_lengths, label_lengths,
+                norm_by_times=False):
+        return F.ctc_loss(log_probs, labels, input_lengths, label_lengths,
+                          blank=self._blank, reduction=self._reduction,
+                          norm_by_times=norm_by_times)
+
+
+class RNNTLoss(Layer):
+    """Reference: nn/layer/loss.py RNNTLoss."""
+
+    def __init__(self, blank=0, fastemit_lambda=0.001, reduction="mean",
+                 name=None):
+        super().__init__()
+        self._args = (blank, fastemit_lambda, reduction)
+
+    def forward(self, input, label, input_lengths, label_lengths):
+        b, f, r = self._args
+        return F.rnnt_loss(input, label, input_lengths, label_lengths,
+                           blank=b, fastemit_lambda=f, reduction=r)
+
+
+class HSigmoidLoss(Layer):
+    """Reference: nn/layer/loss.py HSigmoidLoss (default complete binary
+    tree)."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        if is_custom:
+            raise NotImplementedError(
+                "custom-tree HSigmoidLoss is not implemented")
+        self._num_classes = num_classes
+        self.weight = self.create_parameter(
+            [num_classes - 1, feature_size], attr=weight_attr)
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [num_classes - 1], attr=bias_attr, is_bias=True)
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        return F.hsigmoid_loss(input, label, self._num_classes,
+                               self.weight, self.bias,
+                               path_table=path_table, path_code=path_code)
+
+
+# ---------------- seq2seq decoding ----------------
+
+class RNNCellBase(Layer):
+    """Reference: nn/layer/rnn.py RNNCellBase — the cell contract
+    (state shapes/init) used by RNN and the decoders."""
+
+    def get_initial_states(self, batch_ref, shape=None, dtype="float32",
+                           init_value=0.0, batch_dim_idx=0):
+        batch = batch_ref.shape[batch_dim_idx]
+        hidden = self.hidden_size
+        import jax.numpy as _jnp
+        mk = lambda: Tensor(_jnp.full((batch, hidden), init_value,
+                                      _jnp.float32))
+        if getattr(self, "state_shape", None) is not None and \
+                isinstance(self.state_shape, (list, tuple)) and \
+                len(self.state_shape) == 2:
+            return (mk(), mk())
+        return mk()
+
+
+class BeamSearchDecoder:
+    """Reference: nn/layer/rnn.py BeamSearchDecoder — beam search over an
+    RNN cell with an output projection; used through dynamic_decode.
+    Host-driven (python loop in dynamic_decode), math on device."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    def initialize(self, initial_cell_states):
+        return initial_cell_states
+
+    def _logits(self, out):
+        return self.output_fn(out) if self.output_fn is not None else out
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=100, **kwargs):
+    """Reference: nn/layer/rnn.py dynamic_decode — run a BeamSearchDecoder
+    to completion; returns (ids [B, beam, T], scores [B, beam])."""
+    import jax
+
+    cell = decoder.cell
+    K = decoder.beam_size
+    state = inits
+    # batch from the first state leaf
+    leaf = state[0] if isinstance(state, (list, tuple)) else state
+    B = leaf.shape[0]
+
+    def emb(tok):
+        if decoder.embedding_fn is not None:
+            return decoder.embedding_fn(tok)
+        return tok
+
+    neg_inf = -1e9
+    # run the first step from start tokens to seed K beams per batch
+    tok0 = Tensor(jnp.full((B,), decoder.start_token, jnp.int64))
+    out, state = cell(emb(tok0), state)
+    logp0 = jnp.asarray(
+        jax.nn.log_softmax(decoder._logits(out)._data, axis=-1))
+    V = logp0.shape[-1]
+    scores, ids = jax.lax.top_k(logp0, K)             # [B, K]
+    beam_ids = [np.asarray(ids)]
+    beam_scores = jnp.asarray(scores)                 # [B, K]
+    finished = np.asarray(ids) == decoder.end_token
+
+    def tile_state(s):
+        # [B, H] -> [B*K, H]
+        return Tensor(jnp.repeat(s._data, K, axis=0))
+
+    state = tuple(tile_state(s) for s in state) \
+        if isinstance(state, (list, tuple)) else tile_state(state)
+
+    cur_tokens = Tensor(jnp.asarray(np.asarray(ids).reshape(-1)))
+    for _ in range(max_step_num - 1):
+        if finished.all():
+            break
+        out, new_state = cell(emb(cur_tokens), state)
+        logp = jnp.asarray(jax.nn.log_softmax(
+            decoder._logits(out)._data, axis=-1)).reshape(B, K, V)
+        # finished beams only extend with end_token at no cost
+        fin = jnp.asarray(finished)[:, :, None]
+        step_scores = jnp.where(fin, neg_inf, logp)
+        step_scores = step_scores.at[:, :, decoder.end_token].set(
+            jnp.where(fin[:, :, 0], 0.0,
+                      step_scores[:, :, decoder.end_token]))
+        total = beam_scores[:, :, None] + step_scores       # [B, K, V]
+        flat = total.reshape(B, K * V)
+        beam_scores, flat_idx = jax.lax.top_k(flat, K)      # [B, K]
+        parent = np.asarray(flat_idx // V)                  # [B, K]
+        tok = np.asarray(flat_idx % V)                      # [B, K]
+        # reorder history + states by parent beam
+        beam_ids = [h[np.arange(B)[:, None], parent] for h in beam_ids]
+        beam_ids.append(tok)
+        gather = (np.arange(B)[:, None] * K + parent).reshape(-1)
+
+        def regather(s_new):
+            return Tensor(jnp.take(s_new._data, jnp.asarray(gather),
+                                   axis=0))
+
+        state = tuple(regather(s) for s in new_state) \
+            if isinstance(new_state, (list, tuple)) else regather(new_state)
+        finished = finished[np.arange(B)[:, None], parent] | \
+            (tok == decoder.end_token)
+        cur_tokens = Tensor(jnp.asarray(tok.reshape(-1)))
+
+    ids_arr = np.stack(beam_ids, axis=-1)                   # [B, K, T]
+    return (Tensor(jnp.asarray(ids_arr)),
+            Tensor(jnp.asarray(beam_scores)))

@@ -149,3 +149,8 @@ from . import text  # noqa: F401,E402
 from . import onnx  # noqa: F401,E402
 from . import quantization  # noqa: F401,E402
 from . import sparse  # noqa: F401,E402
+
+# bind the tensor methods that need the fully-assembled namespace
+from .core.tensor import Tensor as _T  # noqa: E402
+_T._late_bind()
+del _T

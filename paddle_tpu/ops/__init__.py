@@ -77,6 +77,36 @@ def _bind():
     Tensor.neg_ = lambda self: self._inplace(neg)
     Tensor.reciprocal_ = lambda self: self._inplace(reciprocal)
 
+    # remaining reference tensor_method_func names (round 4): bind
+    # namespace functions that live outside the method-source modules
+    def _late_bind():
+        import paddle_tpu as _p
+        from .inplace import addmm_, index_put_, masked_scatter_  # noqa
+        Tensor.addmm_ = addmm_
+        Tensor.put_along_axis_ = lambda self, *a, **k: self._inplace(
+            _p.put_along_axis, *a, **k)
+        Tensor.masked_scatter_ = masked_scatter_
+        Tensor.stft = lambda self, *a, **k: _p.signal.stft(self, *a, **k)
+        Tensor.istft = lambda self, *a, **k: _p.signal.istft(self, *a, **k)
+        Tensor.lu = lambda self, *a, **k: _p.linalg.lu(self, *a, **k)
+        Tensor.lu_unpack = lambda self, *a, **k: _p.linalg.lu_unpack(
+            self, *a, **k)
+        Tensor.cond = lambda self, p=None: _p.linalg.cond(self, p)
+        Tensor.householder_product =             lambda self, tau: _p.linalg.householder_product(self, tau)
+        Tensor.multinomial = lambda self, *a, **k: _p.multinomial(
+            self, *a, **k)
+        Tensor.is_complex = lambda self: _p.is_complex(self)
+        Tensor.is_floating_point = lambda self: _p.is_floating_point(self)
+        Tensor.is_integer = lambda self: _p.is_integer(self)
+        Tensor.__xor__ = lambda self, o: self.bitwise_xor(o)             if not str(self.dtype).startswith("bool") else             self.logical_xor(o)
+        Tensor.__rxor__ = Tensor.__xor__
+        Tensor.top_p_sampling = lambda self, *a, **k: _p.top_p_sampling(
+            self, *a, **k)
+        Tensor.pca_lowrank = lambda self, *a, **k: _p.linalg.pca_lowrank(
+            self, *a, **k)
+
+    Tensor._late_bind = staticmethod(_late_bind)
+
 
 _bind()
 

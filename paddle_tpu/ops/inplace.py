@@ -32,7 +32,8 @@ greater_than less_equal less_than fmax fmin maximum minimum
 heaviside copysign nextafter""".split()
 
 _OTHER = """clip scale cast cumsum cumprod tril triu transpose t squeeze
-unsqueeze flatten index_add index_fill index_put
+unsqueeze flatten index_add index_fill index_put addmm
+masked_scatter put_along_axis
 masked_fill renorm multigammaln lerp logical_not bitwise_not""".split()
 
 __all__ = []

@@ -202,3 +202,24 @@ def bincount(x, weights=None, minlength=0, name=None):
     w = weights._data if isinstance(weights, Tensor) else weights
     return Tensor(jnp.bincount(x._data, weights=w, minlength=minlength,
                                length=None))
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """Reference: python/paddle/tensor/linalg.py pca_lowrank (randomized
+    PCA). Exact thin-SVD formulation (XLA SVD is fast at these ranks):
+    returns (U, S, V) of the (optionally centered) matrix, truncated to
+    q components."""
+    arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    m, n = arr.shape[-2], arr.shape[-1]
+    if q is None:
+        q = min(6, m, n)
+
+    def fwd(a):
+        af = a.astype(jnp.float32)
+        if center:
+            af = af - af.mean(axis=-2, keepdims=True)
+        u, s, vt = jnp.linalg.svd(af, full_matrices=False)
+        return (u[..., :q], s[..., :q],
+                jnp.swapaxes(vt, -1, -2)[..., :q])
+
+    return apply("pca_lowrank", fwd, [x], nout=3)

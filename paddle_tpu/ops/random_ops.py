@@ -150,3 +150,30 @@ def standard_gamma(alpha, name=None):
     a = alpha._data if isinstance(alpha, Tensor) else jnp.asarray(alpha)
     out = _jax.random.gamma(prandom.next_key(), a.astype(jnp.float32))
     return Tensor(out, stop_gradient=True)
+
+
+def top_p_sampling(x, ps, threshold=None, seed=None, name=None):
+    """Reference: python/paddle/tensor/random.py top_p_sampling (phi
+    top_p_sampling_kernel): nucleus sampling — keep the smallest prefix of
+    the descending-sorted distribution whose mass exceeds ps, renormalize,
+    sample. x: [B, V] probabilities; ps: [B] cutoffs. Returns
+    (scores, ids) like the reference."""
+    import jax
+    xa = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    pa = ps._data if isinstance(ps, Tensor) else jnp.asarray(ps)
+    key = prandom.next_key()
+
+    order = jnp.argsort(-xa, axis=-1)
+    sorted_p = jnp.take_along_axis(xa, order, axis=-1)
+    cum = jnp.cumsum(sorted_p, axis=-1)
+    # keep tokens while the mass BEFORE them is < ps (always >= 1 token)
+    keep = (cum - sorted_p) < pa[..., None]
+    masked = jnp.where(keep, sorted_p, 0.0)
+    norm = masked / jnp.maximum(masked.sum(-1, keepdims=True), 1e-12)
+    idx_in_sorted = jax.random.categorical(
+        key, jnp.log(jnp.maximum(norm, 1e-12)), axis=-1)
+    ids = jnp.take_along_axis(order, idx_in_sorted[..., None],
+                              axis=-1)
+    scores = jnp.take_along_axis(xa, ids, axis=-1)
+    return (Tensor(scores, stop_gradient=True),
+            Tensor(ids.astype(jnp.int64), stop_gradient=True))

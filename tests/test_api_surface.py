@@ -140,3 +140,17 @@ def test_total_public_op_surface_at_least_600():
                    (paddle.audio.functional, "audio.F.")]:
         total += count(mod, p)
     assert total >= 600, f"public op surface shrank: {total} < 600"
+
+
+def test_tensor_method_surface_vs_reference():
+    """Reference tensor_method_func parity: all but the creation/util
+    names (which are namespace-level here) bind as Tensor methods."""
+    from paddle_tpu.core.tensor import Tensor
+    _has(Tensor, """abs add matmul reshape transpose sum mean max min
+        argmax argsort topk clip exp log sqrt tanh sigmoid split chunk
+        squeeze unsqueeze flatten gather scatter index_select masked_fill
+        cumsum cumprod einsum quantile lerp trunc frac diff put_along_axis
+        take_along_axis stft istft lu lu_unpack cond householder_product
+        multinomial is_complex is_floating_point is_integer addmm_
+        masked_scatter_ put_along_axis_ top_p_sampling pca_lowrank
+        sqrt_ tanh_ add_ clip_""")

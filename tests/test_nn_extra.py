@@ -254,3 +254,61 @@ def test_beam_search_decoder_greedy_consistency():
     ids4, scores4 = dynamic_decode(decoder4, inits=h0, max_step_num=6)
     assert ids4.shape[1] == 4
     assert (scores4.numpy()[:, 0] >= scores.numpy()[:, 0] - 1e-5).all()
+
+
+def test_incubate_fused_functional_namespace():
+    """Reference: python/paddle/incubate/nn/functional — fused ops as
+    single taped apply calls with composition parity."""
+    import paddle_tpu.incubate.nn.functional as IF
+
+    x = rng.randn(2, 5, 8).astype("float32")
+    y = rng.randn(2, 5, 8).astype("float32")
+    # dropout_add: eval mode = x + y
+    out = IF.fused_dropout_add(t(x), t(y), p=0.5, training=False)
+    np.testing.assert_allclose(out.numpy(), x + y, rtol=1e-6)
+
+    w = rng.randn(8, 6).astype("float32")
+    b = rng.randn(6).astype("float32")
+    out = IF.fused_linear(t(x), t(w), t(b))
+    np.testing.assert_allclose(out.numpy(), x @ w + b, rtol=1e-5,
+                               atol=1e-5)
+
+    # fused_feedforward post-LN parity vs manual composition
+    w1 = rng.randn(8, 16).astype("float32")
+    w2 = rng.randn(16, 8).astype("float32")
+    g = rng.rand(8).astype("float32") + 0.5
+    bb = rng.randn(8).astype("float32")
+    out = IF.fused_feedforward(t(x), t(w1), t(w2), ln2_scale=t(g),
+                               ln2_bias=t(bb), activation="relu").numpy()
+    h = x + np.maximum(x @ w1, 0) @ w2
+    mu, var = h.mean(-1, keepdims=True), h.var(-1, keepdims=True)
+    expect = (h - mu) / np.sqrt(var + 1e-5) * g + bb
+    np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-4)
+
+    # fused MHA: self-attention parity vs manual composition
+    E, H, D = 8, 2, 4
+    qkv_w = rng.randn(3, H, D, E).astype("float32") * 0.3
+    lin_w = rng.randn(E, E).astype("float32") * 0.3
+    out = IF.fused_multi_head_attention(
+        t(x), t(qkv_w), t(lin_w), pre_layer_norm=True).numpy()
+    xa = x
+    mu, var = xa.mean(-1, keepdims=True), xa.var(-1, keepdims=True)
+    xn = (xa - mu) / np.sqrt(var + 1e-5)
+    qkv = np.einsum("bse,thde->bsthd", xn, qkv_w)
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    s = np.einsum("bshd,bthd->bhst", q, k) / np.sqrt(D)
+    e = np.exp(s - s.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    ctx = np.einsum("bhst,bthd->bshd", p, v).reshape(2, 5, E)
+    expect = x + ctx @ lin_w
+    np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-4)
+
+    # masked decode attention
+    B, T = 2, 6
+    qx = rng.randn(B, H * D).astype("float32")
+    ckv = rng.randn(2, B, H, T, D).astype("float32")
+    o = IF.masked_multihead_attention(t(qx), t(ckv))
+    assert o.shape == [B, H * D]
+    # fused layer norm with residual returns both
+    o2, res = IF.fused_layer_norm(t(x), t(g), t(bb), residual=t(y))
+    np.testing.assert_allclose(res.numpy(), x + y, rtol=1e-6)

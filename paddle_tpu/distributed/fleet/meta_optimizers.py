@@ -1,0 +1,171 @@
+"""Fleet meta-optimizers: LocalSGD, DGC momentum, LARS momentum.
+
+Reference: python/paddle/distributed/fleet/meta_optimizers/
+{localsgd_optimizer.py:28, dgc_optimizer.py:32 (DGCMomentumOptimizer over
+the dgc_op CUDA kernels), lars_optimizer.py}. TPU-native: LocalSGD syncs
+by averaging PARAMS every k steps through the compiled collective path
+(arbitrary python cadence — no graph surgery needed); DGC's top-k
+sparsified all-reduce with error feedback (u/v local accumulators,
+momentum correction) is plain jnp the tape never sees; LARS is a
+layer-wise trust-ratio `_update`.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...optimizer.optimizer import Optimizer
+
+__all__ = ["LocalSGDOptimizer", "DGCMomentumOptimizer",
+           "LarsMomentumOptimizer"]
+
+
+class LocalSGDOptimizer:
+    """Reference: localsgd_optimizer.py LocalSGDOptimizer — run k_steps
+    local updates, then average parameters across the dp group (the
+    reference's param-allreduce sync step)."""
+
+    def __init__(self, optimizer, k_steps=1, group=None):
+        self._inner = optimizer
+        self.k_steps = int(k_steps)
+        self._group = group
+        self._step_count = 0
+
+    def __getattr__(self, name):
+        if name == "_inner":
+            raise AttributeError(name)
+        return getattr(self._inner, name)
+
+    def step(self):
+        self._inner.step()
+        self._step_count += 1
+        if self._step_count % self.k_steps == 0:
+            self._sync_params()
+
+    def _sync_params(self):
+        from ..collective import _as_group
+        from ..topology import get_hybrid_communicate_group
+        g = self._group
+        if g is None:
+            hcg = get_hybrid_communicate_group()
+            g = hcg.get_data_parallel_group()
+        n = g.nranks
+        if n <= 1:
+            return
+        from jax import shard_map
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        mesh, axis = g.mesh, g.axis
+        for p in self._inner._parameter_list:
+            arr = p._data
+            # average over the group axis: with replicated params on a
+            # single-controller mesh this is identity; on a sharded/
+            # multi-controller layout it is the LocalSGD sync proper
+            sh = getattr(arr, "sharding", None)
+            if sh is None or not hasattr(sh, "mesh"):
+                continue  # host-local replicated: nothing to average
+            spec = P(*([None] * arr.ndim))
+
+            def avg(x):
+                return jax.lax.pmean(x, axis)
+
+            p._data = shard_map(avg, mesh=mesh,
+                                in_specs=(spec,), out_specs=spec,
+                                check_vma=False)(arr)
+
+    def clear_grad(self, *a, **k):
+        self._inner.clear_grad(*a, **k)
+
+
+class DGCMomentumOptimizer(Optimizer):
+    """Reference: dgc_optimizer.py DGCMomentumOptimizer — deep gradient
+    compression: after rampup_begin_step, only the top-(1-sparsity)
+    fraction of gradient entries (by magnitude) participate in the
+    update; the residual accumulates locally with momentum correction
+    (u/v buffers), so information is delayed, not lost."""
+
+    def __init__(self, learning_rate, momentum=0.9, rampup_begin_step=0,
+                 rampup_step=1, sparsity=(0.999,), parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip, multi_precision, name)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+        self._rampup_begin_step = int(rampup_begin_step)
+        self._sparsity = list(sparsity)
+
+    def _cur_sparsity(self):
+        steps_in = self._global_step - self._rampup_begin_step
+        if steps_in < 0:
+            return None
+        idx = min(steps_in, len(self._sparsity) - 1)
+        return float(self._sparsity[idx])
+
+    def _update(self, p, w, g, lr, group):
+        sp = self._cur_sparsity()
+        if sp is None or g.ndim == 0:
+            # warmup: plain momentum SGD
+            v = self._get_accumulator("velocity", p)
+            v = self._momentum * v + g
+            self._set_accumulator("velocity", p, v)
+            if self._use_nesterov:
+                return w - lr * (g + self._momentum * v)
+            return w - lr * v
+        # DGC: u = m*u + g (momentum correction), v += u (error feedback)
+        u = self._get_accumulator("dgc_u", p)
+        vbuf = self._get_accumulator("dgc_v", p)
+        u = self._momentum * u + g
+        vbuf = vbuf + u
+        k = max(1, int(round(vbuf.size * (1.0 - sp))))
+        flat = vbuf.reshape(-1)
+        thresh = jax.lax.top_k(jnp.abs(flat), k)[0][-1]
+        mask = jnp.abs(vbuf) >= thresh
+        send = jnp.where(mask, vbuf, 0.0)    # the sparse communicated grad
+        u = jnp.where(mask, 0.0, u)          # clear sent momentum
+        vbuf = jnp.where(mask, 0.0, vbuf)    # clear sent residual
+        self._set_accumulator("dgc_u", p, u)
+        self._set_accumulator("dgc_v", p, vbuf)
+        return w - lr * send
+
+    def _materialize_param(self, p):
+        self._get_accumulator("velocity", p)
+        self._get_accumulator("dgc_u", p)
+        self._get_accumulator("dgc_v", p)
+
+
+class LarsMomentumOptimizer(Optimizer):
+    """Reference: lars_optimizer.py (phi lars_momentum kernel) — momentum
+    with a layer-wise trust ratio lr_local = lr * coeff * ||w|| /
+    (||g|| + decay * ||w||)."""
+
+    def __init__(self, learning_rate, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, parameters=None,
+                 exclude_from_weight_decay=None, epsilon=1e-9,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip,
+                         multi_precision, name)
+        self._momentum = momentum
+        self._lars_coeff = lars_coeff
+        self._lars_wd = lars_weight_decay
+        self._exclude = list(exclude_from_weight_decay or [])
+        self._epsilon = epsilon
+
+    def _update(self, p, w, g, lr, group):
+        name = getattr(p, "name", "") or ""
+        wd = 0.0 if any(tok in name for tok in self._exclude) \
+            else self._lars_wd
+        w_norm = jnp.sqrt(jnp.sum(w.astype(jnp.float32) ** 2))
+        g_norm = jnp.sqrt(jnp.sum(g.astype(jnp.float32) ** 2))
+        local_lr = jnp.where(
+            (w_norm > 0) & (g_norm > 0),
+            lr * self._lars_coeff * w_norm
+            / (g_norm + wd * w_norm + self._epsilon),
+            jnp.asarray(lr, jnp.float32))
+        v = self._get_accumulator("velocity", p)
+        v = self._momentum * v + local_lr * (g + wd * w)
+        self._set_accumulator("velocity", p, v)
+        return w - v
+
+    def _materialize_param(self, p):
+        self._get_accumulator("velocity", p)

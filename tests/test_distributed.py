@@ -514,3 +514,82 @@ def test_group_sharded_offload_masters_on_host():
         isinstance(a, np.ndarray) for a in inner._master_weights.values())
     from paddle_tpu.distributed.topology import _set_hcg
     _set_hcg(None)
+
+
+def test_dgc_momentum_converges_and_sparsifies():
+    """Reference: fleet/meta_optimizers/dgc_optimizer.py — top-k sparse
+    updates with error feedback must still converge; during rampup it is
+    plain momentum SGD."""
+    from paddle_tpu.distributed.fleet import DGCMomentumOptimizer
+
+    paddle.seed(0)
+    rng = np.random.RandomState(0)
+    W = rng.randn(16, 4).astype("float32")
+    X = rng.randn(64, 16).astype("float32")
+    Y = X @ W
+    model = nn.Linear(16, 4)
+    opt = DGCMomentumOptimizer(learning_rate=0.03, momentum=0.9,
+                               rampup_begin_step=3, sparsity=[0.5],
+                               parameters=model.parameters())
+    losses = []
+    for _ in range(150):
+        loss = F.mse_loss(model(paddle.to_tensor(X)), paddle.to_tensor(Y))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < 0.05 * losses[0], (losses[0], losses[-1])
+    # sparsity actually applied: the residual buffer is non-zero after
+    # rampup (unsent mass is kept for error feedback)
+    resid = opt._accumulators["dgc_v"]
+    assert any(np.asarray(a).any() for a in resid.values())
+
+
+def test_lars_momentum_trust_ratio():
+    """Reference: fleet lars_optimizer.py — layer-wise lr scaling."""
+    from paddle_tpu.distributed.fleet import LarsMomentumOptimizer
+
+    paddle.seed(0)
+    rng = np.random.RandomState(1)
+    W = rng.randn(8, 2).astype("float32")
+    X = rng.randn(32, 8).astype("float32")
+    Y = X @ W
+    model = nn.Linear(8, 2)
+    opt = LarsMomentumOptimizer(learning_rate=0.1, lars_coeff=0.1,
+                                parameters=model.parameters())
+    losses = []
+    for _ in range(50):
+        loss = F.mse_loss(model(paddle.to_tensor(X)), paddle.to_tensor(Y))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < 0.1 * losses[0]
+
+
+def test_localsgd_wrapper_syncs_on_cadence():
+    """Reference: localsgd_optimizer.py — k local steps, then param
+    averaging over the dp group (identity for replicated params on the
+    single-controller mesh; the cadence machinery is what's under test)."""
+    from paddle_tpu.distributed.fleet import LocalSGDOptimizer
+
+    paddle.seed(0)
+    model = nn.Linear(4, 2)
+    model = dist.DataParallel(model)
+    inner = paddle.optimizer.SGD(learning_rate=0.05,
+                                 parameters=model.parameters())
+    opt = LocalSGDOptimizer(inner, k_steps=3)
+    rng = np.random.RandomState(0)
+    X = rng.randn(16, 4).astype("float32")
+    Y = rng.randn(16, 2).astype("float32")
+    synced = {"n": 0}
+    orig = opt._sync_params
+    opt._sync_params = lambda: (synced.__setitem__("n", synced["n"] + 1),
+                                orig())[1]
+    for _ in range(7):
+        loss = F.mse_loss(model(paddle.to_tensor(X)), paddle.to_tensor(Y))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert synced["n"] == 2  # steps 3 and 6
+    assert np.isfinite(float(loss.numpy()))

@@ -13,9 +13,12 @@ from ..core.tensor import Tensor
 from .. import nn
 
 from . import datasets  # noqa: E402,F401
-from .datasets import Conll05st, Imdb, Movielens, UCIHousing  # noqa: E402,F401
+from .datasets import (  # noqa: E402,F401
+    Conll05st, Imdb, Imikolov, Movielens, UCIHousing, WMT14, WMT16,
+)
 
 __all__ = ["viterbi_decode", "ViterbiDecoder", "datasets", "Imdb",
+           "Imikolov", "WMT14", "WMT16",
            "UCIHousing", "Conll05st", "Movielens"]
 
 

@@ -15,7 +15,8 @@ import numpy as np
 
 from ..io import Dataset
 
-__all__ = ["Imdb", "UCIHousing", "Conll05st", "Movielens"]
+__all__ = ["Imdb", "UCIHousing", "Conll05st", "Movielens",
+           "Imikolov", "WMT14", "WMT16"]
 
 
 def _no_download(name, url):
@@ -320,3 +321,164 @@ class Movielens(Dataset):
 
     def __len__(self):
         return len(self._samples)
+
+
+class Imikolov(Dataset):
+    """PTB language-model dataset (reference: text/datasets/imikolov.py).
+
+    data_file: the simple-examples tar (ptb.train/valid.txt inside) or a
+    directory holding ``ptb.train.txt``/``ptb.valid.txt``. data_type
+    'NGRAM' (sliding windows of window_size) or 'SEQ' (<s> src / trg <e>
+    pairs); dict built from train+valid with min_word_freq cutoff,
+    '<unk>' last — reference semantics exactly.
+    """
+
+    URL = "https://dataset.bj.bcebos.com/imikolov%2Fsimple-examples.tgz"
+    _TRAIN = "./simple-examples/data/ptb.train.txt"
+    _VALID = "./simple-examples/data/ptb.valid.txt"
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=-1,
+                 mode="train", min_word_freq=50, download=False):
+        if data_file is None:
+            _no_download("Imikolov", self.URL)
+        data_type = data_type.upper()
+        assert data_type in ("NGRAM", "SEQ"), data_type
+        assert mode in ("train", "valid", "test")
+        self.data_type = data_type
+        self.window_size = window_size
+        self.mode = "valid" if mode == "test" else mode
+        self.min_word_freq = min_word_freq
+        train_text, valid_text = self._read_texts(data_file)
+        self.word_idx = self._build_dict(train_text, valid_text)
+        self.data = self._expand(train_text if self.mode == "train"
+                                 else valid_text)
+
+    def _read_texts(self, data_file):
+        if os.path.isdir(data_file):
+            tr = open(os.path.join(data_file, "ptb.train.txt")).read()
+            va = open(os.path.join(data_file, "ptb.valid.txt")).read()
+            return tr.splitlines(), va.splitlines()
+        with tarfile.open(data_file) as tf:
+            tr = tf.extractfile(self._TRAIN).read().decode()
+            va = tf.extractfile(self._VALID).read().decode()
+        return tr.splitlines(), va.splitlines()
+
+    def _build_dict(self, train_text, valid_text):
+        freq: dict = {}
+        for line in train_text + valid_text:
+            for w in line.strip().split():
+                freq[w] = freq.get(w, 0) + 1
+        freq.pop("<unk>", None)
+        kept = [kv for kv in freq.items() if kv[1] > self.min_word_freq]
+        kept.sort(key=lambda kv: (-kv[1], kv[0]))
+        word_idx = {w: i for i, (w, _) in enumerate(kept)}
+        word_idx["<unk>"] = len(word_idx)
+        return word_idx
+
+    def _expand(self, lines):
+        data = []
+        unk = self.word_idx["<unk>"]
+        for line in lines:
+            if self.data_type == "NGRAM":
+                assert self.window_size > -1, "Invalid gram length"
+                toks = ["<s>"] + line.strip().split() + ["<e>"]
+                if len(toks) >= self.window_size:
+                    ids = [self.word_idx.get(w, unk) for w in toks]
+                    for i in range(self.window_size, len(ids) + 1):
+                        data.append(tuple(ids[i - self.window_size:i]))
+            else:
+                ids = [self.word_idx.get(w, unk)
+                       for w in line.strip().split()]
+                src = [self.word_idx.get("<s>", unk)] + ids
+                trg = ids + [self.word_idx.get("<e>", unk)]
+                if self.window_size > 0 and len(src) > self.window_size:
+                    continue
+                data.append((src, trg))
+        return data
+
+    def __getitem__(self, idx):
+        return tuple(np.array(d) for d in self.data[idx])
+
+    def __len__(self):
+        return len(self.data)
+
+
+class WMT16(Dataset):
+    """ACL2016 multimodal MT dataset (reference: text/datasets/wmt16.py).
+
+    data_file: the wmt16 tar (wmt16/{train,val,test} tab-separated
+    en\\tde lines) or a directory with those files. Dicts are built from
+    the train split, sized to src/trg_dict_size, with <s>/<e>/<unk>
+    reserved — reference semantics. Samples: (src_ids, trg_ids,
+    trg_ids_next)."""
+
+    URL = "http://paddlemodels.bj.bcebos.com/wmt/wmt16.tar.gz"
+    START, END, UNK = "<s>", "<e>", "<unk>"
+
+    def __init__(self, data_file=None, mode="train", src_dict_size=-1,
+                 trg_dict_size=-1, lang="en", download=False):
+        if data_file is None:
+            _no_download("WMT16", self.URL)
+        assert mode in ("train", "val", "test")
+        assert src_dict_size > 0 and trg_dict_size > 0, \
+            "dict_size should be set as positive number"
+        self.mode = mode
+        self.lang = lang
+        train_lines = self._read(data_file, "train")
+        src_col = 0 if lang == "en" else 1
+        self.src_dict = self._build_dict(train_lines, src_col,
+                                         src_dict_size)
+        self.trg_dict = self._build_dict(train_lines, 1 - src_col,
+                                         trg_dict_size)
+        self._load(self._read(data_file, mode), src_col)
+
+    def _read(self, data_file, split):
+        if os.path.isdir(data_file):
+            return open(os.path.join(data_file, split)).read().splitlines()
+        with tarfile.open(data_file) as tf:
+            return tf.extractfile(f"wmt16/{split}").read() \
+                .decode().splitlines()
+
+    def _build_dict(self, lines, col, size):
+        freq: dict = {}
+        for line in lines:
+            parts = line.strip().split("\t")
+            if len(parts) != 2:
+                continue
+            for w in parts[col].split():
+                freq[w] = freq.get(w, 0) + 1
+        kept = sorted(freq.items(), key=lambda kv: (-kv[1], kv[0]))
+        words = [self.START, self.END, self.UNK] + \
+            [w for w, _ in kept[:max(size - 3, 0)]]
+        return {w: i for i, w in enumerate(words)}
+
+    def _load(self, lines, src_col):
+        s_id, e_id = self.src_dict[self.START], self.src_dict[self.END]
+        unk = self.src_dict[self.UNK]
+        self.src_ids, self.trg_ids, self.trg_ids_next = [], [], []
+        for line in lines:
+            parts = line.strip().split("\t")
+            if len(parts) != 2:
+                continue
+            src = [s_id] + [self.src_dict.get(w, unk)
+                            for w in parts[src_col].split()] + [e_id]
+            trg = [self.trg_dict.get(w, unk)
+                   for w in parts[1 - src_col].split()]
+            self.src_ids.append(src)
+            self.trg_ids.append([s_id] + trg)
+            self.trg_ids_next.append(trg + [e_id])
+
+    def get_dict(self, lang, reverse=False):
+        d = self.src_dict if lang == self.lang else self.trg_dict
+        return {v: k for k, v in d.items()} if reverse else d
+
+    def __getitem__(self, idx):
+        return (np.array(self.src_ids[idx]), np.array(self.trg_ids[idx]),
+                np.array(self.trg_ids_next[idx]))
+
+    def __len__(self):
+        return len(self.src_ids)
+
+
+WMT14 = WMT16  # reference WMT14 shares the loader contract (tar of
+# tab-separated parallel text); pass the wmt14 archive's files

@@ -646,3 +646,39 @@ def test_vision_models_surface_complete():
             ref += [a.name for a in node.names]
     missing = [n for n in ref if not hasattr(M, n)]
     assert not missing, missing
+
+
+def test_imikolov_and_wmt16_local(tmp_path):
+    """Reference: text/datasets/{imikolov,wmt16}.py — dict building,
+    ngram/seq expansion, parallel-text ids."""
+    d = tmp_path / "ptb"
+    d.mkdir()
+    text = "the cat sat\nthe dog sat on the mat\nthe cat ran\n"
+    (d / "ptb.train.txt").write_text(text)
+    (d / "ptb.valid.txt").write_text("the cat sat\n")
+    ds = paddle.text.Imikolov(data_file=str(d), data_type="NGRAM",
+                              window_size=2, mode="train",
+                              min_word_freq=1)
+    # words with freq > 1: the(6) cat(3) sat(3); '<unk>' appended last
+    assert ds.word_idx["the"] == 0 and ds.word_idx["<unk>"] == 3
+    assert len(ds) > 0
+    first = ds[0]
+    assert len(first) == 2  # window of 2
+    seq = paddle.text.Imikolov(data_file=str(d), data_type="SEQ",
+                               mode="train", min_word_freq=1)
+    src, trg = seq[0]
+    assert len(src) == len(trg)
+
+    w = tmp_path / "wmt"
+    w.mkdir()
+    (w / "train").write_text(
+        "the cat\tdie katze\na dog\tein hund\nthe dog\tder hund\n")
+    (w / "val").write_text("the cat\tdie katze\n")
+    wmt = paddle.text.WMT16(data_file=str(w), mode="val",
+                            src_dict_size=10, trg_dict_size=10)
+    src, trg, trg_next = wmt[0]
+    assert src[0] == wmt.src_dict["<s>"] and src[-1] == wmt.src_dict["<e>"]
+    assert trg_next[-1] == wmt.src_dict["<e>"]
+    assert wmt.get_dict("en")["the"] >= 3  # after reserved marks
+    rev = wmt.get_dict("de", reverse=True)
+    assert rev[wmt.trg_dict["katze"]] == "katze"

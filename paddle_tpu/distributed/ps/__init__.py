@@ -20,7 +20,7 @@ import numpy as np
 from .. import rpc
 
 __all__ = ["SparseTable", "PSServer", "PSClient", "start_server",
-           "shard_for", "GeoCommunicator"]
+           "shard_for", "GeoCommunicator", "GraphPSClient"]
 
 _tables: dict = {}
 
@@ -252,3 +252,139 @@ class GeoCommunicator:
         for rid, row in zip(ids, fresh):
             self._local[rid] = row.copy()
             self._base[rid] = row.copy()
+
+
+# ---------------- graph PS (reference: ps/table/common_graph_table.h) ---
+
+_graphs: dict = {}
+
+
+class GraphShard:
+    """One server's shard of an edge table: adjacency lists for the nodes
+    this server owns (id-hash sharding, same rule as SparseTable rows)."""
+
+    def __init__(self, name):
+        self.name = name
+        self.adj: dict = {}          # node -> np.int64 neighbor array
+        self.feat: dict = {}         # node -> np.float32 feature row
+
+    def add_edges(self, src, dst):
+        for s, d in zip(src, dst):
+            s = int(s)
+            self.adj.setdefault(s, [])
+            self.adj[s].append(int(d))
+
+    def sample(self, nodes, k, seed):
+        rng = np.random.RandomState(seed)
+        out, counts = [], []
+        for v in nodes:
+            neigh = np.asarray(self.adj.get(int(v), []), np.int64)
+            if k != -1 and len(neigh) > k:
+                neigh = rng.choice(neigh, size=k, replace=False)
+            out.append(neigh)
+            counts.append(len(neigh))
+        flat = np.concatenate(out) if out else np.empty((0,), np.int64)
+        return flat, np.asarray(counts, np.int32)
+
+
+def _gsrv_create(name):
+    _graphs[name] = GraphShard(name)
+    return True
+
+
+def _gsrv_add_edges(name, src, dst):
+    _graphs[name].add_edges(src, dst)
+    return True
+
+
+def _gsrv_sample(name, nodes, k, seed):
+    return _graphs[name].sample(nodes, k, seed)
+
+
+def _gsrv_set_feat(name, nodes, rows):
+    g = _graphs[name]
+    for v, r in zip(nodes, np.asarray(rows, np.float32)):
+        g.feat[int(v)] = r
+    return True
+
+
+def _gsrv_get_feat(name, nodes, dim):
+    g = _graphs[name]
+    return np.stack([g.feat.get(int(v), np.zeros(dim, np.float32))
+                     for v in nodes]) if len(nodes) else \
+        np.zeros((0, dim), np.float32)
+
+
+class GraphPSClient:
+    """Worker handle for the distributed graph (reference: the graph-PS
+    mode of BrpcPsClient + common_graph_table.h): edges and node features
+    shard across servers by src-id hash; neighbor sampling runs ON the
+    owning server (the reference's server-side sampling), so only sampled
+    ids cross the wire."""
+
+    def __init__(self, servers, name="graph"):
+        self.servers = list(servers)
+        self.name = name
+        for s in self.servers:
+            rpc.rpc_sync(s, _gsrv_create, args=(name,))
+
+    def _owner(self, ids):
+        return np.asarray(shard_for(np.asarray(ids, np.int64),
+                                    len(self.servers)))
+
+    def add_edges(self, src, dst):
+        src = np.asarray(src, np.int64)
+        dst = np.asarray(dst, np.int64)
+        owner = self._owner(src)
+        for k, s in enumerate(self.servers):
+            m = owner == k
+            if m.any():
+                rpc.rpc_sync(s, _gsrv_add_edges,
+                             args=(self.name, src[m].tolist(),
+                                   dst[m].tolist()))
+
+    def sample_neighbors(self, nodes, sample_size=-1, seed=0):
+        """-> (neighbors flat, counts) in input-node order."""
+        nodes = np.asarray(nodes, np.int64)
+        owner = self._owner(nodes)
+        flat_parts = [None] * len(nodes)
+        counts = np.zeros(len(nodes), np.int32)
+        for k, s in enumerate(self.servers):
+            m = owner == k
+            if not m.any():
+                continue
+            idxs = np.nonzero(m)[0]
+            fl, ct = rpc.rpc_sync(
+                s, _gsrv_sample,
+                args=(self.name, nodes[m].tolist(), sample_size, seed))
+            off = 0
+            for i, c in zip(idxs, ct):
+                flat_parts[i] = fl[off:off + c]
+                counts[i] = c
+                off += c
+        flat = np.concatenate([p for p in flat_parts if p is not None]) \
+            if any(p is not None and len(p) for p in flat_parts) \
+            else np.empty((0,), np.int64)
+        return flat, counts
+
+    def set_node_feat(self, nodes, rows):
+        nodes = np.asarray(nodes, np.int64)
+        rows = np.asarray(rows, np.float32)
+        owner = self._owner(nodes)
+        for k, s in enumerate(self.servers):
+            m = owner == k
+            if m.any():
+                rpc.rpc_sync(s, _gsrv_set_feat,
+                             args=(self.name, nodes[m].tolist(), rows[m]))
+
+    def get_node_feat(self, nodes, dim):
+        nodes = np.asarray(nodes, np.int64)
+        owner = self._owner(nodes)
+        out = np.zeros((len(nodes), dim), np.float32)
+        for k, s in enumerate(self.servers):
+            m = owner == k
+            if m.any():
+                out[m] = rpc.rpc_sync(
+                    s, _gsrv_get_feat,
+                    args=(self.name, nodes[m].tolist(), dim))
+        return out

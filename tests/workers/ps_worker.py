@@ -73,6 +73,27 @@ def main():
 
     print("PS OK", flush=True)
     print("GEO OK", flush=True)
+
+    # graph-PS: sharded edges + server-side neighbor sampling + features
+    # (reference: ps/table/common_graph_table.h graph mode)
+    gc = ps.GraphPSClient(["ps1", "ps2"], name="g")
+    src = np.array([0, 0, 0, 1, 5, 5, 9], np.int64)
+    dst = np.array([1, 2, 3, 4, 6, 7, 0], np.int64)
+    gc.add_edges(src, dst)
+    flat, counts = gc.sample_neighbors([0, 5, 9, 42], sample_size=-1)
+    assert counts.tolist() == [3, 2, 1, 0], counts
+    assert sorted(flat[:3].tolist()) == [1, 2, 3]
+    assert sorted(flat[3:5].tolist()) == [6, 7]
+    flat2, counts2 = gc.sample_neighbors([0], sample_size=2, seed=1)
+    assert counts2.tolist() == [2]
+    assert set(flat2.tolist()) <= {1, 2, 3}
+    feats = np.arange(6, dtype=np.float32).reshape(2, 3)
+    gc.set_node_feat([0, 5], feats)
+    got = gc.get_node_feat([5, 0, 42], 3)
+    np.testing.assert_allclose(got[0], feats[1])
+    np.testing.assert_allclose(got[1], feats[0])
+    np.testing.assert_allclose(got[2], 0.0)
+    print("GRAPHPS OK", flush=True)
     rpc.shutdown()
 
 

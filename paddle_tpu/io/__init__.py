@@ -2,12 +2,14 @@
 
 Reference namespace: python/paddle/io/__init__.py.
 """
-from .dataloader import DataLoader, default_collate_fn  # noqa: F401
+from .dataloader import (  # noqa: F401
+    DataLoader, default_collate_fn, get_worker_info,
+)
 from .dataset import (  # noqa: F401
-    ChainDataset, ConcatDataset, Dataset, IterableDataset, Subset,
-    TensorDataset, random_split,
+    ChainDataset, ComposeDataset, ConcatDataset, Dataset, IterableDataset,
+    Subset, TensorDataset, random_split,
 )
 from .sampler import (  # noqa: F401
     BatchSampler, DistributedBatchSampler, RandomSampler, Sampler,
-    SequenceSampler, WeightedRandomSampler,
+    SequenceSampler, SubsetRandomSampler, WeightedRandomSampler,
 )

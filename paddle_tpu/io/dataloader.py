@@ -232,3 +232,21 @@ class DataLoader:
             if not batch or (self.drop_last and len(batch) < self.batch_size):
                 return
             yield self.collate_fn(batch)
+
+
+class WorkerInfo:
+    """Reference: io/dataloader/worker.py get_worker_info."""
+
+    def __init__(self, id, num_workers, dataset):
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+
+
+_worker_info = None
+
+
+def get_worker_info():
+    """Returns the active worker's info inside a DataLoader worker, else
+    None (reference semantics; the in-process loader path returns None)."""
+    return _worker_info

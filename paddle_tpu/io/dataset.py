@@ -99,3 +99,28 @@ def random_split(dataset, lengths, generator=None):
         out.append(Subset(dataset, perm[start:start + ln].tolist()))
         start += ln
     return out
+
+
+class ComposeDataset(Dataset):
+    """Reference: io/dataloader/dataset.py ComposeDataset — zip fields of
+    several map-style datasets into one sample tuple."""
+
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+        assert self.datasets, "ComposeDataset needs at least one dataset"
+        n = len(self.datasets[0])
+        for d in self.datasets[1:]:
+            assert len(d) == n, "ComposeDataset inputs must share length"
+
+    def __len__(self):
+        return len(self.datasets[0])
+
+    def __getitem__(self, idx):
+        out = []
+        for d in self.datasets:
+            sample = d[idx]
+            if isinstance(sample, (list, tuple)):
+                out.extend(sample)
+            else:
+                out.append(sample)
+        return tuple(out)

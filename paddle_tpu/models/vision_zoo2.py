@@ -240,10 +240,55 @@ class _InceptionB(nn.Layer):
                            self.bp(x)], axis=1)
 
 
+class _ReductionB(nn.Layer):
+    """InceptionD in the reference naming: 768 -> 1280."""
+
+    def __init__(self, cin):
+        super().__init__()
+        self.b3 = nn.Sequential(_conv_bn(cin, 192, 1),
+                                _conv_bn(192, 320, 3, stride=2))
+        self.b7 = nn.Sequential(
+            _conv_bn(cin, 192, 1),
+            _conv_bn(192, 192, (1, 7), padding=(0, 3)),
+            _conv_bn(192, 192, (7, 1), padding=(3, 0)),
+            _conv_bn(192, 192, 3, stride=2))
+        self.pool = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        from .. import ops
+        return ops.concat([self.b3(x), self.b7(x), self.pool(x)], axis=1)
+
+
+class _InceptionC(nn.Layer):
+    """InceptionE in the reference naming: split 3x3 branches concat to
+    2048 channels."""
+
+    def __init__(self, cin):
+        super().__init__()
+        self.b1 = _conv_bn(cin, 320, 1)
+        self.b3_stem = _conv_bn(cin, 384, 1)
+        self.b3_a = _conv_bn(384, 384, (1, 3), padding=(0, 1))
+        self.b3_b = _conv_bn(384, 384, (3, 1), padding=(1, 0))
+        self.b33_stem = nn.Sequential(_conv_bn(cin, 448, 1),
+                                      _conv_bn(448, 384, 3, padding=1))
+        self.b33_a = _conv_bn(384, 384, (1, 3), padding=(0, 1))
+        self.b33_b = _conv_bn(384, 384, (3, 1), padding=(1, 0))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                                _conv_bn(cin, 192, 1))
+
+    def forward(self, x):
+        from .. import ops
+        h3 = self.b3_stem(x)
+        h33 = self.b33_stem(x)
+        return ops.concat([
+            self.b1(x), self.b3_a(h3), self.b3_b(h3),
+            self.b33_a(h33), self.b33_b(h33), self.bp(x)], axis=1)
+
+
 class InceptionV3(nn.Layer):
-    """Reference: vision/models/inceptionv3.py (A/reduction/B stages +
-    head; the C stages follow the same concat pattern and are represented
-    by a final 1x1 expansion to the reference's 2048 channels)."""
+    """Reference: vision/models/inceptionv3.py — full stage flow:
+    stem -> 3xA -> reductionA -> 4xB -> reductionB -> 2xC -> head
+    (channel flow 192-256-288-288-768-768x4-1280-2048-2048)."""
 
     def __init__(self, num_classes=1000, with_pool=True):
         super().__init__()
@@ -255,18 +300,23 @@ class InceptionV3(nn.Layer):
         self.a1 = _InceptionA(192, 32)
         self.a2 = _InceptionA(256, 64)
         self.a3 = _InceptionA(288, 64)
-        self.red = _ReductionA(288)
+        self.red_a = _ReductionA(288)
         self.b1 = _InceptionB(768, 128)
         self.b2 = _InceptionB(768, 160)
-        self.expand = _conv_bn(768, 2048, 1)
+        self.b3 = _InceptionB(768, 160)
+        self.b4 = _InceptionB(768, 192)
+        self.red_b = _ReductionB(768)
+        self.c1 = _InceptionC(1280)
+        self.c2 = _InceptionC(2048)
         self.pool = nn.AdaptiveAvgPool2D(1)
         self.dropout = nn.Dropout(0.5)
         self.fc = nn.Linear(2048, num_classes)
 
     def forward(self, x):
         x = self.stem(x)
-        x = self.red(self.a3(self.a2(self.a1(x))))
-        x = self.expand(self.b2(self.b1(x)))
+        x = self.red_a(self.a3(self.a2(self.a1(x))))
+        x = self.red_b(self.b4(self.b3(self.b2(self.b1(x)))))
+        x = self.c2(self.c1(x))
         return self.fc(self.dropout(self.pool(x)).flatten(1))
 
 

@@ -83,14 +83,18 @@ class TapeNode:
 
     __slots__ = ("name", "inputs", "vjp_fn", "outputs", "out_avals", "n_outputs",
                  "fwd", "const_arrs", "diff_idx", "has_aux", "tensor_vjp",
-                 "__weakref__")
+                 "lazy", "__weakref__")
 
     def __init__(self, name: str, inputs: Sequence[Any], vjp_fn: Callable,
                  outputs: Sequence[Any], fwd=None, const_arrs=None,
-                 diff_idx=None, has_aux=False, tensor_vjp=None):
+                 diff_idx=None, has_aux=False, tensor_vjp=None, lazy=False):
         self.name = name
         self.inputs = list(inputs)          # Tensor objects (diff inputs only)
         self.vjp_fn = vjp_fn                # pullback: (out_cts...) -> (in_cts...)
+        self.lazy = lazy                    # build vjp_fn on first backward:
+        # jax.vjp at dispatch time costs ~40x the forward itself (it traces
+        # + executes the op again), so the hot eager path defers it — the
+        # dygraph dispatch budget of SURVEY §3.1 is won or lost here
         # weakrefs so dead intermediate tensors don't keep whole graphs alive;
         # the node itself is kept alive by output tensors' grad_fn pointers.
         self.outputs = [weakref.ref(o) for o in outputs]
@@ -139,6 +143,25 @@ class TapeNode:
         out = apply(f"{self.name}_grad", grad_fwd,
                     list(self.inputs) + list(ct_tensors), nout=n_diff)
         return list(out) if isinstance(out, tuple) else [out]
+
+
+def _materialize_vjp(node):
+    """Build the deferred pullback from the op's saved forward + input
+    snapshot (const_arrs captured at dispatch, so later in-place mutation
+    of the inputs cannot corrupt the gradient)."""
+
+    def f(*diff_arrs):
+        merged = list(node.const_arrs)
+        for pos, a in zip(node.diff_idx, diff_arrs):
+            merged[pos] = a
+        return node.fwd(*merged)
+
+    diff_arrs = tuple(node.const_arrs[i] for i in node.diff_idx)
+    if node.has_aux:
+        _, node.vjp_fn, _ = jax.vjp(f, *diff_arrs, has_aux=True)
+    else:
+        _, node.vjp_fn = jax.vjp(f, *diff_arrs)
+    node.lazy = False
 
 
 def record_op(name: str, diff_inputs: Sequence[Any], vjp_fn: Callable,
@@ -237,6 +260,8 @@ def _run_backward(root_tensors, root_grads, retain_graph=False,
         if create_graph:
             in_cts = node.taped_vjp(cts)
         else:
+            if node.vjp_fn is None and node.lazy:
+                _materialize_vjp(node)
             if node.vjp_fn is None:
                 raise RuntimeError(
                     f"Trying to backward through op '{node.name}' a second time; "
@@ -250,6 +275,7 @@ def _run_backward(root_tensors, root_grads, retain_graph=False,
             add_grad(t, g)
         if not retain_graph and not create_graph:
             node.vjp_fn = None  # free residuals
+            node.lazy = False   # a re-backward is an error, not a rebuild
 
     # write .grad on leaves (paddle semantics: accumulate across backward calls)
     for tid, g in list(grads.items()):

@@ -124,18 +124,15 @@ def _apply_impl(name: str, fwd: Callable, inputs: Sequence[Any],
                 _nan_check(name, results)
             return results
 
-        def f(*diff_arrs):
-            merged = list(arrs)
-            for pos, a in zip(diff_idx, diff_arrs):
-                merged[pos] = a
-            return fwd(*merged)
-
-        diff_arrs = tuple(arrs[i] for i in diff_idx)
+        # hot path (SURVEY §3.1): run ONLY the forward now; the pullback
+        # is deferred to backward (autograd._materialize_vjp) — jax.vjp
+        # here would trace+execute the op a second time, ~40x the cost of
+        # the forward itself
+        out = fwd(*arrs)
         if has_aux:
-            primal, vjp_fn, aux = jax.vjp(f, *diff_arrs, has_aux=True)
+            primal, aux = out
         else:
-            primal, vjp_fn = jax.vjp(f, *diff_arrs)
-            aux = ()
+            primal, aux = out, ()
     except Exception as e:
         if isinstance(e, _passthrough_errors()):
             raise
@@ -144,9 +141,9 @@ def _apply_impl(name: str, fwd: Callable, inputs: Sequence[Any],
     primals = primal if isinstance(primal, tuple) else (primal,)
     diff_outputs = [Tensor(p, stop_gradient=False) for p in primals]
     diff_tensors = [inputs[i] for i in diff_idx]
-    autograd.record_op(name, diff_tensors, vjp_fn, diff_outputs,
+    autograd.record_op(name, diff_tensors, None, diff_outputs,
                        fwd=fwd, const_arrs=arrs, diff_idx=diff_idx,
-                       has_aux=has_aux)
+                       has_aux=has_aux, lazy=True)
     results = diff_outputs + [Tensor(a, stop_gradient=True) for a in aux]
     if _check_nan_inf:
         _nan_check(name, results)

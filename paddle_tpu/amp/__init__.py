@@ -5,5 +5,6 @@ amp/auto_cast.py:273, GradScaler amp/grad_scaler.py:578). bf16 is the TPU
 default low-precision dtype.
 """
 from . import amp_lists  # noqa: F401
+from . import debugging  # noqa: F401
 from .auto_cast import amp_guard, auto_cast, decorate  # noqa: F401
 from .grad_scaler import AmpScaler, GradScaler  # noqa: F401

@@ -1,0 +1,215 @@
+"""paddle.amp.debugging — numerics checking + operator statistics.
+
+Reference: python/paddle/amp/debugging.py (DebugMode, check_numerics,
+TensorCheckerConfig, enable/disable_tensor_checker, operator stats
+collection, compare_accuracy). TPU-native: the checks ride the dispatch
+hooks (the same seam as FLAGS_check_nan_inf) and XLA's debug_nans; op
+statistics reuse the profiler's host op tracer aggregation.
+"""
+from __future__ import annotations
+
+import contextlib
+from enum import Enum
+
+import numpy as np
+
+import jax.numpy as jnp
+
+__all__ = ["DebugMode", "TensorCheckerConfig", "check_numerics",
+           "enable_tensor_checker", "disable_tensor_checker",
+           "enable_operator_stats_collection",
+           "disable_operator_stats_collection", "collect_operator_stats",
+           "compare_accuracy", "check_layer_numerics"]
+
+
+class DebugMode(Enum):
+    CHECK_NAN_INF_AND_ABORT = 0
+    CHECK_NAN_INF = 1
+    CHECK_ALL = 4
+
+
+class TensorCheckerConfig:
+    """Reference: debugging.py TensorCheckerConfig."""
+
+    def __init__(self, enable=True,
+                 debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT,
+                 output_dir=None, checked_op_list=None,
+                 skipped_op_list=None, debug_step=None, stack_height_limit=1):
+        self.enable = enable
+        self.debug_mode = debug_mode
+        self.output_dir = output_dir
+        self.checked_op_list = set(checked_op_list or [])
+        self.skipped_op_list = set(skipped_op_list or [])
+        self.debug_step = debug_step
+
+
+def check_numerics(tensor, op_type="", var_name="",
+                   debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT):
+    """Reference: debugging.py check_numerics — returns
+    (num_nan, num_inf, num_zero) as tensors; aborts on NaN/Inf when the
+    mode says so."""
+    from ..core.tensor import Tensor
+    arr = tensor._data if isinstance(tensor, Tensor) else jnp.asarray(tensor)
+    n_nan = jnp.sum(jnp.isnan(arr)).astype(jnp.int64)
+    n_inf = jnp.sum(jnp.isinf(arr)).astype(jnp.int64)
+    n_zero = jnp.sum(arr == 0).astype(jnp.int64)
+    if debug_mode == DebugMode.CHECK_NAN_INF_AND_ABORT:
+        bad = int(n_nan) + int(n_inf)
+        if bad:
+            raise FloatingPointError(
+                f"(check_numerics) op={op_type!r} var={var_name!r}: "
+                f"{int(n_nan)} NaN, {int(n_inf)} Inf values")
+    return (Tensor(n_nan, stop_gradient=True),
+            Tensor(n_inf, stop_gradient=True),
+            Tensor(n_zero, stop_gradient=True))
+
+
+_checker_config = None
+_checker_hook_installed = False
+
+
+def _checker_hook(name, t0, t1, inputs, result=None):
+    cfg = _checker_config
+    if cfg is None or not cfg.enable or result is None:
+        return
+    if cfg.checked_op_list and name not in cfg.checked_op_list:
+        return
+    if name in cfg.skipped_op_list:
+        return
+    from ..core.tensor import Tensor
+    import jax as _jax
+    res = result if isinstance(result, (tuple, list)) else (result,)
+    for r in res:
+        arr = getattr(r, "_data", None)
+        if arr is None or isinstance(arr, _jax.core.Tracer) or \
+                not jnp.issubdtype(arr.dtype, jnp.floating):
+            continue
+        if not bool(jnp.all(jnp.isfinite(arr))):
+            msg = f"(tensor_checker) op '{name}' produced NaN/Inf"
+            if cfg.debug_mode == DebugMode.CHECK_NAN_INF_AND_ABORT:
+                raise FloatingPointError(msg)
+            print(msg)
+
+
+def enable_tensor_checker(checker_config):
+    """Reference: debugging.py enable_tensor_checker — every dispatched
+    op's outputs are checked for NaN/Inf per the config."""
+    global _checker_config
+    from ..core import dispatch as _dispatch
+    _checker_config = checker_config
+    prev = _dispatch._op_profiler
+
+    def chained(name, t0, t1, inputs, result=None):
+        if prev is not None:
+            prev(name, t0, t1, inputs, result)
+        _checker_hook(name, t0, t1, inputs, result)
+
+    chained._tensor_checker = True
+    chained._prev = prev
+    _dispatch._op_profiler = chained
+
+
+def disable_tensor_checker():
+    global _checker_config
+    from ..core import dispatch as _dispatch
+    hook = _dispatch._op_profiler
+    if hook is not None and getattr(hook, "_tensor_checker", False):
+        _dispatch._op_profiler = hook._prev
+    _checker_config = None
+
+
+_op_stats = None
+
+
+def enable_operator_stats_collection():
+    """Reference: debugging.py — count dispatched ops per dtype."""
+    global _op_stats
+    from ..core import dispatch as _dispatch
+    _op_stats = {}
+    prev = _dispatch._op_profiler
+
+    def hook(name, t0, t1, inputs, result=None):
+        if prev is not None:
+            prev(name, t0, t1, inputs, result)
+        dt = ""
+        for t in inputs:
+            d = getattr(t, "dtype", None)
+            if d is not None:
+                dt = str(d)
+                break
+        key = (name, dt)
+        _op_stats[key] = _op_stats.get(key, 0) + 1
+
+    hook._op_stats = True
+    hook._prev = prev
+    _dispatch._op_profiler = hook
+
+
+def disable_operator_stats_collection():
+    from ..core import dispatch as _dispatch
+    hook = _dispatch._op_profiler
+    if hook is not None and getattr(hook, "_op_stats", False):
+        _dispatch._op_profiler = hook._prev
+    stats = _op_stats or {}
+    if stats:
+        print("<------- op list (op, dtype, calls) ------->")
+        for (name, dt), n in sorted(stats.items()):
+            print(f"  {name:30s} {dt:18s} {n}")
+    return stats
+
+
+@contextlib.contextmanager
+def collect_operator_stats():
+    enable_operator_stats_collection()
+    try:
+        yield
+    finally:
+        disable_operator_stats_collection()
+
+
+def compare_accuracy(dump_path, another_dump_path, output_filename,
+                     loss_scale=1, dump_all_tensors=False):
+    """Reference: debugging.py compare_accuracy — compare two runs' saved
+    tensor dumps (paddle.save'd dicts of name->Tensor) and write a csv of
+    per-tensor max abs/rel error."""
+    from .. import load as _load
+    a = _load(dump_path)
+    b = _load(another_dump_path)
+    rows = ["tensor,max_abs_err,max_rel_err"]
+    for k in sorted(set(a) & set(b)):
+        va = np.asarray(a[k].numpy() if hasattr(a[k], "numpy") else a[k],
+                        np.float64)
+        vb = np.asarray(b[k].numpy() if hasattr(b[k], "numpy") else b[k],
+                        np.float64)
+        if va.shape != vb.shape:
+            rows.append(f"{k},shape-mismatch,shape-mismatch")
+            continue
+        abs_err = np.max(np.abs(va - vb)) if va.size else 0.0
+        rel = np.max(np.abs(va - vb) / (np.abs(vb) + 1e-12)) \
+            if va.size else 0.0
+        rows.append(f"{k},{abs_err:.6e},{rel:.6e}")
+    with open(output_filename, "w") as f:
+        f.write("\n".join(rows) + "\n")
+    return output_filename
+
+
+def check_layer_numerics(func):
+    """Reference: debugging.py check_layer_numerics decorator — wraps a
+    Layer.forward so inputs/outputs are numerics-checked."""
+    import functools
+
+    @functools.wraps(func)
+    def wrapper(self, *args, **kwargs):
+        for i, a in enumerate(args):
+            if hasattr(a, "_data"):
+                check_numerics(a, op_type=type(self).__name__,
+                               var_name=f"input{i}")
+        out = func(self, *args, **kwargs)
+        outs = out if isinstance(out, (tuple, list)) else (out,)
+        for i, o in enumerate(outs):
+            if hasattr(o, "_data"):
+                check_numerics(o, op_type=type(self).__name__,
+                               var_name=f"output{i}")
+        return out
+
+    return wrapper

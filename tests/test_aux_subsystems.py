@@ -312,9 +312,15 @@ def test_qat_fake_quant_trains():
     np.testing.assert_allclose(back.numpy(), x.numpy(), atol=1 / 127 + 1e-6)
 
 
-def test_onnx_export_points_to_stablehlo():
-    with pytest.raises(NotImplementedError, match="StableHLO"):
-        paddle.onnx.export(nn.Linear(2, 2), "/tmp/x")
+def test_onnx_export_works_for_sequential(tmp_path):
+    # round 4: export emits real ModelProto bytes for Sequential models;
+    # unsupported graphs still point at the StableHLO path
+    p = paddle.onnx.export(nn.Sequential(nn.Linear(2, 2)),
+                           str(tmp_path / "m"),
+                           input_spec=[paddle.static.InputSpec([1, 2])])
+    assert p.endswith(".onnx") and len(open(p, "rb").read()) > 50
+    with pytest.raises(ValueError, match="input_spec"):
+        paddle.onnx.export(nn.Sequential(nn.Linear(2, 2)), "/tmp/x")
 
 
 def test_device_namespace_and_memory_stats():

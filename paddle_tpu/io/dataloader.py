@@ -194,6 +194,7 @@ class DataLoader:
         self.prefetch_factor = prefetch_factor
         self.timeout = timeout
         self.worker_init_fn = worker_init_fn
+        self.use_buffer_reader = use_buffer_reader
         self._iterable_mode = isinstance(dataset, IterableDataset)
         self.collate_fn = collate_fn or default_collate_fn
         self._worker_collate = collate_fn or _np_collate
@@ -219,11 +220,50 @@ class DataLoader:
             return self._iter_stream()
         if self.num_workers > 0:
             return _MultiprocessIter(self)
+        if self.use_buffer_reader:
+            return self._iter_buffered()
         return self._iter_single()
 
     def _iter_single(self):
         for indices in self.batch_sampler:
             yield self.collate_fn([self.dataset[i] for i in indices])
+
+    def _iter_buffered(self):
+        """use_buffer_reader=True (reference default): a feeder thread
+        collates ahead into the native C++ BlockingQueue
+        (core/native/blocking_queue.cpp — the lod_tensor_blocking_queue.h
+        capability) so host data prep overlaps device compute."""
+        from .blocking_queue import NativeBlockingQueue
+        q = NativeBlockingQueue(capacity=max(int(self.prefetch_factor), 1))
+        err: list = []
+
+        def feeder():
+            try:
+                for indices in self.batch_sampler:
+                    q.push(self._worker_collate(
+                        [self.dataset[i] for i in indices]))
+            except Exception as e:  # surfaced on the consumer side
+                err.append(e)
+            finally:
+                q.close()
+
+        import threading
+        th = threading.Thread(target=feeder, daemon=True)
+        th.start()
+        try:
+            while True:
+                try:
+                    batch = q.pop()
+                except StopIteration:
+                    break
+                yield _to_tensors(batch)
+            if err:
+                raise RuntimeError(
+                    f"DataLoader buffered reader failed: {err[0]}") \
+                    from err[0]
+        finally:
+            q.close()
+            th.join(timeout=5)
 
     def _iter_stream(self):
         it = iter(self.dataset)

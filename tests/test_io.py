@@ -155,3 +155,70 @@ def test_worker_init_fn_runs():
     list(dl)
     seen = {flags.get(timeout=10), flags.get(timeout=10)}
     assert seen == {0, 1}
+
+
+def test_native_blocking_queue_buffered_reader():
+    """Round 4: the C++ BlockingQueue (core/native/blocking_queue.cpp)
+    behind use_buffer_reader=True — order-preserving prefetch, error
+    propagation, and direct queue semantics."""
+    import threading
+    import time
+
+    from paddle_tpu.io.blocking_queue import NativeBlockingQueue
+
+    q = NativeBlockingQueue(capacity=3)
+    N = 500
+
+    def prod():
+        for i in range(N):
+            q.push({"i": i, "x": np.full(16, i, np.float32)})
+        q.close()
+
+    th = threading.Thread(target=prod)
+    th.start()
+    seen = []
+    while True:
+        try:
+            seen.append(q.pop()["i"])
+        except StopIteration:
+            break
+    th.join()
+    assert seen == list(range(N))
+
+    # bounded: push blocks at capacity
+    q2 = NativeBlockingQueue(capacity=1)
+    q2.push(1)
+    t0 = time.time()
+    with pytest.raises(TimeoutError):
+        q2.push(2, timeout_ms=100)
+    assert time.time() - t0 >= 0.09
+
+    # DataLoader use_buffer_reader parity with the plain path
+    class DS(Dataset):
+        def __getitem__(self, i):
+            return np.full(4, i, np.float32), np.int64(i)
+
+        def __len__(self):
+            return 10
+
+    plain = [(x.numpy().copy(), y.numpy().copy()) for x, y in
+             DataLoader(DS(), batch_size=4, use_buffer_reader=False)]
+    buffered = [(x.numpy().copy(), y.numpy().copy()) for x, y in
+                DataLoader(DS(), batch_size=4, use_buffer_reader=True)]
+    assert len(plain) == len(buffered) == 3
+    for (px, py), (bx, by) in zip(plain, buffered):
+        np.testing.assert_array_equal(px, bx)
+        np.testing.assert_array_equal(py, by)
+
+    # feeder errors surface on the consumer
+    class Bad(Dataset):
+        def __getitem__(self, i):
+            if i == 5:
+                raise ValueError("boom")
+            return np.float32(i)
+
+        def __len__(self):
+            return 10
+
+    with pytest.raises(RuntimeError, match="boom"):
+        list(DataLoader(Bad(), batch_size=2, use_buffer_reader=True))

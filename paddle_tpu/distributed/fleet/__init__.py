@@ -13,7 +13,8 @@ from .mp_layers import (  # noqa: F401
     VocabParallelEmbedding,
 )
 from .pipeline import (  # noqa: F401
-    LayerDesc, PipelineLayer, PipelineParallel, SharedLayerDesc,
+    LayerDesc, PipelineLayer, PipelineParallel,
+    PipelineParallelWithInterleave, SharedLayerDesc,
 )
 from .pipeline_compiled import CompiledPipelineParallel  # noqa: F401
 from .recompute import recompute, recompute_sequential  # noqa: F401

@@ -1,19 +1,31 @@
-"""Pipeline parallelism: PipelineLayer + micro-batch schedules.
+"""Pipeline parallelism: PipelineLayer + host-scheduled micro-batch schedules.
 
 Reference: python/paddle/distributed/fleet/meta_parallel/
 pipeline_parallel.py:150 (PipelineParallel, 1F1B forward_backward_pipeline at
-:431, train_batch at :648) and parallel_layers/pp_layers.py:237
+:431, train_batch at :648), :890/:1091 (PipelineParallelWithInterleave —
+virtual-stage interleaved 1F1B) and parallel_layers/pp_layers.py:237
 (PipelineLayer segmenting).
 
-TPU-native design: on a single-controller mesh the per-rank P2P send/recv of
-the reference collapses — stages are placed on sub-meshes of the 'pipe' axis
-(each stage's parameters live on its stage devices) and activations move
-between stages as XLA device-to-device copies when the next stage's
-computation consumes them. The micro-batch schedule (fill-drain with
-gradient accumulation, the GPipe schedule) is driven from the host; within a
-stage everything can still be jit-staged. The interleaved-1F1B compiled
-variant (scan + collective_permute, SURVEY §7 'hard parts') is the planned
-upgrade path.
+TPU-native design: two complementary paths.
+
+* ``CompiledPipelineParallel`` (pipeline_compiled.py) stages the whole
+  schedule into one XLA program with ``lax.scan`` + ``ppermute`` — fastest,
+  but requires structurally identical blocks.
+* This module's ``PipelineParallel`` is the *host-scheduled* path for
+  heterogeneous models the compiled path rejects: stages own arbitrary
+  layers, the host drives micro-batches through a real 1F1B (or F-then-B /
+  interleaved-virtual-stage) schedule, and activations hop stages as XLA
+  device-to-device transfers. The tape is cut at every stage boundary so a
+  stage's saved activations are freed the moment its backward for that
+  micro-batch runs — giving 1F1B's memory bound (stage s holds at most
+  ``num_stages - s`` in-flight micro-batches, not ``M``).
+
+The schedule is executed by a dependency-driven sweep: each stage has an
+action program (warmup forwards, steady-state 1F1B pairs, cooldown
+backwards — reference pipeline_parallel.py:431); an action fires only when
+its input activation/cotangent has arrived, so the sweep is a faithful
+serialization of the parallel timetable and deadlocks are impossible for
+well-formed programs (a stalled sweep raises instead of hanging).
 """
 from __future__ import annotations
 
@@ -22,11 +34,12 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ...core.tensor import Tensor
+from ...core.autograd import backward as _tape_backward
 from ...nn import Layer, LayerList
 from ..topology import get_hybrid_communicate_group
 
 __all__ = ["LayerDesc", "SharedLayerDesc", "PipelineLayer",
-           "PipelineParallel"]
+           "PipelineParallel", "PipelineParallelWithInterleave"]
 
 
 class LayerDesc:
@@ -50,37 +63,43 @@ class SharedLayerDesc(LayerDesc):
 
 class PipelineLayer(Layer):
     """Reference: parallel_layers/pp_layers.py:237 — segments a flat layer
-    list into pipeline stages and places each stage's parameters on its
+    list into ``num_stages * num_virtual_pipeline_stages`` chunks; chunk
+    ``j`` runs on stage ``j % num_stages`` as virtual chunk ``j // S``
+    (Megatron VPP assignment), with each chunk's parameters placed on its
     stage sub-mesh."""
 
     def __init__(self, layers, num_stages=None, topology=None,
-                 seg_method="uniform", loss_fn=None, **kwargs):
+                 seg_method="uniform", loss_fn=None,
+                 num_virtual_pipeline_stages=1, **kwargs):
         super().__init__()
         descs = list(layers)
         built = [d.build() if isinstance(d, LayerDesc) else d for d in descs]
         self.run_function = built
         hcg = get_hybrid_communicate_group()
         self._num_stages = num_stages or hcg.get_pipe_parallel_world_size()
+        self._num_chunks = max(1, int(num_virtual_pipeline_stages))
         self._loss_fn = loss_fn
-        self._segments = self._segment(len(built), self._num_stages,
-                                       seg_method)
+        n_segs = self._num_stages * self._num_chunks
+        assert len(built) >= n_segs, (
+            f"{len(built)} layers cannot fill {n_segs} pipeline chunks")
+        self._segments = self._segment(len(built), n_segs, seg_method)
         self.layers = LayerList(built)
         self._place_stages(hcg)
 
     @staticmethod
-    def _segment(n_layers, n_stages, seg_method):
+    def _segment(n_layers, n_segs, seg_method):
         """Uniform segmentation (reference supports layer:regex too)."""
         bounds = [0]
-        base, extra = divmod(n_layers, n_stages)
-        for s in range(n_stages):
+        base, extra = divmod(n_layers, n_segs)
+        for s in range(n_segs):
             bounds.append(bounds[-1] + base + (1 if s < extra else 0))
         return bounds
 
     def _place_stages(self, hcg):
-        """Pin each stage's params onto its slice of the 'pipe' axis and
-        remember the per-stage shardings so forward can hand activations
-        across the stage boundary (the reference's p2p send/recv becomes an
-        XLA device-to-device transfer)."""
+        """Pin each chunk's params onto its stage's slice of the 'pipe' axis
+        and remember the per-stage shardings so the scheduler can hand
+        activations across the stage boundary (the reference's p2p
+        send/recv becomes an XLA device-to-device transfer)."""
         self._stage_shardings = [None] * self._num_stages
         mesh = hcg.mesh
         if self._num_stages <= 1 or mesh.shape.get("pipe", 1) < \
@@ -90,23 +109,29 @@ class PipelineLayer(Layer):
         for s in range(self._num_stages):
             stage_devs = devs[:, s % devs.shape[1]]
             stage_mesh = Mesh(stage_devs.reshape(-1), ("stage",))
-            sharding = NamedSharding(stage_mesh, P())
-            self._stage_shardings[s] = sharding
-            for li in range(self._segments[s], self._segments[s + 1]):
+            self._stage_shardings[s] = NamedSharding(stage_mesh, P())
+        for seg in range(len(self._segments) - 1):
+            sharding = self._stage_shardings[seg % self._num_stages]
+            for li in range(self._segments[seg], self._segments[seg + 1]):
                 for p in self.layers[li].parameters():
                     p._data = jax.device_put(p._data, sharding)
 
-    def get_stage_layers(self, stage):
-        return self.layers[self._segments[stage]:self._segments[stage + 1]]
+    def segment_layers(self, seg):
+        """Layers of global segment ``seg`` (= chunk*S + stage order)."""
+        return self.layers[self._segments[seg]:self._segments[seg + 1]]
+
+    def get_stage_layers(self, stage, chunk=0):
+        return self.segment_layers(chunk * self._num_stages + stage)
 
     def stage_of_layer(self, idx):
-        for s in range(self._num_stages):
-            if self._segments[s] <= idx < self._segments[s + 1]:
-                return s
+        for seg in range(len(self._segments) - 1):
+            if self._segments[seg] <= idx < self._segments[seg + 1]:
+                return seg % self._num_stages
         return self._num_stages - 1
 
     def _to_stage(self, x, stage):
-        sharding = self._stage_shardings[stage]
+        sharding = (self._stage_shardings[stage]
+                    if stage < len(self._stage_shardings) else None)
         if sharding is None:
             return x
         from ...core.dispatch import apply
@@ -124,14 +149,37 @@ class PipelineLayer(Layer):
         return x
 
 
-class PipelineParallel(Layer):
-    """Reference: meta_parallel/pipeline_parallel.py:150. train_batch runs
-    the GPipe fill-drain micro-batch schedule with gradient accumulation
-    (the reference's 1F1B ordering is a memory optimization of the same
-    math; the compiled single-program scan is the planned upgrade)."""
+class _Saved:
+    """In-flight forward record of one (segment, micro-batch): the leaf cut
+    at the stage boundary plus the segment output (or loss) whose tape
+    holds the activations. Dropping the record after backward is what
+    enforces the 1F1B memory bound."""
 
-    def __init__(self, layers, hcg=None, strategy=None, num_micro_batches
-                 =None):
+    __slots__ = ("x_in", "out", "bytes")
+
+    def __init__(self, x_in, out):
+        self.x_in = x_in
+        self.out = out
+        self.bytes = int(getattr(x_in._data, "nbytes", 0) +
+                         getattr(out._data, "nbytes", 0))
+
+
+class PipelineParallel(Layer):
+    """Host-scheduled pipeline runner (reference:
+    meta_parallel/pipeline_parallel.py:150; 1F1B schedule at :431).
+
+    ``schedule`` picks the micro-batch timetable (reference
+    distributed/passes/pipeline_scheduler_pass.py FThenB/1F1B):
+
+    * ``"1F1B"`` (default) — warmup forwards, steady-state one-forward-
+      one-backward, cooldown backwards; peak in-flight activations per
+      stage ``min(S - s, M)``.
+    * ``"FThenB"`` — GPipe: all forwards then all backwards; peak ``M``.
+      Kept for the memory A/B and schedule debugging.
+    """
+
+    def __init__(self, layers, hcg=None, strategy=None,
+                 num_micro_batches=None, schedule="1F1B"):
         super().__init__()
         assert isinstance(layers, PipelineLayer), \
             "PipelineParallel requires a PipelineLayer model"
@@ -141,7 +189,13 @@ class PipelineParallel(Layer):
             num_micro_batches = strategy.pipeline_configs.get(
                 "accumulate_steps", 1)
         self._num_micro_batches = num_micro_batches or 1
+        assert schedule.upper() in ("1F1B", "FTHENB"), (
+            f"unknown pipeline schedule {schedule!r}; pick '1F1B' or "
+            "'FThenB'")
+        self._schedule = schedule
+        self.last_schedule_stats = None
 
+    # -- parameter plumbing -------------------------------------------------
     def parameters(self, include_sublayers=True):
         return self._layers.parameters(include_sublayers)
 
@@ -163,28 +217,172 @@ class PipelineParallel(Layer):
         mb = b // n
         return [t[i * mb:(i + 1) * mb] for i in range(n)]
 
+    # -- schedule construction ---------------------------------------------
+    @property
+    def _v(self):
+        return self._layers._num_chunks
+
+    def _warmup(self, s, total):
+        S, v = self._layers._num_stages, self._v
+        if v == 1:
+            return min(S - 1 - s, total)
+        # Megatron interleaved warmup (pipeline_parallel.py:1091)
+        return min((S - s - 1) * 2 + (v - 1) * S, total)
+
+    def _stage_program(self, s, M):
+        total = M * self._v
+        if self._schedule.upper() == "FTHENB":
+            return ["F"] * total + ["B"] * total
+        w = self._warmup(s, total)
+        prog = ["F"] * w
+        for _ in range(total - w):
+            prog += ["F", "B"]
+        prog += ["B"] * w
+        return prog
+
+    def _f_unit(self, fi):
+        """(chunk, micro-batch) of a stage's ``fi``-th forward — the
+        Megatron interleave mapping (micro-batch groups of size S, chunks
+        cycled per group); stage-independent by construction."""
+        S, v = self._layers._num_stages, self._v
+        if v == 1:
+            return 0, fi
+        group = S * v
+        chunk = (fi % group) // S
+        mb = (fi // group) * S + (fi % S)
+        return chunk, mb
+
+    def _b_unit(self, bi):
+        S, v = self._layers._num_stages, self._v
+        if v == 1:
+            return 0, bi
+        group = S * v
+        chunk = v - 1 - ((bi % group) // S)
+        mb = (bi // group) * S + (bi % S)
+        return chunk, mb
+
+    # -- the scheduler ------------------------------------------------------
+    def _run_schedule(self, xs, ys, scaler=None):
+        """Drive every (segment, micro-batch) forward/backward in schedule
+        order. Returns the list of per-micro-batch loss floats."""
+        model = self._layers
+        S, v, M = model._num_stages, self._v, len(xs)
+        if v > 1:
+            assert M % S == 0, (
+                f"interleaved schedule needs micro-batches ({M}) divisible "
+                f"by stages ({S})")
+        n_segs = S * v
+        last_seg = n_segs - 1
+        act_ready = [dict() for _ in range(n_segs)]   # seg -> mb -> jnp act
+        grad_ready = [dict() for _ in range(n_segs)]  # seg -> mb -> jnp ct
+        saved = {}                                    # (seg, mb) -> _Saved
+        losses = [None] * M
+        loss_fn = model._loss_fn
+        # memory accounting for the 1F1B bound proof
+        live_bytes = 0
+        peak_bytes = 0
+        inflight = [0] * S
+        peak_inflight = [0] * S
+        order = []
+
+        def run_forward(s, chunk, mb):
+            nonlocal live_bytes, peak_bytes
+            seg = chunk * S + s
+            if seg == 0:
+                x_in = xs[mb]
+            else:
+                arr = act_ready[seg].pop(mb)
+                x_in = Tensor(arr, stop_gradient=False)
+                x_in.is_leaf_ = True
+            x = model._to_stage(x_in, s)
+            for layer in model.segment_layers(seg):
+                x = layer(x)
+            if seg == last_seg:
+                loss = loss_fn(x, ys[mb]) if loss_fn is not None else x
+                losses[mb] = loss.detach()
+                rec = _Saved(x_in, loss)
+            else:
+                act_ready[seg + 1][mb] = x._data
+                rec = _Saved(x_in, x)
+            saved[(seg, mb)] = rec
+            inflight[s] += 1
+            peak_inflight[s] = max(peak_inflight[s], inflight[s])
+            live_bytes += rec.bytes
+            peak_bytes = max(peak_bytes, live_bytes)
+            order.append(("F", s, chunk, mb))
+
+        def run_backward(s, chunk, mb):
+            nonlocal live_bytes
+            seg = chunk * S + s
+            rec = saved.pop((seg, mb))
+            if seg == last_seg:
+                scaled = rec.out * (1.0 / M)
+                if scaler is not None:
+                    scaled = scaler.scale(scaled)
+                _tape_backward([scaled], None)
+            else:
+                ct = grad_ready[seg].pop(mb)
+                _tape_backward([rec.out], [Tensor(ct, stop_gradient=True)])
+            if seg > 0:
+                g = rec.x_in._grad
+                assert g is not None, (
+                    f"stage {s} chunk {chunk} produced no input grad")
+                grad_ready[seg - 1][mb] = g
+                rec.x_in._grad = None
+            inflight[s] -= 1
+            live_bytes -= rec.bytes
+            order.append(("B", s, chunk, mb))
+
+        progs = [self._stage_program(s, M) for s in range(S)]
+        pos = [0] * S
+        fcnt = [0] * S
+        bcnt = [0] * S
+        while any(pos[s] < len(progs[s]) for s in range(S)):
+            progress = False
+            for s in range(S):
+                if pos[s] >= len(progs[s]):
+                    continue
+                kind = progs[s][pos[s]]
+                if kind == "F":
+                    chunk, mb = self._f_unit(fcnt[s])
+                    seg = chunk * S + s
+                    if seg == 0 or mb in act_ready[seg]:
+                        run_forward(s, chunk, mb)
+                        fcnt[s] += 1
+                        pos[s] += 1
+                        progress = True
+                else:
+                    chunk, mb = self._b_unit(bcnt[s])
+                    seg = chunk * S + s
+                    if seg == last_seg or mb in grad_ready[seg]:
+                        run_backward(s, chunk, mb)
+                        bcnt[s] += 1
+                        pos[s] += 1
+                        progress = True
+            if not progress:
+                state = [(s, pos[s], len(progs[s])) for s in range(S)]
+                raise RuntimeError(
+                    f"pipeline schedule deadlock (stage,pos,len)={state}")
+        self.last_schedule_stats = {
+            "schedule": self._schedule,
+            "num_stages": S, "num_chunks": v, "num_micro_batches": M,
+            "peak_live_activation_bytes": peak_bytes,
+            "peak_inflight_per_stage": peak_inflight,
+            "order": order,
+        }
+        return losses
+
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
-        """Reference: pipeline_parallel.py:648 (train_batch) — returns the
-        mean micro-batch loss; gradients are accumulated across
-        micro-batches before one optimizer step."""
+        """Reference: pipeline_parallel.py:648 (train_batch) — drives the
+        1F1B schedule, accumulates grads across micro-batches, then takes
+        one optimizer step. Returns the mean micro-batch loss."""
         from .. import watchdog as _watchdog
         _watchdog.beat()
         x, y = data
         n = self._num_micro_batches
         xs = self._split_micro(x, n)
         ys = self._split_micro(y, n)
-        total = 0.0
-        losses = []
-        for xm, ym in zip(xs, ys):
-            out = self._layers(xm)
-            loss_fn = self._layers._loss_fn
-            loss = loss_fn(out, ym) if loss_fn is not None else out
-            scaled = loss * (1.0 / n)
-            if scaler is not None:
-                scaler.scale(scaled).backward()
-            else:
-                scaled.backward()
-            losses.append(loss)
+        losses = self._run_schedule(xs, ys, scaler=scaler)
         if scaler is not None:
             scaler.step(optimizer)
             scaler.update()
@@ -202,3 +400,21 @@ class PipelineParallel(Layer):
         if compute_loss and self._layers._loss_fn is not None:
             return self._layers._loss_fn(out, y)
         return out
+
+
+class PipelineParallelWithInterleave(PipelineParallel):
+    """Virtual-stage interleaved 1F1B (reference:
+    pipeline_parallel.py:890 PipelineParallelWithInterleave, schedule at
+    :1091). The model must be a :class:`PipelineLayer` built with
+    ``num_virtual_pipeline_stages > 1``; stage ``s`` then owns chunks
+    ``s, s+S, ...`` and the schedule interleaves their micro-batches to
+    shrink the pipeline bubble from ``(S-1)/M`` toward ``(S-1)/(M*v)``."""
+
+    def __init__(self, layers, hcg=None, strategy=None,
+                 num_micro_batches=None):
+        super().__init__(layers, hcg=hcg, strategy=strategy,
+                         num_micro_batches=num_micro_batches,
+                         schedule="1F1B")
+        assert layers._num_chunks > 1, (
+            "PipelineParallelWithInterleave needs a PipelineLayer with "
+            "num_virtual_pipeline_stages > 1")

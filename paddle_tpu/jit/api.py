@@ -33,6 +33,53 @@ __all__ = ["to_static", "not_to_static", "InputSpec", "StaticFunction",
            "ignore_module"]
 
 
+class _EagerFallback(Exception):
+    """Internal: this input signature graph-broke before — skip tracing."""
+
+
+class _break_key_scope:
+    """Tags exceptions escaping a trace/execute region with the cache key
+    they broke under, so __call__ blacklists the right signature even when
+    nested calls of the same StaticFunction are in flight."""
+
+    def __init__(self, key):
+        self._key = key
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, etype, e, tb):
+        if e is not None and not isinstance(e, _EagerFallback) \
+                and not hasattr(e, "_pd_break_key"):
+            try:
+                e._pd_break_key = self._key
+            except AttributeError:
+                pass
+        return False
+
+
+def _is_graph_break(e):
+    """True when the exception means 'this python cannot be staged' (not
+    'the user program is wrong'): our converter's explicit GraphBreak plus
+    jax's trace-time concretization family (data-dependent bool/int/array
+    use of a tracer, leaked tracers from side-effecty code). The dispatch
+    layer wraps jax errors with op context (`raise ... from e`), so walk
+    the cause chain. StaticFunction degrades to eager on these — the SOT
+    graph-break analog."""
+    from .dy2static import GraphBreak
+    kinds = (GraphBreak, jax.errors.ConcretizationTypeError,
+             jax.errors.TracerArrayConversionError,
+             jax.errors.TracerIntegerConversionError,
+             jax.errors.UnexpectedTracerError)
+    seen = 0
+    while e is not None and seen < 10:
+        if isinstance(e, kinds):
+            return True
+        e = e.__cause__
+        seen += 1
+    return False
+
+
 class InputSpec:
     """Reference: paddle.static.InputSpec — shape may contain None for
     dynamic dims (compiled polymorphically via jax.export symbolic shapes
@@ -126,13 +173,15 @@ class StaticFunction:
     jit/dy2static/program_translator.py ASTStaticFunction analog)."""
 
     def __init__(self, function, input_spec=None, capture=None,
-                 build_strategy=None, backend=None, full_graph=True,
+                 build_strategy=None, backend=None, full_graph=False,
                  donate_state=True, convert_control_flow=True):
         from ..nn import Layer
         self._raw_fn = function
         self._input_spec = input_spec
         self._capture = list(capture) if capture is not None else None
         self._donate_state = donate_state
+        self._full_graph = full_graph
+        self._broken_keys = set()  # input signatures that graph-broke
         self._cache = {}
         self._layer = None
         if isinstance(function, Layer):
@@ -186,11 +235,49 @@ class StaticFunction:
         return params, buffers, slots, layers, opts
 
     def __call__(self, *args, **kwargs):
+        try:
+            if self._capture is not None:
+                from ..distributed import watchdog as _watchdog
+                _watchdog.beat()  # collective-hang watchdog (if armed)
+                return self._call_whole_step(args, kwargs)
+            return self._call_forward(args, kwargs)
+        except _EagerFallback:
+            return self._eager_fallback(args, kwargs)
+        except Exception as e:
+            if self._full_graph or not _is_graph_break(e):
+                raise
+            import warnings
+            first_line = (str(e).splitlines() or [""])[0]
+            warnings.warn(
+                f"to_static: falling back to eager for "
+                f"{getattr(self._raw_fn, '__name__', self._raw_fn)} — "
+                f"{type(e).__name__}: {first_line[:200]} "
+                "(graph-break fallback; pass full_graph=True to make this "
+                "an error)", stacklevel=2)
+            # cache the break per input signature (SOT's guarded-subgraph
+            # analog): this call pattern skips tracing from now on, while
+            # other shapes/paths that staged fine keep their compiled
+            # entry. The key rides on the exception (not instance state) so
+            # nested calls of the same StaticFunction can't clobber it.
+            key = getattr(e, "_pd_break_key", None)
+            if key is not None:
+                self._broken_keys.add(key)
+                self._cache.pop(key, None)  # entry never executed compiled
+            return self._eager_fallback(args, kwargs)
+
+    def _eager_fallback(self, args, kwargs):
+        """SOT-analog graph break (reference jit/sot/translate.py:31): the
+        region that refused to stage runs eagerly. The converted fn keeps
+        exact python semantics for concrete predicates, so correctness is
+        unchanged — only staging is lost. Caveat (inherent to trace-then-
+        rerun, unlike SOT's pre-execution bytecode split): the breaking
+        call runs the function's python twice, so side effects before the
+        break repeat; tracked params/buffers are restored by the trace's
+        ``finally`` so tensor state is safe."""
         if self._capture is not None:
             from ..distributed import watchdog as _watchdog
-            _watchdog.beat()  # collective-hang watchdog (if armed)
-            return self._call_whole_step(args, kwargs)
-        return self._call_forward(args, kwargs)
+            _watchdog.beat()
+        return self._fn(*args, **kwargs)
 
     # -- mode 1: compiled forward on the eager tape --
     def _call_forward(self, args, kwargs):
@@ -203,30 +290,34 @@ class StaticFunction:
                      _amp_key())
         cache_key = _static_key(skel, params + buffers + arg_tensors,
                                 key_extra)
-        entry = self._cache.get(cache_key)
-        if entry is None:
-            entry = self._build_forward(skel, params, buffers, len(arg_tensors))
-            self._cache[cache_key] = entry
-        jitted, n_buf, meta = entry
-        rng_key = _random.next_key()
+        if cache_key in self._broken_keys:
+            raise _EagerFallback
+        with _break_key_scope(cache_key):
+            entry = self._cache.get(cache_key)
+            if entry is None:
+                entry = self._build_forward(skel, params, buffers,
+                                            len(arg_tensors))
+                self._cache[cache_key] = entry
+            jitted, n_buf, meta = entry
+            rng_key = _random.next_key()
 
-        ins = params + arg_tensors
-        if n_buf:
+            ins = params + arg_tensors
+            if n_buf:
+                out = apply("to_static", lambda *arrs: jitted(
+                    arrs[:len(params)],
+                    [b._data for b in buffers],
+                    arrs[len(params):], rng_key), ins, has_aux=True)
+                out = list(out) if isinstance(out, tuple) else [out]
+                # trailing aux outputs are the updated buffer values
+                new_bufs = out[-n_buf:]
+                outputs = out[:-n_buf]
+                for b, nb in zip(buffers, new_bufs):
+                    b._data = nb._data
+                return _tree_rebuild(meta["out_skel"], outputs, lambda t: t)
             out = apply("to_static", lambda *arrs: jitted(
-                arrs[:len(params)],
-                [b._data for b in buffers],
-                arrs[len(params):], rng_key), ins, has_aux=True)
-            out = list(out) if isinstance(out, tuple) else [out]
-            # trailing aux outputs are the updated buffer values
-            new_bufs = out[-n_buf:]
-            outputs = out[:-n_buf]
-            for b, nb in zip(buffers, new_bufs):
-                b._data = nb._data
+                arrs[:len(params)], [], arrs[len(params):], rng_key), ins)
+            outputs = list(out) if isinstance(out, tuple) else [out]
             return _tree_rebuild(meta["out_skel"], outputs, lambda t: t)
-        out = apply("to_static", lambda *arrs: jitted(
-            arrs[:len(params)], [], arrs[len(params):], rng_key), ins)
-        outputs = list(out) if isinstance(out, tuple) else [out]
-        return _tree_rebuild(meta["out_skel"], outputs, lambda t: t)
 
     def _build_forward(self, skel, params, buffers, n_args):
         fn = self._fn
@@ -281,6 +372,8 @@ class StaticFunction:
                      training, _amp_key())
         cache_key = _static_key(skel, params + buffers + arg_tensors,
                                 key_extra)
+        if cache_key in self._broken_keys:
+            raise _EagerFallback
         entry = self._cache.get(cache_key)
         if entry is None:
             entry = self._build_whole_step(skel, params, buffers, slots,
@@ -313,9 +406,10 @@ class StaticFunction:
                                         [_aval(t._data) for t in
                                          arg_tensors],
                                         _aval(rng_key), _aval(lrs)))
-        out_arrs, new_state = jitted(state_in,
-                                     [t._data for t in arg_tensors],
-                                     rng_key, lrs)
+        with _break_key_scope(cache_key):  # tracing happens at this call
+            out_arrs, new_state = jitted(state_in,
+                                         [t._data for t in arg_tensors],
+                                         rng_key, lrs)
         if meta.get("unstaged_accumulators"):
             raise RuntimeError(
                 "optimizer state was created during tracing and cannot be "
@@ -460,18 +554,24 @@ class StaticFunction:
 
 
 def to_static(function=None, input_spec=None, build_strategy=None,
-              backend=None, full_graph=True, capture=None, **kwargs):
+              backend=None, full_graph=False, capture=None, **kwargs):
     """Reference: python/paddle/jit/api.py:171 (paddle.jit.to_static).
+
+    ``full_graph=False`` (default, reference SOT semantics) falls back to
+    eager with a warning when tracing hits an unstageable construct;
+    ``full_graph=True`` makes that a hard error.
 
     ``capture=(model, optimizer, ...)`` enables whole-train-step staging —
     see module docstring."""
     def decorate(fn):
         from ..nn import Layer
         if isinstance(fn, Layer):
-            static = StaticFunction(fn, input_spec, capture)
+            static = StaticFunction(fn, input_spec, capture,
+                                    full_graph=full_graph)
             fn.forward = static
             return fn
-        return StaticFunction(fn, input_spec, capture)
+        return StaticFunction(fn, input_spec, capture,
+                              full_graph=full_graph)
 
     if function is not None:
         return decorate(function)

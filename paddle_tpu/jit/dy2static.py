@@ -40,9 +40,17 @@ from .control_flow import cond as _cond
 from .control_flow import while_loop as _while_loop
 
 __all__ = ["convert_to_static", "convert_ifelse", "convert_while",
-           "unsupported_in_converted_block"]
+           "unsupported_in_converted_block", "GraphBreak"]
 
 _MISSING = object()  # name unbound on a branch/loop path
+
+
+class GraphBreak(NotImplementedError):
+    """Raised when tracing reaches a construct the static path cannot
+    stage (return/break/continue under a traced predicate, data-dependent
+    python). StaticFunction catches it and re-runs the region eagerly —
+    the reference's SOT graph-break fallback (jit/sot/translate.py:31),
+    where unsupported bytecode splits the graph instead of failing."""
 
 
 def _is_traced_bool(x):
@@ -89,11 +97,11 @@ def convert_while(cond_fn, body_fn, loop_vars):
 
 
 def unsupported_in_converted_block(kind):
-    raise NotImplementedError(
-        f"'{kind}' inside a tensor-dependent if/while is not supported by "
+    raise GraphBreak(
+        f"'{kind}' inside a tensor-dependent if/while cannot be staged by "
         "the dy2static converter (reference break_continue_transformer "
-        "capability); restructure with boolean state or paddle.static.nn "
-        "control-flow ops")
+        "capability); to_static falls back to eager for this call "
+        "(full_graph=True turns this into a hard error)")
 
 
 def assert_concrete_pred(pred, kind):

@@ -121,14 +121,87 @@ def test_early_return_with_concrete_pred_ok():
     np.testing.assert_allclose(sf(t([1.0]), False).numpy(), [3.0])
 
 
-def test_early_return_with_tensor_pred_raises():
+def test_early_return_with_tensor_pred_falls_back():
+    """Graph-break fallback (reference SOT, jit/sot/translate.py:31):
+    return-under-traced-predicate executes eagerly with a warning instead
+    of raising; the break decision is cached across calls."""
+    calls = []
+
+    def f(x):
+        calls.append(1)
+        if x.sum() > 0:
+            return x * 2.0
+        return x * 3.0
+
+    sf = to_static(f)
+    with pytest.warns(UserWarning, match="falling back to eager"):
+        out = sf(t([1.0]))
+    np.testing.assert_allclose(out.numpy(), [2.0])
+    # the breaking call runs the python twice (partial trace + eager rerun)
+    assert len(calls) == 2
+    # both branches correct eagerly, no second warning (partition cached)
+    import warnings as _w
+    with _w.catch_warnings():
+        _w.simplefilter("error")
+        np.testing.assert_allclose(sf(t([-1.0])).numpy(), [-3.0])
+    assert len(calls) == 3  # cached break: eager only, no re-trace
+    assert sf._broken_keys
+
+
+def test_early_return_full_graph_still_raises():
     def f(x):
         if x.sum() > 0:
             return x * 2.0
         return x * 3.0
 
     with pytest.raises(NotImplementedError, match="return"):
-        to_static(f)(t([1.0]))
+        to_static(f, full_graph=True)(t([1.0]))
+
+
+def test_data_dependent_python_falls_back():
+    """float()/item() on a traced tensor (jax ConcretizationTypeError)
+    breaks the graph instead of erroring."""
+    def f(x):
+        s = float(x.sum())     # data-dependent python
+        return x * s
+
+    sf = to_static(f)
+    with pytest.warns(UserWarning, match="falling back to eager"):
+        out = sf(t([2.0, 3.0]))
+    np.testing.assert_allclose(out.numpy(), [10.0, 15.0])
+
+
+def test_fallback_preserves_autograd():
+    """The eager fallback still participates in the tape: grads flow."""
+    def f(x):
+        if x.sum() > 0:
+            return (x * 2.0).sum()
+        return (x * 3.0).sum()
+
+    sf = to_static(f)
+    x = t([1.0, 2.0])
+    x.stop_gradient = False
+    with pytest.warns(UserWarning):
+        loss = sf(x)
+    loss.backward()
+    np.testing.assert_allclose(np.asarray(x._grad), [2.0, 2.0])
+
+
+def test_break_in_tensor_loop_falls_back():
+    def f(x, n):
+        i = 0
+        acc = x
+        while i < int(n.sum()):
+            acc = acc + x
+            if (acc.sum() > 6).item():
+                break
+            i += 1
+        return acc
+
+    sf = to_static(f)
+    with pytest.warns(UserWarning, match="falling back to eager"):
+        out = sf(t([2.0]), t([5.0]))
+    np.testing.assert_allclose(out.numpy(), [8.0])
 
 
 def test_if_in_layer_forward():

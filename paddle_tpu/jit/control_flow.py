@@ -66,6 +66,32 @@ def _recording(rec):
         _dispatch._cf_recorder = prev
 
 
+@contextlib.contextmanager
+def _patched_reads(reads, read_arrs):
+    """Temporarily point externally-captured Tensors at the traced arrays
+    that represent them inside a lax region (shared by cond, scan_loop and
+    the differentiable while path)."""
+    saved = [(t, t._data) for t in reads]
+    try:
+        for t, a in zip(reads, read_arrs):
+            t._data = a
+        yield
+    finally:
+        for t, a in saved:
+            t._data = a
+
+
+def _check_same_state(skel, tensors, out, what):
+    """Body outputs must match the loop-var structure/shapes/dtypes."""
+    out_list = list(out) if isinstance(out, (tuple, list)) else [out]
+    new_tensors, new_skel = _flatten(out_list)
+    if _skel_sig(new_skel, new_tensors) != _skel_sig(skel, tensors):
+        raise ValueError(
+            f"{what} must return the same structure/shapes as loop_vars: "
+            f"{_skel_sig(skel, tensors)} vs {_skel_sig(new_skel, new_tensors)}")
+    return new_tensors
+
+
 def _flatten(out):
     """Flatten a branch output pytree into (tensors, skeleton)."""
     from .api import _tree_flatten
@@ -141,17 +167,11 @@ def cond(pred, true_fn=None, false_fn=None, name=None, return_names=None):
     def fwd(pred_a, *read_arrs):
         def make(branch_fn):
             def run(read_vals):
-                saved = [(t, t._data) for t in reads]
-                try:
-                    for t, a in zip(reads, read_vals):
-                        t._data = a
+                with _patched_reads(reads, read_vals):
                     with autograd.no_grad():
                         out = branch_fn()
                     tensors, _ = _flatten(out)
                     return tuple(x._data for x in tensors)
-                finally:
-                    for t, a in saved:
-                        t._data = a
             return run
 
         res = jax.lax.cond(_scalar_pred(pred_a), make(true_fn),
@@ -172,59 +192,141 @@ def _loop_state(loop_vars):
     return vars_list, tensors, skel, as_seq
 
 
-def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
-    """Reference: paddle.static.nn.while_loop (control_flow.py:1384).
+def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None,
+               max_steps=None):
+    """Reference: paddle.static.nn.while_loop (control_flow.py:1384);
+    backward capability: while_op.cc WhileGrad.
 
     cond_fn(*loop_vars) -> boolean Tensor; body_fn(*loop_vars) -> updated
-    loop_vars (same structure/shapes). Under tracing this lowers to
-    lax.while_loop — forward-only (use scan_loop for differentiable
-    bounded loops); eager it is a plain python loop (fully taped).
+    loop_vars (same structure/shapes). Eager it is a plain python loop
+    (fully taped, so backward just works). Under tracing it lowers to
+    lax.while_loop — forward-only — UNLESS ``max_steps`` is given, in
+    which case the differentiable bounded-unroll-with-mask path runs: a
+    ``lax.scan`` of ``max_steps`` iterations where finished iterations
+    pass state through unchanged (``where(active, body(s), s)``). The
+    result and its gradient equal the true loop's for any trip count
+    <= max_steps — the TPU rebuild of WhileGrad (XLA's while has no
+    reverse-mode, so the bound is what buys differentiability).
+
+    Caveat (standard masked-unroll): body_fn keeps executing on the final
+    state after the predicate goes false (results discarded); it must not
+    produce NaN/Inf there, or the zeros-times-NaN in backward poisons
+    gradients.
     """
     vars_list, tensors, skel, as_seq = _loop_state(loop_vars)
 
     pred0 = cond_fn(*vars_list)
     if not _is_traced(pred0, *tensors):
+        steps = 0
         while bool(np.asarray(pred0._data if isinstance(pred0, Tensor)
                               else pred0)):
+            if max_steps is not None and steps >= max_steps:
+                break  # same hard bound as the traced masked-unroll path
             out = body_fn(*vars_list)
             vars_list, tensors, new_skel, _ = _loop_state(
                 out if isinstance(out, (list, tuple)) else [out])
             pred0 = cond_fn(*vars_list)
+            steps += 1
         return vars_list if as_seq else vars_list[0]
+
+    if max_steps is not None and autograd.is_grad_enabled():
+        # the traced-but-stop-gradient case (to_static lifts args as
+        # stop_gradient tensors and differentiates the whole program
+        # functionally) cannot be told apart from genuinely non-diff use,
+        # so with a bound given and grad on, take the differentiable
+        # path — identical forward semantics for any trip count <=
+        # max_steps. Under no_grad the early-exiting lax.while_loop below
+        # is strictly cheaper (inference decode loops).
+        return _while_loop_grad(cond_fn, body_fn, vars_list, tensors, skel,
+                                as_seq, int(max_steps))
 
     if autograd.is_grad_enabled() and any(_dispatch._is_diff(t)
                                           for t in tensors):
         raise RuntimeError(
-            "while_loop over traced tensors is forward-only (XLA's while "
-            "has no reverse-mode autodiff). Wrap in paddle.no_grad(), mark "
-            "loop vars stop_gradient, or use paddle.static.nn.scan_loop "
-            "(bounded, differentiable).")
+            "while_loop over traced tensors is forward-only (XLA's "
+            "while has no reverse-mode autodiff). Pass max_steps=N for "
+            "the differentiable masked-unroll path, wrap in "
+            "paddle.no_grad(), mark loop vars stop_gradient, or use "
+            "paddle.static.nn.scan_loop (bounded, differentiable).")
 
     def run(flat):
-        def c(flat_vals):
+        def c(carry):
+            step, flat_vals = carry
             vs = _rebuild(skel, flat_vals)
             with autograd.no_grad():
                 p = cond_fn(*vs)
-            return _scalar_pred(p._data if isinstance(p, Tensor) else p)
+            p_arr = _scalar_pred(p._data if isinstance(p, Tensor) else p)
+            if max_steps is not None:  # same hard bound as the other modes
+                p_arr = jnp.logical_and(p_arr, step < max_steps)
+            return p_arr
 
-        def b(flat_vals):
+        def b(carry):
+            step, flat_vals = carry
             vs = _rebuild(skel, flat_vals)
             with autograd.no_grad():
                 out = body_fn(*vs)
-            out_list = list(out) if isinstance(out, (list, tuple)) else [out]
-            new_tensors, new_skel = _flatten(out_list)
-            if _skel_sig(new_skel, new_tensors) != _skel_sig(skel, tensors):
-                raise ValueError(
-                    "while_loop body must return the same structure/shapes "
-                    f"as loop_vars: {_skel_sig(skel, tensors)} vs "
-                    f"{_skel_sig(new_skel, new_tensors)}")
-            return tuple(t._data for t in new_tensors)
+            new_tensors = _check_same_state(skel, tensors, out,
+                                            "while_loop body")
+            return step + 1, tuple(t._data for t in new_tensors)
 
-        return jax.lax.while_loop(c, b, tuple(flat))
+        _, final = jax.lax.while_loop(
+            c, b, (jnp.asarray(0, jnp.int32), tuple(flat)))
+        return final
 
     res = run([t._data for t in tensors])
     out_vars = _rebuild(skel, res,
                         wrap=lambda a: Tensor(a, stop_gradient=True))
+    return out_vars if as_seq else out_vars[0]
+
+
+def _while_loop_grad(cond_fn, body_fn, vars_list, tensors, skel, as_seq,
+                     max_steps):
+    """Differentiable while: scan of ``max_steps`` masked steps (reference
+    WhileGrad capability, while_op.cc). Each step evaluates the predicate
+    on the live state; once false, subsequent steps are identity, so the
+    final carry equals the true loop result and jax.vjp through the scan
+    yields exactly the loop's gradient (inactive steps contribute identity
+    cotangent propagation)."""
+    rec = _ReadRecorder()
+    with _recording(rec), autograd.no_grad():
+        probe = body_fn(*vars_list)
+        cond_fn(*vars_list)
+    _check_same_state(skel, tensors, probe, "while_loop body")
+    reads = [t for t in rec.reads.values()
+             if not any(t is v for v in tensors)]
+    n_state = len(tensors)
+
+    def fwd(*arrs):
+        state0 = tuple(arrs[:n_state])
+        read_arrs = arrs[n_state:]
+
+        def run_region(fn, vs_flat):
+            with _patched_reads(reads, read_arrs):
+                vs = _rebuild(skel, vs_flat)
+                with autograd.no_grad():
+                    return fn(*vs)
+
+        def step(carry, _):
+            done, state = carry
+            p = run_region(cond_fn, state)
+            p_arr = _scalar_pred(p._data if isinstance(p, Tensor) else p)
+            new_done = jnp.logical_or(done, jnp.logical_not(p_arr))
+            active = jnp.logical_not(new_done)
+            out = run_region(body_fn, state)
+            out_list = list(out) if isinstance(out, (list, tuple)) else [out]
+            new_tensors, _ = _flatten(out_list)
+            new_state = tuple(
+                jnp.where(active, n._data, o)
+                for n, o in zip(new_tensors, state))
+            return (new_done, new_state), None
+
+        (done, final), _ = jax.lax.scan(
+            step, (jnp.asarray(False), state0), None, length=max_steps)
+        return final if n_state != 1 else final[0]
+
+    out = apply("while_loop_grad", fwd, tensors + reads, nout=n_state)
+    out_tensors = list(out) if isinstance(out, tuple) else [out]
+    out_vars = _rebuild(skel, out_tensors, wrap=lambda t: t)
     return out_vars if as_seq else out_vars[0]
 
 
@@ -243,13 +345,7 @@ def scan_loop(body_fn, loop_vars, n_steps, name=None):
     rec = _ReadRecorder()
     with _recording(rec), autograd.no_grad():
         probe = body_fn(Tensor(jnp.asarray(0, jnp.int32)), *vars_list)
-    probe_list = list(probe) if isinstance(probe, (list, tuple)) else [probe]
-    p_tensors, p_skel = _flatten(probe_list)
-    if _skel_sig(p_skel, p_tensors) != _skel_sig(skel, tensors):
-        raise ValueError(
-            "scan_loop body must return the same structure/shapes as "
-            f"loop_vars: {_skel_sig(skel, tensors)} vs "
-            f"{_skel_sig(p_skel, p_tensors)}")
+    _check_same_state(skel, tensors, probe, "scan_loop body")
     reads = [t for t in rec.reads.values()
              if not any(t is v for v in tensors)]
     n_state = len(tensors)
@@ -259,10 +355,7 @@ def scan_loop(body_fn, loop_vars, n_steps, name=None):
         read_arrs = arrs[n_state:]
 
         def step(carry, i):
-            saved = [(t, t._data) for t in reads]
-            try:
-                for t, a in zip(reads, read_arrs):
-                    t._data = a
+            with _patched_reads(reads, read_arrs):
                 vs = _rebuild(skel, carry)
                 with autograd.no_grad():
                     out = body_fn(Tensor(i), *vs)
@@ -270,9 +363,6 @@ def scan_loop(body_fn, loop_vars, n_steps, name=None):
                     else [out]
                 new_tensors, _ = _flatten(out_list)
                 return tuple(t._data for t in new_tensors), None
-            finally:
-                for t, a in saved:
-                    t._data = a
 
         final, _ = jax.lax.scan(step, state0,
                                 jnp.arange(n_steps, dtype=jnp.int32))

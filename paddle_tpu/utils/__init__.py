@@ -9,7 +9,10 @@ from __future__ import annotations
 
 import contextlib
 
-__all__ = ["run_check", "deprecated", "unique_name", "try_import"]
+from . import cpp_extension  # noqa: F401  (custom-op registration)
+
+__all__ = ["run_check", "deprecated", "unique_name", "try_import",
+           "cpp_extension"]
 
 
 def run_check(verbose=True):

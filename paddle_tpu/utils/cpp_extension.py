@@ -1,0 +1,242 @@
+"""Custom-op registration — TPU-native analog of Paddle's custom operators.
+
+Reference: ``paddle/fluid/framework/custom_operator.cc`` (PD_BUILD_OP
+registration + kernel wiring), ``python/paddle/utils/cpp_extension/``
+(CppExtension/CUDAExtension/load build path), ``test/custom_op/`` (the
+user-facing contract: a custom op behaves exactly like a built-in — eager,
+static, with autograd).
+
+On TPU the "custom kernel" is a user JAX or Pallas function, so the C++
+build machinery collapses: :func:`custom_op` registers a python function
+operating on raw jax arrays as a first-class taped op. The registered op
+
+* dispatches through :func:`core.dispatch.apply` — AMP autocast, the
+  profiler, NaN/Inf checking, the static-graph recorder and the autograd
+  tape all see it exactly like a generated op;
+* differentiates via ``jax.vjp`` of the forward by default, or a
+  user-supplied VJP rule (wrapped into ``jax.custom_vjp``);
+* works under ``to_static`` (tracing dispatches the same ``apply`` path);
+* optionally binds onto the ``Tensor`` method surface;
+* carries a built-in golden check (:meth:`CustomOp.check`) replicating the
+  reference's OpTest numeric-gradient validation for user ops.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+__all__ = ["custom_op", "CustomOp", "get_op", "registered_ops",
+           "CppExtension", "CUDAExtension", "load"]
+
+_REGISTRY: dict = {}
+
+
+class CustomOp:
+    """A registered custom operator (reference: the OpMetaInfo record built
+    by PD_BUILD_OP, custom_operator.cc).
+
+    ``fn(*args, **attrs)`` operates on raw jax arrays (Tensor args are
+    unwrapped before the call). ``vjp``, when given, receives
+    ``(ct, *args, out)`` — the output cotangent, the op's original
+    (array-valued) arguments, and the forward output — and must return one
+    cotangent per Tensor argument, in positional order.
+    """
+
+    def __init__(self, name, fn, vjp=None, nout=1, golden=None):
+        self.name = name
+        self.fn = fn
+        self.vjp = vjp
+        self.nout = nout
+        self.golden = golden
+        functools.update_wrapper(self, fn)
+
+    def __call__(self, *args, **kwargs):
+        from ..core.dispatch import apply
+        from ..core.tensor import Tensor
+        for k, v in kwargs.items():
+            if isinstance(v, Tensor):
+                raise TypeError(
+                    f"custom op '{self.name}': Tensor keyword argument "
+                    f"'{k}' is not supported — pass tensors positionally "
+                    "(keywords are compile-time attributes)")
+        tensor_idx = [i for i, a in enumerate(args)
+                      if isinstance(a, Tensor)]
+        statics = list(args)
+
+        def fwd(*arrs):
+            merged = list(statics)
+            for pos, a in zip(tensor_idx, arrs):
+                merged[pos] = a
+            return self.fn(*merged, **kwargs)
+
+        if self.vjp is not None:
+            fwd = self._with_custom_vjp(fwd, tensor_idx, statics, kwargs)
+        tensors = [args[i] for i in tensor_idx]
+        return apply(self.name, fwd, tensors, nout=self.nout)
+
+    def _with_custom_vjp(self, fwd, tensor_idx, statics, kwargs):
+        """Wrap the array-level forward with the user's backward rule, so
+        the tape's jax.vjp picks up the custom rule (the custom grad
+        kernel of custom_operator.cc RunCustomOperator's grad path)."""
+        import jax
+        user_vjp = self.vjp
+        f = jax.custom_vjp(fwd)
+
+        def f_fwd(*arrs):
+            out = fwd(*arrs)
+            return out, (arrs, out)
+
+        def f_bwd(res, ct):
+            arrs, out = res
+            merged = list(statics)
+            for pos, a in zip(tensor_idx, arrs):
+                merged[pos] = a
+            cts = user_vjp(ct, *merged, out=out, **kwargs)
+            if not isinstance(cts, (tuple, list)):
+                cts = (cts,)
+            if len(cts) != len(arrs):
+                raise ValueError(
+                    f"custom op '{self.name}': vjp returned {len(cts)} "
+                    f"cotangents for {len(arrs)} tensor inputs")
+            return tuple(cts)
+
+        f.defvjp(f_fwd, f_bwd)
+        return f
+
+    # -- golden validation (reference: test/custom_op/ + OpTest) ----------
+    def check(self, *args, golden=None, rtol=1e-5, atol=1e-6, grad=True,
+              eps=1e-3, seed=0, **kwargs):
+        """Validate the op against a numpy reference and (directionally)
+        its gradient against finite differences — the OpTest
+        check_output/check_grad pair for user ops. Raises on mismatch."""
+        from ..core.tensor import Tensor
+        golden = golden or self.golden
+        out = self(*args, **kwargs)
+        outs = out if isinstance(out, tuple) else (out,)
+        if golden is not None:
+            np_args = [np.asarray(a._data) if isinstance(a, Tensor) else a
+                       for a in args]
+            ref = golden(*np_args, **kwargs)
+            refs = ref if isinstance(ref, tuple) else (ref,)
+            for o, r in zip(outs, refs):
+                np.testing.assert_allclose(np.asarray(o._data), r,
+                                           rtol=rtol, atol=atol,
+                                           err_msg=f"{self.name} forward")
+        if not grad:
+            return
+        tensors = [a for a in args if isinstance(a, Tensor)
+                   and not a.stop_gradient]
+        if not tensors:
+            return
+        rng = np.random.RandomState(seed)
+        ct = [rng.randn(*o.shape).astype("float32") for o in outs]
+
+        def scalar_loss(inputs):
+            res = self(*inputs, **kwargs)
+            res = res if isinstance(res, tuple) else (res,)
+            total = None
+            for o, c in zip(res, ct):
+                term = (o.astype("float32") * Tensor(c)).sum()
+                total = term if total is None else total + term
+            return total
+
+        loss = scalar_loss(list(args))
+        from ..core.autograd import grad as _grad
+        analytic = _grad([loss], tensors, allow_unused=True)
+        # directional FD: d/dt loss(x + t*d) at t=0 vs <grad, d>
+        for t, g in zip(tensors, analytic):
+            d = rng.randn(*t.shape).astype(np.asarray(t._data).dtype)
+            base = np.asarray(t._data)
+
+            def loss_at(delta):
+                shifted = []
+                for a in args:
+                    if a is t:
+                        shifted.append(Tensor(base + delta * d,
+                                              stop_gradient=True))
+                    else:
+                        shifted.append(a)
+                return float(np.asarray(scalar_loss(shifted)._data))
+
+            fd = (loss_at(eps) - loss_at(-eps)) / (2 * eps)
+            an = float(np.sum(np.asarray(g._data) * d)) if g is not None \
+                else 0.0
+            np.testing.assert_allclose(
+                an, fd, rtol=5e-2, atol=5e-3,
+                err_msg=f"{self.name} grad wrt input (analytic {an} vs "
+                        f"finite-difference {fd})")
+
+
+def custom_op(name=None, vjp=None, nout=1, bind_method=False, golden=None,
+              override=False):
+    """Decorator registering a JAX/Pallas function as a first-class op.
+
+    Example (the TPU analog of a PD_BUILD_OP custom kernel)::
+
+        @paddle.utils.cpp_extension.custom_op(vjp=my_relu_grad)
+        def my_relu(x):                 # raw jax arrays in/out
+            return jnp.maximum(x, 0)
+
+        y = my_relu(tensor)             # eager, taped
+        paddle.jit.to_static(f)(...)    # stages like any built-in op
+
+    ``vjp(ct, *args, out=...)`` returns one cotangent per Tensor argument.
+    ``bind_method=True`` also attaches the op to the Tensor method surface.
+    """
+    def decorate(fn):
+        op_name = name or fn.__name__
+        if op_name in _REGISTRY and not override:
+            raise ValueError(
+                f"custom op '{op_name}' is already registered; pass "
+                "override=True to replace it")
+        op = CustomOp(op_name, fn, vjp=vjp, nout=nout, golden=golden)
+        _REGISTRY[op_name] = op
+        if bind_method:
+            from ..core.tensor import Tensor
+            if hasattr(Tensor, op_name) and not override:
+                raise ValueError(
+                    f"Tensor already has a method '{op_name}'; pass "
+                    "override=True to shadow it")
+            setattr(Tensor, op_name,
+                    lambda self, *a, **k: op(self, *a, **k))
+        return op
+
+    if callable(name):  # bare @custom_op
+        fn, name = name, None
+        return decorate(fn)
+    return decorate
+
+
+def get_op(name):
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise KeyError(
+            f"no custom op named '{name}' is registered "
+            f"(registered: {sorted(_REGISTRY)})") from None
+
+
+def registered_ops():
+    return sorted(_REGISTRY)
+
+
+# -- reference-API build shims -------------------------------------------
+def _no_cpp(name):
+    raise NotImplementedError(
+        f"{name}: C++/CUDA extension builds target CUDA devices; on the "
+        "TPU backend register a JAX/Pallas function with "
+        "paddle.utils.cpp_extension.custom_op instead (same taped-op "
+        "semantics, no build step)")
+
+
+def CppExtension(*a, **k):
+    _no_cpp("CppExtension")
+
+
+def CUDAExtension(*a, **k):
+    _no_cpp("CUDAExtension")
+
+
+def load(*a, **k):
+    _no_cpp("load")

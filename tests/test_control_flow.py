@@ -218,3 +218,105 @@ def test_generate_compiled_one_program_matches_eager():
                                         temperature=0.0, compiled=True))
     out_s = sf(ids)
     assert (out_s.numpy() == out_c.numpy()).all()
+
+
+def test_while_loop_max_steps_differentiable():
+    """Differentiable while via bounded masked unroll (reference WhileGrad,
+    while_op.cc): gradient parity vs a manually unrolled loop on a
+    decode-style recurrence with a data-dependent trip count."""
+    rng = np.random.RandomState(0)
+    W0 = rng.randn(4, 4).astype("float32") * 0.3
+    h0 = rng.randn(1, 4).astype("float32")
+
+    def run_while(n_val):
+        W = t(W0, stop_gradient=False)
+        h = t(h0, stop_gradient=False)
+
+        def f(n, W, h):
+            i = paddle.zeros([], "int32")
+            i2, hf = while_loop(
+                lambda i, hh: i < n,
+                lambda i, hh: [i + paddle.ones([], "int32"),
+                               paddle.tanh(hh.matmul(W))],
+                [i, h * 1.0], max_steps=8)
+            return hf.sum()
+
+        loss = to_static(f, full_graph=True)(
+            paddle.to_tensor(np.int32(n_val)), W, h)
+        loss.backward()
+        gW = np.zeros_like(W0) if W._grad is None else np.asarray(W._grad)
+        gh = np.zeros_like(h0) if h._grad is None else np.asarray(h._grad)
+        return float(loss.numpy()), gW, gh
+
+    def run_unrolled(n_val):
+        W = t(W0, stop_gradient=False)
+        h = t(h0, stop_gradient=False)
+        hh = h * 1.0
+        for _ in range(n_val):
+            hh = paddle.tanh(hh.matmul(W))
+        loss = hh.sum()
+        loss.backward()
+        gW = np.zeros_like(W0) if W._grad is None else np.asarray(W._grad)
+        gh = np.zeros_like(h0) if h._grad is None else np.asarray(h._grad)
+        return float(loss.numpy()), gW, gh
+
+    for n in (0, 3, 8):  # empty, partial, exactly-at-bound trip counts
+        lw, gww, ghw = run_while(n)
+        lu, gwu, ghu = run_unrolled(n)
+        np.testing.assert_allclose(lw, lu, rtol=1e-5, err_msg=f"n={n}")
+        np.testing.assert_allclose(gww, gwu, rtol=1e-4, atol=1e-6,
+                                   err_msg=f"n={n} dW")
+        np.testing.assert_allclose(ghw, ghu, rtol=1e-4, atol=1e-6,
+                                   err_msg=f"n={n} dh")
+
+
+def test_while_loop_max_steps_data_dependent_stop():
+    """Trip count decided by the loop state itself (decode hitting EOS),
+    not by an external counter bound."""
+    def f(x):
+        i = paddle.zeros([], "int32")
+        i2, y = while_loop(
+            lambda i, y: y.sum() < 100.0,
+            lambda i, y: [i + paddle.ones([], "int32"), y * 2.0],
+            [i, x], max_steps=16)
+        return y.sum()
+
+    x = t([3.0], stop_gradient=False)
+    loss = to_static(f, full_graph=True)(x)
+    # 3 -> 6 -> ... doubles until >= 100: 3*2^6 = 192
+    np.testing.assert_allclose(float(loss.numpy()), 192.0)
+    loss.backward()
+    np.testing.assert_allclose(np.asarray(x._grad), [64.0])
+
+
+def test_while_loop_max_steps_bounds_eager_too():
+    """max_steps is a hard bound in both modes: eager stops at the bound
+    exactly like the traced masked unroll (same results either mode)."""
+    def f(x):
+        i = paddle.zeros([], "int32")
+        _, y = while_loop(lambda i, y: y.sum() < 1e9,
+                          lambda i, y: [i + paddle.ones([], "int32"),
+                                        y * 2.0],
+                          [i, x], max_steps=4)
+        return y
+
+    x = t([1.0])
+    np.testing.assert_allclose(f(x).numpy(), [16.0])          # eager
+    np.testing.assert_allclose(
+        to_static(f, full_graph=True)(x).numpy(), [16.0])     # traced
+
+
+def test_while_loop_max_steps_no_grad_uses_early_exit():
+    """Under no_grad the bounded loop still lowers to lax.while_loop
+    (early exit), not the masked scan — and still computes correctly."""
+    def f(x):
+        with paddle.no_grad():
+            i = paddle.zeros([], "int32")
+            _, y = while_loop(lambda i, y: y.sum() < 100.0,
+                              lambda i, y: [i + paddle.ones([], "int32"),
+                                            y * 2.0],
+                              [i, x], max_steps=64)
+        return y
+
+    np.testing.assert_allclose(
+        to_static(f, full_graph=True)(t([3.0])).numpy(), [192.0])

@@ -1,0 +1,156 @@
+"""Custom-op registration API (reference: test/custom_op/ — a user op must
+behave like a built-in in eager, under to_static, and with backward()).
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.utils.cpp_extension import (
+    custom_op, get_op, registered_ops, load,
+)
+
+
+def t(x, stop_gradient=True):
+    tt = paddle.to_tensor(np.asarray(x, dtype="float32"))
+    tt.stop_gradient = stop_gradient
+    return tt
+
+
+@custom_op(golden=lambda x: np.maximum(x, 0) + 0.1 * np.minimum(x, 0))
+def leaky01(x):
+    return jnp.maximum(x, 0) + 0.1 * jnp.minimum(x, 0)
+
+
+def _sq_vjp(ct, x, out=None):
+    return (ct * 2.0 * x,)
+
+
+@custom_op(name="square_cv", vjp=_sq_vjp, golden=lambda x: x * x)
+def _square(x):
+    return x * x
+
+
+def test_eager_forward_and_registry():
+    x = t([[-1.0, 2.0], [3.0, -4.0]])
+    out = leaky01(x)
+    np.testing.assert_allclose(out.numpy(),
+                               [[-0.1, 2.0], [3.0, -0.4]], rtol=1e-6)
+    assert "leaky01" in registered_ops()
+    assert get_op("leaky01") is leaky01
+    with pytest.raises(KeyError, match="no custom op named"):
+        get_op("nope")
+
+
+def test_autograd_default_vjp():
+    x = t([[-1.0, 2.0]], stop_gradient=False)
+    leaky01(x).sum().backward()
+    np.testing.assert_allclose(np.asarray(x._grad), [[0.1, 1.0]])
+
+
+def test_autograd_custom_vjp_rule_is_used():
+    calls = []
+
+    def marked_vjp(ct, x, out=None):
+        calls.append(1)
+        return (ct * 2.0 * x,)
+
+    @custom_op(name="square_marked", vjp=marked_vjp)
+    def sq(x):
+        return x * x
+
+    x = t([3.0], stop_gradient=False)
+    sq(x).sum().backward()
+    np.testing.assert_allclose(np.asarray(x._grad), [6.0])
+    assert calls, "custom vjp rule was not invoked"
+
+
+def test_under_to_static():
+    def f(x):
+        return get_op("square_cv")(x) + leaky01(x)
+
+    sf = paddle.jit.to_static(f, full_graph=True)
+    x = t([[-2.0, 3.0]])
+    np.testing.assert_allclose(sf(x).numpy(), [[4.0 - 0.2, 9.0 + 3.0]],
+                               rtol=1e-6)
+
+
+def test_to_static_backward_through_custom_vjp():
+    def f(x):
+        return get_op("square_cv")(x).sum()
+
+    sf = paddle.jit.to_static(f, full_graph=True)
+    x = t([2.0, -3.0], stop_gradient=False)
+    sf(x).backward()
+    np.testing.assert_allclose(np.asarray(x._grad), [4.0, -6.0])
+
+
+def test_golden_check_passes_and_catches_bad_vjp():
+    x = t(np.random.RandomState(0).randn(4, 3), stop_gradient=False)
+    leaky01.check(x)
+    get_op("square_cv").check(t(np.random.RandomState(1).randn(5),
+                                stop_gradient=False))
+
+    def wrong_vjp(ct, x, out=None):
+        return (ct * 3.0 * x,)  # wrong factor
+
+    @custom_op(name="square_bad", vjp=wrong_vjp)
+    def sqb(x):
+        return x * x
+
+    with pytest.raises(AssertionError):
+        sqb.check(t([1.0, 2.0], stop_gradient=False))
+
+
+def test_attrs_and_multi_output():
+    @custom_op(name="split_scale", nout=2)
+    def split_scale(x, alpha=2.0):
+        return x * alpha, x / alpha
+
+    a, b = split_scale(t([4.0]), alpha=4.0)
+    np.testing.assert_allclose(a.numpy(), [16.0])
+    np.testing.assert_allclose(b.numpy(), [1.0])
+    with pytest.raises(TypeError, match="Tensor keyword argument"):
+        split_scale(t([1.0]), alpha=t([2.0]))
+
+
+def test_tensor_method_binding():
+    @custom_op(name="plus_one_m", bind_method=True)
+    def plus_one_m(x):
+        return x + 1.0
+
+    np.testing.assert_allclose(t([1.0]).plus_one_m().numpy(), [2.0])
+
+
+def test_duplicate_registration_rejected():
+    with pytest.raises(ValueError, match="already registered"):
+        @custom_op(name="leaky01")
+        def clash(x):
+            return x
+
+
+def test_pallas_kernel_port():
+    """Port of the repo's own Pallas RMSNorm through the public custom-op
+    API (VERDICT r4 item 4): registered, eager+taped, golden-checked."""
+    from paddle_tpu.ops.pallas.rms_norm import rms_norm as _pallas_rms
+
+    def rms_golden(x, w, eps=1e-6):
+        ms = np.mean(x.astype(np.float64) ** 2, axis=-1, keepdims=True)
+        return (x / np.sqrt(ms + eps) * w).astype(np.float32)
+
+    op = custom_op(name="pallas_rms_norm", golden=rms_golden)(
+        lambda x, w, eps=1e-6: _pallas_rms(x, w, eps=eps, interpret=True))
+
+    rng = np.random.RandomState(0)
+    x = t(rng.randn(8, 128), stop_gradient=False)
+    w = t(rng.rand(128) + 0.5, stop_gradient=False)
+    op.check(x, w, rtol=1e-4, atol=1e-4)
+    # trains end-to-end
+    loss = (op(x, w) ** 2).mean()
+    loss.backward()
+    assert x._grad is not None and w._grad is not None
+
+
+def test_cpp_build_shims_redirect():
+    with pytest.raises(NotImplementedError, match="custom_op"):
+        load(name="x", sources=["x.cc"])

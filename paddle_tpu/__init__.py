@@ -149,8 +149,15 @@ from . import text  # noqa: F401,E402
 from . import onnx  # noqa: F401,E402
 from . import quantization  # noqa: F401,E402
 from . import sparse  # noqa: F401,E402
+from . import strings  # noqa: F401,E402
 
 # bind the tensor methods that need the fully-assembled namespace
 from .core.tensor import Tensor as _T  # noqa: E402
 _T._late_bind()
 del _T
+
+# InferMeta preflights: paddle-style shape/dtype errors before XLA
+# (reference: phi/infermeta/*) — wraps the assembled namespaces, so last
+from .core import infermeta as _infermeta  # noqa: E402
+_infermeta.install()
+del _infermeta

@@ -1,0 +1,404 @@
+"""InferMeta preflights — Paddle-style shape/dtype errors BEFORE XLA.
+
+Reference: paddle/phi/infermeta/{unary,binary,ternary,multiary}.cc — every
+op validates its inputs and emits a one-line InvalidArgument message; the
+user never sees a raw backend traceback for a shape mistake. Here a rule
+registry covers the top-ops by family; :func:`install` wraps the public
+op functions (root namespace, op modules and Tensor methods) so the check
+runs at the python boundary — the dispatch-level error enricher
+(core/dispatch.py) remains the net for everything else.
+
+Rules fail OPEN on signature drift (a TypeError applying a rule skips the
+check rather than breaking a valid call) and never inspect values — only
+shapes/dtypes, exactly like the reference's InferMeta contract.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from .enforce import InvalidArgumentError, _fail
+
+__all__ = ["install", "RULES", "preflight_names"]
+
+
+def _shape(t):
+    return tuple(getattr(t, "shape", ()) or ())
+
+
+def _is_tensor(t):
+    from .tensor import Tensor
+    return isinstance(t, Tensor)
+
+
+def _rank(t):
+    return len(_shape(t))
+
+
+def _norm_axis(op, axis, rank, extra=0):
+    """Validate one axis value against rank (+extra for insert ops)."""
+    hi = rank + extra
+    if not (-hi <= axis < hi) and not (rank == 0 and axis in (0, -1)):
+        _fail(op, f"axis {axis} is out of range for rank-{rank} input "
+                  f"(expected {-hi} <= axis < {hi}) "
+                  f"[reference: phi/infermeta unary.cc axis checks]")
+    return axis % hi if hi else 0
+
+
+def _check_axis_arg(op, x, axis, extra=0):
+    if axis is None or not _is_tensor(x):
+        return
+    r = _rank(x)
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    for a in axes:
+        if isinstance(a, int):
+            _norm_axis(op, a, r, extra)
+
+
+# -- rule builders --------------------------------------------------------
+
+def _axis_rule(op, extra=0, axis_pos=0):
+    """axis_pos: positional index of ``axis`` AFTER x (ops like
+    repeat_interleave/quantile carry another argument first)."""
+    def check(x, *args, **kwargs):
+        axis = kwargs.get("axis",
+                          args[axis_pos] if len(args) > axis_pos else None)
+        if isinstance(axis, bool):  # e.g. sum(x, keepdim) misuse — skip
+            return
+        _check_axis_arg(op, x, axis, extra)
+    return check
+
+
+def _broadcast_rule(op):
+    def check(x, y=None, *args, **kwargs):
+        if not (_is_tensor(x) and _is_tensor(y)):
+            return
+        try:
+            np.broadcast_shapes(_shape(x), _shape(y))
+        except ValueError:
+            _fail(op, f"inputs could not be broadcast together: "
+                      f"x{list(_shape(x))} vs y{list(_shape(y))} "
+                      f"[reference: phi/infermeta binary.cc "
+                      f"ElementwiseInferMeta]")
+    return check
+
+
+def _square_rule(op):
+    def check(x, *args, **kwargs):
+        s = _shape(x)
+        if len(s) < 2:
+            _fail(op, f"input must be at least 2-D, got {list(s)}")
+        if s[-1] != s[-2]:
+            _fail(op, f"input must be square in its last two dims, got "
+                      f"{list(s)} [reference: phi/infermeta unary.cc "
+                      f"CholeskyInferMeta et al.]")
+    return check
+
+
+def _min2d_rule(op):
+    def check(x, *args, **kwargs):
+        if _rank(x) < 2:
+            _fail(op, f"input must be at least 2-D, got "
+                      f"{list(_shape(x))}")
+    return check
+
+
+def _int_index_rule(op, index_pos=1):
+    def check(*args, **kwargs):
+        idx = kwargs.get("index", args[index_pos]
+                         if len(args) > index_pos else None)
+        if _is_tensor(idx) and np.dtype(str(idx.dtype)).kind not in "iu":
+            _fail(op, f"index must be an integer tensor, got {idx.dtype} "
+                      f"[reference: phi/infermeta GatherInferMeta]")
+        x = args[0] if args else kwargs.get("x")
+        axis = kwargs.get("axis", None)
+        if axis is not None and isinstance(axis, int):
+            _check_axis_arg(op, x, axis)
+    return check
+
+
+# -- per-op rules ---------------------------------------------------------
+
+def _r_matmul(x, y, transpose_x=False, transpose_y=False, **kw):
+    from .enforce import check_matmul
+    if _is_tensor(x) and _is_tensor(y):
+        check_matmul(_shape(x), _shape(y), transpose_x, transpose_y)
+
+
+def _r_bmm(x, y, **kw):
+    sx, sy = _shape(x), _shape(y)
+    if len(sx) != 3 or len(sy) != 3:
+        _fail("bmm", f"inputs must be 3-D, got x{list(sx)} y{list(sy)}")
+    if sx[0] != sy[0]:
+        _fail("bmm", f"batch sizes must match: x{list(sx)} vs y{list(sy)}")
+    if sx[2] != sy[1]:
+        _fail("bmm", f"inner dims must match: x{list(sx)} (K={sx[2]}) @ "
+                     f"y{list(sy)} (K={sy[1]})")
+
+
+def _r_dot(x, y, **kw):
+    sx, sy = _shape(x), _shape(y)
+    if len(sx) not in (1, 2) or len(sy) not in (1, 2):
+        _fail("dot", f"inputs must be 1-D or 2-D, got x{list(sx)} "
+                     f"y{list(sy)}")
+    if sx[-1] != sy[-1]:
+        _fail("dot", f"last dims must match: x{list(sx)} vs y{list(sy)}")
+
+
+def _r_where(cond, x=None, y=None, **kw):
+    if not (_is_tensor(x) and _is_tensor(y) and _is_tensor(cond)):
+        return
+    if np.dtype(str(cond.dtype)) != np.bool_:
+        _fail("where", f"condition must be a bool tensor, got "
+                       f"{cond.dtype}")
+    try:
+        np.broadcast_shapes(_shape(cond), _shape(x), _shape(y))
+    except ValueError:
+        _fail("where", f"condition{list(_shape(cond))}, x{list(_shape(x))}"
+                       f" and y{list(_shape(y))} could not be broadcast "
+                       f"together")
+
+
+def _r_topk(x, k, axis=-1, **kw):
+    if not isinstance(k, int) or _is_tensor(k):
+        return
+    if k < 1:
+        _fail("topk", f"k must be >= 1, got {k}")
+    r = _rank(x)
+    if r:
+        ax = _norm_axis("topk", axis if isinstance(axis, int) else -1, r)
+        if k > _shape(x)[ax]:
+            _fail("topk", f"k ({k}) exceeds dim {ax} size "
+                          f"({_shape(x)[ax]}) of input {list(_shape(x))}")
+
+
+def _r_kthvalue(x, k, axis=-1, keepdim=False, **kw):
+    _r_topk(x, k, axis)
+
+
+def _r_split(x, num_or_sections, axis=0, **kw):
+    r = _rank(x)
+    ax = _norm_axis("split", axis if isinstance(axis, int) else 0, r)
+    if isinstance(num_or_sections, int):
+        d = _shape(x)[ax]
+        if num_or_sections <= 0 or d % num_or_sections != 0:
+            _fail("split", f"dim {ax} (size {d}) is not divisible into "
+                           f"{num_or_sections} equal sections "
+                           f"[reference: SplitInferMeta]")
+
+
+def _r_chunk(x, chunks, axis=0, **kw):
+    if isinstance(chunks, int) and chunks <= 0:
+        _fail("chunk", f"chunks must be positive, got {chunks}")
+    _check_axis_arg("chunk", x, axis)
+
+
+def _r_stack(x, axis=0, **kw):
+    if not isinstance(x, (list, tuple)) or not x:
+        return
+    shapes = [_shape(t) for t in x if _is_tensor(t)]
+    for i, s in enumerate(shapes[1:], 1):
+        if s != shapes[0]:
+            _fail("stack", f"all inputs must have the same shape; input 0 "
+                           f"is {list(shapes[0])}, input {i} is {list(s)}")
+    _check_axis_arg("stack", x[0], axis, extra=1)
+
+
+def _r_expand(x, shape, **kw):
+    s = _shape(x)
+    tgt = list(shape)
+    if len(tgt) < len(s):
+        _fail("expand", f"target rank {len(tgt)} is smaller than input "
+                        f"rank {len(s)} ({list(s)} -> {tgt})")
+    for xd, td in zip(s[::-1], tgt[::-1]):
+        if xd != 1 and td != -1 and xd != td:
+            _fail("expand", f"cannot expand dim of size {xd} to {td} "
+                            f"({list(s)} -> {tgt}) [reference: "
+                            f"ExpandInferMeta]")
+
+
+def _r_transpose(x, perm=None, **kw):
+    if perm is None or not _is_tensor(x):
+        return
+    r = _rank(x)
+    if sorted(int(p) % max(r, 1) for p in perm) != list(range(r)):
+        _fail("transpose", f"perm {list(perm)} is not a permutation of "
+                           f"rank-{r} input {list(_shape(x))}")
+
+
+def _r_solve(x, y, **kw):
+    sx, sy = _shape(x), _shape(y)
+    if len(sx) < 2 or sx[-1] != sx[-2]:
+        _fail("solve", f"coefficient matrix must be square, got "
+                       f"{list(sx)}")
+    if sy and sx[-1] != sy[-2 if len(sy) >= 2 else -1]:
+        _fail("solve", f"dimension mismatch: A{list(sx)} vs b{list(sy)}")
+
+
+def _r_pad(x, pad=None, *args, **kw):
+    if pad is None or _is_tensor(pad):
+        return
+    p = list(pad)
+    if len(p) % 2 != 0 or len(p) > 2 * _rank(x):
+        _fail("pad", f"pad must hold an even number of entries covering "
+                     f"at most every dim (rank {_rank(x)}), got {p}")
+
+
+def _r_clip(x, min=None, max=None, **kw):  # noqa: A002
+    if isinstance(min, (int, float)) and isinstance(max, (int, float)) \
+            and min > max:
+        _fail("clip", f"min ({min}) must be <= max ({max})")
+
+
+def _r_cross(x, y, axis=None, **kw):
+    sx = _shape(x)
+    if axis is None:
+        if 3 not in sx:
+            _fail("cross", f"no dim of size 3 in input {list(sx)}")
+    else:
+        ax = _norm_axis("cross", axis, len(sx))
+        if sx[ax] != 3:
+            _fail("cross", f"dim {axis} must have size 3, got {list(sx)}")
+
+
+def _r_one_hot(x, num_classes, **kw):
+    if isinstance(num_classes, int) and num_classes <= 0:
+        _fail("one_hot", f"num_classes must be positive, got "
+                         f"{num_classes}")
+
+
+def _r_masked(x, mask, *args, **kw):
+    if _is_tensor(mask) and np.dtype(str(mask.dtype)) != np.bool_:
+        _fail("masked_select", f"mask must be a bool tensor, got "
+                               f"{mask.dtype}")
+
+
+def _r_gather_nd(x, index, **kw):
+    if _is_tensor(index):
+        if np.dtype(str(index.dtype)).kind not in "iu":
+            _fail("gather_nd", f"index must be integer, got {index.dtype}")
+        if _shape(index) and _shape(index)[-1] > _rank(x):
+            _fail("gather_nd", f"index depth {_shape(index)[-1]} exceeds "
+                               f"input rank {_rank(x)}")
+
+
+def _r_linspace(start, stop, num, *args, **kw):
+    if isinstance(num, int) and num <= 0:
+        _fail("linspace", f"num must be positive, got {num}")
+
+
+def _r_diag(x, *args, **kw):
+    if _rank(x) > 2:
+        _fail("diag", f"input must be 1-D or 2-D, got {list(_shape(x))}")
+
+
+_AXIS_OPS = """sum mean max min prod all any argmax argmin cumsum cumprod
+logsumexp amax amin nansum nanmean squeeze softmax log_softmax argsort
+sort flip cummax cummin median nanmedian unstack unbind mode
+count_nonzero""".split()
+
+# axis is the SECOND argument after x for these
+_AXIS_POS1_OPS = "repeat_interleave quantile nanquantile".split()
+
+_BROADCAST_OPS = """add subtract multiply divide floor_divide remainder
+mod maximum minimum fmax fmin atan2 hypot copysign nextafter heaviside
+logaddexp logaddexp2 lcm gcd equal not_equal less_than less_equal
+greater_than greater_equal logical_and logical_or logical_xor bitwise_and
+bitwise_or bitwise_xor""".split()
+
+_SQUARE_OPS = """cholesky inverse matrix_power slogdet eig eigvals
+cholesky_solve lu_unpack""".split()
+
+_MIN2D_OPS = """tril triu qr lu svd matrix_rank pinv lstsq
+eigh eigvalsh""".split()
+
+_INT_INDEX_OPS = """gather index_select take_along_axis put_along_axis
+index_sample scatter index_add index_put""".split()
+
+
+def _build_rules():
+    rules = {}
+    for op in _AXIS_OPS:
+        rules[op] = _axis_rule(op)
+    for op in _AXIS_POS1_OPS:
+        rules[op] = _axis_rule(op, axis_pos=1)
+    rules["unsqueeze"] = _axis_rule("unsqueeze", extra=1)
+    for op in _BROADCAST_OPS:
+        rules[op] = _broadcast_rule(op)
+    for op in _SQUARE_OPS:
+        rules[op] = _square_rule(op)
+    for op in _MIN2D_OPS:
+        rules[op] = _min2d_rule(op)
+    for op in _INT_INDEX_OPS:
+        rules[op] = _int_index_rule(op)
+    rules.update({
+        "matmul": _r_matmul, "mm": _r_matmul, "bmm": _r_bmm,
+        "dot": _r_dot, "where": _r_where, "topk": _r_topk,
+        "kthvalue": _r_kthvalue, "split": _r_split, "chunk": _r_chunk,
+        "stack": _r_stack, "expand": _r_expand,
+        "broadcast_to": _r_expand, "transpose": _r_transpose,
+        "solve": _r_solve, "triangular_solve": _r_solve, "pad": _r_pad,
+        "clip": _r_clip, "cross": _r_cross, "one_hot": _r_one_hot,
+        "masked_select": _r_masked, "masked_fill": _r_masked,
+        "gather_nd": _r_gather_nd, "linspace": _r_linspace,
+        "diag": _r_diag,
+    })
+    return rules
+
+
+RULES = _build_rules()
+
+
+def preflight_names():
+    """Ops with a codegen-layer preflight (the inline enforce checks in
+    ops/linalg.py, manipulation.py and nn/functional/common.py count —
+    same mechanism, installed at authoring time)."""
+    inline = ["reshape", "concat", "linear", "conv2d", "embedding",
+              "cross_entropy"]
+    return sorted(set(RULES) | set(inline))
+
+
+def _wrap(name, fn):
+    rule = RULES[name]
+
+    @functools.wraps(fn)
+    def guarded(*args, **kwargs):
+        try:
+            rule(*args, **kwargs)
+        except InvalidArgumentError:
+            raise
+        except TypeError:
+            pass  # signature drift: fail open, never block a valid call
+        return fn(*args, **kwargs)
+
+    guarded.__pd_infermeta__ = True
+    return guarded
+
+
+def install():
+    """Wrap every registered op across the public namespaces + Tensor
+    methods. Idempotent."""
+    import types
+
+    import paddle_tpu as paddle
+    from ..core.tensor import Tensor
+    from ..nn import functional as F
+    from ..nn.functional import common as _F_common
+    from ..nn.functional import extra as _F_extra
+    from ..ops import (
+        creation, generated_root, linalg, logic, manipulation, math,
+        search,
+    )
+    spaces = [paddle, paddle.linalg, creation, generated_root, linalg,
+              logic, manipulation, math, search, F, _F_common, _F_extra]
+    for name in RULES:
+        for ns in spaces:
+            fn = getattr(ns, name, None)
+            if isinstance(fn, types.FunctionType) and \
+                    not getattr(fn, "__pd_infermeta__", False):
+                setattr(ns, name, _wrap(name, fn))
+        m = getattr(Tensor, name, None)
+        if isinstance(m, types.FunctionType) and \
+                not getattr(m, "__pd_infermeta__", False):
+            setattr(Tensor, name, _wrap(name, m))

@@ -25,6 +25,20 @@ from .watchdog import (  # noqa: F401
 )
 from . import ps  # noqa: F401
 from . import rpc  # noqa: F401
+from . import io  # noqa: F401
+from . import launch  # noqa: F401
+from . import stream  # noqa: F401
+from . import passes  # noqa: F401
+from .comm_extra import (  # noqa: F401
+    CountFilterEntry, DistAttr, DistModel, InMemoryDataset, ParallelEnv,
+    ParallelMode, Placement, ProbabilityEntry, QueueDataset, ReduceType,
+    ShowClickEntry, Strategy, all_gather_object, alltoall, alltoall_single,
+    broadcast_object_list, dtensor_from_fn, gather, get_backend,
+    gloo_barrier, gloo_init_parallel_env, gloo_release, irecv, is_available,
+    isend, recv, scatter_object_list, send, shard_optimizer, spawn, split,
+    to_static, wait,
+)
+from .checkpoint import load_state_dict, save_state_dict  # noqa: F401
 from .auto_tuner import AutoTuner  # noqa: F401
 from .elastic import ElasticManager, ElasticStatus  # noqa: F401
 from .topology import (  # noqa: F401

@@ -20,6 +20,9 @@ from .pipeline_compiled import CompiledPipelineParallel  # noqa: F401
 from .recompute import recompute, recompute_sequential  # noqa: F401
 from . import sequence_parallel_utils  # noqa: F401
 from .sharding import DygraphShardingOptimizer, group_sharded_parallel  # noqa: F401
+from . import metrics  # noqa: F401
+from . import utils_fs  # noqa: F401
+from .utils_fs import HDFSClient, LocalFS  # noqa: F401
 from .meta_optimizers import (  # noqa: F401
     DGCMomentumOptimizer, LarsMomentumOptimizer, LocalSGDOptimizer,
 )

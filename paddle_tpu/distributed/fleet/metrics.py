@@ -1,0 +1,87 @@
+"""Fleet distributed metrics — cross-rank metric reduction.
+
+Reference: python/paddle/distributed/fleet/metrics/metric.py (sum/max/min/
+auc over the trainer group via all_reduce, used to aggregate PS-mode
+evaluation). TPU-native: the reductions ride the compiled XLA collectives
+of distributed.collective; on a single-controller mesh the "ranks" are
+mesh coordinates, so numpy inputs reduce locally with the same API.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...core.tensor import Tensor
+
+__all__ = ["sum", "max", "min", "auc", "mae", "rmse", "acc"]
+
+_pysum, _pymax, _pymin = sum, max, min
+
+
+def _to_np(v):
+    if isinstance(v, Tensor):
+        return np.asarray(v.numpy(), np.float64)
+    return np.asarray(v, np.float64)
+
+
+def _reduce(value, op):
+    import jax
+    arr = _to_np(value)
+    # single-controller SPMD: local stats over global arrays ARE global;
+    # only the multi-controller (multi-process) case needs a reduction
+    if jax.process_count() <= 1:
+        return arr
+    from .. import collective as C
+    t = Tensor(arr.astype(np.float32))
+    C.all_reduce(t, op=op)
+    return np.asarray(t.numpy(), np.float64)
+
+
+def sum(value, scope=None, util=None):  # noqa: A001
+    """Reference: fleet.metrics.sum — global sum of a local stat."""
+    from ..collective import ReduceOp
+    return _reduce(value, ReduceOp.SUM)
+
+
+def max(value, scope=None, util=None):  # noqa: A001
+    from ..collective import ReduceOp
+    return _reduce(value, ReduceOp.MAX)
+
+
+def min(value, scope=None, util=None):  # noqa: A001
+    from ..collective import ReduceOp
+    return _reduce(value, ReduceOp.MIN)
+
+
+def auc(stat_pos, stat_neg, scope=None, util=None):
+    """Reference: fleet.metrics.auc — merge per-rank positive/negative
+    histogram buckets, then integrate the ROC curve exactly like the
+    reference's global_auc."""
+    pos = sum(stat_pos)
+    neg = sum(stat_neg)
+    # walk thresholds from high to low accumulating TP/FP
+    tot_pos = float(pos.sum())
+    tot_neg = float(neg.sum())
+    if tot_pos == 0 or tot_neg == 0:
+        return 0.5
+    area = 0.0
+    tp = fp = 0.0
+    for i in range(len(pos) - 1, -1, -1):
+        new_tp = tp + float(pos[i])
+        new_fp = fp + float(neg[i])
+        area += (new_fp - fp) * (tp + new_tp) / 2.0
+        tp, fp = new_tp, new_fp
+    return area / (tot_pos * tot_neg)
+
+
+def mae(abserr, total_ins_num, scope=None, util=None):
+    """Global mean absolute error from per-rank (sum |err|, count)."""
+    return float(sum(abserr)) / _pymax(float(sum(total_ins_num)), 1.0)
+
+
+def rmse(sqrerr, total_ins_num, scope=None, util=None):
+    return float(np.sqrt(float(sum(sqrerr))
+                         / _pymax(float(sum(total_ins_num)), 1.0)))
+
+
+def acc(correct, total, scope=None, util=None):
+    return float(sum(correct)) / _pymax(float(sum(total)), 1.0)

@@ -1,0 +1,35 @@
+"""paddle.distributed.io — persistables save/load helpers.
+
+Reference: python/paddle/distributed/io.py (save_persistables /
+load_persistables over the static Scope). TPU-native: persistable state is
+the Layer/Program state_dict; files are the framework.io pickle format.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["save_persistables", "load_persistables",
+           "is_persistable"]
+
+
+def is_persistable(var):
+    return bool(getattr(var, "persistable", True))
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    """Save every persistable parameter of ``main_program`` (a Layer or a
+    static Program) under ``dirname``."""
+    from ..framework.io import save
+    target = main_program if main_program is not None else executor
+    state = target.state_dict() if hasattr(target, "state_dict") else target
+    os.makedirs(dirname, exist_ok=True)
+    save(state, os.path.join(dirname, filename or "persistables.pdparams"))
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    from ..framework.io import load
+    state = load(os.path.join(dirname, filename or "persistables.pdparams"))
+    target = main_program if main_program is not None else executor
+    if hasattr(target, "set_state_dict"):
+        target.set_state_dict(state)
+    return state

@@ -1,0 +1,49 @@
+"""paddle.distributed.stream — stream-variant collectives.
+
+Reference: python/paddle/distributed/communication/stream/* — the
+``use_calc_stream`` forms that skip the comm-stream hop and run on the
+calculation stream. TPU-native collapse: XLA programs have no separate
+comm stream; compiled collectives are already scheduled inline with
+compute (the whole point of the GSPMD design), so every stream variant is
+the base collective with the sync knobs accepted for API parity.
+"""
+from __future__ import annotations
+
+from . import collective as _c
+from .comm_extra import alltoall, alltoall_single, gather, recv, send
+
+__all__ = ["all_gather", "all_reduce", "alltoall", "alltoall_single",
+           "broadcast", "gather", "recv", "reduce", "reduce_scatter",
+           "scatter", "send"]
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True,
+               use_calc_stream=False):
+    return _c.all_gather(tensor_list, tensor, group=group, sync_op=sync_op)
+
+
+def all_reduce(tensor, op=_c.ReduceOp.SUM, group=None, sync_op=True,
+               use_calc_stream=False):
+    return _c.all_reduce(tensor, op=op, group=group, sync_op=sync_op)
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True,
+              use_calc_stream=False):
+    return _c.broadcast(tensor, src=src, group=group, sync_op=sync_op)
+
+
+def reduce(tensor, dst=0, op=_c.ReduceOp.SUM, group=None, sync_op=True,
+           use_calc_stream=False):
+    return _c.reduce(tensor, dst=dst, op=op, group=group, sync_op=sync_op)
+
+
+def reduce_scatter(tensor, tensor_or_tensor_list, op=_c.ReduceOp.SUM,
+                   group=None, sync_op=True, use_calc_stream=False):
+    return _c.reduce_scatter(tensor, tensor_or_tensor_list, op=op,
+                             group=group, sync_op=sync_op)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True,
+            use_calc_stream=False):
+    return _c.scatter(tensor, tensor_list=tensor_list, src=src,
+                      group=group, sync_op=sync_op)

@@ -142,3 +142,136 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
     return out, None
 
 from . import autograd  # noqa: F401,E402
+
+
+# -- graph/segment + fused-softmax long tail (reference:
+# python/paddle/incubate/__init__.py __all__) ----------------------------
+
+def softmax_mask_fuse(x, mask, name=None):
+    """Reference: incubate.softmax_mask_fuse (fused_softmax_mask op):
+    softmax(x + mask) in one kernel — XLA fuses the add into the softmax."""
+    import jax
+
+    return apply("softmax_mask_fuse",
+                 lambda a, m: jax.nn.softmax(a + m, axis=-1), [x, mask])
+
+
+def softmax_mask_fuse_upper_triangle(x):
+    """Reference: incubate.softmax_mask_fuse_upper_triangle — causal
+    (lower-triangle visible) fused softmax for [B, H, S, S] scores."""
+    import jax
+
+    def f(a):
+        S = a.shape[-1]
+        causal = jnp.tril(jnp.ones((S, S), bool))
+        return jax.nn.softmax(jnp.where(causal, a, jnp.asarray(
+            -1e4, a.dtype)), axis=-1)
+
+    return apply("softmax_mask_fuse_upper_triangle", f, [x])
+
+
+def identity_loss(x, reduction="none"):
+    """Reference: incubate.identity_loss — marks a loss for the IPU
+    backend; numerically reduce-or-passthrough."""
+    red = {0: "sum", 1: "mean", 2: "none"}.get(reduction, reduction)
+    if red == "mean":
+        return apply("identity_loss", jnp.mean, [x])
+    if red == "sum":
+        return apply("identity_loss", jnp.sum, [x])
+    return x
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """Reference: incubate.graph_khop_sampler — multi-hop neighbor
+    sampling by chaining per-hop samplers (host op, like the reference
+    CPU kernel)."""
+    from .. import geometric as G
+    import numpy as np
+
+    nodes = input_nodes
+    rows_all, cols_all = [], []
+    frontier = nodes
+    for size in sample_sizes:
+        nbr, cnt = G.sample_neighbors(row, colptr, frontier,
+                                      sample_size=size)
+        nnp = np.asarray(nbr._data if hasattr(nbr, "_data") else nbr)
+        cnp = np.asarray(cnt._data if hasattr(cnt, "_data") else cnt)
+        src = np.repeat(np.asarray(
+            frontier._data if hasattr(frontier, "_data") else frontier),
+            cnp)
+        rows_all.append(nnp)
+        cols_all.append(src)
+        from ..core.tensor import Tensor as _T
+        frontier = _T(jnp.asarray(np.unique(nnp)))
+    import numpy as np2
+    all_rows = np2.concatenate(rows_all) if rows_all else np2.empty(0)
+    all_cols = np2.concatenate(cols_all) if cols_all else np2.empty(0)
+    from ..core.tensor import Tensor as _T
+    edge_src = _T(jnp.asarray(all_rows.astype(np2.int64)))
+    edge_dst = _T(jnp.asarray(all_cols.astype(np2.int64)))
+    sample_index = _T(jnp.asarray(np2.unique(
+        np2.concatenate([np2.asarray(
+            input_nodes._data if hasattr(input_nodes, "_data")
+            else input_nodes), all_rows]).astype(np2.int64))))
+    reindex = {int(v): i for i, v in enumerate(
+        np2.asarray(sample_index._data))}
+    local_src = _T(jnp.asarray(np2.asarray(
+        [reindex[int(v)] for v in all_rows], np2.int64)))
+    local_dst = _T(jnp.asarray(np2.asarray(
+        [reindex[int(v)] for v in all_cols], np2.int64)))
+    if return_eids:
+        raise NotImplementedError(
+            "graph_khop_sampler(return_eids=True): edge-id tracking needs "
+            "sorted_eids plumbed through the per-hop sampler; sample "
+            "without eids or look features up by (src, dst) pairs")
+    return local_src, local_dst, sample_index
+
+
+# reference aliases onto the geometric message-passing family
+def graph_send_recv(x, src_index, dst_index, pool_type="sum",
+                    out_size=None, name=None):
+    from .. import geometric as G
+    return G.send_u_recv(x, src_index, dst_index, reduce_op=pool_type,
+                         out_size=out_size)
+
+
+def graph_sample_neighbors(row, colptr, input_nodes, eids=None,
+                           perm_buffer=None, sample_size=-1,
+                           return_eids=False, flag_perm_buffer=False,
+                           name=None):
+    from .. import geometric as G
+    return G.sample_neighbors(row, colptr, input_nodes,
+                              sample_size=sample_size)
+
+
+def graph_reindex(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  flag_buffer_hashtable=False, name=None):
+    from .. import geometric as G
+    return G.reindex_graph(x, neighbors, count)
+
+
+def segment_sum(data, segment_ids, name=None):
+    from .. import geometric as G
+    return G.segment_sum(data, segment_ids)
+
+
+def segment_mean(data, segment_ids, name=None):
+    from .. import geometric as G
+    return G.segment_mean(data, segment_ids)
+
+
+def segment_max(data, segment_ids, name=None):
+    from .. import geometric as G
+    return G.segment_max(data, segment_ids)
+
+
+def segment_min(data, segment_ids, name=None):
+    from .. import geometric as G
+    return G.segment_min(data, segment_ids)
+
+
+__all__ += ["softmax_mask_fuse", "softmax_mask_fuse_upper_triangle",
+            "identity_loss", "graph_send_recv", "graph_khop_sampler",
+            "graph_sample_neighbors", "graph_reindex", "segment_sum",
+            "segment_mean", "segment_max", "segment_min"]

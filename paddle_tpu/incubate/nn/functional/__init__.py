@@ -262,3 +262,179 @@ def masked_multihead_attention(x, cache_kv=None, bias=None,
         return o.reshape(B, H * D)
 
     return apply("masked_multihead_attention", fwd, ins)
+
+
+def fused_linear_activation(x, y, bias, trans_x=False, trans_y=False,
+                            activation="gelu", name=None):
+    """Reference: fused_gemm_epilogue — matmul + bias + activation in one
+    op (cublasLt epilogue there; one XLA fusion here)."""
+    act = {"gelu": jax.nn.gelu, "relu": jax.nn.relu,
+           "none": lambda a: a}[activation]
+
+    def f(xa, ya, ba):
+        if trans_x:
+            xa = jnp.swapaxes(xa, -1, -2)
+        if trans_y:
+            ya = jnp.swapaxes(ya, -1, -2)
+        return act(xa @ ya + ba)
+
+    return apply("fused_linear_activation", f, [x, y, bias])
+
+
+def fused_bias_dropout_residual_layer_norm(
+        x, residual, bias=None, ln_scale=None, ln_bias=None,
+        dropout_rate=0.5, ln_epsilon=1e-5, training=True,
+        mode="upscale_in_train", name=None):
+    """Reference: fused_transformer.py fused_bias_dropout_residual_layer_
+    norm: LN(residual + dropout(x + bias))."""
+    key = _random.next_key() if (training and dropout_rate > 0) else None
+    has_bias = bias is not None
+    has_scale = ln_scale is not None
+    has_lnb = ln_bias is not None
+
+    def f(xa, res, *rest):
+        rest = list(rest)
+        b = rest.pop(0) if has_bias else None
+        sc = rest.pop(0) if has_scale else None
+        lb = rest.pop(0) if has_lnb else None
+        h = xa + b if b is not None else xa
+        if key is not None:
+            keep = jax.random.bernoulli(key, 1.0 - dropout_rate, h.shape)
+            if mode == "upscale_in_train":
+                h = jnp.where(keep, h / (1.0 - dropout_rate), 0.0)
+            else:
+                h = jnp.where(keep, h, 0.0)
+        h = h + res
+        mu = h.mean(-1, keepdims=True)
+        var = ((h - mu) ** 2).mean(-1, keepdims=True)
+        out = (h - mu) / jnp.sqrt(var + ln_epsilon)
+        if sc is not None:
+            out = out * sc
+        if lb is not None:
+            out = out + lb
+        return out
+
+    ins = [x, residual]
+    for t in (bias, ln_scale, ln_bias):
+        if t is not None:
+            ins.append(t)
+    return apply("fused_bias_dropout_residual_layer_norm", f, ins)
+
+
+def fused_ec_moe(x, gate, bmm0_weight, bmm0_bias, bmm1_weight, bmm1_bias,
+                 act_type="gelu", name=None):
+    """Reference: fused_ec_moe op — expert-choice MoE: every token is
+    processed by every expert, outputs mixed by softmax gate weights.
+    x [B, S, D]; gate [B, S, E]; bmm0 [E, D, Dff]; bmm1 [E, Dff, D]."""
+    act = {"gelu": jax.nn.gelu, "relu": jax.nn.relu}[act_type]
+
+    def f(xa, ga, w0, b0, w1, b1):
+        h = jnp.einsum("bsd,edf->besf", xa, w0) + b0[None, :, None, :]
+        h = act(h)
+        o = jnp.einsum("besf,efd->besd", h, w1) + b1[None, :, None, :]
+        gw = jax.nn.softmax(ga, axis=-1)          # [B, S, E]
+        return jnp.einsum("besd,bse->bsd", o, gw)
+
+    return apply("fused_ec_moe", f,
+                 [x, gate, bmm0_weight, bmm0_bias, bmm1_weight, bmm1_bias])
+
+
+def variable_length_memory_efficient_attention(
+        query, key, value, seq_lens, kv_seq_lens, mask=None, scale=None,
+        causal=False, pre_cache_length=0):
+    """Reference: variable_length_memory_efficient_attention.py (cutlass
+    memory-efficient kernel): attention over [B, H, S, D] with per-batch
+    valid lengths; padded keys masked out. XLA fuses the masking; the
+    Pallas flash path covers the fixed-length fast case."""
+    has_mask = mask is not None
+
+    def f(q, k, v, sl, kvl, *rest):
+        B, H, S, D = q.shape
+        sc = scale if scale is not None else 1.0 / np.sqrt(D)
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * sc
+        if rest:
+            s = s + rest[0].astype(s.dtype)
+        kpos = jnp.arange(k.shape[2])
+        kv_valid = kpos[None, :] < kvl[:, None]          # [B, Sk]
+        neg = jnp.asarray(-1e30, s.dtype)
+        s = jnp.where(kv_valid[:, None, None, :], s, neg)
+        if causal:
+            qpos = jnp.arange(S)
+            s = jnp.where(qpos[:, None] >= (kpos - pre_cache_length)[None],
+                          s, neg)
+        p = jax.nn.softmax(s, axis=-1)
+        out = jnp.einsum("bhqk,bhkd->bhqd", p, v)
+        q_valid = jnp.arange(S)[None, :] < sl[:, None]   # [B, S]
+        return jnp.where(q_valid[:, None, :, None], out, 0.0)
+
+    ins = [query, key, value, seq_lens, kv_seq_lens]
+    if has_mask:
+        ins.append(mask)
+    return apply("variable_length_memory_efficient_attention", f, ins)
+
+
+def fused_multi_transformer(
+        x, ln_scales, ln_biases, qkv_weights, qkv_biases, linear_weights,
+        linear_biases, ffn_ln_scales, ffn_ln_biases, ffn1_weights,
+        ffn1_biases, ffn2_weights, ffn2_biases, pre_layer_norm=True,
+        epsilon=1e-5, cache_kvs=None, attn_mask=None, dropout_rate=0.0,
+        activation="gelu", training=False, mode="upscale_in_train",
+        name=None):
+    """Reference: fused_transformer.py fused_multi_transformer — a stack
+    of pre-LN transformer layers as one op (the serving fast path).
+    qkv_weights[i]: [3, H, D, hidden]; returns the final hidden states.
+    Simplifications vs the CUDA op: inference path (no dropout inside,
+    matching its primary use), no cache update when cache_kvs is None."""
+    n_layers = len(qkv_weights)
+    act = {"gelu": jax.nn.gelu, "relu": jax.nn.relu}[activation]
+    has_mask = attn_mask is not None
+
+    flat = [x]
+    for i in range(n_layers):
+        flat += [ln_scales[i], ln_biases[i], qkv_weights[i], qkv_biases[i],
+                 linear_weights[i], linear_biases[i], ffn_ln_scales[i],
+                 ffn_ln_biases[i], ffn1_weights[i], ffn1_biases[i],
+                 ffn2_weights[i], ffn2_biases[i]]
+    if has_mask:
+        flat.append(attn_mask)
+
+    def f(xa, *arrs):
+        arrs = list(arrs)
+        m = arrs.pop() if has_mask else None
+        h = xa
+        for i in range(n_layers):
+            (lns, lnb, qkvw, qkvb, lw, lb, flns, flnb, f1w, f1b, f2w,
+             f2b) = arrs[i * 12:(i + 1) * 12]
+
+            def ln(t, s, b):
+                mu = t.mean(-1, keepdims=True)
+                var = ((t - mu) ** 2).mean(-1, keepdims=True)
+                return (t - mu) / jnp.sqrt(var + epsilon) * s + b
+
+            inp = ln(h, lns, lnb) if pre_layer_norm else h
+            B, S, D = inp.shape
+            nh, hd = qkvw.shape[1], qkvw.shape[2]
+            qkv = jnp.einsum("bsd,thed->bsthe", inp,
+                             qkvw) + qkvb[None, None]
+            q = qkv[:, :, 0].transpose(0, 2, 1, 3)   # [B, H, S, hd]
+            k = qkv[:, :, 1].transpose(0, 2, 1, 3)
+            v = qkv[:, :, 2].transpose(0, 2, 1, 3)
+            s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(hd)
+            if m is not None:
+                s = s + m.astype(s.dtype)
+            p = jax.nn.softmax(s, axis=-1)
+            o = jnp.einsum("bhqk,bhkd->bhqd", p, v).transpose(0, 2, 1, 3)
+            o = o.reshape(B, S, nh * hd) @ lw + lb
+            h = h + o
+            ff_in = ln(h, flns, flnb) if pre_layer_norm else h
+            ff = act(ff_in @ f1w + f1b) @ f2w + f2b
+            h = h + ff
+        return h
+
+    return apply("fused_multi_transformer", f, flat)
+
+
+__all__ += ["fused_linear_activation",
+            "fused_bias_dropout_residual_layer_norm", "fused_ec_moe",
+            "variable_length_memory_efficient_attention",
+            "fused_multi_transformer"]

@@ -10,5 +10,8 @@ from .extra import *  # noqa: F401,F403
 from .loss import *  # noqa: F401,F403
 from .norm import *  # noqa: F401,F403
 from .pooling import *  # noqa: F401,F403
+from .long_tail import *  # noqa: F401,F403
 
-from . import activation, common, conv, loss, norm, pooling  # noqa: F401
+from . import (  # noqa: F401
+    activation, common, conv, long_tail, loss, norm, pooling,
+)

@@ -376,10 +376,38 @@ def hsigmoid_loss(input, label, num_classes, weight, bias=None,
     (matrix_bit_code.h SimpleCode complete-binary-tree default):
     node(j) = (label + num_classes) >> (j+1) - 1,
     bit(j) = ((label + num_classes) >> j) & 1."""
-    if path_table is not None or path_code is not None:
-        raise NotImplementedError(
-            "custom-tree hsigmoid (path_table/path_code) is not "
-            "implemented; the default complete-binary-tree path is")
+    if (path_table is None) != (path_code is None):
+        raise ValueError(
+            "hsigmoid_loss: path_table and path_code must be given "
+            "together (reference CustomCode needs both)")
+    custom = path_table is not None
+
+    def _bce_over_path(x, w, bb, nodes, bits, valid):
+        nodes_c = jnp.maximum(nodes, 0)
+        wn = jnp.take(w, nodes_c, axis=0)                  # [B, D, in]
+        logits = jnp.einsum("bdi,bi->bd", wn, x)
+        if bb is not None:
+            logits = logits + jnp.take(bb.reshape(-1), nodes_c)
+        # P(bit) via sigmoid: loss = sum BCE(bit, logit) over valid nodes
+        bce = jnp.maximum(logits, 0) - logits * bits.astype(jnp.float32) \
+            + jnp.log1p(jnp.exp(-jnp.abs(logits)))
+        return jnp.sum(jnp.where(valid, bce, 0.0), axis=1, keepdims=True)
+
+    if custom:
+        # reference matrix_bit_code.h CustomCode: per-sample node ids in
+        # path_table, 0/1 codes in path_code, entries < 0 are padding
+        ins = [input, path_table, path_code, weight] + (
+            [bias] if bias is not None else [])
+
+        def fwd_custom(x, ptab, pcode, w, *bb):
+            nodes = ptab.astype(jnp.int32)
+            bits = pcode.astype(jnp.int32)
+            valid = nodes >= 0
+            return _bce_over_path(x, w, bb[0] if bb else None, nodes,
+                                  bits, valid)
+
+        return apply("hsigmoid_loss", fwd_custom, ins)
+
     depth = int(np.ceil(np.log2(max(num_classes, 2))))
     ins = [input, label, weight] + ([bias] if bias is not None else [])
 
@@ -389,16 +417,8 @@ def hsigmoid_loss(input, label, num_classes, weight, bias=None,
         js = jnp.arange(depth, dtype=jnp.int32)
         nodes = (code[:, None] >> (js + 1)[None, :]) - 1   # [B, D]
         bits = (code[:, None] >> js[None, :]) & 1          # [B, D]
-        valid = nodes >= 0
-        nodes_c = jnp.maximum(nodes, 0)
-        wn = jnp.take(w, nodes_c, axis=0)                  # [B, D, in]
-        logits = jnp.einsum("bdi,bi->bd", wn, x)
-        if bb:
-            logits = logits + jnp.take(bb[0].reshape(-1), nodes_c)
-        # P(bit) via sigmoid: loss = sum BCE(bit, logit) over valid nodes
-        bce = jnp.maximum(logits, 0) - logits * bits.astype(jnp.float32) \
-            + jnp.log1p(jnp.exp(-jnp.abs(logits)))
-        return jnp.sum(jnp.where(valid, bce, 0.0), axis=1, keepdims=True)
+        return _bce_over_path(x, w, bb[0] if bb else None, nodes, bits,
+                              nodes >= 0)
 
     return apply("hsigmoid_loss", fwd, ins)
 

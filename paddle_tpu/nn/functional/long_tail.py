@@ -1,0 +1,261 @@
+"""Long-tail functional ops (reference: python/paddle/nn/functional/ —
+activation.py inplace variants, loss.py dice/log/npair/focal/margin
+losses, common.py sequence_mask, input.py class_center_sample,
+extra.py gather_tree, norm.py local_response_norm,
+sparse_attention over phi sparse_attention kernels)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dispatch import apply
+from ...core.tensor import Tensor
+
+__all__ = [
+    "thresholded_relu", "elu_", "hardtanh_", "leaky_relu_", "softmax_",
+    "tanh_", "thresholded_relu_", "local_response_norm", "sequence_mask",
+    "gather_tree", "dice_loss", "log_loss", "npair_loss",
+    "sigmoid_focal_loss", "margin_cross_entropy", "class_center_sample",
+    "sparse_attention",
+]
+
+
+def thresholded_relu(x, threshold=1.0, name=None):
+    """Reference: F.thresholded_relu — x where x > threshold else 0."""
+    return apply("thresholded_relu",
+                 lambda a: jnp.where(a > threshold, a, 0.0), [x])
+
+
+# -- inplace activation variants (reference: activation.py *_ ad_funcs;
+# XLA arrays are immutable so inplace adopts the result, ops/inplace.py) --
+
+def elu_(x, alpha=1.0, name=None):
+    from .activation import elu
+    return x._inplace(elu, alpha)
+
+
+def hardtanh_(x, min=-1.0, max=1.0, name=None):  # noqa: A002
+    from .activation import hardtanh
+    return x._inplace(hardtanh, min, max)
+
+
+def leaky_relu_(x, negative_slope=0.01, name=None):
+    from .activation import leaky_relu
+    return x._inplace(leaky_relu, negative_slope)
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    from .activation import softmax
+    return x._inplace(softmax, axis, dtype)
+
+
+def tanh_(x, name=None):
+    from .activation import tanh
+    return x._inplace(tanh)
+
+
+def thresholded_relu_(x, threshold=1.0, name=None):
+    return x._inplace(thresholded_relu, threshold)
+
+
+def local_response_norm(x, size=5, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    """Reference: F.local_response_norm (AlexNet LRN): divide by
+    (k + alpha/size * sum of squares over a cross-channel window)^beta."""
+    if data_format not in ("NCL", "NCHW", "NCDHW"):
+        raise ValueError(
+            f"local_response_norm supports channels-first formats, got "
+            f"{data_format}")
+
+    def f(a):
+        sq = jnp.square(a)
+        half = size // 2
+        pads = [(0, 0)] * a.ndim
+        pads[1] = (half, size - 1 - half)
+        padded = jnp.pad(sq, pads)
+        acc = jnp.zeros_like(a)
+        for i in range(size):
+            acc = acc + jax.lax.slice_in_dim(padded, i, i + a.shape[1],
+                                             axis=1)
+        div = jnp.power(k + alpha / size * acc, beta)
+        return a / div
+
+    return apply("local_response_norm", f, [x])
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    """Reference: F.sequence_mask — mask[..., j] = j < x[...]."""
+    from ...core.dtype import convert_dtype
+
+    arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    if maxlen is None:
+        maxlen = int(np.asarray(arr).max())
+    jdt = convert_dtype(dtype)
+
+    def f(lens):
+        rng = jnp.arange(maxlen, dtype=lens.dtype)
+        return (rng < lens[..., None]).astype(jdt)
+
+    return apply("sequence_mask", f, [x])
+
+
+def gather_tree(ids, parents):
+    """Reference: F.gather_tree (beam search backtrace): walk parent
+    pointers from the last step so every beam's path is consistent.
+    ids/parents: [T, B, beam]."""
+    def f(idv, par):
+        T = idv.shape[0]
+
+        def step(carry, t):
+            beams = carry  # [B, beam] current beam index per slot
+            out = jnp.take_along_axis(idv[t], beams, axis=-1)
+            nxt = jnp.take_along_axis(par[t], beams, axis=-1)
+            return nxt, out
+
+        last = jnp.broadcast_to(
+            jnp.arange(idv.shape[2], dtype=idv.dtype),
+            idv.shape[1:])
+        _, rev = jax.lax.scan(step, last, jnp.arange(T - 1, -1, -1))
+        return rev[::-1]
+
+    return apply("gather_tree", f, [ids, parents])
+
+
+# -- loss family ---------------------------------------------------------
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """Reference: F.dice_loss — 1 - 2|X∩Y| / (|X|+|Y|). input [N, ..., C]
+    probabilities; label [N, ..., 1] class ids."""
+    def f(inp, lab):
+        lab_oh = jax.nn.one_hot(lab[..., 0], inp.shape[-1],
+                                dtype=inp.dtype)
+        reduce_axes = tuple(range(1, inp.ndim))
+        inter = jnp.sum(inp * lab_oh, axis=reduce_axes)
+        union = jnp.sum(inp, axis=reduce_axes) + \
+            jnp.sum(lab_oh, axis=reduce_axes)
+        return jnp.mean(1 - (2 * inter + epsilon) / (union + epsilon))
+
+    return apply("dice_loss", f, [input, label])
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    """Reference: F.log_loss — negative log likelihood of probabilities."""
+    def f(inp, lab):
+        return -lab * jnp.log(inp + epsilon) \
+            - (1 - lab) * jnp.log(1 - inp + epsilon)
+
+    return apply("log_loss", f, [input, label])
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
+    """Reference: F.npair_loss (Sohn 2016): softmax CE over
+    anchor·positiveᵀ similarities with matching-label targets + L2."""
+    def f(anc, pos, lab):
+        l2 = jnp.sum(anc * anc) / anc.shape[0] + \
+            jnp.sum(pos * pos) / pos.shape[0]
+        sim = anc @ pos.T                          # [B, B]
+        same = (lab[:, None] == lab[None, :]).astype(sim.dtype)
+        targets = same / jnp.maximum(same.sum(-1, keepdims=True), 1.0)
+        logp = jax.nn.log_softmax(sim, axis=-1)
+        ce = -jnp.mean(jnp.sum(targets * logp, axis=-1))
+        return ce + l2_reg * l2 * 0.25
+
+    return apply("npair_loss", f, [anchor, positive, labels])
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25,
+                       gamma=2.0, reduction="sum", name=None):
+    """Reference: F.sigmoid_focal_loss (RetinaNet)."""
+    has_norm = normalizer is not None
+
+    def f(lg, lab, *rest):
+        p = jax.nn.sigmoid(lg)
+        ce = jnp.maximum(lg, 0) - lg * lab + \
+            jnp.log1p(jnp.exp(-jnp.abs(lg)))
+        p_t = p * lab + (1 - p) * (1 - lab)
+        a_t = alpha * lab + (1 - alpha) * (1 - lab)
+        loss = a_t * ((1 - p_t) ** gamma) * ce
+        if rest:
+            loss = loss / rest[0]
+        if reduction == "sum":
+            return jnp.sum(loss)
+        if reduction == "mean":
+            return jnp.mean(loss)
+        return loss
+
+    ins = [logit, label] + ([normalizer] if has_norm else [])
+    return apply("sigmoid_focal_loss", f, ins)
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean", name=None):
+    """Reference: F.margin_cross_entropy (ArcFace/CosFace family):
+    cos(m1·θ + m2) - m3 on the target logit, then scaled softmax CE.
+    Single-group form (the reference's model-parallel group splits the
+    class dim; under GSPMD the sharded matmul handles that upstream)."""
+    def f(lg, lab):
+        n = lg.shape[-1]
+        cos = jnp.clip(lg, -1.0, 1.0)
+        theta = jnp.arccos(cos)
+        modified = jnp.cos(margin1 * theta + margin2) - margin3
+        oh = jax.nn.one_hot(lab, n, dtype=lg.dtype)
+        out = scale * (oh * modified + (1 - oh) * cos)
+        logp = jax.nn.log_softmax(out, axis=-1)
+        ce = -jnp.sum(oh * logp, axis=-1, keepdims=True)
+        sm = jnp.exp(logp)
+        if reduction == "mean":
+            ce_r = jnp.mean(ce)
+        elif reduction == "sum":
+            ce_r = jnp.sum(ce)
+        else:
+            ce_r = ce
+        return (ce_r, sm) if return_softmax else ce_r
+
+    if return_softmax:
+        return apply("margin_cross_entropy", f, [logits, label], nout=2)
+    return apply("margin_cross_entropy", f, [logits, label])
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """Reference: F.class_center_sample (PartialFC): keep all positive
+    class centers plus a uniform sample of negatives; remap labels into
+    the sampled index space. Host op (unique + sampling are inherently
+    data-dependent)."""
+    lab = np.asarray(label._data if isinstance(label, Tensor) else label)
+    pos = np.unique(lab)
+    if pos.size >= num_samples:
+        sampled = pos
+    else:
+        neg = np.setdiff1d(np.arange(num_classes), pos, assume_unique=True)
+        extra = np.random.permutation(neg)[:num_samples - pos.size]
+        sampled = np.sort(np.concatenate([pos, extra]))
+    remap = np.full((num_classes,), -1, np.int64)
+    remap[sampled] = np.arange(sampled.size)
+    return (Tensor(jnp.asarray(remap[lab])),
+            Tensor(jnp.asarray(sampled.astype(np.int64))))
+
+
+def sparse_attention(query, key, value, sparse_csr_offset,
+                     sparse_csr_columns, key_padding_mask=None,
+                     attn_mask=None, name=None):
+    """Reference: F.sparse_attention (phi sparse_attention kernels) — the
+    CSR (offset, columns) pattern selects which logits participate.
+    Adapter over the BCOO sparse-mask attention (sparse/nn/functional.py):
+    the CSR pattern is densified once; XLA fuses the masking."""
+    off = np.asarray(sparse_csr_offset._data
+                     if isinstance(sparse_csr_offset, Tensor)
+                     else sparse_csr_offset)
+    col = np.asarray(sparse_csr_columns._data
+                     if isinstance(sparse_csr_columns, Tensor)
+                     else sparse_csr_columns)
+    S = query.shape[2]
+    mask = np.zeros((S, S), np.float32)
+    off2 = off.reshape(-1, off.shape[-1])[0]
+    col2 = col.reshape(-1, col.shape[-1])[0]
+    for r in range(S):
+        mask[r, col2[off2[r]:off2[r + 1]]] = 1.0
+    from ...sparse.nn.functional import attention as _att
+    return _att(query, key, value, Tensor(jnp.asarray(mask)),
+                key_padding_mask=key_padding_mask, attn_mask=attn_mask)

@@ -1,0 +1,213 @@
+"""paddle.nn.utils — weight reparameterizations + parameter/grad helpers.
+
+Reference: python/paddle/nn/utils/ (weight_norm_hook.py, spectral_norm_hook
+.py, transform_parameters.py, clip_grad_norm_.py, clip_grad_value_.py).
+Reparameterizations install a forward-pre-hook that recomputes the weight
+from the reparameterized pieces before every call — same mechanism as the
+reference's hook-based design.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Parameter, Tensor
+
+__all__ = ["weight_norm", "remove_weight_norm", "spectral_norm",
+           "parameters_to_vector", "vector_to_parameters",
+           "clip_grad_norm_", "clip_grad_value_"]
+
+
+def _norm_except(w, dim):
+    """L2 norm over all axes except ``dim`` (keepdims at dim)."""
+    if dim is None:
+        return jnp.sqrt(jnp.sum(jnp.square(w)))
+    axes = tuple(i for i in range(w.ndim) if i != dim)
+    return jnp.sqrt(jnp.sum(jnp.square(w), axis=axes, keepdims=False))
+
+
+def _reshape_g(g, w, dim):
+    if dim is None:
+        return g
+    shape = [1] * w.ndim
+    shape[dim] = -1
+    return g.reshape(shape)
+
+
+class _WeightNormHook:
+    def __init__(self, name, dim):
+        self.name = name
+        self.dim = dim
+
+    def compute(self, layer):
+        g = getattr(layer, self.name + "_g")
+        v = getattr(layer, self.name + "_v")
+        # computed through one taped apply so grads reach both g and v
+        from ..core.dispatch import apply
+
+        def f(gv, vv):
+            n = _norm_except(vv, self.dim)
+            if self.dim is None:
+                return vv * (gv / n)
+            return vv * (_reshape_g(gv, vv, self.dim)
+                         / _reshape_g(n, vv, self.dim))
+        return apply("weight_norm", f, [g, v])
+
+    def __call__(self, layer, inputs):
+        object.__setattr__(layer, self.name, self.compute(layer))
+        return None
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Reference: nn.utils.weight_norm — w = g * v / ||v|| with ||·|| over
+    every axis except ``dim``; g and v become the trainable parameters."""
+    w = getattr(layer, name)
+    del layer._parameters[name]
+    v = Parameter(w._data)
+    v.stop_gradient = False
+    g_init = np.asarray(_norm_except(w._data, dim))
+    g = Parameter(jnp.asarray(g_init))
+    g.stop_gradient = False
+    layer.add_parameter(name + "_v", v)
+    layer.add_parameter(name + "_g", g)
+    hook = _WeightNormHook(name, dim)
+    helper = layer.register_forward_pre_hook(hook)
+    layer._weight_norm_hooks = getattr(layer, "_weight_norm_hooks", {})
+    layer._weight_norm_hooks[name] = (hook, helper)
+    hook(layer, None)  # materialize once so the attr exists pre-forward
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    hook, helper = layer._weight_norm_hooks.pop(name)
+    helper.remove()
+    w = hook.compute(layer)
+    del layer._parameters[name + "_g"]
+    del layer._parameters[name + "_v"]
+    w2 = Parameter(w._data)
+    w2.stop_gradient = False
+    layer.add_parameter(name, w2)
+    return layer
+
+
+class _SpectralNormHook:
+    def __init__(self, name, n_power_iterations, eps, dim):
+        self.name = name
+        self.n = n_power_iterations
+        self.eps = eps
+        self.dim = dim
+
+    def compute(self, layer):
+        from ..core.dispatch import apply
+        import jax
+        w = getattr(layer, self.name + "_orig")
+        u = getattr(layer, self.name + "_u")
+        mat = w._data
+        if self.dim != 0:
+            mat = jnp.moveaxis(mat, self.dim, 0)
+        mat2 = mat.reshape(mat.shape[0], -1)
+        uv = u._data
+        for _ in range(self.n):
+            v = mat2.T @ uv
+            v = v / (jnp.linalg.norm(v) + self.eps)
+            uv = mat2 @ v
+            uv = uv / (jnp.linalg.norm(uv) + self.eps)
+        u._data = jax.lax.stop_gradient(uv)
+        vv = jax.lax.stop_gradient(v)
+
+        def f(wa):
+            m = wa
+            if self.dim != 0:
+                m = jnp.moveaxis(m, self.dim, 0)
+            m2 = m.reshape(m.shape[0], -1)
+            sigma = uv @ (m2 @ vv)
+            return wa / sigma
+
+        return apply("spectral_norm", f, [w])
+
+    def __call__(self, layer, inputs):
+        object.__setattr__(layer, self.name, self.compute(layer))
+        return None
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    """Reference: nn.utils.spectral_norm — divide the weight by its
+    largest singular value, estimated by power iteration."""
+    w = getattr(layer, name)
+    if dim is None:
+        dim = 1 if type(layer).__name__ in (
+            "Linear", "ColumnParallelLinear", "RowParallelLinear") else 0
+    del layer._parameters[name]
+    orig = Parameter(w._data)
+    orig.stop_gradient = False
+    layer.add_parameter(name + "_orig", orig)
+    mat = w._data
+    if dim != 0:
+        mat = jnp.moveaxis(mat, dim, 0)
+    h = mat.reshape(mat.shape[0], -1).shape[0]
+    rng = np.random.RandomState(0)
+    u0 = rng.randn(h).astype(np.asarray(w._data).dtype)
+    u0 /= np.linalg.norm(u0) + eps
+    layer.register_buffer(name + "_u", Tensor(jnp.asarray(u0)))
+    hook = _SpectralNormHook(name, n_power_iterations, eps, dim)
+    helper = layer.register_forward_pre_hook(hook)
+    layer._spectral_norm_hooks = getattr(layer, "_spectral_norm_hooks", {})
+    layer._spectral_norm_hooks[name] = (hook, helper)
+    hook(layer, None)
+    return layer
+
+
+def parameters_to_vector(parameters, name=None):
+    """Reference: transform_parameters.py — flatten + concat."""
+    params = list(parameters)
+    from ..core.dispatch import apply
+    return apply("parameters_to_vector",
+                 lambda *arrs: jnp.concatenate(
+                     [a.reshape(-1) for a in arrs]), params)
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    params = list(parameters)
+    pos = 0
+    arr = vec._data
+    for p in params:
+        n = int(np.prod(p.shape)) if p.shape else 1
+        chunk = arr[pos:pos + n].reshape(p.shape)
+        p._data = chunk.astype(p._data.dtype)
+        pos += n
+    return params
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    """Reference: clip_grad_norm_.py — scales .grad in place, returns the
+    total norm of the gradients."""
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    grads = [p._grad for p in parameters if p._grad is not None]
+    if not grads:
+        return Tensor(jnp.asarray(0.0))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack([jnp.max(jnp.abs(g)) for g in grads]))
+    else:
+        total = jnp.sum(jnp.stack(
+            [jnp.sum(jnp.abs(g) ** norm_type) for g in grads])) \
+            ** (1.0 / norm_type)
+    if error_if_nonfinite and not bool(jnp.isfinite(total)):
+        raise RuntimeError(
+            f"the total norm of gradients is non-finite ({total}); set "
+            "error_if_nonfinite=False to scale anyway")
+    coef = jnp.minimum(max_norm / (total + 1e-6), 1.0)
+    for p in parameters:
+        if p._grad is not None:
+            p._grad = p._grad * coef
+    return Tensor(total)
+
+
+def clip_grad_value_(parameters, clip_value):
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    for p in parameters:
+        if p._grad is not None:
+            p._grad = jnp.clip(p._grad, -clip_value, clip_value)

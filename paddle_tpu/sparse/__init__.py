@@ -15,7 +15,16 @@ from jax.experimental import sparse as jsparse
 from ..core.tensor import Tensor
 
 __all__ = ["sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
-           "is_same_shape", "add", "matmul", "masked_matmul", "nn"]
+           "is_same_shape", "add", "matmul", "masked_matmul", "nn",
+           # unary family (reference: paddle/sparse/unary.py)
+           "abs", "sin", "sinh", "asin", "asinh", "atan", "atanh", "tan",
+           "tanh", "sqrt", "square", "log1p", "expm1", "neg", "pow",
+           "deg2rad", "rad2deg", "cast", "isnan", "coalesce", "relu",
+           "relu6", "leaky_relu", "softmax", "transpose", "reshape",
+           "slice", "sum",
+           # binary/matrix family (reference: paddle/sparse/binary.py)
+           "subtract", "multiply", "divide", "mv", "addmm", "attention",
+           "pca_lowrank"]
 
 
 class SparseCooTensor:
@@ -117,4 +126,187 @@ def masked_matmul(x, y, mask):
     return SparseCooTensor(jsparse.BCOO((vals, idx), shape=full.shape))
 
 
+# -- unary family (reference: python/paddle/sparse/unary.py — value-wise
+# ops preserve the sparsity pattern; kernels in phi/kernels/sparse/) ------
+
+def _as_coo(x, op):
+    if not isinstance(x, SparseCooTensor):
+        raise TypeError(f"sparse.{op} expects a SparseCooTensor, got "
+                        f"{type(x).__name__}")
+    return x._bcoo
+
+
+def _unary(op, fn):
+    def f(x, name=None):
+        bcoo = _as_coo(x, op)
+        return SparseCooTensor(jsparse.BCOO((fn(bcoo.data), bcoo.indices),
+                                            shape=bcoo.shape))
+    f.__name__ = op
+    f.__qualname__ = op
+    f.__doc__ = (f"paddle.sparse.{op} — value-wise on stored elements, "
+                 "sparsity pattern preserved (reference: "
+                 "python/paddle/sparse/unary.py)")
+    return f
+
+
+abs = _unary("abs", jnp.abs)
+sin = _unary("sin", jnp.sin)
+sinh = _unary("sinh", jnp.sinh)
+asin = _unary("asin", jnp.arcsin)
+asinh = _unary("asinh", jnp.arcsinh)
+atan = _unary("atan", jnp.arctan)
+atanh = _unary("atanh", jnp.arctanh)
+tan = _unary("tan", jnp.tan)
+tanh = _unary("tanh", jnp.tanh)
+sqrt = _unary("sqrt", jnp.sqrt)
+square = _unary("square", jnp.square)
+log1p = _unary("log1p", jnp.log1p)
+expm1 = _unary("expm1", jnp.expm1)
+neg = _unary("neg", jnp.negative)
+deg2rad = _unary("deg2rad", jnp.deg2rad)
+rad2deg = _unary("rad2deg", jnp.rad2deg)
+isnan = _unary("isnan", jnp.isnan)
+relu = _unary("relu", lambda v: jnp.maximum(v, 0))
+relu6 = _unary("relu6", lambda v: jnp.clip(v, 0, 6))
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    bcoo = _as_coo(x, "leaky_relu")
+    data = jnp.where(bcoo.data > 0, bcoo.data, negative_slope * bcoo.data)
+    return SparseCooTensor(jsparse.BCOO((data, bcoo.indices),
+                                        shape=bcoo.shape))
+
+
+def pow(x, factor, name=None):
+    bcoo = _as_coo(x, "pow")
+    return SparseCooTensor(jsparse.BCOO((jnp.power(bcoo.data, factor),
+                                         bcoo.indices), shape=bcoo.shape))
+
+
+def cast(x, index_dtype=None, value_dtype=None, name=None):
+    from ..core.dtype import convert_dtype
+    bcoo = _as_coo(x, "cast")
+    data, idx = bcoo.data, bcoo.indices
+    if value_dtype is not None:
+        data = data.astype(convert_dtype(value_dtype))
+    if index_dtype is not None:
+        idx = idx.astype(convert_dtype(index_dtype))
+    return SparseCooTensor(jsparse.BCOO((data, idx), shape=bcoo.shape))
+
+
+def coalesce(x, name=None):
+    """Merge duplicate indices (reference: sparse/unary.py coalesce)."""
+    return SparseCooTensor(jsparse.bcoo_sum_duplicates(_as_coo(x,
+                                                               "coalesce")))
+
+
+def softmax(x, axis=-1, name=None):
+    """Softmax over the stored elements of each row — zeros are treated as
+    -inf exactly like the reference CSR softmax
+    (phi/kernels/sparse/softmax_kernel).  2-D COO only."""
+    bcoo = _as_coo(x, "softmax")
+    if len(bcoo.shape) != 2 or axis not in (-1, 1):
+        raise NotImplementedError(
+            "sparse.softmax supports 2-D tensors over the last axis")
+    bcoo = jsparse.bcoo_sum_duplicates(bcoo)
+    rows = bcoo.indices[:, 0]
+    n_rows = bcoo.shape[0]
+    row_max = jax.ops.segment_max(bcoo.data, rows, num_segments=n_rows)
+    shifted = jnp.exp(bcoo.data - row_max[rows])
+    row_sum = jax.ops.segment_sum(shifted, rows, num_segments=n_rows)
+    return SparseCooTensor(jsparse.BCOO((shifted / row_sum[rows],
+                                         bcoo.indices), shape=bcoo.shape))
+
+
+def transpose(x, perm, name=None):
+    bcoo = _as_coo(x, "transpose")
+    idx = bcoo.indices[:, jnp.asarray(perm, jnp.int32)]
+    shape = tuple(bcoo.shape[p] for p in perm)
+    return SparseCooTensor(jsparse.BCOO((bcoo.data, idx), shape=shape))
+
+
+def reshape(x, shape, name=None):
+    """Dense-bridge reshape (pattern recomputed; reference
+    sparse/unary.py reshape semantics)."""
+    dense = _as_coo(x, "reshape").todense().reshape(shape)
+    return _dense_to_coo(dense)
+
+
+def slice(x, axes, starts, ends, name=None):
+    import builtins
+    dense = _as_coo(x, "slice").todense()
+    index = [builtins.slice(None)] * dense.ndim
+    for ax, s, e in zip(axes, starts, ends):
+        index[ax] = builtins.slice(s, e)
+    return _dense_to_coo(dense[tuple(index)])
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    """Reduce over a sparse tensor → dense Tensor (reference returns
+    sparse; the dense bridge keeps downstream composition simple)."""
+    bcoo = _as_coo(x, "sum")
+    dense = bcoo.todense()
+    out = jnp.sum(dense, axis=axis, keepdims=keepdim)
+    if dtype is not None:
+        from ..core.dtype import convert_dtype
+        out = out.astype(convert_dtype(dtype))
+    return Tensor(out)
+
+
+def _dense_to_coo(dense):
+    nz = jnp.stack(jnp.nonzero(dense), axis=1)
+    vals = dense[tuple(nz.T)]
+    return SparseCooTensor(jsparse.BCOO((vals, nz.astype(jnp.int32)),
+                                        shape=dense.shape))
+
+
+# -- binary / matrix family (reference: python/paddle/sparse/binary.py) ---
+
+def subtract(x, y, name=None):
+    return add(x, SparseCooTensor(
+        jsparse.BCOO((-y._bcoo.data, y._bcoo.indices), shape=y._bcoo.shape)))
+
+
+def _dense_binary(op, fn):
+    def f(x, y, name=None):
+        a = _as_coo(x, op).todense()
+        b = _as_coo(y, op).todense()
+        return _dense_to_coo(fn(a, b))
+    f.__name__ = op
+    f.__doc__ = (f"paddle.sparse.{op} — elementwise on two sparse tensors "
+                 "(dense bridge; reference sparse/binary.py)")
+    return f
+
+
+multiply = _dense_binary("multiply", jnp.multiply)
+divide = _dense_binary("divide",
+                       lambda a, b: jnp.where(b != 0, a / jnp.where(
+                           b != 0, b, 1), 0.0))
+
+
+def mv(x, vec, name=None):
+    """sparse matrix @ dense vector → dense (reference: sparse.mv)."""
+    v = vec._data if isinstance(vec, Tensor) else jnp.asarray(vec)
+    return Tensor(_as_coo(x, "mv") @ v)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """beta*input + alpha*(x @ y), x sparse (reference: sparse.addmm)."""
+    dense_in = input._data if isinstance(input, Tensor) else \
+        jnp.asarray(input)
+    ya = y._data if isinstance(y, Tensor) else jnp.asarray(y)
+    return Tensor(beta * dense_in + alpha * (_as_coo(x, "addmm") @ ya))
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """Reference: paddle.sparse.pca_lowrank — dense bridge onto the dense
+    linalg implementation."""
+    from ..ops import linalg as _linalg
+    return _linalg.pca_lowrank(Tensor(_as_coo(x, "pca_lowrank").todense()),
+                               q=q, center=center, niter=niter)
+
+
 from . import nn  # noqa: E402,F401
+from .nn import functional as _spF  # noqa: E402
+
+attention = _spF.attention

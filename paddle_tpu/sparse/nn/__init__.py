@@ -1,0 +1,150 @@
+"""paddle.sparse.nn — sparse layers over the functional gather-GEMM ops.
+
+Reference: python/paddle/sparse/nn/layer/ (conv.py SubmConv2D/3D + Conv2D/
+3D, activation.py, norm.py BatchNorm/SyncBatchNorm, pooling.py MaxPool3D).
+Layers hold parameters and defer to .functional; norms run the dense
+BatchNorm1D machinery on the [nnz, C] value matrix (values-only batch norm,
+exactly the reference's sparse BN semantics: statistics over stored
+elements per channel).
+"""
+from __future__ import annotations
+
+from ... import nn as dense_nn
+from . import functional
+from .functional import attention  # noqa: F401  (reference re-export)
+
+__all__ = ["attention", "functional",
+           "SubmConv2D", "SubmConv3D", "Conv2D", "Conv3D",
+           "ReLU", "ReLU6", "LeakyReLU", "Softmax",
+           "BatchNorm", "SyncBatchNorm", "MaxPool3D"]
+
+
+class _SubmConvND(dense_nn.Layer):
+    """Gather-GEMM submanifold conv (reference: sparse/nn/layer/conv.py).
+    Outputs live only at INPUT active sites, so sparsity does not dilate."""
+
+    _ndim = 3
+
+    def __init__(self, in_channels, out_channels, kernel_size=3,
+                 bias_attr=None):
+        super().__init__()
+        assert kernel_size % 2 == 1, "submanifold conv needs odd kernels"
+        self.in_channels = in_channels
+        self.out_channels = out_channels
+        self.kernel_size = kernel_size
+        kn = kernel_size ** self._ndim
+        self.weight = self.create_parameter(
+            (kn * in_channels, out_channels))
+        self.bias = None if bias_attr is False else self.create_parameter(
+            (out_channels,), is_bias=True)
+
+    def forward(self, x):
+        return functional._subm_conv(
+            x, self.weight, self.bias, self.kernel_size, self._ndim,
+            f"subm_conv{self._ndim}d")
+
+
+class SubmConv3D(_SubmConvND):
+    _ndim = 3
+
+
+class SubmConv2D(_SubmConvND):
+    _ndim = 2
+
+
+def _dilation_warning(cls):
+    import warnings
+    warnings.warn(
+        f"paddle_tpu.sparse.nn.{cls} computes outputs at INPUT active "
+        "sites only (submanifold semantics): the reference Conv dilates "
+        "the active set by the kernel footprint. Results differ wherever "
+        "dilation would activate new sites — use the dense conv for exact "
+        "reference semantics.", stacklevel=3)
+
+
+class Conv3D(SubmConv3D):
+    """Non-submanifold sparse conv (reference: sparse/nn/layer/conv.py
+    Conv3D). Simplification: computes at input active sites only."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        _dilation_warning("Conv3D")
+
+
+class Conv2D(SubmConv2D):
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        _dilation_warning("Conv2D")
+
+
+class _ValueAct(dense_nn.Layer):
+    _fn = None
+
+    def forward(self, x):
+        return type(self)._fn(x)
+
+
+class ReLU(_ValueAct):
+    _fn = staticmethod(functional.relu)
+
+
+class ReLU6(_ValueAct):
+    _fn = staticmethod(functional.relu6)
+
+
+class LeakyReLU(dense_nn.Layer):
+    def __init__(self, negative_slope=0.01):
+        super().__init__()
+        self._slope = negative_slope
+
+    def forward(self, x):
+        return functional.leaky_relu(x, self._slope)
+
+
+class Softmax(dense_nn.Layer):
+    def __init__(self, axis=-1):
+        super().__init__()
+        self._axis = axis
+
+    def forward(self, x):
+        return functional.softmax(x, self._axis)
+
+
+class BatchNorm(dense_nn.Layer):
+    """Values-only batch norm (reference: sparse/nn/layer/norm.py
+    BatchNorm — statistics over stored elements per channel)."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NDHWC",
+                 name=None):
+        super().__init__()
+        self._bn = dense_nn.BatchNorm1D(num_features, momentum=momentum,
+                                        epsilon=epsilon)
+
+    def forward(self, x):
+        import jax.experimental.sparse as jsparse
+        from .. import SparseCooTensor
+        from ...core.tensor import Tensor
+        new_vals = self._bn(Tensor(x._bcoo.data))
+        return SparseCooTensor(jsparse.BCOO(
+            (new_vals._data, x._bcoo.indices), shape=x._bcoo.shape))
+
+
+class SyncBatchNorm(BatchNorm):
+    """Single-controller SPMD: global statistics come from GSPMD sharding
+    of the values, so the layer body is identical to BatchNorm (reference:
+    sparse/nn/layer/norm.py SyncBatchNorm over comm kernels)."""
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        return layer
+
+
+class MaxPool3D(dense_nn.Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, name=None):
+        super().__init__()
+        self._args = (kernel_size, stride, padding)
+
+    def forward(self, x):
+        k, s, p = self._args
+        return functional.max_pool3d(x, k, stride=s, padding=p)

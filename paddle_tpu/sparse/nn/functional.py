@@ -1,0 +1,185 @@
+"""paddle.sparse.nn.functional — sparse attention, conv, pooling, acts.
+
+Reference: python/paddle/sparse/nn/functional/ (attention.py, conv.py,
+pooling.py, activation.py over phi/kernels/sparse/). TPU-native design:
+submanifold conv is the gather-GEMM formulation — gather active-site
+neighborhoods from a dense scatter grid, one [n_active, K^n*Cin] x
+[K^n*Cin, Cout] MXU matmul; activations are value-wise on the stored
+elements; pooling takes the dense bridge (reduce_window) and re-sparsifies.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dispatch import apply
+from ...core.tensor import Tensor
+
+__all__ = ["attention", "relu", "relu6", "leaky_relu", "softmax",
+           "conv2d", "conv3d", "subm_conv2d", "subm_conv3d", "max_pool3d"]
+
+
+def attention(query, key, value, sparse_mask, key_padding_mask=None,
+              attn_mask=None, name=None):
+    """Sparse-mask attention (reference: sparse/nn/functional/attention.py).
+
+    query/key/value: [B, H, S, D]; sparse_mask: SparseCooTensor [S, S] (its
+    sparsity pattern selects which logits participate in the softmax)."""
+    from .. import SparseCooTensor
+    mask_dense = sparse_mask.to_dense() if isinstance(
+        sparse_mask, SparseCooTensor) else sparse_mask
+
+    has_kp = key_padding_mask is not None
+    has_am = attn_mask is not None
+
+    def f(q, k, v, m, *rest):
+        d = q.shape[-1]
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.float32(np.sqrt(d))
+        neg = np.float32(-1e30)
+        s = jnp.where(m != 0, s, neg)
+        rest = list(rest)
+        if has_kp:
+            kp = rest.pop(0)  # [B, S] True = keep
+            s = jnp.where(kp[:, None, None, :], s, neg)
+        if has_am:
+            am = rest.pop(0)  # additive mask broadcastable to [B,H,S,S]
+            s = s + am.astype(s.dtype)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+    ins = [query, key, value, mask_dense]
+    if has_kp:
+        ins.append(key_padding_mask)
+    if has_am:
+        ins.append(attn_mask)
+    return apply("sparse_attention", f, ins)
+
+
+def relu(x, name=None):
+    from .. import relu as _r
+    return _r(x)
+
+
+def relu6(x, name=None):
+    from .. import relu6 as _r
+    return _r(x)
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    from .. import leaky_relu as _l
+    return _l(x, negative_slope)
+
+
+def softmax(x, axis=-1, name=None):
+    from .. import softmax as _s
+    return _s(x, axis)
+
+
+def _neighbor_offsets(kernel_size, ndim):
+    r = kernel_size // 2
+    rng = range(-r, r + 1)
+    if ndim == 2:
+        return [(dy, dx) for dy in rng for dx in rng]
+    return [(dz, dy, dx) for dz in rng for dy in rng for dx in rng]
+
+
+def _subm_conv(x, weight, bias, kernel_size, ndim, op_name):
+    """Gather-GEMM submanifold conv over COO [B, *spatial, C]: outputs live
+    only at input active sites (reference SubmConv semantics,
+    sparse/nn/layer/conv.py)."""
+    from .. import SparseCooTensor
+    assert kernel_size % 2 == 1, "submanifold conv needs odd kernels"
+    bcoo = x._bcoo
+    idx = bcoo.indices           # [nnz, 1+ndim]
+    vals = bcoo.data             # [nnz, C]
+    shape = x.shape
+    spatial = shape[1:1 + ndim]
+    in_channels = shape[-1]
+    out_channels = (weight.shape[-1] if not isinstance(weight, Tensor)
+                    else weight.shape[-1])
+    offs = np.array(_neighbor_offsets(kernel_size, ndim), np.int32)
+
+    def f(idx_a, vals_a, w, *rest):
+        grid = jnp.zeros((shape[0],) + tuple(spatial) + (in_channels,),
+                         vals_a.dtype)
+        grid = grid.at[tuple(idx_a[:, d] for d in range(1 + ndim))].set(
+            vals_a)
+        gathered = []
+        for off in offs:
+            coords = [idx_a[:, 0]]
+            inside = None
+            for d, delta in enumerate(off):
+                raw = idx_a[:, 1 + d] + delta
+                ok = (raw >= 0) & (raw < spatial[d])
+                inside = ok if inside is None else (inside & ok)
+                coords.append(jnp.clip(raw, 0, spatial[d] - 1))
+            g = grid[tuple(coords)]
+            gathered.append(jnp.where(inside[:, None], g, 0.0))
+        feat = jnp.concatenate(gathered, axis=-1)  # [nnz, k^n*Cin]
+        out = feat @ w                              # MXU GEMM
+        if rest:
+            out = out + rest[0]
+        return out
+
+    ins = [Tensor(idx), Tensor(vals), weight]
+    if bias is not None:
+        ins.append(bias)
+    out_vals = apply(op_name, f, ins)
+    out_bcoo = jax.experimental.sparse.BCOO(
+        (out_vals._data, idx),
+        shape=(shape[0],) + tuple(spatial) + (out_channels,))
+    return SparseCooTensor(out_bcoo)
+
+
+def subm_conv2d(x, weight, bias=None, kernel_size=None, name=None):
+    """weight: [K*K*Cin, Cout] (gather-GEMM layout). kernel_size inferred
+    from the weight when omitted."""
+    k = kernel_size or int(round((weight.shape[0] // x.shape[-1]) ** 0.5))
+    return _subm_conv(x, weight, bias, k, 2, "subm_conv2d")
+
+
+def subm_conv3d(x, weight, bias=None, kernel_size=None, name=None):
+    k = kernel_size or int(round((weight.shape[0] // x.shape[-1])
+                                 ** (1.0 / 3)))
+    return _subm_conv(x, weight, bias, k, 3, "subm_conv3d")
+
+
+def _dilation_warning(op):
+    import warnings
+    warnings.warn(
+        f"paddle_tpu.sparse.nn.functional.{op} computes outputs at INPUT "
+        "active sites only (submanifold semantics): the reference dilates "
+        "the active set by the kernel footprint. Use the dense conv for "
+        "exact reference semantics.", stacklevel=3)
+
+
+def conv2d(x, weight, bias=None, kernel_size=None, name=None):
+    _dilation_warning("conv2d")
+    return subm_conv2d(x, weight, bias, kernel_size)
+
+
+def conv3d(x, weight, bias=None, kernel_size=None, name=None):
+    _dilation_warning("conv3d")
+    return subm_conv3d(x, weight, bias, kernel_size)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, name=None):
+    """Dense-bridge sparse max pooling (reference:
+    sparse/nn/functional/pooling.py — values at active sites, -inf
+    elsewhere, then windowed max; windows with no active site stay empty).
+    x: COO [B, D, H, W, C]."""
+    from .. import SparseCooTensor, _dense_to_coo
+    from ...nn.functional.pooling import max_pool3d as _dense_pool
+    bcoo = x._bcoo
+    neg = jnp.asarray(-np.inf, bcoo.data.dtype)
+    dense = jnp.full(x.shape, neg)
+    dense = dense.at[tuple(bcoo.indices[:, d] for d in
+                           range(bcoo.indices.shape[1]))].set(bcoo.data)
+    # dense pool expects channels-first [B, C, D, H, W]
+    nchw = jnp.moveaxis(dense, -1, 1)
+    pooled = _dense_pool(Tensor(nchw), kernel_size, stride=stride,
+                         padding=padding)
+    out = jnp.moveaxis(pooled._data, 1, -1)
+    out = jnp.where(jnp.isfinite(out), out, 0.0)
+    return _dense_to_coo(out)

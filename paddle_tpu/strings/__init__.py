@@ -1,0 +1,224 @@
+"""paddle_tpu.strings — string tensors + tokenizer kernels.
+
+Reference: ``paddle/phi/core/string_tensor.h`` (StringTensor),
+``paddle/phi/kernels/strings/`` (empty/copy/lower/upper over pstring data +
+``unicode.h`` case tables), and ``paddle/fluid/operators/string/
+faster_tokenizer_op.h`` (BasicTokenizer → WordPieceTokenizer pipeline that
+turns raw text into input_ids/token_type_ids inside the graph).
+
+TPU-native design: XLA has no string dtype, so string storage and
+transformation are host ops by construction (they are CPU-pinned in the
+reference too); the tokenizer's OUTPUT (ids/segments) is where the device
+path begins. StringTensor wraps a numpy object array; FasterTokenizer
+produces padded int32 jax arrays ready to feed an embedding on device.
+"""
+from __future__ import annotations
+
+import unicodedata
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["StringTensor", "to_string_tensor", "empty", "empty_like",
+           "copy", "lower", "upper", "BasicTokenizer", "WordPieceTokenizer",
+           "FasterTokenizer"]
+
+
+class StringTensor:
+    """Host string tensor (reference: phi/core/string_tensor.h — pstring
+    payloads with a DDim; device kernels are CPU-only there as well)."""
+
+    def __init__(self, data, name=None):
+        arr = np.asarray(data, dtype=object)
+        self._data = arr
+        self.name = name
+
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    def numpy(self):
+        return self._data
+
+    def tolist(self):
+        return self._data.tolist()
+
+    def __repr__(self):
+        return f"StringTensor(shape={self.shape}, data={self._data!r})"
+
+
+def to_string_tensor(data, name=None):
+    return StringTensor(data, name=name)
+
+
+def empty(shape, name=None):
+    """Reference: strings_empty_kernel — a StringTensor of empty strings."""
+    return StringTensor(np.full(tuple(shape), "", dtype=object))
+
+
+def empty_like(x, name=None):
+    return empty(x.shape)
+
+
+def copy(x, name=None):
+    return StringTensor(x._data.copy())
+
+
+def _case_map(x, fn, use_utf8_encoding):
+    # use_utf8_encoding=False: ASCII-only case map (reference
+    # strings_lower_upper_kernel AsciiCaseConverter); True: full unicode
+    # (UTF8CaseConverter over unicode.h tables)
+    if use_utf8_encoding:
+        conv = fn
+    else:
+        def conv(s):
+            return "".join(fn(c) if ord(c) < 128 else c for c in s)
+    out = np.empty_like(x._data)
+    it = np.nditer(x._data, flags=["multi_index", "refs_ok"])
+    for _ in it:
+        out[it.multi_index] = conv(str(x._data[it.multi_index]))
+    return StringTensor(out)
+
+
+def lower(x, use_utf8_encoding=False, name=None):
+    """Reference: phi strings_lower_upper_kernel StringLower."""
+    return _case_map(x, str.lower, use_utf8_encoding)
+
+
+def upper(x, use_utf8_encoding=False, name=None):
+    """Reference: phi strings_lower_upper_kernel StringUpper."""
+    return _case_map(x, str.upper, use_utf8_encoding)
+
+
+def _is_punct(ch):
+    cp = ord(ch)
+    if (33 <= cp <= 47) or (58 <= cp <= 64) or (91 <= cp <= 96) \
+            or (123 <= cp <= 126):
+        return True
+    return unicodedata.category(ch).startswith("P")
+
+
+def _is_chinese_char(cp):
+    return ((0x4E00 <= cp <= 0x9FFF) or (0x3400 <= cp <= 0x4DBF)
+            or (0x20000 <= cp <= 0x2A6DF) or (0x2A700 <= cp <= 0x2B73F)
+            or (0x2B740 <= cp <= 0x2B81F) or (0x2B820 <= cp <= 0x2CEAF)
+            or (0xF900 <= cp <= 0xFAFF) or (0x2F800 <= cp <= 0x2FA1F))
+
+
+class BasicTokenizer:
+    """Whitespace/punctuation/CJK splitting + optional lower/strip-accents
+    (reference: faster_tokenizer_op.h:45 BasicTokenizer)."""
+
+    def __init__(self, do_lower_case=True):
+        self.do_lower_case = do_lower_case
+
+    def tokenize(self, text):
+        if self.do_lower_case:
+            text = text.lower()
+            text = "".join(c for c in unicodedata.normalize("NFD", text)
+                           if unicodedata.category(c) != "Mn")
+        out = []
+        for ch in text:
+            if _is_chinese_char(ord(ch)):
+                out.append(f" {ch} ")
+            elif _is_punct(ch):
+                out.append(f" {ch} ")
+            elif ch.isspace():
+                out.append(" ")
+            elif ord(ch) == 0 or ord(ch) == 0xFFFD:
+                continue
+            else:
+                out.append(ch)
+        return "".join(out).split()
+
+
+class WordPieceTokenizer:
+    """Greedy longest-match-first subword split (reference:
+    faster_tokenizer_op.h:56)."""
+
+    def __init__(self, vocab, unk_token="[UNK]", max_input_chars_per_word
+                 =100):
+        self.vocab = vocab
+        self.unk_token = unk_token
+        self.max_chars = max_input_chars_per_word
+
+    def tokenize(self, word):
+        if len(word) > self.max_chars:
+            return [self.vocab.get(self.unk_token, 0)]
+        ids = []
+        start = 0
+        while start < len(word):
+            end = len(word)
+            cur = None
+            while start < end:
+                sub = word[start:end]
+                if start > 0:
+                    sub = "##" + sub
+                if sub in self.vocab:
+                    cur = self.vocab[sub]
+                    break
+                end -= 1
+            if cur is None:
+                return [self.vocab.get(self.unk_token, 0)]
+            ids.append(cur)
+            start = end
+        return ids
+
+
+class FasterTokenizer:
+    """BERT-style text → (input_ids, token_type_ids) as device-ready int32
+    tensors (reference: faster_tokenizer_op.h FasterTokenizerKernel — the
+    op form of tokenization so serving graphs embed it; here the host op
+    feeds jax arrays straight to the embedding)."""
+
+    def __init__(self, vocab, do_lower_case=True, unk_token="[UNK]",
+                 cls_token="[CLS]", sep_token="[SEP]", pad_token="[PAD]"):
+        if not isinstance(vocab, dict):
+            vocab = {tok: i for i, tok in enumerate(vocab)}
+        self.vocab = vocab
+        self.basic = BasicTokenizer(do_lower_case)
+        self.wordpiece = WordPieceTokenizer(vocab, unk_token)
+        self.cls_id = vocab.get(cls_token, 0)
+        self.sep_id = vocab.get(sep_token, 0)
+        self.pad_id = vocab.get(pad_token, 0)
+
+    def _encode_one(self, text):
+        ids = []
+        for word in self.basic.tokenize(str(text)):
+            ids.extend(self.wordpiece.tokenize(word))
+        return ids
+
+    def __call__(self, text, text_pair=None, max_seq_len=None,
+                 is_split_into_words=False, pad_to_max_seq_len=False):
+        texts = (text.tolist() if isinstance(text, StringTensor)
+                 else ([text] if isinstance(text, str) else list(text)))
+        pairs = None
+        if text_pair is not None:
+            pairs = (text_pair.tolist()
+                     if isinstance(text_pair, StringTensor)
+                     else ([text_pair] if isinstance(text_pair, str)
+                           else list(text_pair)))
+        rows, segs = [], []
+        for i, tx in enumerate(texts):
+            ids = [self.cls_id] + self._encode_one(tx) + [self.sep_id]
+            seg = [0] * len(ids)
+            if pairs is not None:
+                p = self._encode_one(pairs[i]) + [self.sep_id]
+                ids += p
+                seg += [1] * len(p)
+            if max_seq_len and len(ids) > max_seq_len:
+                ids = ids[:max_seq_len - 1] + [self.sep_id]
+                seg = seg[:max_seq_len]
+            rows.append(ids)
+            segs.append(seg)
+        width = max(len(r) for r in rows)
+        if pad_to_max_seq_len and max_seq_len:
+            width = max_seq_len
+        out = np.full((len(rows), width), self.pad_id, np.int32)
+        seg_out = np.zeros((len(rows), width), np.int32)
+        for i, (r, s) in enumerate(zip(rows, segs)):
+            out[i, :len(r)] = r
+            seg_out[i, :len(s)] = s
+        return (Tensor(jnp.asarray(out)), Tensor(jnp.asarray(seg_out)))

@@ -188,3 +188,750 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
         return jnp.transpose(pooled, (0, 3, 1, 2))
 
     return apply("roi_align", f, [x, boxes, boxes_num])
+
+
+# ---------------------------------------------------------------------------
+# Detection op family (reference: python/paddle/vision/ops.py over
+# phi/kernels roi_pool/psroi_pool/deform_conv/yolo_box/... kernels).
+# Dense sampling math runs as jnp taped ops; proposal-style ops with
+# data-dependent output counts (generate_proposals, matrix_nms) run on the
+# host like the reference's CPU kernels — their outputs are ragged by
+# nature and feed host-side dataloaders, not jitted training steps.
+# ---------------------------------------------------------------------------
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    """Reference: vision/ops.py roi_pool (quantized max pooling per bin).
+    x [N, C, H, W]; boxes [R, 4]; returns [R, C, oh, ow]."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+
+    def f(feat, rois, rois_num):
+        N, C, H, W = feat.shape
+        R = rois.shape[0]
+        img_idx = jnp.repeat(jnp.arange(N), rois_num, axis=0,
+                             total_repeat_length=R)
+        scaled = jnp.round(rois * spatial_scale)
+        x1, y1 = scaled[:, 0], scaled[:, 1]
+        x2, y2 = jnp.maximum(scaled[:, 2], x1 + 1), \
+            jnp.maximum(scaled[:, 3], y1 + 1)
+        rw, rh = x2 - x1, y2 - y1
+        neg = jnp.asarray(-jnp.inf, feat.dtype)
+        ys = jnp.arange(H, dtype=feat.dtype)
+        xs = jnp.arange(W, dtype=feat.dtype)
+        roi_feat = feat[img_idx]                   # [R, C, H, W] — hoisted
+        outs = []
+        for by in range(oh):
+            for bx in range(ow):
+                ys0 = y1 + jnp.floor(rh * by / oh)
+                ys1 = y1 + jnp.ceil(rh * (by + 1) / oh)
+                xs0 = x1 + jnp.floor(rw * bx / ow)
+                xs1 = x1 + jnp.ceil(rw * (bx + 1) / ow)
+                inside = ((ys[None, :] >= ys0[:, None])
+                          & (ys[None, :] < ys1[:, None]))[:, None, :, None] \
+                    & ((xs[None, :] >= xs0[:, None])
+                       & (xs[None, :] < xs1[:, None]))[:, None, None, :]
+                masked = jnp.where(inside, roi_feat, neg)
+                outs.append(masked.max(axis=(2, 3)))
+        out = jnp.stack(outs, axis=-1).reshape(R, C, oh, ow)
+        return jnp.where(jnp.isfinite(out), out, 0.0)
+
+    return apply("roi_pool", f, [x, boxes, boxes_num])
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """Reference: vision/ops.py psroi_pool — position-sensitive average
+    pooling: input channels C = out_c*oh*ow, bin (i,j) reads channel slice
+    [(i*ow+j)*out_c : ...]. Returns [R, out_c, oh, ow]."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+
+    def f(feat, rois, rois_num):
+        N, C, H, W = feat.shape
+        assert C % (oh * ow) == 0, (
+            f"psroi_pool needs channels ({C}) divisible by "
+            f"output_size bins ({oh}x{ow})")
+        out_c = C // (oh * ow)
+        R = rois.shape[0]
+        img_idx = jnp.repeat(jnp.arange(N), rois_num, axis=0,
+                             total_repeat_length=R)
+        scaled = rois * spatial_scale
+        x1, y1, x2, y2 = (scaled[:, i] for i in range(4))
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        ys = jnp.arange(H, dtype=feat.dtype)
+        xs = jnp.arange(W, dtype=feat.dtype)
+        bins = []
+        for by in range(oh):
+            for bx in range(ow):
+                ys0 = y1 + rh * by / oh
+                ys1 = y1 + rh * (by + 1) / oh
+                xs0 = x1 + rw * bx / ow
+                xs1 = x1 + rw * (bx + 1) / ow
+                inside = ((ys[None, :] >= jnp.floor(ys0)[:, None])
+                          & (ys[None, :] < jnp.ceil(ys1)[:, None])
+                          )[:, None, :, None] \
+                    & ((xs[None, :] >= jnp.floor(xs0)[:, None])
+                       & (xs[None, :] < jnp.ceil(xs1)[:, None])
+                       )[:, None, None, :]
+                sl = feat[img_idx,
+                          (by * ow + bx) * out_c:(by * ow + bx + 1) * out_c]
+                total = jnp.where(inside, sl, 0.0).sum(axis=(2, 3))
+                cnt = jnp.maximum(
+                    inside.astype(feat.dtype).sum(axis=(2, 3)), 1.0)
+                bins.append(total / cnt)
+        return jnp.stack(bins, axis=-1).reshape(R, out_c, oh, ow)
+
+    return apply("psroi_pool", f, [x, boxes, boxes_num])
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Reference: vision/ops.py deform_conv2d (DCNv1; DCNv2 when mask is
+    given). x [N,Cin,H,W]; offset [N, 2*dg*kh*kw, Ho, Wo] (y,x order per
+    kernel point); weight [Cout, Cin/groups, kh, kw].
+
+    TPU-native: bilinear sampling at offset positions is a vectorized
+    gather; the conv collapses to one einsum over sampled patches (MXU)."""
+    s = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    p = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    d = (dilation, dilation) if isinstance(dilation, int) else tuple(dilation)
+    has_mask = mask is not None
+    has_bias = bias is not None
+
+    def f(xa, off, w, *rest):
+        rest = list(rest)
+        m = rest.pop(0) if has_mask else None
+        b = rest.pop(0) if has_bias else None
+        N, Cin, H, W = xa.shape
+        Cout, Cg, kh, kw = w.shape
+        Ho = (H + 2 * p[0] - d[0] * (kh - 1) - 1) // s[0] + 1
+        Wo = (W + 2 * p[1] - d[1] * (kw - 1) - 1) // s[1] + 1
+        K = kh * kw
+        off = off.reshape(N, deformable_groups, K, 2, Ho, Wo)
+        # base sampling grid
+        oy = jnp.arange(Ho) * s[0] - p[0]
+        ox = jnp.arange(Wo) * s[1] - p[1]
+        ky = jnp.arange(kh) * d[0]
+        kx = jnp.arange(kw) * d[1]
+        base_y = oy[None, :, None] + ky.reshape(kh, 1, 1)   # [kh,Ho,1]
+        base_x = ox[None, None, :] + kx.reshape(kw, 1, 1)   # [kw,1,Wo] -> fix
+        base_y = base_y.reshape(kh, 1, Ho, 1)
+        base_x = base_x.reshape(1, kw, 1, Wo)
+        base_y = jnp.broadcast_to(base_y, (kh, kw, Ho, Wo)).reshape(K, Ho, Wo)
+        base_x = jnp.broadcast_to(base_x, (kh, kw, Ho, Wo)).reshape(K, Ho, Wo)
+        sy = base_y[None, None] + off[:, :, :, 0]   # [N,dg,K,Ho,Wo]
+        sx = base_x[None, None] + off[:, :, :, 1]
+
+        def bilinear(img, yy, xx):
+            # img [Cin,H,W]; yy/xx [dg,K,Ho,Wo] -> samples [Cin,dg,K,Ho,Wo]
+            y0 = jnp.floor(yy)
+            x0 = jnp.floor(xx)
+            wy = yy - y0
+            wx = xx - x0
+            out = 0.0
+            for dy2, wy2 in ((0, 1 - wy), (1, wy)):
+                for dx2, wx2 in ((0, 1 - wx), (1, wx)):
+                    yi = y0 + dy2
+                    xi = x0 + dx2
+                    valid = ((yi >= 0) & (yi < H) & (xi >= 0) & (xi < W))
+                    yc = jnp.clip(yi, 0, H - 1).astype(jnp.int32)
+                    xc = jnp.clip(xi, 0, W - 1).astype(jnp.int32)
+                    v = img[:, yc, xc]              # [Cin,dg,K,Ho,Wo]
+                    out = out + jnp.where(valid, (wy2 * wx2), 0.0) * v
+            return out
+
+        samples = jax.vmap(bilinear)(xa, sy, sx)    # [N,Cin,dg,K,Ho,Wo]
+        if m is not None:
+            mm = m.reshape(N, deformable_groups, K, Ho, Wo)
+            samples = samples * mm[:, None]
+        # fold deformable groups back into channels: each input channel
+        # belongs to dg group c // (Cin/dg)
+        cg = Cin // deformable_groups
+        samples = samples.reshape(N, deformable_groups, cg,
+                                  deformable_groups, K, Ho, Wo)
+        samples = jnp.stack([samples[:, g, :, g] for g in
+                             range(deformable_groups)], axis=1)
+        samples = samples.reshape(N, Cin, K, Ho, Wo)
+        wk = w.reshape(groups, Cout // groups, Cg, K)
+        sg = samples.reshape(N, groups, Cg, K, Ho, Wo)
+        out = jnp.einsum("ngckyx,gock->ngoyx", sg, wk)  # MXU GEMM
+        out = out.reshape(N, Cout, Ho, Wo)
+        if b is not None:
+            out = out + b.reshape(1, Cout, 1, 1)
+        return out
+
+    ins = [x, offset, weight]
+    if has_mask:
+        ins.append(mask)
+    if has_bias:
+        ins.append(bias)
+    return apply("deform_conv2d", f, ins)
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True, axis=0,
+              name=None):
+    """Reference: vision/ops.py box_coder (phi box_coder_kernel): encode
+    boxes against priors or decode offsets back to boxes."""
+    def f(prior, tbox, *rest):
+        var = rest[0] if rest else None
+        norm = 0.0 if box_normalized else 1.0
+        pw = prior[:, 2] - prior[:, 0] + norm
+        ph = prior[:, 3] - prior[:, 1] + norm
+        pcx = prior[:, 0] + pw * 0.5
+        pcy = prior[:, 1] + ph * 0.5
+        if code_type == "encode_center_size":
+            tw = tbox[:, None, 2] - tbox[:, None, 0] + norm
+            th = tbox[:, None, 3] - tbox[:, None, 1] + norm
+            tcx = tbox[:, None, 0] + tw * 0.5
+            tcy = tbox[:, None, 1] + th * 0.5
+            out = jnp.stack([(tcx - pcx[None]) / pw[None],
+                             (tcy - pcy[None]) / ph[None],
+                             jnp.log(tw / pw[None]),
+                             jnp.log(th / ph[None])], axis=-1)
+            if var is not None:
+                out = out / (var[None] if var.ndim == 2 else var)
+            return out
+        # decode_center_size: tbox [N, M, 4]
+        dev = tbox
+        if var is not None:
+            dev = dev * (var[None] if var.ndim == 2 else var)
+        if axis == 0:
+            pw_, ph_, pcx_, pcy_ = (v[None, :] for v in (pw, ph, pcx, pcy))
+        else:
+            pw_, ph_, pcx_, pcy_ = (v[:, None] for v in (pw, ph, pcx, pcy))
+        ocx = dev[..., 0] * pw_ + pcx_
+        ocy = dev[..., 1] * ph_ + pcy_
+        ow_ = jnp.exp(dev[..., 2]) * pw_
+        oh_ = jnp.exp(dev[..., 3]) * ph_
+        return jnp.stack([ocx - ow_ * 0.5, ocy - oh_ * 0.5,
+                          ocx + ow_ * 0.5 - norm,
+                          ocy + oh_ * 0.5 - norm], axis=-1)
+
+    ins = [prior_box, target_box]
+    if prior_box_var is not None and not isinstance(prior_box_var, list):
+        ins.append(prior_box_var)
+    elif isinstance(prior_box_var, list):
+        ins.append(Tensor(jnp.asarray(prior_box_var, jnp.float32)))
+    return apply("box_coder", f, ins)
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5,
+              min_max_aspect_ratios_order=False, name=None):
+    """Reference: vision/ops.py prior_box (SSD prior generation).
+    Returns (boxes [H, W, P, 4], variances [H, W, P, 4])."""
+    import numpy as onp
+    feat_h, feat_w = int(input.shape[2]), int(input.shape[3])
+    img_h, img_w = int(image.shape[2]), int(image.shape[3])
+    step_h = steps[1] or img_h / feat_h
+    step_w = steps[0] or img_w / feat_w
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if not any(abs(ar - e) < 1e-6 for e in ars):
+            ars.append(float(ar))
+            if flip:
+                ars.append(1.0 / float(ar))
+    boxes = []
+    for ms_i, ms in enumerate(min_sizes):
+        if min_max_aspect_ratios_order:
+            boxes.append((ms, ms))
+            if max_sizes:
+                mx = max_sizes[ms_i]
+                boxes.append((onp.sqrt(ms * mx), onp.sqrt(ms * mx)))
+            for ar in ars:
+                if abs(ar - 1.0) < 1e-6:
+                    continue
+                boxes.append((ms * onp.sqrt(ar), ms / onp.sqrt(ar)))
+        else:
+            for ar in ars:
+                boxes.append((ms * onp.sqrt(ar), ms / onp.sqrt(ar)))
+            if max_sizes:
+                mx = max_sizes[ms_i]
+                boxes.append((onp.sqrt(ms * mx), onp.sqrt(ms * mx)))
+    P = len(boxes)
+    wh = onp.asarray(boxes, onp.float32)            # [P, 2] (w, h)
+    cy = (onp.arange(feat_h) + offset) * step_h
+    cx = (onp.arange(feat_w) + offset) * step_w
+    cxg, cyg = onp.meshgrid(cx, cy)                 # [H, W]
+    out = onp.zeros((feat_h, feat_w, P, 4), onp.float32)
+    out[..., 0] = (cxg[:, :, None] - wh[None, None, :, 0] / 2) / img_w
+    out[..., 1] = (cyg[:, :, None] - wh[None, None, :, 1] / 2) / img_h
+    out[..., 2] = (cxg[:, :, None] + wh[None, None, :, 0] / 2) / img_w
+    out[..., 3] = (cyg[:, :, None] + wh[None, None, :, 1] / 2) / img_h
+    if clip:
+        out = out.clip(0.0, 1.0)
+    var = onp.broadcast_to(onp.asarray(variance, onp.float32),
+                           out.shape).copy()
+    return Tensor(jnp.asarray(out)), Tensor(jnp.asarray(var))
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, clip_bbox=True, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5, name=None):
+    """Reference: vision/ops.py yolo_box (phi yolo_box_kernel): decode
+    YOLOv3 head [N, A*(5+cls), H, W] into boxes [N, A*H*W, 4] and scores
+    [N, A*H*W, cls]; confidences below conf_thresh zero the scores."""
+    A = len(anchors) // 2
+
+    def f(xa, imgs):
+        N, C, H, W = xa.shape
+        an = jnp.asarray(anchors, jnp.float32).reshape(A, 2)
+        if iou_aware:
+            ious = jax.nn.sigmoid(xa[:, :A].reshape(N, A, 1, H, W))
+            xa = xa[:, A:]
+        feats = xa.reshape(N, A, 5 + class_num, H, W)
+        gx = (jnp.arange(W, dtype=jnp.float32))[None, None, None, :]
+        gy = (jnp.arange(H, dtype=jnp.float32))[None, None, :, None]
+        bx = (jax.nn.sigmoid(feats[:, :, 0]) * scale_x_y
+              - (scale_x_y - 1) / 2 + gx) / W
+        by = (jax.nn.sigmoid(feats[:, :, 1]) * scale_x_y
+              - (scale_x_y - 1) / 2 + gy) / H
+        in_w = W * downsample_ratio
+        in_h = H * downsample_ratio
+        bw = jnp.exp(feats[:, :, 2]) * an[None, :, 0, None, None] / in_w
+        bh = jnp.exp(feats[:, :, 3]) * an[None, :, 1, None, None] / in_h
+        conf = jax.nn.sigmoid(feats[:, :, 4])
+        if iou_aware:
+            conf = conf ** (1 - iou_aware_factor) * \
+                ious[:, :, 0] ** iou_aware_factor
+        cls = jax.nn.sigmoid(feats[:, :, 5:]) * conf[:, :, None]
+        imgh = imgs[:, 0].reshape(N, 1, 1, 1).astype(jnp.float32)
+        imgw = imgs[:, 1].reshape(N, 1, 1, 1).astype(jnp.float32)
+        x1 = (bx - bw / 2) * imgw
+        y1 = (by - bh / 2) * imgh
+        x2 = (bx + bw / 2) * imgw
+        y2 = (by + bh / 2) * imgh
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0)
+            y1 = jnp.clip(y1, 0)
+            x2 = jnp.minimum(x2, imgw - 1)
+            y2 = jnp.minimum(y2, imgh - 1)
+        keep = (conf > conf_thresh)[:, :, None]        # [N, A, 1, H, W]
+        boxes = jnp.stack([x1, y1, x2, y2], axis=2)    # [N, A, 4, H, W]
+        boxes = jnp.where(keep, boxes, 0.0)
+        scores = jnp.where(keep, cls, 0.0)
+        boxes = boxes.transpose(0, 1, 3, 4, 2).reshape(N, A * H * W, 4)
+        scores = scores.transpose(0, 1, 3, 4, 2).reshape(
+            N, A * H * W, class_num)
+        return boxes, scores
+
+    return apply("yolo_box", f, [x, img_size], nout=2)
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, scale_x_y=1.0, name=None):
+    """Reference: vision/ops.py yolo_loss (phi yolo_loss_kernel). YOLOv3
+    training loss: BCE on xy, L1 on wh, BCE on objectness (with
+    ignore_thresh masking of well-matched predictions) and class BCE.
+    Simplification vs the CUDA kernel: objectness targets use the best
+    anchor per gt (same assignment rule), gt_score defaults to 1."""
+    A = len(anchor_mask)
+
+    def f(xa, gbox, glabel, *rest):
+        N, C, H, W = xa.shape
+        feats = xa.reshape(N, A, 5 + class_num, H, W)
+        an_all = jnp.asarray(anchors, jnp.float32).reshape(-1, 2)
+        an = an_all[jnp.asarray(anchor_mask)]
+        in_w = W * downsample_ratio
+        in_h = H * downsample_ratio
+        B = gbox.shape[1]
+        # gt in [0,1] center-size
+        gx, gy, gw, gh = (gbox[..., i] for i in range(4))
+        valid = (gw > 0) & (gh > 0)
+        gi = jnp.clip((gx * W).astype(jnp.int32), 0, W - 1)
+        gj = jnp.clip((gy * H).astype(jnp.int32), 0, H - 1)
+        # best anchor per gt by wh IoU against ALL anchors (reference rule)
+        gwp = gw * in_w
+        ghp = gh * in_h
+        inter = jnp.minimum(gwp[..., None], an_all[None, None, :, 0]) * \
+            jnp.minimum(ghp[..., None], an_all[None, None, :, 1])
+        union = gwp[..., None] * ghp[..., None] + \
+            an_all[None, None, :, 0] * an_all[None, None, :, 1] - inter
+        best = jnp.argmax(inter / jnp.maximum(union, 1e-9), axis=-1)
+        mask_pos = jnp.zeros((N, A, H, W))
+        tx = jnp.zeros((N, A, H, W))
+        ty = jnp.zeros((N, A, H, W))
+        tw = jnp.zeros((N, A, H, W))
+        th = jnp.zeros((N, A, H, W))
+        tcls = jnp.zeros((N, A, H, W, class_num))
+        tscale = jnp.zeros((N, A, H, W))
+        bidx = jnp.arange(N)[:, None].repeat(B, 1)
+        # map best anchor to local index in anchor_mask (-1 if absent)
+        local = jnp.full((an_all.shape[0],), -1, jnp.int32)
+        local = local.at[jnp.asarray(anchor_mask, jnp.int32)].set(
+            jnp.arange(A, dtype=jnp.int32))
+        la = local[best]
+        ok = valid & (la >= 0)
+        la_c = jnp.clip(la, 0, A - 1)
+        scale = 2.0 - gw * gh
+        mask_pos = mask_pos.at[bidx, la_c, gj, gi].max(
+            jnp.where(ok, 1.0, 0.0))
+        tx = tx.at[bidx, la_c, gj, gi].set(
+            jnp.where(ok, gx * W - gi, 0.0))
+        ty = ty.at[bidx, la_c, gj, gi].set(jnp.where(ok, gy * H - gj, 0.0))
+        sel_an = an_all[jnp.clip(best, 0, an_all.shape[0] - 1)]
+        tw = tw.at[bidx, la_c, gj, gi].set(
+            jnp.where(ok, jnp.log(jnp.maximum(gwp / sel_an[..., 0], 1e-9)),
+                      0.0))
+        th = th.at[bidx, la_c, gj, gi].set(
+            jnp.where(ok, jnp.log(jnp.maximum(ghp / sel_an[..., 1], 1e-9)),
+                      0.0))
+        tscale = tscale.at[bidx, la_c, gj, gi].set(
+            jnp.where(ok, scale, 0.0))
+        onehot = jax.nn.one_hot(glabel, class_num)
+        if use_label_smooth:
+            delta = 1.0 / max(class_num, 1)
+            onehot = onehot * (1 - delta) + delta / class_num
+        tcls = tcls.at[bidx, la_c, gj, gi].set(
+            onehot * jnp.where(ok, 1.0, 0.0)[..., None])
+        # predictions
+        px = jax.nn.sigmoid(feats[:, :, 0])
+        py = jax.nn.sigmoid(feats[:, :, 1])
+        pw = feats[:, :, 2]
+        ph = feats[:, :, 3]
+        pobj = feats[:, :, 4]
+        pcls = feats[:, :, 5:].transpose(0, 1, 3, 4, 2)
+
+        def bce(logit, target):
+            return jnp.maximum(logit, 0) - logit * target + \
+                jnp.log1p(jnp.exp(-jnp.abs(logit)))
+
+        def bce_p(prob, target):
+            p = jnp.clip(prob, 1e-7, 1 - 1e-7)
+            return -(target * jnp.log(p) + (1 - target) * jnp.log(1 - p))
+
+        loss_xy = (tscale * (bce_p(px, tx) + bce_p(py, ty)) * mask_pos
+                   ).sum(axis=(1, 2, 3))
+        loss_wh = (tscale * (jnp.abs(pw - tw) + jnp.abs(ph - th)) * mask_pos
+                   ).sum(axis=(1, 2, 3))
+        # ignore mask: predicted boxes overlapping any gt above thresh
+        bx = (px + jnp.arange(W)[None, None, None, :]) / W
+        by = (py + jnp.arange(H)[None, None, :, None]) / H
+        bw = jnp.exp(pw) * an[None, :, 0, None, None] / in_w
+        bh = jnp.exp(ph) * an[None, :, 1, None, None] / in_h
+        px1, py1 = bx - bw / 2, by - bh / 2
+        px2, py2 = bx + bw / 2, by + bh / 2
+        gx1, gy1 = gx - gw / 2, gy - gh / 2
+        gx2, gy2 = gx + gw / 2, gy + gh / 2
+        ix1 = jnp.maximum(px1[:, :, :, :, None], gx1[:, None, None, None, :])
+        iy1 = jnp.maximum(py1[:, :, :, :, None], gy1[:, None, None, None, :])
+        ix2 = jnp.minimum(px2[:, :, :, :, None], gx2[:, None, None, None, :])
+        iy2 = jnp.minimum(py2[:, :, :, :, None], gy2[:, None, None, None, :])
+        iw = jnp.clip(ix2 - ix1, 0)
+        ih = jnp.clip(iy2 - iy1, 0)
+        inter2 = iw * ih
+        areap = bw * bh
+        areag = (gw * gh)[:, None, None, None, :]
+        iou = inter2 / jnp.maximum(areap[..., None] + areag - inter2, 1e-9)
+        iou = jnp.where(valid[:, None, None, None, :], iou, 0.0)
+        best_iou = iou.max(axis=-1)
+        noobj_mask = ((best_iou < ignore_thresh) & (mask_pos < 0.5)
+                      ).astype(jnp.float32)
+        loss_obj = (bce(pobj, mask_pos) * (mask_pos + noobj_mask)
+                    ).sum(axis=(1, 2, 3))
+        loss_cls = (bce(pcls, tcls) * mask_pos[..., None]
+                    ).sum(axis=(1, 2, 3, 4))
+        return loss_xy + loss_wh + loss_obj + loss_cls
+
+    ins = [x, gt_box, gt_label]
+    if gt_score is not None:
+        ins.append(gt_score)
+    return apply("yolo_loss", f, ins)
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
+               nms_top_k=400, keep_top_k=200, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=0, normalized=True,
+               return_index=False, return_rois_num=True, name=None):
+    """Reference: vision/ops.py matrix_nms (SOLOv2 decay NMS) — host op
+    (ragged output), single image or batch [N, M, 4] + [N, C, M]."""
+    import numpy as onp
+    b = onp.asarray(bboxes._data if isinstance(bboxes, Tensor) else bboxes)
+    s = onp.asarray(scores._data if isinstance(scores, Tensor) else scores)
+    outs, idxs, nums = [], [], []
+    for n in range(b.shape[0]):
+        dets = []
+        det_idx = []
+        for c in range(s.shape[1]):
+            if c == background_label:
+                continue
+            sc = s[n, c]
+            keep = onp.where(sc > score_threshold)[0]
+            if keep.size == 0:
+                continue
+            order = keep[onp.argsort(-sc[keep])][:nms_top_k]
+            boxes_c = b[n, order]
+            sc_c = sc[order]
+            # iou matrix (upper triangle)
+            x1 = onp.maximum(boxes_c[:, None, 0], boxes_c[None, :, 0])
+            y1 = onp.maximum(boxes_c[:, None, 1], boxes_c[None, :, 1])
+            x2 = onp.minimum(boxes_c[:, None, 2], boxes_c[None, :, 2])
+            y2 = onp.minimum(boxes_c[:, None, 3], boxes_c[None, :, 3])
+            norm = 0.0 if normalized else 1.0
+            iw = onp.clip(x2 - x1 + norm, 0, None)
+            ih = onp.clip(y2 - y1 + norm, 0, None)
+            inter = iw * ih
+            area = (boxes_c[:, 2] - boxes_c[:, 0] + norm) * \
+                (boxes_c[:, 3] - boxes_c[:, 1] + norm)
+            iou = inter / onp.maximum(area[:, None] + area[None] - inter,
+                                      1e-9)
+            iou = onp.triu(iou, 1)
+            max_iou = iou.max(axis=0)  # per column: worst overlap above
+            if use_gaussian:
+                decay = onp.exp(-(iou ** 2 - max_iou[None] ** 2)
+                                / gaussian_sigma).min(axis=0)
+            else:
+                decay = ((1 - iou) / onp.maximum(1 - max_iou[None], 1e-9)
+                         ).min(axis=0)
+            dec_sc = sc_c * decay
+            ok = dec_sc >= post_threshold
+            for i in onp.where(ok)[0]:
+                dets.append([c, dec_sc[i], *boxes_c[i]])
+                det_idx.append(n * b.shape[1] + order[i])
+        dets = onp.asarray(dets, onp.float32).reshape(-1, 6)
+        det_idx = onp.asarray(det_idx, onp.int64)
+        if dets.shape[0] > keep_top_k:
+            top = onp.argsort(-dets[:, 1])[:keep_top_k]
+            dets, det_idx = dets[top], det_idx[top]
+        outs.append(dets)
+        idxs.append(det_idx)
+        nums.append(dets.shape[0])
+    out = Tensor(jnp.asarray(onp.concatenate(outs, 0)))
+    ret = [out]
+    if return_index:
+        ret.append(Tensor(jnp.asarray(onp.concatenate(idxs, 0))))
+    if return_rois_num:
+        ret.append(Tensor(jnp.asarray(onp.asarray(nums, onp.int32))))
+    return tuple(ret) if len(ret) > 1 else out
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=True, name=None):
+    """Reference: vision/ops.py generate_proposals (RPN) — host op:
+    decode anchors with deltas, clip, filter small, NMS per image."""
+    import numpy as onp
+    sc = onp.asarray(scores._data if isinstance(scores, Tensor) else scores)
+    dl = onp.asarray(bbox_deltas._data
+                     if isinstance(bbox_deltas, Tensor) else bbox_deltas)
+    im = onp.asarray(img_size._data
+                     if isinstance(img_size, Tensor) else img_size)
+    an = onp.asarray(anchors._data
+                     if isinstance(anchors, Tensor) else anchors
+                     ).reshape(-1, 4)
+    va = onp.asarray(variances._data
+                     if isinstance(variances, Tensor) else variances
+                     ).reshape(-1, 4)
+    N, A, H, W = sc.shape
+    offset = 1.0 if pixel_offset else 0.0
+    rois_all, scores_all, nums = [], [], []
+    for n in range(N):
+        s_n = sc[n].transpose(1, 2, 0).reshape(-1)
+        d_n = dl[n].reshape(A, 4, H, W).transpose(2, 3, 0, 1).reshape(-1, 4)
+        order = onp.argsort(-s_n)[:pre_nms_top_n]
+        s_o, d_o, a_o, v_o = s_n[order], d_n[order], an[order], va[order]
+        aw = a_o[:, 2] - a_o[:, 0] + offset
+        ah = a_o[:, 3] - a_o[:, 1] + offset
+        acx = a_o[:, 0] + aw / 2
+        acy = a_o[:, 1] + ah / 2
+        cx = v_o[:, 0] * d_o[:, 0] * aw + acx
+        cy = v_o[:, 1] * d_o[:, 1] * ah + acy
+        w = onp.exp(onp.minimum(v_o[:, 2] * d_o[:, 2], 10.0)) * aw
+        h = onp.exp(onp.minimum(v_o[:, 3] * d_o[:, 3], 10.0)) * ah
+        props = onp.stack([cx - w / 2, cy - h / 2,
+                           cx + w / 2 - offset, cy + h / 2 - offset], 1)
+        ih, iw_ = im[n, 0], im[n, 1]
+        props[:, 0] = props[:, 0].clip(0, iw_ - offset)
+        props[:, 1] = props[:, 1].clip(0, ih - offset)
+        props[:, 2] = props[:, 2].clip(0, iw_ - offset)
+        props[:, 3] = props[:, 3].clip(0, ih - offset)
+        ws = props[:, 2] - props[:, 0] + offset
+        hs = props[:, 3] - props[:, 1] + offset
+        keep = onp.where((ws >= min_size) & (hs >= min_size))[0]
+        props, s_k = props[keep], s_o[keep]
+        # greedy nms
+        order2 = onp.argsort(-s_k)
+        selected = []
+        while order2.size and len(selected) < post_nms_top_n:
+            i = order2[0]
+            selected.append(i)
+            if order2.size == 1:
+                break
+            rest = order2[1:]
+            xx1 = onp.maximum(props[i, 0], props[rest, 0])
+            yy1 = onp.maximum(props[i, 1], props[rest, 1])
+            xx2 = onp.minimum(props[i, 2], props[rest, 2])
+            yy2 = onp.minimum(props[i, 3], props[rest, 3])
+            iw2 = onp.clip(xx2 - xx1 + offset, 0, None)
+            ih2 = onp.clip(yy2 - yy1 + offset, 0, None)
+            inter = iw2 * ih2
+            a_i = (props[i, 2] - props[i, 0] + offset) * \
+                (props[i, 3] - props[i, 1] + offset)
+            a_r = (props[rest, 2] - props[rest, 0] + offset) * \
+                (props[rest, 3] - props[rest, 1] + offset)
+            iou = inter / onp.maximum(a_i + a_r - inter, 1e-9)
+            order2 = rest[iou <= nms_thresh]
+        rois_all.append(props[selected])
+        scores_all.append(s_k[selected])
+        nums.append(len(selected))
+    rois = Tensor(jnp.asarray(onp.concatenate(rois_all, 0)
+                              .astype(onp.float32)))
+    rscores = Tensor(jnp.asarray(onp.concatenate(scores_all, 0)
+                                 .astype(onp.float32)))
+    if return_rois_num:
+        return rois, rscores, Tensor(jnp.asarray(onp.asarray(nums,
+                                                             onp.int32)))
+    return rois, rscores
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False, rois_num=None,
+                             name=None):
+    """Reference: vision/ops.py distribute_fpn_proposals: route each RoI to
+    its FPN level by sqrt(area) relative to refer_scale."""
+    import numpy as onp
+    rois = onp.asarray(fpn_rois._data
+                       if isinstance(fpn_rois, Tensor) else fpn_rois)
+    offset = 1.0 if pixel_offset else 0.0
+    w = rois[:, 2] - rois[:, 0] + offset
+    h = rois[:, 3] - rois[:, 1] + offset
+    scale = onp.sqrt(onp.clip(w * h, 0, None))
+    lvl = onp.floor(onp.log2(scale / refer_scale + 1e-8)) + refer_level
+    lvl = onp.clip(lvl, min_level, max_level).astype(onp.int64)
+    # per-image ownership of each roi (reference returns per-level
+    # rois_num of shape [N] so callers can split every level per image)
+    if rois_num is not None:
+        per_img = onp.asarray(rois_num._data if isinstance(
+            rois_num, Tensor) else rois_num, onp.int64)
+        img_of = onp.repeat(onp.arange(per_img.size), per_img)
+    else:
+        per_img = None
+        img_of = onp.zeros(rois.shape[0], onp.int64)
+    multi_rois = []
+    restore = onp.zeros(rois.shape[0], onp.int64)
+    rois_num_per = []
+    order = []
+    for l in range(min_level, max_level + 1):
+        idx = onp.where(lvl == l)[0]
+        # within a level, keep image-major order (reference layout)
+        idx = idx[onp.argsort(img_of[idx], kind="stable")]
+        multi_rois.append(Tensor(jnp.asarray(
+            rois[idx].astype(onp.float32).reshape(-1, 4))))
+        if per_img is not None:
+            counts = onp.bincount(img_of[idx],
+                                  minlength=per_img.size)
+            rois_num_per.append(Tensor(jnp.asarray(
+                counts.astype(onp.int32))))
+        else:
+            rois_num_per.append(Tensor(jnp.asarray(
+                onp.asarray([idx.size], onp.int32))))
+        order.extend(idx.tolist())
+    restore[onp.asarray(order, onp.int64)] = onp.arange(len(order))
+    restore_t = Tensor(jnp.asarray(restore.reshape(-1, 1)))
+    if rois_num is not None:
+        return multi_rois, restore_t, rois_num_per
+    return multi_rois, restore_t
+
+
+def read_file(path, name=None):
+    """Reference: vision/ops.py read_file — file bytes as a uint8 tensor."""
+    with open(path, "rb") as f:
+        data = f.read()
+    import numpy as onp
+    return Tensor(jnp.asarray(onp.frombuffer(data, onp.uint8)))
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """Reference: vision/ops.py decode_jpeg (host decode; the reference
+    uses nvjpeg on GPU, libjpeg on CPU). Returns [C, H, W] uint8."""
+    import io
+
+    import numpy as onp
+    from PIL import Image
+    data = onp.asarray(x._data if isinstance(x, Tensor) else x,
+                       onp.uint8).tobytes()
+    img = Image.open(io.BytesIO(data))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode == "rgb":
+        img = img.convert("RGB")
+    arr = onp.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(jnp.asarray(arr))
+
+
+class RoIAlign(object):
+    """Reference: vision/ops.py RoIAlign layer."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        self._args = (output_size, spatial_scale)
+
+    def __call__(self, x, boxes, boxes_num):
+        return roi_align(x, boxes, boxes_num, self._args[0],
+                         spatial_scale=self._args[1])
+
+
+class RoIPool(object):
+    def __init__(self, output_size, spatial_scale=1.0):
+        self._args = (output_size, spatial_scale)
+
+    def __call__(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self._args[0],
+                        spatial_scale=self._args[1])
+
+
+class PSRoIPool(object):
+    def __init__(self, output_size, spatial_scale=1.0):
+        self._args = (output_size, spatial_scale)
+
+    def __call__(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self._args[0],
+                          spatial_scale=self._args[1])
+
+
+class DeformConv2D(object):
+    """Reference: vision/ops.py DeformConv2D layer (DCNv1/v2)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        import paddle_tpu as _paddle
+        ks = (kernel_size, kernel_size) if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        fan = in_channels * ks[0] * ks[1]
+        bound = 1.0 / np.sqrt(fan)
+        rng = np.random.RandomState(0)
+        self.weight = _paddle.to_tensor(rng.uniform(
+            -bound, bound, (out_channels, in_channels // groups,
+                            ks[0], ks[1])).astype("float32"))
+        self.weight.stop_gradient = False
+        self.bias = None
+        if bias_attr is not False:
+            self.bias = _paddle.to_tensor(
+                np.zeros((out_channels,), "float32"))
+            self.bias.stop_gradient = False
+        self._cfg = (stride, padding, dilation, deformable_groups, groups)
+
+    def __call__(self, x, offset, mask=None):
+        s, p, d, dg, g = self._cfg
+        return deform_conv2d(x, offset, self.weight, self.bias, stride=s,
+                             padding=p, dilation=d, deformable_groups=dg,
+                             groups=g, mask=mask)
+
+
+__all__ += ["roi_pool", "psroi_pool", "deform_conv2d", "box_coder",
+            "prior_box", "yolo_box", "yolo_loss", "matrix_nms",
+            "generate_proposals", "distribute_fpn_proposals", "read_file",
+            "decode_jpeg", "RoIAlign", "RoIPool", "PSRoIPool",
+            "DeformConv2D"]

@@ -7,7 +7,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from ..core.tensor import Tensor
+from ...core.tensor import Tensor
 
 __all__ = ["Compose", "ToTensor", "Normalize", "Resize", "CenterCrop",
            "RandomCrop", "RandomHorizontalFlip", "RandomVerticalFlip",
@@ -193,3 +193,12 @@ class BrightnessTransform:
         if np.asarray(img).dtype == np.uint8:
             return np.clip(out, 0, 255).astype(np.uint8)
         return out
+
+
+# functional submodule (reference: vision/transforms/functional.py); its
+# primitives are also reachable at the transforms level like the reference
+from . import functional  # noqa: E402,F401
+from .functional import (  # noqa: E402,F401
+    adjust_brightness, adjust_contrast, adjust_hue, adjust_saturation,
+    affine, crop, erase, pad, perspective, rotate, to_grayscale, vflip,
+)

@@ -1,0 +1,291 @@
+"""paddle.vision.transforms.functional — image transform primitives.
+
+Reference: python/paddle/vision/transforms/functional.py (+ the
+functional_pil/functional_tensor backends it dispatches to). Host-side
+image ops by design (they run in DataLoader workers, as in the reference);
+inputs may be PIL images, numpy HWC arrays, or CHW Tensors; output type
+follows input type.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...core.tensor import Tensor
+
+__all__ = ["to_tensor", "resize", "pad", "crop", "center_crop", "hflip",
+           "vflip", "adjust_brightness", "adjust_contrast",
+           "adjust_saturation", "adjust_hue", "affine", "rotate",
+           "perspective", "to_grayscale", "normalize", "erase"]
+
+
+def _to_hwc(img):
+    """-> (numpy HWC float or uint8, restore_fn)."""
+    try:
+        from PIL import Image
+        if isinstance(img, Image.Image):
+            arr = np.asarray(img)
+            return arr, lambda a: Image.fromarray(
+                np.clip(a, 0, 255).astype(np.uint8))
+    except ImportError:
+        pass
+    if isinstance(img, Tensor):
+        arr = np.asarray(img._data)
+        if arr.ndim == 3 and arr.shape[0] in (1, 3, 4):  # CHW
+            return arr.transpose(1, 2, 0), \
+                lambda a: Tensor(np.ascontiguousarray(
+                    a.transpose(2, 0, 1)).astype(arr.dtype))
+        return arr, lambda a: Tensor(a.astype(arr.dtype))
+    arr = np.asarray(img)
+    return arr, lambda a: a.astype(arr.dtype) if a.dtype != arr.dtype else a
+
+
+def to_tensor(pic, data_format="CHW"):
+    from . import to_tensor as _tt
+    return _tt(pic, data_format)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    from . import normalize as _n
+    return _n(img, mean, std, data_format)
+
+
+def resize(img, size, interpolation="bilinear"):
+    from . import resize as _r
+    return _r(img, size, interpolation)
+
+
+def center_crop(img, output_size):
+    from . import center_crop as _c
+    return _c(img, output_size)
+
+
+def hflip(img):
+    arr, back = _to_hwc(img)
+    return back(arr[:, ::-1].copy())
+
+
+def vflip(img):
+    arr, back = _to_hwc(img)
+    return back(arr[::-1].copy())
+
+
+def crop(img, top, left, height, width):
+    arr, back = _to_hwc(img)
+    return back(arr[top:top + height, left:left + width].copy())
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    """padding: int | [pad_left, pad_right] | [l, t, r, b]."""
+    arr, back = _to_hwc(img)
+    if isinstance(padding, int):
+        l = t = r = b = padding
+    elif len(padding) == 2:
+        l, t = padding
+        r, b = padding
+    else:
+        l, t, r, b = padding
+    pads = [(t, b), (l, r)] + [(0, 0)] * (arr.ndim - 2)
+    if padding_mode == "constant":
+        out = np.pad(arr, pads, mode="constant", constant_values=fill)
+    else:
+        mode = {"reflect": "reflect", "edge": "edge",
+                "symmetric": "symmetric"}[padding_mode]
+        out = np.pad(arr, pads, mode=mode)
+    return back(out)
+
+
+def adjust_brightness(img, brightness_factor):
+    arr, back = _to_hwc(img)
+    return back(np.clip(arr.astype(np.float32) * brightness_factor, 0,
+                        255 if arr.dtype == np.uint8 else np.inf))
+
+
+def adjust_contrast(img, contrast_factor):
+    arr, back = _to_hwc(img)
+    f = arr.astype(np.float32)
+    gray_mean = f.mean() if f.ndim == 2 or f.shape[-1] == 1 else \
+        (f[..., :3] @ np.array([0.299, 0.587, 0.114],
+                               np.float32)).mean()
+    out = gray_mean + contrast_factor * (f - gray_mean)
+    return back(np.clip(out, 0, 255 if arr.dtype == np.uint8 else np.inf))
+
+
+def adjust_saturation(img, saturation_factor):
+    arr, back = _to_hwc(img)
+    f = arr.astype(np.float32)
+    gray = f[..., :3] @ np.array([0.299, 0.587, 0.114], np.float32)
+    out = gray[..., None] + saturation_factor * (f - gray[..., None])
+    return back(np.clip(out, 0, 255 if arr.dtype == np.uint8 else np.inf))
+
+
+def adjust_hue(img, hue_factor):
+    """hue_factor in [-0.5, 0.5] — shift the H channel in HSV space."""
+    if not -0.5 <= hue_factor <= 0.5:
+        raise ValueError("hue_factor must be in [-0.5, 0.5]")
+    arr, back = _to_hwc(img)
+    f = arr.astype(np.float32)
+    scale = 255.0 if arr.dtype == np.uint8 else 1.0
+    rgb = f[..., :3] / scale
+    mx = rgb.max(-1)
+    mn = rgb.min(-1)
+    diff = mx - mn
+    r, g, b = rgb[..., 0], rgb[..., 1], rgb[..., 2]
+    h = np.zeros_like(mx)
+    mask = diff > 0
+    rmax = mask & (mx == r)
+    gmax = mask & (mx == g) & ~rmax
+    bmax = mask & ~rmax & ~gmax
+    h[rmax] = ((g - b)[rmax] / diff[rmax]) % 6
+    h[gmax] = (b - r)[gmax] / diff[gmax] + 2
+    h[bmax] = (r - g)[bmax] / diff[bmax] + 4
+    h = (h / 6 + hue_factor) % 1.0
+    # hsv -> rgb
+    v = mx
+    s = np.where(mx > 0, diff / np.where(mx > 0, mx, 1), 0)
+    i = np.floor(h * 6)
+    fr = h * 6 - i
+    p = v * (1 - s)
+    q = v * (1 - fr * s)
+    t = v * (1 - (1 - fr) * s)
+    i = i.astype(np.int32) % 6
+    out = np.stack([
+        np.choose(i, [v, q, p, p, t, v]),
+        np.choose(i, [t, v, v, q, p, p]),
+        np.choose(i, [p, p, t, v, v, q])], axis=-1) * scale
+    if f.shape[-1] > 3:
+        out = np.concatenate([out, f[..., 3:]], axis=-1)
+    return back(np.clip(out, 0, 255 if arr.dtype == np.uint8 else np.inf))
+
+
+def _sample_affine(arr, mat, fill=0, interpolation="nearest"):
+    """Inverse-warp sampling with a 3x3 matrix mapping OUTPUT -> INPUT."""
+    H, W = arr.shape[:2]
+    ys, xs = np.meshgrid(np.arange(H), np.arange(W), indexing="ij")
+    ones = np.ones_like(xs)
+    coords = np.stack([xs, ys, ones], 0).reshape(3, -1).astype(np.float32)
+    src = mat @ coords
+    sx = src[0] / np.where(src[2] == 0, 1, src[2])
+    sy = src[1] / np.where(src[2] == 0, 1, src[2])
+    if interpolation == "bilinear":
+        x0 = np.floor(sx).astype(np.int64)
+        y0 = np.floor(sy).astype(np.int64)
+        wx = sx - x0
+        wy = sy - y0
+        out = np.zeros((H * W,) + arr.shape[2:], np.float32)
+        valid_any = np.zeros(H * W, bool)
+        for dy, wyv in ((0, 1 - wy), (1, wy)):
+            for dx, wxv in ((0, 1 - wx), (1, wx)):
+                xi, yi = x0 + dx, y0 + dy
+                ok = (xi >= 0) & (xi < W) & (yi >= 0) & (yi < H)
+                valid_any |= ok
+                w = (wxv * wyv)[ok]
+                if arr.ndim == 3:
+                    w = w[:, None]
+                out[ok] += w * arr[yi[ok].clip(0, H - 1),
+                                   xi[ok].clip(0, W - 1)].astype(np.float32)
+        out[~valid_any] = fill
+    else:
+        xi = np.round(sx).astype(np.int64)
+        yi = np.round(sy).astype(np.int64)
+        ok = (xi >= 0) & (xi < W) & (yi >= 0) & (yi < H)
+        out = np.full((H * W,) + arr.shape[2:], fill, np.float32)
+        out[ok] = arr[yi[ok], xi[ok]]
+    return out.reshape(arr.shape)
+
+
+def affine(img, angle, translate, scale, shear, interpolation="nearest",
+           fill=0, center=None):
+    """Reference: functional.affine — rotation/translation/scale/shear
+    about the image center (or ``center``)."""
+    arr, back = _to_hwc(img)
+    H, W = arr.shape[:2]
+    cx, cy = center if center is not None else ((W - 1) * 0.5,
+                                                (H - 1) * 0.5)
+    rot = np.deg2rad(angle)
+    sx, sy = [np.deg2rad(s) for s in (shear if isinstance(
+        shear, (list, tuple)) else (shear, 0.0))]
+    # forward matrix (center-relative), reference _get_affine_matrix
+    a = np.cos(rot - sy) / np.cos(sy)
+    b = -np.cos(rot - sy) * np.tan(sx) / np.cos(sy) - np.sin(rot)
+    c = np.sin(rot - sy) / np.cos(sy)
+    d = -np.sin(rot - sy) * np.tan(sx) / np.cos(sy) + np.cos(rot)
+    fwd = np.array([[scale * a, scale * b, 0],
+                    [scale * c, scale * d, 0],
+                    [0, 0, 1]], np.float32)
+    tx, ty = translate
+    pre = np.array([[1, 0, cx + tx], [0, 1, cy + ty], [0, 0, 1]],
+                   np.float32)
+    post = np.array([[1, 0, -cx], [0, 1, -cy], [0, 0, 1]], np.float32)
+    m = pre @ fwd @ post
+    inv = np.linalg.inv(m)
+    return back(_sample_affine(arr, inv, fill, interpolation))
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    arr, back = _to_hwc(img)
+    if expand:
+        H, W = arr.shape[:2]
+        rot = np.deg2rad(angle)
+        nw = int(np.ceil(abs(W * np.cos(rot)) + abs(H * np.sin(rot))))
+        nh = int(np.ceil(abs(W * np.sin(rot)) + abs(H * np.cos(rot))))
+        # rotate on a canvas big enough both ways, then crop to (nh, nw)
+        sh, sw = max(nh, H), max(nw, W)
+        padded = np.zeros((sh, sw) + arr.shape[2:], arr.dtype)
+        oy, ox = (sh - H) // 2, (sw - W) // 2
+        padded[oy:oy + H, ox:ox + W] = arr
+        rotated = affine(padded, angle, (0, 0), 1.0, (0.0, 0.0),
+                         interpolation, fill, None)
+        cy, cx = (sh - nh) // 2, (sw - nw) // 2
+        return back(np.asarray(rotated)[cy:cy + nh, cx:cx + nw])
+    return affine(img, angle, (0, 0), 1.0, (0.0, 0.0), interpolation,
+                  fill, center)
+
+
+def _get_perspective_coeffs(startpoints, endpoints):
+    """Solve the 8-dof homography endpoints -> startpoints (reference
+    functional.py:811)."""
+    a = []
+    b = []
+    for (sx, sy), (ex, ey) in zip(startpoints, endpoints):
+        a.append([ex, ey, 1, 0, 0, 0, -sx * ex, -sx * ey])
+        a.append([0, 0, 0, ex, ey, 1, -sy * ex, -sy * ey])
+        b += [sx, sy]
+    coeffs = np.linalg.solve(np.asarray(a, np.float32),
+                             np.asarray(b, np.float32))
+    return coeffs
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest",
+                fill=0):
+    arr, back = _to_hwc(img)
+    co = _get_perspective_coeffs(startpoints, endpoints)
+    m = np.array([[co[0], co[1], co[2]],
+                  [co[3], co[4], co[5]],
+                  [co[6], co[7], 1.0]], np.float32)
+    return back(_sample_affine(arr, m, fill, interpolation))
+
+
+def to_grayscale(img, num_output_channels=1):
+    arr, back = _to_hwc(img)
+    f = arr.astype(np.float32)
+    gray = f[..., :3] @ np.array([0.299, 0.587, 0.114], np.float32)
+    out = np.repeat(gray[..., None], num_output_channels, axis=-1)
+    return back(out)
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    """Reference: functional.erase — fill box [i:i+h, j:j+w] with v.
+    Tensor input is CHW (fills [:, i:i+h, j:j+w])."""
+    if isinstance(img, Tensor):
+        arr = np.asarray(img._data).copy()
+        arr[..., i:i + h, j:j + w] = np.asarray(v, arr.dtype)
+        if inplace:
+            import jax.numpy as jnp
+            img._data = jnp.asarray(arr)
+            return img
+        return Tensor(arr)
+    arr, back = _to_hwc(img)
+    out = arr.copy()
+    out[i:i + h, j:j + w] = v
+    return back(out)

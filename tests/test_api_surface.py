@@ -106,12 +106,16 @@ def test_hub_surface():
     assert callable(hub.load) and callable(hub.list) and callable(hub.help)
 
 
-def test_total_public_op_surface_at_least_600():
-    """VERDICT r3 item 5 'Done' criterion: >=600 public callable names
-    across the op-carrying namespaces (reference: ~2000 across
-    python/paddle/tensor + namespaces; the measured set excludes classes
-    and submodule re-exports so growth tracks real op work)."""
+def test_total_public_op_surface_at_least_940():
+    """VERDICT r4 item 6 'Done' criterion (was >=600 in r3): public
+    callable names across every op-carrying namespace. The name-diff vs
+    the reference surface is checked in at tests/surface_diff.md; the
+    measured set excludes classes and submodule re-exports so growth
+    tracks real op work (reference ~2000 names counts classes, aliases
+    and per-method re-exports)."""
     import inspect
+
+    import paddle_tpu.vision.transforms.functional as vtf
 
     seen = set()
 
@@ -133,13 +137,28 @@ def test_total_public_op_surface_at_least_600():
                    (paddle.signal, "signal."),
                    (paddle.geometric, "geometric."),
                    (paddle.nn.functional, "F."),
+                   (paddle.nn.utils, "nn.utils."),
                    (paddle.vision.ops, "vision.ops."),
+                   (vtf, "vision.VF."),
+                   (paddle.vision.transforms, "vision.T."),
                    (paddle.sparse, "sparse."),
+                   (paddle.sparse.nn.functional, "sparse.F."),
                    (paddle.incubate, "incubate."),
+                   (paddle.incubate.nn.functional, "incubate.F."),
                    (paddle.distributed, "dist."),
-                   (paddle.audio.functional, "audio.F.")]:
+                   (paddle.distributed.stream, "dist.stream."),
+                   (paddle.audio.functional, "audio.F."),
+                   (paddle.strings, "strings."),
+                   (paddle.static, "static."),
+                   (paddle.static.nn, "static.nn."),
+                   (paddle.autograd, "autograd."),
+                   (paddle.amp, "amp."), (paddle.jit, "jit."),
+                   (paddle.io, "io."), (paddle.device, "device."),
+                   (paddle.utils, "utils."),
+                   (paddle.utils.cpp_extension, "utils.cpp."),
+                   (paddle.distribution, "distribution.")]:
         total += count(mod, p)
-    assert total >= 600, f"public op surface shrank: {total} < 600"
+    assert total >= 940, f"public op surface shrank: {total} < 940"
 
 
 def test_tensor_method_surface_vs_reference():

@@ -1,0 +1,155 @@
+"""Long-tail F.* ops (reference: python/paddle/nn/functional/ — the 16
+names VERDICT r4's surface diff flagged). Golden against numpy/torch-style
+formulas."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+
+def t(x, sg=True):
+    tt = paddle.to_tensor(np.asarray(x, dtype="float32"))
+    tt.stop_gradient = sg
+    return tt
+
+
+def test_thresholded_relu_and_inplace_acts():
+    x = t([-2.0, 0.5, 1.5, 3.0])
+    np.testing.assert_allclose(F.thresholded_relu(x).numpy(),
+                               [0, 0, 1.5, 3.0])
+    y = t([-2.0, 2.0])
+    F.tanh_(y)
+    np.testing.assert_allclose(y.numpy(), np.tanh([-2.0, 2.0]), rtol=1e-6)
+    z = t([-2.0, 2.0])
+    F.hardtanh_(z)
+    np.testing.assert_allclose(z.numpy(), [-1.0, 1.0])
+    w = t([-4.0, 4.0])
+    F.leaky_relu_(w, 0.1)
+    np.testing.assert_allclose(w.numpy(), [-0.4, 4.0], rtol=1e-6)
+    s = t([[1.0, 2.0]])
+    F.softmax_(s)
+    np.testing.assert_allclose(s.numpy().sum(), 1.0, rtol=1e-6)
+    e = t([-1.0, 1.0])
+    F.elu_(e, alpha=0.5)
+    np.testing.assert_allclose(e.numpy(),
+                               [0.5 * (np.exp(-1) - 1), 1.0], rtol=1e-5)
+    tr = t([0.5, 2.0])
+    F.thresholded_relu_(tr)
+    np.testing.assert_allclose(tr.numpy(), [0.0, 2.0])
+
+
+def test_local_response_norm_matches_manual():
+    rng = np.random.RandomState(0)
+    x = rng.rand(2, 6, 4, 4).astype("float32")
+    out = F.local_response_norm(t(x), size=3, alpha=0.01, beta=0.5, k=2.0)
+    # manual: cross-channel window sum of squares
+    padded = np.pad(x ** 2, ((0, 0), (1, 1), (0, 0), (0, 0)))
+    acc = sum(padded[:, i:i + 6] for i in range(3))
+    want = x / (2.0 + 0.01 / 3 * acc) ** 0.5
+    np.testing.assert_allclose(out.numpy(), want, rtol=1e-5)
+
+
+def test_sequence_mask():
+    out = F.sequence_mask(paddle.to_tensor(np.array([1, 3, 2], "int32")),
+                          maxlen=4)
+    np.testing.assert_array_equal(
+        out.numpy(), [[1, 0, 0, 0], [1, 1, 1, 0], [1, 1, 0, 0]])
+    out2 = F.sequence_mask(paddle.to_tensor(np.array([2], "int32")))
+    assert out2.shape == [1, 2]
+
+
+def test_gather_tree():
+    # T=3, B=1, beam=2 (reference doc example shape)
+    ids = np.array([[[2, 2]], [[6, 1]], [[3, 9]]], "int32")
+    parents = np.array([[[0, 0]], [[1, 1]], [[0, 0]]], "int32")
+    out = F.gather_tree(paddle.to_tensor(ids), paddle.to_tensor(parents))
+    # backtrace: both final beams point to parent 0 at t2, whose t1 entry
+    # is 6 with parent 1, whose t0 entry is 2
+    want = np.array([[[2, 2]], [[6, 6]], [[3, 9]]], "int32")
+    np.testing.assert_array_equal(out.numpy(), want)
+
+
+def test_dice_log_npair_losses():
+    rng = np.random.RandomState(1)
+    probs = rng.rand(2, 4, 3).astype("float32")
+    probs /= probs.sum(-1, keepdims=True)
+    label = rng.randint(0, 3, (2, 4, 1)).astype("int32")
+    d = float(F.dice_loss(t(probs), paddle.to_tensor(label)).numpy())
+    assert 0.0 < d < 1.0
+
+    p = np.clip(rng.rand(6, 1).astype("float32"), 0.05, 0.95)
+    y = (rng.rand(6, 1) > 0.5).astype("float32")
+    ll = F.log_loss(t(p), t(y)).numpy()
+    want = -y * np.log(p + 1e-4) - (1 - y) * np.log(1 - p + 1e-4)
+    np.testing.assert_allclose(ll, want, rtol=1e-5)
+
+    anc = rng.randn(4, 8).astype("float32")
+    pos = rng.randn(4, 8).astype("float32")
+    lab = np.array([0, 1, 0, 2], "int64")
+    n = float(F.npair_loss(t(anc), t(pos),
+                           paddle.to_tensor(lab)).numpy())
+    assert np.isfinite(n) and n > 0
+
+
+def test_sigmoid_focal_loss_reduces_easy_examples():
+    logit = t([[5.0], [-5.0]], sg=False)    # confident correct
+    label = t([[1.0], [0.0]])
+    easy = float(F.sigmoid_focal_loss(logit, label).numpy())
+    hard = float(F.sigmoid_focal_loss(t([[-5.0], [5.0]]), label).numpy())
+    assert easy < hard * 1e-3  # focal term crushes easy examples
+    loss = F.sigmoid_focal_loss(logit, label, reduction="mean")
+    loss.backward()
+    assert logit._grad is not None
+
+
+def test_margin_cross_entropy_penalizes_target():
+    rng = np.random.RandomState(2)
+    cos = np.clip(rng.rand(4, 10).astype("float32"), -1, 1)
+    lab = np.array([1, 3, 5, 7], "int64")
+    plain, sm = F.margin_cross_entropy(
+        t(cos), paddle.to_tensor(lab), margin1=1.0, margin2=0.0,
+        margin3=0.0, scale=10.0, return_softmax=True, reduction="none")
+    arc = F.margin_cross_entropy(
+        t(cos), paddle.to_tensor(lab), margin1=1.0, margin2=0.5,
+        margin3=0.0, scale=10.0, reduction="none")
+    # the angular margin makes the target harder: loss must increase
+    assert (arc.numpy() > plain.numpy()).all()
+    np.testing.assert_allclose(sm.numpy().sum(-1), 1.0, rtol=1e-5)
+    # m2=0 reduces to plain scaled softmax CE
+    oh = np.eye(10)[lab]
+    want = -(np.log(np.exp(10 * cos)
+                    / np.exp(10 * cos).sum(-1, keepdims=True)) * oh
+             ).sum(-1, keepdims=True)
+    np.testing.assert_allclose(plain.numpy(), want, rtol=1e-4)
+
+
+def test_class_center_sample():
+    lab = paddle.to_tensor(np.array([2, 7, 2, 9], "int64"))
+    remapped, sampled = F.class_center_sample(lab, 20, 6)
+    s = sampled.numpy()
+    assert len(s) == 6 and {2, 7, 9}.issubset(set(s.tolist()))
+    r = remapped.numpy()
+    np.testing.assert_array_equal(s[r], [2, 7, 2, 9])
+
+
+def test_sparse_attention_csr():
+    rng = np.random.RandomState(3)
+    B, H, S, D = 1, 1, 4, 8
+    q = t(rng.randn(B, H, S, D))
+    k = t(rng.randn(B, H, S, D))
+    v = t(rng.randn(B, H, S, D))
+    # full causal CSR pattern
+    rows = [list(range(i + 1)) for i in range(S)]
+    offset = np.cumsum([0] + [len(r) for r in rows]).astype("int32")
+    columns = np.concatenate(rows).astype("int32")
+    out = F.sparse_attention(q, k, v, paddle.to_tensor(offset),
+                             paddle.to_tensor(columns))
+    # golden: dense causal attention
+    s = (q.numpy() @ k.numpy().transpose(0, 1, 3, 2)) / np.sqrt(D)
+    causal = np.tril(np.ones((S, S)))
+    s = np.where(causal, s, -1e30)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    want = p @ v.numpy()
+    np.testing.assert_allclose(out.numpy(), want, rtol=1e-4, atol=1e-5)

@@ -1,0 +1,295 @@
+"""nn.utils + incubate long tail + distributed-root API parity
+(reference: python/paddle/nn/utils/, incubate/__init__.py,
+distributed/__init__.py __all__)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+def t(x, sg=True):
+    tt = paddle.to_tensor(np.asarray(x, dtype="float32"))
+    tt.stop_gradient = sg
+    return tt
+
+
+# -- nn.utils -------------------------------------------------------------
+
+def test_weight_norm_reparam_and_remove():
+    paddle.seed(0)
+    lin = nn.Linear(4, 3)
+    w0 = lin.weight.numpy().copy()
+    nn.utils.weight_norm(lin, dim=0)
+    names = dict(lin.named_parameters())
+    assert any(n.endswith("weight_g") for n in names)
+    assert any(n.endswith("weight_v") for n in names)
+    x = t(np.random.RandomState(0).randn(2, 4))
+    out = lin(x)
+    np.testing.assert_allclose(out.numpy(), x.numpy() @ w0
+                               + lin.bias.numpy(), rtol=1e-5)
+    # grads flow to g and v
+    out.sum().backward()
+    g = [p for n, p in lin.named_parameters() if n.endswith("weight_g")][0]
+    v = [p for n, p in lin.named_parameters() if n.endswith("weight_v")][0]
+    assert g._grad is not None and v._grad is not None
+    nn.utils.remove_weight_norm(lin)
+    np.testing.assert_allclose(lin.weight.numpy(), w0, rtol=1e-5)
+
+
+def test_spectral_norm_bounds_sigma():
+    paddle.seed(1)
+    lin = nn.Linear(6, 6)
+    big = np.random.RandomState(1).randn(6, 6).astype("float32") * 5
+    lin.weight.set_value(big)
+    nn.utils.spectral_norm(lin, n_power_iterations=20)
+    x = t(np.eye(6))
+    _ = lin(x)
+    w_eff = np.asarray(lin.weight._data)
+    sigma = np.linalg.svd(w_eff, compute_uv=False)[0]
+    np.testing.assert_allclose(sigma, 1.0, rtol=1e-2)
+
+
+def test_parameters_vector_roundtrip():
+    paddle.seed(2)
+    lin = nn.Linear(3, 2)
+    vec = nn.utils.parameters_to_vector(lin.parameters())
+    assert vec.shape == [3 * 2 + 2]
+    new = np.arange(8, dtype="float32")
+    nn.utils.vector_to_parameters(paddle.to_tensor(new), lin.parameters())
+    np.testing.assert_allclose(lin.weight.numpy().ravel(), new[:6])
+    np.testing.assert_allclose(lin.bias.numpy(), new[6:])
+
+
+def test_clip_grad_helpers():
+    p = t([3.0, 4.0], sg=False)
+    (p * p).sum().backward()          # grad = [6, 8], norm 10
+    total = nn.utils.clip_grad_norm_([p], max_norm=5.0)
+    np.testing.assert_allclose(float(total.numpy()), 10.0, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(p._grad), [3.0, 4.0], rtol=1e-4)
+    nn.utils.clip_grad_value_([p], 3.5)
+    np.testing.assert_allclose(np.asarray(p._grad), [3.0, 3.5], rtol=1e-5)
+
+
+# -- incubate long tail ---------------------------------------------------
+
+def test_softmax_mask_fuse_family():
+    import paddle_tpu.incubate as I
+    x = t(np.random.RandomState(0).randn(1, 2, 3, 3))
+    m = t(np.zeros((1, 1, 3, 3)))
+    out = I.softmax_mask_fuse(x, m)
+    np.testing.assert_allclose(out.numpy().sum(-1), 1.0, rtol=1e-5)
+    tri = I.softmax_mask_fuse_upper_triangle(x)
+    tn = tri.numpy()
+    assert tn[0, 0, 0, 1] < 1e-4 and tn[0, 0, 0, 2] < 1e-4  # masked future
+    np.testing.assert_allclose(tn.sum(-1), 1.0, rtol=1e-4)
+
+
+def test_incubate_segment_and_graph_aliases():
+    import paddle_tpu.incubate as I
+    data = t([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]])
+    ids = paddle.to_tensor(np.array([0, 0, 1], "int32"))
+    np.testing.assert_allclose(I.segment_sum(data, ids).numpy(),
+                               [[4.0, 6.0], [5.0, 6.0]])
+    np.testing.assert_allclose(I.segment_mean(data, ids).numpy(),
+                               [[2.0, 3.0], [5.0, 6.0]])
+    out = I.graph_send_recv(data,
+                            paddle.to_tensor(np.array([0, 1], "int32")),
+                            paddle.to_tensor(np.array([1, 2], "int32")))
+    assert out.shape == [3, 2]
+    loss = I.identity_loss(data, reduction="mean")
+    np.testing.assert_allclose(float(loss.numpy()), 3.5)
+
+
+def test_fused_long_tail_ops():
+    import paddle_tpu.incubate.nn.functional as IF
+    rng = np.random.RandomState(3)
+    x = t(rng.randn(2, 4))
+    w = t(rng.randn(4, 3))
+    b = t(rng.randn(3))
+    out = IF.fused_linear_activation(x, w, b, activation="relu")
+    np.testing.assert_allclose(out.numpy(),
+                               np.maximum(x.numpy() @ w.numpy()
+                                          + b.numpy(), 0), rtol=1e-5)
+    # bias dropout residual LN (inference path)
+    h = t(rng.randn(2, 3, 4))
+    res = t(rng.randn(2, 3, 4))
+    ln = IF.fused_bias_dropout_residual_layer_norm(
+        h, res, dropout_rate=0.0, training=False)
+    np.testing.assert_allclose(ln.numpy().mean(-1), 0.0, atol=1e-5)
+    # expert-choice MoE mixes experts by softmax gate
+    B, S, D, E, F2 = 1, 2, 4, 3, 8
+    xx = t(rng.randn(B, S, D))
+    gate = t(rng.randn(B, S, E))
+    out = IF.fused_ec_moe(xx, gate, t(rng.randn(E, D, F2) * 0.1),
+                          t(np.zeros((E, F2))),
+                          t(rng.randn(E, F2, D) * 0.1),
+                          t(np.zeros((E, D))))
+    assert out.shape == [B, S, D]
+
+
+def test_variable_length_attention_masks_padding():
+    import paddle_tpu.incubate.nn.functional as IF
+    rng = np.random.RandomState(4)
+    q = t(rng.randn(2, 1, 4, 8))
+    k = t(rng.randn(2, 1, 4, 8))
+    v = t(rng.randn(2, 1, 4, 8))
+    sl = paddle.to_tensor(np.array([4, 2], "int32"))
+    out = IF.variable_length_memory_efficient_attention(q, k, v, sl, sl)
+    o = out.numpy()
+    assert np.abs(o[1, 0, 2:]).sum() == 0.0  # padded queries zeroed
+    # batch 0 equals full attention
+    s = (q.numpy()[0, 0] @ k.numpy()[0, 0].T) / np.sqrt(8)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    np.testing.assert_allclose(o[0, 0], p @ v.numpy()[0, 0], rtol=1e-4)
+
+
+def test_fused_multi_transformer_runs_stack():
+    import paddle_tpu.incubate.nn.functional as IF
+    rng = np.random.RandomState(5)
+    B, S, D, H = 1, 3, 8, 2
+    hd = D // H
+    L = 2
+    x = t(rng.randn(B, S, D) * 0.3)
+    args = dict(
+        ln_scales=[t(np.ones(D)) for _ in range(L)],
+        ln_biases=[t(np.zeros(D)) for _ in range(L)],
+        qkv_weights=[t(rng.randn(3, H, hd, D) * 0.1) for _ in range(L)],
+        qkv_biases=[t(np.zeros((3, H, hd))) for _ in range(L)],
+        linear_weights=[t(rng.randn(D, D) * 0.1) for _ in range(L)],
+        linear_biases=[t(np.zeros(D)) for _ in range(L)],
+        ffn_ln_scales=[t(np.ones(D)) for _ in range(L)],
+        ffn_ln_biases=[t(np.zeros(D)) for _ in range(L)],
+        ffn1_weights=[t(rng.randn(D, 2 * D) * 0.1) for _ in range(L)],
+        ffn1_biases=[t(np.zeros(2 * D)) for _ in range(L)],
+        ffn2_weights=[t(rng.randn(2 * D, D) * 0.1) for _ in range(L)],
+        ffn2_biases=[t(np.zeros(D)) for _ in range(L)],
+    )
+    out = IF.fused_multi_transformer(x, **args)
+    assert out.shape == [B, S, D]
+    assert np.isfinite(out.numpy()).all()
+
+
+# -- distributed root -----------------------------------------------------
+
+def test_dist_root_surface_and_small_ops():
+    import paddle_tpu.distributed as dist
+    assert dist.is_available()
+    assert dist.get_backend() == "xla"
+    env = dist.ParallelEnv()
+    assert env.world_size >= 1 and env.rank >= 0
+    assert dist.ParallelMode.DATA_PARALLEL == 0
+
+    # single-controller p2p mailbox
+    src = t([1.0, 2.0])
+    dstt = t([0.0, 0.0])
+    task = dist.isend(src, dst=0)
+    assert task.is_completed()
+    dist.recv(dstt, src=0)
+    np.testing.assert_allclose(dstt.numpy(), [1.0, 2.0])
+    dist.wait(dstt)
+
+    objs = []
+    dist.all_gather_object(objs, {"a": 1})
+    assert objs == [{"a": 1}]
+    out = []
+    dist.scatter_object_list(out, [[1, 2]])
+    assert out == [[1, 2]]
+
+
+def test_dist_gather_and_alltoall_single():
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed import fleet
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 8, "pp_degree": 1,
+                               "sharding_degree": 1, "sep_degree": 1,
+                               "mp_degree": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+    xs = t(np.arange(8, dtype="float32"))
+    got = dist.gather(xs)
+    assert len(got) == 8
+    # global [nranks, len] buffer; exchange = chunk transpose
+    mat = t(np.arange(64, dtype="float32").reshape(8, 8))
+    out = paddle.zeros([8, 8], "float32")
+    dist.alltoall_single(out, mat)
+    # row r holds chunk r of every rank: out[r, j] = in[j, r]
+    want = mat.numpy().reshape(8, 8, 1).swapaxes(0, 1).reshape(8, 8)
+    np.testing.assert_allclose(out.numpy(), want)
+
+
+def test_dist_attr_strategy_dtensor_from_fn():
+    import paddle_tpu.distributed as dist
+    mesh = dist.ProcessMesh([0, 1, 2, 3, 4, 5, 6, 7], dim_names=["x"])
+    tt = dist.dtensor_from_fn(paddle.ones, mesh, [dist.Replicate()], [4])
+    assert tt.shape == [4]
+    attr = dist.DistAttr(mesh, ["x", None])
+    assert "x" in repr(attr)
+    s = dist.Strategy({"sharding": {"stage": 2}})
+    assert s.sharding.stage == 2
+
+
+def test_dist_model_to_static_trains():
+    import paddle_tpu.distributed as dist
+    paddle.seed(0)
+    model = nn.Linear(4, 2)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+    loss_fn = nn.MSELoss()
+    dm = dist.to_static(model, None, loss_fn, opt)
+    rng = np.random.RandomState(0)
+    x, y = t(rng.randn(8, 4)), t(rng.randn(8, 2))
+    losses = [float(dm(x, y).numpy()) for _ in range(10)]
+    assert losses[-1] < losses[0]
+    dm.eval()
+    ev = float(dm(x, y).numpy())
+    assert np.isfinite(ev)
+
+
+def test_inmemory_dataset_and_entries(tmp_path):
+    import paddle_tpu.distributed as dist
+    f = tmp_path / "part-0"
+    f.write_text("a 1\nb 2\n")
+    ds = dist.InMemoryDataset()
+    ds.set_filelist([str(f)])
+    ds.load_into_memory()
+    assert ds.get_memory_data_size() == 2
+    ds.local_shuffle()
+    qd = dist.QueueDataset()
+    with pytest.raises(RuntimeError):
+        qd.global_shuffle()
+    e = dist.CountFilterEntry(10)
+    assert "10" in repr(e)
+    assert dist.ShowClickEntry("show", "click").kind == "show_click_entry"
+
+
+def test_fleet_fs_and_metrics(tmp_path):
+    """Fleet misc row (reference fleet/utils/fs.py + fleet/metrics)."""
+    from paddle_tpu.distributed import fleet
+    fs = fleet.LocalFS()
+    d = str(tmp_path / "ckpts")
+    fs.mkdirs(d)
+    fs.touch(d + "/a.txt")
+    assert fs.is_file(d + "/a.txt") and fs.is_dir(d)
+    dirs, files = fs.ls_dir(d)
+    assert files == ["a.txt"]
+    fs.mv(d + "/a.txt", d + "/b.txt")
+    assert fs.is_exist(d + "/b.txt") and not fs.is_exist(d + "/a.txt")
+    with pytest.raises(Exception):
+        fs.mv(d + "/missing", d + "/x")
+    fs.delete(d)
+    assert not fs.is_exist(d)
+    # HDFS client surfaces the reference API and fails loudly w/o hadoop
+    h = fleet.HDFSClient(hadoop_home="/nonexistent")
+    with pytest.raises(Exception, match="hadoop"):
+        h.mkdirs("/tmp/x")
+
+    from paddle_tpu.distributed.fleet import metrics as M
+    assert float(M.sum(np.array([1.0, 2.0])).sum()) == 3.0
+    assert M.acc(np.array([8.0]), np.array([10.0])) == 0.8
+    np.testing.assert_allclose(
+        M.rmse(np.array([8.0]), np.array([2.0])), 2.0)
+    # perfect separation -> auc 1.0
+    pos = np.array([0.0, 0.0, 5.0])   # all positives in top bucket
+    neg = np.array([5.0, 0.0, 0.0])
+    np.testing.assert_allclose(M.auc(pos, neg), 1.0)

@@ -312,3 +312,46 @@ def test_incubate_fused_functional_namespace():
     # fused layer norm with residual returns both
     o2, res = IF.fused_layer_norm(t(x), t(g), t(bb), residual=t(y))
     np.testing.assert_allclose(res.numpy(), x + y, rtol=1e-6)
+
+
+def test_hsigmoid_custom_tree_matches_default():
+    """Custom path_table/path_code (reference matrix_bit_code.h
+    CustomCode) — feeding the DEFAULT complete-binary-tree paths through
+    the custom-tree API must reproduce the default result exactly."""
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    rng = np.random.RandomState(0)
+    B, IN, C = 4, 6, 7
+    x = paddle.to_tensor(rng.randn(B, IN).astype("float32"))
+    y = np.array([0, 3, 5, 6])
+    label = paddle.to_tensor(y.astype("int64"))
+    w = paddle.to_tensor(rng.randn(2 * C, IN).astype("float32") * 0.3)
+    b = paddle.to_tensor(rng.randn(2 * C).astype("float32") * 0.1)
+    base = F.hsigmoid_loss(x, label, C, w, bias=b)
+
+    depth = int(np.ceil(np.log2(C)))
+    code = y + C
+    js = np.arange(depth)
+    ptab = (code[:, None] >> (js + 1)[None]) - 1
+    pcode = (code[:, None] >> js[None]) & 1
+    pcode = np.where(ptab >= 0, pcode, -1)
+    custom = F.hsigmoid_loss(x, None, C, w, bias=b,
+                             path_table=paddle.to_tensor(
+                                 ptab.astype("int64")),
+                             path_code=paddle.to_tensor(
+                                 pcode.astype("int64")))
+    np.testing.assert_allclose(custom.numpy(), base.numpy(), rtol=1e-5)
+    # grads flow through the custom path too
+    x2 = paddle.to_tensor(rng.randn(B, IN).astype("float32"))
+    x2.stop_gradient = False
+    F.hsigmoid_loss(x2, None, C, w,
+                    path_table=paddle.to_tensor(ptab.astype("int64")),
+                    path_code=paddle.to_tensor(pcode.astype("int64"))
+                    ).sum().backward()
+    assert x2._grad is not None
+
+    import pytest
+    with pytest.raises(ValueError, match="together"):
+        F.hsigmoid_loss(x, label, C, w, path_table=paddle.to_tensor(
+            ptab.astype("int64")))

@@ -1,0 +1,184 @@
+"""Sparse op family (reference: python/paddle/sparse unary/binary +
+nn layers over phi/kernels/sparse/ — VERDICT r4 'op long tail' sparse row).
+Golden testing: every sparse op is checked against the same computation on
+the dense bridge."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import sparse
+
+
+def _rand_coo(shape=(4, 6), nnz=8, seed=0):
+    rng = np.random.RandomState(seed)
+    flat = rng.choice(int(np.prod(shape)), size=nnz, replace=False)
+    idx = np.stack(np.unravel_index(flat, shape))
+    vals = rng.randn(nnz).astype("float32")
+    return sparse.sparse_coo_tensor(idx, vals, shape), idx, vals
+
+
+def test_unary_family_value_wise():
+    x, idx, vals = _rand_coo()
+    cases = {
+        "abs": np.abs, "sin": np.sin, "tanh": np.tanh,
+        "square": np.square, "expm1": np.expm1, "neg": np.negative,
+        "deg2rad": np.deg2rad, "rad2deg": np.rad2deg,
+        "relu": lambda v: np.maximum(v, 0),
+        "relu6": lambda v: np.clip(v, 0, 6),
+    }
+    for name, ref in cases.items():
+        out = getattr(sparse, name)(x)
+        np.testing.assert_allclose(np.asarray(out.values().numpy()),
+                                   ref(vals), rtol=1e-6, atol=1e-6,
+                                   err_msg=name)
+        assert out.shape == x.shape
+
+
+def test_unary_domain_ops():
+    x, idx, vals = _rand_coo(seed=3)
+    pos = sparse.sparse_coo_tensor(idx, np.abs(vals) + 0.1, x.shape)
+    np.testing.assert_allclose(
+        sparse.sqrt(pos).values().numpy(), np.sqrt(np.abs(vals) + 0.1),
+        rtol=1e-6)
+    np.testing.assert_allclose(
+        sparse.log1p(pos).values().numpy(), np.log1p(np.abs(vals) + 0.1),
+        rtol=1e-6)
+    np.testing.assert_allclose(
+        sparse.pow(pos, 3).values().numpy(), (np.abs(vals) + 0.1) ** 3,
+        rtol=1e-5)
+    np.testing.assert_allclose(
+        sparse.leaky_relu(x, 0.2).values().numpy(),
+        np.where(vals > 0, vals, 0.2 * vals), rtol=1e-6)
+
+
+def test_cast_and_isnan():
+    x, idx, vals = _rand_coo()
+    c = sparse.cast(x, index_dtype="int64", value_dtype="float64")
+    assert str(c.values().numpy().dtype) == "float64"
+    n = sparse.isnan(x)
+    assert not n.values().numpy().any()
+
+
+def test_binary_family_matches_dense():
+    x, _, _ = _rand_coo(seed=1)
+    y, _, _ = _rand_coo(seed=2)
+    dx, dy = x.to_dense().numpy(), y.to_dense().numpy()
+    np.testing.assert_allclose(
+        sparse.add(x, y).to_dense().numpy(), dx + dy, rtol=1e-6)
+    np.testing.assert_allclose(
+        sparse.subtract(x, y).to_dense().numpy(), dx - dy, rtol=1e-6)
+    np.testing.assert_allclose(
+        sparse.multiply(x, y).to_dense().numpy(), dx * dy, rtol=1e-6)
+    quot = sparse.divide(x, y).to_dense().numpy()
+    mask = dy != 0
+    np.testing.assert_allclose(quot[mask & (dx != 0)],
+                               (dx / np.where(mask, dy, 1))[mask & (dx != 0)],
+                               rtol=1e-5)
+
+
+def test_matrix_family():
+    x, _, _ = _rand_coo(shape=(4, 6), seed=4)
+    dx = x.to_dense().numpy()
+    v = np.random.RandomState(0).randn(6).astype("float32")
+    np.testing.assert_allclose(
+        sparse.mv(x, paddle.to_tensor(v)).numpy(), dx @ v, rtol=1e-5)
+    y = np.random.RandomState(1).randn(6, 3).astype("float32")
+    inp = np.random.RandomState(2).randn(4, 3).astype("float32")
+    np.testing.assert_allclose(
+        sparse.addmm(paddle.to_tensor(inp), x, paddle.to_tensor(y),
+                     beta=0.5, alpha=2.0).numpy(),
+        0.5 * inp + 2.0 * (dx @ y), rtol=1e-5)
+
+
+def test_softmax_rowwise_over_stored():
+    x, idx, vals = _rand_coo(shape=(3, 5), nnz=7, seed=5)
+    out = sparse.softmax(x)
+    dense = out.to_dense().numpy()
+    ref = x.to_dense().numpy()
+    for r in range(3):
+        stored = ref[r] != 0
+        if not stored.any():
+            continue
+        e = np.exp(ref[r][stored] - ref[r][stored].max())
+        np.testing.assert_allclose(dense[r][stored], e / e.sum(),
+                                   rtol=1e-5, err_msg=f"row {r}")
+        np.testing.assert_allclose(dense[r][stored].sum(), 1.0, rtol=1e-5)
+
+
+def test_shape_ops():
+    x, idx, vals = _rand_coo(shape=(4, 6), seed=6)
+    d = x.to_dense().numpy()
+    np.testing.assert_allclose(
+        sparse.transpose(x, [1, 0]).to_dense().numpy(), d.T, rtol=1e-6)
+    np.testing.assert_allclose(
+        sparse.reshape(x, [2, 12]).to_dense().numpy(), d.reshape(2, 12),
+        rtol=1e-6)
+    np.testing.assert_allclose(
+        sparse.slice(x, [0, 1], [1, 2], [3, 5]).to_dense().numpy(),
+        d[1:3, 2:5], rtol=1e-6)
+    np.testing.assert_allclose(sparse.sum(x).numpy(), d.sum(), rtol=1e-5)
+    np.testing.assert_allclose(sparse.sum(x, axis=1).numpy(), d.sum(1),
+                               rtol=1e-5)
+
+
+def test_coalesce():
+    idx = np.array([[0, 0, 1], [1, 1, 2]])
+    vals = np.array([1.0, 2.0, 5.0], "float32")
+    x = sparse.sparse_coo_tensor(idx, vals, (2, 3))
+    c = sparse.coalesce(x)
+    assert c.nnz() == 2
+    np.testing.assert_allclose(c.to_dense().numpy()[0, 1], 3.0)
+
+
+def test_subm_conv2d_layer():
+    paddle.seed(0)
+    conv = sparse.nn.SubmConv2D(2, 3, kernel_size=3)
+    rng = np.random.RandomState(0)
+    idx = np.stack(np.unravel_index(
+        rng.choice(64, 10, replace=False), (1, 8, 8)))
+    vals = rng.randn(10, 2).astype("float32")
+    x = sparse.sparse_coo_tensor(idx, vals, (1, 8, 8, 2))
+    out = conv(x)
+    assert out.shape == [1, 8, 8, 3]
+    assert out.nnz() == 10  # submanifold: same active sites
+    # golden: dense conv with the gather-GEMM weight reshaped
+    w = conv.weight.numpy().reshape(3, 3, 2, 3)
+    dense = x.to_dense().numpy()[0]
+    pad = np.pad(dense, ((1, 1), (1, 1), (0, 0)))
+    want = np.zeros((8, 8, 3), "float32")
+    for yy in range(8):
+        for xx in range(8):
+            patch = pad[yy:yy + 3, xx:xx + 3]
+            want[yy, xx] = np.einsum("klc,klco->o", patch, w)
+    want += conv.bias.numpy()
+    got = out.to_dense().numpy()[0]
+    active = dense.any(-1)
+    np.testing.assert_allclose(got[active], want[active], rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_sparse_batchnorm_and_acts():
+    paddle.seed(0)
+    bn = sparse.nn.BatchNorm(4)
+    x, idx, _ = _rand_coo(shape=(3, 5), nnz=6, seed=7)
+    vals = np.random.RandomState(8).randn(6, 4).astype("float32")
+    xc = sparse.sparse_coo_tensor(idx, vals, (3, 5, 4))
+    out = bn(xc)
+    v = out.values().numpy()
+    np.testing.assert_allclose(v.mean(0), 0.0, atol=1e-4)
+    np.testing.assert_allclose(v.std(0), 1.0, atol=1e-2)
+    r = sparse.nn.ReLU()(xc)
+    assert (r.values().numpy() >= 0).all()
+    assert sparse.nn.SyncBatchNorm.convert_sync_batchnorm(bn) is bn
+
+
+def test_sparse_max_pool3d():
+    rng = np.random.RandomState(0)
+    idx = np.stack(np.unravel_index(
+        rng.choice(4 * 4 * 4, 12, replace=False), (1, 4, 4, 4)))
+    vals = np.abs(rng.randn(12, 2)).astype("float32")
+    x = sparse.sparse_coo_tensor(idx, vals, (1, 4, 4, 4, 2))
+    out = sparse.nn.functional.max_pool3d(x, kernel_size=2, stride=2)
+    dense = x.to_dense().numpy()
+    want = dense.reshape(1, 2, 2, 2, 2, 2, 2, 2).max(axis=(2, 4, 6))
+    np.testing.assert_allclose(out.to_dense().numpy(), want, rtol=1e-6)

@@ -1,0 +1,99 @@
+"""vision.transforms.functional primitives (reference:
+python/paddle/vision/transforms/functional.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.vision.transforms.functional as VF
+
+
+def _img(h=6, w=8, c=3, seed=0):
+    return (np.random.RandomState(seed).rand(h, w, c) * 255).astype("uint8")
+
+
+def test_flip_crop_pad():
+    x = _img()
+    np.testing.assert_array_equal(VF.hflip(x), x[:, ::-1])
+    np.testing.assert_array_equal(VF.vflip(x), x[::-1])
+    np.testing.assert_array_equal(VF.crop(x, 1, 2, 3, 4), x[1:4, 2:6])
+    p = VF.pad(x, 2, fill=7)
+    assert p.shape == (10, 12, 3)
+    assert (p[:2] == 7).all()
+    p2 = VF.pad(x, [1, 2, 3, 4], padding_mode="edge")
+    assert p2.shape == (6 + 2 + 4, 8 + 1 + 3, 3)
+
+
+def test_photometric_adjustments():
+    x = _img()
+    np.testing.assert_array_equal(VF.adjust_brightness(x, 1.0), x)
+    darker = VF.adjust_brightness(x, 0.5)
+    assert darker.mean() < x.mean()
+    flat = VF.adjust_contrast(x, 0.0)
+    assert flat.std() < 1.0  # collapses to the gray mean
+    gray = VF.adjust_saturation(x, 0.0)
+    # channels equal after full desaturation
+    np.testing.assert_allclose(gray[..., 0], gray[..., 1], atol=1.0)
+    hue = VF.adjust_hue(x, 0.0)
+    np.testing.assert_allclose(hue.astype(int), x.astype(int), atol=2)
+    with pytest.raises(ValueError):
+        VF.adjust_hue(x, 0.7)
+
+
+def test_hue_shift_rotates_channels():
+    # pure red shifted by 1/3 -> green
+    red = np.zeros((2, 2, 3), "uint8")
+    red[..., 0] = 200
+    shifted = VF.adjust_hue(red, 1.0 / 3.0)
+    assert shifted[..., 1].mean() > 150 and shifted[..., 0].mean() < 50
+
+
+def test_affine_identity_and_rotate():
+    x = _img()
+    same = VF.affine(x, 0.0, (0, 0), 1.0, (0.0, 0.0))
+    np.testing.assert_array_equal(same, x)
+    rot180 = VF.rotate(x, 180.0)
+    # 180-degree rotation about the center = flip both axes
+    np.testing.assert_array_equal(rot180, x[::-1, ::-1])
+    shifted = VF.affine(x, 0.0, (2, 0), 1.0, (0.0, 0.0))
+    np.testing.assert_array_equal(shifted[:, 2:], x[:, :-2])
+
+
+def test_rotate_expand_grows_canvas():
+    x = _img(4, 8)
+    out = VF.rotate(x, 90.0, expand=True)
+    assert out.shape[0] >= 8 and out.shape[1] >= 4
+
+
+def test_perspective_identity():
+    x = _img()
+    pts = [(0, 0), (7, 0), (7, 5), (0, 5)]
+    out = VF.perspective(x, pts, pts)
+    np.testing.assert_array_equal(out, x)
+
+
+def test_grayscale_and_erase():
+    x = _img()
+    g = VF.to_grayscale(x)
+    assert g.shape == (6, 8, 1)
+    g3 = VF.to_grayscale(x, 3)
+    np.testing.assert_array_equal(g3[..., 0], g3[..., 2])
+    e = VF.erase(x, 1, 2, 2, 3, 0)
+    assert (e[1:3, 2:5] == 0).all()
+    assert (e[0] == x[0]).all()
+
+
+def test_tensor_chw_roundtrip():
+    chw = paddle.to_tensor(
+        np.random.RandomState(1).rand(3, 6, 8).astype("float32"))
+    flipped = VF.hflip(chw)
+    np.testing.assert_allclose(flipped.numpy(), chw.numpy()[:, :, ::-1])
+    er = VF.erase(chw, 0, 0, 2, 2, 0.0)
+    assert (er.numpy()[:, :2, :2] == 0).all()
+
+
+def test_pil_input():
+    from PIL import Image
+    img = Image.fromarray(_img())
+    out = VF.hflip(img)
+    assert isinstance(out, Image.Image)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(img)[:, ::-1])

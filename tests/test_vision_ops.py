@@ -1,0 +1,243 @@
+"""Detection op family (reference: python/paddle/vision/ops.py over phi
+roi_pool/psroi_pool/deform_conv/yolo_box/box_coder/... kernels). Golden
+testing against straightforward numpy implementations."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops as vops
+
+
+def t(x):
+    return paddle.to_tensor(np.asarray(x, dtype="float32"))
+
+
+def test_roi_pool_matches_manual():
+    rng = np.random.RandomState(0)
+    feat = rng.randn(1, 2, 8, 8).astype("float32")
+    boxes = np.array([[0, 0, 4, 4], [2, 2, 8, 8]], "float32")
+    out = vops.roi_pool(t(feat), t(boxes),
+                        paddle.to_tensor(np.array([2], "int32")), 2)
+    assert out.shape == [2, 2, 2, 2]
+    # roi 0: bins over [0:4, 0:4] quantized
+    want00 = feat[0, :, 0:2, 0:2].max(axis=(1, 2))
+    np.testing.assert_allclose(out.numpy()[0, :, 0, 0], want00, rtol=1e-6)
+    want11 = feat[0, :, 2:4, 2:4].max(axis=(1, 2))
+    np.testing.assert_allclose(out.numpy()[0, :, 1, 1], want11, rtol=1e-6)
+
+
+def test_psroi_pool_shapes_and_values():
+    rng = np.random.RandomState(1)
+    feat = rng.randn(1, 8, 6, 6).astype("float32")  # 8 = 2 out_c * 2*2 bins
+    boxes = np.array([[0, 0, 6, 6]], "float32")
+    out = vops.psroi_pool(t(feat), t(boxes),
+                          paddle.to_tensor(np.array([1], "int32")), 2)
+    assert out.shape == [1, 2, 2, 2]
+    # bin (0,0) of out_c 0 reads channel 0 over rows 0:3, cols 0:3
+    np.testing.assert_allclose(out.numpy()[0, 0, 0, 0],
+                               feat[0, 0, 0:3, 0:3].mean(), rtol=1e-5)
+    # bin (1,1) of out_c 1 reads channel (3*2+1)=7 over rows 3:6, cols 3:6
+    np.testing.assert_allclose(out.numpy()[0, 1, 1, 1],
+                               feat[0, 7, 3:6, 3:6].mean(), rtol=1e-5)
+
+
+def test_deform_conv2d_zero_offset_equals_conv():
+    """With zero offsets DCN must equal a standard conv."""
+    rng = np.random.RandomState(2)
+    x = rng.randn(1, 3, 6, 6).astype("float32")
+    w = rng.randn(4, 3, 3, 3).astype("float32") * 0.2
+    off = np.zeros((1, 2 * 9, 4, 4), "float32")
+    out = vops.deform_conv2d(t(x), t(off), t(w))
+    # manual valid conv
+    want = np.zeros((1, 4, 4, 4), "float32")
+    for o in range(4):
+        for yy in range(4):
+            for xx in range(4):
+                want[0, o, yy, xx] = (x[0, :, yy:yy + 3, xx:xx + 3]
+                                      * w[o]).sum()
+    np.testing.assert_allclose(out.numpy(), want, rtol=1e-4, atol=1e-4)
+
+
+def test_deform_conv2d_mask_and_grad():
+    rng = np.random.RandomState(3)
+    x = t(rng.randn(1, 2, 5, 5).astype("float32"))
+    w = t(rng.randn(2, 2, 3, 3).astype("float32") * 0.3)
+    w.stop_gradient = False
+    off = t(rng.randn(1, 18, 3, 3).astype("float32") * 0.1)
+    mask = t(np.ones((1, 9, 3, 3), "float32") * 0.5)
+    out = vops.deform_conv2d(x, off, w, mask=mask)
+    assert out.shape == [1, 2, 3, 3]
+    out.sum().backward()
+    assert w._grad is not None
+
+
+def test_box_coder_roundtrip():
+    rng = np.random.RandomState(4)
+    priors = np.abs(rng.rand(5, 4).astype("float32"))
+    priors[:, 2:] = priors[:, :2] + 0.5 + priors[:, 2:]
+    var = np.full((5, 4), 0.1, "float32")
+    gt = priors + rng.rand(5, 4).astype("float32") * 0.1
+    enc = vops.box_coder(t(priors), t(var), t(gt),
+                         code_type="encode_center_size")
+    dec = vops.box_coder(t(priors), t(var),
+                         paddle.to_tensor(enc.numpy()),
+                         code_type="decode_center_size", axis=0)
+    # enc[t, p] encodes gt t against prior p; decoding against prior p
+    # (axis=0) makes the diagonal the roundtrip
+    diag = dec.numpy()[np.arange(5), np.arange(5)]
+    np.testing.assert_allclose(diag, gt, rtol=1e-3, atol=1e-4)
+
+
+def test_prior_box_counts_and_range():
+    x = t(np.zeros((1, 8, 4, 4)))
+    img = t(np.zeros((1, 3, 32, 32)))
+    boxes, var = vops.prior_box(x, img, min_sizes=[8.0], max_sizes=[16.0],
+                                aspect_ratios=[2.0], flip=True, clip=True)
+    # priors: ar1 + ar2 + ar0.5 + max-size sqrt = 4
+    assert boxes.shape == [4, 4, 4, 4]
+    assert var.shape == [4, 4, 4, 4]
+    b = boxes.numpy()
+    assert (b >= 0).all() and (b <= 1).all()
+    np.testing.assert_allclose(var.numpy()[..., 2], 0.2)
+
+
+def test_yolo_box_decodes():
+    rng = np.random.RandomState(5)
+    A, cls, H = 2, 3, 4
+    x = rng.randn(1, A * (5 + cls), H, H).astype("float32")
+    boxes, scores = vops.yolo_box(t(x),
+                                  paddle.to_tensor(
+                                      np.array([[64, 64]], "int32")),
+                                  anchors=[10, 13, 16, 30], class_num=cls,
+                                  conf_thresh=0.0, downsample_ratio=16)
+    assert boxes.shape == [1, A * H * H, 4]
+    assert scores.shape == [1, A * H * H, cls]
+    b = boxes.numpy()
+    assert (b[..., 2] >= b[..., 0] - 1e-3).all()
+    s = scores.numpy()
+    assert (s >= 0).all() and (s <= 1).all()
+
+
+def test_yolo_loss_decreases_on_fit():
+    """The loss must be trainable: gradient steps on a fixed tiny target
+    reduce it."""
+    rng = np.random.RandomState(6)
+    A, cls, H = 3, 2, 4
+    x = paddle.to_tensor(rng.randn(1, A * (5 + cls), H, H)
+                         .astype("float32") * 0.1)
+    x.stop_gradient = False
+    gt_box = paddle.to_tensor(
+        np.array([[[0.5, 0.5, 0.3, 0.4]]], "float32"))
+    gt_label = paddle.to_tensor(np.array([[1]], "int32"))
+    opt = paddle.optimizer.Adam(learning_rate=0.05, parameters=[x])
+    losses = []
+    for _ in range(25):
+        loss = vops.yolo_loss(x, gt_box, gt_label,
+                              anchors=[10, 13, 16, 30, 33, 23],
+                              anchor_mask=[0, 1, 2], class_num=cls,
+                              ignore_thresh=0.7, downsample_ratio=8)
+        losses.append(float(loss.numpy().sum()))
+        loss.sum().backward()
+        opt.step()
+        opt.clear_grad()
+    assert losses[-1] < losses[0] * 0.7, losses[::6]
+
+
+def test_matrix_nms_decays_overlaps():
+    boxes = np.array([[[0, 0, 10, 10], [0, 0, 10, 10], [20, 20, 30, 30]]],
+                     "float32")
+    scores = np.array([[[0.9, 0.8, 0.7]]], "float32")  # one class
+    out, nums = vops.matrix_nms(t(boxes), t(scores), score_threshold=0.1,
+                                background_label=-1, normalized=False)
+    o = out.numpy()
+    assert int(nums.numpy()[0]) == 3
+    top = o[np.argsort(-o[:, 1])]
+    np.testing.assert_allclose(top[0, 1], 0.9, rtol=1e-5)   # best kept
+    assert top[-1, 1] < 0.2  # duplicate decayed hard
+
+
+def test_generate_proposals_and_fpn_distribute():
+    rng = np.random.RandomState(7)
+    N, A, H, W = 1, 2, 4, 4
+    scores = rng.rand(N, A, H, W).astype("float32")
+    deltas = (rng.randn(N, A * 4, H, W) * 0.1).astype("float32")
+    anchors = np.zeros((H, W, A, 4), "float32")
+    for yy in range(H):
+        for xx in range(W):
+            for a, size in enumerate((8, 16)):
+                cx, cy = xx * 8 + 4, yy * 8 + 4
+                anchors[yy, xx, a] = [cx - size / 2, cy - size / 2,
+                                      cx + size / 2, cy + size / 2]
+    var = np.full((H, W, A, 4), 1.0, "float32")
+    rois, rscores, num = vops.generate_proposals(
+        t(scores), t(deltas), paddle.to_tensor(
+            np.array([[32, 32]], "float32")),
+        t(anchors), t(var), pre_nms_top_n=32, post_nms_top_n=8,
+        nms_thresh=0.7, min_size=2.0)
+    n = int(num.numpy()[0])
+    assert 1 <= n <= 8 and rois.shape[0] == n
+    r = rois.numpy()
+    assert (r[:, 0] >= 0).all() and (r[:, 2] <= 32).all()
+    # route them to FPN levels
+    multi, restore = vops.distribute_fpn_proposals(rois, 2, 5, 4, 16)
+    assert len(multi) == 4
+    total = sum(m.shape[0] for m in multi)
+    assert total == n
+    assert sorted(restore.numpy().ravel().tolist()) == list(range(n))
+
+
+def test_read_file_and_decode_jpeg(tmp_path):
+    from PIL import Image
+    img = Image.fromarray(
+        (np.random.RandomState(0).rand(8, 6, 3) * 255).astype("uint8"))
+    p = str(tmp_path / "x.jpg")
+    img.save(p, quality=95)
+    raw = vops.read_file(p)
+    assert raw.numpy().dtype == np.uint8 and raw.shape[0] > 100
+    dec = vops.decode_jpeg(raw, mode="rgb")
+    assert dec.shape == [3, 8, 6]
+
+
+def test_layer_wrappers():
+    rng = np.random.RandomState(8)
+    feat = t(rng.randn(1, 2, 8, 8).astype("float32"))
+    boxes = t(np.array([[0, 0, 4, 4]], "float32"))
+    bn = paddle.to_tensor(np.array([1], "int32"))
+    assert vops.RoIPool(2)(feat, boxes, bn).shape == [1, 2, 2, 2]
+    assert vops.RoIAlign(2)(feat, boxes, bn).shape == [1, 2, 2, 2]
+    feat8 = t(rng.randn(1, 8, 8, 8).astype("float32"))
+    assert vops.PSRoIPool(2)(feat8, boxes, bn).shape == [1, 2, 2, 2]
+    dcn = vops.DeformConv2D(2, 3, 3)
+    off = t(np.zeros((1, 18, 6, 6), "float32"))
+    assert dcn(feat, off).shape == [1, 3, 6, 6]
+
+
+def test_fpn_distribute_per_image_counts():
+    """rois_num in -> per-level rois_num out has one count PER IMAGE
+    (review r5 finding: the global count broke N>1 splitting)."""
+    rois = np.array([[0, 0, 10, 10],      # img0, small -> low level
+                     [0, 0, 200, 200],    # img0, big  -> high level
+                     [0, 0, 12, 12],      # img1, small
+                     [0, 0, 11, 11]],     # img1, small
+                    "float32")
+    multi, restore, nums = vops.distribute_fpn_proposals(
+        t(rois), 2, 5, 4, 64,
+        rois_num=paddle.to_tensor(np.array([2, 2], "int32")))
+    assert all(n.shape == [2] for n in nums)
+    total = np.stack([n.numpy() for n in nums]).sum(axis=0)
+    np.testing.assert_array_equal(total, [2, 2])  # every roi routed once
+    small_level = nums[0].numpy()
+    np.testing.assert_array_equal(small_level, [1, 2])
+
+
+def test_infermeta_pos1_axis_ops_accept_valid_calls():
+    """repeat_interleave/quantile 2nd positional arg is NOT an axis
+    (review r5 finding: the preflight mis-read it as one)."""
+    x = t(np.random.RandomState(0).rand(2, 3))
+    assert paddle.repeat_interleave(x, 3).shape == [18]
+    q = paddle.quantile(x, 1.0)
+    assert np.isfinite(float(q.numpy()))
+    # inner with different leading dims is valid too
+    out = paddle.inner(t(np.random.RandomState(1).rand(3, 4)),
+                       t(np.random.RandomState(2).rand(5, 4)))
+    assert out.shape == [3, 5]

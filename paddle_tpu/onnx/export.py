@@ -150,14 +150,48 @@ def _emit_layer(em, layer, cur):
         "(StableHLO) for arbitrary models.")
 
 
+def _example_from_spec(spec):
+    """Concrete example tensor from an InputSpec/shape (None dims -> 1)."""
+    import numpy as np
+
+    from ..core.tensor import Tensor
+    shape = [1 if d is None else int(d) for d in
+             (spec.shape if hasattr(spec, "shape") else spec)]
+    dtype = str(getattr(spec, "dtype", "float32") or "float32")
+    if "int" in dtype:
+        return Tensor(np.zeros(shape, dtype))
+    return Tensor(np.zeros(shape, np.float32))
+
+
 def export(layer, path, input_spec=None, opset_version=13, **configs):
     """Reference: paddle.onnx.export(layer, path, input_spec) — writes
-    ``path + '.onnx'``. input_spec: one InputSpec/shape for the single
-    graph input (None dims = dynamic batch)."""
+    ``path + '.onnx'``. input_spec: InputSpec/shape (None dims = dynamic
+    batch) or concrete example Tensors.
+
+    Sequential MLP/CNN stacks go through the layer-by-layer emitter (keeps
+    dynamic batch dims and Gemm/Conv-level nodes); ANY other traceable
+    model goes through the jaxpr walker (jaxpr_export.export_traced) —
+    the paddle2onnx-equivalent general path."""
     if input_spec is None:
         raise ValueError("paddle.onnx.export requires input_spec")
-    spec = input_spec[0] if isinstance(input_spec, (list, tuple)) \
-        else input_spec
+    from .. import nn
+    from ..core.tensor import Tensor
+    specs = list(input_spec) if isinstance(input_spec, (list, tuple)) \
+        else [input_spec]
+    if not isinstance(layer, nn.Sequential):
+        from .jaxpr_export import export_traced
+        examples = [s if isinstance(s, Tensor) else _example_from_spec(s)
+                    for s in specs]
+        was_training = getattr(layer, "training", False)
+        if hasattr(layer, "eval"):
+            layer.eval()
+        try:
+            return export_traced(layer, examples, path,
+                                 opset_version=opset_version)
+        finally:
+            if was_training and hasattr(layer, "train"):
+                layer.train()
+    spec = specs[0]
     shape = list(spec.shape) if hasattr(spec, "shape") else list(spec)
 
     em = _Emitter()

@@ -1,0 +1,497 @@
+"""ONNX export for arbitrary traced models — jaxpr → ONNX graph.
+
+Reference: python/paddle/onnx/export.py delegates to paddle2onnx, which
+walks the static Program op-by-op and maps each op to ONNX nodes. The
+TPU-native analog walks the model's *jaxpr* (the traced forward is the
+program; there is no ProgramDesc) and maps each lax primitive to ONNX —
+so any model the tracer can stage exports, not just Sequential stacks.
+
+Design:
+* constant folding — an equation whose inputs are all known constants
+  (weights are closed-over constants of the trace) is evaluated eagerly
+  and becomes an initializer; position ids, causal masks, iota etc.
+  disappear from the graph;
+* call primitives (pjit, custom_jvp, remat) are inlined recursively;
+* unsupported primitives raise with the primitive's name (the reference's
+  paddle2onnx contract: a clear per-op error, never a silent skip).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import proto
+
+__all__ = ["export_traced", "UnsupportedOpError"]
+
+_BOOL, _INT32 = 9, 6
+
+
+class UnsupportedOpError(NotImplementedError):
+    def __init__(self, prim, detail=""):
+        super().__init__(
+            f"ONNX export: jax primitive '{prim}' is not mapped to an ONNX "
+            f"op{(' (' + detail + ')') if detail else ''}; supported set: "
+            f"{sorted(_HANDLERS)}")
+
+
+def _np_dtype_to_onnx(dt):
+    dt = np.dtype(dt)
+    if dt == np.float32 or dt == np.float64 or dt == np.float16 \
+            or str(dt) == "bfloat16":
+        return proto.FLOAT
+    if dt == np.bool_:
+        return _BOOL
+    if dt == np.int32:
+        return _INT32
+    return proto.INT64
+
+
+def _np_for_onnx(arr):
+    """Normalize to the dtypes the initializer writer emits."""
+    arr = np.asarray(arr)
+    code = _np_dtype_to_onnx(arr.dtype)
+    if code == proto.FLOAT:
+        return arr.astype(np.float32), proto.FLOAT
+    if code == _BOOL:
+        return arr.astype(np.bool_), _BOOL
+    if code == _INT32:
+        return arr.astype(np.int32), _INT32
+    return arr.astype(np.int64), proto.INT64
+
+
+class _GraphBuilder:
+    def __init__(self):
+        self.nodes = []
+        self.inits = []
+        self.count = 0
+
+    def name(self, base):
+        self.count += 1
+        return f"{base}_{self.count}"
+
+    def add_init(self, base, arr):
+        arr, code = _np_for_onnx(arr)
+        nm = self.name(base)
+        self.inits.append(proto.tensor_proto(
+            nm, arr.shape, code, np.ascontiguousarray(arr).tobytes()))
+        return nm
+
+    def emit(self, op, inputs, attrs=(), n_out=1):
+        outs = [self.name(op.lower()) for _ in range(n_out)]
+        self.nodes.append(proto.node(op, inputs, outs,
+                                     name=self.name(op), attrs=attrs))
+        return outs[0] if n_out == 1 else outs
+
+
+class _Ctx:
+    """var -> ('c', np array) constant or ('n', str) graph edge."""
+
+    def __init__(self, gb):
+        self.gb = gb
+        self.env = {}
+
+    def read(self, var):
+        if hasattr(var, "val"):  # jax Literal
+            return ("c", np.asarray(var.val))
+        return self.env[var]
+
+    def name_of(self, v):
+        """Graph-edge name for a value, materializing constants."""
+        kind, val = v
+        if kind == "n":
+            return val
+        return self.gb.add_init("const", val)
+
+
+def _all_const(vals):
+    return all(k == "c" for k, _ in vals)
+
+
+def _fold(eqn, vals):
+    """Evaluate a fully-constant equation eagerly."""
+    args = [v for _, v in vals]
+    sub = eqn.params.get("jaxpr") or eqn.params.get("call_jaxpr")
+    if sub is not None:
+        try:
+            from jax.core import eval_jaxpr
+        except ImportError:
+            from jax.extend.core import eval_jaxpr
+        jx = sub.jaxpr if hasattr(sub, "jaxpr") else sub
+        consts = sub.consts if hasattr(sub, "consts") else []
+        out = eval_jaxpr(jx, consts, *args)
+        return [np.asarray(o) for o in out]
+    out = eqn.primitive.bind(*args, **eqn.params)
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    return [np.asarray(o) for o in outs]
+
+
+# -- primitive handlers ---------------------------------------------------
+
+def _ew(op):
+    def h(ctx, eqn, ins):
+        return ctx.gb.emit(op, [ctx.name_of(v) for v in ins])
+    return h
+
+
+def _h_integer_pow(ctx, eqn, ins):
+    y = eqn.params["y"]
+    x = ctx.name_of(ins[0])
+    if y == 2:
+        return ctx.gb.emit("Mul", [x, x])
+    e = ctx.gb.add_init("exp", np.asarray(float(y), np.float32))
+    return ctx.gb.emit("Pow", [x, e])
+
+
+def _h_select_n(ctx, eqn, ins):
+    # select_n(pred, case0, case1): pred True -> case1
+    pred, a, b = [ctx.name_of(v) for v in ins]
+    return ctx.gb.emit("Where", [pred, b, a])
+
+
+def _h_broadcast_in_dim(ctx, eqn, ins):
+    shape = eqn.params["shape"]
+    bdims = eqn.params["broadcast_dimensions"]
+    src = ins[0]
+    # reshape to rank(shape) with singletons, then Expand
+    inter = [1] * len(shape)
+    in_aval = eqn.invars[0].aval
+    for i, d in enumerate(bdims):
+        inter[d] = in_aval.shape[i]
+    x = ctx.name_of(src)
+    if list(in_aval.shape) != inter:
+        shp = ctx.gb.add_init("shape", np.asarray(inter, np.int64))
+        x = ctx.gb.emit("Reshape", [x, shp])
+    tgt = ctx.gb.add_init("shape", np.asarray(shape, np.int64))
+    return ctx.gb.emit("Expand", [x, tgt])
+
+
+def _h_reshape(ctx, eqn, ins):
+    shp = ctx.gb.add_init(
+        "shape", np.asarray(eqn.params["new_sizes"], np.int64))
+    return ctx.gb.emit("Reshape", [ctx.name_of(ins[0]), shp])
+
+
+def _h_shape_to(ctx, eqn, ins):
+    """squeeze/expand_dims — both are reshapes to the output aval."""
+    shp = ctx.gb.add_init(
+        "shape", np.asarray(eqn.outvars[0].aval.shape, np.int64))
+    return ctx.gb.emit("Reshape", [ctx.name_of(ins[0]), shp])
+
+
+def _h_transpose(ctx, eqn, ins):
+    perm = [int(p) for p in eqn.params["permutation"]]
+    return ctx.gb.emit("Transpose", [ctx.name_of(ins[0])],
+                       attrs=[proto.attribute("perm", ints=perm)])
+
+
+def _h_concatenate(ctx, eqn, ins):
+    return ctx.gb.emit(
+        "Concat", [ctx.name_of(v) for v in ins],
+        attrs=[proto.attribute("axis", i=int(eqn.params["dimension"]))])
+
+
+def _h_slice(ctx, eqn, ins):
+    p = eqn.params
+    starts = ctx.gb.add_init("starts",
+                             np.asarray(p["start_indices"], np.int64))
+    ends = ctx.gb.add_init("ends", np.asarray(p["limit_indices"], np.int64))
+    axes = ctx.gb.add_init(
+        "axes", np.arange(len(p["start_indices"]), dtype=np.int64))
+    args = [ctx.name_of(ins[0]), starts, ends, axes]
+    if p.get("strides") is not None:
+        args.append(ctx.gb.add_init("steps",
+                                    np.asarray(p["strides"], np.int64)))
+    return ctx.gb.emit("Slice", args)
+
+
+def _h_convert(ctx, eqn, ins):
+    code = _np_dtype_to_onnx(eqn.params["new_dtype"])
+    return ctx.gb.emit("Cast", [ctx.name_of(ins[0])],
+                       attrs=[proto.attribute("to", i=code)])
+
+
+def _h_reduce(onnx_op):
+    def h(ctx, eqn, ins):
+        axes = ctx.gb.add_init("axes",
+                               np.asarray(eqn.params["axes"], np.int64))
+        return ctx.gb.emit(onnx_op, [ctx.name_of(ins[0]), axes],
+                           attrs=[proto.attribute("keepdims", i=0)])
+    return h
+
+
+def _h_dot_general(ctx, eqn, ins):
+    (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+    la, ra = eqn.invars[0].aval, eqn.invars[1].aval
+
+    def arrange(v, aval, batch, contract, contract_last):
+        free = [d for d in range(len(aval.shape))
+                if d not in batch and d not in contract]
+        perm = list(batch) + (free + list(contract) if contract_last
+                              else list(contract) + free)
+        x = ctx.name_of(v)
+        if perm != list(range(len(aval.shape))):
+            x = ctx.gb.emit("Transpose", [x],
+                            attrs=[proto.attribute("perm",
+                                                   ints=[int(p) for p
+                                                         in perm])])
+        b = int(np.prod([aval.shape[d] for d in batch])) if batch else 1
+        k = int(np.prod([aval.shape[d] for d in contract]))
+        f = int(np.prod([aval.shape[d] for d in free])) if free else 1
+        shape = ([b, f, k] if contract_last else [b, k, f])
+        shp = ctx.gb.add_init("shape", np.asarray(shape, np.int64))
+        return ctx.gb.emit("Reshape", [x, shp]), f
+
+    lx, m = arrange(ins[0], la, lb, lc, True)
+    rx, n = arrange(ins[1], ra, rb, rc, False)
+    mm = ctx.gb.emit("MatMul", [lx, rx])
+    out_shape = [int(s) for s in eqn.outvars[0].aval.shape]
+    shp = ctx.gb.add_init("shape", np.asarray(out_shape, np.int64))
+    return ctx.gb.emit("Reshape", [mm, shp])
+
+
+def _h_conv(ctx, eqn, ins):
+    p = eqn.params
+    dn = p["dimension_numbers"]
+    if tuple(dn.lhs_spec) != tuple(range(len(dn.lhs_spec))) or \
+            tuple(dn.rhs_spec) != tuple(range(len(dn.rhs_spec))):
+        raise UnsupportedOpError("conv_general_dilated",
+                                 f"dimension_numbers {dn} (need NCHW/OIHW)")
+    if p.get("lhs_dilation") and any(d != 1 for d in p["lhs_dilation"]):
+        raise UnsupportedOpError("conv_general_dilated",
+                                 "transposed conv (lhs_dilation)")
+    pads_pairs = p["padding"]
+    pads = [int(lo) for lo, _ in pads_pairs] + [int(hi) for _, hi
+                                                in pads_pairs]
+    attrs = [proto.attribute("strides",
+                             ints=[int(s) for s in p["window_strides"]]),
+             proto.attribute("pads", ints=pads),
+             proto.attribute("dilations",
+                             ints=[int(d) for d in p["rhs_dilation"]]),
+             proto.attribute("group", i=int(p["feature_group_count"]))]
+    return ctx.gb.emit("Conv", [ctx.name_of(ins[0]), ctx.name_of(ins[1])],
+                       attrs=attrs)
+
+
+def _h_reduce_window_max(ctx, eqn, ins):
+    p = eqn.params
+    wd = p["window_dimensions"]
+    if len(wd) < 3 or wd[0] != 1 or wd[1] != 1:
+        raise UnsupportedOpError("reduce_window_max",
+                                 f"window {wd} (need NCHW pooling)")
+    pads_pairs = p["padding"][2:]
+    pads = [int(lo) for lo, _ in pads_pairs] + [int(hi) for _, hi
+                                                in pads_pairs]
+    attrs = [proto.attribute("kernel_shape",
+                             ints=[int(w) for w in wd[2:]]),
+             proto.attribute("strides",
+                             ints=[int(s) for s in
+                                   p["window_strides"][2:]]),
+             proto.attribute("pads", ints=pads)]
+    return ctx.gb.emit("MaxPool", [ctx.name_of(ins[0])], attrs=attrs)
+
+
+def _h_reduce_window_add(ctx, eqn, ins):
+    # sum-pool = AveragePool * window_size (count_include_pad=1)
+    p = eqn.params
+    wd = p["window_dimensions"]
+    if len(wd) < 3 or wd[0] != 1 or wd[1] != 1:
+        raise UnsupportedOpError("reduce_window_sum",
+                                 f"window {wd} (need NCHW pooling)")
+    pads_pairs = p["padding"][2:]
+    pads = [int(lo) for lo, _ in pads_pairs] + [int(hi) for _, hi
+                                                in pads_pairs]
+    attrs = [proto.attribute("kernel_shape",
+                             ints=[int(w) for w in wd[2:]]),
+             proto.attribute("strides",
+                             ints=[int(s) for s in
+                                   p["window_strides"][2:]]),
+             proto.attribute("pads", ints=pads),
+             proto.attribute("count_include_pad", i=1)]
+    ap = ctx.gb.emit("AveragePool", [ctx.name_of(ins[0])], attrs=attrs)
+    k = ctx.gb.add_init("winsize",
+                        np.asarray(float(np.prod(wd)), np.float32))
+    return ctx.gb.emit("Mul", [ap, k])
+
+
+def _h_pad(ctx, eqn, ins):
+    p = eqn.params["padding_config"]
+    if any(inner != 0 for _, _, inner in p) or \
+            any(lo < 0 or hi < 0 for lo, hi, _ in p):
+        raise UnsupportedOpError("pad", "interior/negative padding")
+    pads = [lo for lo, _, _ in p] + [hi for _, hi, _ in p]
+    pn = ctx.gb.add_init("pads", np.asarray(pads, np.int64))
+    cv = ctx.name_of(ins[1])
+    return ctx.gb.emit("Pad", [ctx.name_of(ins[0]), pn, cv])
+
+
+def _h_gather(ctx, eqn, ins):
+    """The embedding-lookup shape of lax.gather → ONNX Gather(axis=0)."""
+    p = eqn.params["dimension_numbers"]
+    op_aval = eqn.invars[0].aval
+    idx_aval = eqn.invars[1].aval
+    ss = eqn.params["slice_sizes"]
+    if (tuple(p.collapsed_slice_dims) == (0,)
+            and tuple(p.start_index_map) == (0,)
+            and ss[0] == 1 and tuple(ss[1:]) == tuple(op_aval.shape[1:])):
+        idx = ctx.name_of(ins[1])
+        if idx_aval.shape and idx_aval.shape[-1] == 1:
+            shp = ctx.gb.add_init(
+                "shape", np.asarray(idx_aval.shape[:-1], np.int64))
+            idx = ctx.gb.emit("Reshape", [idx, shp])
+        return ctx.gb.emit("Gather", [ctx.name_of(ins[0]), idx],
+                           attrs=[proto.attribute("axis", i=0)])
+    raise UnsupportedOpError("gather", "general gather (only embedding "
+                             "lookup pattern supported)")
+
+
+def _h_erfc(ctx, eqn, ins):
+    e = ctx.gb.emit("Erf", [ctx.name_of(ins[0])])
+    one = ctx.gb.add_init("one", np.asarray(1.0, np.float32))
+    return ctx.gb.emit("Sub", [one, e])
+
+
+def _h_rsqrt(ctx, eqn, ins):
+    s = ctx.gb.emit("Sqrt", [ctx.name_of(ins[0])])
+    return ctx.gb.emit("Reciprocal", [s])
+
+
+def _h_stop_gradient(ctx, eqn, ins):
+    return ctx.name_of(ins[0])
+
+
+def _h_square(ctx, eqn, ins):
+    x = ctx.name_of(ins[0])
+    return ctx.gb.emit("Mul", [x, x])
+
+
+_HANDLERS = {
+    "add": _ew("Add"), "sub": _ew("Sub"), "mul": _ew("Mul"),
+    "div": _ew("Div"), "max": _ew("Max"), "min": _ew("Min"),
+    "pow": _ew("Pow"), "neg": _ew("Neg"), "exp": _ew("Exp"),
+    "log": _ew("Log"), "tanh": _ew("Tanh"), "logistic": _ew("Sigmoid"),
+    "erf": _ew("Erf"), "erfc": _h_erfc, "sqrt": _ew("Sqrt"),
+    "abs": _ew("Abs"),
+    "sign": _ew("Sign"), "floor": _ew("Floor"), "ceil": _ew("Ceil"),
+    "round": _ew("Round"),
+    "eq": _ew("Equal"), "lt": _ew("Less"), "gt": _ew("Greater"),
+    "le": _ew("LessOrEqual"), "ge": _ew("GreaterOrEqual"),
+    "and": _ew("And"), "or": _ew("Or"), "not": _ew("Not"),
+    "rsqrt": _h_rsqrt, "integer_pow": _h_integer_pow,
+    "square": _h_square,
+    "select_n": _h_select_n, "broadcast_in_dim": _h_broadcast_in_dim,
+    "reshape": _h_reshape, "squeeze": _h_shape_to,
+    "expand_dims": _h_shape_to, "transpose": _h_transpose,
+    "concatenate": _h_concatenate, "slice": _h_slice,
+    "convert_element_type": _h_convert,
+    "reduce_sum": _h_reduce("ReduceSum"),
+    "reduce_max": _h_reduce("ReduceMax"),
+    "reduce_min": _h_reduce("ReduceMin"),
+    "reduce_prod": _h_reduce("ReduceProd"),
+    "dot_general": _h_dot_general,
+    "conv_general_dilated": _h_conv,
+    "reduce_window_max": _h_reduce_window_max,
+    "reduce_window_sum": _h_reduce_window_add,
+    "pad": _h_pad, "gather": _h_gather,
+    "stop_gradient": _h_stop_gradient,
+    "copy": _h_stop_gradient,
+}
+
+_CALL_PRIMS = {"pjit", "closed_call", "custom_jvp_call", "custom_vjp_call",
+               "custom_jvp_call_jaxpr", "remat", "checkpoint",
+               "custom_vjp_call_jaxpr", "jit"}
+
+
+def _walk(ctx, jaxpr, consts, in_vals):
+    for var, c in zip(jaxpr.constvars, consts):
+        ctx.env[var] = ("c", np.asarray(c))
+    for var, v in zip(jaxpr.invars, in_vals):
+        ctx.env[var] = v
+
+    for eqn in jaxpr.eqns:
+        ins = [ctx.read(v) for v in eqn.invars]
+        pname = eqn.primitive.name
+        if _all_const(ins):
+            try:
+                outs = _fold(eqn, ins)
+                for var, o in zip(eqn.outvars, outs):
+                    ctx.env[var] = ("c", o)
+                continue
+            except Exception:
+                pass  # fall through to symbolic emission
+        if pname in _CALL_PRIMS:
+            sub = eqn.params.get("jaxpr") or eqn.params.get("call_jaxpr")
+            jx = sub.jaxpr if hasattr(sub, "jaxpr") else sub
+            consts_sub = sub.consts if hasattr(sub, "consts") else []
+            n_consts = eqn.params.get("num_consts", 0)
+            call_ins = ins
+            if pname.startswith("custom_jvp") or \
+                    pname.startswith("custom_vjp"):
+                call_ins = ins[n_consts:] if n_consts else ins
+            sub_ctx_env = dict(ctx.env)
+            outs = _walk_sub(ctx, jx, consts_sub, call_ins)
+            ctx.env.update(sub_ctx_env)
+            for var, o in zip(eqn.outvars, outs):
+                ctx.env[var] = o
+            continue
+        handler = _HANDLERS.get(pname)
+        if handler is None:
+            raise UnsupportedOpError(pname)
+        if len(eqn.outvars) > 1:
+            raise UnsupportedOpError(pname, "multi-output primitive")
+        out = handler(ctx, eqn, ins)
+        ctx.env[eqn.outvars[0]] = ("n", out)
+    return [ctx.read(v) for v in jaxpr.outvars]
+
+
+def _walk_sub(ctx, jaxpr, consts, in_vals):
+    sub = _Ctx(ctx.gb)
+    sub.env = ctx.env  # share: names/constants remain valid
+    return _walk(sub, jaxpr, consts, in_vals)
+
+
+def export_traced(fn, example_inputs, path, opset_version=13,
+                  input_names=None):
+    """Trace ``fn`` (a Layer or python callable over Tensors) on
+    ``example_inputs`` and write an ONNX model mapping the whole traced
+    graph. Returns the output path."""
+    import jax
+
+    from ..core.tensor import Tensor
+
+    tensors = [x if isinstance(x, Tensor) else Tensor(np.asarray(x))
+               for x in example_inputs]
+
+    def pure(*arrs):
+        from ..core import autograd
+        with autograd.no_grad():
+            outs = fn(*[Tensor(a, stop_gradient=True) for a in arrs])
+        out = outs[0] if isinstance(outs, (list, tuple)) else outs
+        return out._data
+
+    closed = jax.make_jaxpr(pure)(*[t._data for t in tensors])
+
+    gb = _GraphBuilder()
+    ctx = _Ctx(gb)
+    in_names = input_names or [f"input_{i}" for i in range(len(tensors))]
+    in_vals = [("n", nm) for nm in in_names]
+    outs = _walk(ctx, closed.jaxpr, closed.consts, in_vals)
+    out_kind, out_val = outs[0]
+    if out_kind == "c":
+        out_name = gb.add_init("const_out", out_val)
+    else:
+        out_name = out_val
+
+    g_inputs = [proto.value_info(nm, _np_dtype_to_onnx(t._data.dtype),
+                                 list(t.shape))
+                for nm, t in zip(in_names, tensors)]
+    out_aval = closed.jaxpr.outvars[0].aval
+    g_outputs = [proto.value_info(out_name,
+                                  _np_dtype_to_onnx(out_aval.dtype),
+                                  list(out_aval.shape))]
+    g = proto.graph(gb.nodes, "paddle_tpu_traced", gb.inits, g_inputs,
+                    g_outputs)
+    blob = proto.model(g, opset=opset_version)
+    out_path = path if str(path).endswith(".onnx") else str(path) + ".onnx"
+    with open(out_path, "wb") as f:
+        f.write(blob)
+    return out_path

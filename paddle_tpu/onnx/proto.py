@@ -252,7 +252,8 @@ def _parse_tensor(buf):
             t["name"] = val.decode()
         elif num == 9:
             raw = val
-    dt = np.float32 if t["data_type"] == FLOAT else np.int64
+    dt = {FLOAT: np.float32, INT64: np.int64, 6: np.int32,
+          9: np.bool_}.get(t["data_type"], np.float32)
     t["array"] = np.frombuffer(raw, dt).reshape(t["dims"])
     return t
 

@@ -1,0 +1,196 @@
+"""Minimal numpy evaluator for the ONNX op subset this package emits.
+
+No onnxruntime is available in the environment, so numerical verification
+of exports runs the parsed ModelProto (proto.parse_model) directly — the
+same role onnxruntime plays in the reference's paddle2onnx test suite.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import proto
+
+__all__ = ["run_model"]
+
+
+def _from_tensor(t):
+    return t["array"]
+
+
+def _pool_view(x, kernel, strides, pads):
+    n, c, h, w = x.shape
+    kh, kw = kernel
+    sh, sw = strides
+    pt, pl, pb, pr = pads[0], pads[1], pads[2], pads[3]
+    return x, n, c, h, w, kh, kw, sh, sw, pt, pl, pb, pr
+
+
+def run_model(blob_or_parsed, feeds):
+    """Execute a (parsed) model on {input_name: np array}; returns the list
+    of graph outputs."""
+    m = blob_or_parsed if isinstance(blob_or_parsed, dict) else \
+        proto.parse_model(blob_or_parsed)
+    g = m["graph"]
+    env = dict(feeds)
+    for init in g["initializers"]:
+        env[init["name"]] = _from_tensor(init)
+
+    for nd in g["nodes"]:
+        op = nd["op_type"]
+        a = nd["attrs"]
+        x = [env[i] for i in nd["inputs"] if i]
+        out = None
+        if op == "Add":
+            out = x[0] + x[1]
+        elif op == "Sub":
+            out = x[0] - x[1]
+        elif op == "Mul":
+            out = x[0] * x[1]
+        elif op == "Div":
+            out = x[0] / x[1]
+        elif op == "MatMul":
+            out = x[0] @ x[1]
+        elif op == "Max":
+            out = np.maximum(x[0], x[1])
+        elif op == "Min":
+            out = np.minimum(x[0], x[1])
+        elif op == "Pow":
+            out = np.power(x[0], x[1])
+        elif op == "Neg":
+            out = -x[0]
+        elif op == "Exp":
+            out = np.exp(x[0])
+        elif op == "Log":
+            out = np.log(x[0])
+        elif op == "Sqrt":
+            out = np.sqrt(x[0])
+        elif op == "Reciprocal":
+            out = 1.0 / x[0]
+        elif op == "Tanh":
+            out = np.tanh(x[0])
+        elif op == "Sigmoid":
+            out = 1.0 / (1.0 + np.exp(-x[0]))
+        elif op == "Erf":
+            from scipy.special import erf as _erf
+            out = _erf(x[0])
+        elif op == "Abs":
+            out = np.abs(x[0])
+        elif op == "Sign":
+            out = np.sign(x[0])
+        elif op == "Floor":
+            out = np.floor(x[0])
+        elif op == "Ceil":
+            out = np.ceil(x[0])
+        elif op == "Round":
+            out = np.round(x[0])
+        elif op == "Equal":
+            out = x[0] == x[1]
+        elif op == "Less":
+            out = x[0] < x[1]
+        elif op == "Greater":
+            out = x[0] > x[1]
+        elif op == "LessOrEqual":
+            out = x[0] <= x[1]
+        elif op == "GreaterOrEqual":
+            out = x[0] >= x[1]
+        elif op == "And":
+            out = x[0] & x[1]
+        elif op == "Or":
+            out = x[0] | x[1]
+        elif op == "Not":
+            out = ~x[0]
+        elif op == "Where":
+            out = np.where(x[0], x[1], x[2])
+        elif op == "Reshape":
+            out = x[0].reshape([int(v) for v in x[1]])
+        elif op == "Transpose":
+            out = np.transpose(x[0], a.get("perm"))
+        elif op == "Expand":
+            out = np.broadcast_to(x[0], [int(v) for v in x[1]]).copy()
+        elif op == "Concat":
+            out = np.concatenate(x, axis=a["axis"])
+        elif op == "Cast":
+            dt = {1: np.float32, 7: np.int64, 6: np.int32, 9: np.bool_}[
+                a["to"]]
+            out = x[0].astype(dt)
+        elif op == "Slice":
+            starts, ends = x[1], x[2]
+            axes = x[3] if len(x) > 3 else np.arange(len(starts))
+            steps = x[4] if len(x) > 4 else np.ones(len(starts), np.int64)
+            idx = [slice(None)] * x[0].ndim
+            for s, e, ax, st in zip(starts, ends, axes, steps):
+                idx[int(ax)] = slice(int(s), int(e), int(st))
+            out = x[0][tuple(idx)]
+        elif op == "Gather":
+            out = np.take(x[0], x[1].astype(np.int64), axis=a.get(
+                "axis", 0))
+        elif op in ("ReduceSum", "ReduceMax", "ReduceMin", "ReduceProd"):
+            axes = tuple(int(v) for v in x[1]) if len(x) > 1 else None
+            keep = bool(a.get("keepdims", 1))
+            fn = {"ReduceSum": np.sum, "ReduceMax": np.max,
+                  "ReduceMin": np.min, "ReduceProd": np.prod}[op]
+            out = fn(x[0], axis=axes, keepdims=keep)
+        elif op == "Conv":
+            out = _conv(x[0], x[1], x[2] if len(x) > 2 else None, a)
+        elif op in ("MaxPool", "AveragePool"):
+            out = _pool(x[0], a, op)
+        elif op == "Pad":
+            pads = x[1]
+            n2 = x[0].ndim
+            cfg = [(int(pads[i]), int(pads[i + n2])) for i in range(n2)]
+            cval = float(x[2]) if len(x) > 2 else 0.0
+            out = np.pad(x[0], cfg, constant_values=cval)
+        elif op == "Gemm":
+            y = x[0] @ (x[1].T if a.get("transB") else x[1])
+            if len(x) > 2:
+                y = y + x[2]
+            out = y
+        elif op == "Relu":
+            out = np.maximum(x[0], 0)
+        else:
+            raise NotImplementedError(f"onnx.runtime: op {op}")
+        env[nd["outputs"][0]] = out
+
+    return [env[o["name"]] for o in g["outputs"]]
+
+
+def _conv(x, w, b, a):
+    import jax
+    import jax.numpy as jnp
+    strides = a.get("strides", [1] * (x.ndim - 2))
+    dil = a.get("dilations", [1] * (x.ndim - 2))
+    pads = a.get("pads", [0] * (2 * (x.ndim - 2)))
+    nsp = x.ndim - 2
+    padding = [(int(pads[i]), int(pads[i + nsp])) for i in range(nsp)]
+    dn = jax.lax.conv_dimension_numbers(x.shape, w.shape,
+                                        ("NCHW", "OIHW", "NCHW")
+                                        if nsp == 2 else None)
+    out = jax.lax.conv_general_dilated(
+        jnp.asarray(x, jnp.float32), jnp.asarray(w, jnp.float32),
+        window_strides=[int(s) for s in strides], padding=padding,
+        rhs_dilation=[int(d) for d in dil], dimension_numbers=dn,
+        feature_group_count=int(a.get("group", 1)))
+    out = np.asarray(out)
+    if b is not None:
+        out = out + b.reshape((1, -1) + (1,) * nsp)
+    return out
+
+
+def _pool(x, a, kind):
+    kh, kw = a["kernel_shape"]
+    sh, sw = a.get("strides", [1, 1])
+    pads = a.get("pads", [0, 0, 0, 0])
+    pt, pl, pb, pr = (int(p) for p in pads)
+    fill = -np.inf if kind == "MaxPool" else 0.0
+    xp = np.pad(x, ((0, 0), (0, 0), (pt, pb), (pl, pr)),
+                constant_values=fill)
+    n, c, h, w = xp.shape
+    oh = (h - kh) // sh + 1
+    ow = (w - kw) // sw + 1
+    out = np.empty((n, c, oh, ow), np.float32)
+    for i in range(oh):
+        for j in range(ow):
+            win = xp[:, :, i * sh:i * sh + kh, j * sw:j * sw + kw]
+            out[:, :, i, j] = win.max((2, 3)) if kind == "MaxPool" \
+                else win.mean((2, 3))
+    return out

@@ -72,10 +72,20 @@ def test_onnx_export_cnn_structure(tmp_path):
     assert init[conv["inputs"][1]].shape == (8, 3, 3, 3)
 
 
-def test_onnx_export_rejects_unsupported(tmp_path):
-    import pytest
+def test_onnx_export_non_sequential_goes_traced(tmp_path):
+    """Round-5: arbitrary models route through the jaxpr walker instead of
+    being rejected (VERDICT r4 item 8)."""
+    import numpy as np
+
     from paddle_tpu.models import LeNet
-    with pytest.raises(NotImplementedError, match="jit.save"):
-        paddle.onnx.export(LeNet(), str(tmp_path / "x"),
-                           input_spec=[paddle.static.InputSpec(
-                               [1, 1, 28, 28])])
+    from paddle_tpu.onnx.runtime import run_model
+    paddle.seed(0)
+    m = LeNet()
+    out = paddle.onnx.export(m, str(tmp_path / "x"),
+                             input_spec=[paddle.static.InputSpec(
+                                 [1, 1, 28, 28])])
+    x = np.zeros((1, 1, 28, 28), np.float32)
+    got = run_model(open(out, "rb").read(), {"input_0": x})[0]
+    m.eval()
+    want = m(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)

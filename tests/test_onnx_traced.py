@@ -1,0 +1,117 @@
+"""ONNX export for arbitrary traced models (VERDICT r4 item 8; reference:
+python/paddle/onnx/export.py via paddle2onnx). Exports are parsed by the
+package's own proto reader and numerically verified with the numpy ONNX
+evaluator (no onnxruntime in the environment)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.onnx import proto
+from paddle_tpu.onnx.jaxpr_export import UnsupportedOpError, export_traced
+from paddle_tpu.onnx.runtime import run_model
+
+
+def _verify(model, example, path, rtol=1e-3, atol=1e-4):
+    model.eval()
+    p = export_traced(model, [example], str(path))
+    blob = open(p, "rb").read()
+    parsed = proto.parse_model(blob)
+    assert parsed["graph"]["nodes"], "empty graph"
+    got = run_model(parsed, {"input_0": np.asarray(example.numpy())})[0]
+    want = model(example)
+    want = (want[0] if isinstance(want, (list, tuple)) else want).numpy()
+    np.testing.assert_allclose(got, want, rtol=rtol, atol=atol)
+    return parsed
+
+
+def test_mlp_with_gelu_layernorm(tmp_path):
+    paddle.seed(0)
+    m = nn.Sequential(nn.Linear(4, 8), nn.GELU(), nn.LayerNorm(8),
+                      nn.Linear(8, 2))
+    x = paddle.to_tensor(np.random.RandomState(0).randn(3, 4)
+                         .astype("float32"))
+    # non-Sequential path: wrap so export_traced (not the layer emitter)
+    # handles it
+
+    class Wrap(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.m = m
+
+        def forward(self, x):
+            return self.m(x)
+
+    _verify(Wrap(), x, tmp_path / "mlp.onnx")
+
+
+def test_resnet18_export_verified(tmp_path):
+    from paddle_tpu.models import resnet18
+    paddle.seed(1)
+    m = resnet18(num_classes=10)
+    x = paddle.to_tensor(np.random.RandomState(1).randn(1, 3, 32, 32)
+                         .astype("float32"))
+    parsed = _verify(m, x, tmp_path / "resnet18.onnx")
+    ops = {n["op_type"] for n in parsed["graph"]["nodes"]}
+    assert "Conv" in ops and "MaxPool" in ops
+
+
+def test_bert_tiny_export_verified(tmp_path):
+    from paddle_tpu.models.bert import BertConfig, BertModel
+    paddle.seed(2)
+    cfg = BertConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                     num_heads=2, max_seq_len=16, intermediate_size=64,
+                     dropout=0.0)
+    m = BertModel(cfg)
+    ids = paddle.to_tensor(np.random.RandomState(2)
+                           .randint(0, 64, (2, 16)).astype("int32"))
+    parsed = _verify(m, ids, tmp_path / "bert.onnx")
+    ops = {n["op_type"] for n in parsed["graph"]["nodes"]}
+    assert "MatMul" in ops and "Gather" in ops  # attention + embedding
+
+
+def test_public_export_routes_arbitrary_models(tmp_path):
+    """paddle.onnx.export now accepts any traceable Layer."""
+    from paddle_tpu.models import resnet18
+    paddle.seed(3)
+    m = resnet18(num_classes=4)
+    x = paddle.to_tensor(np.random.RandomState(3).randn(1, 3, 32, 32)
+                         .astype("float32"))
+    out = paddle.onnx.export(m, str(tmp_path / "via_public"),
+                             input_spec=[x])
+    assert out.endswith(".onnx")
+    got = run_model(open(out, "rb").read(), {"input_0": x.numpy()})[0]
+    m.eval()
+    np.testing.assert_allclose(got, m(x).numpy(), rtol=1e-3, atol=1e-4)
+
+
+def test_unsupported_op_names_the_primitive(tmp_path):
+    class Sorter(nn.Layer):
+        def forward(self, x):
+            return paddle.sort(x, axis=-1)
+
+    x = paddle.to_tensor(np.random.RandomState(4).randn(2, 5)
+                         .astype("float32"))
+    with pytest.raises(NotImplementedError, match="sort"):
+        export_traced(Sorter(), [x], str(tmp_path / "bad.onnx"))
+
+
+def test_constant_folding_bakes_masks(tmp_path):
+    """Causal masks / position ids fold into initializers, not ops."""
+    class Masked(nn.Layer):
+        def forward(self, x):
+            import paddle_tpu as p
+            S = x.shape[-1]
+            mask = p.tril(p.ones([S, S]))
+            return x.matmul(mask)
+
+    x = paddle.to_tensor(np.random.RandomState(5).randn(2, 6)
+                         .astype("float32"))
+    m = Masked()
+    p = export_traced(m, [x], str(tmp_path / "mask.onnx"))
+    parsed = proto.parse_model(open(p, "rb").read())
+    ops = [n["op_type"] for n in parsed["graph"]["nodes"]]
+    # no ops to build the mask — only the matmul chain remains
+    assert ops.count("Where") == 0
+    got = run_model(parsed, {"input_0": x.numpy()})[0]
+    np.testing.assert_allclose(got, m(x).numpy(), rtol=1e-5)

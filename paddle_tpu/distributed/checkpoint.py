@@ -140,6 +140,13 @@ def load_state_dict(state_dict, path, process_group=None,
                 global_np[()] = arr
         if isinstance(target, Tensor):
             new = jnp.asarray(global_np).astype(target._data.dtype)
-            # reshard onto the target's current placement
-            target._data = jax.device_put(new, target._data.sharding)
+            sh = getattr(target._data, "sharding", None)
+            if sh is not None and hasattr(sh, "mesh"):
+                # reshard onto the target's mesh placement
+                target._data = jax.device_put(new, sh)
+            else:
+                # single-device target: keep the loaded array UNcommitted —
+                # an explicit SingleDeviceSharding would pin it and clash
+                # with mesh-sharded peers inside one jitted step
+                target._data = new
     return state_dict

@@ -1,0 +1,193 @@
+"""Distributed program-rewrite passes (pass-pipeline analog).
+
+Reference: python/paddle/distributed/passes/ — ``new_pass(name, attrs)``
+builds a registered pass; ``pass.apply([main_prog], [startup_prog], ctx)``
+rewrites the static programs (auto_parallel_amp.py,
+auto_parallel_gradient_merge.py, fusion passes ...). TPU-native: most
+reference passes collapse into XLA (fusion, sharding insertion), so the
+pipeline here carries the ones with *semantic* effect on our lazy-DAG
+``static.Program``: AMP compute-dtype rewriting, gradient merge
+(k-step accumulation), and matmul+add fusion as the representative
+DAG-rewrite pass.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["new_pass", "PassManager", "PassContext", "register_pass"]
+
+_REGISTRY: dict = {}
+
+
+class PassContext:
+    def __init__(self):
+        self.attrs = {}
+
+
+def register_pass(name):
+    def deco(cls):
+        _REGISTRY[name] = cls
+        cls.name = name
+        return cls
+    return deco
+
+
+def new_pass(name, pass_attrs=None):
+    """Reference: distributed/passes/pass_base.py new_pass."""
+    try:
+        cls = _REGISTRY[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown pass '{name}'; registered: {sorted(_REGISTRY)}"
+        ) from None
+    return cls(pass_attrs or {})
+
+
+class _PassBase:
+    def __init__(self, attrs):
+        self.attrs = dict(attrs)
+
+    def apply(self, main_programs, startup_programs=None, context=None):
+        if not isinstance(main_programs, (list, tuple)):
+            main_programs = [main_programs]
+        for prog in main_programs:
+            self._apply_single(prog, context or PassContext())
+        return context
+
+    def _apply_single(self, prog, ctx):
+        raise NotImplementedError
+
+
+class PassManager:
+    """Reference: pass_base.py PassManager — ordered pass application."""
+
+    def __init__(self, passes):
+        self._passes = list(passes)
+
+    def apply(self, main_programs, startup_programs=None):
+        ctx = PassContext()
+        for p in self._passes:
+            p.apply(main_programs, startup_programs, ctx)
+        return ctx
+
+    @property
+    def names(self):
+        return [p.name for p in self._passes]
+
+
+def _op_nodes(prog):
+    from ..static.program import Variable
+    return [v for v in prog.vars
+            if isinstance(v, Variable) and v._op is not None]
+
+
+@register_pass("auto_parallel_amp")
+class _AmpPass(_PassBase):
+    """Rewrite compute-heavy nodes to run in bf16 with f32 outputs
+    (reference: passes/auto_parallel_amp.py white-list rewriting; the
+    cast-insertion becomes an fwd wrapper on the DAG node)."""
+
+    WHITELIST = ("matmul", "mm", "bmm", "conv2d", "linear", "einsum")
+
+    def _apply_single(self, prog, ctx):
+        import jax.numpy as jnp
+        dtype = jnp.bfloat16 if self.attrs.get("dtype", "bfloat16") == \
+            "bfloat16" else jnp.float16
+
+        def wrap(fwd):
+            def amp_fwd(*arrs):
+                cast = [a.astype(dtype)
+                        if hasattr(a, "dtype") and
+                        jnp.issubdtype(a.dtype, jnp.floating) else a
+                        for a in arrs]
+                out = fwd(*cast)
+                if isinstance(out, tuple):
+                    return tuple(o.astype(jnp.float32) for o in out)
+                return out.astype(jnp.float32)
+            return amp_fwd
+
+        n = 0
+        for v in _op_nodes(prog):
+            name, fwd, nout = v._op
+            if name in self.WHITELIST and not name.startswith("amp@"):
+                new_op = (f"amp@{name}", wrap(fwd), nout)
+                for sib in _op_nodes(prog):
+                    if sib._op is v._op:
+                        sib._op = new_op
+                n += 1
+        ctx.attrs["amp_rewritten"] = ctx.attrs.get("amp_rewritten", 0) + n
+
+
+@register_pass("auto_parallel_gradient_merge")
+class _GradientMergePass(_PassBase):
+    """k-step gradient accumulation before each optimizer update
+    (reference: passes/auto_parallel_gradient_merge.py — the program
+    rewrite becomes a wrapper over the program's minimize ops)."""
+
+    def _apply_single(self, prog, ctx):
+        k = int(self.attrs.get("k_steps", 2))
+        avg = bool(self.attrs.get("avg", True))
+        merged = []
+        for opt, loss in prog.minimize_ops:
+            merged.append((_MergedOptimizer(opt, k, avg), loss))
+        prog.minimize_ops[:] = merged
+        ctx.attrs["gradient_merge_k"] = k
+
+
+class _MergedOptimizer:
+    def __init__(self, inner, k, avg):
+        self._inner = inner
+        self._k = k
+        self._avg = avg
+        self._count = 0
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def step(self):
+        self._count += 1
+        if self._count % self._k != 0:
+            return  # keep accumulating — grads stay on the params
+        if self._avg:
+            for p in self._inner._parameter_list:
+                if p._grad is not None:
+                    p._grad = p._grad / self._k
+        self._inner.step()
+
+    def clear_grad(self, *a, **k):
+        if self._count % self._k != 0:
+            return  # NOT a real boundary: keep accumulated grads
+        self._inner.clear_grad(*a, **k)
+
+
+@register_pass("fused_linear")
+class _FusedLinearPass(_PassBase):
+    """Fuse matmul+add chains into one DAG node (reference:
+    passes/fuse pattern rewrites; the representative fusion on the lazy
+    DAG — XLA fuses the rest after staging)."""
+
+    def _apply_single(self, prog, ctx):
+        from ..static.program import Variable
+        fused = 0
+        for v in _op_nodes(prog):
+            name, fwd, nout = v._op
+            if name != "add" or len(v._ins) != 2:
+                continue
+            lhs = v._ins[0]
+            if not (isinstance(lhs, Variable) and lhs._op is not None
+                    and lhs._op[0] in ("matmul", "mm")):
+                continue
+            users = [u for u in _op_nodes(prog)
+                     if any(i is lhs for i in u._ins)]
+            if len(users) != 1:  # matmul output used elsewhere: keep
+                continue
+            mm_fwd = lhs._op[1]
+
+            def fused_fwd(a, b, bias, _mm=mm_fwd):
+                return _mm(a, b) + bias
+
+            v._op = ("fused_matmul_add", fused_fwd, 1)
+            v._ins = list(lhs._ins) + [v._ins[1]]
+            fused += 1
+        ctx.attrs["fused_linear"] = ctx.attrs.get("fused_linear", 0) + \
+            fused

@@ -25,6 +25,11 @@ __all__ = ["SparseTable", "PSServer", "PSClient", "start_server",
 _tables: dict = {}
 
 
+from .tables import (  # noqa: F401
+    Accessor, AdagradAccessor, CtrAccessor, SGDAccessor, SSDSparseTable,
+)
+
+
 class SparseTable:
     """Server-side sparse table (reference: ps/table/memory_sparse_table).
     Rows are created on first touch with the configured initializer."""

@@ -1,0 +1,283 @@
+"""PS table zoo: disk-backed sparse tables + accessors.
+
+Reference: paddle/fluid/distributed/ps/table/ — ``memory_sparse_table``
+(in ps/__init__.py here), ``ssd_sparse_table.cc`` (rocksdb-backed rows
+with a hot in-memory cache) and the accessor zoo
+(``ctr_accessor.cc``/``sparse_accessor.cc``: per-row layout + update rule
++ admission/eviction policy). TPU-native mapping: the table lives on the
+host PS process either way (sparse side never touches the chip); rocksdb
+becomes sqlite3 (in-box, crash-safe, ordered scans) with the same
+hot-cache + spill design.
+"""
+from __future__ import annotations
+
+import os
+import sqlite3
+import threading
+from collections import OrderedDict
+
+import numpy as np
+
+__all__ = ["Accessor", "SGDAccessor", "AdagradAccessor", "CtrAccessor",
+           "SSDSparseTable"]
+
+
+class Accessor:
+    """Per-row layout + update rule (reference: ps/table/accessor.h).
+
+    ``width`` counts the FULL stored row: embedding dim + any optimizer /
+    statistics columns the accessor keeps alongside it."""
+
+    def __init__(self, dim, lr=0.1, init_std=0.01):
+        self.dim = dim
+        self.lr = lr
+        self.init_std = init_std
+
+    @property
+    def width(self):
+        return self.dim
+
+    def create(self, rng):
+        return (rng.randn(self.width) * self.init_std).astype(np.float32)
+
+    def embedding(self, row):
+        return row[:self.dim]
+
+    def update(self, row, grad, lr=None):
+        row[:self.dim] -= (self.lr if lr is None else lr) * grad
+
+    def admit(self, entry=None, stats=None):
+        return True
+
+    def should_evict(self, row):
+        return False
+
+
+class SGDAccessor(Accessor):
+    """Plain SGD rows (reference: sparse_sgd_rule.cc naive rule)."""
+
+
+class AdagradAccessor(Accessor):
+    """Embedding + per-row g2sum column (reference: sparse_sgd_rule.cc
+    SparseAdaGradSGDRule — the classic PS adagrad)."""
+
+    def __init__(self, dim, lr=0.1, init_std=0.01, epsilon=1e-8):
+        super().__init__(dim, lr, init_std)
+        self.epsilon = epsilon
+
+    @property
+    def width(self):
+        return self.dim + 1  # trailing g2sum
+
+    def create(self, rng):
+        row = np.zeros(self.width, np.float32)
+        row[:self.dim] = rng.randn(self.dim) * self.init_std
+        return row
+
+    def update(self, row, grad, lr=None):
+        g = np.asarray(grad, np.float32)
+        row[self.dim] += float(g @ g) / self.dim
+        scale = (self.lr if lr is None else lr) / (
+            np.sqrt(row[self.dim]) + self.epsilon)
+        row[:self.dim] -= scale * g
+
+
+class CtrAccessor(AdagradAccessor):
+    """CTR rows: [show, click, g2sum, embedding] with show/click decay and
+    count-based admission/eviction (reference: ctr_accessor.cc)."""
+
+    def __init__(self, dim, lr=0.1, init_std=0.01, epsilon=1e-8,
+                 show_decay=0.98, admit_threshold=0.0,
+                 delete_threshold=0.8):
+        super().__init__(dim, lr, init_std, epsilon)
+        self.show_decay = show_decay
+        self.admit_threshold = admit_threshold
+        self.delete_threshold = delete_threshold
+
+    @property
+    def width(self):
+        return self.dim + 3  # show, click, g2sum + embedding
+
+    def create(self, rng):
+        row = np.zeros(self.width, np.float32)
+        row[3:] = rng.randn(self.dim) * self.init_std
+        return row
+
+    def embedding(self, row):
+        return row[3:]
+
+    def add_show_click(self, row, show=1.0, click=0.0):
+        row[0] += show
+        row[1] += click
+
+    def decay(self, row):
+        row[0] *= self.show_decay
+        row[1] *= self.show_decay
+
+    def update(self, row, grad, lr=None):
+        g = np.asarray(grad, np.float32)
+        row[2] += float(g @ g) / self.dim
+        scale = (self.lr if lr is None else lr) / (np.sqrt(row[2])
+                                                   + self.epsilon)
+        row[3:] -= scale * g
+
+    def admit(self, entry=None, stats=None):
+        """CountFilterEntry/ProbabilityEntry gate feature creation
+        (reference: DownpourCtrAccessor NeedCreate + entry configs)."""
+        if entry is None:
+            return True
+        kind = getattr(entry, "kind", None)
+        if kind == "count_filter_entry":
+            return (stats or 0) >= entry.args[0]
+        if kind == "probability_entry":
+            return np.random.rand() < entry.args[0]
+        return True
+
+    def should_evict(self, row):
+        return row[0] < self.delete_threshold
+
+
+class SSDSparseTable:
+    """Disk-backed sparse table with a hot-row cache (reference:
+    ps/table/ssd_sparse_table.cc — rocksdb rows + memory cache; sqlite3
+    plays rocksdb's role here). Cold rows spill to disk on LRU eviction;
+    pull/push touch the cache and fault rows in from disk."""
+
+    def __init__(self, name, dim, path=None, cache_rows=4096,
+                 accessor=None, entry=None, seed=0, lr=0.1):
+        self.name = name
+        self.accessor = accessor or SGDAccessor(dim, lr=lr)
+        self.dim = dim
+        self.entry = entry
+        self._rng = np.random.RandomState(seed)
+        self._cache: "OrderedDict[int, np.ndarray]" = OrderedDict()
+        self._cache_rows = cache_rows
+        self._touch_counts: dict = {}
+        self._lock = threading.RLock()
+        self._path = path or f"/tmp/pt_ssd_table_{name}_{os.getpid()}.db"
+        self._db = sqlite3.connect(self._path, check_same_thread=False)
+        self._db.execute(
+            "CREATE TABLE IF NOT EXISTS rows (id INTEGER PRIMARY KEY, "
+            "val BLOB)")
+
+    # -- storage plumbing -------------------------------------------------
+    def _disk_get(self, rid):
+        cur = self._db.execute("SELECT val FROM rows WHERE id=?", (rid,))
+        hit = cur.fetchone()
+        if hit is None:
+            return None
+        return np.frombuffer(hit[0], np.float32).copy()
+
+    def _disk_put(self, rid, row):
+        self._db.execute(
+            "INSERT OR REPLACE INTO rows (id, val) VALUES (?, ?)",
+            (rid, row.astype(np.float32).tobytes()))
+
+    def _evict_cold(self):
+        while len(self._cache) > self._cache_rows:
+            rid, row = self._cache.popitem(last=False)  # LRU front
+            self._disk_put(rid, row)
+        self._db.commit()
+
+    def _row(self, rid, create=True):
+        rid = int(rid)
+        row = self._cache.get(rid)
+        if row is not None:
+            self._cache.move_to_end(rid)
+            return row
+        row = self._disk_get(rid)
+        if row is None:
+            if not create:
+                return None
+            n = self._touch_counts.get(rid, 0) + 1
+            self._touch_counts[rid] = n
+            if not self.accessor.admit(self.entry, n):
+                return None  # not admitted yet (CountFilter/Probability)
+            row = self.accessor.create(self._rng)
+        self._cache[rid] = row
+        self._evict_cold()
+        return row
+
+    # -- table API (reference memory_sparse_table surface) ----------------
+    def pull(self, ids):
+        with self._lock:
+            out = np.zeros((len(ids), self.dim), np.float32)
+            for k, i in enumerate(ids):
+                row = self._row(i)
+                if row is not None:
+                    out[k] = self.accessor.embedding(row)
+            return out
+
+    def push_grad(self, ids, grads, lr=None):
+        with self._lock:
+            for i, g in zip(ids, grads):
+                row = self._row(i)
+                if row is not None:
+                    self.accessor.update(row, np.asarray(g, np.float32),
+                                         lr)
+
+    def push_show_click(self, ids, shows=None, clicks=None):
+        if not isinstance(self.accessor, CtrAccessor):
+            raise TypeError("push_show_click needs a CtrAccessor table")
+        with self._lock:
+            for k, i in enumerate(ids):
+                row = self._row(i)
+                if row is not None:
+                    self.accessor.add_show_click(
+                        row, 1.0 if shows is None else shows[k],
+                        0.0 if clicks is None else clicks[k])
+
+    def shrink(self):
+        """Evict under-threshold rows (reference: Table::Shrink)."""
+        with self._lock:
+            self._flush_cache()
+            dead = []
+            for rid, blob in self._db.execute(
+                    "SELECT id, val FROM rows"):
+                row = np.frombuffer(blob, np.float32)
+                if self.accessor.should_evict(row):
+                    dead.append(rid)
+            for rid in dead:
+                self._db.execute("DELETE FROM rows WHERE id=?", (rid,))
+            self._db.commit()
+            return len(dead)
+
+    def _flush_cache(self):
+        for rid, row in self._cache.items():
+            self._disk_put(rid, row)
+        self._db.commit()
+        self._cache.clear()
+
+    def save(self, path):
+        with self._lock:
+            self._flush_cache()
+            ids, vals = [], []
+            for rid, blob in self._db.execute(
+                    "SELECT id, val FROM rows ORDER BY id"):
+                ids.append(rid)
+                vals.append(np.frombuffer(blob, np.float32))
+            np.savez(path, ids=np.asarray(ids, np.int64),
+                     vals=np.stack(vals) if vals else
+                     np.zeros((0, self.accessor.width), np.float32))
+
+    def load(self, path):
+        with self._lock:
+            z = np.load(path if str(path).endswith(".npz")
+                        else str(path) + ".npz")
+            for rid, val in zip(z["ids"], z["vals"]):
+                self._disk_put(int(rid), val)
+            self._db.commit()
+            self._cache.clear()
+
+    def state(self):
+        with self._lock:
+            n_disk = self._db.execute(
+                "SELECT COUNT(*) FROM rows").fetchone()[0]
+            return {"n_rows_cache": len(self._cache),
+                    "n_rows_disk": int(n_disk), "dim": self.dim,
+                    "accessor": type(self.accessor).__name__}
+
+    def close(self):
+        with self._lock:
+            self._flush_cache()
+            self._db.close()

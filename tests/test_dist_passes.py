@@ -1,0 +1,101 @@
+"""Program-rewrite pass pipeline (reference: python/paddle/distributed/
+passes/ — new_pass/PassManager + amp / gradient-merge / fusion passes)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.passes import PassManager, new_pass
+
+
+def _build_linear_prog():
+    paddle.enable_static()
+    try:
+        main = paddle.static.Program()
+        startup = paddle.static.Program()
+        with paddle.static.program_guard(main, startup):
+            x = paddle.static.data("x", [4, 3], "float32")
+            w = paddle.create_parameter([3, 2], "float32")
+            b = paddle.create_parameter([2], "float32")
+            y = paddle.add(paddle.matmul(x, w), b)
+        return main, startup, x, w, b, y
+    finally:
+        paddle.disable_static()
+
+
+def test_unknown_pass_rejected():
+    with pytest.raises(ValueError, match="unknown pass"):
+        new_pass("no_such_pass")
+
+
+def test_fused_linear_pass_rewrites_dag():
+    main, startup, x, w, b, y = _build_linear_prog()
+    ctx = new_pass("fused_linear").apply([main], [startup])
+    assert y._op[0] == "fused_matmul_add"
+    assert len(y._ins) == 3
+    paddle.enable_static()
+    try:
+        exe = paddle.static.Executor()
+        out = exe.run(main, feed={"x": np.ones((4, 3), np.float32)},
+                      fetch_list=[y])[0]
+    finally:
+        paddle.disable_static()
+    want = np.ones((4, 3)) @ np.asarray(w._data) + np.asarray(b._data)
+    np.testing.assert_allclose(out, want, rtol=1e-5)
+
+
+def test_amp_pass_runs_matmul_in_bf16():
+    main, startup, x, w, b, y = _build_linear_prog()
+    new_pass("auto_parallel_amp").apply([main])
+    mm = y._ins[0]
+    assert mm._op[0] == "amp@matmul"
+    paddle.enable_static()
+    try:
+        exe = paddle.static.Executor()
+        feed = np.full((4, 3), 1.001, np.float32)
+        out = exe.run(main, feed={"x": feed}, fetch_list=[y])[0]
+    finally:
+        paddle.disable_static()
+    # bf16 rounding is visible vs the f32 product
+    import jax.numpy as jnp
+    want_bf16 = np.asarray(
+        (jnp.asarray(feed, jnp.bfloat16)
+         @ jnp.asarray(w._data, jnp.bfloat16)).astype(jnp.float32)) \
+        + np.asarray(b._data)
+    np.testing.assert_allclose(out, want_bf16, rtol=1e-6)
+    f32 = feed @ np.asarray(w._data) + np.asarray(b._data)
+    assert not np.allclose(out, f32, rtol=0, atol=0)  # genuinely bf16
+
+
+def test_gradient_merge_pass_accumulates_k_steps():
+    paddle.enable_static()
+    try:
+        main = paddle.static.Program()
+        startup = paddle.static.Program()
+        with paddle.static.program_guard(main, startup):
+            x = paddle.static.data("x", [2, 3], "float32")
+            w = paddle.create_parameter([3, 1], "float32")
+            loss = paddle.matmul(x, w).sum()
+            opt = paddle.optimizer.SGD(learning_rate=0.1)
+            opt.minimize(loss)
+        new_pass("auto_parallel_gradient_merge",
+                 {"k_steps": 2, "avg": True}).apply([main])
+        exe = paddle.static.Executor()
+        w0 = np.asarray(w._data).copy()
+        feed = {"x": np.ones((2, 3), np.float32)}
+        exe.run(main, feed=feed)           # step 1: accumulate only
+        np.testing.assert_allclose(np.asarray(w._data), w0)
+        exe.run(main, feed=feed)           # step 2: apply averaged grad
+        g = np.full((3, 1), 2.0)           # d(sum(xw))/dw = col-sums = 2
+        np.testing.assert_allclose(np.asarray(w._data), w0 - 0.1 * g,
+                                   rtol=1e-5)
+    finally:
+        paddle.disable_static()
+
+
+def test_pass_manager_chains():
+    main, startup, x, w, b, y = _build_linear_prog()
+    pm = PassManager([new_pass("fused_linear"),
+                      new_pass("auto_parallel_amp")])
+    ctx = pm.apply([main])
+    assert pm.names == ["fused_linear", "auto_parallel_amp"]
+    assert ctx.attrs.get("fused_linear") == 1

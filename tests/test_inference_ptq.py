@@ -76,3 +76,44 @@ def test_observers_produce_sane_scales(cls):
     s = obs.scale()
     # |x| ~ N(0,1): absmax-family scales land in (absmax/127-ish) range
     assert 1e-4 < s < 0.2, (cls.__name__, s)
+
+
+def test_batching_predictor_dynamic_batching(tmp_path):
+    """Serving-side dynamic batching (SURVEY layer 11): concurrent
+    single-example requests are grouped, padded to a bucket, executed as
+    one compiled call, and each caller gets its own row back."""
+    import threading
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.inference import BatchingPredictor, Predictor
+
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(4, 3), nn.ReLU(), nn.Linear(3, 2))
+    path = str(tmp_path / "serve")
+    paddle.jit.save(model, path,
+                    input_spec=[paddle.static.InputSpec([4, 4],
+                                                        "float32")])
+    # one bucket = the saved static batch shape (XLA static-shape serving)
+    bp = BatchingPredictor(Predictor(path), max_batch_size=4,
+                           max_wait_ms=30.0, batch_buckets=[4])
+    rng = np.random.RandomState(0)
+    examples = [rng.randn(4).astype("float32") for _ in range(6)]
+    results = [None] * 6
+
+    def call(i):
+        results[i] = bp.predict(examples[i])
+
+    threads = [threading.Thread(target=call, args=(i,)) for i in range(6)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=30)
+    model.eval()
+    want = model(paddle.to_tensor(np.stack(examples))).numpy()
+    for i in range(6):
+        np.testing.assert_allclose(results[i], want[i], rtol=1e-4,
+                                   atol=1e-5, err_msg=f"req {i}")
+    bp.close()

@@ -38,8 +38,14 @@ def _flatten(d, prefix=""):
 
 
 def save_state_dict(state_dict, path, process_group=None,
-                    coordinator_rank=0, unique_id=None):
-    """Reference: distributed/checkpoint/save_state_dict.py:77."""
+                    coordinator_rank=0, unique_id=None, async_save=False):
+    """Reference: distributed/checkpoint/save_state_dict.py:77.
+
+    ``async_save=True`` hands the serialized shard + metadata files to the
+    native C++ IO worker pool (core/native/ckpt_io.cpp): device buffers
+    are snapshotted synchronously (cheap D2H), disk IO runs off-thread
+    with fsync + atomic rename, and the returned handle's ``wait()``
+    blocks until the snapshot is durable."""
     os.makedirs(path, exist_ok=True)
     flat = _flatten(state_dict)
     rank = jax.process_index()
@@ -78,9 +84,24 @@ def save_state_dict(state_dict, path, process_group=None,
                 {"key": key, "file": shard_file,
                  "index": tuple((0, d) for d in arr.shape)})
         metadata["state"][name] = entry
+    if async_save:
+        import io as _io
+
+        from .ckpt_io import AsyncCheckpointWriter
+        buf = _io.BytesIO()
+        np.savez(buf, **shards)
+        # ONE worker => strict FIFO: the shard file is durable (renamed)
+        # before the metadata that references it starts — a crash between
+        # the two can't publish new metadata over an old shard
+        writer = AsyncCheckpointWriter(n_threads=1)
+        writer.submit(os.path.join(path, shard_file), buf.getbuffer())
+        writer.submit(os.path.join(path, f"metadata_{rank}.pkl"),
+                      pickle.dumps(metadata, protocol=4))
+        return writer
     np.savez(os.path.join(path, shard_file), **shards)
     with open(os.path.join(path, f"metadata_{rank}.pkl"), "wb") as f:
         pickle.dump(metadata, f, protocol=4)
+    return None
 
 
 def load_state_dict(state_dict, path, process_group=None,

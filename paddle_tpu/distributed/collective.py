@@ -298,3 +298,40 @@ def barrier(group=None):
     arr = place_global(np.ones((g.nranks, 1), np.float32),
                        NamedSharding(g.mesh, spec))
     _rankdim_op(g, lambda x: jax.lax.psum(x, g.axis), arr).block_until_ready()
+
+
+def all_reduce_quantized(tensor, group=None, bits=8, sync_op=True):
+    """Quantized all-reduce (EQuARX, arxiv 2506.17615): trade a little
+    gradient precision for ~4x less ICI wire volume (f32 -> int8 payload
+    plus one scale per rank). Per-rank blocks are symmetric-scale int8
+    quantized, exchanged, dequantized and summed — all inside ONE
+    compiled shard_map program so XLA schedules the collective on ICI
+    like any other.
+
+    Semantics: approximate SUM all-reduce (rtol ~ 1/2^(bits-1) per rank
+    contribution). In-place like :func:`all_reduce`. Only bits=8 is
+    supported: int4 would need nibble packing to actually halve the wire
+    volume again, and without it lower bits only add error."""
+    if bits != 8:
+        raise ValueError(f"all_reduce_quantized supports bits=8 only "
+                         f"(int4 without nibble packing saves no "
+                         f"bandwidth), got {bits}")
+    g = _as_group(group)
+    arr = _placed(tensor._data, g)
+    qmax = float(2 ** (bits - 1) - 1)
+
+    def f(x):
+        # x: this rank's block [1, ...]. Symmetric per-rank scale.
+        scale = jnp.max(jnp.abs(x)) / qmax
+        safe = jnp.where(scale > 0, scale, 1.0)
+        q = jnp.clip(jnp.round(x / safe), -qmax, qmax).astype(jnp.int8)
+        # wire exchange: int8 payload + one f32 scale per rank
+        qs = jax.lax.all_gather(q, g.axis)          # [N, 1, ...] int8
+        ss = jax.lax.all_gather(safe, g.axis)       # [N]
+        deq = qs.astype(jnp.float32) * ss.reshape(
+            (-1,) + (1,) * (qs.ndim - 1))
+        return jnp.sum(deq, axis=0).astype(x.dtype)
+
+    out = _rankdim_op(g, f, arr)
+    tensor._data = out
+    return tensor

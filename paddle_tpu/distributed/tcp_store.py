@@ -19,45 +19,13 @@ _LIB = None
 _LIB_LOCK = threading.Lock()
 
 
-def _native_dir():
-    return os.path.join(os.path.dirname(os.path.dirname(__file__)), "core",
-                        "native")
-
-
 def _load_lib():
     global _LIB
     with _LIB_LOCK:
         if _LIB is not None:
             return _LIB
-        src = os.path.join(_native_dir(), "tcp_store.cpp")
-        build_dir = os.path.join(_native_dir(), "build")
-        os.makedirs(build_dir, exist_ok=True)
-        # Key the build artifact on the source content hash (mtimes are
-        # meaningless after a fresh clone), so the reviewed .cpp is always
-        # what gets dlopen'ed.
-        import hashlib
-        with open(src, "rb") as f:
-            digest = hashlib.sha256(f.read()).hexdigest()[:16]
-        so = os.path.join(build_dir, f"libpd_tcp_store-{digest}.so")
-        if not os.path.exists(so):
-            # drop stale digests so build/ stays bounded across revisions
-            import glob
-            for old in glob.glob(
-                    os.path.join(build_dir, "libpd_tcp_store-*.so")):
-                if old == so:
-                    continue  # another rank may have just built it
-                try:
-                    os.unlink(old)
-                except OSError:
-                    pass
-            # per-process tmp name: ranks of a multi-process launch may all
-            # hit the cold-build path at once, and os.replace is atomic
-            tmp = f"{so}.{os.getpid()}.tmp"
-            cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
-                   "-pthread", src, "-o", tmp]
-            subprocess.run(cmd, check=True, capture_output=True)
-            os.replace(tmp, so)
-        lib = ctypes.CDLL(so)
+        from ..core.native_build import load_native_lib
+        lib = load_native_lib("tcp_store.cpp", "libpd_tcp_store")
         lib.pd_store_server_start.restype = ctypes.c_void_p
         lib.pd_store_server_start.argtypes = [ctypes.c_int]
         lib.pd_store_server_stop.argtypes = [ctypes.c_void_p]

@@ -19,37 +19,13 @@ _LIB = None
 _LIB_LOCK = threading.Lock()
 
 
-def _native_dir():
-    return os.path.join(os.path.dirname(os.path.dirname(__file__)), "core",
-                        "native")
-
-
 def _load_lib():
     global _LIB
     with _LIB_LOCK:
         if _LIB is not None:
             return _LIB
-        src = os.path.join(_native_dir(), "blocking_queue.cpp")
-        build_dir = os.path.join(_native_dir(), "build")
-        os.makedirs(build_dir, exist_ok=True)
-        import hashlib
-        with open(src, "rb") as f:
-            digest = hashlib.sha256(f.read()).hexdigest()[:16]
-        so = os.path.join(build_dir, f"libpd_bqueue-{digest}.so")
-        if not os.path.exists(so):
-            import glob
-            for old in glob.glob(os.path.join(build_dir,
-                                              "libpd_bqueue-*.so")):
-                try:
-                    os.unlink(old)
-                except OSError:
-                    pass
-            tmp = so + f".tmp{os.getpid()}"
-            subprocess.run(
-                ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-o", tmp,
-                 src, "-lpthread"], check=True, capture_output=True)
-            os.replace(tmp, so)
-        lib = ctypes.CDLL(so)
+        from ..core.native_build import load_native_lib
+        lib = load_native_lib("blocking_queue.cpp", "libpd_bqueue")
         lib.pd_bq_create.restype = ctypes.c_void_p
         lib.pd_bq_create.argtypes = [ctypes.c_uint64]
         lib.pd_bq_destroy.argtypes = [ctypes.c_void_p]

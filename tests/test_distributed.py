@@ -593,3 +593,26 @@ def test_localsgd_wrapper_syncs_on_cadence():
         opt.clear_grad()
     assert synced["n"] == 2  # steps 3 and 6
     assert np.isfinite(float(loss.numpy()))
+
+
+def test_all_reduce_quantized_approximates_sum(_env):
+    """EQuARX-style quantized all-reduce: int8 wire, approximate sum
+    (error bounded by the per-rank quantization step)."""
+    rng = np.random.RandomState(0)
+    data = rng.randn(8, 64).astype("float32")
+    t = paddle.to_tensor(data.copy())
+    dist.collective.all_reduce_quantized(t)
+    want = data.sum(axis=0, keepdims=True)
+    got = t.numpy()
+    # every rank-row holds the (approximate) global sum
+    step = np.abs(data).max(axis=1) / 127.0   # per-rank quant step
+    tol = step.sum() * 0.51 + 1e-6
+    assert np.abs(got - want).max() < tol
+    np.testing.assert_allclose(got[0], got[3], rtol=1e-6)
+    # exact path still exact
+    t2 = paddle.to_tensor(data.copy())
+    dist.all_reduce(t2)
+    np.testing.assert_allclose(t2.numpy()[:1], want, rtol=1e-4)
+    with pytest.raises(ValueError, match="bits"):
+        dist.collective.all_reduce_quantized(
+            paddle.to_tensor(data.copy()), bits=16)

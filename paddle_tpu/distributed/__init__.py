@@ -29,6 +29,7 @@ from . import io  # noqa: F401
 from . import launch  # noqa: F401
 from . import stream  # noqa: F401
 from . import passes  # noqa: F401
+from . import fleet_executor  # noqa: F401
 from .comm_extra import (  # noqa: F401
     CountFilterEntry, DistAttr, DistModel, InMemoryDataset, ParallelEnv,
     ParallelMode, Placement, ProbabilityEntry, QueueDataset, ReduceType,

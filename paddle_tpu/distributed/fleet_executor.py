@@ -1,0 +1,305 @@
+"""Fleet executor — actor-style pipeline runtime for heterogeneous graphs.
+
+Reference: paddle/fluid/distributed/fleet_executor/ — a ``Carrier`` routes
+messages between ``Interceptor`` actors (compute/source/sink/amplifier,
+compute_interceptor.cc), the task graph is ``TaskNode``s
+(runtime_graph.cc), and a brpc ``MessageBus`` carries cross-process
+messages (message_bus.cc). This is the runtime the reference uses when a
+static graph is heterogeneous (different programs per stage) — exactly
+the case the compiled ppermute pipeline cannot express.
+
+TPU-native mapping: actors are host-side (they schedule work; the work
+itself is compiled XLA programs), the in-process bus is a queue, and the
+cross-process bus rides distributed.rpc (the brpc stand-in). Flow control
+follows the reference's credit protocol (compute_interceptor.cc
+SendDataReadyToDownStream / ReplyCompletedToUpStream): a producer may
+have at most ``buffer_size`` unacknowledged steps per downstream edge;
+consumers return a credit after processing, so no queue grows unbounded.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from collections import defaultdict, deque
+
+__all__ = ["TaskNode", "Interceptor", "ComputeInterceptor", "Carrier",
+           "FleetExecutor"]
+
+
+class TaskNode:
+    """Reference: fleet_executor/task_node.cc — one actor's static
+    description: its role (a python callable standing in for the stage
+    program), upstream/downstream wiring, and how many micro-batch steps
+    it runs."""
+
+    def __init__(self, task_id, fn=None, rank=0, max_run_times=1,
+                 role="compute"):
+        self.task_id = task_id
+        self.fn = fn
+        self.rank = rank
+        self.max_run_times = max_run_times
+        self.role = role
+        self.upstream = []             # task ids feeding this node
+        self.downstream = []           # task ids fed by this node
+        self.buffer_sizes = {}         # dst id -> credit window
+
+    def add_upstream_task(self, tid):
+        """The credit window is a PRODUCER-side property — configure it on
+        the upstream's add_downstream_task (this mirrors who enforces it:
+        the producer throttles, the consumer only acknowledges)."""
+        self.upstream.append(tid)
+        return self
+
+    def add_downstream_task(self, tid, buffer_size=2):
+        self.downstream.append(tid)
+        self.buffer_sizes[tid] = buffer_size
+        return self
+
+
+class Interceptor:
+    """Reference: interceptor.cc — an actor with a mailbox; Carrier
+    delivers messages, the actor reacts."""
+
+    def __init__(self, node, carrier):
+        self.node = node
+        self.carrier = carrier
+
+    def handle(self, msg):
+        raise NotImplementedError
+
+    def send(self, dst_id, msg):
+        self.carrier.route(self.node.task_id, dst_id, msg)
+
+
+class ComputeInterceptor(Interceptor):
+    """Reference: compute_interceptor.cc — runs its program once per
+    micro-batch step when every upstream's data for that step arrived,
+    forwards under the credit window, and acknowledges upstream."""
+
+    def __init__(self, node, carrier):
+        super().__init__(node, carrier)
+        self._pending = defaultdict(dict)   # step -> {src: payload}
+        self._credits = dict(node.buffer_sizes)
+        self._outq = deque()                # produced, waiting for credit
+        self._next_source_step = 0
+        self._steps_run = 0                 # completed fn invocations
+
+    def quiesced(self):
+        """True when this actor has run all its steps and holds nothing
+        unsent — the per-rank completion signal for multi-rank graphs."""
+        if self.node.upstream:
+            done = self._steps_run >= self.node.max_run_times
+        else:
+            done = self._next_source_step >= self.node.max_run_times
+        return done and not self._outq
+
+    # -- source driving ----------------------------------------------------
+    def start(self):
+        if not self.node.upstream:
+            self._pump_source()
+
+    def _pump_source(self):
+        """Run source steps only while every downstream edge has credit —
+        the producer never races ahead of consumers by more than the
+        window (reference flow control)."""
+        while (self._next_source_step < self.node.max_run_times
+               and self._can_send()):
+            step = self._next_source_step
+            self._next_source_step += 1
+            self._emit(step, self.node.fn(step) if self.node.fn else None)
+
+    # -- message handling --------------------------------------------------
+    def handle(self, msg):
+        if msg.get("kind") == "credit":
+            self._credits[msg["src"]] += 1
+            self._flush_outq()
+            if not self.node.upstream:
+                self._pump_source()
+            return
+        step = msg["step"]
+        self._pending[step][msg["src"]] = msg["data"]
+        if len(self._pending[step]) == len(self.node.upstream):
+            inputs = self._pending.pop(step)
+            ordered = [inputs[src] for src in self.node.upstream]
+            out = self.node.fn(step, *ordered) if self.node.fn else \
+                (ordered[0] if ordered else None)
+            self._steps_run += 1
+            # ack AFTER the step ran: the upstream window bounds work in
+            # flight, not merely messages in flight
+            for src in self.node.upstream:
+                self.send(src, {"kind": "credit"})
+            self._emit(step, out)
+
+    # -- credited emission -------------------------------------------------
+    def _can_send(self):
+        return all(self._credits.get(d, 1) > 0 for d in self.node.downstream)
+
+    def _emit(self, step, out):
+        if not self.node.downstream:
+            self.carrier._sink(self.node.task_id, step, out)
+            return
+        self._outq.append((step, out))
+        self._flush_outq()
+
+    def _flush_outq(self):
+        while self._outq and self._can_send():
+            step, out = self._outq.popleft()
+            for dst in self.node.downstream:
+                self._credits[dst] -= 1
+                self.send(dst, {"kind": "data", "step": step, "data": out})
+
+
+class Carrier:
+    """Reference: carrier.cc — owns this rank's interceptors and routes
+    messages; off-rank destinations go through the message bus (rpc)."""
+
+    def __init__(self, rank=0):
+        self.rank = rank
+        self._interceptors = {}
+        self._locations = {}                 # task_id -> rank
+        self._results = {}
+        self._inbox = queue.Queue()
+        self._done = threading.Event()
+        self._expected_sink_msgs = 0
+        self._bus_errors = []
+        self._bus_lock = threading.Lock()
+
+    def add_interceptor(self, node, cls=ComputeInterceptor):
+        ic = cls(node, self)
+        self._interceptors[node.task_id] = ic
+        self._locations[node.task_id] = node.rank
+        return ic
+
+    def route(self, src_id, dst_id, msg):
+        msg = dict(msg, src=src_id)
+        dst_rank = self._locations.get(dst_id, self.rank)
+        if dst_rank == self.rank:
+            self._inbox.put((dst_id, msg))
+            return
+        # cross-process hop over the rpc message bus; failures must
+        # surface, not vanish with the discarded future
+        from . import rpc
+        peer = rpc.get_all_worker_infos()[dst_rank].name
+        fut = rpc.rpc_async(peer, _bus_deliver, args=(dst_id, msg))
+
+        def _check(f, dst=dst_id):
+            try:
+                exc = f.exception()
+            except Exception as e:  # noqa: BLE001 — cancelled etc.
+                exc = e
+            if exc is not None:
+                with self._bus_lock:
+                    self._bus_errors.append(f"send to task {dst}: {exc}")
+
+        fut.add_done_callback(_check)
+
+    def _sink(self, task_id, step, data):
+        self._results[(task_id, step)] = data
+        if len(self._results) >= self._expected_sink_msgs:
+            self._done.set()
+
+    def _raise_bus_errors(self):
+        with self._bus_lock:
+            if self._bus_errors:
+                errs = "; ".join(self._bus_errors)
+                self._bus_errors.clear()
+                raise RuntimeError(f"fleet executor message bus: {errs}")
+
+    def run(self, timeout=120):
+        """Drive the actor loop until every LOCAL sink step produced. On a
+        rank hosting no sink (multi-rank graphs), starting the sources is
+        the rank's whole job: the mailbox still needs draining for credit
+        messages, which arrive until every local source finished."""
+        sinks = [ic.node for ic in self._interceptors.values()
+                 if not ic.node.downstream]
+        self._expected_sink_msgs = sum(n.max_run_times for n in sinks)
+        self._results.clear()
+        self._done.clear()
+        for ic in self._interceptors.values():
+            if isinstance(ic, ComputeInterceptor):
+                ic.start()
+
+        def quiesced():
+            # every LOCAL actor ran all its steps with nothing unsent —
+            # middle stages hosted here count too, not just sources
+            return all(ic.quiesced() for ic in self._interceptors.values()
+                       if isinstance(ic, ComputeInterceptor))
+
+        import time
+        deadline = time.monotonic() + timeout
+        while True:
+            self._raise_bus_errors()  # fail fast, not at timeout
+            if sinks:
+                if self._done.is_set():
+                    break
+            elif quiesced() and self._inbox.empty():
+                break
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError(
+                    f"fleet executor: {len(self._results)}/"
+                    f"{self._expected_sink_msgs} sink messages after "
+                    f"{timeout}s")
+            try:
+                dst_id, msg = self._inbox.get(timeout=min(remaining, 0.2))
+            except queue.Empty:
+                continue
+            self._interceptors[dst_id].handle(msg)
+        self._raise_bus_errors()
+        return dict(self._results)
+
+
+_GLOBAL_CARRIER = None
+_CARRIER_READY = threading.Event()
+
+
+def set_global_carrier(carrier):
+    global _GLOBAL_CARRIER
+    _GLOBAL_CARRIER = carrier
+    _CARRIER_READY.set()
+
+
+def _bus_deliver(dst_id, msg):
+    """rpc-side entry: deliver a cross-process bus message to the local
+    carrier (reference: message_bus.cc DispatchMsgToCarrier). Waits for
+    the carrier — a fast peer may send before this rank finished its own
+    graph setup."""
+    if not _CARRIER_READY.wait(timeout=60):
+        raise RuntimeError("no local Carrier registered within 60s")
+    _GLOBAL_CARRIER._inbox.put((dst_id, msg))
+
+
+class FleetExecutor:
+    """Reference: fleet_executor.cc:36 — builds the runtime graph from
+    per-stage callables and runs M micro-batches through the actor
+    pipeline. Stages may be arbitrarily heterogeneous (each fn can wrap a
+    differently-shaped compiled program); with ``ranks_of_stages`` each
+    rank constructs the same graph and hosts only its own stages,
+    messages crossing the rpc bus."""
+
+    def __init__(self, stage_fns, num_micro_batches=1, rank=0,
+                 ranks_of_stages=None, buffer_size=2):
+        self.carrier = Carrier(rank)
+        set_global_carrier(self.carrier)
+        nodes = []
+        for i, fn in enumerate(stage_fns):
+            node = TaskNode(task_id=i, fn=fn,
+                            rank=(ranks_of_stages[i]
+                                  if ranks_of_stages else rank),
+                            max_run_times=num_micro_batches)
+            nodes.append(node)
+        for a, b in zip(nodes, nodes[1:]):
+            a.add_downstream_task(b.task_id, buffer_size)
+            b.add_upstream_task(a.task_id)
+        for n in nodes:
+            if n.rank == rank or ranks_of_stages is None:
+                self.carrier.add_interceptor(n)
+            else:
+                self.carrier._locations[n.task_id] = n.rank
+        self._m = num_micro_batches
+
+    def run(self, timeout=120):
+        """Returns {step: output} for every LOCAL sink micro-batch (empty
+        dict on ranks that host no sink stage)."""
+        raw = self.carrier.run(timeout=timeout)
+        return {step: data for (_, step), data in raw.items()}

@@ -163,3 +163,22 @@ def test_multicontroller_hybrid_mesh_parity(tmp_path):
                 (f"rank {rank} step {i}: {got[i]} vs single {ref[i]}")
         assert "WORLD processes=2 local=4 global=8" in log
         assert "ALLREDUCE 3.0" in log
+
+
+@pytest.mark.slow
+def test_fleet_executor_two_process(tmp_path):
+    """Fleet-executor actors on two ranks, messages over the rpc message
+    bus (reference: fleet_executor/message_bus.cc DispatchMsgToCarrier)."""
+    port = 29881
+    env = _clean_env(port)
+    env["PADDLE_MASTER_ENDPOINT"] = f"127.0.0.1:{port}"
+    log_dir = str(tmp_path / "logs")
+    launched = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--master", f"127.0.0.1:{port+1}",
+         "--log_dir", log_dir,
+         os.path.join(WORKERS, "fleet_executor_worker.py")],
+        env=env, capture_output=True, text=True, timeout=300, cwd=REPO)
+    assert launched.returncode == 0, launched.stdout + launched.stderr
+    with open(os.path.join(log_dir, "workerlog.1")) as f:
+        assert "FLEET_EXECUTOR OK rank=1" in f.read()

@@ -124,29 +124,36 @@ class ComputeInterceptor(Interceptor):
             out = self.node.fn(step, *ordered) if self.node.fn else \
                 (ordered[0] if ordered else None)
             self._steps_run += 1
-            # ack AFTER the step ran: the upstream window bounds work in
-            # flight, not merely messages in flight
-            for src in self.node.upstream:
-                self.send(src, {"kind": "credit"})
-            self._emit(step, out)
+            # the upstream ack rides the OUTPUT's departure (_flush_outq):
+            # acking on run-completion would let a middle stage drain its
+            # upstream at full speed while its own _outq grows unbounded —
+            # end-to-end backpressure needs the credit chain to extend
+            # through every hop
+            self._emit(step, out, acks=list(self.node.upstream))
 
     # -- credited emission -------------------------------------------------
     def _can_send(self):
         return all(self._credits.get(d, 1) > 0 for d in self.node.downstream)
 
-    def _emit(self, step, out):
+    def _ack(self, acks):
+        for src in acks:
+            self.send(src, {"kind": "credit"})
+
+    def _emit(self, step, out, acks=()):
         if not self.node.downstream:
             self.carrier._sink(self.node.task_id, step, out)
+            self._ack(acks)
             return
-        self._outq.append((step, out))
+        self._outq.append((step, out, list(acks)))
         self._flush_outq()
 
     def _flush_outq(self):
         while self._outq and self._can_send():
-            step, out = self._outq.popleft()
+            step, out, acks = self._outq.popleft()
             for dst in self.node.downstream:
                 self._credits[dst] -= 1
                 self.send(dst, {"kind": "data", "step": step, "data": out})
+            self._ack(acks)
 
 
 class Carrier:
@@ -163,6 +170,9 @@ class Carrier:
         self._expected_sink_msgs = 0
         self._bus_errors = []
         self._bus_lock = threading.Lock()
+        self._inflight_sends = 0
+        self._peer_names = None              # rank -> rpc worker name
+        self._ran = False
 
     def add_interceptor(self, node, cls=ComputeInterceptor):
         ic = cls(node, self)
@@ -179,16 +189,22 @@ class Carrier:
         # cross-process hop over the rpc message bus; failures must
         # surface, not vanish with the discarded future
         from . import rpc
-        peer = rpc.get_all_worker_infos()[dst_rank].name
-        fut = rpc.rpc_async(peer, _bus_deliver, args=(dst_id, msg))
+        if self._peer_names is None:  # resolve rank->name ONCE, by rank
+            self._peer_names = {w.rank: w.name
+                                for w in rpc.get_all_worker_infos()}
+        fut = rpc.rpc_async(self._peer_names[dst_rank], _bus_deliver,
+                            args=(dst_id, msg))
+        with self._bus_lock:
+            self._inflight_sends += 1
 
         def _check(f, dst=dst_id):
             try:
                 exc = f.exception()
             except Exception as e:  # noqa: BLE001 — cancelled etc.
                 exc = e
-            if exc is not None:
-                with self._bus_lock:
+            with self._bus_lock:
+                self._inflight_sends -= 1
+                if exc is not None:
                     self._bus_errors.append(f"send to task {dst}: {exc}")
 
         fut.add_done_callback(_check)
@@ -210,6 +226,11 @@ class Carrier:
         rank hosting no sink (multi-rank graphs), starting the sources is
         the rank's whole job: the mailbox still needs draining for credit
         messages, which arrive until every local source finished."""
+        if self._ran:
+            raise RuntimeError(
+                "this Carrier already ran; interceptor state is consumed — "
+                "build a new FleetExecutor per run")
+        self._ran = True
         sinks = [ic.node for ic in self._interceptors.values()
                  if not ic.node.downstream]
         self._expected_sink_msgs = sum(n.max_run_times for n in sinks)
@@ -229,10 +250,14 @@ class Carrier:
         deadline = time.monotonic() + timeout
         while True:
             self._raise_bus_errors()  # fail fast, not at timeout
+            with self._bus_lock:
+                inflight = self._inflight_sends
             if sinks:
                 if self._done.is_set():
                     break
-            elif quiesced() and self._inbox.empty():
+            elif quiesced() and self._inbox.empty() and inflight == 0:
+                # in-flight rpc sends must land (or fail loudly) before a
+                # sink-less rank declares itself finished
                 break
             remaining = deadline - time.monotonic()
             if remaining <= 0:

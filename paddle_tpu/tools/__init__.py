@@ -1,0 +1,10 @@
+"""paddle_tpu.tools — CLI utilities.
+
+Reference: tools/ — op-benchmark hooks (ci_op_benchmark.sh +
+check_op_benchmark_result.py) and the CrossStackProfiler multi-node
+timeline merger. Exposed as python -m entry points:
+
+    python -m paddle_tpu.tools.op_benchmark --op matmul --shapes 256x256,256x256
+    python -m paddle_tpu.tools.merge_profiles rank*.json -o merged.json
+"""
+from . import merge_profiles, op_benchmark  # noqa: F401

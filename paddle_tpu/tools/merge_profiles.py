@@ -1,0 +1,28 @@
+"""Multi-rank profile merger CLI (reference: tools/CrossStackProfiler —
+merges per-node timelines into one chrome trace).
+
+    python -m paddle_tpu.tools.merge_profiles rank0.json rank1.json \
+        -o merged.json
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+__all__ = ["main"]
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="paddle_tpu.tools.merge_profiles")
+    ap.add_argument("traces", nargs="+", help="per-rank chrome traces")
+    ap.add_argument("-o", "--out", required=True)
+    args = ap.parse_args(argv)
+    from ..profiler import merge_profiler_results
+    merged = merge_profiler_results(args.traces, out_path=args.out)
+    print(f"merged {len(args.traces)} traces -> {args.out} "
+          f"({len(merged['traceEvents'])} events)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

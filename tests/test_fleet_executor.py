@@ -110,3 +110,38 @@ def test_no_sink_rank_returns_after_quiesce():
     assert out == {}
     assert time.monotonic() - t0 < 5.0  # quiesce exit, not timeout
     assert [m["step"] for _, m in sent if m.get("kind") == "data"] == [0, 1]
+
+
+def test_backpressure_propagates_through_middle_stages():
+    """End-to-end credit chain: a middle stage must not drain its upstream
+    faster than ITS downstream accepts (review r5: the ack rides the
+    output's departure, not the step's completion)."""
+    produced = {"n": 0}
+    consumed = {"n": 0}
+    max_gap = {"v": 0}
+
+    def src(step):
+        produced["n"] += 1
+        max_gap["v"] = max(max_gap["v"], produced["n"] - consumed["n"])
+        return step
+
+    def mid(step, x):
+        return x * 2
+
+    def sink(step, x):
+        consumed["n"] += 1
+        return x
+
+    fe = FleetExecutor([src, mid, sink], num_micro_batches=24,
+                       buffer_size=2)
+    out = fe.run(timeout=30)
+    assert len(out) == 24
+    # window 2 per hop, 2 hops + steps in hand: gap stays small, not ~24
+    assert max_gap["v"] <= 2 * 2 + 2, max_gap
+
+
+def test_rerun_fails_fast():
+    fe = FleetExecutor([lambda s: s, lambda s, x: x], num_micro_batches=2)
+    assert len(fe.run(timeout=10)) == 2
+    with pytest.raises(RuntimeError, match="already ran"):
+        fe.run(timeout=10)

@@ -1,0 +1,69 @@
+"""CLI tools (reference: tools/ci_op_benchmark.sh +
+check_op_benchmark_result.py + CrossStackProfiler)."""
+import json
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+
+def _run(args, **kw):
+    import os
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    return subprocess.run([sys.executable, "-c",
+                           "import jax; jax.config.update('jax_platforms','cpu');"
+                           f"import sys; sys.argv = ['x'] + {args!r};"
+                           "from paddle_tpu.tools import op_benchmark;"
+                           "sys.exit(op_benchmark.main())"],
+                          capture_output=True, text=True, timeout=240,
+                          env=env, **kw)
+
+
+def test_op_benchmark_cli(tmp_path):
+    out = _run(["--op", "matmul", "--shapes", "64x64,64x64",
+                "--repeat", "5", "--out", str(tmp_path / "r.json")])
+    assert out.returncode == 0, out.stderr
+    row = json.loads(out.stdout.strip().splitlines()[-1])
+    assert row["op"] == "matmul" and row["us_per_call"] > 0
+    saved = json.load(open(tmp_path / "r.json"))
+    assert saved[0]["op"] == "matmul"
+
+
+def test_op_benchmark_regression_gate(tmp_path):
+    base = [{"op": "relu", "us_per_call": 1e-6}]  # impossibly fast
+    json.dump(base, open(tmp_path / "base.json", "w"))
+    out = _run(["--op", "relu", "--shapes", "64", "--repeat", "3",
+                "--baseline", str(tmp_path / "base.json")])
+    assert out.returncode == 1
+    assert "regressions" in out.stderr
+
+
+def test_compare_logic():
+    from paddle_tpu.tools.op_benchmark import compare
+    res = [{"op": "a", "us_per_call": 110.0},
+           {"op": "b", "us_per_call": 99.0}]
+    base = [{"op": "a", "us_per_call": 100.0},
+            {"op": "b", "us_per_call": 100.0}]
+    regs = compare(res, base, threshold=0.05)
+    assert [r["op"] for r in regs] == ["a"]
+
+
+def test_merge_profiles_cli(tmp_path):
+    import paddle_tpu as paddle
+    for r in range(2):
+        ev = {"traceEvents": [
+            {"name": "op", "ph": "X", "ts": 1, "dur": 2, "pid": 0,
+             "tid": 0, "args": {"name": f"rank_{r}"}}]}
+        json.dump(ev, open(tmp_path / f"rank{r}.json", "w"))
+    from paddle_tpu.tools.merge_profiles import main
+    rc = main([str(tmp_path / "rank0.json"), str(tmp_path / "rank1.json"),
+               "-o", str(tmp_path / "merged.json")])
+    assert rc == 0
+    merged = json.load(open(tmp_path / "merged.json"))
+    # 2 op events + 2 process_name lane labels (one per rank)
+    ops = [e for e in merged["traceEvents"] if e.get("ph") == "X"]
+    lanes = [e for e in merged["traceEvents"] if e.get("ph") == "M"]
+    assert len(ops) == 2 and len(lanes) == 2
+    assert {e["pid"] for e in ops} == {0, 1}

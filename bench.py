@@ -410,6 +410,37 @@ def bench_fused_adamw():
     return t_fused * 1e3, t_jnp * 1e3
 
 
+def bench_layer_norm():
+    """Pallas fused LayerNorm vs the jnp composition, [4096, 4096] bf16."""
+    from paddle_tpu.ops.pallas.layer_norm import layer_norm
+
+    chain = 10
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(4096, 4096), jnp.bfloat16)
+    w = jnp.asarray(rng.randn(4096), jnp.float32)
+    b = jnp.asarray(rng.randn(4096), jnp.float32)
+
+    @jax.jit
+    def run_pallas(x):
+        def body(i, x):
+            return layer_norm(x, w, b).astype(x.dtype)
+        return jax.lax.fori_loop(0, chain, body, x)
+
+    @jax.jit
+    def run_jnp(x):
+        def body(i, x):
+            xf = x.astype(jnp.float32)
+            mu = jnp.mean(xf, -1, keepdims=True)
+            var = jnp.mean((xf - mu) ** 2, -1, keepdims=True)
+            return ((xf - mu) * jax.lax.rsqrt(var + 1e-5) * w + b
+                    ).astype(x.dtype)
+        return jax.lax.fori_loop(0, chain, body, x)
+
+    t_pallas = _timeit(lambda: run_pallas(x), 5) / chain
+    t_jnp = _timeit(lambda: run_jnp(x), 5) / chain
+    return t_pallas * 1e3, t_jnp * 1e3
+
+
 def bench_rms_norm():
     """Pallas fused RMSNorm vs the jnp composition, [4096, 4096] bf16."""
     from paddle_tpu.ops.pallas.rms_norm import rms_norm
@@ -586,6 +617,13 @@ def main():
         _log(f"[bench] rms norm: pallas {rn_ms:.3f}ms vs jnp "
              f"{rn_jnp_ms:.3f}ms")
 
+    def _ln():
+        ln_ms, ln_jnp_ms = bench_layer_norm()
+        sub["layer_norm_pallas_ms"] = round(ln_ms, 3)
+        sub["layer_norm_jnp_ms"] = round(ln_jnp_ms, 3)
+        _log(f"[bench] layer norm: pallas {ln_ms:.3f}ms vs jnp "
+             f"{ln_jnp_ms:.3f}ms")
+
     def _gpt():
         gpt_mfu, gpt_t, tok_s, n_params = bench_gpt(peak)
         sub["gpt_step_ms"] = round(gpt_t * 1e3, 2)
@@ -633,6 +671,7 @@ def main():
     if on_tpu:  # Pallas kernels need the device (interpret-only on CPU)
         guarded("fused_adamw", _fused)
         guarded("rms_norm", _rms)
+        guarded("layer_norm", _ln)
     guarded("gpt", _gpt)
     if not _FAST and on_tpu:
         guarded("matmul_sweep", _matmul_sweep)

@@ -91,7 +91,33 @@ def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
 
 
 def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5,
-                     begin_norm_axis=-1):
+                     begin_norm_axis=-1, use_pallas=None, interpret=False):
+    """Reference: incubate/nn/functional/fused_layer_norm.py
+    (fused_layernorm_kernel.cu). Last-axis normalization rides the Pallas
+    kernel (ops/pallas/layer_norm.py — mean+variance+affine in one VMEM
+    pass) on TPU; other begin_norm_axis values use the jnp composition.
+    interpret=True runs the kernel in interpret mode for CPU parity."""
+    import jax as _jax
+
+    from ..core.dispatch import apply
+
+    last_axis = begin_norm_axis in (-1, x.ndim - 1)
+    if use_pallas is None:
+        use_pallas = interpret or _jax.default_backend() == "tpu"
+    if use_pallas and last_axis:
+        from ..ops.pallas.layer_norm import layer_norm as _pallas_ln
+        has_w = norm_weight is not None
+        has_b = norm_bias is not None
+        ins = [x] + ([norm_weight] if has_w else []) \
+            + ([norm_bias] if has_b else [])
+
+        def fwd(*arrs):
+            wa = arrs[1] if has_w else None
+            ba = arrs[1 + has_w] if has_b else None
+            return _pallas_ln(arrs[0], wa, ba, eps=epsilon,
+                              interpret=interpret)
+
+        return apply("fused_layer_norm", fwd, ins)
     from ..nn.functional import layer_norm
     # normalize over ALL dims from begin_norm_axis onward (reference
     # fused_layer_norm begin_norm_axis semantics)

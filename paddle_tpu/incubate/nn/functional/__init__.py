@@ -70,7 +70,12 @@ def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
 def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5,
                      residual=None, bias=None, **kwargs):
     """Reference: fused_layer_norm.py — (x + bias + residual) layernormed
-    in one op; returns (out, residual_out) when residual is given."""
+    in one op; returns (out, residual_out) when residual is given. The
+    plain case delegates to the top-level Pallas-backed kernel (same
+    routing as fused_rms_norm below)."""
+    if residual is None and bias is None:
+        from ... import fused_layer_norm as _top
+        return _top(x, norm_weight, norm_bias, epsilon, **kwargs)
     ins = [x, norm_weight, norm_bias]
     has_res = residual is not None
     if has_res:

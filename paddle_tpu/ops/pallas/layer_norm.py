@@ -1,0 +1,123 @@
+"""Fused LayerNorm — Pallas TPU kernel (forward; backward via custom_vjp).
+
+Reference: paddle/phi/kernels/fusion/gpu/fused_layernorm_kernel.cu (the
+layer-norm path — mean AND variance, vs the rms path's mean-square only).
+One VMEM pass per row-tile computes mean, variance, normalize and affine;
+the backward is the (XLA-fused) jnp expression of the analytic gradient,
+same split as ops/pallas/rms_norm.py: Pallas where a fused single pass
+beats jnp's multiple HBM passes (the fwd), XLA where it already fuses.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu  # noqa: F401
+
+DEFAULT_BLOCK_ROWS = 256
+
+__all__ = ["layer_norm"]
+
+
+def _kernel(x_ref, w_ref, b_ref, o_ref, *, eps, has_w, has_b):
+    x = x_ref[...].astype(jnp.float32)                  # [BR, H]
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    xc = x - mu
+    var = jnp.mean(xc * xc, axis=-1, keepdims=True)
+    inv = jax.lax.rsqrt(var + np.float32(eps))
+    out = xc * inv
+    if has_w:
+        out = out * w_ref[...].astype(jnp.float32)[None, :]
+    if has_b:
+        out = out + b_ref[...].astype(jnp.float32)[None, :]
+    o_ref[...] = out.astype(o_ref.dtype)
+
+
+def _fwd_pallas(x2, w, b, eps, block_rows, interpret):
+    R, H = x2.shape
+    br = min(block_rows, R)
+    pad = (-R) % br
+    if pad:  # pad to a whole grid: one giant block would overflow VMEM
+        x2 = jnp.concatenate(
+            [x2, jnp.zeros((pad, H), x2.dtype)], axis=0)
+    Rp = R + pad
+    grid = (Rp // br,)
+    row_spec = pl.BlockSpec((br, H), lambda i: (i, 0))
+    vec_spec = pl.BlockSpec((H,), lambda i: (0,))
+    has_w, has_b = w is not None, b is not None
+    ins = [x2] + ([w] if has_w else []) + ([b] if has_b else [])
+    in_specs = [row_spec] + [vec_spec] * (int(has_w) + int(has_b))
+    kern = functools.partial(
+        _dispatch_kernel, eps=eps, has_w=has_w, has_b=has_b)
+    with jax.enable_x64(False):
+        out = pl.pallas_call(
+            kern, grid=grid, in_specs=in_specs, out_specs=row_spec,
+            out_shape=jax.ShapeDtypeStruct((Rp, H), x2.dtype),
+            interpret=interpret)(*ins)
+    return out[:R] if pad else out
+
+
+def _dispatch_kernel(x_ref, *refs, eps, has_w, has_b):
+    o_ref = refs[-1]
+    w_ref = refs[0] if has_w else None
+    b_ref = refs[1 if has_w else 0] if has_b else None
+    _kernel(x_ref, w_ref, b_ref, o_ref, eps=eps, has_w=has_w, has_b=has_b)
+
+
+def _bwd_math(x, w, ct, eps, has_b):
+    xf = x.astype(jnp.float32)
+    ctf = ct.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    xc = xf - mu
+    var = jnp.mean(xc * xc, axis=-1, keepdims=True)
+    inv = jax.lax.rsqrt(var + np.float32(eps))
+    xhat = xc * inv
+    ctw = ctf * (w.astype(jnp.float32) if w is not None else 1.0)
+    m1 = jnp.mean(ctw, axis=-1, keepdims=True)
+    m2 = jnp.mean(ctw * xhat, axis=-1, keepdims=True)
+    dx = (inv * (ctw - m1 - xhat * m2)).astype(x.dtype)
+    axes = tuple(range(x.ndim - 1))
+    dw = jnp.sum(ctf * xhat, axis=axes).astype(
+        w.dtype) if w is not None else None
+    db = jnp.sum(ctf, axis=axes) if has_b else None  # cast at the caller
+    return dx, dw, db
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _ln(x, w, b, eps, block_rows, interpret, has_w, has_b):
+    shape = x.shape
+    out = _fwd_pallas(x.reshape(-1, shape[-1]),
+                      w if has_w else None, b if has_b else None,
+                      eps, block_rows, interpret)
+    return out.reshape(shape)
+
+
+def _ln_fwd(x, w, b, eps, block_rows, interpret, has_w, has_b):
+    return _ln(x, w, b, eps, block_rows, interpret, has_w, has_b), \
+        (x, w, b)
+
+
+def _ln_bwd(eps, block_rows, interpret, has_w, has_b, res, ct):
+    x, w, b = res
+    dx, dw, db = _bwd_math(x, w if has_w else None, ct, eps, has_b)
+    # cotangent dtypes must match the primals (bf16 x with f32 params is
+    # the standard mix — custom_vjp enforces this)
+    return (dx,
+            dw if dw is not None else jnp.zeros_like(w),
+            db.astype(b.dtype) if db is not None else jnp.zeros_like(b))
+
+
+_ln.defvjp(_ln_fwd, _ln_bwd)
+
+
+def layer_norm(x, weight=None, bias=None, eps=1e-5,
+               block_rows=DEFAULT_BLOCK_ROWS, interpret=False):
+    """x: [..., H]; weight/bias: [H] or None. Differentiable."""
+    has_w, has_b = weight is not None, bias is not None
+    H = x.shape[-1]
+    w = weight if has_w else jnp.zeros((H,), x.dtype)  # placeholder
+    b = bias if has_b else jnp.zeros((H,), x.dtype)
+    return _ln(x, w, b, float(eps), block_rows, interpret, has_w, has_b)

@@ -310,3 +310,98 @@ def test_fused_adamw_composes_with_zero_sharding():
     fused_w = run(True)
     assert calls["n"] > 0, "fused kernel never ran under ZeRO sharding"
     np.testing.assert_allclose(fused_w, run(False), rtol=2e-5, atol=1e-6)
+
+
+def test_pallas_layer_norm_matches_reference():
+    """ops/pallas/layer_norm.py vs the jnp composition (interpret mode on
+    CPU), incl. weight/bias combinations."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.pallas.layer_norm import layer_norm as pln
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(6, 128).astype("float32"))
+    w = jnp.asarray((rng.rand(128) + 0.5).astype("float32"))
+    b = jnp.asarray(rng.randn(128).astype("float32"))
+
+    def ref(xa, wa, ba, eps=1e-5):
+        mu = xa.mean(-1, keepdims=True)
+        var = ((xa - mu) ** 2).mean(-1, keepdims=True)
+        out = (xa - mu) / np.sqrt(np.asarray(var) + eps)
+        if wa is not None:
+            out = out * wa
+        if ba is not None:
+            out = out + ba
+        return out
+
+    for wa, ba in ((w, b), (w, None), (None, None)):
+        got = np.asarray(pln(x, wa, ba, interpret=True))
+        np.testing.assert_allclose(got, np.asarray(ref(x, wa, ba)),
+                                   rtol=2e-5, atol=2e-5)
+
+
+def test_pallas_layer_norm_grads_match_jnp():
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.pallas.layer_norm import layer_norm as pln
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(4, 64).astype("float32"))
+    w = jnp.asarray((rng.rand(64) + 0.5).astype("float32"))
+    b = jnp.asarray(rng.randn(64).astype("float32"))
+    ct = jnp.asarray(rng.randn(4, 64).astype("float32"))
+
+    def pallas_loss(xa, wa, ba):
+        return (pln(xa, wa, ba, interpret=True) * ct).sum()
+
+    def ref_loss(xa, wa, ba):
+        mu = xa.mean(-1, keepdims=True)
+        var = ((xa - mu) ** 2).mean(-1, keepdims=True)
+        out = (xa - mu) * jax.lax.rsqrt(var + 1e-5) * wa + ba
+        return (out * ct).sum()
+
+    gp = jax.grad(pallas_loss, argnums=(0, 1, 2))(x, w, b)
+    gr = jax.grad(ref_loss, argnums=(0, 1, 2))(x, w, b)
+    for a, c in zip(gp, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(c),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_incubate_fused_layer_norm_pallas_path_trains():
+    import paddle_tpu as paddle
+    rng = np.random.RandomState(2)
+    x = paddle.to_tensor(rng.randn(8, 128).astype("float32"))
+    x.stop_gradient = False
+    w = paddle.to_tensor((rng.rand(128) + 0.5).astype("float32"))
+    w.stop_gradient = False
+    b = paddle.to_tensor(np.zeros(128, "float32"))
+    out = paddle.incubate.fused_layer_norm(x, w, b, interpret=True)
+    ref = paddle.nn.functional.layer_norm(
+        x.detach(), [128],
+        paddle.to_tensor(w.numpy()), paddle.to_tensor(b.numpy()))
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=2e-5,
+                               atol=2e-5)
+    out.sum().backward()
+    assert x._grad is not None and w._grad is not None
+
+
+def test_pallas_layer_norm_mixed_dtype_and_ragged_rows():
+    """bf16 activations + f32 params (the standard TPU mix) must
+    differentiate, and non-block-divisible row counts must pad, not
+    build one giant VMEM block (review r5 findings)."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.pallas.layer_norm import layer_norm as pln
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(rng.randn(7, 64), jnp.bfloat16)   # 7 % block != 0
+    w = jnp.asarray(rng.rand(64) + 0.5, jnp.float32)
+    b = jnp.asarray(rng.randn(64), jnp.float32)
+    out = pln(x, w, b, interpret=True, block_rows=4)
+    assert out.shape == (7, 64) and out.dtype == jnp.bfloat16
+
+    gx, gw, gb = jax.grad(
+        lambda xa, wa, ba: pln(xa, wa, ba, interpret=True,
+                               block_rows=4).astype(jnp.float32).sum(),
+        argnums=(0, 1, 2))(x, w, b)
+    assert gx.dtype == jnp.bfloat16
+    assert gw.dtype == jnp.float32 and gb.dtype == jnp.float32

@@ -75,7 +75,13 @@ def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5,
     routing as fused_rms_norm below)."""
     if residual is None and bias is None:
         from ... import fused_layer_norm as _top
-        return _top(x, norm_weight, norm_bias, epsilon, **kwargs)
+        # forward only the kwargs the top-level accepts; the reference
+        # signature carries extras (quant_scale, norm_type, ...) that the
+        # old inline path silently ignored — keep ignoring them
+        fwd_kwargs = {k: v for k, v in kwargs.items()
+                      if k in ("begin_norm_axis", "use_pallas",
+                               "interpret")}
+        return _top(x, norm_weight, norm_bias, epsilon, **fwd_kwargs)
     ins = [x, norm_weight, norm_bias]
     has_res = residual is not None
     if has_res:

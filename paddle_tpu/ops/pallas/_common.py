@@ -1,0 +1,22 @@
+"""Shared Pallas kernel scaffolding."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["pad_rows_to_grid"]
+
+
+def pad_rows_to_grid(x2, block_rows):
+    """Pad a [R, H] operand so R divides the row-block size.
+
+    Row-tiled kernels must not fall back to one giant [R, H] block when R
+    is not divisible (a single block must fit VMEM, ~16 MB); padding the
+    grid and slicing the output back is the safe general form. Returns
+    (padded, R, br): the original row count and the block size to use.
+    """
+    R, H = x2.shape
+    br = min(block_rows, R)
+    pad = (-R) % br
+    if pad:
+        x2 = jnp.concatenate([x2, jnp.zeros((pad, H), x2.dtype)], axis=0)
+    return x2, R, br

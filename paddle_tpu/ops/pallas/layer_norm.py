@@ -37,13 +37,9 @@ def _kernel(x_ref, w_ref, b_ref, o_ref, *, eps, has_w, has_b):
 
 
 def _fwd_pallas(x2, w, b, eps, block_rows, interpret):
-    R, H = x2.shape
-    br = min(block_rows, R)
-    pad = (-R) % br
-    if pad:  # pad to a whole grid: one giant block would overflow VMEM
-        x2 = jnp.concatenate(
-            [x2, jnp.zeros((pad, H), x2.dtype)], axis=0)
-    Rp = R + pad
+    from ._common import pad_rows_to_grid
+    x2, R, br = pad_rows_to_grid(x2, block_rows)
+    Rp, H = x2.shape
     grid = (Rp // br,)
     row_spec = pl.BlockSpec((br, H), lambda i: (i, 0))
     vec_spec = pl.BlockSpec((H,), lambda i: (0,))
@@ -57,7 +53,7 @@ def _fwd_pallas(x2, w, b, eps, block_rows, interpret):
             kern, grid=grid, in_specs=in_specs, out_specs=row_spec,
             out_shape=jax.ShapeDtypeStruct((Rp, H), x2.dtype),
             interpret=interpret)(*ins)
-    return out[:R] if pad else out
+    return out[:R] if Rp != R else out
 
 
 def _dispatch_kernel(x_ref, *refs, eps, has_w, has_b):

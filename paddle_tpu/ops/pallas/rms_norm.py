@@ -37,31 +37,32 @@ def _kernel_bias(x_ref, w_ref, b_ref, o_ref, *, eps):
 
 
 def _fwd_pallas(x2, w, b, eps, block_rows, interpret):
-    R, H = x2.shape
-    br = min(block_rows, R)
-    if R % br:
-        br = R
-    grid = (R // br,)
+    from ._common import pad_rows_to_grid
+    x2, R, br = pad_rows_to_grid(x2, block_rows)
+    Rp, H = x2.shape
+    grid = (Rp // br,)
     row_spec = pl.BlockSpec((br, H), lambda i: (i, 0))
     vec_spec = pl.BlockSpec((H,), lambda i: (0,))
     with jax.enable_x64(False):
         if b is None:
-            return pl.pallas_call(
+            out = pl.pallas_call(
                 functools.partial(_kernel, eps=eps),
                 grid=grid,
                 in_specs=[row_spec, vec_spec],
                 out_specs=row_spec,
-                out_shape=jax.ShapeDtypeStruct((R, H), x2.dtype),
+                out_shape=jax.ShapeDtypeStruct((Rp, H), x2.dtype),
                 interpret=interpret,
             )(x2, w)
-        return pl.pallas_call(
-            functools.partial(_kernel_bias, eps=eps),
-            grid=grid,
-            in_specs=[row_spec, vec_spec, vec_spec],
-            out_specs=row_spec,
-            out_shape=jax.ShapeDtypeStruct((R, H), x2.dtype),
-            interpret=interpret,
-        )(x2, w, b)
+        else:
+            out = pl.pallas_call(
+                functools.partial(_kernel_bias, eps=eps),
+                grid=grid,
+                in_specs=[row_spec, vec_spec, vec_spec],
+                out_specs=row_spec,
+                out_shape=jax.ShapeDtypeStruct((Rp, H), x2.dtype),
+                interpret=interpret,
+            )(x2, w, b)
+    return out[:R] if Rp != R else out
 
 
 def _bwd_math(x, w, ct, eps):

@@ -1,0 +1,130 @@
+"""End-to-end 'book' model convergence (reference: test/book/ —
+word2vec, recommender_system, understand_sentiment; fit-a-line and
+recognize-digits live in test_static_program.py / test_models.py).
+Public-API-only scripts that must CONVERGE, the reference's e2e bar."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def test_word2vec_ngram_converges():
+    """N-gram word2vec (reference: test/book/test_word2vec.py shapes):
+    predict the next word from 4 context embeddings; loss must collapse
+    on a tiny corpus with a deterministic pattern."""
+    paddle.seed(0)
+    vocab, emb_dim = 32, 16
+    rng = np.random.RandomState(0)
+    corpus = np.array([i % vocab for i in range(200)], "int64")
+    ctx = np.stack([corpus[i:i + 4] for i in range(len(corpus) - 4)])
+    nxt = corpus[4:]
+
+    class NGram(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.emb = nn.Embedding(vocab, emb_dim)
+            self.fc1 = nn.Linear(4 * emb_dim, 64)
+            self.fc2 = nn.Linear(64, vocab)
+
+        def forward(self, x):
+            e = self.emb(x).reshape([x.shape[0], -1])
+            return self.fc2(paddle.tanh(self.fc1(e)))
+
+    model = NGram()
+    opt = paddle.optimizer.Adam(learning_rate=5e-3,
+                                parameters=model.parameters())
+    x = paddle.to_tensor(ctx.astype("int64"))
+    y = paddle.to_tensor(nxt)
+    losses = []
+    for _ in range(60):
+        loss = F.cross_entropy(model(x), y)
+        losses.append(float(loss.numpy()))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert losses[-1] < 0.1 * losses[0], (losses[0], losses[-1])
+    # the pattern is deterministic: prediction accuracy ~ 1.0
+    pred = np.argmax(model(x).numpy(), -1)
+    assert (pred == nxt).mean() > 0.95
+
+
+def test_recommender_system_converges():
+    """User/item embedding recommender (reference:
+    test/book/test_recommender_system.py): dot-product rating regression
+    on a synthetic low-rank preference matrix."""
+    paddle.seed(1)
+    n_users, n_items, k_true = 24, 30, 3
+    rng = np.random.RandomState(1)
+    U = rng.randn(n_users, k_true)
+    V = rng.randn(n_items, k_true)
+    ratings = (U @ V.T).astype("float32")
+    users, items = np.meshgrid(np.arange(n_users), np.arange(n_items),
+                               indexing="ij")
+
+    class Recommender(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.u = nn.Embedding(n_users, 8)
+            self.v = nn.Embedding(n_items, 8)
+
+        def forward(self, uid, iid):
+            return (self.u(uid) * self.v(iid)).sum(axis=-1)
+
+    model = Recommender()
+    opt = paddle.optimizer.Adam(learning_rate=2e-2,
+                                parameters=model.parameters())
+    uid = paddle.to_tensor(users.ravel().astype("int64"))
+    iid = paddle.to_tensor(items.ravel().astype("int64"))
+    target = paddle.to_tensor(ratings.ravel())
+    losses = []
+    for _ in range(80):
+        loss = paddle.mean((model(uid, iid) - target) ** 2)
+        losses.append(float(loss.numpy()))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert losses[-1] < 0.1 * losses[0], (losses[0], losses[-1])
+
+
+def test_understand_sentiment_lstm_converges():
+    """LSTM sentiment classifier (reference:
+    test/book/test_understand_sentiment.py 'stacked_lstm' flavor): a
+    separable synthetic task — positive sequences draw from the top half
+    of the vocab — must reach high train accuracy."""
+    paddle.seed(2)
+    vocab, seq_len, emb_dim, hidden = 40, 12, 16, 32
+    rng = np.random.RandomState(2)
+    n = 64
+    labels = rng.randint(0, 2, n)
+    seqs = np.where(labels[:, None] == 1,
+                    rng.randint(vocab // 2, vocab, (n, seq_len)),
+                    rng.randint(0, vocab // 2, (n, seq_len)))
+
+    class SentimentLSTM(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.emb = nn.Embedding(vocab, emb_dim)
+            self.lstm = nn.LSTM(emb_dim, hidden)
+            self.head = nn.Linear(hidden, 2)
+
+        def forward(self, x):
+            out, _ = self.lstm(self.emb(x))
+            return self.head(out[:, -1])
+
+    model = SentimentLSTM()
+    opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                parameters=model.parameters())
+    x = paddle.to_tensor(seqs.astype("int64"))
+    y = paddle.to_tensor(labels.astype("int64"))
+    losses = []
+    for _ in range(40):
+        loss = F.cross_entropy(model(x), y)
+        losses.append(float(loss.numpy()))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert losses[-1] < 0.2 * losses[0], (losses[0], losses[-1])
+    acc = (np.argmax(model(x).numpy(), -1) == labels).mean()
+    assert acc > 0.95, acc

@@ -405,3 +405,33 @@ def test_pallas_layer_norm_mixed_dtype_and_ragged_rows():
         argnums=(0, 1, 2))(x, w, b)
     assert gx.dtype == jnp.bfloat16
     assert gw.dtype == jnp.float32 and gb.dtype == jnp.float32
+
+
+def test_pallas_rms_norm_ragged_rows_pad_grid():
+    """rms_norm shares the pad-to-grid scaffold (review r5): odd row
+    counts must not build one giant VMEM block."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.pallas.rms_norm import rms_norm as prms
+    rng = np.random.RandomState(4)
+    x = jnp.asarray(rng.randn(7, 64).astype("float32"))
+    w = jnp.asarray((rng.rand(64) + 0.5).astype("float32"))
+    out = np.asarray(prms(x, w, block_rows=4, interpret=True))
+    xa = np.asarray(x)
+    inv = 1.0 / np.sqrt((xa * xa).mean(-1, keepdims=True) + 1e-6)
+    np.testing.assert_allclose(out, xa * inv * np.asarray(w),
+                               rtol=2e-5, atol=2e-5)
+    assert out.shape == (7, 64)
+
+
+def test_incubate_functional_fused_layer_norm_ignores_reference_extras():
+    import paddle_tpu as paddle
+    import paddle_tpu.incubate.nn.functional as IF
+    rng = np.random.RandomState(5)
+    x = paddle.to_tensor(rng.randn(4, 32).astype("float32"))
+    w = paddle.to_tensor(np.ones(32, "float32"))
+    b = paddle.to_tensor(np.zeros(32, "float32"))
+    # reference-signature extras must be silently ignored, not TypeError
+    out = IF.fused_layer_norm(x, w, b, quant_scale=-1,
+                              norm_type="layernorm", interpret=True)
+    np.testing.assert_allclose(out.numpy().mean(-1), 0.0, atol=1e-5)

@@ -77,10 +77,13 @@ def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5,
         from ... import fused_layer_norm as _top
         # forward only the kwargs the top-level accepts; the reference
         # signature carries extras (quant_scale, norm_type, ...) that the
-        # old inline path silently ignored — keep ignoring them
-        fwd_kwargs = {k: v for k, v in kwargs.items()
-                      if k in ("begin_norm_axis", "use_pallas",
-                               "interpret")}
+        # old inline path silently ignored — keep ignoring them. The
+        # accepted set derives from the live signature so the two stay
+        # in sync as kwargs are added.
+        import inspect
+        accepted = set(inspect.signature(_top).parameters) - {
+            "x", "norm_weight", "norm_bias", "epsilon"}
+        fwd_kwargs = {k: v for k, v in kwargs.items() if k in accepted}
         return _top(x, norm_weight, norm_bias, epsilon, **fwd_kwargs)
     ins = [x, norm_weight, norm_bias]
     has_res = residual is not None

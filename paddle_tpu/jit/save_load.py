@@ -72,8 +72,7 @@ def save(layer, path, input_spec=None, **configs):
 
         state_shapes = [jax.ShapeDtypeStruct(tuple(t.shape), t._data.dtype)
                         for t in state_tensors]
-        arg_shapes = [jax.ShapeDtypeStruct(tuple(int(d) for d in s.shape),
-                                           s.dtype) for s in specs]
+        arg_shapes = _arg_shapes(specs)
         exported = jax_export.export(jax.jit(pure))(state_shapes, arg_shapes)
         blob = exported.serialize()
     finally:
@@ -93,6 +92,38 @@ def save(layer, path, input_spec=None, **configs):
     })
     with open(path + ".pdmeta", "wb") as f:
         pickle.dump(meta, f, protocol=4)
+
+
+def _arg_shapes(specs):
+    """InputSpec shapes → ShapeDtypeStructs; ``None`` dims export as
+    symbolic dimensions (reference: dynamic-shape InputSpec in jit.save).
+    Axis-0 ``None``s share one 'batch' symbol across every input (batch
+    dims must agree between inputs of one forward); other ``None`` axes
+    get independent symbols."""
+    if not any(d is None for s in specs for d in s.shape):
+        return [jax.ShapeDtypeStruct(tuple(int(d) for d in s.shape),
+                                     s.dtype) for s in specs]
+    scope = jax_export.SymbolicScope()
+    shapes = []
+    fresh = 0
+    for s in specs:
+        if not any(d is None for d in s.shape):
+            shapes.append(jax.ShapeDtypeStruct(
+                tuple(int(d) for d in s.shape), s.dtype))
+            continue
+        names = []
+        for ax, d in enumerate(s.shape):
+            if d is None:
+                if ax == 0:
+                    names.append("batch")
+                else:
+                    names.append(f"dyn{fresh}")
+                    fresh += 1
+            else:
+                names.append(str(int(d)))
+        dims = jax_export.symbolic_shape(", ".join(names), scope=scope)
+        shapes.append(jax.ShapeDtypeStruct(dims, s.dtype))
+    return shapes
 
 
 class TranslatedLayer:

@@ -3,7 +3,6 @@ word2vec, recommender_system, understand_sentiment; fit-a-line and
 recognize-digits live in test_static_program.py / test_models.py).
 Public-API-only scripts that must CONVERGE, the reference's e2e bar."""
 import numpy as np
-import pytest
 
 import paddle_tpu as paddle
 import paddle_tpu.nn as nn
@@ -16,7 +15,6 @@ def test_word2vec_ngram_converges():
     on a tiny corpus with a deterministic pattern."""
     paddle.seed(0)
     vocab, emb_dim = 32, 16
-    rng = np.random.RandomState(0)
     corpus = np.array([i % vocab for i in range(200)], "int64")
     ctx = np.stack([corpus[i:i + 4] for i in range(len(corpus) - 4)])
     nxt = corpus[4:]

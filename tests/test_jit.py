@@ -183,3 +183,28 @@ def test_to_static_output_structure_per_cache_entry():
     np.testing.assert_allclose(again.numpy(), [2, 2, 2])
     pair2 = f(x, return_aux=True)
     np.testing.assert_allclose(pair2[1].numpy(), [2, 2, 2])
+
+
+def test_jit_save_load_dynamic_batch(tmp_path):
+    """InputSpec with None dims exports symbolic shapes (reference:
+    dynamic-shape jit.save): the loaded artifact serves ANY batch size
+    from one compiled export."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    model.eval()
+    path = str(tmp_path / "dyn")
+    paddle.jit.save(model, path,
+                    input_spec=[paddle.static.InputSpec([None, 4],
+                                                        "float32")])
+    loaded = paddle.jit.load(path)
+    rng = np.random.RandomState(0)
+    for b in (1, 3, 17):
+        x = rng.randn(b, 4).astype("float32")
+        got = loaded(paddle.to_tensor(x))
+        np.testing.assert_allclose(got.numpy(),
+                                   model(paddle.to_tensor(x)).numpy(),
+                                   rtol=1e-5, atol=1e-6)

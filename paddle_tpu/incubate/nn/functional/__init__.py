@@ -67,6 +67,16 @@ def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
                              transpose_y=transpose_weight)
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=4)
+def _fused_ln_accepted(top_fn):
+    import inspect
+    return frozenset(inspect.signature(top_fn).parameters) - {
+        "x", "norm_weight", "norm_bias", "epsilon"}
+
+
 def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5,
                      residual=None, bias=None, **kwargs):
     """Reference: fused_layer_norm.py — (x + bias + residual) layernormed
@@ -78,12 +88,10 @@ def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5,
         # forward only the kwargs the top-level accepts; the reference
         # signature carries extras (quant_scale, norm_type, ...) that the
         # old inline path silently ignored — keep ignoring them. The
-        # accepted set derives from the live signature so the two stay
-        # in sync as kwargs are added.
-        import inspect
-        accepted = set(inspect.signature(_top).parameters) - {
-            "x", "norm_weight", "norm_bias", "epsilon"}
-        fwd_kwargs = {k: v for k, v in kwargs.items() if k in accepted}
+        # accepted set derives from the live signature (computed once,
+        # this is a per-layer-per-step hot path) so the two stay in sync.
+        fwd_kwargs = {k: v for k, v in kwargs.items()
+                      if k in _fused_ln_accepted(_top)}
         return _top(x, norm_weight, norm_bias, epsilon, **fwd_kwargs)
     ins = [x, norm_weight, norm_bias]
     has_res = residual is not None

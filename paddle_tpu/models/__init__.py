@@ -4,6 +4,7 @@ from .gpt import (  # noqa: F401
     GPTConfig, GPTForCausalLM, GPTForCausalLMPipe, GPTModel,
     GPTPretrainingCriterion, gpt_1p3b, gpt_13b, gpt_small, gpt_tiny,
 )
+from .seq2seq import Seq2SeqTransformer  # noqa: F401
 from .bert import (  # noqa: F401
     BertConfig, BertForMaskedLM, BertForSequenceClassification, BertModel,
     bert_base, bert_tiny,

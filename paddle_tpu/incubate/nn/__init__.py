@@ -1,2 +1,313 @@
-"""paddle.incubate.nn (reference: python/paddle/incubate/nn)."""
-from . import functional  # noqa: F401
+"""paddle.incubate.nn — fused Layer classes over the functional fused ops.
+
+Reference: python/paddle/incubate/nn/layer/fused_transformer.py (the
+Layer-with-parameters wrappers around the fused CUDA entry points). Each
+class here owns the parameters and defers the math to
+incubate.nn.functional — which is one taped op (XLA fuses it), riding the
+Pallas kernels where eligible.
+"""
+from __future__ import annotations
+
+from ...nn import Layer
+from ...nn import initializer as I
+from . import functional as F_inc
+
+functional = F_inc  # the public submodule name
+
+__all__ = ["FusedLinear", "FusedDropoutAdd", "FusedEcMoe",
+           "FusedBiasDropoutResidualLayerNorm", "FusedMultiHeadAttention",
+           "FusedFeedForward", "FusedTransformerEncoderLayer",
+           "FusedMultiTransformer"]
+
+
+class FusedLinear(Layer):
+    """Reference: incubate/nn/layer/fused_linear.py."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, transpose_weight=False, name=None):
+        super().__init__()
+        shape = ((out_features, in_features) if transpose_weight
+                 else (in_features, out_features))
+        self.weight = self.create_parameter(shape, attr=weight_attr)
+        self.bias = None if bias_attr is False else self.create_parameter(
+            (out_features,), attr=bias_attr, is_bias=True)
+        self._transpose_weight = transpose_weight
+
+    def forward(self, x):
+        return F_inc.fused_linear(x, self.weight, self.bias,
+                                  transpose_weight=self._transpose_weight)
+
+
+class FusedDropoutAdd(Layer):
+    """Reference: incubate/nn/layer/fused_dropout_add.py."""
+
+    def __init__(self, p=0.5, mode="upscale_in_train", name=None):
+        super().__init__()
+        self.p = p
+        self.mode = mode
+
+    def forward(self, x, y):
+        return F_inc.fused_dropout_add(x, y, p=self.p,
+                                       training=self.training,
+                                       mode=self.mode)
+
+
+class FusedBiasDropoutResidualLayerNorm(Layer):
+    """Reference: incubate/nn/layer/fused_transformer.py
+    FusedBiasDropoutResidualLayerNorm."""
+
+    def __init__(self, embed_dim, dropout_rate=0.5, weight_attr=None,
+                 bias_attr=None, epsilon=1e-5, name=None):
+        super().__init__()
+        self.ln_scale = self.create_parameter(
+            (embed_dim,), default_initializer=I.Constant(1.0))
+        self.ln_bias = self.create_parameter((embed_dim,), is_bias=True)
+        self.linear_bias = self.create_parameter((embed_dim,),
+                                                 is_bias=True)
+        self._dropout_rate = dropout_rate
+        self._epsilon = epsilon
+
+    def forward(self, x, residual):
+        return F_inc.fused_bias_dropout_residual_layer_norm(
+            x, residual, bias=self.linear_bias, ln_scale=self.ln_scale,
+            ln_bias=self.ln_bias, dropout_rate=self._dropout_rate,
+            ln_epsilon=self._epsilon, training=self.training)
+
+
+class FusedEcMoe(Layer):
+    """Reference: incubate/nn/layer/fused_ec_moe.py — expert-choice MoE
+    ([E, D, Dff] / [E, Dff, D] expert banks + gate projection)."""
+
+    def __init__(self, hidden_size, inter_size, num_experts,
+                 act_type="gelu", weight_attr=None, bias_attr=None):
+        super().__init__()
+        self.gate = self.create_parameter((hidden_size, num_experts))
+        self.bmm0_weight = self.create_parameter(
+            (num_experts, hidden_size, inter_size))
+        self.bmm0_bias = self.create_parameter(
+            (num_experts, inter_size), is_bias=True)
+        self.bmm1_weight = self.create_parameter(
+            (num_experts, inter_size, hidden_size))
+        self.bmm1_bias = self.create_parameter(
+            (num_experts, hidden_size), is_bias=True)
+        self._act = act_type
+
+    def forward(self, x, gate=None):
+        gate_logits = gate if gate is not None else x.matmul(self.gate)
+        return F_inc.fused_ec_moe(x, gate_logits, self.bmm0_weight,
+                                  self.bmm0_bias, self.bmm1_weight,
+                                  self.bmm1_bias, act_type=self._act)
+
+
+class FusedMultiHeadAttention(Layer):
+    """Reference: incubate/nn/layer/fused_transformer.py
+    FusedMultiHeadAttention (pre/post-LN fused MHA block)."""
+
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5,
+                 attn_dropout_rate=0.5, kdim=None, vdim=None,
+                 normalize_before=False, need_weights=False,
+                 weight_attr=None, bias_attr=None, epsilon=1e-5,
+                 nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        assert embed_dim % num_heads == 0
+        head = embed_dim // num_heads
+        self.qkv_weight = self.create_parameter(
+            (3, num_heads, head, embed_dim))
+        self.qkv_bias = self.create_parameter((3, num_heads, head),
+                                              is_bias=True)
+        self.linear_weight = self.create_parameter((embed_dim, embed_dim))
+        self.linear_bias = self.create_parameter((embed_dim,),
+                                                 is_bias=True)
+        self.pre_ln_scale = self.create_parameter(
+            (embed_dim,), default_initializer=I.Constant(1.0))
+        self.pre_ln_bias = self.create_parameter((embed_dim,),
+                                                 is_bias=True)
+        self.ln_scale = self.create_parameter(
+            (embed_dim,), default_initializer=I.Constant(1.0))
+        self.ln_bias = self.create_parameter((embed_dim,), is_bias=True)
+        self._cfg = dict(pre_layer_norm=normalize_before,
+                         dropout_rate=dropout_rate,
+                         attn_dropout_rate=attn_dropout_rate,
+                         ln_epsilon=epsilon, num_heads=num_heads)
+
+    def forward(self, query, key=None, value=None, attn_mask=None,
+                cache=None):
+        if cache is not None:
+            raise NotImplementedError(
+                "FusedMultiHeadAttention cache is not wired; use "
+                "models/gpt.py's compiled KV decode for serving")
+        return F_inc.fused_multi_head_attention(
+            query, self.qkv_weight, self.linear_weight,
+            pre_layer_norm=self._cfg["pre_layer_norm"],
+            pre_ln_scale=self.pre_ln_scale, pre_ln_bias=self.pre_ln_bias,
+            ln_scale=self.ln_scale, ln_bias=self.ln_bias,
+            qkv_bias=self.qkv_bias, linear_bias=self.linear_bias,
+            attn_mask=attn_mask,
+            dropout_rate=self._cfg["dropout_rate"] if self.training else 0.0,
+            attn_dropout_rate=(self._cfg["attn_dropout_rate"]
+                               if self.training else 0.0),
+            ln_epsilon=self._cfg["ln_epsilon"], training=self.training,
+            num_heads=self._cfg["num_heads"])
+
+
+class FusedFeedForward(Layer):
+    """Reference: incubate/nn/layer/fused_transformer.py
+    FusedFeedForward."""
+
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 epsilon=1e-5, activation="relu", act_dropout_rate=None,
+                 normalize_before=False, linear1_weight_attr=None,
+                 linear1_bias_attr=None, linear2_weight_attr=None,
+                 linear2_bias_attr=None, ln1_scale_attr=None,
+                 ln1_bias_attr=None, ln2_scale_attr=None,
+                 ln2_bias_attr=None, nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        self.linear1_weight = self.create_parameter(
+            (d_model, dim_feedforward))
+        self.linear1_bias = self.create_parameter((dim_feedforward,),
+                                                  is_bias=True)
+        self.linear2_weight = self.create_parameter(
+            (dim_feedforward, d_model))
+        self.linear2_bias = self.create_parameter((d_model,),
+                                                  is_bias=True)
+        self.ln1_scale = self.create_parameter(
+            (d_model,), default_initializer=I.Constant(1.0))
+        self.ln1_bias = self.create_parameter((d_model,), is_bias=True)
+        self.ln2_scale = self.create_parameter(
+            (d_model,), default_initializer=I.Constant(1.0))
+        self.ln2_bias = self.create_parameter((d_model,), is_bias=True)
+        self._cfg = dict(dropout_rate=dropout_rate, epsilon=epsilon,
+                         activation=activation,
+                         act_dropout_rate=(act_dropout_rate
+                                           if act_dropout_rate is not None
+                                           else dropout_rate),
+                         normalize_before=normalize_before)
+
+    def forward(self, x):
+        return F_inc.fused_feedforward(
+            x, self.linear1_weight, self.linear2_weight,
+            linear1_bias=self.linear1_bias,
+            linear2_bias=self.linear2_bias,
+            ln1_scale=self.ln1_scale, ln1_bias=self.ln1_bias,
+            ln2_scale=self.ln2_scale, ln2_bias=self.ln2_bias,
+            dropout1_rate=(self._cfg["act_dropout_rate"]
+                           if self.training else 0.0),
+            dropout2_rate=(self._cfg["dropout_rate"]
+                           if self.training else 0.0),
+            activation=self._cfg["activation"],
+            ln1_epsilon=self._cfg["epsilon"],
+            ln2_epsilon=self._cfg["epsilon"],
+            pre_layer_norm=self._cfg["normalize_before"],
+            training=self.training)
+
+
+class FusedTransformerEncoderLayer(Layer):
+    """Reference: incubate/nn/layer/fused_transformer.py
+    FusedTransformerEncoderLayer = FusedMultiHeadAttention +
+    FusedFeedForward."""
+
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None,
+                 act_dropout_rate=None, normalize_before=False,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        self.fused_attn = FusedMultiHeadAttention(
+            d_model, nhead, dropout_rate=dropout_rate,
+            attn_dropout_rate=(attn_dropout_rate
+                               if attn_dropout_rate is not None
+                               else dropout_rate),
+            normalize_before=normalize_before)
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate=dropout_rate,
+            activation=activation, act_dropout_rate=act_dropout_rate,
+            normalize_before=normalize_before)
+
+    def forward(self, src, src_mask=None, cache=None):
+        if cache is not None:
+            raise NotImplementedError(
+                "FusedTransformerEncoderLayer cache is not wired")
+        return self.ffn(self.fused_attn(src, attn_mask=src_mask))
+
+
+class FusedMultiTransformer(Layer):
+    """Reference: incubate/nn/layer/fused_transformer.py
+    FusedMultiTransformer — the whole pre-LN stack as one op (serving
+    fast path; rides functional.fused_multi_transformer)."""
+
+    def __init__(self, embed_dim, num_heads, dim_feedforward,
+                 dropout_rate=0.0, activation="gelu", normalize_before
+                 =True, ln_scale_attrs=None, ln_bias_attrs=None,
+                 qkv_weight_attrs=None, qkv_bias_attrs=None,
+                 linear_weight_attrs=None, linear_bias_attrs=None,
+                 ffn_ln_scale_attrs=None, ffn_ln_bias_attrs=None,
+                 ffn1_weight_attrs=None, ffn1_bias_attrs=None,
+                 ffn2_weight_attrs=None, ffn2_bias_attrs=None,
+                 epsilon=1e-5, num_layers=-1, nranks=1, ring_id=-1,
+                 name=None):
+        super().__init__()
+        assert normalize_before, (
+            "FusedMultiTransformer is the pre-LN serving stack "
+            "(reference constraint)")
+        if num_layers < 0:
+            num_layers = len(qkv_weight_attrs) if qkv_weight_attrs else 1
+        head = embed_dim // num_heads
+
+        def mk(shape, attrs, i, is_bias=False, ones=False):
+            # per-layer ParamAttr lists (initializers included) are honored
+            attr = attrs[i] if attrs is not None else None
+            return self.create_parameter(
+                shape, attr=attr, is_bias=is_bias,
+                default_initializer=I.Constant(1.0) if ones else None)
+
+        L = num_layers
+        self.ln_scales = [mk((embed_dim,), ln_scale_attrs, i, ones=True)
+                          for i in range(L)]
+        self.ln_biases = [mk((embed_dim,), ln_bias_attrs, i, is_bias=True)
+                          for i in range(L)]
+        self.qkv_weights = [mk((3, num_heads, head, embed_dim),
+                               qkv_weight_attrs, i) for i in range(L)]
+        self.qkv_biases = [mk((3, num_heads, head), qkv_bias_attrs, i,
+                              is_bias=True) for i in range(L)]
+        self.linear_weights = [mk((embed_dim, embed_dim),
+                                  linear_weight_attrs, i)
+                               for i in range(L)]
+        self.linear_biases = [mk((embed_dim,), linear_bias_attrs, i,
+                                 is_bias=True) for i in range(L)]
+        self.ffn_ln_scales = [mk((embed_dim,), ffn_ln_scale_attrs, i,
+                                 ones=True) for i in range(L)]
+        self.ffn_ln_biases = [mk((embed_dim,), ffn_ln_bias_attrs, i,
+                                 is_bias=True) for i in range(L)]
+        self.ffn1_weights = [mk((embed_dim, dim_feedforward),
+                                ffn1_weight_attrs, i) for i in range(L)]
+        self.ffn1_biases = [mk((dim_feedforward,), ffn1_bias_attrs, i,
+                               is_bias=True) for i in range(L)]
+        self.ffn2_weights = [mk((dim_feedforward, embed_dim),
+                                ffn2_weight_attrs, i) for i in range(L)]
+        self.ffn2_biases = [mk((embed_dim,), ffn2_bias_attrs, i,
+                               is_bias=True) for i in range(L)]
+        # register the per-layer parameter lists so parameters() sees them
+        for i in range(num_layers):
+            for group in ("ln_scales", "ln_biases", "qkv_weights",
+                          "qkv_biases", "linear_weights", "linear_biases",
+                          "ffn_ln_scales", "ffn_ln_biases", "ffn1_weights",
+                          "ffn1_biases", "ffn2_weights", "ffn2_biases"):
+                self.add_parameter(f"{group}_{i}",
+                                   getattr(self, group)[i])
+        self._cfg = dict(epsilon=epsilon, activation=activation,
+                         dropout_rate=dropout_rate)
+
+    def forward(self, src, attn_mask=None, caches=None, time_step=None):
+        if caches is not None or time_step is not None:
+            raise NotImplementedError(
+                "FusedMultiTransformer incremental decoding (caches/"
+                "time_step) is not wired; use models/gpt.py's compiled "
+                "fixed-shape KV decode for serving")
+        return F_inc.fused_multi_transformer(
+            src, self.ln_scales, self.ln_biases, self.qkv_weights,
+            self.qkv_biases, self.linear_weights, self.linear_biases,
+            self.ffn_ln_scales, self.ffn_ln_biases, self.ffn1_weights,
+            self.ffn1_biases, self.ffn2_weights, self.ffn2_biases,
+            pre_layer_norm=True, epsilon=self._cfg["epsilon"],
+            attn_mask=attn_mask, activation=self._cfg["activation"],
+            dropout_rate=(self._cfg["dropout_rate"] if self.training
+                          else 0.0), training=self.training)

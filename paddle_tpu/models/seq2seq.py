@@ -53,10 +53,7 @@ class Seq2SeqTransformer(nn.Layer):
         return Tensor(jnp.arange(S, dtype=jnp.int64)[None, :])
 
     def _causal_mask(self, S):
-        import jax.numpy as jnp
-        # additive mask: 0 on/below diag, -inf above (future positions)
-        m = jnp.where(jnp.tril(jnp.ones((S, S), bool)), 0.0, -1e9)
-        return Tensor(m.astype(jnp.float32))
+        return self.transformer.generate_square_subsequent_mask(S)
 
     def _encode(self, src):
         scale = float(np.sqrt(self.d_model))

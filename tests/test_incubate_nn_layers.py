@@ -1,0 +1,75 @@
+"""incubate.nn fused Layer classes (reference:
+python/paddle/incubate/nn/layer/fused_transformer.py et al.)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.incubate.nn as inn
+
+
+def _x(b=2, s=5, d=32, seed=0):
+    return paddle.to_tensor(
+        np.random.RandomState(seed).randn(b, s, d).astype("float32"))
+
+
+def test_surface_complete():
+    for n in ["FusedMultiHeadAttention", "FusedFeedForward",
+              "FusedTransformerEncoderLayer", "FusedMultiTransformer",
+              "FusedLinear", "FusedBiasDropoutResidualLayerNorm",
+              "FusedEcMoe", "FusedDropoutAdd"]:
+        assert hasattr(inn, n), n
+
+
+def test_fused_linear_matches_matmul():
+    paddle.seed(0)
+    lin = inn.FusedLinear(32, 16)
+    x = _x()
+    np.testing.assert_allclose(
+        lin(x).numpy(),
+        x.numpy() @ lin.weight.numpy() + lin.bias.numpy(),
+        rtol=1e-5, atol=1e-5)
+
+
+def test_encoder_layer_trains():
+    paddle.seed(1)
+    layer = inn.FusedTransformerEncoderLayer(32, 4, 64, dropout_rate=0.0)
+    x = _x(seed=1)
+    tgt = _x(seed=2)
+    opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                parameters=layer.parameters())
+    losses = []
+    for _ in range(12):
+        loss = ((layer(x) - tgt) ** 2).mean()
+        losses.append(float(loss.numpy()))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert losses[-1] < losses[0]
+    assert len(list(layer.parameters())) == 16
+
+
+def test_multi_transformer_stack_runs_and_has_params():
+    paddle.seed(2)
+    m = inn.FusedMultiTransformer(32, 4, 64, num_layers=3)
+    m.eval()
+    out = m(_x(seed=3))
+    assert out.shape == [2, 5, 32]
+    assert len(list(m.parameters())) == 3 * 12
+    # grads reach every layer's parameters
+    m.train()
+    out = m(_x(seed=3))
+    out.sum().backward()
+    missing = [i for i, p in enumerate(m.parameters()) if p._grad is None]
+    assert not missing, missing
+
+
+def test_ec_moe_and_dropout_add_and_bdrln():
+    paddle.seed(3)
+    x = _x(seed=4)
+    moe = inn.FusedEcMoe(32, 64, num_experts=4)
+    assert moe(x).shape == [2, 5, 32]
+    da = inn.FusedDropoutAdd(p=0.0)
+    np.testing.assert_allclose(da(x, x).numpy(), 2 * x.numpy(), rtol=1e-6)
+    bdrln = inn.FusedBiasDropoutResidualLayerNorm(32, dropout_rate=0.0)
+    out = bdrln(x, x)
+    np.testing.assert_allclose(out.numpy().mean(-1), 0.0, atol=1e-5)

@@ -539,7 +539,107 @@ def _log(msg):
     print(msg, file=sys.stderr, flush=True)
 
 
+def run_chaos_smoke(steps=6):
+    """``--chaos`` smoke mode: a launcher-managed CPU run with one injected
+    crash + one torn shard write (distributed/fault.py); asserts the
+    checkpoint resume reproduces the uninterrupted loss trajectory and
+    measures recovery time + checkpoint save/verify latency so robustness
+    regressions show up in the perf trajectory alongside MFU."""
+    import glob as _glob
+    import shutil
+    import subprocess
+    import tempfile
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    workers_dir = os.path.join(repo, "tests", "workers")
+    worker = os.path.join(workers_dir, "ft_worker.py")
+    if workers_dir not in sys.path:
+        sys.path.insert(0, workers_dir)
+    from ft_markers import parse_losses as losses, parse_stamps as stamps
+    tmp = tempfile.mkdtemp(prefix="pd_chaos_")
+    base_env = {k: v for k, v in os.environ.items()
+                if not k.startswith(("PADDLE_TPU_", "PADDLE_TRAINER"))}
+    base_env.update({
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+        # prepend, never clobber: the parent's PYTHONPATH may carry deps
+        "PYTHONPATH": os.pathsep.join(
+            [repo] + [p for p in os.environ.get(
+                "PYTHONPATH", "").split(os.pathsep) if p and p != repo]),
+        "PADDLE_TPU_FT_STEPS": str(steps),
+    })
+    try:
+        env = dict(base_env,
+                   PADDLE_TPU_CKPT_DIR=os.path.join(tmp, "ck_ref"))
+        t0 = time.perf_counter()
+        ref = subprocess.run([sys.executable, worker], env=env,
+                             capture_output=True, text=True, timeout=600,
+                             cwd=repo)
+        ref_wall = time.perf_counter() - t0
+        if ref.returncode != 0:
+            return {"error": "chaos reference run failed: "
+                             + (ref.stdout + ref.stderr)[-300:]}
+        ref_losses = losses(ref.stdout)
+        log_dir = os.path.join(tmp, "logs")
+        env = dict(base_env,
+                   PADDLE_TPU_CKPT_DIR=os.path.join(tmp, "ck_fault"),
+                   PADDLE_TPU_FAULTS="crash@step:3,torn_write@ckpt:2")
+        t0 = time.perf_counter()
+        launched = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--nproc_per_node", "1", "--max_restarts", "1",
+             "--log_dir", log_dir, worker],
+            env=env, capture_output=True, text=True, timeout=600, cwd=repo)
+        fault_wall = time.perf_counter() - t0
+        logs = [open(p).read() for p in sorted(
+            _glob.glob(os.path.join(log_dir, "workerlog.0*")))]
+        merged = "".join(logs)
+        got = losses(merged)
+        ok = (launched.returncode == 0 and set(got) == set(ref_losses)
+              and all(abs(got[i] - ref_losses[i]) <= 1e-6
+                      for i in ref_losses))
+        out = {
+            "chaos_resume_ok": ok,
+            "chaos_wall_overhead_s": round(fault_wall - ref_wall, 3),
+        }
+        # resume gap: last durable step of the crashed incarnation → first
+        # completed (recomputed) step of the resumed one
+        done = [stamps(t, r"STEP_DONE \d+") for t in logs]
+        if len(done) >= 2 and done[0] and done[1]:
+            out["chaos_recovery_s"] = round(done[1][0] - done[0][-1], 3)
+        save_ms = stamps(merged, "CKPT_SAVE_MS")
+        if save_ms:
+            out["ckpt_save_ms"] = round(sum(save_ms) / len(save_ms), 2)
+        verify_ms = stamps(merged, "CKPT_VERIFY_MS")
+        if verify_ms:
+            out["ckpt_verify_ms"] = round(verify_ms[0], 2)
+        if not ok:
+            out["error"] = ("chaos run rc=%d; losses %d/%d matched"
+                            % (launched.returncode, sum(
+                                1 for i in ref_losses if i in got
+                                and abs(got[i] - ref_losses[i]) <= 1e-6),
+                               len(ref_losses)))
+        return out
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def main_chaos():
+    sub = run_chaos_smoke()
+    ok = bool(sub.get("chaos_resume_ok"))
+    print(json.dumps({
+        "metric": "chaos_recovery_s",
+        "value": sub.get("chaos_recovery_s", 0.0),
+        "unit": "s",
+        "vs_baseline": 1.0 if ok else 0.0,
+        "submetrics": sub,
+    }))
+    return 0 if ok else 1
+
+
 def main():
+    if "--chaos" in sys.argv:
+        sys.exit(main_chaos())
     peak = _peak_flops()
     device = jax.devices()[0].device_kind
     on_tpu = "TPU" in str(device)

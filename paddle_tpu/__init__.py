@@ -17,6 +17,12 @@ import jax as _jax
 # use bf16/f32 explicitly, so TPU speed is unaffected.
 _jax.config.update("jax_enable_x64", True)
 
+# older jax runtimes lack top-level shard_map: publish the alias BEFORE any
+# submodule does `from jax import shard_map`
+from .core import jax_compat as _jax_compat  # noqa: E402
+
+_jax_compat.install()
+
 from .core.autograd import enable_grad, grad, is_grad_enabled, no_grad, set_grad_enabled  # noqa: F401
 from .core.device import (  # noqa: F401
     CPUPlace, Place, TPUPlace, device_count, get_device, is_compiled_with_tpu,

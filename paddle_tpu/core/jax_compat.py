@@ -1,0 +1,40 @@
+"""Version-compatibility shims for the installed jax.
+
+The codebase targets the current jax surface (top-level ``jax.shard_map``
+with the ``check_vma`` kwarg). Older runtimes (<= 0.4.x) only ship
+``jax.experimental.shard_map.shard_map`` with the equivalent kwarg named
+``check_rep``. Rather than scattering try/except at every import site,
+``install()`` (called once from ``paddle_tpu/__init__``) publishes a
+top-level alias that adapts the kwarg — so ``from jax import shard_map``
+works everywhere against either runtime. No-op on a modern jax.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+
+
+def install() -> None:
+    if not hasattr(jax.lax, "pcast"):
+        # varying-manual-axes (VMA) annotation; pre-VMA runtimes have no
+        # such type distinction, so the value-level identity is exact
+        jax.lax.pcast = lambda x, axes=None, *, to=None: x
+    if not hasattr(jax, "enable_x64"):
+        # the x64 context manager was promoted out of jax.experimental;
+        # the pallas kernels use it to drop to i32 index arithmetic
+        from jax.experimental import enable_x64 as _enable_x64
+        jax.enable_x64 = _enable_x64
+    if hasattr(jax, "shard_map"):
+        return
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    @functools.wraps(_shard_map)
+    def shard_map(f, /, mesh=None, *, in_specs=None, out_specs=None,
+                  check_vma=None, **kwargs):
+        if check_vma is not None and "check_rep" not in kwargs:
+            kwargs["check_rep"] = check_vma
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, **kwargs)
+
+    jax.shard_map = shard_map

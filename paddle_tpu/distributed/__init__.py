@@ -19,7 +19,13 @@ from .env import (  # noqa: F401
     get_rank, get_world_size, init_parallel_env, is_initialized, world_mesh,
 )
 from .parallel import DataParallel, shard_batch  # noqa: F401
-from .tcp_store import TCPStore, Watchdog  # noqa: F401
+from . import fault  # noqa: F401
+from .fault import (  # noqa: F401
+    Backoff, CheckpointLineage, EXIT_FAULT, EXIT_PREEMPT, EXIT_WATCHDOG,
+    exit_preempted, install_preemption_handler, maybe_inject, preempted,
+    retry, set_fault_spec,
+)
+from .tcp_store import StoreTimeoutError, TCPStore, Watchdog  # noqa: F401
 from .watchdog import (  # noqa: F401
     start_step_watchdog, stop_step_watchdog, get_step_watchdog,
 )
@@ -39,7 +45,10 @@ from .comm_extra import (  # noqa: F401
     isend, recv, scatter_object_list, send, shard_optimizer, spawn, split,
     to_static, wait,
 )
-from .checkpoint import load_state_dict, save_state_dict  # noqa: F401
+from .checkpoint import (  # noqa: F401
+    CheckpointCorruptError, load_state_dict, save_state_dict,
+    verify_checkpoint,
+)
 from .auto_tuner import AutoTuner  # noqa: F401
 from .elastic import ElasticManager, ElasticStatus  # noqa: F401
 from .topology import (  # noqa: F401

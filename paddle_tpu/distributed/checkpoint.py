@@ -14,16 +14,80 @@ collapses into XLA resharding).
 """
 from __future__ import annotations
 
+import json
 import os
 import pickle
+import zlib
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ..core.tensor import Tensor
+from .fault import atomic_write, atomic_write_bytes, maybe_inject
 
-__all__ = ["save_state_dict", "load_state_dict"]
+__all__ = ["save_state_dict", "load_state_dict", "verify_checkpoint",
+           "CheckpointCorruptError"]
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A snapshot failed integrity validation (torn/missing shard, CRC
+    mismatch, or missing rank manifest) — never load it."""
+
+
+def _crc_of_file(path, chunk=1 << 22):
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(chunk)
+            if not block:
+                return crc & 0xFFFFFFFF
+            crc = zlib.crc32(block, crc)
+
+
+def verify_checkpoint(path):
+    """Integrity-check a snapshot directory against its CRC manifests.
+
+    Every rank records a ``manifest_<rank>.json`` naming its files with
+    size + CRC32 and the world size at save time; completeness = all
+    ranks' manifests present AND every listed file matches. Raises
+    :class:`CheckpointCorruptError` otherwise (a pre-manifest snapshot —
+    no manifests at all — is treated as unverifiable and rejected the
+    same way, so lineage fallback skips it)."""
+    import glob
+
+    manifests = sorted(glob.glob(os.path.join(path, "manifest_*.json")))
+    if not manifests:
+        raise CheckpointCorruptError(
+            f"{path}: no manifest files (uncommitted or pre-manifest "
+            "snapshot)")
+    world = 1
+    files = {}
+    for mf in manifests:
+        try:
+            with open(mf) as f:
+                m = json.load(f)
+        except (OSError, ValueError) as e:
+            raise CheckpointCorruptError(f"{mf}: unreadable manifest ({e})")
+        world = max(world, int(m.get("world_size", 1)))
+        files.update(m.get("files", {}))
+    if len(manifests) < world:
+        raise CheckpointCorruptError(
+            f"{path}: only {len(manifests)}/{world} rank manifests present")
+    for fname, rec in files.items():
+        fp = os.path.join(path, fname)
+        if not os.path.exists(fp):
+            raise CheckpointCorruptError(f"{fp}: listed in manifest but "
+                                         "missing")
+        size = os.path.getsize(fp)
+        if size != int(rec["size"]):
+            raise CheckpointCorruptError(
+                f"{fp}: size {size} != manifest {rec['size']} (torn write)")
+        crc = _crc_of_file(fp)
+        if crc != int(rec["crc32"]):
+            raise CheckpointCorruptError(
+                f"{fp}: crc32 {crc:#010x} != manifest "
+                f"{int(rec['crc32']):#010x} (corrupt shard)")
 
 
 def _flatten(d, prefix=""):
@@ -84,31 +148,84 @@ def save_state_dict(state_dict, path, process_group=None,
                 {"key": key, "file": shard_file,
                  "index": tuple((0, d) for d in arr.shape)})
         metadata["state"][name] = entry
-    if async_save:
-        import io as _io
+    meta_file = f"metadata_{rank}.pkl"
+    meta_bytes = pickle.dumps(metadata, protocol=4)
 
-        from .ckpt_io import AsyncCheckpointWriter
+    # integrity manifest: CRC32 + size of the bytes we INTEND to land; a
+    # torn write leaves the disk file disagreeing, which load detects
+    def _manifest_bytes(shard_crc, shard_size):
+        manifest = {
+            "version": 1, "rank": rank, "world_size": jax.process_count(),
+            "files": {
+                shard_file: {"crc32": shard_crc, "size": shard_size},
+                meta_file: {"crc32": zlib.crc32(meta_bytes) & 0xFFFFFFFF,
+                            "size": len(meta_bytes)},
+            },
+        }
+        return json.dumps(manifest, indent=1).encode()
+
+    shard_path = os.path.join(path, shard_file)
+    fault_kind = maybe_inject("ckpt")
+    if async_save or fault_kind == "torn_write":
+        # both need the serialized archive in memory: the async pool is
+        # handed a buffer, and a torn write must know the INTENDED crc of
+        # bytes it deliberately truncates. memoryview end-to-end — CRC,
+        # torn slice, and submit all read the ONE BytesIO buffer
+        import io as _io
         buf = _io.BytesIO()
         np.savez(buf, **shards)
-        # ONE worker => strict FIFO: the shard file is durable (renamed)
-        # before the metadata that references it starts — a crash between
-        # the two can't publish new metadata over an old shard
-        writer = AsyncCheckpointWriter(n_threads=1)
-        writer.submit(os.path.join(path, shard_file), buf.getbuffer())
-        writer.submit(os.path.join(path, f"metadata_{rank}.pkl"),
-                      pickle.dumps(metadata, protocol=4))
-        return writer
-    np.savez(os.path.join(path, shard_file), **shards)
-    with open(os.path.join(path, f"metadata_{rank}.pkl"), "wb") as f:
-        pickle.dump(metadata, f, protocol=4)
+        shard_view = buf.getbuffer()
+        manifest_bytes = _manifest_bytes(
+            zlib.crc32(shard_view) & 0xFFFFFFFF, shard_view.nbytes)
+        shard_write = shard_view
+        if fault_kind == "torn_write":
+            # chaos harness: land a truncated shard at the FINAL path
+            # (models a non-atomic writer killed mid-stream); load-time
+            # validation must catch the manifest disagreement
+            shard_write = shard_view[:max(1, shard_view.nbytes // 2)]
+        if async_save:
+            from .ckpt_io import AsyncCheckpointWriter
+            # ONE worker => strict FIFO: the shard file is durable
+            # (renamed) before the metadata that references it starts, and
+            # the manifest lands last — a crash between any two can't
+            # publish a manifest over missing shards
+            writer = AsyncCheckpointWriter(n_threads=1)
+            writer.submit(shard_path, shard_write)
+            writer.submit(os.path.join(path, meta_file), meta_bytes)
+            writer.submit(os.path.join(path, f"manifest_{rank}.json"),
+                          manifest_bytes)
+            return writer
+        with open(shard_path, "wb") as f:
+            f.write(shard_write)
+            f.flush()
+            os.fsync(f.fileno())
+    else:
+        # sync path streams the archive straight into the atomic temp file
+        # — never the whole serialized shard set in host RAM (at pod scale
+        # that transiently doubles checkpoint memory) — then CRCs the
+        # landed bytes for the manifest
+        atomic_write(shard_path, lambda f: np.savez(f, **shards))
+        manifest_bytes = _manifest_bytes(_crc_of_file(shard_path),
+                                         os.path.getsize(shard_path))
+    atomic_write_bytes(os.path.join(path, meta_file), meta_bytes)
+    atomic_write_bytes(os.path.join(path, f"manifest_{rank}.json"),
+                       manifest_bytes)
     return None
 
 
 def load_state_dict(state_dict, path, process_group=None,
-                    coordinator_rank=0, unique_id=None):
+                    coordinator_rank=0, unique_id=None, _verified=False):
     """Reference: distributed/checkpoint/load_state_dict.py:365. Fills the
     given (possibly sharded) state_dict in place, resharding as needed."""
     import glob
+
+    # integrity gate: if CRC manifests exist, a corrupted/torn shard must
+    # be detected BEFORE any bytes are deserialized (never load it);
+    # manifest-less snapshots predate the lineage layer and load as-is.
+    # _verified: the caller (CheckpointLineage.load_latest) already ran
+    # verify_checkpoint on this directory — don't re-read every shard
+    if not _verified and glob.glob(os.path.join(path, "manifest_*.json")):
+        verify_checkpoint(path)
 
     # merge every rank's metadata (multi-host saves write one per rank)
     metadata = {"state": {}, "files": []}

@@ -71,6 +71,8 @@ class AsyncCheckpointWriter:
     def submit(self, path, data) -> int:
         """Queue one shard (bytes or a writable buffer — memoryview is
         accepted without an extra python-side copy); returns a job id."""
+        from .fault import maybe_inject
+        maybe_inject("ckpt_io")  # chaos site: slow_io delays the submit
         pool = self._require_open()
         if isinstance(data, (bytes, bytearray)):
             buf = (ctypes.c_char * len(data)).from_buffer_copy(data)

@@ -21,6 +21,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
 from ..core.tensor import Tensor
+from . import fault as _fault
 
 __all__ = ["ReduceOp", "Group", "new_group", "get_group", "all_reduce",
            "all_gather", "reduce", "broadcast", "scatter", "reduce_scatter",
@@ -151,6 +152,7 @@ def _reduce_fn(op, axis):
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     """In-place all-reduce over the rank axis (leading dim).
     Reference: communication/all_reduce.py."""
+    _fault.maybe_inject("allreduce")
     g = _as_group(group)
     arr = _placed(tensor._data, g)
     red = _reduce_fn(op, g.axis)
@@ -169,6 +171,7 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
 def all_gather(tensor_list, tensor, group=None, sync_op=True):
     """Gather every rank's slice; fills tensor_list with the N slices
     (replicated). Reference: communication/all_gather.py."""
+    _fault.maybe_inject("allgather")
     g = _as_group(group)
     arr = _placed(tensor._data, g)
 
@@ -208,6 +211,7 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
 def broadcast(tensor, src=0, group=None, sync_op=True):
     """Every rank slice becomes the src slice.
     Reference: communication/broadcast.py."""
+    _fault.maybe_inject("broadcast")
     g = _as_group(group)
     arr = _placed(tensor._data, g)
 
@@ -233,6 +237,7 @@ def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM, group=None,
                    sync_op=True):
     """Each rank gets one reduced chunk: input per-rank [N*c, ...] → output
     per-rank [c, ...]. Reference: communication/reduce_scatter.py."""
+    _fault.maybe_inject("reducescatter")
     g = _as_group(group)
     src = tensor_or_tensor_list
     if isinstance(src, (list, tuple)):
@@ -268,6 +273,7 @@ def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM, group=None,
 def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
     """Rank i sends chunk j to rank j. Global view: [N, N, ...] transpose of
     the two leading axes. Reference: communication/all_to_all.py."""
+    _fault.maybe_inject("alltoall")
     g = _as_group(group)
     if isinstance(in_tensor_list, (list, tuple)):
         arr = jnp.stack([t._data for t in in_tensor_list])
@@ -293,6 +299,7 @@ def barrier(group=None):
     payload is identical on every process, so it places globally under
     multi-controller SPMD too."""
     from .placement import place_global
+    _fault.maybe_inject("barrier")
     g = _as_group(group)
     spec = P(g.axis, *([None]))
     arr = place_global(np.ones((g.nranks, 1), np.float32),

@@ -25,12 +25,50 @@ def init_parallel_env():
     if _initialized:
         return _default_group()
     # multi-host: the launcher (paddle_tpu.distributed.launch analog) sets
-    # coordinator env vars; jax.distributed wires DCN coordination
+    # coordinator env vars; jax.distributed wires DCN coordination. Group
+    # init is retried with backoff: right after a launcher restart the
+    # coordinator port can still be draining its previous incarnation
     if os.environ.get("PADDLE_TPU_COORDINATOR"):
-        jax.distributed.initialize(
-            coordinator_address=os.environ["PADDLE_TPU_COORDINATOR"],
-            num_processes=int(os.environ.get("PADDLE_TPU_NUM_PROCESSES", 1)),
-            process_id=int(os.environ.get("PADDLE_TPU_PROCESS_ID", 0)))
+        from . import fault as _fault
+
+        # multi-process CPU meshes (tests, local chaos runs) need a real
+        # cross-process collectives impl — without it the runtime raises
+        # "Multiprocess computations aren't implemented on the CPU
+        # backend" at the first compiled collective
+        try:
+            if getattr(jax.config, "jax_platforms", None) == "cpu" \
+                    or os.environ.get("JAX_PLATFORMS") == "cpu":
+                jax.config.update(
+                    "jax_cpu_collectives_implementation", "gloo")
+        except Exception:
+            pass
+
+        def _init_once():
+            try:
+                jax.distributed.initialize(
+                    coordinator_address=os.environ[
+                        "PADDLE_TPU_COORDINATOR"],
+                    num_processes=int(
+                        os.environ.get("PADDLE_TPU_NUM_PROCESSES", 1)),
+                    process_id=int(
+                        os.environ.get("PADDLE_TPU_PROCESS_ID", 0)))
+            except Exception:
+                # a failed connect leaves partial global state and a bare
+                # re-initialize would raise "should only be called once":
+                # tear it down so the retry actually reconnects
+                try:
+                    jax.distributed.shutdown()
+                except Exception:
+                    pass
+                raise
+
+        _fault.retry(
+            _init_once,
+            retry_on=(RuntimeError, OSError, ConnectionError),
+            attempts=int(os.environ.get("PADDLE_TPU_INIT_RETRIES", "4")),
+            base=0.5, cap=8.0,
+            deadline=float(os.environ.get(
+                "PADDLE_TPU_INIT_DEADLINE", "120")))
     devices = np.array(jax.devices())
     _world_mesh = Mesh(devices, axis_names=("world",))
     _initialized = True

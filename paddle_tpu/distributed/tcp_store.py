@@ -12,8 +12,16 @@ import ctypes
 import os
 import subprocess
 import threading
+import time
 
-__all__ = ["TCPStore", "Watchdog"]
+from . import fault as _fault
+
+__all__ = ["TCPStore", "Watchdog", "StoreTimeoutError"]
+
+
+class StoreTimeoutError(RuntimeError):
+    """A blocking get() expired — the key never arrived. NOT retried (the
+    wait already consumed the full deadline)."""
 
 _LIB = None
 _LIB_LOCK = threading.Lock()
@@ -68,54 +76,134 @@ class TCPStore:
         lib = _load_lib()
         self._lib = lib
         self._server = None
+        self._client = None
+        self._host = host
+        self._port = int(port)
         self._timeout_ms = int(timeout * 1000)
         if is_master:
             self._server = lib.pd_store_server_start(port)
             if not self._server:
                 raise RuntimeError(f"TCPStore master failed to bind :{port}")
-        self._client = lib.pd_store_client_connect(
-            host.encode(), port, self._timeout_ms)
-        if not self._client:
+        try:
+            self._connect()
+        except Exception:
             if self._server:
                 lib.pd_store_server_stop(self._server)
-            raise RuntimeError(f"TCPStore could not connect {host}:{port}")
+                self._server = None
+            raise
+
+    def _connect(self):
+        """Connect with exponential backoff + deadline: a worker that comes
+        up before the master has bound its port must outwait it instead of
+        dying on the first refused connection (ISSUE tentpole (2))."""
+        deadline = min(self._timeout_ms / 1000.0,
+                       float(os.environ.get(
+                           "PADDLE_TPU_STORE_CONNECT_DEADLINE", "30")))
+
+        def once():
+            c = self._lib.pd_store_client_connect(
+                self._host.encode(), self._port, self._timeout_ms)
+            if not c:
+                raise ConnectionError(
+                    f"TCPStore could not connect "
+                    f"{self._host}:{self._port}")
+            self._client = c
+
+        try:
+            _fault.retry(once, retry_on=(ConnectionError,), attempts=None,
+                         base=0.05, cap=1.0, deadline=deadline)
+        except ConnectionError as e:
+            raise RuntimeError(f"{e} (gave up after {deadline:.0f}s of "
+                               "backoff)") from None
+
+    def _drop_connection(self):
+        if self._client:
+            try:
+                self._lib.pd_store_client_close(self._client)
+            except Exception:
+                pass
+            self._client = None
+
+    def _op(self, fn, idempotent=True):
+        """Run one store op; on a dropped/failed connection reconnect with
+        backoff and retry (bounded). A blocking-get timeout is NOT retried
+        — it already consumed its full deadline. Non-idempotent ops (add)
+        are never re-issued after a mid-op failure: the server may have
+        applied the first attempt and a double-applied add would release a
+        barrier early — only the reconnect of an already-dead client is
+        retried for those. The injected ``store_drop`` fault severs the
+        socket *before* the op is issued, so it exercises exactly that
+        safe path."""
+        if _fault.maybe_inject("store") == "store_drop":
+            self._drop_connection()
+        delays = _fault.Backoff(base=0.05, cap=0.5).delays()
+        for attempt in range(3):
+            if self._client is None:
+                self._connect()
+            try:
+                return fn()
+            except StoreTimeoutError:
+                raise
+            except (RuntimeError, ConnectionError):
+                self._drop_connection()
+                if not idempotent or attempt == 2:
+                    raise
+                time.sleep(next(delays, 0.1))
 
     def set(self, key: str, value) -> None:
         data = value if isinstance(value, bytes) else str(value).encode()
-        rc = self._lib.pd_store_set(self._client, key.encode(), data,
-                                    len(data))
-        if rc != 0:
-            raise RuntimeError(f"TCPStore.set({key!r}) failed")
 
-    def get(self, key: str) -> bytes:
-        cap = 1 << 20
-        buf = ctypes.create_string_buffer(cap)
-        n = self._lib.pd_store_get(self._client, key.encode(),
-                                   self._timeout_ms, buf, cap)
-        if n == -3:  # value larger than the fast-path buffer: retry at the
-            cap = 64 << 20  # server's max accepted value size
+        def do():
+            rc = self._lib.pd_store_set(self._client, key.encode(), data,
+                                        len(data))
+            if rc != 0:
+                raise RuntimeError(f"TCPStore.set({key!r}) failed")
+
+        self._op(do)
+
+    def get(self, key: str, timeout=None) -> bytes:
+        """Blocking get. ``timeout`` (seconds) overrides the store-level
+        deadline for this one call — e.g. a preemption-bounded barrier."""
+        timeout_ms = self._timeout_ms if timeout is None \
+            else max(1, int(timeout * 1000))
+
+        def do():
+            cap = 1 << 20
             buf = ctypes.create_string_buffer(cap)
             n = self._lib.pd_store_get(self._client, key.encode(),
-                                       self._timeout_ms, buf, cap)
-        if n == -1:
-            raise RuntimeError(
-                f"TCPStore.get({key!r}) timed out after "
-                f"{self._timeout_ms} ms")
-        if n < 0:
-            raise RuntimeError(f"TCPStore.get({key!r}) failed ({n})")
-        return buf.raw[:n]
+                                       timeout_ms, buf, cap)
+            if n == -3:  # value larger than the fast-path buffer: retry at
+                cap = 64 << 20  # the server's max accepted value size
+                buf = ctypes.create_string_buffer(cap)
+                n = self._lib.pd_store_get(self._client, key.encode(),
+                                           timeout_ms, buf, cap)
+            if n == -1:
+                raise StoreTimeoutError(
+                    f"TCPStore.get({key!r}) timed out after "
+                    f"{timeout_ms} ms")
+            if n < 0:
+                raise RuntimeError(f"TCPStore.get({key!r}) failed ({n})")
+            return buf.raw[:n]
+
+        return self._op(do)
 
     def add(self, key: str, amount: int = 1) -> int:
-        v = self._lib.pd_store_add(self._client, key.encode(), amount)
-        if v == -(2 ** 63):
-            raise RuntimeError(f"TCPStore.add({key!r}) failed")
-        return int(v)
+        def do():
+            v = self._lib.pd_store_add(self._client, key.encode(), amount)
+            if v == -(2 ** 63):
+                raise RuntimeError(f"TCPStore.add({key!r}) failed")
+            return int(v)
+
+        return self._op(do, idempotent=False)
 
     def check(self, key: str) -> bool:
-        rc = self._lib.pd_store_check(self._client, key.encode())
-        if rc < 0:
-            raise RuntimeError(f"TCPStore.check({key!r}) failed")
-        return bool(rc)
+        def do():
+            rc = self._lib.pd_store_check(self._client, key.encode())
+            if rc < 0:
+                raise RuntimeError(f"TCPStore.check({key!r}) failed")
+            return bool(rc)
+
+        return self._op(do)
 
     def wait(self, keys, timeout=None):
         keys = [keys] if isinstance(keys, str) else list(keys)
@@ -123,14 +211,18 @@ class TCPStore:
             self.get(k)  # blocking get IS the wait
 
     def delete_key(self, key: str) -> bool:
-        return self._lib.pd_store_delete(self._client, key.encode()) == 0
+        return self._op(
+            lambda: self._lib.pd_store_delete(self._client,
+                                              key.encode()) == 0)
 
-    def barrier(self, name: str, world_size: int):
-        """add+wait barrier (reference masterDaemon barrier pattern)."""
+    def barrier(self, name: str, world_size: int, timeout=None):
+        """add+wait barrier (reference masterDaemon barrier pattern).
+        ``timeout`` bounds the wait (StoreTimeoutError) — a dead peer must
+        not hold a preempting rank past the launcher's kill grace."""
         n = self.add(f"__barrier/{name}", 1)
         if n >= world_size:
             self.set(f"__barrier/{name}/done", b"1")
-        self.get(f"__barrier/{name}/done")
+        self.get(f"__barrier/{name}/done", timeout=timeout)
 
     def __del__(self):
         try:

@@ -68,7 +68,12 @@ def get_step_watchdog():
 def beat():
     """Heartbeat — called by the training-step entry points. The beat lands
     BEFORE the step executes: if the step hangs, the missing next beat
-    trips the timeout."""
+    trips the timeout. Doubles as the chaos harness's ``step`` injection
+    site: every staged train step (``to_static`` whole-step call, both
+    pipeline ``train_batch`` paths) funnels through here, so
+    ``crash@step:N`` fires deterministically before the Nth step runs."""
+    from . import fault as _fault
+    _fault.maybe_inject("step")
     wd = get_step_watchdog()
     if wd is not None:
         wd.beat()

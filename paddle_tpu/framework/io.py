@@ -50,11 +50,17 @@ def _unpack(obj, return_numpy=False):
 
 
 def save(obj, path, protocol=4, **configs):
+    path = str(path)
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
-    with open(path, "wb") as f:
-        pickle.dump(_pack(obj), f, protocol=protocol)
+    # atomic publish: a kill mid-write must leave either the old file or
+    # the new one, never a torn pickle; pickle streams straight into the
+    # temp file — no full in-memory blob (lazy import: fault.py is
+    # stdlib-only but lives under the heavier distributed package)
+    packed = _pack(obj)
+    from ..distributed.fault import atomic_write
+    atomic_write(path, lambda f: pickle.dump(packed, f, protocol=protocol))
 
 
 def load(path, return_numpy=False, **configs):

@@ -20,6 +20,9 @@ import pytest  # noqa: E402
 
 
 def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running multiprocess/chaos test "
+        "(deselected by the tier-1 `-m 'not slow'` run)")
     assert jax.devices()[0].platform == "cpu", "tests must run on CPU mesh"
     assert len(jax.devices()) == 8, "expected 8 virtual CPU devices"
 

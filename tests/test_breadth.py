@@ -638,7 +638,10 @@ def test_vision_models_surface_complete():
     """All 51 reference vision model names exist."""
     import ast
     from paddle_tpu.vision import models as M
-    src = open("/root/reference/python/paddle/vision/__init__.py").read()
+    ref_init = "/root/reference/python/paddle/vision/__init__.py"
+    if not os.path.exists(ref_init):
+        pytest.skip("reference PaddlePaddle checkout not present")
+    src = open(ref_init).read()
     ref = []
     for node in ast.walk(ast.parse(src)):
         if isinstance(node, ast.ImportFrom) and node.module \

@@ -26,6 +26,10 @@ def _clean_env(port):
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
     env["JAX_PLATFORMS"] = "cpu"
     env["PADDLE_TPU_MASTER"] = f"127.0.0.1:{port}"
+    # direct (non-launcher) worker runs need the import path too
+    env["PYTHONPATH"] = os.pathsep.join(
+        [REPO] + [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+                  if p and p != REPO])
     return env
 
 

@@ -1,0 +1,24 @@
+"""Parsers for ft_worker.py's stdout marker contract.
+
+Side-effect free (no jax/paddle imports) so both the chaos tests
+(tests/test_fault_tolerance.py) and bench.py --chaos can share the one
+definition of the marker grammar — LOSS/STEP_DONE/CKPT_*_MS lines
+documented in ft_worker.py's docstring.
+"""
+import re
+
+LOSS_RE = re.compile(r"LOSS (\d+) ([\d.eE+-]+)")
+
+
+def parse_losses(text):
+    """step -> loss for every LOSS line (later lines win, matching the
+    resume semantics: a recomputed step overwrites the pre-crash one)."""
+    return {int(m.group(1)): float(m.group(2))
+            for m in LOSS_RE.finditer(text)}
+
+
+def parse_stamps(text, name):
+    """All float payloads of marker ``name`` (e.g. CKPT_SAVE_MS, or
+    ``STEP_DONE \\d+`` whose payload is the wall-clock stamp)."""
+    return [float(m.group(1))
+            for m in re.finditer(rf"{name} ([\d.eE+-]+)", text)]

@@ -1,0 +1,118 @@
+"""Fault-tolerance chaos worker (tests/test_fault_tolerance.py, bench --chaos).
+
+Trains a deterministic Linear regression for N steps under the launcher,
+checkpointing every step through the verified lineage layer
+(fault.CheckpointLineage). On start it resumes from the newest COMPLETE
+checkpoint, so an injected crash (PADDLE_TPU_FAULTS="crash@step:K"), a torn
+shard write, or a SIGTERM preemption must all recover to the exact same
+loss trajectory as an uninterrupted run.
+
+Markers on stdout (one per line, parsed by the tests):
+    RESUMED <step>            resumed from a verified snapshot at <step>
+    FRESH                     no usable snapshot, starting from step 0
+    LOSS <step> <value>       per-step loss (repr precision)
+    CKPT_SAVE_MS <ms>         lineage save latency for that step
+    CKPT_VERIFY_MS <ms>       verify_checkpoint latency at resume
+    STEP_DONE <step> <wall>   wall-clock stamp after save completes
+    PREEMPT_SAVED <step>      graceful SIGTERM save before exit 75
+
+Env knobs: PADDLE_TPU_CKPT_DIR (required), PADDLE_TPU_FT_STEPS (default 6),
+PADDLE_TPU_FT_STORE_PORT (commit-barrier TCPStore, multi-process only),
+PADDLE_TPU_FT_PREEMPT_AT (self-SIGTERM before that step on the first
+incarnation — models the scheduler's preemption notice).
+"""
+import os
+import sys
+import time
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=1")
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed import checkpoint as dckpt
+from paddle_tpu.distributed import fault
+from paddle_tpu.jit import to_static
+
+
+def main():
+    dist.init_parallel_env()
+    world = jax.process_count()
+    rank = jax.process_index()
+    n_steps = int(os.environ.get("PADDLE_TPU_FT_STEPS", "6"))
+    root = os.environ["PADDLE_TPU_CKPT_DIR"]
+    preempt_at = os.environ.get("PADDLE_TPU_FT_PREEMPT_AT")
+    incarnation = int(os.environ.get("PADDLE_TPU_RESTART_NUM", "0"))
+
+    store = None
+    port = os.environ.get("PADDLE_TPU_FT_STORE_PORT")
+    if port and world > 1:
+        store = dist.TCPStore("127.0.0.1", int(port), is_master=(rank == 0),
+                              world_size=world, timeout=120)
+    lineage = fault.CheckpointLineage(root, store=store, world_size=world,
+                                      rank=rank)
+
+    paddle.seed(0)
+    X = np.random.RandomState(42).randn(32, 16).astype("float32")
+    Y = X @ np.random.RandomState(7).randn(16, 4).astype("float32")
+    model = nn.Linear(16, 4)
+    opt = paddle.optimizer.SGD(learning_rate=0.05,
+                               parameters=model.parameters())
+
+    def train_step(xb, yb):
+        loss = F.mse_loss(model(xb), yb)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    step_fn = to_static(train_step, capture=(model, opt))
+    xb = paddle.to_tensor(X)
+    yb = paddle.to_tensor(Y)
+
+    # -- resume from the newest complete verified snapshot --
+    target = {"model": model.state_dict(), "step": 0}
+    start = 0
+    resumed = lineage.load_latest(target)
+    if resumed is not None:
+        start = int(target["step"])
+        t0 = time.perf_counter()
+        dckpt.verify_checkpoint(lineage.step_dir(resumed))
+        print(f"CKPT_VERIFY_MS {(time.perf_counter() - t0) * 1e3:.2f}",
+              flush=True)
+        print(f"RESUMED {start}", flush=True)
+    else:
+        print("FRESH", flush=True)
+
+    fault.install_preemption_handler()
+
+    for i in range(start, n_steps):
+        if preempt_at is not None and incarnation == 0 \
+                and i == int(preempt_at):
+            # the scheduler's preemption notice; first incarnation only —
+            # the handler sets the flag, the poll below acts on it
+            os.kill(os.getpid(), 15)
+        if fault.preempted():
+            print(f"PREEMPT_SAVED {i}", flush=True)
+            fault.exit_preempted(
+                lambda: lineage.save(
+                    {"model": model.state_dict(), "step": i}, step=i))
+        loss = step_fn(xb, yb)
+        print(f"LOSS {i} {float(loss.numpy())!r}", flush=True)
+        t0 = time.perf_counter()
+        lineage.save({"model": model.state_dict(), "step": i + 1},
+                     step=i + 1)
+        print(f"CKPT_SAVE_MS {(time.perf_counter() - t0) * 1e3:.2f}",
+              flush=True)
+        print(f"STEP_DONE {i} {time.time():.6f}", flush=True)
+    sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -539,6 +539,26 @@ def _log(msg):
     print(msg, file=sys.stderr, flush=True)
 
 
+def _chaos_child_env(repo):
+    """Hermetic env for chaos worker subprocesses: CPU jax, single host
+    device, repo on PYTHONPATH, no inherited fault/trainer state — and no
+    shared persistent jit cache (bench.py sets one for itself at import):
+    a worker SIGKILLed mid-cache-write leaves a torn entry whose
+    deserialization corrupts a later incarnation's heap."""
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("PADDLE_TPU_", "PADDLE_TRAINER"))}
+    env.pop("JAX_COMPILATION_CACHE_DIR", None)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+        # prepend, never clobber: the parent's PYTHONPATH may carry deps
+        "PYTHONPATH": os.pathsep.join(
+            [repo] + [p for p in os.environ.get(
+                "PYTHONPATH", "").split(os.pathsep) if p and p != repo]),
+    })
+    return env
+
+
 def run_chaos_smoke(steps=6):
     """``--chaos`` smoke mode: a launcher-managed CPU run with one injected
     crash + one torn shard write (distributed/fault.py); asserts the
@@ -557,17 +577,8 @@ def run_chaos_smoke(steps=6):
         sys.path.insert(0, workers_dir)
     from ft_markers import parse_losses as losses, parse_stamps as stamps
     tmp = tempfile.mkdtemp(prefix="pd_chaos_")
-    base_env = {k: v for k, v in os.environ.items()
-                if not k.startswith(("PADDLE_TPU_", "PADDLE_TRAINER"))}
-    base_env.update({
-        "JAX_PLATFORMS": "cpu",
-        "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
-        # prepend, never clobber: the parent's PYTHONPATH may carry deps
-        "PYTHONPATH": os.pathsep.join(
-            [repo] + [p for p in os.environ.get(
-                "PYTHONPATH", "").split(os.pathsep) if p and p != repo]),
-        "PADDLE_TPU_FT_STEPS": str(steps),
-    })
+    base_env = _chaos_child_env(repo)
+    base_env["PADDLE_TPU_FT_STEPS"] = str(steps)
     try:
         env = dict(base_env,
                    PADDLE_TPU_CKPT_DIR=os.path.join(tmp, "ck_ref"))
@@ -624,9 +635,85 @@ def run_chaos_smoke(steps=6):
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def run_elastic_chaos(epochs=2, batches=6):
+    """``--chaos`` elastic leg: SIGKILL one worker of a 3-worker elastic
+    job (``--np 2:3``, hapi.Model.fit + CheckpointLineage) and measure the
+    scale-event recovery time — the killed rank's SELF_SIGKILL stamp to
+    the survivors' first post-resume BATCH stamp at world_size=2 — so
+    elastic regressions show up in the perf trajectory alongside the
+    checkpoint latency numbers."""
+    import re
+    import shutil
+    import subprocess
+    import tempfile
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    workers_dir = os.path.join(repo, "tests", "workers")
+    if workers_dir not in sys.path:
+        sys.path.insert(0, workers_dir)
+    from ft_markers import free_port as _free_port
+    from ft_markers import read_worker_logs
+    worker = os.path.join(workers_dir, "elastic_worker.py")
+    tmp = tempfile.mkdtemp(prefix="pd_elastic_")
+    log_dir = os.path.join(tmp, "logs")
+    env = _chaos_child_env(repo)
+    env.update({
+        "PADDLE_TPU_CKPT_DIR": os.path.join(tmp, "ck"),
+        "PADDLE_TPU_FT_STORE_PORT": str(_free_port()),
+        "PADDLE_TPU_FT_EPOCHS": str(epochs),
+        "PADDLE_TPU_FT_BATCHES": str(batches),
+        "PADDLE_TPU_FT_INTERVAL": "1",
+        "PADDLE_TPU_ELASTIC_KILL": "2:2",   # rank 2: SIGKILL at batch 2
+    })
+    try:
+        r = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--np", "2:3", "--master", f"127.0.0.1:{_free_port()}",
+             "--elastic_port", str(_free_port()),
+             "--terminate_grace", "5", "--log_dir", log_dir, worker],
+            env=env, capture_output=True, text=True, timeout=600, cwd=repo)
+        scaled = ("scale event" in r.stderr
+                  and "relaunching at world_size=2" in r.stderr)
+
+        def _log_of(rank):
+            return read_worker_logs(log_dir, rank)
+
+        kill_stamps = [float(m.group(1)) for m in re.finditer(
+            r"SELF_SIGKILL ([\d.]+)", _log_of(2))]
+        resumed = 0
+        first_batch = []
+        for rank in (0, 1):
+            log = _log_of(rank)
+            if re.search(r"RESUMED epoch=\d+ step=\d+", log):
+                resumed += 1
+            round1 = log.split("WORLD 2", 1)
+            if len(round1) == 2:
+                m = re.search(r"BATCH \d+ \d+ \d+ ([\d.]+)", round1[1])
+                if m:
+                    first_batch.append(float(m.group(1)))
+        ok = (r.returncode == 0 and scaled and resumed == 2
+              and bool(kill_stamps) and len(first_batch) == 2)
+        out = {"elastic_scale_ok": ok}
+        if kill_stamps and first_batch:
+            out["elastic_recovery_s"] = round(
+                min(first_batch) - kill_stamps[0], 3)
+        if not ok:
+            out["elastic_error"] = (
+                "rc=%d scaled=%s resumed=%d/2: %s" % (
+                    r.returncode, scaled, resumed, r.stderr[-300:]))
+        return out
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def main_chaos():
     sub = run_chaos_smoke()
-    ok = bool(sub.get("chaos_resume_ok"))
+    try:
+        sub.update(run_elastic_chaos())
+    except Exception as e:  # keep the smoke leg's numbers on the wire
+        sub.update({"elastic_scale_ok": False,
+                    "elastic_error": repr(e)[-300:]})
+    ok = bool(sub.get("chaos_resume_ok")) and bool(sub.get("elastic_scale_ok"))
     print(json.dumps({
         "metric": "chaos_recovery_s",
         "value": sub.get("chaos_recovery_s", 0.0),

@@ -46,11 +46,12 @@ from .comm_extra import (  # noqa: F401
     to_static, wait,
 )
 from .checkpoint import (  # noqa: F401
-    CheckpointCorruptError, load_state_dict, save_state_dict,
-    verify_checkpoint,
+    AsyncSaveHandle, CheckpointCorruptError, load_state_dict,
+    save_state_dict, verify_checkpoint,
 )
 from .auto_tuner import AutoTuner  # noqa: F401
-from .elastic import ElasticManager, ElasticStatus  # noqa: F401
+from .elastic import ElasticManager, ElasticStatus, worker_from_env  # noqa: F401
+from .resumable import ResumableTraining  # noqa: F401
 from .topology import (  # noqa: F401
     CommunicateTopology, HybridCommunicateGroup, build_mesh,
     get_hybrid_communicate_group,

@@ -243,19 +243,50 @@ class Engine:
                                capture=(self.model, self.optimizer))
 
     def fit(self, train_data, epochs=1, batch_size=None, verbose=0,
-            steps_per_epoch=None):
+            steps_per_epoch=None, lineage=None, snapshot_interval=None,
+            async_snapshot=False):
+        """``lineage`` (CheckpointLineage or root path) makes this bare
+        loop resumable exactly like ``hapi.Model.fit``: restore model /
+        optimizer / RNG / position, skip already-consumed batches of the
+        resumed epoch, snapshot on the interval + epoch boundaries
+        (optionally overlapped), SIGTERM → save + exit 75."""
         import numpy as np
         if self.strategy is None:
             self.prepare()
         if self._step is None:
             self._build_step()
+        rt = None
+        if lineage is not None:
+            from ..resumable import ResumableTraining
+            rt = ResumableTraining(
+                lineage, network=self.model, optimizer=self.optimizer,
+                interval=snapshot_interval, async_snapshot=async_snapshot)
+            rt.restore()
         history = []
-        for _ in range(epochs):
-            for i, batch in enumerate(train_data):
-                if steps_per_epoch is not None and i >= steps_per_epoch:
-                    break
-                loss = self._step(*batch)
-                history.append(float(np.asarray(loss.numpy())))
+        try:
+            for epoch in range(rt.epoch if rt is not None else 0, epochs):
+                for i, batch in enumerate(train_data):
+                    if steps_per_epoch is not None and i >= steps_per_epoch:
+                        break
+                    if rt is not None:
+                        if rt.skip_batch(epoch, i):
+                            continue
+                        rt.poll_preempt(epoch, i)
+                    loss = self._step(*batch)
+                    history.append(float(np.asarray(loss.numpy())))
+                    if rt is not None:
+                        rt.step_done(epoch, i)
+                if rt is not None:
+                    rt.epoch_done(epoch)
+        except BaseException:
+            if rt is not None:
+                try:
+                    rt.finalize()  # keep the last snapshot intact
+                except Exception:
+                    pass  # never mask the training error
+            raise
+        if rt is not None:
+            rt.finalize()
         return history
 
     def evaluate(self, eval_data, steps=None):

@@ -17,6 +17,7 @@ from __future__ import annotations
 import json
 import os
 import pickle
+import threading
 import zlib
 
 import jax
@@ -27,7 +28,7 @@ from ..core.tensor import Tensor
 from .fault import atomic_write, atomic_write_bytes, maybe_inject
 
 __all__ = ["save_state_dict", "load_state_dict", "verify_checkpoint",
-           "CheckpointCorruptError"]
+           "AsyncSaveHandle", "CheckpointCorruptError"]
 
 
 class CheckpointCorruptError(RuntimeError):
@@ -90,6 +91,114 @@ def verify_checkpoint(path):
                 f"{int(rec['crc32']):#010x} (corrupt shard)")
 
 
+class AsyncSaveHandle:
+    """One overlapped async snapshot (``save_state_dict(async_save=True)``).
+
+    The calling (training) thread returns as soon as the device buffers
+    are snapshotted to host — serialization of the npz archive, the
+    per-file CRC32, the disk IO, and any registered done-callbacks (the
+    lineage's commit barrier + LATEST flip) all run on this handle's
+    completion thread. File bytes stream through the native writer pool
+    (ckpt_io.AsyncCheckpointWriter) with ONE worker, so the FIFO ordering
+    shard → metadata → manifest survives: a kill between any two files
+    can never publish a manifest over missing shards.
+
+    The manifest (written last, recording each file's intended CRC32 +
+    size) is computed from the exact bytes handed to the writer, so
+    load-time verification can prove the commit covers the bytes on
+    disk. ``wait()`` blocks until everything — including callbacks —
+    finished, re-raising any background failure. Chaos: ``async_torn``
+    (site ``async_ckpt``) truncates the landed shard while the manifest
+    keeps the intended CRC — exactly a writer killed mid-overlap;
+    load-time verification must reject it and fall back.
+    """
+
+    def __init__(self, path, rank, shards, shard_file, meta_file,
+                 meta_bytes, manifest_fn, fault_kind=None):
+        self._done = threading.Event()
+        self._error = None
+        self._callbacks = []
+        self._cb_lock = threading.Lock()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"ckpt-async-{os.path.basename(path)}",
+            args=(path, rank, shards, shard_file, meta_file, meta_bytes,
+                  manifest_fn, fault_kind))
+        self._thread.start()
+
+    def _run(self, path, rank, shards, shard_file, meta_file, meta_bytes,
+             manifest_fn, fault_kind):
+        import io as _io
+        from .ckpt_io import AsyncCheckpointWriter
+        writer = None
+        try:
+            buf = _io.BytesIO()
+            np.savez(buf, **shards)
+            view = buf.getbuffer()
+            # the manifest records the bytes we INTEND to land, so a
+            # torn write disagrees with it at load time
+            manifest_bytes = manifest_fn(
+                zlib.crc32(view) & 0xFFFFFFFF, view.nbytes)
+            shard_write = view
+            torn = (fault_kind == "torn_write"
+                    or maybe_inject("async_ckpt") == "async_torn")
+            if torn:
+                shard_write = view[:max(1, view.nbytes // 2)]
+            writer = AsyncCheckpointWriter(n_threads=1)
+            writer.submit(os.path.join(path, shard_file), shard_write)
+            writer.submit(os.path.join(path, meta_file), meta_bytes)
+            writer.submit(os.path.join(path, f"manifest_{rank}.json"),
+                          manifest_bytes)
+            writer.wait()  # raises if any file failed to land
+            if not torn:
+                # a torn overlap models a writer KILLED mid-stream — such
+                # a process never reaches its commit, so the callbacks
+                # (lineage barrier + LATEST flip) must not run either
+                for cb in self._drain_callbacks():
+                    cb()
+        except BaseException as e:  # surfaced at wait()
+            self._error = e
+        finally:
+            if writer is not None:
+                writer.close()
+            self._done.set()
+
+    def _drain_callbacks(self):
+        with self._cb_lock:
+            cbs, self._callbacks = self._callbacks, None
+            return cbs
+
+    def add_done_callback(self, cb):
+        """Run ``cb()`` on the completion thread once the snapshot is
+        durable (inline if it already is). The lineage registers its
+        commit here — the barrier overlaps with training too."""
+        with self._cb_lock:
+            if self._callbacks is not None:
+                self._callbacks.append(cb)
+                return
+        if self._error is None:
+            cb()
+
+    def wait(self, timeout=None) -> bool:
+        """True once the snapshot is durable and callbacks ran (re-raises
+        a background failure); False if ``timeout`` expired first."""
+        if not self._done.wait(timeout):
+            return False
+        if self._error is not None:
+            raise self._error
+        return True
+
+    def close(self):
+        """API-compat with the raw writer handle (resources are released
+        by the completion thread itself)."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
 def _flatten(d, prefix=""):
     out = {}
     for k, v in d.items():
@@ -105,11 +214,12 @@ def save_state_dict(state_dict, path, process_group=None,
                     coordinator_rank=0, unique_id=None, async_save=False):
     """Reference: distributed/checkpoint/save_state_dict.py:77.
 
-    ``async_save=True`` hands the serialized shard + metadata files to the
-    native C++ IO worker pool (core/native/ckpt_io.cpp): device buffers
-    are snapshotted synchronously (cheap D2H), disk IO runs off-thread
-    with fsync + atomic rename, and the returned handle's ``wait()``
-    blocks until the snapshot is durable."""
+    ``async_save=True`` returns an :class:`AsyncSaveHandle`: device
+    buffers are snapshotted synchronously (cheap D2H), then archive
+    serialization, per-file CRC futures AND the disk IO (native C++
+    worker pool, core/native/ckpt_io.cpp, fsync + atomic rename) overlap
+    with training on the handle's completion thread; ``wait()`` blocks
+    until the snapshot is durable and its done-callbacks ran."""
     os.makedirs(path, exist_ok=True)
     flat = _flatten(state_dict)
     rank = jax.process_index()
@@ -166,35 +276,25 @@ def save_state_dict(state_dict, path, process_group=None,
 
     shard_path = os.path.join(path, shard_file)
     fault_kind = maybe_inject("ckpt")
-    if async_save or fault_kind == "torn_write":
-        # both need the serialized archive in memory: the async pool is
-        # handed a buffer, and a torn write must know the INTENDED crc of
-        # bytes it deliberately truncates. memoryview end-to-end — CRC,
-        # torn slice, and submit all read the ONE BytesIO buffer
+    if async_save:
+        # OVERLAPPED path: the device→host snapshot above is all the
+        # training thread pays — serialization, CRC, IO and the commit
+        # callback stream on the handle's completion thread
+        return AsyncSaveHandle(path, rank, shards, shard_file, meta_file,
+                               meta_bytes, _manifest_bytes, fault_kind)
+    if fault_kind == "torn_write":
+        # chaos harness: a torn write must know the INTENDED crc of bytes
+        # it deliberately truncates, so serialize in memory, then land a
+        # truncated shard at the FINAL path (models a non-atomic writer
+        # killed mid-stream); load-time validation must catch the
+        # manifest disagreement
         import io as _io
         buf = _io.BytesIO()
         np.savez(buf, **shards)
         shard_view = buf.getbuffer()
         manifest_bytes = _manifest_bytes(
             zlib.crc32(shard_view) & 0xFFFFFFFF, shard_view.nbytes)
-        shard_write = shard_view
-        if fault_kind == "torn_write":
-            # chaos harness: land a truncated shard at the FINAL path
-            # (models a non-atomic writer killed mid-stream); load-time
-            # validation must catch the manifest disagreement
-            shard_write = shard_view[:max(1, shard_view.nbytes // 2)]
-        if async_save:
-            from .ckpt_io import AsyncCheckpointWriter
-            # ONE worker => strict FIFO: the shard file is durable
-            # (renamed) before the metadata that references it starts, and
-            # the manifest lands last — a crash between any two can't
-            # publish a manifest over missing shards
-            writer = AsyncCheckpointWriter(n_threads=1)
-            writer.submit(shard_path, shard_write)
-            writer.submit(os.path.join(path, meta_file), meta_bytes)
-            writer.submit(os.path.join(path, f"manifest_{rank}.json"),
-                          manifest_bytes)
-            return writer
+        shard_write = shard_view[:max(1, shard_view.nbytes // 2)]
         with open(shard_path, "wb") as f:
             f.write(shard_write)
             f.flush()

@@ -17,7 +17,7 @@ import time
 
 from .tcp_store import TCPStore
 
-__all__ = ["ElasticManager", "ElasticStatus"]
+__all__ = ["ElasticManager", "ElasticStatus", "worker_from_env"]
 
 
 class ElasticStatus:
@@ -52,6 +52,7 @@ class ElasticManager:
         self._name = None
         self._beat_thread = None
         self._stop = threading.Event()
+        self._join_cache = {}  # idx -> name; join-log entries are immutable
 
     @staticmethod
     def _parse_np(np_spec):
@@ -69,7 +70,11 @@ class ElasticManager:
         self._name = name or f"{os.uname().nodename}-{os.getpid()}"
         self.store.set(f"{self._prefix}/hosts/{self._name}",
                        str(time.time()))
-        members = self.store.add(f"{self._prefix}/known", 0)  # touch
+        # append to the join sequence: the manager discovers members it did
+        # not announce (node-join → scale-out) by scanning this log, since
+        # the TCPStore has no key enumeration
+        idx = self.store.add(f"{self._prefix}/join_seq", 1)
+        self.store.set(f"{self._prefix}/join/{idx}", self._name)
         self._stop.clear()
         self._beat_thread = threading.Thread(target=self._beat_loop,
                                              daemon=True)
@@ -89,13 +94,46 @@ class ElasticManager:
         if self._name:
             self.store.set(f"{self._prefix}/hosts/{self._name}", "0")
 
+    def joined_names(self):
+        """Every member that ever registered, in join order (the join-seq
+        log survives deaths; liveness is the heartbeat's job). Resolved
+        entries are cached — the log is append-only and immutable — so
+        the launcher's ~5 Hz poll costs one ``add`` round-trip at steady
+        state instead of a full rescan; only still-unresolved indices
+        (a registrant between its seq bump and its name write, or one
+        that died in that window) are re-probed."""
+        try:
+            n = int(self.store.add(f"{self._prefix}/join_seq", 0))
+        except Exception:
+            return []
+        out = []
+        for i in range(1, n + 1):
+            name = self._join_cache.get(i)
+            if name is None:
+                key = f"{self._prefix}/join/{i}"
+                if not self.store.check(key):
+                    continue
+                name = self.store.get(key).decode()
+                self._join_cache[i] = name
+            out.append(name)
+        return out
+
+    def new_joins(self, known):
+        """Names that registered but are NOT in ``known`` — the launcher's
+        scale-out trigger (a freshly joined node widens the world)."""
+        known = set(known)
+        return [n for n in self.joined_names() if n not in known]
+
     def hosts(self):
-        """Live members (heartbeat within ttl)."""
+        """Live members (heartbeat within ttl): the announced roster plus
+        any later joiner from the join-seq log."""
         names = self.store.get(f"{self._prefix}/roster").decode() \
             if self.store.check(f"{self._prefix}/roster") else ""
+        candidates = list(dict.fromkeys(
+            list(filter(None, names.split(","))) + self.joined_names()))
         alive = []
         now = time.time()
-        for name in filter(None, names.split(",")):
+        for name in candidates:
             key = f"{self._prefix}/hosts/{name}"
             if not self.store.check(key):
                 continue
@@ -120,15 +158,23 @@ class ElasticManager:
         within [min_np, max_np]; EXIT when it fell below min_np for longer
         than ttl; COMPLETED when the completion flag is set; HOLD when
         max_wait elapses with no event."""
-        roster = self.store.get(f"{self._prefix}/roster").decode() \
-            if self.store.check(f"{self._prefix}/roster") else ""
+        try:
+            roster = self.store.get(f"{self._prefix}/roster").decode() \
+                if self.store.check(f"{self._prefix}/roster") else ""
+        except Exception:
+            return ElasticStatus.ERROR
         baseline = set(filter(None, roster.split(",")))
         waited = 0.0
         below_since = None
         while True:
-            if self.store.check(f"{self._prefix}/completed"):
-                return ElasticStatus.COMPLETED
-            live = set(self.hosts())
+            try:
+                if self.store.check(f"{self._prefix}/completed"):
+                    return ElasticStatus.COMPLETED
+                live = set(self.hosts())
+            except Exception:
+                # dead master: the store's bounded reconnect retries were
+                # exhausted — report instead of spinning forever
+                return ElasticStatus.ERROR
             if live != baseline:
                 if len(live) >= self.min_np:
                     return ElasticStatus.RESTART
@@ -144,3 +190,33 @@ class ElasticManager:
 
     def complete(self):
         self.store.set(f"{self._prefix}/completed", "1")
+
+
+# -- worker-side bootstrap (launcher exports the env) --
+
+_env_worker = None
+_env_worker_lock = threading.Lock()
+
+
+def worker_from_env():
+    """Register this process with the launcher's elastic registry when
+    PADDLE_TPU_ELASTIC_JOB_ID is set (and start the background heartbeat).
+    Idempotent; returns the ElasticManager or None outside elastic jobs.
+    Called from init_parallel_env so every launcher-managed trainer
+    heartbeats without code changes."""
+    global _env_worker
+    job = os.environ.get("PADDLE_TPU_ELASTIC_JOB_ID")
+    store_addr = os.environ.get("PADDLE_TPU_ELASTIC_STORE")
+    if not job or not store_addr:
+        return None
+    with _env_worker_lock:
+        if _env_worker is not None:
+            return _env_worker
+        host, port = store_addr.rsplit(":", 1)
+        em = ElasticManager(
+            job, os.environ.get("PADDLE_TPU_ELASTIC_NP", "1"),
+            host=host, port=int(port),
+            ttl=float(os.environ.get("PADDLE_TPU_ELASTIC_TTL", "10")))
+        em.register(os.environ.get("PADDLE_TPU_ELASTIC_NAME"))
+        _env_worker = em
+        return em

@@ -24,6 +24,17 @@ def init_parallel_env():
     global _initialized, _world_mesh
     if _initialized:
         return _default_group()
+    # elastic jobs: register with the launcher's membership registry and
+    # start heartbeating BEFORE the (potentially slow) collective init, so
+    # the master can already see this worker as live
+    if os.environ.get("PADDLE_TPU_ELASTIC_JOB_ID"):
+        from .elastic import worker_from_env
+        try:
+            worker_from_env()
+        except Exception as e:
+            import sys
+            print(f"[elastic] worker registration failed: {e}",
+                  file=sys.stderr, flush=True)
     # multi-host: the launcher (paddle_tpu.distributed.launch analog) sets
     # coordinator env vars; jax.distributed wires DCN coordination. Group
     # init is retried with backoff: right after a launcher restart the

@@ -16,6 +16,7 @@ Four pieces, stdlib-only (importable by the launcher before jax loads):
        spec    := entry ("," entry)*
        entry   := kind ["@" site] ":" trigger ["%" rank]
        kind    := crash | hang | torn_write | store_drop | slow_io
+                | async_torn | commit_stall
        trigger := 1-based Nth matching hit that fires the fault
        rank    := only this process id injects (default: every rank)
 
@@ -71,11 +72,17 @@ EXIT_FAULT = 43      # injected crash — a real failure, consumes a restart
 EXIT_PREEMPT = 75    # graceful preemption (EX_TEMPFAIL) — resumable free
 EXIT_WATCHDOG = 17   # native watchdog abort (core/native/tcp_store.cpp)
 
-_KINDS = ("crash", "hang", "torn_write", "store_drop", "slow_io")
+_KINDS = ("crash", "hang", "torn_write", "store_drop", "slow_io",
+          "async_torn", "commit_stall")
 # a site-less (wildcard) cooperative entry only fires at sites whose
 # callers honor the returned kind — anywhere else it would burn its
-# trigger silently; crash/hang/slow_io wildcards fire at any site
-_WILDCARD_SITES = {"store_drop": ("store",), "torn_write": ("ckpt",)}
+# trigger silently; crash/hang/slow_io/commit_stall wildcards fire at
+# any site. ``async_torn`` tears a shard landed by the OVERLAPPED async
+# writer (checkpoint.AsyncSaveHandle); ``commit_stall`` sleeps inside
+# the lineage commit window (between the durability barrier and the
+# LATEST flip) so the chaos harness can kill mid-commit.
+_WILDCARD_SITES = {"store_drop": ("store",), "torn_write": ("ckpt",),
+                   "async_torn": ("async_ckpt",)}
 
 _lock = threading.Lock()
 _entries: list | None = None  # parsed spec; None = not yet loaded from env
@@ -245,6 +252,9 @@ def maybe_inject(site: str):
         elif e.kind == "slow_io":
             time.sleep(float(os.environ.get(
                 "PADDLE_TPU_FAULT_SLOW_IO_S", "1.0")))
+        elif e.kind == "commit_stall":
+            time.sleep(float(os.environ.get(
+                "PADDLE_TPU_FAULT_COMMIT_STALL_S", "5.0")))
         else:
             result = e.kind
     return result
@@ -425,6 +435,8 @@ class CheckpointLineage:
         self.rank = int(rank)
         self.keep = int(keep)
         self._warned_no_store = False
+        self._store_hostage = False  # abandoned thread may hold the store
+        self._inflight = None  # overlapped async save not yet committed
         os.makedirs(self.root, exist_ok=True)
 
     # -- layout --
@@ -465,18 +477,74 @@ class CheckpointLineage:
 
     # -- save --
     def save(self, state_dict, step: int, async_save=False) -> str:
-        """Write one snapshot and two-phase commit the LATEST pointer."""
+        """Write one snapshot and two-phase commit the LATEST pointer.
+
+        ``async_save=True`` OVERLAPS with training: device buffers are
+        snapshotted synchronously (cheap D2H), then serialization, per-
+        shard CRC, disk IO *and the commit barrier itself* run on the
+        handle's completion thread — the trainer keeps stepping while the
+        previous snapshot streams out and commits. At most one snapshot
+        is in flight: a new ``save`` (or :meth:`wait`, or ``load_latest``)
+        first drains the previous one, so the commit order matches the
+        step order and the lineage's TCPStore is never used from two
+        threads at once."""
         from . import checkpoint as _ckpt
+        if preempted():
+            # graceful-save window: the previous overlapped commit may be
+            # stuck in a barrier whose peer died BEFORE SIGTERM arrived
+            # (entered with the store's long timeout, not the preempt-
+            # bounded one) — draining unbounded here would blow past the
+            # launcher's kill grace and lose this save entirely. Bound
+            # the drain and abandon the stale handle: the snapshot we
+            # are about to write is newer than anything it could commit.
+            if not self.wait(float(os.environ.get(
+                    "PADDLE_TPU_PREEMPT_COMMIT_TIMEOUT_S", "5"))):
+                self._inflight = None
+                # the abandoned thread may be blocked INSIDE a store op,
+                # holding the client mutex — _commit must not queue
+                # behind it (it flips locally instead)
+                self._store_hostage = True
+        else:
+            self.wait()  # ≤1 in flight; completes the prior commit
         d = self.step_dir(step)
         handle = _ckpt.save_state_dict(state_dict, d, async_save=async_save)
         if handle is not None:
-            # lineage commit requires durability: drain the async writer
-            handle.wait()
-            handle.close()
+            # overlapped commit: barrier + pointer flip run from the
+            # handle's completion thread once every per-shard CRC future
+            # resolved and the files are durable
+            handle.add_done_callback(lambda: self._commit_and_prune(step))
+            self._inflight = handle
+            return d
+        self._commit_and_prune(step)
+        return d
+
+    def _commit_and_prune(self, step: int):
         self._commit(step)
         if self.rank == 0:
             self._prune()
-        return d
+
+    def wait(self, timeout=None) -> bool:
+        """Drain the in-flight overlapped snapshot (durability + commit).
+        True when nothing is pending or the drain finished; False on
+        timeout (the handle stays in flight). Errors from the background
+        write/commit re-raise here."""
+        h = self._inflight
+        if h is None:
+            return True
+        try:
+            if not h.wait(timeout):
+                return False
+        except BaseException:
+            # a failed overlapped save is finished, not in flight: keep
+            # the handle and every later save()/load_latest()/wait() —
+            # including the SIGTERM graceful-save path — re-raises the
+            # same stale error forever
+            self._inflight = None
+            h.close()
+            raise
+        self._inflight = None
+        h.close()
+        return True
 
     def _commit(self, step: int):
         """Two-phase commit of the LATEST pointer (class docstring).
@@ -490,9 +558,36 @@ class CheckpointLineage:
         uncommitted-but-complete, which ``load_latest`` still rescues
         (it scans every candidate, not just LATEST)."""
         def _flip():
+            # chaos window: ``commit_stall`` sleeps here — after the
+            # shards are durable, before the pointer names them — so a
+            # kill lands exactly mid-commit (snapshot complete but
+            # uncommitted; load_latest still rescues it)
+            maybe_inject("commit")
+            # LATEST is monotonic: an abandoned overlapped commit (e.g.
+            # one the preemption drain timed out on) waking up after a
+            # newer sync save committed must not flip the pointer BACK —
+            # the next incarnation would restore the older step and GC
+            # the newer snapshot, losing the graceful save
+            cur = self.latest_committed()
+            if cur is not None and cur >= step:
+                return
             atomic_write_bytes(os.path.join(self.root, "LATEST"),
                                os.path.basename(self.step_dir(step)).encode())
 
+        if self._store_hostage and preempted():
+            # the preempt drain abandoned a completion thread that may
+            # still be blocked inside a store op — the client's per-call
+            # mutex would serialize OUR barrier behind it for the store's
+            # FULL timeout, blowing the launcher's kill grace. Skip the
+            # barrier (the peer it would prove is likely dead anyway) and
+            # flip locally: uncommitted-but-complete snapshots are still
+            # restored by load_latest.
+            print(f"[fault] rank {self.rank}: step-{step} commit skips "
+                  "the barrier (store held by an abandoned overlapped "
+                  "commit); flipping locally", file=sys.stderr, flush=True)
+            if self.rank == 0:
+                _flip()
+            return
         if self.store is None or self.world_size <= 1:
             if self.store is None and self.world_size > 1 \
                     and not self._warned_no_store:
@@ -551,6 +646,7 @@ class CheckpointLineage:
         the pointer."""
         from . import checkpoint as _ckpt
         import shutil
+        self.wait()  # an in-flight overlapped save must land before we scan
         cands = self.candidates()
         ptr = self.latest_committed()
         ordered = [c for c in cands if c[0] == ptr] \

@@ -16,6 +16,17 @@ Fault-tolerance contract (distributed/fault.py):
 - When ``PADDLE_TPU_FAULTS`` is set, a fault ledger file under
   ``--log_dir`` is exported so deterministic injections fire once per
   job, not once per incarnation.
+
+Elastic rendezvous (``--np min:max``, reference fleet/elastic/manager.py):
+the launcher owns an ``ElasticManager`` registry (TCPStore) that every
+worker registers + heartbeats into via ``init_parallel_env``. A worker
+death that leaves the live world inside ``[min_np, max_np]`` is a *scale
+event*, not a fatal exit: survivors are torn down and the job relaunches
+with the smaller world size (``PADDLE_TRAINERS_NUM`` / ranks re-rendered);
+a node joining the registry mid-run or during the below-``min_np`` HOLD
+window widens the world back up (bounded by ``max_np``). State recovery
+across scale events is the checkpoint lineage's job (resumable trainers
+reload the newest verified snapshot).
 """
 from __future__ import annotations
 
@@ -51,6 +62,24 @@ def _parse_args(argv=None):
         help="coordinator address host:port (rank-0 host)")
     p.add_argument("--nproc_per_node", type=int, default=1,
                    help="processes per host (1 per host is the TPU model)")
+    p.add_argument("--np", default=None, dest="np_spec", metavar="MIN:MAX",
+                   help="elastic world-size range 'N' or 'min:max': worker "
+                        "loss inside the range relaunches at the smaller "
+                        "world instead of failing; joins widen it back up")
+    p.add_argument("--job_id", default=os.environ.get(
+        "PADDLE_TPU_JOB_ID", "default"),
+        help="elastic job id (registry namespace)")
+    p.add_argument("--elastic_port", type=int, default=0,
+                   help="TCPStore port of the elastic registry "
+                        "(default: master port + 1)")
+    p.add_argument("--elastic_ttl", type=float, default=10.0,
+                   help="heartbeat liveness window (seconds)")
+    p.add_argument("--elastic_timeout", type=float, default=120.0,
+                   help="HOLD: how long to wait for node joins when the "
+                        "live world fell below min_np")
+    p.add_argument("--max_elastic_events", type=int, default=16,
+                   help="runaway guard for scale-event relaunches (scale "
+                        "events do not consume --max_restarts)")
     p.add_argument("--log_dir", default="log", help="per-rank log directory")
     p.add_argument("--max_restarts", type=int, default=0,
                    help="relaunch failed workers up to N times (elastic)")
@@ -68,9 +97,14 @@ def _parse_args(argv=None):
     return p.parse_args(argv)
 
 
-def _spawn(args, local_rank, restart_count, extra_env=None):
-    global_rank = args.node_rank * args.nproc_per_node + local_rank
-    world = args.nnodes * args.nproc_per_node
+def _spawn(args, local_rank, restart_count, extra_env=None, world_np=None):
+    """Spawn one worker. ``world_np`` overrides the world size (elastic
+    relaunch at a new scale re-renders PADDLE_TRAINERS_NUM + ranks)."""
+    if world_np is not None:
+        global_rank, world = local_rank, world_np
+    else:
+        global_rank = args.node_rank * args.nproc_per_node + local_rank
+        world = args.nnodes * args.nproc_per_node
     env = dict(os.environ)
     env.update(extra_env or {})
     env.update({
@@ -123,14 +157,21 @@ def _terminate_survivors(procs, grace):
             time.sleep(0.1)
 
 
-def _wait_any_failure(procs, poll_interval=0.2):
-    """Poll ALL workers concurrently; return (rcs, first_bad) where
-    first_bad is (rc, log_path) of the earliest observed failure, or None
-    if every worker exited 0. The old sequential ``proc.wait()`` loop
-    blocked on worker 0 while a crashed peer left the survivors hung in
-    collectives forever."""
+def _wait_any_failure(procs, poll_interval=0.2, on_poll=None,
+                      settle=0.0):
+    """Poll ALL workers concurrently; return (rcs, first_bad, event) where
+    first_bad is (rc, log_path) of the earliest observed failure (None if
+    every worker exited 0) and event is the first TRUTHY value returned
+    by ``on_poll()`` (elastic join watcher; an empty join list is not an
+    event) — a pending event aborts the wait with workers still running.
+    The old sequential ``proc.wait()``
+    loop blocked on worker 0 while a crashed peer left the survivors hung
+    in collectives forever. ``settle`` keeps polling that many seconds
+    after the first failure so simultaneous deaths (a whole host lost)
+    are counted as ONE scale event, not several."""
     rcs = [None] * len(procs)
     first_bad = None
+    bad_since = None
     while any(rc is None for rc in rcs):
         for i, (proc, log_path) in enumerate(procs):
             if rcs[i] is None:
@@ -140,10 +181,76 @@ def _wait_any_failure(procs, poll_interval=0.2):
                     if rc != 0 and first_bad is None:
                         first_bad = (rc, log_path)
         if first_bad is not None and any(rc is None for rc in rcs):
-            return rcs, first_bad
+            bad_since = bad_since or time.time()
+            if time.time() - bad_since >= settle:
+                return rcs, first_bad, None
+        elif first_bad is None and on_poll is not None:
+            # a failure observed in this same sweep wins over a join: the
+            # scale-down branch re-polls joins itself, so the joiner is
+            # counted as backfill there instead of masking the loss
+            event = on_poll()
+            if event:
+                return rcs, first_bad, event
         if any(rc is None for rc in rcs):
             time.sleep(poll_interval)
-    return rcs, first_bad
+    return rcs, first_bad, None
+
+
+class _ElasticState:
+    """Launcher-side handle on the rendezvous registry: owns the master
+    TCPStore, assigns per-round worker names, and watches the join-seq for
+    outsiders (scale-out)."""
+
+    def __init__(self, args):
+        from ..elastic import ElasticManager
+        self.min_np, self.max_np = ElasticManager._parse_np(args.np_spec)
+        host, _, mport = args.master.partition(":")
+        self.host = host or "127.0.0.1"
+        self.port = args.elastic_port or int(mport or 8476) + 1
+        self.manager = ElasticManager(
+            args.job_id, args.np_spec, host=self.host, port=self.port,
+            is_master=True, ttl=args.elastic_ttl)
+        self.assigned = set()   # every name this launcher ever handed out
+        self.standby = []       # joiners seen while already at max_np
+        self.events = 0
+
+    def worker_env(self, args):
+        return {
+            "PADDLE_TPU_ELASTIC_JOB_ID": args.job_id,
+            "PADDLE_TPU_ELASTIC_STORE": f"{self.host}:{self.port}",
+            "PADDLE_TPU_ELASTIC_NP": str(args.np_spec),
+            "PADDLE_TPU_ELASTIC_TTL": str(args.elastic_ttl),
+        }
+
+    def round_names(self, spawn_round, cur_np):
+        names = [f"r{spawn_round}-w{r}" for r in range(cur_np)]
+        self.assigned.update(names)
+        self.manager.announce(names)
+        return names
+
+    def joins(self):
+        """Names registered into the job that this launcher never spawned
+        (an operator adding capacity) — ignore errors, joins are advisory."""
+        try:
+            return self.manager.new_joins(self.assigned)
+        except Exception:
+            return []
+
+    def absorb(self, names):
+        """Mark joiners as processed so one join is one scale event, not a
+        scale event per poll."""
+        self.assigned.update(names)
+
+    def hold_for_joins(self, need, deadline_s, interval=0.5):
+        """Below min_np: HOLD, waiting for at least ``need`` joiners with
+        live heartbeats (they keep beating while they wait)."""
+        deadline = time.time() + deadline_s
+        while time.time() < deadline:
+            fresh = self.joins()
+            if len(fresh) >= need:
+                return fresh
+            time.sleep(interval)
+        return self.joins()
 
 
 def launch(argv=None):
@@ -161,19 +268,82 @@ def launch(argv=None):
         os.makedirs(args.log_dir, exist_ok=True)
         extra_env["PADDLE_TPU_FAULT_LEDGER"] = os.path.abspath(
             os.path.join(args.log_dir, "fault_ledger.txt"))
+
+    elastic = None
+    cur_np = None
+    if args.np_spec:
+        if args.nnodes != 1:
+            raise SystemExit("--np elastic mode drives a single-host "
+                             "process group (nnodes must be 1)")
+        elastic = _ElasticState(args)
+        cur_np = elastic.max_np  # rendezvous starts at full width
+        extra_env.update(elastic.worker_env(args))
+
     restarts = 0
     preempt_restarts = 0
     spawn_round = 0
     while True:
-        procs = [_spawn(args, lr, spawn_round, extra_env)
-                 for lr in range(args.nproc_per_node)]
-        rcs, first_bad = _wait_any_failure(procs)
+        names = None
+        if elastic is not None:
+            names = elastic.round_names(spawn_round, cur_np)
+            print(f"[elastic] round {spawn_round}: world_size={cur_np} "
+                  f"(range {elastic.min_np}:{elastic.max_np})",
+                  file=sys.stderr)
+        procs = []
+        for lr in range(cur_np if elastic is not None
+                        else args.nproc_per_node):
+            env = dict(extra_env)
+            if names is not None:
+                env["PADDLE_TPU_ELASTIC_NAME"] = names[lr]
+            procs.append(_spawn(args, lr, spawn_round, env,
+                                world_np=cur_np))
+        rcs, first_bad, event = _wait_any_failure(
+            procs,
+            on_poll=(elastic.joins if elastic is not None else None),
+            settle=(min(1.0, args.terminate_grace)
+                    if elastic is not None else 0.0))
+
+        if event:  # scale-OUT: joiners widen the world
+            # one join = one scale decision: absorb the names now or the
+            # same joiners re-trigger an event every poll forever
+            elastic.absorb(event)
+            new_np = min(elastic.max_np, cur_np + len(event))
+            if new_np == cur_np:
+                # absorbed (so the same join can't re-fire every poll)
+                # but NOT forgotten: a later worker loss consumes this
+                # standby capacity instead of scaling down
+                elastic.standby.extend(event)
+                print(f"[elastic] join {event} held as standby: already "
+                      f"at max_np={elastic.max_np}", file=sys.stderr)
+                # the workers are still running: just keep waiting
+                rcs, first_bad, _ = _wait_any_failure(procs, settle=1.0)
+            else:
+                elastic.events += 1
+                if elastic.events > args.max_elastic_events:
+                    print("[elastic] scale-event limit reached",
+                          file=sys.stderr)
+                    _terminate_survivors(procs, args.terminate_grace)
+                    return 1
+                print(f"[elastic] node join {event}: scaling "
+                      f"{cur_np} -> {new_np}; SIGTERM current workers "
+                      "(graceful save) and relaunching", file=sys.stderr)
+                _terminate_survivors(procs, args.terminate_grace)
+                cur_np = new_np
+                spawn_round += 1
+                time.sleep(1)
+                continue
+
         if first_bad is not None and any(rc is None for rc in rcs):
             print("[launch] terminating surviving workers "
                   f"(first failure rc={first_bad[0]})", file=sys.stderr)
             _terminate_survivors(procs, args.terminate_grace)
         if first_bad is None:
             print(f"[launch] all {len(procs)} worker(s) finished")
+            if elastic is not None:
+                try:
+                    elastic.manager.complete()
+                except Exception:
+                    pass
             return 0
         rc, log_path = first_bad
         print(f"[launch] worker failed (rc={rc}); log: {log_path}",
@@ -187,6 +357,52 @@ def launch(argv=None):
             print(f"[launch] graceful preemption: resuming "
                   f"(preempt resume {preempt_restarts}, does not consume "
                   f"max_restarts)", file=sys.stderr)
+        elif elastic is not None:
+            # scale event: only hard-killed members (rc == -SIGKILL, the
+            # lost-host signal) shed capacity.  A peer dying mid-collective
+            # takes the survivors down too (gloo broken pipe -> SIGABRT/
+            # SIGSEGV inside the settle window) — those are collateral, the
+            # capacity is still here and relaunches.  With no hard kill the
+            # one causal failure sheds a single member; rc 0 = clean finish
+            # and EXIT_PREEMPT = graceful save never shed capacity.
+            lost = sum(1 for r in rcs if r == -signal.SIGKILL)
+            new_np = cur_np - max(1, lost)
+            joiners = elastic.joins()
+            elastic.absorb(joiners)
+            if elastic.standby:
+                # standby capacity (joins that arrived at max_np) backfills
+                # the loss — but only nodes still heartbeating
+                try:
+                    live = set(elastic.manager.hosts())
+                except Exception:
+                    live = set()
+                fresh = [n for n in elastic.standby if n in live]
+                elastic.standby = []
+                joiners = joiners + fresh
+            new_np = min(elastic.max_np, new_np + len(joiners))
+            if new_np < elastic.min_np:
+                print(f"[elastic] live world {new_np} below min_np="
+                      f"{elastic.min_np}: HOLD {args.elastic_timeout:.0f}s "
+                      "for joins", file=sys.stderr)
+                held = elastic.hold_for_joins(
+                    elastic.min_np - new_np, args.elastic_timeout)
+                elastic.absorb(held)
+                joiners = joiners + held
+                new_np = min(elastic.max_np, new_np + len(held))
+                if new_np < elastic.min_np:
+                    print("[elastic] no joins arrived: exiting",
+                          file=sys.stderr)
+                    return rc
+            elastic.events += 1
+            if elastic.events > args.max_elastic_events:
+                print("[elastic] scale-event limit reached",
+                      file=sys.stderr)
+                return rc
+            print(f"[elastic] scale event (lost {max(1, lost)}, "
+                  f"joined {len(joiners)}): relaunching at "
+                  f"world_size={new_np} (does not consume max_restarts)",
+                  file=sys.stderr)
+            cur_np = new_np
         else:
             if restarts >= args.max_restarts:
                 return rc

@@ -1,0 +1,197 @@
+"""Resumable-training glue: trainer loops × verified checkpoint lineage.
+
+Reference capability: fleet's checkpoint auto-recovery around trainer
+loops (the elastic manager relaunches a job; something must put the
+trainer back where it was). :class:`ResumableTraining` is that something
+for every loop in this repo — ``hapi.Model.fit``, the auto-parallel
+``Engine.fit`` and bare worker loops:
+
+- composes ONE state dict out of model params, optimizer accumulators
+  (materialized up front so a pre-step resume still restores them), the
+  global RNG state and the loop progress (epoch / step-in-epoch / global
+  step);
+- restores it from the newest verified snapshot on (re)start
+  (``CheckpointLineage.load_latest``) so a relaunched worker — crash,
+  preemption or elastic scale event — continues at the exact batch it
+  left, and the resumed epoch skips the already-consumed prefix instead
+  of double-counting it;
+- snapshots on a step interval, optionally OVERLAPPED with training
+  (``async_snapshot``: serialization, IO and the commit barrier run on
+  the save handle's completion thread, ``checkpoint.AsyncSaveHandle``);
+- converts SIGTERM into a synchronized *sync* save + ``EXIT_PREEMPT``
+  (75) at the next batch boundary, which the launcher resumes for free.
+
+Exact batch-skip resume assumes the dataloader order is deterministic
+across incarnations (``shuffle=False`` or a seeded/epoch-keyed shuffle) —
+the RNG state is restored before any batch is drawn to help with that.
+Across an elastic WORLD-SIZE change a sharded sampler repartitions the
+dataset, so the skip stays positionally exact (right epoch/step) but not
+sample-exact; the restore logs ``RESUMED_RESHARDED`` when that happens.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+from .fault import (CheckpointLineage, exit_preempted,
+                    install_preemption_handler, preempted)
+
+__all__ = ["ResumableTraining"]
+
+
+class ResumableTraining:
+    """Drive one training loop's checkpoint/restore/preemption lifecycle.
+
+    Usage (what ``Model.fit`` does)::
+
+        rt = ResumableTraining(lineage, network=net, optimizer=opt,
+                               interval=50, async_snapshot=True)
+        rt.restore()                      # -> None or restored step
+        for epoch in range(rt.epoch, epochs):
+            for step, batch in enumerate(loader):
+                if rt.skip_batch(epoch, step):
+                    continue              # consumed before the restart
+                rt.poll_preempt(epoch, step)   # SIGTERM -> save + exit 75
+                train(batch)
+                rt.step_done(epoch, step)      # interval snapshot
+            rt.epoch_done(epoch)               # epoch-boundary snapshot
+        rt.finalize()                          # drain overlapped save
+    """
+
+    def __init__(self, lineage, network=None, optimizer=None, interval=None,
+                 async_snapshot=False, extra_state=None, verbose=True):
+        # verbose=True by default on purpose: RESUMED/FRESH/PREEMPT_SAVED
+        # are state-transition markers the chaos harness and operators
+        # grep worker logs for — they print even when the loop itself is
+        # quiet (pass verbose=False to silence)
+        if isinstance(lineage, (str, os.PathLike)):
+            lineage = CheckpointLineage(str(lineage))
+        self.lineage = lineage
+        self.network = network
+        self.optimizer = optimizer
+        self.interval = int(interval) if interval else None
+        self.async_snapshot = bool(async_snapshot)
+        self.extra_state = dict(extra_state or {})
+        self.verbose = verbose
+        self.epoch = 0
+        self.step_in_epoch = 0
+        self.global_step = 0
+        self._last_saved_step = None
+
+    # -- state composition --
+    def state(self, epoch, step_in_epoch, global_step):
+        """The composite trainer state for one snapshot: the (epoch,
+        step_in_epoch) pair is the RESUME point — the first batch the
+        restored loop should run, not the last one it finished."""
+        from ..core.random import get_rng_state
+        state = {"epoch": int(epoch),
+                 "step_in_epoch": int(step_in_epoch),
+                 "global_step": int(global_step),
+                 "world_size": int(getattr(self.lineage, "world_size", 1)
+                                   or 1),
+                 "rng": list(get_rng_state())}
+        if self.network is not None:
+            state["model"] = self.network.state_dict()
+        if self.optimizer is not None:
+            state["opt"] = self.optimizer.state_dict()
+        state.update(self.extra_state)
+        return state
+
+    def restore(self):
+        """Load the newest verified snapshot (None = fresh start) and
+        arm the SIGTERM handler. Restores model (in place), optimizer
+        accumulators, RNG and loop progress."""
+        if self.optimizer is not None \
+                and hasattr(self.optimizer, "materialize"):
+            # lazy accumulators must exist BEFORE the load, or a resume
+            # that restarts ahead of the first step drops them silently
+            self.optimizer.materialize()
+        target = self.state(0, 0, 0)
+        restored = self.lineage.load_latest(target)
+        if restored is not None:
+            if self.network is not None:
+                self.network.set_state_dict(target["model"])
+            if self.optimizer is not None and "opt" in target:
+                self.optimizer.set_state_dict(target["opt"])
+            if target.get("rng") is not None:
+                from ..core.random import set_rng_state
+                set_rng_state(tuple(target["rng"]))
+            self.epoch = int(target["epoch"])
+            self.step_in_epoch = int(target["step_in_epoch"])
+            self.global_step = int(target["global_step"])
+            self._last_saved_step = self.global_step
+            old_world = int(target.get("world_size", 0) or 0)
+            new_world = int(getattr(self.lineage, "world_size", 1) or 1)
+            if old_world and old_world != new_world:
+                # elastic scale event: a sharded sampler repartitions the
+                # dataset by world size, so the positional batch-prefix
+                # skip resumes at the right (epoch, step) but over a
+                # DIFFERENT sample partition — sample-exact resume holds
+                # only within an unchanged world
+                self._log(f"RESUMED_RESHARDED world={old_world}->"
+                          f"{new_world} (partition changed; batch skip "
+                          "is positional, not sample-exact)")
+            for k in self.extra_state:
+                self.extra_state[k] = target[k]
+            self._log(f"RESUMED epoch={self.epoch} "
+                      f"step={self.step_in_epoch} "
+                      f"global_step={self.global_step}")
+        else:
+            self._log("FRESH")
+        install_preemption_handler()  # flag-only: loop polls poll_preempt
+        return restored
+
+    # -- loop hooks --
+    def skip_batch(self, epoch, step_in_epoch) -> bool:
+        """True for batches the pre-restart incarnation already consumed
+        (the resumed epoch must not double-count its prefix)."""
+        return epoch == self.epoch and step_in_epoch < self.step_in_epoch
+
+    def poll_preempt(self, epoch, step_in_epoch):
+        """At a batch boundary: if SIGTERM arrived, synchronously save a
+        snapshot resuming AT this batch and exit ``EXIT_PREEMPT`` (the
+        launcher relaunches without consuming its restart budget)."""
+        if not preempted():
+            return
+        self._log(f"PREEMPT_SAVED {self.global_step}")
+        exit_preempted(lambda: self._save(epoch, step_in_epoch, sync=True))
+
+    def step_done(self, epoch, step_in_epoch, defer_to_epoch=False):
+        """One batch finished: bump counters; snapshot on the interval
+        (resume point = the NEXT batch). Returns True if it saved.
+
+        ``defer_to_epoch``: the loop knows this was the epoch's LAST
+        batch — suppress the interval snapshot and let ``epoch_done``
+        write the boundary one instead. An interval snapshot here would
+        create a resume point AFTER the last batch but BEFORE the
+        epoch-end processing (callbacks/eval), which a resume would then
+        silently skip; ``epoch_done`` runs after those hooks, so its
+        snapshot is the hook-exact boundary."""
+        self.global_step += 1
+        if self.interval and self.global_step % self.interval == 0 \
+                and not defer_to_epoch:
+            self._save(epoch, step_in_epoch + 1)
+            return True
+        return False
+
+    def epoch_done(self, epoch):
+        """Epoch boundary: snapshot resuming at the next epoch's start
+        (skipped when the interval save already covered this step)."""
+        if self._last_saved_step != self.global_step:
+            self._save(epoch + 1, 0)
+
+    def finalize(self):
+        """Drain an in-flight overlapped snapshot (durability + commit)."""
+        self.lineage.wait()
+
+    # -- internals --
+    def _save(self, epoch, step_in_epoch, sync=False):
+        self.lineage.save(
+            self.state(epoch, step_in_epoch, self.global_step),
+            step=self.global_step,
+            async_save=self.async_snapshot and not sync)
+        self._last_saved_step = self.global_step
+
+    def _log(self, msg):
+        if self.verbose:
+            print(msg, file=sys.stdout, flush=True)

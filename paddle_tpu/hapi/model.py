@@ -115,7 +115,7 @@ class Model:
         return self.network(x)
 
     # ---- loops ----
-    def _loader(self, data, batch_size, shuffle):
+    def _loader(self, data, batch_size, shuffle, epoch_keyed=False):
         if isinstance(data, DataLoader):
             return data
         if isinstance(data, Dataset):
@@ -128,68 +128,141 @@ class Model:
                 sampler = DistributedBatchSampler(
                     data, batch_size=batch_size, shuffle=shuffle)
                 return DataLoader(data, batch_sampler=sampler)
+            if epoch_keyed and shuffle:
+                # resumable fit: the plain RandomSampler draws from the
+                # numpy global RNG, which snapshots do not capture — a
+                # resumed incarnation would iterate a DIFFERENT
+                # permutation and skip the wrong batches. The sharded
+                # sampler at nranks=1 shuffles epoch-keyed
+                # (RandomState(epoch)), identical across incarnations.
+                from ..io import DistributedBatchSampler
+                sampler = DistributedBatchSampler(
+                    data, batch_size=batch_size, num_replicas=1, rank=0,
+                    shuffle=True)
+                return DataLoader(data, batch_sampler=sampler)
             return DataLoader(data, batch_size=batch_size, shuffle=shuffle)
         raise TypeError(f"expected Dataset or DataLoader, got {type(data)}")
 
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
-            num_iters=None):
-        """Reference: Model.fit (hapi/model.py:1756)."""
+            num_iters=None, lineage=None, snapshot_interval=None,
+            async_snapshot=False):
+        """Reference: Model.fit (hapi/model.py:1756).
+
+        ``lineage`` (a ``distributed.fault.CheckpointLineage`` or a root
+        directory path) makes the loop RESUMABLE: on entry the newest
+        verified snapshot restores model/optimizer/RNG and the exact
+        epoch+batch position (already-consumed batches of the resumed
+        epoch are skipped, never double-counted), snapshots land every
+        ``snapshot_interval`` steps and at every epoch boundary
+        (``async_snapshot=True`` overlaps serialization, IO and the
+        commit barrier with training), and SIGTERM converts into a
+        synchronized save + exit 75 which the launcher resumes without
+        consuming its restart budget. When ``train_data`` is a Dataset
+        the loop makes the iteration order deterministic itself (an
+        epoch-keyed shuffle, identical across incarnations); a
+        user-supplied DataLoader must provide that determinism for exact
+        batch-skip resume (shuffle=False or a seeded/epoch-keyed
+        shuffle)."""
         from .callbacks import Callback, ProgBarLogger
         cbs = _as_list(callbacks)
         if verbose and not any(isinstance(c, ProgBarLogger) for c in cbs):
             cbs.append(ProgBarLogger(log_freq, verbose))
         for c in cbs:
             c.set_model(self)
-        loader = self._loader(train_data, batch_size, shuffle)
+        loader = self._loader(train_data, batch_size, shuffle,
+                              epoch_keyed=lineage is not None)
+        rt = None
+        if lineage is not None:
+            from ..distributed.resumable import ResumableTraining
+            rt = ResumableTraining(
+                lineage, network=self.network, optimizer=self._optimizer,
+                interval=snapshot_interval, async_snapshot=async_snapshot)
+            rt.restore()
         history = {"loss": []}
         for c in cbs:
             c.on_train_begin()
-        it = 0
+        it = rt.global_step if rt is not None else 0
         done = False
-        for epoch in range(epochs):
-            if done:
-                break
-            self.network.train()
-            for c in cbs:
-                c.on_epoch_begin(epoch)
-            for m in self._metrics:
-                m.reset()
-            epoch_losses = []
-            for step, batch in enumerate(loader):
-                if num_iters is not None and it >= num_iters:
-                    done = True
+        try:
+            for epoch in range(rt.epoch if rt is not None else 0, epochs):
+                if done:
                     break
-                x, y = batch[0], batch[1]
-                loss = self.train_batch(x, y)
-                epoch_losses.append(loss)
-                logs = {"loss": loss}
+                self.network.train()
+                sampler = getattr(loader, "batch_sampler", None)
+                if hasattr(sampler, "set_epoch"):
+                    # per-epoch reshuffle (reference set_epoch idiom) — and
+                    # the key a resumed incarnation replays the same
+                    # permutation from
+                    sampler.set_epoch(epoch)
+                for c in cbs:
+                    c.on_epoch_begin(epoch)
+                for m in self._metrics:
+                    m.reset()
+                epoch_losses = []
+                for step, batch in enumerate(loader):
+                    if rt is not None and rt.skip_batch(epoch, step):
+                        continue  # consumed before the restart
+                    if num_iters is not None and it >= num_iters:
+                        done = True
+                        break
+                    if rt is not None:
+                        rt.poll_preempt(epoch, step)
+                    x, y = batch[0], batch[1]
+                    loss = self.train_batch(x, y)
+                    epoch_losses.append(loss)
+                    logs = {"loss": loss}
+                    for m in self._metrics:
+                        logs[m.name()] = m.accumulate()
+                    for c in cbs:
+                        c.on_train_batch_end(step, logs)
+                    it += 1
+                    if rt is not None:
+                        try:
+                            last = step + 1 == len(loader)
+                        except TypeError:  # unsized iterable loader
+                            last = False
+                        rt.step_done(epoch, step, defer_to_epoch=last)
+                if not epoch_losses:
+                    if rt is not None and epoch == rt.epoch \
+                            and rt.step_in_epoch > 0:
+                        continue  # resumed exactly at this epoch's end
+                    break
+                logs = {"loss": float(np.mean(epoch_losses))}
                 for m in self._metrics:
                     logs[m.name()] = m.accumulate()
+                history["loss"].append(logs["loss"])
+                if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                    eval_logs = self.evaluate(eval_data, batch_size=batch_size,
+                                              verbose=0)
+                    logs.update({f"eval_{k}": v for k, v in eval_logs.items()})
+                    for c in cbs:
+                        c.on_eval_end(eval_logs)
                 for c in cbs:
-                    c.on_train_batch_end(step, logs)
-                it += 1
-            if not epoch_losses:
-                break
-            logs = {"loss": float(np.mean(epoch_losses))}
-            for m in self._metrics:
-                logs[m.name()] = m.accumulate()
-            history["loss"].append(logs["loss"])
-            if eval_data is not None and (epoch + 1) % eval_freq == 0:
-                eval_logs = self.evaluate(eval_data, batch_size=batch_size,
-                                          verbose=0)
-                logs.update({f"eval_{k}": v for k, v in eval_logs.items()})
-                for c in cbs:
-                    c.on_eval_end(eval_logs)
-            for c in cbs:
-                c.on_epoch_end(epoch, logs)
-            if save_dir and (epoch + 1) % save_freq == 0:
-                self.save(f"{save_dir}/{epoch}")
-            if any(getattr(c, "stop_training", False) for c in cbs):
-                break
+                    c.on_epoch_end(epoch, logs)
+                if save_dir and (epoch + 1) % save_freq == 0:
+                    self.save(f"{save_dir}/{epoch}")
+                if rt is not None and not done:
+                    # a num_iters cut mid-epoch must NOT snapshot the epoch as
+                    # complete — resuming would silently skip its tail
+                    rt.epoch_done(epoch)
+                if any(getattr(c, "stop_training", False) for c in cbs):
+                    break
+        except BaseException:
+            if rt is not None:
+                # drain the in-flight overlapped snapshot so the
+                # error path still leaves a complete, committed
+                # last snapshot on disk
+                try:
+                    rt.finalize()
+                except Exception:
+                    pass  # never mask the training error
+            raise
         for c in cbs:
             c.on_train_end()
+        if rt is not None:
+            rt.finalize()
         return history
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,

@@ -46,8 +46,12 @@ def parse_xplane(path_or_logdir):
     for plane in xs.planes:
         meta = {k: v.name for k, v in plane.event_metadata.items()}
         for line in plane.lines:
-            # device-execution lines: TPU streams or CPU client threads
+            # device-execution lines: TPU streams or CPU client threads.
+            # The CPU client thread-line name varies by jax/xla version:
+            # "XLAPjRtCpuClient" (older), "XLATfrtCpuClient" (jax 0.4.3x
+            # TFRT CPU client, e.g. "tf_XLATfrtCpuClient/<tid>").
             is_dev = ("XLAPjRtCpuClient" in line.name
+                      or "XLATfrtCpuClient" in line.name
                       or plane.name.startswith("/device:"))
             if not is_dev:
                 continue

@@ -3,6 +3,7 @@ word2vec, recommender_system, understand_sentiment; fit-a-line and
 recognize-digits live in test_static_program.py / test_models.py).
 Public-API-only scripts that must CONVERGE, the reference's e2e bar."""
 import numpy as np
+import pytest
 
 import paddle_tpu as paddle
 import paddle_tpu.nn as nn
@@ -86,6 +87,7 @@ def test_recommender_system_converges():
     assert losses[-1] < 0.1 * losses[0], (losses[0], losses[-1])
 
 
+@pytest.mark.slow
 def test_understand_sentiment_lstm_converges():
     """LSTM sentiment classifier (reference:
     test/book/test_understand_sentiment.py 'stacked_lstm' flavor): a

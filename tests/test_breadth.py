@@ -15,6 +15,7 @@ import paddle_tpu.nn.functional as F
 @pytest.mark.parametrize("builder,size", [
     ("vgg11", 64), ("MobileNetV1", 64), ("MobileNetV2", 64),
 ])
+@pytest.mark.slow
 def test_vision_zoo_forward(builder, size):
     from paddle_tpu.vision import models as M
     paddle.seed(0)
@@ -29,6 +30,7 @@ def test_vision_zoo_forward(builder, size):
     assert out.shape == [2, 10]
 
 
+@pytest.mark.slow
 def test_vision_zoo_trains():
     from paddle_tpu.vision.models import MobileNetV2
     paddle.seed(0)
@@ -620,6 +622,7 @@ def test_conll05st_parser(tmp_path):
     ("resnext50_32x4d", 64, {}),
     ("wide_resnet50_2", 64, {}),
 ])
+@pytest.mark.slow
 def test_vision_zoo2_forward(name, size, kwargs):
     """Round-4 zoo families (reference: vision/models/*) — forward shape
     + finiteness at reduced resolution."""

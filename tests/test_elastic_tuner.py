@@ -121,6 +121,93 @@ def test_auto_tuner_real_trials_on_mesh():
     _set_hcg(None)
 
 
+def _free_port():
+    import os
+    import sys
+    workers = os.path.join(os.path.dirname(__file__), "workers")
+    if workers not in sys.path:
+        sys.path.insert(0, workers)
+    from ft_markers import free_port
+    return free_port()
+
+
+def test_heartbeat_expiry_is_a_scale_down_event():
+    """A worker whose heartbeat goes stale (SIGKILLed host: no deregister,
+    just silence) must drop out of hosts() after ttl and turn the watch
+    into RESTART while the remainder stays >= min_np (satellite #4)."""
+    port = _free_port()
+    mgr = dist.ElasticManager("hb", np="1:3", port=port, is_master=True,
+                              ttl=1.0)
+    w1 = dist.ElasticManager("hb", np="1:3", port=port, ttl=1.0)
+    w2 = dist.ElasticManager("hb", np="1:3", port=port, ttl=1.0)
+    n1 = w1.register("hb-w1")
+    n2 = w2.register("hb-w2")
+    mgr.announce([n1, n2])
+    assert set(mgr.hosts()) == {"hb-w1", "hb-w2"}
+    w2._stop.set()  # host lost: heartbeats stop, timestamp left stale
+    status = mgr.watch(interval=0.2, max_wait=8.0)
+    assert status == dist.ElasticStatus.RESTART
+    assert mgr.hosts() == ["hb-w1"]  # expiry, not deregistration
+    w1.deregister()
+
+
+def test_all_hearts_stopped_below_min_np_exits():
+    """When the live world stays below min_np for longer than ttl the
+    watch gives up with EXIT (the launcher's HOLD window is upstream)."""
+    port = _free_port()
+    mgr = dist.ElasticManager("hbx", np="2:2", port=port, is_master=True,
+                              ttl=0.8)
+    w1 = dist.ElasticManager("hbx", np="2:2", port=port, ttl=0.8)
+    w2 = dist.ElasticManager("hbx", np="2:2", port=port, ttl=0.8)
+    mgr.announce([w1.register("x-w1"), w2.register("x-w2")])
+    w1._stop.set()
+    w2._stop.set()
+    status = mgr.watch(interval=0.2, max_wait=10.0)
+    assert status == dist.ElasticStatus.EXIT
+
+
+def test_join_inside_range_triggers_restart_and_new_joins():
+    """A node registering into the job (join-seq log) is visible without
+    any announce: hosts() includes it, watch() reports RESTART (scale-out
+    within [min_np, max_np]), and new_joins() names it for the launcher
+    (satellite #4)."""
+    port = _free_port()
+    mgr = dist.ElasticManager("join", np="1:3", port=port, is_master=True,
+                              ttl=2.0)
+    w1 = dist.ElasticManager("join", np="1:3", port=port, ttl=2.0)
+    n1 = w1.register("j-w1")
+    mgr.announce([n1])
+    assert mgr.new_joins([n1]) == []
+    w2 = dist.ElasticManager("join", np="1:3", port=port, ttl=2.0)
+    w2.register("j-w2")
+    assert mgr.new_joins([n1]) == ["j-w2"]
+    assert set(mgr.joined_names()) == {"j-w1", "j-w2"}
+    status = mgr.watch(interval=0.2, max_wait=5.0)
+    assert status == dist.ElasticStatus.RESTART
+    w1.deregister()
+    w2.deregister()
+
+
+def test_dead_master_watch_reports_error(monkeypatch):
+    """The registry master dying must surface as ERROR from watch() once
+    the store's bounded reconnect gives up — never an infinite spin
+    (satellite #4)."""
+    monkeypatch.setenv("PADDLE_TPU_STORE_CONNECT_DEADLINE", "0.3")
+    port = _free_port()
+    master = dist.TCPStore("127.0.0.1", port, is_master=True, timeout=5)
+    w = dist.ElasticManager("dead", np="1:2", port=port, ttl=1.0, timeout=5)
+    n = w.register("d-w1")
+    w.announce([n])
+    assert w.hosts() == [n]
+    w._stop.set()  # silence the beat thread before the store goes away
+    master._lib.pd_store_server_stop(master._server)
+    master._server = None
+    t0 = time.time()
+    status = w.watch(interval=0.2, max_wait=30.0)
+    assert status == dist.ElasticStatus.ERROR
+    assert time.time() - t0 < 25  # bounded, not the full max_wait spin
+
+
 def test_elastic_membership_and_scale_event():
     port = 29871
     mgr = dist.ElasticManager("job1", np="1:3", port=port, is_master=True,

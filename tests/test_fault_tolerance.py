@@ -13,7 +13,6 @@ import glob
 import os
 import re
 import signal
-import socket
 import subprocess
 import sys
 import time
@@ -30,13 +29,9 @@ WORKERS = os.path.join(os.path.dirname(__file__), "workers")
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if WORKERS not in sys.path:
     sys.path.insert(0, WORKERS)
-from ft_markers import parse_losses  # noqa: E402  (shared with bench.py)
-
-
-def _free_port():
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+from ft_markers import (parse_losses,  # noqa: E402  (shared with bench.py)
+                        free_port as _free_port,  # noqa: E402
+                        read_worker_logs as _read_worker_logs)  # noqa: E402
 
 
 @pytest.fixture(autouse=True)
@@ -72,6 +67,20 @@ def test_fault_spec_grammar():
         fault.parse_fault_spec("torn_write@ckpt_io:1")
     with pytest.raises(ValueError):
         fault.parse_fault_spec("store_drop@step:1")
+    # overlap-era kinds: async_torn is cooperative (async_ckpt only),
+    # commit_stall executes (a sleep) like slow_io
+    es = fault.parse_fault_spec("async_torn@async_ckpt:2,commit_stall@commit:1")
+    assert [e.key() for e in es] == ["async_torn@async_ckpt:2",
+                                    "commit_stall@commit:1"]
+    with pytest.raises(ValueError):
+        fault.parse_fault_spec("async_torn@ckpt:1")
+
+
+def test_async_torn_wildcard_only_fires_at_async_site():
+    fault.set_fault_spec("async_torn:1")
+    assert fault.maybe_inject("ckpt") is None
+    assert fault.maybe_inject("step") is None
+    assert fault.maybe_inject("async_ckpt") == "async_torn"
 
 
 def test_injection_fires_on_exact_nth_hit():
@@ -240,6 +249,112 @@ def test_lineage_prunes_old_snapshots(tmp_path):
     assert lin.latest_committed() == 5
 
 
+# ------------------------------------------- overlapped async save/commit
+
+def test_async_overlapped_save_commits_in_background(tmp_path):
+    """lineage.save(async_save=True) returns while the snapshot is still
+    streaming; the two-phase commit (LATEST flip) runs on the handle's
+    completion thread WITHOUT any wait() from the trainer — the commit
+    barrier no longer drains the writer (ISSUE tentpole (3))."""
+    lin = fault.CheckpointLineage(str(tmp_path / "ck"))
+    t = paddle.to_tensor(np.ones((64, 64), "float32"))
+    lin.save({"w": t, "step": 1}, step=1, async_save=True)
+    deadline = time.time() + 30
+    while lin.latest_committed() != 1 and time.time() < deadline:
+        time.sleep(0.01)
+    assert lin.latest_committed() == 1  # committed with no explicit drain
+    assert lin.wait(timeout=10)
+    # a second overlapped save drains the first, keeping commit order
+    lin.save({"w": t, "step": 2}, step=2, async_save=True)
+    assert lin.wait(timeout=30)
+    assert lin.latest_committed() == 2
+    target = {"w": paddle.zeros([64, 64]), "step": 0}
+    assert lin.load_latest(target) == 2
+    assert target["step"] == 2
+
+
+def test_async_torn_injection_detected(tmp_path):
+    """async_torn tears the shard the OVERLAPPED writer lands (and models
+    the killed-before-commit window: no LATEST flip); CRC verification
+    rejects it and lineage falls back to the previous complete snapshot."""
+    lin, _, t2 = _mk_lineage(tmp_path)  # steps 1, 2 committed
+    fault.set_fault_spec("async_torn:1")  # wildcard: async_ckpt site only
+    lin.save({"w": t2, "step": 3}, step=3, async_save=True)
+    assert lin.wait(timeout=30)
+    assert lin.latest_committed() == 2  # torn overlap never committed
+    with pytest.raises(dckpt.CheckpointCorruptError, match="size"):
+        dckpt.verify_checkpoint(lin.step_dir(3))
+    target = {"w": paddle.zeros([3, 4]), "step": 0}
+    assert lin.load_latest(target) == 2
+    assert target["step"] == 2
+    assert not os.path.exists(lin.step_dir(3))  # torn branch GC'd
+
+
+def test_commit_stall_widens_commit_window(tmp_path, monkeypatch):
+    """commit_stall sleeps between shard durability and the LATEST flip —
+    the chaos window a mid-commit kill lands in; an unkilled save still
+    commits correctly afterwards."""
+    monkeypatch.setenv("PADDLE_TPU_FAULT_COMMIT_STALL_S", "0.3")
+    fault.set_fault_spec("commit_stall@commit:1")
+    lin = fault.CheckpointLineage(str(tmp_path / "ck"))
+    t = paddle.to_tensor(np.ones((2, 2), "float32"))
+    t0 = time.monotonic()
+    lin.save({"w": t, "step": 1}, step=1)
+    assert time.monotonic() - t0 >= 0.3  # the stall ran inside _commit
+    assert lin.latest_committed() == 1
+
+
+# ----------------------------------------------- resumable hapi.Model.fit
+
+def test_model_fit_resumable_matches_uninterrupted(tmp_path):
+    """fit(lineage=) restores model/optimizer/RNG and the exact position,
+    skipping already-consumed batches: an interrupted-mid-epoch run that
+    resumes must land on the SAME weights as one uninterrupted run (Adam
+    accumulators and the batch schedule must round-trip exactly)."""
+    import paddle_tpu.nn as nn
+    from paddle_tpu.io import Dataset
+
+    X = np.random.RandomState(0).randn(16, 8).astype("float32")
+    Y = X @ np.random.RandomState(1).randn(8, 2).astype("float32")
+
+    class DS(Dataset):
+        def __getitem__(self, i):
+            return X[i], Y[i]
+
+        def __len__(self):
+            return 16
+
+    def make():
+        # reset the auto-name counter: optimizer state keys embed param
+        # names, and a real restart (fresh process, same construction
+        # order) reproduces them — three in-process models would not
+        from paddle_tpu.core.tensor import _tensor_counter
+        _tensor_counter[0] = 10_000
+        paddle.seed(123)
+        net = nn.Linear(8, 2)
+        m = paddle.Model(net)
+        opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                    parameters=net.parameters())
+        m.prepare(optimizer=opt, loss=nn.MSELoss())
+        return m, net
+
+    m_ref, net_ref = make()
+    m_ref.fit(DS(), batch_size=4, epochs=2, shuffle=False, verbose=0)
+
+    # interrupted mid-epoch-1 (num_iters cuts after 6 of 8 batches); the
+    # interval snapshot at step 6 is the resume point
+    m1, _ = make()
+    m1.fit(DS(), batch_size=4, epochs=2, shuffle=False, verbose=0,
+           num_iters=6, lineage=str(tmp_path / "ck"), snapshot_interval=2)
+    m2, net2 = make()
+    m2.fit(DS(), batch_size=4, epochs=2, shuffle=False, verbose=0,
+           lineage=str(tmp_path / "ck"), snapshot_interval=2)
+    np.testing.assert_allclose(net2.weight.numpy(), net_ref.weight.numpy(),
+                               atol=1e-6)
+    np.testing.assert_allclose(net2.bias.numpy(), net_ref.bias.numpy(),
+                               atol=1e-6)
+
+
 # --------------------------------------------------- store drop + retry
 
 def test_tcp_store_survives_injected_connection_drop():
@@ -334,12 +449,6 @@ def _clean_env(extra=None):
     return env
 
 
-def _read_worker_logs(log_dir, rank):
-    text = ""
-    for p in sorted(glob.glob(os.path.join(log_dir, f"workerlog.{rank}*"))):
-        with open(p) as f:
-            text += f.read()
-    return text
 
 
 def _reference_losses(tmp_path, steps=6):
@@ -383,6 +492,188 @@ def test_launcher_arms_watchdog_by_default(tmp_path):
     out = _read_worker_logs(str(tmp_path / "logs"), 0)
     assert "WD 300.0" in out
     assert "fault_ledger.txt" in out
+
+
+# ------------------------------------------------- elastic launcher (fast)
+
+def _elastic_script(tmp_path):
+    """Plain-python elastic worker (no jax import => cheap): prints its
+    rendezvous env, optionally exits nonzero / sleeps per round+rank."""
+    script = tmp_path / "ew.py"
+    script.write_text(
+        "import os, sys, time\n"
+        "rank = os.environ['PADDLE_TPU_PROCESS_ID']\n"
+        "world = os.environ['PADDLE_TRAINERS_NUM']\n"
+        "rnd = os.environ['PADDLE_TPU_RESTART_NUM']\n"
+        "print('ENV', rnd, rank, world,\n"
+        "      os.environ.get('PADDLE_TPU_ELASTIC_NAME'),\n"
+        "      os.environ.get('PADDLE_TPU_ELASTIC_STORE'), flush=True)\n"
+        "mode = os.environ.get('EW_MODE', '')\n"
+        "if rnd == '0' and mode in ('lose_rank1', 'join_flow') "
+        "and rank == '1':\n"
+        "    sys.exit(7)\n"
+        "if rnd == '0' and mode == 'lose_all':\n"
+        "    sys.exit(9)\n"
+        "if rnd == '0' and mode == 'standby_flow' and rank == '1':\n"
+        "    time.sleep(6)\n"   # die AFTER the standby joiner registered
+        "    sys.exit(7)\n"
+        "if rnd == '0' or (rnd == '1' and mode == 'join_flow'):\n"
+        "    time.sleep(60)\n"
+        "sys.exit(0)\n")
+    return str(script)
+
+
+def _launch_elastic(tmp_path, np_spec, extra_argv=(), env=None,
+                    timeout_args=()):
+    from paddle_tpu.distributed.launch.main import launch
+    saved = {k: os.environ.get(k) for k in (env or {})}
+    os.environ.update(env or {})
+    try:
+        return launch(["--np", np_spec,
+                       "--master", f"127.0.0.1:{_free_port()}",
+                       "--elastic_port", str(_free_port()),
+                       "--terminate_grace", "1",
+                       "--log_dir", str(tmp_path / "logs"),
+                       *extra_argv, _elastic_script(tmp_path)])
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def test_elastic_launcher_scale_down_relaunches_smaller(tmp_path, capfd):
+    """Tentpole (1): losing one worker of two inside [1,2] is a SCALE
+    EVENT — survivors torn down, relaunch at world_size=1 with re-rendered
+    PADDLE_TRAINERS_NUM/rank env — not a fatal exit."""
+    rc = _launch_elastic(tmp_path, "1:2", env={"EW_MODE": "lose_rank1"})
+    assert rc == 0
+    err = capfd.readouterr().err
+    assert "scale event" in err and "world_size=1" in err
+    assert "does not consume max_restarts" in err
+    round0 = _read_worker_logs(str(tmp_path / "logs"), 0)
+    assert "ENV 0 0 2 r0-w0" in round0   # round 0: world 2, named worker
+    assert "ENV 1 0 1 r1-w0" in round0   # round 1: world re-rendered to 1
+
+
+def test_elastic_launcher_standby_join_backfills_loss(tmp_path, capfd):
+    """A join arriving while the world is already at max_np is held as
+    STANDBY, not discarded: when a worker is later lost, the standby
+    capacity backfills the loss and the job relaunches at the SAME world
+    size instead of scaling down."""
+    import threading
+    from paddle_tpu.distributed import ElasticManager
+    eport = _free_port()
+
+    launch_done = threading.Event()
+
+    def join_early():
+        time.sleep(2.0)  # world 2 is running; rank 1 dies at ~6s
+        em = ElasticManager("default", "1:2", port=eport, ttl=10.0)
+        em.register("standby-0")
+        launch_done.wait(timeout=30)  # keep beating until the job ends
+        em.deregister()
+
+    t = threading.Thread(target=join_early, daemon=True)
+    t.start()
+    from paddle_tpu.distributed.launch.main import launch
+    os.environ["EW_MODE"] = "standby_flow"
+    try:
+        rc = launch(["--np", "1:2", "--master",
+                     f"127.0.0.1:{_free_port()}",
+                     "--elastic_port", str(eport), "--terminate_grace", "1",
+                     "--log_dir", str(tmp_path / "logs"),
+                     _elastic_script(tmp_path)])
+    finally:
+        os.environ.pop("EW_MODE", None)
+        launch_done.set()
+    t.join(timeout=15)
+    assert rc == 0
+    err = capfd.readouterr().err
+    assert "held as standby" in err
+    # the loss is backfilled: relaunch stays at world 2, never shrinks
+    assert "relaunching at world_size=2" in err
+    assert "world_size=1" not in err
+    round1 = _read_worker_logs(str(tmp_path / "logs"), 1)
+    assert "ENV 1 1 2" in round1  # round 1 still has a second worker
+
+
+def test_elastic_launcher_join_scales_out(tmp_path, capfd):
+    """A node registering into the rendezvous mid-run widens the world
+    back up: after a scale-down to 1 (rendezvous always STARTS at max_np),
+    the join makes the launcher SIGTERM the current round and relaunch at
+    world_size=2."""
+    import threading
+    from paddle_tpu.distributed import ElasticManager
+    eport = _free_port()
+
+    launch_done = threading.Event()
+
+    def join_later():
+        time.sleep(4.0)  # after the round-0 loss scaled the world to 1
+        em = ElasticManager("default", "1:2", port=eport, ttl=10.0)
+        em.register("ext-0")
+        launch_done.wait(timeout=30)  # keep beating until the job ends
+        em.deregister()
+
+    t = threading.Thread(target=join_later, daemon=True)
+    t.start()
+    from paddle_tpu.distributed.launch.main import launch
+    os.environ["EW_MODE"] = "join_flow"
+    try:
+        rc = launch(["--np", "1:2", "--master",
+                     f"127.0.0.1:{_free_port()}",
+                     "--elastic_port", str(eport), "--terminate_grace", "1",
+                     "--log_dir", str(tmp_path / "logs"),
+                     _elastic_script(tmp_path)])
+    finally:
+        os.environ.pop("EW_MODE", None)
+        launch_done.set()
+    t.join(timeout=15)
+    assert rc == 0
+    err = capfd.readouterr().err
+    assert "scale event" in err          # round 0 -> 1: lost a worker
+    assert "node join" in err            # round 1 -> 2: joiner widened it
+    assert "relaunching" in err and "world_size=2" in err.split(
+        "node join")[1]
+    round2 = _read_worker_logs(str(tmp_path / "logs"), 1)
+    assert "ENV 2 1 2" in round2  # second worker exists again in round 2
+
+
+def test_elastic_launcher_holds_below_min_for_joins(tmp_path, capfd):
+    """Below min_np the launcher HOLDs for joiners instead of dying; two
+    registrations during the window bring the world back to min_np."""
+    import threading
+    from paddle_tpu.distributed import ElasticManager
+    eport = _free_port()
+
+    def join_later():
+        time.sleep(2.5)
+        for i in range(2):
+            em = ElasticManager("default", "2:2", port=eport, ttl=10.0)
+            em.register(f"hold-ext-{i}")
+
+    t = threading.Thread(target=join_later, daemon=True)
+    t.start()
+    from paddle_tpu.distributed.launch.main import launch
+    os.environ["EW_MODE"] = "lose_all"
+    try:
+        rc = launch(["--np", "2:2", "--master",
+                     f"127.0.0.1:{_free_port()}",
+                     "--elastic_port", str(eport), "--terminate_grace", "1",
+                     "--elastic_timeout", "15",
+                     "--log_dir", str(tmp_path / "logs"),
+                     _elastic_script(tmp_path)])
+    finally:
+        os.environ.pop("EW_MODE", None)
+    t.join(timeout=10)
+    assert rc == 0
+    err = capfd.readouterr().err
+    assert "HOLD" in err
+    assert "relaunching at world_size=2" in err
+    round1 = _read_worker_logs(str(tmp_path / "logs"), 0)
+    assert "ENV 1 0 2" in round1
 
 
 @pytest.mark.slow
@@ -485,6 +776,134 @@ def test_chaos_two_process_crash_torn_resume(tmp_path):
     if os.path.exists(step1):
         with pytest.raises(dckpt.CheckpointCorruptError):
             dckpt.verify_checkpoint(step1)
+
+
+@pytest.mark.slow
+def test_launcher_async_overlap_torn_resume(tmp_path):
+    """Acceptance: async_save OVERLAPPED with training survives a torn
+    mid-overlap snapshot + crash — the resumed run rejects the torn
+    snapshot by CRC, falls back to the previous complete one, and matches
+    the uninterrupted trajectory."""
+    steps = 6
+    ref = _reference_losses(tmp_path, steps)
+    log_dir = str(tmp_path / "logs")
+    env = _clean_env({
+        "PADDLE_TPU_CKPT_DIR": str(tmp_path / "ck_async"),
+        "PADDLE_TPU_FT_STEPS": str(steps),
+        "PADDLE_TPU_FT_ASYNC": "1",
+        "PADDLE_TPU_FAULTS": "async_torn@async_ckpt:2,crash@step:3",
+    })
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "1", "--max_restarts", "1",
+         "--log_dir", log_dir, os.path.join(WORKERS, "ft_worker.py")],
+        env=env, capture_output=True, text=True, timeout=600, cwd=REPO)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "rc=43" in r.stderr
+    log = _read_worker_logs(log_dir, 0)
+    assert "injecting async_torn" in log    # the overlap was really torn
+    assert re.search(r"RESUMED 1\b", log)   # fell back past torn step_2
+    got = parse_losses(log)
+    assert set(got) == set(ref)
+    for i in ref:
+        assert abs(got[i] - ref[i]) < 1e-6
+    # the torn uncommitted snapshot can never be loaded: it was either
+    # GC'd on resume or still fails CRC verification
+    step2 = os.path.join(str(tmp_path / "ck_async"), "step_00000002")
+    if os.path.exists(step2):
+        with pytest.raises(dckpt.CheckpointCorruptError):
+            dckpt.verify_checkpoint(step2)
+
+
+@pytest.mark.slow
+def test_launcher_async_mid_commit_kill_falls_back(tmp_path):
+    """Acceptance: a kill landing INSIDE the overlapped commit window
+    (commit_stall holds the LATEST flip while crash@step fires on the
+    training thread) leaves the newest snapshot complete-but-uncommitted;
+    the resumed run restores from the committed pointer and reproduces
+    the uninterrupted trajectory."""
+    steps = 6
+    ref = _reference_losses(tmp_path, steps)
+    log_dir = str(tmp_path / "logs")
+    env = _clean_env({
+        "PADDLE_TPU_CKPT_DIR": str(tmp_path / "ck_commit"),
+        "PADDLE_TPU_FT_STEPS": str(steps),
+        "PADDLE_TPU_FT_ASYNC": "1",
+        "PADDLE_TPU_FAULT_COMMIT_STALL_S": "30",
+        "PADDLE_TPU_FAULTS": "commit_stall@commit:2,crash@step:3",
+    })
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "1", "--max_restarts", "1",
+         "--log_dir", log_dir, os.path.join(WORKERS, "ft_worker.py")],
+        env=env, capture_output=True, text=True, timeout=600, cwd=REPO)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "rc=43" in r.stderr
+    log = _read_worker_logs(log_dir, 0)
+    assert "injecting commit_stall" in log  # the kill window was open
+    assert re.search(r"RESUMED 1\b", log)   # committed pointer wins
+    got = parse_losses(log)
+    assert set(got) == set(ref)
+    for i in ref:
+        assert abs(got[i] - ref[i]) < 1e-6
+
+
+@pytest.mark.slow
+def test_elastic_chaos_sigkill_scales_down_and_resumes(tmp_path):
+    """THE acceptance chaos run: SIGKILL one worker of a 3-worker elastic
+    job (hapi.Model.fit + CheckpointLineage under ``--np 2:3``). The
+    launcher must relaunch at world_size=2; training must resume from the
+    last verified snapshot at the exact epoch/step (no batch of the
+    resumed epoch re-consumed) and run to completion."""
+    log_dir = str(tmp_path / "logs")
+    master_port = _free_port()
+    store_port = _free_port()
+    env = _clean_env({
+        "PADDLE_TPU_CKPT_DIR": str(tmp_path / "ck_elastic"),
+        "PADDLE_TPU_FT_STORE_PORT": str(store_port),
+        "PADDLE_TPU_FT_EPOCHS": "2",
+        "PADDLE_TPU_FT_BATCHES": "9",
+        "PADDLE_TPU_FT_INTERVAL": "1",
+        "PADDLE_TPU_ELASTIC_KILL": "2:2",  # rank 2: SIGKILL after 2 batches
+    })
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--np", "2:3", "--master", f"127.0.0.1:{master_port}",
+         "--elastic_port", str(_free_port()),
+         "--terminate_grace", "5", "--log_dir", log_dir,
+         os.path.join(WORKERS, "elastic_worker.py")],
+        env=env, capture_output=True, text=True, timeout=600, cwd=REPO)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "scale event" in r.stderr
+    assert "relaunching at world_size=2" in r.stderr
+
+    # round 0 (world 3): rank 2 really SIGKILLed itself mid-epoch
+    k = _read_worker_logs(log_dir, 2)
+    assert "WORLD 3" in k and "SELF_SIGKILL" in k
+
+    for rank in (0, 1):
+        log = _read_worker_logs(log_dir, rank)
+        assert "WORLD 3" in log and "WORLD 2" in log  # both incarnations
+        m = re.search(r"RESUMED epoch=(\d+) step=(\d+) global_step=(\d+)",
+                      log)
+        assert m, f"rank {rank} never resumed:\n{log}"
+        e, s, g = (int(x) for x in m.groups())
+        # the snapshot interval is 1, so the resume point is the batch
+        # right after the last committed one
+        round1 = log.split("WORLD 2", 1)[1]
+        batches = [tuple(int(x) for x in bm.groups())
+                   for bm in re.finditer(r"BATCH (\d+) (\d+) (\d+)",
+                                         round1)]
+        assert batches, f"rank {rank} ran no batches after resume"
+        # first post-resume batch is exactly the resume point: nothing
+        # before (e, s) is re-consumed, nothing after it is skipped
+        assert (batches[0][0], batches[0][1]) == (e, s), \
+            f"rank {rank}: resumed at {(e, s)} but first batch was " \
+            f"{batches[0][:2]}"
+        assert "DONE" in round1  # the resumed job ran to completion
+        # epoch 1 exists in round 1: the job finished all epochs at the
+        # smaller world size
+        assert any(b[0] == 1 for b in batches)
 
 
 def test_slow_io_injection_delays_async_writer(tmp_path):

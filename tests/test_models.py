@@ -76,6 +76,7 @@ def test_graft_entry_compiles():
     assert out.shape == (2, 32, 256)
 
 
+@pytest.mark.slow
 def test_graft_dryrun_multichip():
     import __graft_entry__ as ge
     ge.dryrun_multichip(8)
@@ -156,6 +157,7 @@ def test_nn_functional_vision_ops():
     np.testing.assert_allclose(out.numpy(), img.numpy(), atol=1e-5)
 
 
+@pytest.mark.slow
 def test_seq2seq_transformer_learns_copy_task():
     """Encoder-decoder Transformer (reference: the book/tutorial
     translation Transformer over nn.Transformer): teacher-forced training

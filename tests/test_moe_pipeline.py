@@ -64,6 +64,7 @@ def test_moe_capacity_drops_overflow():
     assert zero_frac > 0.5
 
 
+@pytest.mark.slow
 def test_moe_trains():
     paddle.seed(2)
     _fleet(dp=8)
@@ -181,6 +182,7 @@ def test_ring_attention_matches_full_attention():
                                atol=2e-3)
 
 
+@pytest.mark.slow
 def test_ring_attention_backward():
     from paddle_tpu.incubate.ring_attention import ring_attention
     _fleet(dp=1, sep=4, mp=2)

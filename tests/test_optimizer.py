@@ -198,6 +198,7 @@ def _synthetic_digits(n=512, seed=0):
     return images, labels.astype(np.int64)
 
 
+@pytest.mark.slow
 def test_lenet_converges():
     paddle.seed(42)
     images, labels = _synthetic_digits()

@@ -4,6 +4,7 @@ Interpret-mode kernels vs the jnp compositions (reference:
 fused_adam_kernel.cu, fusion/gpu/fused_layernorm_kernel.cu)."""
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 import paddle_tpu as paddle
 from paddle_tpu.ops.pallas.fused_adamw import fused_adamw
@@ -252,6 +253,7 @@ def test_asp_indivisible_dim_warns():
     assert any("not divisible" in str(x.message) for x in w)
 
 
+@pytest.mark.slow
 def test_fused_adamw_composes_with_zero_sharding():
     """VERDICT r3 weak #6: fused AdamW must stay ACTIVE under ZeRO — the
     kernel shard_maps over each device's local shard of the merged spec.

@@ -60,6 +60,7 @@ class _GradCatcher(paddle.optimizer.SGD):
                        if p._grad is not None}
 
 
+@pytest.mark.slow
 def test_compiled_grad_parity_with_host():
     _fleet_pp(2)
     paddle.seed(3)
@@ -100,6 +101,7 @@ def test_compiled_trains_and_converges():
     assert losses[-1] < losses[0] * 0.9, losses
 
 
+@pytest.mark.slow
 def test_virtual_stages_interleaved():
     """virtual_pp_degree=2: 8 blocks on 4 stages, 2 chunks each
     (reference: PipelineParallelWithInterleave, pipeline_parallel.py:890)."""

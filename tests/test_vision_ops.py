@@ -118,6 +118,7 @@ def test_yolo_box_decodes():
     assert (s >= 0).all() and (s <= 1).all()
 
 
+@pytest.mark.slow
 def test_yolo_loss_decreases_on_fit():
     """The loss must be trainable: gradient steps on a fixed tiny target
     reduce it."""

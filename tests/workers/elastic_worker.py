@@ -1,0 +1,124 @@
+"""Elastic self-healing chaos worker (tests/test_fault_tolerance.py,
+bench --chaos elastic mode).
+
+Trains a deterministic Linear regression through ``hapi.Model.fit`` with a
+``CheckpointLineage`` under the ELASTIC launcher (``--np min:max``): every
+incarnation re-reads its world size from the env, restores the newest
+verified snapshot (epoch/step/optimizer/RNG), and skips the batches the
+previous incarnation already consumed. A self-SIGKILL knob models losing a
+host mid-run — the launcher must turn that into a scale event (relaunch at
+the smaller world size), not a fatal exit.
+
+Markers on stdout (one per line, parsed by the tests):
+    WORLD <n>                      world size this incarnation trains at
+    RESUMED epoch=E step=S global_step=G   (from ResumableTraining)
+    FRESH                          no usable snapshot
+    BATCH <epoch> <step> <global_step>     one executed (not skipped) batch
+    DONE <global_step>             clean finish
+
+Env knobs: PADDLE_TPU_CKPT_DIR (required), PADDLE_TPU_FT_STORE_PORT
+(commit-barrier TCPStore, multi-process only), PADDLE_TPU_FT_EPOCHS /
+PADDLE_TPU_FT_BATCHES (loop shape), PADDLE_TPU_ELASTIC_KILL="rank:step"
+(SIGKILL self on that rank after that many executed batches, first
+incarnation only), PADDLE_TPU_FT_INTERVAL (snapshot every N steps),
+PADDLE_TPU_FT_ASYNC=1 (overlapped snapshots).
+"""
+import os
+import signal
+import sys
+import time
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=1")
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed import fault
+from paddle_tpu.hapi.callbacks import Callback
+from paddle_tpu.io import Dataset
+
+
+class _Markers(Callback):
+    """Print one BATCH marker per EXECUTED batch and self-SIGKILL at the
+    configured point (models sudden host loss — no graceful save)."""
+
+    def __init__(self, rank, incarnation):
+        self.rank = rank
+        self.incarnation = incarnation
+        self.executed = 0
+        kill = os.environ.get("PADDLE_TPU_ELASTIC_KILL", "")
+        self.kill_rank = self.kill_after = None
+        if kill:
+            r, n = kill.split(":")
+            self.kill_rank, self.kill_after = int(r), int(n)
+        self.epoch = 0
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.epoch = epoch
+
+    def on_train_batch_end(self, step, logs=None):
+        self.executed += 1
+        # trailing wall-clock stamp: bench --chaos subtracts the killed
+        # rank's SELF_SIGKILL stamp from the survivors' first post-resume
+        # BATCH stamp to get the scale-event recovery time
+        print(f"BATCH {self.epoch} {step} {self.executed} "
+              f"{time.time():.6f}", flush=True)
+        if (self.incarnation == 0 and self.kill_rank == self.rank
+                and self.executed == self.kill_after):
+            print(f"SELF_SIGKILL {time.time():.6f}", flush=True)
+            sys.stdout.flush()
+            os.kill(os.getpid(), signal.SIGKILL)
+
+
+def main():
+    dist.init_parallel_env()
+    world = jax.process_count()
+    rank = jax.process_index()
+    incarnation = int(os.environ.get("PADDLE_TPU_RESTART_NUM", "0"))
+    print(f"WORLD {world}", flush=True)
+
+    store = None
+    port = os.environ.get("PADDLE_TPU_FT_STORE_PORT")
+    if port and world > 1:
+        store = dist.TCPStore("127.0.0.1", int(port), is_master=(rank == 0),
+                              world_size=world, timeout=120)
+    lineage = fault.CheckpointLineage(os.environ["PADDLE_TPU_CKPT_DIR"],
+                                      store=store, world_size=world,
+                                      rank=rank)
+
+    epochs = int(os.environ.get("PADDLE_TPU_FT_EPOCHS", "2"))
+    n_batches = int(os.environ.get("PADDLE_TPU_FT_BATCHES", "8"))
+    interval = int(os.environ.get("PADDLE_TPU_FT_INTERVAL", "1"))
+
+    paddle.seed(0)
+    X = np.random.RandomState(42).randn(n_batches * 4, 16).astype("float32")
+    Y = X @ np.random.RandomState(7).randn(16, 4).astype("float32")
+
+    class DS(Dataset):
+        def __getitem__(self, i):
+            return X[i], Y[i]
+
+        def __len__(self):
+            return len(X)
+
+    net = nn.Linear(16, 4)
+    model = paddle.Model(net)
+    opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                parameters=net.parameters())
+    model.prepare(optimizer=opt, loss=nn.MSELoss())
+
+    cb = _Markers(rank, incarnation)
+    model.fit(DS(), batch_size=4, epochs=epochs, shuffle=False, verbose=0,
+              callbacks=[cb], lineage=lineage, snapshot_interval=interval,
+              async_snapshot=os.environ.get("PADDLE_TPU_FT_ASYNC") == "1")
+    print(f"DONE {cb.executed}", flush=True)
+    sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -22,3 +22,26 @@ def parse_stamps(text, name):
     ``STEP_DONE \\d+`` whose payload is the wall-clock stamp)."""
     return [float(m.group(1))
             for m in re.finditer(rf"{name} ([\d.eE+-]+)", text)]
+
+
+def read_worker_logs(log_dir, rank):
+    """Concatenated stdout of every incarnation of one rank — the
+    launcher names logs ``workerlog.<rank>[.restart<m>]`` (one source of
+    that naming knowledge for the chaos tests and bench --chaos)."""
+    import glob
+    import os
+    text = ""
+    for p in sorted(glob.glob(os.path.join(log_dir,
+                                           f"workerlog.{rank}*"))):
+        with open(p) as f:
+            text += f.read()
+    return text
+
+
+def free_port():
+    """An OS-assigned free TCP port (shared by the chaos tests and the
+    bench chaos legs, which burn several ports per scenario)."""
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]

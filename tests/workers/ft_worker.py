@@ -19,7 +19,10 @@ Markers on stdout (one per line, parsed by the tests):
 Env knobs: PADDLE_TPU_CKPT_DIR (required), PADDLE_TPU_FT_STEPS (default 6),
 PADDLE_TPU_FT_STORE_PORT (commit-barrier TCPStore, multi-process only),
 PADDLE_TPU_FT_PREEMPT_AT (self-SIGTERM before that step on the first
-incarnation — models the scheduler's preemption notice).
+incarnation — models the scheduler's preemption notice),
+PADDLE_TPU_FT_ASYNC=1 (OVERLAPPED saves: serialization/IO/commit stream on
+the AsyncSaveHandle completion thread while the next step computes — the
+chaos target for async_torn / commit_stall / mid-overlap kills).
 """
 import os
 import sys
@@ -57,6 +60,7 @@ def main():
                               world_size=world, timeout=120)
     lineage = fault.CheckpointLineage(root, store=store, world_size=world,
                                       rank=rank)
+    async_save = os.environ.get("PADDLE_TPU_FT_ASYNC") == "1"
 
     paddle.seed(0)
     X = np.random.RandomState(42).randn(32, 16).astype("float32")
@@ -107,10 +111,11 @@ def main():
         print(f"LOSS {i} {float(loss.numpy())!r}", flush=True)
         t0 = time.perf_counter()
         lineage.save({"model": model.state_dict(), "step": i + 1},
-                     step=i + 1)
+                     step=i + 1, async_save=async_save)
         print(f"CKPT_SAVE_MS {(time.perf_counter() - t0) * 1e3:.2f}",
               flush=True)
         print(f"STEP_DONE {i} {time.time():.6f}", flush=True)
+    lineage.wait()  # drain the last overlapped snapshot before a clean exit
     sys.exit(0)
 
 

@@ -706,6 +706,62 @@ def run_elastic_chaos(epochs=2, batches=6):
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def run_hang_chaos(steps=6):
+    """``--chaos`` hang leg: inject ``hang@step`` into 1 of 3 workers of a
+    launcher-managed job with the flight recorder + watchdog armed. Every
+    rank must dump its collective ring and the launcher post-mortem must
+    name the hung rank; detect-to-abort latency (watchdog trip to process
+    exit, from the dumps' escalate_ms) lands in the bench JSON so hang-
+    diagnosis regressions show up alongside the recovery numbers."""
+    import shutil
+    import subprocess
+    import tempfile
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    workers_dir = os.path.join(repo, "tests", "workers")
+    if workers_dir not in sys.path:
+        sys.path.insert(0, workers_dir)
+    from ft_markers import free_port as _free_port
+    from paddle_tpu.distributed.flight_recorder import collect_dumps
+    worker = os.path.join(workers_dir, "fr_worker.py")
+    tmp = tempfile.mkdtemp(prefix="pd_hang_")
+    log_dir = os.path.join(tmp, "logs")
+    env = _chaos_child_env(repo)
+    env.update({
+        "PADDLE_TPU_FLIGHT_RECORDER": "64",
+        "PADDLE_TPU_WATCHDOG_TIMEOUT": "10",
+        "PADDLE_TPU_WATCHDOG_ESCALATION_BUDGET_S": "10",
+        "PADDLE_TPU_FR_STORE": f"127.0.0.1:{_free_port()}",
+        "PADDLE_TPU_FR_STEPS": str(steps),
+        "PADDLE_TPU_FAULTS": "hang@step:3%1",
+        "PADDLE_TPU_FAULT_HANG_S": "3600",
+    })
+    try:
+        t0 = time.perf_counter()
+        r = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--nproc_per_node", "3", "--master",
+             f"127.0.0.1:{_free_port()}", "--log_dir", log_dir, worker],
+            env=env, capture_output=True, text=True, timeout=600, cwd=repo)
+        wall = time.perf_counter() - t0
+        dumps = collect_dumps(log_dir)
+        dumped = sorted(d.get("rank") for d in dumps)
+        named = "rank 1 stalled before" in r.stderr
+        ok = (r.returncode == 19 and dumped == [0, 1, 2] and named)
+        out = {"hang_postmortem_ok": ok,
+               "hang_job_wall_s": round(wall, 3)}
+        esc = [d.get("escalate_ms") for d in dumps
+               if d.get("escalate_ms") is not None]
+        if esc:
+            out["hang_detect_to_abort_s"] = round(max(esc) / 1e3, 3)
+        if not ok:
+            out["hang_error"] = ("rc=%d dumped=%s named=%s: %s" % (
+                r.returncode, dumped, named, r.stderr[-300:]))
+        return out
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def main_chaos():
     sub = run_chaos_smoke()
     try:
@@ -713,7 +769,14 @@ def main_chaos():
     except Exception as e:  # keep the smoke leg's numbers on the wire
         sub.update({"elastic_scale_ok": False,
                     "elastic_error": repr(e)[-300:]})
-    ok = bool(sub.get("chaos_resume_ok")) and bool(sub.get("elastic_scale_ok"))
+    try:
+        sub.update(run_hang_chaos())
+    except Exception as e:
+        sub.update({"hang_postmortem_ok": False,
+                    "hang_error": repr(e)[-300:]})
+    ok = bool(sub.get("chaos_resume_ok")) \
+        and bool(sub.get("elastic_scale_ok")) \
+        and bool(sub.get("hang_postmortem_ok"))
     print(json.dumps({
         "metric": "chaos_recovery_s",
         "value": sub.get("chaos_recovery_s", 0.0),

@@ -21,9 +21,14 @@ from .env import (  # noqa: F401
 from .parallel import DataParallel, shard_batch  # noqa: F401
 from . import fault  # noqa: F401
 from .fault import (  # noqa: F401
-    Backoff, CheckpointLineage, EXIT_FAULT, EXIT_PREEMPT, EXIT_WATCHDOG,
-    exit_preempted, install_preemption_handler, maybe_inject, preempted,
-    retry, set_fault_spec,
+    Backoff, CheckpointLineage, EXIT_DESYNC, EXIT_FAULT, EXIT_HANG,
+    EXIT_PREEMPT, EXIT_WATCHDOG, describe_exit, exit_preempted,
+    install_preemption_handler, maybe_inject, preempted, retry,
+    set_fault_spec,
+)
+from . import flight_recorder  # noqa: F401
+from .flight_recorder import (  # noqa: F401
+    CollectiveDesyncError, FlightRecorder,
 )
 from .tcp_store import StoreTimeoutError, TCPStore, Watchdog  # noqa: F401
 from .watchdog import (  # noqa: F401

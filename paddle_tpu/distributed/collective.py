@@ -22,6 +22,7 @@ from jax import shard_map
 
 from ..core.tensor import Tensor
 from . import fault as _fault
+from . import flight_recorder as _fr
 
 __all__ = ["ReduceOp", "Group", "new_group", "get_group", "all_reduce",
            "all_gather", "reduce", "broadcast", "scatter", "reduce_scatter",
@@ -95,6 +96,24 @@ def destroy_process_group(group=None):
     if group is None:
         _default_group = None
         _groups.clear()
+        # group identities die with the groups: per-group seq counters
+        # (and the gloo barrier's) must not leak into the next process
+        # group — a resumed incarnation would collide on store keys
+        _fr.reset_seqs()
+
+
+def _collective_begin(site, kind, g, arr=None):
+    """Per-collective bookkeeping: fault injection, flight-recorder issue
+    and the opt-in pre-issue desync cross-check. Returns the recorder
+    entry (None when the recorder is disabled); the caller completes it
+    after the collective returns."""
+    injected = _fault.maybe_inject(site)
+    e = _fr.record_issue(kind, group=f"{g.axis}:{g.id}",
+                         shape=tuple(getattr(arr, "shape", ()) or ())
+                         if arr is not None else None,
+                         dtype=getattr(arr, "dtype", None))
+    _fr.check_desync(e, injected=(injected == "desync"))
+    return e
 
 
 def _as_group(group):
@@ -152,8 +171,8 @@ def _reduce_fn(op, axis):
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     """In-place all-reduce over the rank axis (leading dim).
     Reference: communication/all_reduce.py."""
-    _fault.maybe_inject("allreduce")
     g = _as_group(group)
+    rec = _collective_begin("allreduce", "all_reduce", g, tensor._data)
     arr = _placed(tensor._data, g)
     red = _reduce_fn(op, g.axis)
 
@@ -165,14 +184,15 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
 
     out = _rankdim_op(g, f, arr)
     tensor._data = out
+    _fr.record_complete(rec)
     return tensor
 
 
 def all_gather(tensor_list, tensor, group=None, sync_op=True):
     """Gather every rank's slice; fills tensor_list with the N slices
     (replicated). Reference: communication/all_gather.py."""
-    _fault.maybe_inject("allgather")
     g = _as_group(group)
+    rec = _collective_begin("allgather", "all_gather", g, tensor._data)
     arr = _placed(tensor._data, g)
 
     def f(x):
@@ -182,6 +202,7 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True):
     gathered = shard_map(f, mesh=g.mesh, in_specs=(spec_in,),
                          out_specs=P(*([None] * arr.ndim)),
                          check_vma=False)(arr)
+    _fr.record_complete(rec)
     if tensor_list is not None:
         tensor_list.clear()
         for i in range(g.nranks):
@@ -194,6 +215,7 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
     (reference ProcessGroup::Reduce semantics leave non-dst undefined — we
     keep input)."""
     g = _as_group(group)
+    rec = _collective_begin("reduce", "reduce", g, tensor._data)
     arr = _placed(tensor._data, g)
     red = _reduce_fn(op, g.axis)
 
@@ -205,14 +227,15 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
         return jnp.where(idx == dst, y, x)
 
     tensor._data = _rankdim_op(g, f, arr)
+    _fr.record_complete(rec)
     return tensor
 
 
 def broadcast(tensor, src=0, group=None, sync_op=True):
     """Every rank slice becomes the src slice.
     Reference: communication/broadcast.py."""
-    _fault.maybe_inject("broadcast")
     g = _as_group(group)
+    rec = _collective_begin("broadcast", "broadcast", g, tensor._data)
     arr = _placed(tensor._data, g)
 
     def f(x):
@@ -220,6 +243,7 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
         return full[src][None]
 
     tensor._data = _rankdim_op(g, f, arr)
+    _fr.record_complete(rec)
     return tensor
 
 
@@ -227,9 +251,11 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     """Rank i receives tensor_list[i] (from src). With a single controller the
     list is already global: stack + shard."""
     g = _as_group(group)
+    rec = _collective_begin("scatter", "scatter", g, tensor._data)
     stacked = jnp.stack([t._data if isinstance(t, Tensor) else jnp.asarray(t)
                          for t in tensor_list])
     tensor._data = _placed(stacked, g)
+    _fr.record_complete(rec)
     return tensor
 
 
@@ -237,8 +263,9 @@ def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM, group=None,
                    sync_op=True):
     """Each rank gets one reduced chunk: input per-rank [N*c, ...] → output
     per-rank [c, ...]. Reference: communication/reduce_scatter.py."""
-    _fault.maybe_inject("reducescatter")
     g = _as_group(group)
+    rec = _collective_begin("reducescatter", "reduce_scatter", g,
+                            tensor._data)
     src = tensor_or_tensor_list
     if isinstance(src, (list, tuple)):
         # list form: element i is rank i's full payload [N*c, ...]; stacking
@@ -267,18 +294,19 @@ def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM, group=None,
 
     out = _rankdim_op(g, f, g_arr)
     tensor._data = out
+    _fr.record_complete(rec)
     return tensor
 
 
 def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
     """Rank i sends chunk j to rank j. Global view: [N, N, ...] transpose of
     the two leading axes. Reference: communication/all_to_all.py."""
-    _fault.maybe_inject("alltoall")
     g = _as_group(group)
     if isinstance(in_tensor_list, (list, tuple)):
         arr = jnp.stack([t._data for t in in_tensor_list])
     else:
         arr = in_tensor_list._data
+    rec = _collective_begin("alltoall", "all_to_all", g, arr)
     g_arr = _placed(arr, g)
 
     def f(x):
@@ -287,6 +315,7 @@ def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
                                   tiled=True)
 
     out = _rankdim_op(g, f, g_arr)
+    _fr.record_complete(rec)
     if out_tensor_list is not None:
         out_tensor_list.clear()
         for i in range(out.shape[0]):
@@ -299,12 +328,13 @@ def barrier(group=None):
     payload is identical on every process, so it places globally under
     multi-controller SPMD too."""
     from .placement import place_global
-    _fault.maybe_inject("barrier")
     g = _as_group(group)
+    rec = _collective_begin("barrier", "barrier", g)
     spec = P(g.axis, *([None]))
     arr = place_global(np.ones((g.nranks, 1), np.float32),
                        NamedSharding(g.mesh, spec))
     _rankdim_op(g, lambda x: jax.lax.psum(x, g.axis), arr).block_until_ready()
+    _fr.record_complete(rec)
 
 
 def all_reduce_quantized(tensor, group=None, bits=8, sync_op=True):
@@ -324,6 +354,8 @@ def all_reduce_quantized(tensor, group=None, bits=8, sync_op=True):
                          f"(int4 without nibble packing saves no "
                          f"bandwidth), got {bits}")
     g = _as_group(group)
+    rec = _collective_begin("allreduce", "all_reduce_quantized", g,
+                            tensor._data)
     arr = _placed(tensor._data, g)
     qmax = float(2 ** (bits - 1) - 1)
 
@@ -341,4 +373,5 @@ def all_reduce_quantized(tensor, group=None, bits=8, sync_op=True):
 
     out = _rankdim_op(g, f, arr)
     tensor._data = out
+    _fr.record_complete(rec)
     return tensor

@@ -18,6 +18,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.tensor import Tensor
+from . import flight_recorder as _fr
 from .collective import ReduceOp, _as_group, all_gather  # noqa: F401
 
 __all__ = ["gather", "alltoall", "alltoall_single", "send", "recv",
@@ -113,10 +114,13 @@ def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
         raise ValueError(
             f"alltoall_single expects the global [nranks={n}, len] buffer, "
             f"got shape {tuple(arr.shape)}")
+    rec = _fr.record_issue("alltoall_single", group=f"{g.axis}:{g.id}",
+                           shape=tuple(arr.shape), dtype=arr.dtype)
     k = arr.shape[1] // n
     chunked = arr.reshape((n, n, k) + arr.shape[2:])
     out = jnp.swapaxes(chunked, 0, 1).reshape(arr.shape)
     out_tensor._data = out
+    _fr.record_complete(rec)
     return out_tensor
 
 
@@ -135,16 +139,23 @@ def send(tensor, dst=0, group=None, sync_op=True):
     rank in-process (mailbox move); multi-controller routes bytes through
     the TCPStore side channel, the reference's Gloo-equivalent path."""
     from .env import get_rank, get_world_size
+    rec = _fr.record_issue("send", group="p2p",
+                           shape=tuple(tensor._data.shape),
+                           dtype=tensor._data.dtype, extra={"dst": dst})
     if get_world_size() > 1 and _store() is not None:
         key = f"p2p/{get_rank()}->{dst}"
         _store().set(key, pickle.dumps(np.asarray(tensor._data)))
     else:
         _mailbox.setdefault(dst, []).append(np.asarray(tensor._data))
+    _fr.record_complete(rec)
     return _Task(None)
 
 
 def recv(tensor, src=0, group=None, sync_op=True):
     from .env import get_rank, get_world_size
+    rec = _fr.record_issue("recv", group="p2p",
+                           shape=tuple(tensor._data.shape),
+                           dtype=tensor._data.dtype, extra={"src": src})
     if get_world_size() > 1 and _store() is not None:
         key = f"p2p/{src}->{get_rank()}"
         _store().wait([key])
@@ -156,6 +167,7 @@ def recv(tensor, src=0, group=None, sync_op=True):
             raise RuntimeError(f"recv: no message pending from rank {src}")
         arr = box.pop(0)
     tensor._data = jnp.asarray(arr)
+    _fr.record_complete(rec)
     return _Task(tensor)
 
 
@@ -194,6 +206,7 @@ def all_gather_object(object_list, obj, group=None):
     or direct append (single-controller: one process holds all ranks)."""
     from .env import get_rank, get_world_size
     world = get_world_size()
+    rec = _fr.record_issue("all_gather_object", group="object")
     if world > 1 and _store() is not None:
         st = _store()
         st.set(f"ago/{get_rank()}", pickle.dumps(obj))
@@ -202,12 +215,15 @@ def all_gather_object(object_list, obj, group=None):
             object_list.append(pickle.loads(st.get(f"ago/{r}")))
     else:
         object_list.append(obj)
+    _fr.record_complete(rec)
     return object_list
 
 
 def broadcast_object_list(object_list, src=0, group=None):
     from .env import get_rank, get_world_size
     world = get_world_size()
+    rec = _fr.record_issue("broadcast_object_list", group="object",
+                           extra={"src": src})
     if world > 1 and _store() is not None:
         st = _store()
         if get_rank() == src:
@@ -215,6 +231,7 @@ def broadcast_object_list(object_list, src=0, group=None):
         st.wait(["bol/payload"])
         got = pickle.loads(st.get("bol/payload"))
         object_list[:] = got
+    _fr.record_complete(rec)
     return object_list
 
 
@@ -222,6 +239,8 @@ def scatter_object_list(out_object_list, in_object_list=None, src=0,
                         group=None):
     from .env import get_rank, get_world_size
     world = get_world_size()
+    rec = _fr.record_issue("scatter_object_list", group="object",
+                           extra={"src": src})
     if world > 1 and _store() is not None:
         st = _store()
         if get_rank() == src:
@@ -231,6 +250,7 @@ def scatter_object_list(out_object_list, in_object_list=None, src=0,
         out_object_list.append(pickle.loads(st.get(f"sol/{get_rank()}")))
     else:
         out_object_list.append((in_object_list or [None])[0])
+    _fr.record_complete(rec)
     return out_object_list
 
 
@@ -282,22 +302,30 @@ def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
     _env._gloo_rank = rank_id
 
 
-_gloo_barrier_seq = [0]
-
-
 def gloo_barrier():
+    """Store-backed CPU barrier. The barrier key now comes from the flight
+    recorder's per-group seq registry, namespaced by incarnation
+    (``flight_recorder.store_scope()``): the old process-global
+    ``_gloo_barrier_seq`` counter was never reset on
+    ``destroy_process_group``/``gloo_release`` and restarted from zero in
+    a relaunched incarnation, colliding with the stale keys the previous
+    incarnation left in the store."""
     from . import env as _env
     st = getattr(_env, "_global_store", None)
     if st is None:
         raise RuntimeError("call gloo_init_parallel_env first")
     n = getattr(_env, "_gloo_world", 1)
-    _gloo_barrier_seq[0] += 1
-    st.barrier(f"gloo_barrier_{_gloo_barrier_seq[0]}", n)
+    seq = _fr.next_group_seq("gloo_barrier")
+    rec = _fr.record_issue("gloo_barrier", group="gloo",
+                           extra={"gloo_seq": seq})
+    st.barrier(f"{_fr.store_scope()}/gloo_barrier/{seq}", n)
+    _fr.record_complete(rec)
 
 
 def gloo_release():
     from . import env as _env
     _env._global_store = None
+    _fr.reset_seqs("gloo_barrier")  # next gloo env starts a fresh lineage
 
 
 # -- TP split helper ------------------------------------------------------

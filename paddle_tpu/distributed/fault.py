@@ -16,7 +16,7 @@ Four pieces, stdlib-only (importable by the launcher before jax loads):
        spec    := entry ("," entry)*
        entry   := kind ["@" site] ":" trigger ["%" rank]
        kind    := crash | hang | torn_write | store_drop | slow_io
-                | async_torn | commit_stall
+                | async_torn | commit_stall | desync
        trigger := 1-based Nth matching hit that fires the fault
        rank    := only this process id injects (default: every rank)
 
@@ -61,7 +61,8 @@ import threading
 import time
 
 __all__ = [
-    "EXIT_FAULT", "EXIT_PREEMPT", "EXIT_WATCHDOG", "FaultEntry",
+    "EXIT_FAULT", "EXIT_PREEMPT", "EXIT_WATCHDOG", "EXIT_HANG",
+    "EXIT_DESYNC", "EXIT_CAUSES", "describe_exit", "FaultEntry",
     "parse_fault_spec", "set_fault_spec", "maybe_inject", "fault_rank",
     "Backoff", "retry", "atomic_write", "atomic_write_bytes",
     "CheckpointLineage",
@@ -71,18 +72,54 @@ __all__ = [
 EXIT_FAULT = 43      # injected crash — a real failure, consumes a restart
 EXIT_PREEMPT = 75    # graceful preemption (EX_TEMPFAIL) — resumable free
 EXIT_WATCHDOG = 17   # native watchdog abort (core/native/tcp_store.cpp)
+EXIT_HANG = 19       # watchdog ESCALATION: flight-recorder dump + blame
+                     # written, then abort (distributed/watchdog.py)
+EXIT_DESYNC = 21     # collective desync detected pre-issue (fail-fast,
+                     # distributed/flight_recorder.py)
+
+# The one copy of the worker exit-code -> human cause mapping (launcher
+# failure summaries, tests). Negative codes are death-by-signal and are
+# rendered by describe_exit.
+EXIT_CAUSES = {
+    EXIT_FAULT: "injected chaos crash",
+    EXIT_PREEMPT: "graceful preemption (resumable, does not consume "
+                  "restarts)",
+    EXIT_WATCHDOG: "hung collective — native watchdog abort (no dump: "
+                   "escalation backstop)",
+    EXIT_HANG: "hung collective — watchdog escalated: flight-recorder "
+               "dump + blame written",
+    EXIT_DESYNC: "collective desync — mismatched collective detected "
+                 "before issue (fail-fast)",
+}
+
+
+def describe_exit(rc) -> str:
+    """'rc=<n>: <cause>' for known worker exit codes; signal names for
+    negative codes; bare 'rc=<n>' otherwise."""
+    cause = EXIT_CAUSES.get(rc)
+    if cause is None and isinstance(rc, int) and rc < 0:
+        try:
+            cause = f"killed by {signal.Signals(-rc).name}"
+        except ValueError:
+            cause = None
+    return f"rc={rc}: {cause}" if cause else f"rc={rc}"
+
 
 _KINDS = ("crash", "hang", "torn_write", "store_drop", "slow_io",
-          "async_torn", "commit_stall")
+          "async_torn", "commit_stall", "desync")
 # a site-less (wildcard) cooperative entry only fires at sites whose
 # callers honor the returned kind — anywhere else it would burn its
 # trigger silently; crash/hang/slow_io/commit_stall wildcards fire at
 # any site. ``async_torn`` tears a shard landed by the OVERLAPPED async
 # writer (checkpoint.AsyncSaveHandle); ``commit_stall`` sleeps inside
 # the lineage commit window (between the durability barrier and the
-# LATEST flip) so the chaos harness can kill mid-commit.
+# LATEST flip) so the chaos harness can kill mid-commit. ``desync`` is
+# cooperative at the eager-collective sites: the collective perturbs its
+# cross-rank signature so the opt-in desync check trips deterministically.
+_DESYNC_SITES = ("allreduce", "allgather", "reduce", "broadcast", "scatter",
+                 "reducescatter", "alltoall", "barrier")
 _WILDCARD_SITES = {"store_drop": ("store",), "torn_write": ("ckpt",),
-                   "async_torn": ("async_ckpt",)}
+                   "async_torn": ("async_ckpt",), "desync": _DESYNC_SITES}
 
 _lock = threading.Lock()
 _entries: list | None = None  # parsed spec; None = not yet loaded from env

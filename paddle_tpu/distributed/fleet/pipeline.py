@@ -36,6 +36,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ...core.tensor import Tensor
 from ...core.autograd import backward as _tape_backward
 from ...nn import Layer, LayerList
+from .. import fault as _fault
+from .. import flight_recorder as _fr
 from ..topology import get_hybrid_communicate_group
 
 __all__ = ["LayerDesc", "SharedLayerDesc", "PipelineLayer",
@@ -287,6 +289,13 @@ class PipelineParallel(Layer):
 
         def run_forward(s, chunk, mb):
             nonlocal live_bytes, peak_bytes
+            # micro-batch boundary: chaos site + flight-recorder entry —
+            # a post-mortem shows exactly which (stage, micro-batch) the
+            # schedule reached
+            _fault.maybe_inject("pp_microbatch")
+            fre = _fr.record_issue("pp_forward", group="pipe",
+                                   extra={"stage": s, "pp_chunk": chunk,
+                                          "mb": mb})
             seg = chunk * S + s
             if seg == 0:
                 x_in = xs[mb]
@@ -310,9 +319,13 @@ class PipelineParallel(Layer):
             live_bytes += rec.bytes
             peak_bytes = max(peak_bytes, live_bytes)
             order.append(("F", s, chunk, mb))
+            _fr.record_complete(fre)
 
         def run_backward(s, chunk, mb):
             nonlocal live_bytes
+            fre = _fr.record_issue("pp_backward", group="pipe",
+                                   extra={"stage": s, "pp_chunk": chunk,
+                                          "mb": mb})
             seg = chunk * S + s
             rec = saved.pop((seg, mb))
             if seg == last_seg:
@@ -332,6 +345,7 @@ class PipelineParallel(Layer):
             inflight[s] -= 1
             live_bytes -= rec.bytes
             order.append(("B", s, chunk, mb))
+            _fr.record_complete(fre)
 
         progs = [self._stage_program(s, M) for s in range(S)]
         pos = [0] * S

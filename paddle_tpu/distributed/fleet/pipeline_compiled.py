@@ -32,6 +32,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ...core import random as _random
 from ...core.tensor import Parameter, Tensor
 from ...nn import Layer
+from .. import fault as _fault
+from .. import flight_recorder as _fr
 from ..topology import get_hybrid_communicate_group
 from .pipeline import PipelineLayer
 
@@ -307,6 +309,18 @@ class CompiledPipelineParallel(Layer):
         _watchdog.beat()
         x, y = data
         M = self._num_micro
+        # The whole M-micro-batch schedule compiles into ONE XLA program,
+        # so the only host-visible micro-batch boundary is here, before
+        # launch. Walking it gives the chaos harness a deterministic
+        # per-micro-batch site (``<kind>@pp_microbatch:N`` counts logical
+        # micro-batches across steps — ROADMAP open item "fault sites
+        # inside the compiled pipeline schedule") and the flight recorder
+        # one entry per micro-batch of the schedule.
+        for mb in range(M):
+            _fault.maybe_inject("pp_microbatch")
+            _fr.record_complete(_fr.record_issue(
+                "pp_microbatch", group="pipe", shape=tuple(x.shape),
+                dtype=x.dtype, extra={"mb": mb, "n_micro": M}))
         key = ("train", tuple(x.shape), str(x.dtype), tuple(y.shape))
         step = self._cache.get(key)
         if step is None:
@@ -318,9 +332,13 @@ class CompiledPipelineParallel(Layer):
         scale = jnp.asarray(
             scaler._scale if scaler is not None and scaler.is_enable()
             else 1.0, jnp.float32)
+        rec = _fr.record_issue("pipeline_compiled_step", group="pipe",
+                               shape=tuple(x.shape), dtype=x.dtype,
+                               extra={"n_micro": M})
         loss, (g_pre, g_blk, g_post) = step(
             pre_arrs, blk_arrs, post_arrs, x._data, y._data,
             _random.next_key(), scale)
+        _fr.record_complete(rec)
         for p, g in zip(self._pre_params, g_pre):
             p._grad = g if p._grad is None else p._grad + g
         for p, g in zip(self._stacked, g_blk):

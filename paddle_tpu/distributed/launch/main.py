@@ -11,8 +11,12 @@ Fault-tolerance contract (distributed/fault.py):
   by ``--max_preempt_restarts`` as a runaway guard).
 - With ``--max_restarts > 0`` the per-step watchdog is armed by default
   (``PADDLE_TPU_WATCHDOG_TIMEOUT`` forwarded to workers, override or
-  set 0 to disable): a hung collective converts into an abort (exit 17)
-  and thus a restart instead of a stuck job.
+  set 0 to disable): a hung collective converts into an escalated abort
+  (flight-recorder dump + blame, exit 19; native exit-17 backstop) and
+  thus a restart instead of a stuck job. Worker exit codes are mapped to
+  causes via ``fault.describe_exit``; after any failure the launcher
+  prints a per-rank flight-recorder post-mortem when dumps exist in
+  ``--log_dir`` (workers learn it via ``PADDLE_TPU_WORKERLOG_DIR``).
 - When ``PADDLE_TPU_FAULTS`` is set, a fault ledger file under
   ``--log_dir`` is exported so deterministic injections fire once per
   job, not once per incarnation.
@@ -37,7 +41,7 @@ import subprocess
 import sys
 import time
 
-from ..fault import EXIT_PREEMPT
+from ..fault import EXIT_PREEMPT, describe_exit
 
 __all__ = ["launch", "main"]
 
@@ -115,6 +119,8 @@ def _spawn(args, local_rank, restart_count, extra_env=None, world_np=None):
         # reference-compatible names (fleet env bootstrap)
         "PADDLE_TRAINER_ID": str(global_rank),
         "PADDLE_TRAINERS_NUM": str(world),
+        # flight-recorder dumps + watchdog post-mortems land here
+        "PADDLE_TPU_WORKERLOG_DIR": os.path.abspath(args.log_dir),
     })
     if not env["PADDLE_TPU_COORDINATOR"]:
         env.pop("PADDLE_TPU_COORDINATOR")
@@ -133,6 +139,33 @@ def _spawn(args, local_rank, restart_count, extra_env=None, world_np=None):
         env=env, stdout=log_f, stderr=subprocess.STDOUT)
     log_f.close()  # the child holds its own fd copy
     return proc, log_path
+
+
+def _clear_dumps(log_dir):
+    """Drop flight-recorder dumps left by a previous spawn round (or a
+    previous job sharing this log dir): each round's post-mortem must
+    describe THAT round's failure, not blame a restart's crash on the
+    stale dumps of an earlier hang."""
+    import glob
+    for p in glob.glob(os.path.join(log_dir, "flight_recorder.*.json")):
+        try:
+            os.unlink(p)
+        except OSError:
+            pass
+
+
+def _post_mortem(log_dir):
+    """One-screen flight-recorder post-mortem after a worker failure:
+    collect the per-rank dumps the workers wrote into ``log_dir`` and
+    print the blame summary ("rank 2 stalled before all_reduce seq=417").
+    Silent when no worker dumped."""
+    try:
+        from ..flight_recorder import collect_dumps, format_post_mortem
+        text = format_post_mortem(collect_dumps(log_dir))
+    except Exception:
+        return
+    if text:
+        print(text, file=sys.stderr, flush=True)
 
 
 def _terminate_survivors(procs, grace):
@@ -289,6 +322,8 @@ def launch(argv=None):
             print(f"[elastic] round {spawn_round}: world_size={cur_np} "
                   f"(range {elastic.min_np}:{elastic.max_np})",
                   file=sys.stderr)
+        os.makedirs(args.log_dir, exist_ok=True)
+        _clear_dumps(args.log_dir)
         procs = []
         for lr in range(cur_np if elastic is not None
                         else args.nproc_per_node):
@@ -346,8 +381,9 @@ def launch(argv=None):
                     pass
             return 0
         rc, log_path = first_bad
-        print(f"[launch] worker failed (rc={rc}); log: {log_path}",
-              file=sys.stderr)
+        print(f"[launch] worker failed ({describe_exit(rc)}); "
+              f"log: {log_path}", file=sys.stderr)
+        _post_mortem(args.log_dir)
         if rc == EXIT_PREEMPT:
             preempt_restarts += 1
             if preempt_restarts > args.max_preempt_restarts:

@@ -33,6 +33,7 @@ from __future__ import annotations
 import os
 import sys
 
+from . import flight_recorder as _fr
 from .fault import (CheckpointLineage, exit_preempted,
                     install_preemption_handler, preempted)
 
@@ -120,6 +121,7 @@ class ResumableTraining:
             self.step_in_epoch = int(target["step_in_epoch"])
             self.global_step = int(target["global_step"])
             self._last_saved_step = self.global_step
+            _fr.note_step(self.global_step)
             old_world = int(target.get("world_size", 0) or 0)
             new_world = int(getattr(self.lineage, "world_size", 1) or 1)
             if old_world and old_world != new_world:
@@ -168,6 +170,9 @@ class ResumableTraining:
         silently skip; ``epoch_done`` runs after those hooks, so its
         snapshot is the hook-exact boundary."""
         self.global_step += 1
+        # pin the flight recorder's step number so hang/desync post-
+        # mortems name the exact trainer step, not a heartbeat estimate
+        _fr.note_step(self.global_step)
         if self.interval and self.global_step % self.interval == 0 \
                 and not defer_to_epoch:
             self._save(epoch, step_in_epoch + 1)

@@ -219,10 +219,14 @@ class TCPStore:
         """add+wait barrier (reference masterDaemon barrier pattern).
         ``timeout`` bounds the wait (StoreTimeoutError) — a dead peer must
         not hold a preempting rank past the launcher's kill grace."""
+        from . import flight_recorder as _fr
+        rec = _fr.record_issue("store_barrier", group="store",
+                               extra={"name": name})
         n = self.add(f"__barrier/{name}", 1)
         if n >= world_size:
             self.set(f"__barrier/{name}/done", b"1")
         self.get(f"__barrier/{name}/done", timeout=timeout)
+        _fr.record_complete(rec)
 
     def __del__(self):
         try:

@@ -58,6 +58,10 @@ def _seed():
         if k in _flags._registry:
             _flags._registry[k].value = v
     _dispatch._check_nan_inf = saved_nan_check
+    # the flight recorder is process-wide too: drop back to the (disabled)
+    # env-gated default so an enabled recorder/desync mode can't leak
+    from paddle_tpu.distributed import flight_recorder as _flight
+    _flight._reset_state()
     if os.environ.get("PADDLE_TPU_FAULTS") != saved_fault_env:
         if saved_fault_env is None:
             os.environ.pop("PADDLE_TPU_FAULTS", None)

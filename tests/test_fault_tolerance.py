@@ -24,6 +24,8 @@ import paddle_tpu as paddle
 import paddle_tpu.distributed as dist
 from paddle_tpu.distributed import checkpoint as dckpt
 from paddle_tpu.distributed import fault
+from paddle_tpu.distributed import flight_recorder as flight
+from paddle_tpu.distributed import watchdog as watchdog_mod
 
 WORKERS = os.path.join(os.path.dirname(__file__), "workers")
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -36,12 +38,15 @@ from ft_markers import (parse_losses,  # noqa: E402  (shared with bench.py)
 
 @pytest.fixture(autouse=True)
 def _clean_fault_state(monkeypatch):
-    """Each test starts with no spec, no ledger, and leaves none behind."""
+    """Each test starts with no spec, no ledger, no flight recorder, and
+    leaves none behind."""
     monkeypatch.delenv("PADDLE_TPU_FAULTS", raising=False)
     monkeypatch.delenv("PADDLE_TPU_FAULT_LEDGER", raising=False)
     fault.set_fault_spec(None)
+    flight._reset_state()
     yield
     fault.set_fault_spec(None)
+    flight._reset_state()
 
 
 # ---------------------------------------------------------------- spec
@@ -74,6 +79,15 @@ def test_fault_spec_grammar():
                                     "commit_stall@commit:1"]
     with pytest.raises(ValueError):
         fault.parse_fault_spec("async_torn@ckpt:1")
+    # flight-recorder era: desync is cooperative at the eager-collective
+    # sites only (the desync check enacts the perturbed signature there)
+    es = fault.parse_fault_spec("desync@barrier:2%2,desync@allreduce:1")
+    assert [e.key() for e in es] == ["desync@barrier:2%2",
+                                    "desync@allreduce:1"]
+    with pytest.raises(ValueError):
+        fault.parse_fault_spec("desync@step:1")
+    with pytest.raises(ValueError):
+        fault.parse_fault_spec("desync@ckpt:1")
 
 
 def test_async_torn_wildcard_only_fires_at_async_site():
@@ -904,6 +918,474 @@ def test_elastic_chaos_sigkill_scales_down_and_resumes(tmp_path):
         # epoch 1 exists in round 1: the job finished all epochs at the
         # smaller world size
         assert any(b[0] == 1 for b in batches)
+
+
+# ------------------------------------------------ collective flight recorder
+
+def test_flight_recorder_disabled_is_noop():
+    """Steady-state overhead when disabled (acceptance): the env gate is
+    off, so every hook returns immediately — no recorder, no ring slot,
+    no store traffic."""
+    assert flight.get_recorder() is None
+    assert flight.record_issue("all_reduce", group="world:0") is None
+    flight.record_complete(None)  # must not throw
+    flight.note_heartbeat()
+    t = paddle.to_tensor(np.ones((8, 2), "float32"))
+    dist.all_reduce(t)  # full collective path with the recorder off
+    assert flight.get_recorder() is None
+
+
+def test_flight_recorder_records_collectives_and_wraps():
+    rec = flight.enable(capacity=4)
+    t = paddle.to_tensor(np.ones((8, 2), "float32"))
+    dist.all_reduce(t)
+    dist.barrier()
+    es = rec.entries()
+    assert [e["kind"] for e in es] == ["all_reduce", "barrier"]
+    assert all(e["status"] == "completed" for e in es)
+    assert es[0]["shape"] == [8, 2] and es[0]["dtype"] == "float32"
+    assert es[0]["site"] and "test_fault_tolerance" in es[0]["site"]
+    assert es[0]["seq"] == 1 and es[1]["seq"] == 2
+    assert rec.last_completed["kind"] == "barrier"
+    # ring wraps at capacity, keeping the newest entries
+    for _ in range(7):
+        dist.all_reduce(t)
+    es = rec.entries()
+    assert len(es) == 4
+    assert es[-1]["seq"] == 9  # 2 + 7
+
+
+def test_flight_recorder_dump_roundtrip(tmp_path):
+    rec = flight.enable(capacity=8)
+    t = paddle.to_tensor(np.ones((8, 2), "float32"))
+    dist.all_reduce(t)
+    rec.issue("barrier", group="world:0")  # left pending on purpose
+    path = flight.dump(reason="manual", dump_dir=str(tmp_path))
+    assert os.path.basename(path) == "flight_recorder.0.json"
+    [doc] = flight.collect_dumps(str(tmp_path))
+    assert doc["reason"] == "manual" and doc["enabled"]
+    assert doc["pending"]["kind"] == "barrier"
+    assert doc["last_completed"]["kind"] == "all_reduce"
+    assert len(doc["entries"]) == 2
+    assert any("MainThread" in k for k in doc["threads"])  # stacks dumped
+
+
+def test_flight_recorder_compiled_pipeline_microbatch_sites():
+    """Satellite: the compiled pipeline schedule walks a deterministic
+    per-micro-batch fault site and records one entry per micro-batch."""
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.models import GPTConfig, GPTForCausalLMPipe
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "pp_degree": 2,
+                               "sharding_degree": 1, "sep_degree": 1,
+                               "mp_degree": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                    num_heads=2, max_seq_len=16, dropout=0.0)
+    model = GPTForCausalLMPipe(cfg, num_stages=2)
+    pipe = fleet.CompiledPipelineParallel(model, num_micro_batches=4)
+    opt = paddle.optimizer.SGD(learning_rate=0.0,
+                               parameters=pipe.parameters())
+    rng = np.random.RandomState(0)
+    # batch 16 / 4 micro-batches = mb 4, divisible by the auto-filled dp=4
+    ids = paddle.to_tensor(rng.randint(0, 64, (16, 16)).astype("int32"))
+    lab = paddle.to_tensor(rng.randint(0, 64, (16, 16)).astype("int32"))
+    rec = flight.enable(capacity=32)
+    # a never-firing entry counts site hits: one per micro-batch boundary
+    fault.set_fault_spec("crash@pp_microbatch:999")
+    pipe.train_batch((ids, lab), opt)
+    [entry] = fault._entries
+    assert entry.hits == 4
+    mbs = [e for e in rec.entries() if e["kind"] == "pp_microbatch"]
+    assert [e["mb"] for e in mbs] == [0, 1, 2, 3]
+    assert [e["kind"] for e in rec.entries()][-1] == "pipeline_compiled_step"
+    # second batch: the counter keeps counting logical micro-batches
+    pipe.train_batch((ids, lab), opt)
+    assert entry.hits == 8
+
+
+def test_flight_recorder_seq_registry_and_incarnation(monkeypatch):
+    """Per-group seqs are monotonic, resettable, and store keys are
+    namespaced by launcher incarnation (satellite: no cross-incarnation
+    store-key collisions) AND by reset epoch (a same-process re-init
+    whose counters restart must not reuse the old lifetime's keys)."""
+    assert flight.next_group_seq("op/world:0") == 1
+    assert flight.next_group_seq("op/world:0") == 2
+    assert flight.next_group_seq("op/sub:1") == 1
+    flight.reset_seqs("op/sub")
+    assert flight.current_group_seq("op/world:0") == 2
+    assert flight.current_group_seq("op/sub:1") == 0
+    scope = flight.store_scope()
+    assert scope.startswith("fr/i0")
+    flight.reset_seqs()
+    assert flight.current_group_seq("op/world:0") == 0
+    # counters restarted -> the namespace must have rotated with them
+    assert flight.store_scope() != scope
+    assert flight.store_scope().startswith("fr/i0")
+    monkeypatch.setenv("PADDLE_TPU_RESTART_NUM", "3")
+    assert flight.store_scope().startswith("fr/i3")
+
+
+def test_gloo_barrier_keys_namespaced_per_incarnation(monkeypatch):
+    """The gloo barrier now draws its seq from the flight-recorder
+    registry and scopes store keys by incarnation: a relaunched worker
+    cannot collide with the keys its previous incarnation left behind
+    (the old process-global counter restarted at 0 every incarnation)."""
+    from paddle_tpu.distributed import env as dist_env
+    port = _free_port()
+    # the launcher-side store outlives worker incarnations — exactly the
+    # collision scenario: the second incarnation's counter restarts at 1
+    # while the store still holds the first incarnation's keys
+    master = dist.TCPStore("127.0.0.1", port, is_master=True, timeout=15)
+    dist_env._global_store, dist_env._gloo_world = master, 1
+    try:
+        s0 = flight.store_scope()
+        dist.gloo_barrier()
+        assert master.check(f"__barrier/{s0}/gloo_barrier/1")
+        dist.gloo_barrier()
+        assert master.check(f"__barrier/{s0}/gloo_barrier/2")
+        # same-process re-init against the SAME surviving store: the gloo
+        # seq counter restarts at 1, so the namespace must rotate — a
+        # reused key would find the old done-flag and "release" the
+        # barrier before any peer arrived
+        dist.gloo_release()
+        dist_env._global_store, dist_env._gloo_world = master, 1
+        s1 = flight.store_scope()
+        assert s1 != s0
+        dist.gloo_barrier()
+        assert master.check(f"__barrier/{s1}/gloo_barrier/1")
+        # cross-incarnation: relaunched worker, counters reset again —
+        # fresh namespace, no collision with either earlier lineage
+        dist.gloo_release()
+        flight.reset_seqs()
+        monkeypatch.setenv("PADDLE_TPU_RESTART_NUM", "1")
+        dist_env._global_store, dist_env._gloo_world = master, 1
+        s2 = flight.store_scope()
+        assert s2.startswith("fr/i1") and s2 not in (s0, s1)
+        dist.gloo_barrier()
+        assert master.check(f"__barrier/{s2}/gloo_barrier/1")
+        assert not master.check(f"__barrier/{s2}/gloo_barrier/2")
+    finally:
+        dist.gloo_release()
+
+
+# --------------------------------------------------------- desync detection
+
+def test_verify_signatures_names_divergent_rank():
+    flight.verify_signatures({0: "a", 1: "a"})  # agreement: no raise
+    with pytest.raises(dist.CollectiveDesyncError) as ei:
+        flight.verify_signatures({0: "sigA", 1: "sigB", 2: "sigA"},
+                                 what="all_reduce seq=7")
+    msg = str(ei.value)
+    assert "rank 1" in msg and "sigB" in msg and "sigA" in msg
+    assert "all_reduce seq=7" in msg
+    # an injection-marked signature can never win a tie: the perturbed
+    # rank is blamed even in a 2-rank world
+    with pytest.raises(dist.CollectiveDesyncError) as ei:
+        flight.verify_signatures({0: "s|DESYNC-INJECTED", 1: "s"})
+    assert "rank 0" in str(ei.value)
+
+
+def test_injected_desync_warns_when_checking_inactive(capfd):
+    """A consumed desync trigger with checking inactive must be LOUD: the
+    chaos run would otherwise pass vacuously (the ledger burns the
+    entry)."""
+    fault.set_fault_spec("desync@allreduce:1")
+    t = paddle.to_tensor(np.ones((8, 2), "float32"))
+    dist.all_reduce(t)  # recorder off, desync off: nothing enacted
+    err = capfd.readouterr().err
+    assert "desync checking is INACTIVE" in err
+
+
+def test_flight_recorder_garbage_env_value_stays_disabled(monkeypatch,
+                                                          capfd):
+    monkeypatch.setenv("PADDLE_TPU_FLIGHT_RECORDER", "false")
+    flight._reset_state()
+    assert flight.get_recorder() is None
+    assert "stays DISABLED" in capfd.readouterr().err
+
+
+def test_injected_desync_fails_fast_before_issue():
+    """Acceptance: an injected ``desync`` makes the pre-issue cross-check
+    raise a rank-naming diagnostic INSTEAD of issuing the collective."""
+    port = _free_port()
+    store = dist.TCPStore("127.0.0.1", port, is_master=True, timeout=15)
+    flight.enable(capacity=8, desync=True, store=store, world_size=2,
+                  rank=0)
+    g = dist.get_group()
+    gkey = f"{g.axis}:{g.id}"
+    seq = flight.current_group_seq(f"op/{gkey}") + 1
+    clean = f"all_reduce|group={gkey}|shape=[8, 2]|dtype=float32"
+    # peer rank 1 announces the clean signature for the upcoming seq
+    store.set(f"{flight.store_scope()}/sig/{gkey}/{seq}/1", clean.encode())
+    fault.set_fault_spec("desync@allreduce:1")
+    t = paddle.to_tensor(np.ones((8, 2), "float32"))
+    before = t.numpy().copy()
+    with pytest.raises(dist.CollectiveDesyncError) as ei:
+        dist.all_reduce(t)
+    msg = str(ei.value)
+    assert "rank 0" in msg and "DESYNC-INJECTED" in msg
+    np.testing.assert_array_equal(t.numpy(), before)  # never issued
+    # with matching signatures the same path passes clean
+    fault.set_fault_spec(None)
+    seq2 = flight.current_group_seq(f"op/{gkey}") + 1
+    store.set(f"{flight.store_scope()}/sig/{gkey}/{seq2}/1",
+              clean.encode())
+    dist.all_reduce(t)
+
+
+def test_desync_check_disabled_means_no_store_traffic():
+    """Acceptance: without desync mode there is no signature exchange —
+    the recorder works with no store at all."""
+    rec = flight.enable(capacity=8)  # desync off, no store
+    t = paddle.to_tensor(np.ones((8, 2), "float32"))
+    dist.all_reduce(t)
+    assert rec._store is None and not rec._store_failed
+
+
+# ------------------------------------------------------- blame + post-mortem
+
+def test_blame_rows_names_laggard_and_stalled_collective():
+    rows = [
+        {"rank": 0, "issued_seq": 418, "issued_kind": "all_reduce",
+         "completed_seq": 417, "step": 83},
+        {"rank": 1, "issued_seq": 418, "issued_kind": "all_reduce",
+         "completed_seq": 417, "step": 83},
+        {"rank": 2, "issued_seq": 417, "issued_kind": "step",
+         "completed_seq": 417, "step": 83},
+    ]
+    b = flight.blame_rows(rows)
+    assert b["rank"] == 2 and b["seq"] == 418 and b["kind"] == "all_reduce"
+    assert "rank 2 stalled before all_reduce seq=418" in b["text"]
+    # aligned ranks: nobody to blame
+    assert flight.blame_rows(rows[:2]) is None
+    assert flight.blame_rows(rows[:1]) is None
+
+
+def test_format_post_mortem_from_dump_files(tmp_path):
+    for rank, (seq, status, kind) in enumerate(
+            [(418, "issued", "all_reduce"), (418, "issued", "all_reduce"),
+             (417, "completed", "step")]):
+        flight.enable(capacity=4, rank=rank)
+        e = flight.record_issue(kind, group="world:0")
+        for _ in range(seq - 1):  # advance this rank's seq counter
+            e = flight.record_issue(kind, group="world:0")
+        if status == "completed":
+            flight.record_complete(e)
+        flight.get_recorder().step = 83
+        flight.dump(reason="watchdog_timeout", dump_dir=str(tmp_path))
+        flight.reset_seqs()
+    dumps = flight.collect_dumps(str(tmp_path))
+    assert [d["rank"] for d in dumps] == [0, 1, 2]
+    text = flight.format_post_mortem(dumps)
+    assert "3 rank dump(s)" in text
+    assert "rank 2 stalled before all_reduce seq=418, step 83" in text
+    assert flight.format_post_mortem([]) is None
+
+
+# ------------------------------------------- watchdog arm/disarm + escalation
+
+@pytest.fixture
+def _watchdog_state():
+    """Snapshot/restore the watchdog module globals around a test."""
+    yield
+    watchdog_mod.stop_step_watchdog()
+    watchdog_mod._disabled = False
+
+
+def test_stop_step_watchdog_is_durable(monkeypatch, _watchdog_state):
+    """Satellite: stop_step_watchdog must disarm DURABLY — the env var
+    must not re-arm it (slow eval/checkpoint after the train loop must not
+    be shot by a stale timeout) — while a fresh process re-arms from env."""
+    monkeypatch.setenv("PADDLE_TPU_WATCHDOG_TIMEOUT", "60")
+    watchdog_mod._disabled = False
+    wd = watchdog_mod.get_step_watchdog()
+    assert wd is not None  # auto-armed from env
+    watchdog_mod.beat()    # beats without re-arming trouble
+    watchdog_mod.stop_step_watchdog()
+    assert watchdog_mod.get_step_watchdog() is None  # durable: env ignored
+    watchdog_mod.beat()  # still safe with no watchdog armed
+    assert watchdog_mod.get_step_watchdog() is None
+    # a fresh process (simulated: clear the durable flag) re-arms from env
+    watchdog_mod._disabled = False
+    wd2 = watchdog_mod.get_step_watchdog()
+    assert wd2 is not None and wd2 is not wd
+
+
+def test_start_step_watchdog_rearm_replaces_previous(_watchdog_state):
+    w1 = watchdog_mod.start_step_watchdog(60.0, abort_on_trip=False)
+    w2 = watchdog_mod.start_step_watchdog(60.0, abort_on_trip=True)
+    assert w2 is not w1
+    assert watchdog_mod.get_step_watchdog() is w2
+    assert watchdog_mod._monitor is not None  # escalation armed
+    watchdog_mod.stop_step_watchdog()
+    assert watchdog_mod._monitor is None and watchdog_mod._watchdog is None
+
+
+def test_watchdog_escalation_dumps_even_without_store(tmp_path):
+    """Satellite: the dump-then-abort path — on trip the worker writes the
+    flight-recorder dump + stacks and exits EXIT_HANG even when the blame
+    store is unreachable (dump lands BEFORE any store op)."""
+    script = tmp_path / "hang.py"
+    script.write_text(
+        "import os, time\n"
+        "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+        "import paddle_tpu.distributed as dist\n"
+        "from paddle_tpu.distributed import flight_recorder as fr\n"
+        "fr.record_complete(fr.record_issue('all_reduce',"
+        " group='world:1', shape=(2,), dtype='float32'))\n"
+        "fr.record_issue('barrier', group='world:1')\n"
+        "dist.start_step_watchdog(1.0, abort_on_trip=True)\n"
+        "time.sleep(120)\n")
+    env = _clean_env({
+        "PADDLE_TPU_FLIGHT_RECORDER": "8",
+        "PADDLE_TPU_WORKERLOG_DIR": str(tmp_path),
+        "PADDLE_TPU_FR_STORE": "127.0.0.1:1",      # unreachable
+        "PADDLE_TPU_NUM_PROCESSES": "2",           # so publish is attempted
+        "PADDLE_TPU_STORE_CONNECT_DEADLINE": "1",
+        "PADDLE_TPU_WATCHDOG_ESCALATION_BUDGET_S": "5",
+    })
+    t0 = time.monotonic()
+    r = subprocess.run([sys.executable, str(script)], env=env,
+                       capture_output=True, text=True, timeout=240,
+                       cwd=REPO)
+    assert r.returncode == fault.EXIT_HANG == 19, r.stdout + r.stderr
+    assert time.monotonic() - t0 < 200
+    assert "pd_watchdog" in r.stderr and "aborting process" in r.stderr
+    [doc] = flight.collect_dumps(str(tmp_path))
+    assert doc["reason"] == "watchdog_timeout"
+    assert doc["pending"]["kind"] == "barrier"  # what it hung on
+    assert len(doc["entries"]) == 2
+    assert doc.get("escalate_ms") is not None
+    assert doc["threads"]  # all-thread stacks captured
+
+
+# ------------------------------------------------- launcher cause mapping
+
+def test_describe_exit_maps_known_codes_and_signals():
+    assert fault.describe_exit(75).startswith("rc=75")
+    assert "preemption" in fault.describe_exit(75)
+    assert "watchdog" in fault.describe_exit(17)
+    assert "flight-recorder" in fault.describe_exit(19)
+    assert "desync" in fault.describe_exit(21)
+    assert "chaos" in fault.describe_exit(43)
+    assert fault.describe_exit(-9) == "rc=-9: killed by SIGKILL"
+    assert fault.describe_exit(1) == "rc=1"
+
+
+def test_launcher_failure_summary_names_cause(tmp_path, capfd):
+    """Satellite: the launcher's failure summary maps known exit codes to
+    human-readable causes (single copy: fault.EXIT_CAUSES)."""
+    script = tmp_path / "desync_exit.py"
+    script.write_text("import sys\nsys.exit(21)\n")
+    from paddle_tpu.distributed.launch.main import launch
+    rc = launch(["--nproc_per_node", "1", "--max_restarts", "0",
+                 "--log_dir", str(tmp_path / "logs"), str(script)])
+    assert rc == fault.EXIT_DESYNC
+    err = capfd.readouterr().err
+    assert "rc=21: collective desync" in err
+
+
+def test_launcher_exports_workerlog_dir(tmp_path):
+    """Workers must learn where flight-recorder dumps go."""
+    script = tmp_path / "printdir.py"
+    script.write_text(
+        "import os\nprint('DIR', os.environ['PADDLE_TPU_WORKERLOG_DIR'])\n")
+    from paddle_tpu.distributed.launch.main import launch
+    rc = launch(["--nproc_per_node", "1",
+                 "--log_dir", str(tmp_path / "logs"), str(script)])
+    assert rc == 0
+    out = _read_worker_logs(str(tmp_path / "logs"), 0)
+    assert f"DIR {tmp_path / 'logs'}" in out
+
+
+# ------------------------------------------- chaos acceptance (multi-proc)
+
+def _fr_worker_env(extra):
+    env = _clean_env({
+        "PADDLE_TPU_FR_STORE": f"127.0.0.1:{_free_port()}",
+        "PADDLE_TPU_FR_STEPS": "6",
+    })
+    env.update(extra)
+    return env
+
+
+@pytest.mark.slow
+def test_hang_chaos_dumps_and_post_mortem_blames_hung_rank(tmp_path):
+    """THE hang acceptance run: 3 workers, ``hang@step:3%1`` freezes rank
+    1 inside its 3rd heartbeat. Every rank's watchdog must trip, dump the
+    flight recorder and exit EXIT_HANG within the timeout budget, and the
+    launcher post-mortem must name the hung rank and the barrier seq it
+    stalled before."""
+    log_dir = str(tmp_path / "logs")
+    env = _fr_worker_env({
+        "PADDLE_TPU_FLIGHT_RECORDER": "64",
+        "PADDLE_TPU_WATCHDOG_TIMEOUT": "10",
+        "PADDLE_TPU_WATCHDOG_ESCALATION_BUDGET_S": "10",
+        "PADDLE_TPU_FAULTS": "hang@step:3%1",
+        "PADDLE_TPU_FAULT_HANG_S": "3600",
+    })
+    t0 = time.monotonic()
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "3", "--master", f"127.0.0.1:{_free_port()}",
+         "--log_dir", log_dir, os.path.join(WORKERS, "fr_worker.py")],
+        env=env, capture_output=True, text=True, timeout=300, cwd=REPO)
+    wall = time.monotonic() - t0
+    assert r.returncode == fault.EXIT_HANG, r.stdout + r.stderr
+    # detect-to-abort stayed within the watchdog budget: the job ended
+    # within startup + 2 steps + timeout (10s) + escalation (10s) + slack
+    assert wall < 240, f"hang diagnosis took {wall:.0f}s"
+    dumps = flight.collect_dumps(log_dir)
+    assert sorted(d["rank"] for d in dumps) == [0, 1, 2]  # every rank dumped
+    assert all(d["reason"] == "watchdog_timeout" for d in dumps)
+    blame = flight.blame_rows(flight.rows_from_dumps(dumps))
+    assert blame["rank"] == 1 and blame["kind"] == "barrier"
+    # the launcher printed the one-screen post-mortem naming the laggard
+    assert "[post-mortem]" in r.stderr
+    assert re.search(r"rank 1 stalled before barrier seq=\d+", r.stderr)
+    assert "rc=19: hung collective" in r.stderr
+    # the hung rank froze before issuing what its peers are waiting in
+    by_rank = {d["rank"]: d for d in dumps}
+    assert by_rank[0]["pending"]["kind"] == "barrier"
+    assert by_rank[1]["last_issued"]["seq"] \
+        < by_rank[0]["last_issued"]["seq"]
+
+
+@pytest.mark.slow
+def test_desync_chaos_fails_fast_with_rank_naming_diagnostic(tmp_path):
+    """THE desync acceptance run: 3 workers in desync debug mode;
+    ``desync@barrier:2%2`` perturbs rank 2's 2nd barrier signature. Every
+    rank must fail fast (EXIT_DESYNC) with a diagnostic naming rank 2 and
+    both signatures — no hang, no watchdog needed."""
+    log_dir = str(tmp_path / "logs")
+    env = _fr_worker_env({
+        "PADDLE_TPU_DESYNC_CHECK": "1",
+        "PADDLE_TPU_DESYNC_TIMEOUT_S": "60",
+        "PADDLE_TPU_FAULTS": "desync@barrier:2%2",
+    })
+    t0 = time.monotonic()
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "3", "--master", f"127.0.0.1:{_free_port()}",
+         "--log_dir", log_dir, os.path.join(WORKERS, "fr_worker.py")],
+        env=env, capture_output=True, text=True, timeout=300, cwd=REPO)
+    wall = time.monotonic() - t0
+    assert r.returncode == fault.EXIT_DESYNC, r.stdout + r.stderr
+    assert wall < 240, f"desync diagnosis took {wall:.0f}s"
+    assert "rc=21: collective desync" in r.stderr
+    # at least the injecting rank's log carries the full diagnostic naming
+    # the divergent rank and both signatures
+    diags = [_read_worker_logs(log_dir, rank) for rank in range(3)]
+    named = [d for d in diags
+             if "CollectiveDesyncError" in d and "rank 2" in d
+             and "DESYNC-INJECTED" in d]
+    assert named, "no worker log carries the rank-naming diagnostic"
+    # desync dumps landed and feed the launcher post-mortem
+    dumps = flight.collect_dumps(log_dir)
+    assert dumps and all(d["reason"] == "desync" for d in dumps)
+    assert "[post-mortem]" in r.stderr
 
 
 def test_slow_io_injection_delays_async_writer(tmp_path):

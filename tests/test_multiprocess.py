@@ -5,7 +5,8 @@ processes and compares losses vs single-process;
 test_parallel_dygraph_dataparallel.py:100 start_local_trainers. Here the
 launcher (paddle_tpu.distributed.launch) spawns 2 CPU processes wired by
 jax.distributed; DP losses must match the single-process run; a killed peer
-must trip the armed watchdog (abort, rc=17) instead of hanging forever.
+must trip the armed watchdog (escalated abort: flight-recorder dump then
+rc=19, native rc=17 backstop) instead of hanging forever.
 """
 import os
 import re
@@ -71,7 +72,8 @@ def test_launcher_dp_two_process_matches_single(tmp_path):
 @pytest.mark.slow
 def test_watchdog_aborts_on_dead_peer(tmp_path):
     """Kill one worker mid-run: the survivor's collective hangs, the armed
-    watchdog aborts it (rc 17) instead of blocking forever."""
+    watchdog aborts it (escalation: dump then rc 19) instead of blocking
+    forever."""
     port = 29531
     env = _clean_env(port)
     log_dir = str(tmp_path / "logs")

@@ -1,0 +1,57 @@
+"""Flight-recorder chaos worker (tests/test_fault_tolerance.py,
+bench --chaos hang leg).
+
+Runs N steps of watchdog-beaten global barriers under the launcher so the
+collective flight recorder sees one heartbeat + one recorded collective
+per step on every rank. Two chaos targets:
+
+* ``PADDLE_TPU_FAULTS="hang@step:K%r"`` — rank r freezes inside the step-K
+  heartbeat (before issuing the step's barrier); the peers block inside
+  the barrier, every rank's watchdog trips, escalates (flight-recorder
+  dump + blame) and exits ``EXIT_HANG``; the launcher post-mortem must
+  name rank r and the barrier seq it never reached.
+* ``PADDLE_TPU_FAULTS="desync@barrier:K%r"`` with
+  ``PADDLE_TPU_DESYNC_CHECK=1`` — rank r's K-th barrier announces a
+  perturbed signature; every rank fails fast with a rank-naming
+  CollectiveDesyncError (exit ``EXIT_DESYNC``) instead of hanging.
+
+Markers on stdout: ``STEP <i>`` per completed step, ``DONE`` on a clean
+finish.
+
+Env knobs: PADDLE_TPU_FR_STEPS (default 6), PADDLE_TPU_FR_STORE
+(host:port side-channel TCPStore for desync checks + watchdog blame;
+rank 0 is its master), PADDLE_TPU_FLIGHT_RECORDER / PADDLE_TPU_DESYNC_CHECK
+/ PADDLE_TPU_WATCHDOG_TIMEOUT as documented in the README.
+"""
+import os
+import sys
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=1")
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import paddle_tpu as paddle  # noqa: F401  (arms dispatch etc.)
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed import flight_recorder as fr
+from paddle_tpu.distributed import watchdog as wd
+
+
+def main():
+    dist.init_parallel_env()
+    rank = dist.get_rank()
+    steps = int(os.environ.get("PADDLE_TPU_FR_STEPS", "6"))
+    # connect the side-channel store up front: the watchdog escalation
+    # must not bootstrap a TCPStore mid-crisis
+    fr.wire_from_env()
+    print(f"START rank={rank}", flush=True)
+    for i in range(steps):
+        wd.beat()  # the 'step' fault site: hang@step freezes HERE
+        dist.barrier()
+        print(f"STEP {i}", flush=True)
+    print("DONE", flush=True)
+    sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

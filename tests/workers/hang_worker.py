@@ -1,6 +1,8 @@
 """Worker for the watchdog kill-one-peer test: rank 1 exits mid-run; rank
 0's next cross-process collective hangs and the armed watchdog must abort
-the process with _exit(17) (reference: comm_task_manager.cc abort-on-hang).
+the process — since the flight-recorder escalation it dumps diagnosis
+first and exits EXIT_HANG (19), with the native _exit(17) as backstop
+(reference: comm_task_manager.cc abort-on-hang).
 """
 import os
 import sys

@@ -762,6 +762,81 @@ def run_hang_chaos(steps=6):
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def run_node_chaos(epochs=2, batches=6):
+    """``--chaos`` node leg (multi-host elastic): a simulated 3-node
+    elastic job (``--nnodes 1:3``, one worker per node) loses a WHOLE
+    node to SIGKILL, then a second node turns flaky (same crash every
+    incarnation) until the quarantine window excludes it. Records the
+    node-loss detect-to-resume latency (coordinator detection stamp →
+    survivors' first post-relaunch batch) and the quarantine hit count so
+    multi-host robustness regressions show up in the perf trajectory."""
+    import re
+    import shutil
+    import subprocess
+    import tempfile
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    workers_dir = os.path.join(repo, "tests", "workers")
+    if workers_dir not in sys.path:
+        sys.path.insert(0, workers_dir)
+    from ft_markers import free_port as _free_port
+    from ft_markers import read_worker_logs
+    worker = os.path.join(workers_dir, "elastic_worker.py")
+    tmp = tempfile.mkdtemp(prefix="pd_node_")
+    log_dir = os.path.join(tmp, "logs")
+    env = _chaos_child_env(repo)
+    env.update({
+        "PADDLE_TPU_CKPT_DIR": os.path.join(tmp, "ck"),
+        "PADDLE_TPU_FT_STORE_PORT": str(_free_port()),
+        "PADDLE_TPU_FT_EPOCHS": str(epochs),
+        "PADDLE_TPU_FT_BATCHES": str(batches),
+        "PADDLE_TPU_FT_INTERVAL": "1",
+        # node2's worker (grank 2) SIGKILLs after 2 batches; its agent
+        # converts that into whole-node death (host loss)
+        "PADDLE_TPU_ELASTIC_KILL": "2:2",
+        "PADDLE_TPU_NODE_DIE_WITH_RANK": "2",
+        # node1 is FLAKY from the relaunch on: same crash every
+        # incarnation until quarantined (2 failures in the window)
+        "PADDLE_TPU_NODE_CRASH": "node1:1:43:1",
+    })
+    try:
+        r = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--nnodes", "1:3", "--nproc_per_node", "1",
+             "--master", f"127.0.0.1:{_free_port()}",
+             "--elastic_ttl", "3", "--terminate_grace", "5",
+             "--quarantine_window", "300", "--log_dir", log_dir, worker],
+            env=env, capture_output=True, text=True, timeout=600, cwd=repo)
+        lost = re.search(r"node loss detected node=\S+ wall=([\d.]+)",
+                         r.stderr)
+        qhits = re.search(r"quarantine_hits=(\d+)", r.stderr)
+        quarantined = "quarantine node=node1" in r.stderr
+        first_batch = None
+        for rank in (0, 1):
+            log = read_worker_logs(log_dir, rank)
+            after = log.split("WORLD 2", 1)
+            if len(after) == 2:
+                m = re.search(r"BATCH \d+ \d+ \d+ ([\d.]+)", after[1])
+                if m:
+                    t = float(m.group(1))
+                    first_batch = t if first_batch is None \
+                        else min(first_batch, t)
+        ok = (r.returncode == 0 and lost is not None and quarantined
+              and first_batch is not None)
+        out = {"node_elastic_ok": ok,
+               "node_quarantine_hits": int(qhits.group(1)) if qhits
+               else 0}
+        if lost and first_batch is not None:
+            out["node_loss_detect_to_resume_s"] = round(
+                first_batch - float(lost.group(1)), 3)
+        if not ok:
+            out["node_error"] = ("rc=%d lost=%s quarantined=%s: %s" % (
+                r.returncode, bool(lost), quarantined, r.stderr[-300:]))
+        return out
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def main_chaos():
     sub = run_chaos_smoke()
     try:
@@ -774,9 +849,15 @@ def main_chaos():
     except Exception as e:
         sub.update({"hang_postmortem_ok": False,
                     "hang_error": repr(e)[-300:]})
+    try:
+        sub.update(run_node_chaos())
+    except Exception as e:  # prior legs' JSON stays on the wire
+        sub.update({"node_elastic_ok": False,
+                    "node_error": repr(e)[-300:]})
     ok = bool(sub.get("chaos_resume_ok")) \
         and bool(sub.get("elastic_scale_ok")) \
-        and bool(sub.get("hang_postmortem_ok"))
+        and bool(sub.get("hang_postmortem_ok")) \
+        and bool(sub.get("node_elastic_ok"))
     print(json.dumps({
         "metric": "chaos_recovery_s",
         "value": sub.get("chaos_recovery_s", 0.0),

@@ -30,7 +30,9 @@ from . import flight_recorder  # noqa: F401
 from .flight_recorder import (  # noqa: F401
     CollectiveDesyncError, FlightRecorder,
 )
-from .tcp_store import StoreTimeoutError, TCPStore, Watchdog  # noqa: F401
+from .tcp_store import (  # noqa: F401
+    FailoverStore, StoreTimeoutError, TCPStore, Watchdog,
+)
 from .watchdog import (  # noqa: F401
     start_step_watchdog, stop_step_watchdog, get_step_watchdog,
 )
@@ -55,9 +57,12 @@ from .checkpoint import (  # noqa: F401
     save_state_dict, verify_checkpoint,
 )
 from .auto_tuner import AutoTuner  # noqa: F401
-from .elastic import ElasticManager, ElasticStatus, worker_from_env  # noqa: F401
+from .elastic import (  # noqa: F401
+    ElasticManager, ElasticStatus, NodeRegistry, QuarantineList,
+    render_node_round, worker_from_env,
+)
 from .resumable import ResumableTraining  # noqa: F401
 from .topology import (  # noqa: F401
-    CommunicateTopology, HybridCommunicateGroup, build_mesh,
-    get_hybrid_communicate_group,
+    CommunicateTopology, FailureDomainMap, HybridCommunicateGroup,
+    build_mesh, get_hybrid_communicate_group,
 )

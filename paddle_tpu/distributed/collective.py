@@ -103,15 +103,32 @@ def destroy_process_group(group=None):
 
 
 def _collective_begin(site, kind, g, arr=None):
-    """Per-collective bookkeeping: fault injection, flight-recorder issue
-    and the opt-in pre-issue desync cross-check. Returns the recorder
-    entry (None when the recorder is disabled); the caller completes it
-    after the collective returns."""
+    """Per-collective bookkeeping, phase 1: fault injection + the
+    flight-recorder issue entry (recorded BEFORE placement, so a hang
+    inside a multi-process placement reshard is still visible in the
+    ring). Returns ``(entry, injected)``; the caller runs
+    :func:`_collective_ready` once the payload is placed, and completes
+    the entry after the collective returns."""
     injected = _fault.maybe_inject(site)
     e = _fr.record_issue(kind, group=f"{g.axis}:{g.id}",
                          shape=tuple(getattr(arr, "shape", ()) or ())
                          if arr is not None else None,
                          dtype=getattr(arr, "dtype", None))
+    return e, injected
+
+
+def _collective_ready(e, injected, arr=None):
+    """Phase 2, after placement: fold the POST-placement payload into the
+    ring entry, then run the opt-in pre-issue desync cross-check on it.
+    The signature must describe what is actually issued — stacking
+    (scatter/all_to_all list forms) and the mesh commit happen between
+    the user call and the XLA collective, so a placement-stage
+    shape/dtype divergence is named in the signature instead of being
+    caught by seq drift only (ISSUE satellite; ROADMAP open item)."""
+    if e is not None and arr is not None:
+        e["shape"] = list(getattr(arr, "shape", ()) or ())
+        e["dtype"] = str(arr.dtype) if getattr(arr, "dtype", None) \
+            is not None else None
     _fr.check_desync(e, injected=(injected == "desync"))
     return e
 
@@ -172,8 +189,9 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     """In-place all-reduce over the rank axis (leading dim).
     Reference: communication/all_reduce.py."""
     g = _as_group(group)
-    rec = _collective_begin("allreduce", "all_reduce", g, tensor._data)
+    rec, inj = _collective_begin("allreduce", "all_reduce", g, tensor._data)
     arr = _placed(tensor._data, g)
+    _collective_ready(rec, inj, arr)
     red = _reduce_fn(op, g.axis)
 
     def f(x):
@@ -192,8 +210,9 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True):
     """Gather every rank's slice; fills tensor_list with the N slices
     (replicated). Reference: communication/all_gather.py."""
     g = _as_group(group)
-    rec = _collective_begin("allgather", "all_gather", g, tensor._data)
+    rec, inj = _collective_begin("allgather", "all_gather", g, tensor._data)
     arr = _placed(tensor._data, g)
+    _collective_ready(rec, inj, arr)
 
     def f(x):
         return jax.lax.all_gather(x[0], g.axis)  # [N, ...] replicated
@@ -215,8 +234,9 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
     (reference ProcessGroup::Reduce semantics leave non-dst undefined — we
     keep input)."""
     g = _as_group(group)
-    rec = _collective_begin("reduce", "reduce", g, tensor._data)
+    rec, inj = _collective_begin("reduce", "reduce", g, tensor._data)
     arr = _placed(tensor._data, g)
+    _collective_ready(rec, inj, arr)
     red = _reduce_fn(op, g.axis)
 
     def f(x):
@@ -235,8 +255,9 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
     """Every rank slice becomes the src slice.
     Reference: communication/broadcast.py."""
     g = _as_group(group)
-    rec = _collective_begin("broadcast", "broadcast", g, tensor._data)
+    rec, inj = _collective_begin("broadcast", "broadcast", g, tensor._data)
     arr = _placed(tensor._data, g)
+    _collective_ready(rec, inj, arr)
 
     def f(x):
         full = jax.lax.all_gather(x[0], g.axis)
@@ -251,10 +272,15 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     """Rank i receives tensor_list[i] (from src). With a single controller the
     list is already global: stack + shard."""
     g = _as_group(group)
-    rec = _collective_begin("scatter", "scatter", g, tensor._data)
+    rec, inj = _collective_begin("scatter", "scatter", g, tensor._data)
     stacked = jnp.stack([t._data if isinstance(t, Tensor) else jnp.asarray(t)
                          for t in tensor_list])
-    tensor._data = _placed(stacked, g)
+    placed = _placed(stacked, g)
+    # the signature describes the stacked GLOBAL payload, not the output
+    # buffer: a rank whose tensor_list stacked to a different shape/dtype
+    # is named before the data moves
+    _collective_ready(rec, inj, placed)
+    tensor._data = placed
     _fr.record_complete(rec)
     return tensor
 
@@ -264,8 +290,8 @@ def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM, group=None,
     """Each rank gets one reduced chunk: input per-rank [N*c, ...] → output
     per-rank [c, ...]. Reference: communication/reduce_scatter.py."""
     g = _as_group(group)
-    rec = _collective_begin("reducescatter", "reduce_scatter", g,
-                            tensor._data)
+    rec, inj = _collective_begin("reducescatter", "reduce_scatter", g,
+                                 tensor._data)
     src = tensor_or_tensor_list
     if isinstance(src, (list, tuple)):
         # list form: element i is rank i's full payload [N*c, ...]; stacking
@@ -275,6 +301,7 @@ def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM, group=None,
         arr = src._data
     # global layout: [N, N*c, ...] — leading rank axis + per-rank payload
     g_arr = _placed(arr, g)
+    _collective_ready(rec, inj, g_arr)
     is_sum = op in (ReduceOp.SUM, ReduceOp.AVG, "sum", "avg")
 
     def f(x):
@@ -306,8 +333,9 @@ def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
         arr = jnp.stack([t._data for t in in_tensor_list])
     else:
         arr = in_tensor_list._data
-    rec = _collective_begin("alltoall", "all_to_all", g, arr)
+    rec, inj = _collective_begin("alltoall", "all_to_all", g, arr)
     g_arr = _placed(arr, g)
+    _collective_ready(rec, inj, g_arr)
 
     def f(x):
         # x: [1, N, ...] — chunk j of dim 1 goes to rank j (tiled keeps shape)
@@ -329,10 +357,11 @@ def barrier(group=None):
     multi-controller SPMD too."""
     from .placement import place_global
     g = _as_group(group)
-    rec = _collective_begin("barrier", "barrier", g)
+    rec, inj = _collective_begin("barrier", "barrier", g)
     spec = P(g.axis, *([None]))
     arr = place_global(np.ones((g.nranks, 1), np.float32),
                        NamedSharding(g.mesh, spec))
+    _collective_ready(rec, inj)  # constant payload: signature stays bare
     _rankdim_op(g, lambda x: jax.lax.psum(x, g.axis), arr).block_until_ready()
     _fr.record_complete(rec)
 
@@ -354,9 +383,10 @@ def all_reduce_quantized(tensor, group=None, bits=8, sync_op=True):
                          f"(int4 without nibble packing saves no "
                          f"bandwidth), got {bits}")
     g = _as_group(group)
-    rec = _collective_begin("allreduce", "all_reduce_quantized", g,
-                            tensor._data)
+    rec, inj = _collective_begin("allreduce", "all_reduce_quantized", g,
+                                 tensor._data)
     arr = _placed(tensor._data, g)
+    _collective_ready(rec, inj, arr)
     qmax = float(2 ** (bits - 1) - 1)
 
     def f(x):

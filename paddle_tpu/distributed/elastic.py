@@ -8,16 +8,28 @@ relaunch). This environment has no etcd; the native C++ TCPStore
 membership change inside [min_np, max_np] reports a scale event the
 launcher turns into a relaunch with the new world size (checkpoint-resume
 is the state story, reference recovery model).
+
+Multi-host extension (node-level elastic, ``--nnodes MIN:MAX``): the unit
+of membership becomes a whole NODE. Each host runs a
+:mod:`~paddle_tpu.distributed.launch.node_agent` that supervises its
+local workers and heartbeats a node-scoped record through
+:class:`NodeRegistry`; the coordinator publishes *round specs* (world
+size, node→rank map, quarantine list) that agents apply by relaunching
+their workers with re-rendered env. :class:`QuarantineList` keeps a
+sliding window of per-node failures so a flaky host degrades capacity
+instead of livelocking the job in relaunch cycles.
 """
 from __future__ import annotations
 
+import json
 import os
 import threading
 import time
 
 from .tcp_store import TCPStore
 
-__all__ = ["ElasticManager", "ElasticStatus", "worker_from_env"]
+__all__ = ["ElasticManager", "ElasticStatus", "worker_from_env",
+           "NodeRegistry", "QuarantineList", "render_node_round"]
 
 
 class ElasticStatus:
@@ -220,3 +232,206 @@ def worker_from_env():
         em.register(os.environ.get("PADDLE_TPU_ELASTIC_NAME"))
         _env_worker = em
         return em
+
+
+# ---------------------------------------------------- node-level registry
+
+class NodeRegistry:
+    """Node-scoped rendezvous state over a (failover-capable) store.
+
+    Two planes, both namespaced under ``elastic/<job>/node``:
+
+    - **membership**: agents ``register`` once (append-only join log, same
+      shape as ElasticManager's — the TCPStore has no key enumeration)
+      and ``beat`` a JSON record every ttl/3 (node id, host, round,
+      worker statuses, timestamp). ``live()`` filters by heartbeat age.
+    - **rounds**: the coordinator ``publish_round``\\ s a spec (world
+      size, node→node_rank map, quarantine list); agents poll
+      ``round_no()`` and apply only the NEWEST spec — an agent that
+      missed rounds (stalled, partitioned) jumps straight to the latest,
+      which is exactly the fencing semantics a zombie node needs.
+
+    The store may be a :class:`~paddle_tpu.distributed.tcp_store.
+    FailoverStore`: after a failover the standby is EMPTY, so the join-log
+    cache is invalidated whenever the store incarnation moved and callers
+    re-register / re-publish through their ``on_failover`` hooks."""
+
+    def __init__(self, store, job_id, ttl=10.0):
+        self.store = store
+        self.ttl = float(ttl)
+        self._prefix = f"elastic/{job_id}/node"
+        self._join_cache = {}
+        self._inc_seen = getattr(store, "incarnation", 0)
+
+    def _maybe_invalidate(self):
+        inc = getattr(self.store, "incarnation", 0)
+        if inc != self._inc_seen:
+            self._join_cache.clear()
+            self._inc_seen = inc
+
+    # -- membership (agent side) --
+    def register(self, node_id, record):
+        """First beat + append to the node join log."""
+        self.beat(node_id, record)
+        idx = self.store.add(f"{self._prefix}/join_seq", 1)
+        self.store.set(f"{self._prefix}/join/{idx}", node_id)
+
+    def beat(self, node_id, record):
+        rec = dict(record)
+        rec["node"] = node_id
+        rec["ts"] = time.time()
+        self.store.set(f"{self._prefix}/r/{node_id}",
+                       json.dumps(rec).encode())
+
+    # -- membership (shared) --
+    def record(self, node_id):
+        key = f"{self._prefix}/r/{node_id}"
+        try:
+            if not self.store.check(key):
+                return None
+            return json.loads(self.store.get(key).decode())
+        except Exception:
+            return None
+
+    def joined(self):
+        """Every node that ever registered, in join order (cached like
+        ElasticManager.joined_names; invalidated on store failover)."""
+        self._maybe_invalidate()
+        try:
+            n = int(self.store.add(f"{self._prefix}/join_seq", 0))
+        except Exception:
+            return []
+        out = []
+        for i in range(1, n + 1):
+            name = self._join_cache.get(i)
+            if name is None:
+                key = f"{self._prefix}/join/{i}"
+                try:
+                    if not self.store.check(key):
+                        continue
+                    name = self.store.get(key).decode()
+                except Exception:
+                    continue
+                self._join_cache[i] = name
+            if name not in out:
+                out.append(name)
+        return out
+
+    def live(self, node_ids=None, now=None):
+        """node_id -> record for every node whose heartbeat is fresh."""
+        now = time.time() if now is None else now
+        out = {}
+        for nid in (self.joined() if node_ids is None else node_ids):
+            rec = self.record(nid)
+            if rec is not None and now - float(rec.get("ts", 0)) <= self.ttl:
+                out[nid] = rec
+        return out
+
+    # -- rounds (coordinator publishes, agents poll) --
+    def publish_round(self, spec) -> int:
+        no = int(self.store.add(f"{self._prefix}/round_seq", 1))
+        spec = dict(spec)
+        spec["round"] = no
+        self.store.set(f"{self._prefix}/round/{no}",
+                       json.dumps(spec).encode())
+        return no
+
+    def republish_round(self, spec):
+        """After a store failover: reinstall the CURRENT round into the
+        (empty) standby without bumping the round number — agents seeing
+        an unchanged round number keep their workers running, so training
+        rides through the control-plane failover untouched."""
+        no = int(spec["round"])
+        self.store.set(f"{self._prefix}/round/{no}",
+                       json.dumps(spec).encode())
+        cur = int(self.store.add(f"{self._prefix}/round_seq", 0))
+        if cur < no:
+            self.store.add(f"{self._prefix}/round_seq", no - cur)
+
+    def round_no(self) -> int:
+        try:
+            return int(self.store.add(f"{self._prefix}/round_seq", 0))
+        except Exception:
+            return 0
+
+    def poll(self):
+        """``(is_complete, round_no)`` in one pass, RAISING on store
+        failure — unlike the defensive readers above. The agent's orphan
+        fencing must SEE unreachability: an exception-swallowing poll
+        would let a node whose control plane is gone run stale workers
+        forever."""
+        complete = bool(self.store.check(f"{self._prefix}/complete"))
+        return complete, int(self.store.add(f"{self._prefix}/round_seq", 0))
+
+    def round(self, no):
+        try:
+            return json.loads(
+                self.store.get(f"{self._prefix}/round/{no}").decode())
+        except Exception:
+            return None
+
+    def announce_complete(self):
+        self.store.set(f"{self._prefix}/complete", b"1")
+
+    def is_complete(self) -> bool:
+        try:
+            return bool(self.store.check(f"{self._prefix}/complete"))
+        except Exception:
+            return False
+
+
+def render_node_round(participants, nproc_per_node, master,
+                      quarantined=(), store_inc=0):
+    """One round spec: the coordinator's single source of the node→rank
+    map. ``participants`` order is the registration order, so node_rank 0
+    (whose first worker binds the jax coordinator service) stays on the
+    longest-lived node."""
+    participants = list(participants)
+    return {
+        "nodes": {nid: i for i, nid in enumerate(participants)},
+        "nproc": int(nproc_per_node),
+        "world": len(participants) * int(nproc_per_node),
+        "master": master,
+        "quarantined": list(quarantined),
+        "store_inc": int(store_inc),
+    }
+
+
+# --------------------------------------------------- flaky-node quarantine
+
+class QuarantineList:
+    """Sliding-window failure ledger per node: ``threshold`` blamed
+    failures of the SAME node inside ``window_s`` seconds quarantine it —
+    the node is excluded from every later rendezvous round, degrading
+    capacity instead of livelocking the job in relaunch cycles. Collateral
+    deaths (survivors shot by a broken collective) must NOT be recorded
+    here; only causal blame (node loss, a real worker failure exit)."""
+
+    def __init__(self, window_s=300.0, threshold=2):
+        self.window_s = float(window_s)
+        self.threshold = max(1, int(threshold))
+        self._failures = {}     # node_id -> [monotonic stamps]
+        self._quarantined = {}  # node_id -> stamp quarantined at
+        self.hits = 0           # total quarantine events (bench metric)
+
+    def record_failure(self, node_id, now=None) -> bool:
+        """Record one blamed failure; True when this pushed the node into
+        quarantine (idempotent for already-quarantined nodes)."""
+        if node_id in self._quarantined:
+            return False
+        now = time.monotonic() if now is None else now
+        stamps = [t for t in self._failures.get(node_id, [])
+                  if now - t <= self.window_s]
+        stamps.append(now)
+        self._failures[node_id] = stamps
+        if len(stamps) >= self.threshold:
+            self._quarantined[node_id] = now
+            self.hits += 1
+            return True
+        return False
+
+    def is_quarantined(self, node_id) -> bool:
+        return node_id in self._quarantined
+
+    def quarantined(self):
+        return sorted(self._quarantined)

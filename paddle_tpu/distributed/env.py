@@ -26,8 +26,12 @@ def init_parallel_env():
         return _default_group()
     # elastic jobs: register with the launcher's membership registry and
     # start heartbeating BEFORE the (potentially slow) collective init, so
-    # the master can already see this worker as live
-    if os.environ.get("PADDLE_TPU_ELASTIC_JOB_ID"):
+    # the master can already see this worker as live. Under a node agent
+    # (--nnodes MIN:MAX) membership is NODE-scoped — the agent heartbeats
+    # one record per host; workers must not self-register even if
+    # worker-level elastic env leaked into their environment
+    if os.environ.get("PADDLE_TPU_ELASTIC_JOB_ID") \
+            and not os.environ.get("PADDLE_TPU_NODE_AGENT"):
         from .elastic import worker_from_env
         try:
             worker_from_env()

@@ -17,8 +17,11 @@ Four pieces, stdlib-only (importable by the launcher before jax loads):
        entry   := kind ["@" site] ":" trigger ["%" rank]
        kind    := crash | hang | torn_write | store_drop | slow_io
                 | async_torn | commit_stall | desync
+                | node_die | agent_stall | store_die
        trigger := 1-based Nth matching hit that fires the fault
-       rank    := only this process id injects (default: every rank)
+       rank    := only this process id injects (default: every rank;
+                  node-scoped kinds filter by NODE ordinal — the agent
+                  exports its ordinal as its own process id)
 
    e.g. ``PADDLE_TPU_FAULTS="crash@step:3,torn_write@ckpt:1%0"`` crashes
    every rank at its 3rd train step and tears rank 0's first checkpoint
@@ -62,7 +65,8 @@ import time
 
 __all__ = [
     "EXIT_FAULT", "EXIT_PREEMPT", "EXIT_WATCHDOG", "EXIT_HANG",
-    "EXIT_DESYNC", "EXIT_CAUSES", "describe_exit", "FaultEntry",
+    "EXIT_DESYNC", "EXIT_USAGE", "EXIT_CAUSES", "describe_exit",
+    "FaultEntry",
     "parse_fault_spec", "set_fault_spec", "maybe_inject", "fault_rank",
     "Backoff", "retry", "atomic_write", "atomic_write_bytes",
     "CheckpointLineage",
@@ -76,6 +80,8 @@ EXIT_HANG = 19       # watchdog ESCALATION: flight-recorder dump + blame
                      # written, then abort (distributed/watchdog.py)
 EXIT_DESYNC = 21     # collective desync detected pre-issue (fail-fast,
                      # distributed/flight_recorder.py)
+EXIT_USAGE = 64      # launcher flag combination rejected (EX_USAGE) —
+                     # mapped + hinted instead of a bare traceback
 
 # The one copy of the worker exit-code -> human cause mapping (launcher
 # failure summaries, tests). Negative codes are death-by-signal and are
@@ -90,6 +96,8 @@ EXIT_CAUSES = {
                "dump + blame written",
     EXIT_DESYNC: "collective desync — mismatched collective detected "
                  "before issue (fail-fast)",
+    EXIT_USAGE: "launcher usage error — incompatible flag combination "
+                "(see the hint printed above it)",
 }
 
 
@@ -106,7 +114,8 @@ def describe_exit(rc) -> str:
 
 
 _KINDS = ("crash", "hang", "torn_write", "store_drop", "slow_io",
-          "async_torn", "commit_stall", "desync")
+          "async_torn", "commit_stall", "desync",
+          "node_die", "agent_stall", "store_die")
 # a site-less (wildcard) cooperative entry only fires at sites whose
 # callers honor the returned kind — anywhere else it would burn its
 # trigger silently; crash/hang/slow_io/commit_stall wildcards fire at
@@ -118,8 +127,19 @@ _KINDS = ("crash", "hang", "torn_write", "store_drop", "slow_io",
 # cross-rank signature so the opt-in desync check trips deterministically.
 _DESYNC_SITES = ("allreduce", "allgather", "reduce", "broadcast", "scatter",
                  "reducescatter", "alltoall", "barrier")
+# node-scoped kinds (multi-host elastic): ``node_die`` is cooperative at
+# the agent's heartbeat site — the agent enacts a whole-node SIGKILL
+# (itself + every local worker, modelling sudden host loss);
+# ``agent_stall`` executes a sleep there (heartbeats stop while workers
+# keep running — the zombie-node case the coordinator must fence);
+# ``store_die`` is cooperative at the coordinator's registry-poll site —
+# the coordinator enacts it by stopping the PRIMARY registry server
+# (master-node death), forcing every client onto the warm standby.
 _WILDCARD_SITES = {"store_drop": ("store",), "torn_write": ("ckpt",),
-                   "async_torn": ("async_ckpt",), "desync": _DESYNC_SITES}
+                   "async_torn": ("async_ckpt",), "desync": _DESYNC_SITES,
+                   "node_die": ("node_beat",),
+                   "agent_stall": ("node_beat",),
+                   "store_die": ("elastic_store",)}
 
 _lock = threading.Lock()
 _entries: list | None = None  # parsed spec; None = not yet loaded from env
@@ -292,6 +312,9 @@ def maybe_inject(site: str):
         elif e.kind == "commit_stall":
             time.sleep(float(os.environ.get(
                 "PADDLE_TPU_FAULT_COMMIT_STALL_S", "5.0")))
+        elif e.kind == "agent_stall":
+            time.sleep(float(os.environ.get(
+                "PADDLE_TPU_FAULT_AGENT_STALL_S", "30.0")))
         else:
             result = e.kind
     return result

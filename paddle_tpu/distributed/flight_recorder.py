@@ -48,8 +48,10 @@ from . import fault as _fault
 __all__ = [
     "FlightRecorder", "CollectiveDesyncError", "get_recorder", "enable",
     "disable", "record_issue", "record_complete", "note_step",
-    "note_heartbeat", "check_desync", "verify_signatures", "wire_from_env",
+    "note_heartbeat", "note_resume", "check_desync", "verify_signatures",
+    "wire_from_env",
     "next_group_seq", "current_group_seq", "reset_seqs", "incarnation",
+    "note_store_incarnation", "store_incarnation",
     "store_scope", "dump", "dump_path", "watchdog_escalation",
     "collect_dumps", "rows_from_dumps", "blame_rows", "format_post_mortem",
 ]
@@ -109,13 +111,36 @@ def incarnation() -> int:
     return int(os.environ.get("PADDLE_TPU_RESTART_NUM", "0") or 0)
 
 
+_store_inc = [0]
+
+
+def note_store_incarnation(n: int):
+    """Record the control-plane store incarnation — bumped by
+    :class:`~paddle_tpu.distributed.tcp_store.FailoverStore` when clients
+    re-home to a standby master. Keys derived from :func:`store_scope`
+    rotate with it, so a process that outlived a store failover can never
+    collide with keys a slow peer wrote under the previous store lifetime
+    (or with a restarted primary's leftovers)."""
+    _store_inc[0] = max(_store_inc[0], int(n))
+
+
+def store_incarnation() -> int:
+    return max(_store_inc[0],
+               int(os.environ.get("PADDLE_TPU_STORE_INCARNATION", "0")
+                   or 0))
+
+
 def store_scope() -> str:
     """Store-key namespace: unique per incarnation (a relaunched worker
-    must never collide with keys its previous incarnation left behind)
-    AND per seq-reset epoch (same-process re-init against a surviving
-    store must not reuse the old lifetime's keys)."""
+    must never collide with keys its previous incarnation left behind),
+    per seq-reset epoch (same-process re-init against a surviving
+    store must not reuse the old lifetime's keys) AND per store
+    incarnation (a store failover re-homes everyone onto a different
+    master whose keyspace history is unknown)."""
     e = _scope_epoch[0]
-    return f"fr/i{incarnation()}" + (f".e{e}" if e else "")
+    s = store_incarnation()
+    return (f"fr/i{incarnation()}" + (f".e{e}" if e else "")
+            + (f".s{s}" if s else ""))
 
 
 def _env_world() -> int:
@@ -259,6 +284,7 @@ def _reset_state():
     with _state_lock:
         _rec = None
         _loaded = False
+    _store_inc[0] = 0
     reset_seqs()
 
 
@@ -295,6 +321,22 @@ def note_heartbeat():
         return
     rec.step += 1
     rec.complete(rec.issue("step", group="step"))
+
+
+def note_resume(step, old_world=None, new_world=None):
+    """Leave a completed ``resume`` marker in the ring: a post-mortem that
+    spans an elastic relaunch must show WHERE the restored incarnation
+    re-entered the step sequence (and across which world-size change)."""
+    rec = _rec if _loaded else _load()
+    if rec is None:
+        return
+    rec.step = int(step)
+    extra = {}
+    if old_world is not None:
+        extra["old_world"] = int(old_world)
+    if new_world is not None:
+        extra["new_world"] = int(new_world)
+    rec.complete(rec.issue("resume", group="step", extra=extra or None))
 
 
 # ------------------------------------------------------ store side channel

@@ -31,6 +31,20 @@ a node joining the registry mid-run or during the below-``min_np`` HOLD
 window widens the world back up (bounded by ``max_np``). State recovery
 across scale events is the checkpoint lineage's job (resumable trainers
 reload the newest verified snapshot).
+
+Multi-host elastic (``--nnodes MIN:MAX``): the unit of membership becomes
+a whole NODE. This launcher turns into the *coordinator*: it serves the
+rendezvous registry (primary + optional warm-standby TCPStore — a second
+comma-separated ``--master`` candidate), waits for per-node agents
+(``launch/node_agent.py``; spawned locally for the single-machine pod
+simulation, one per host in a real pod) to register, and publishes round
+specs the agents apply. Node loss inside [MIN, MAX] nodes re-renders the
+world across the SURVIVING agents and relaunches at the smaller scale;
+joins/standbys backfill exactly like the single-host path; repeated
+failures of the same node inside ``--quarantine_window`` move it to a
+quarantine list (capacity degrades, the job never livelocks in relaunch
+cycles); death of the primary registry master re-homes every client onto
+the standby under a bumped store incarnation.
 """
 from __future__ import annotations
 
@@ -41,7 +55,7 @@ import subprocess
 import sys
 import time
 
-from ..fault import EXIT_PREEMPT, describe_exit
+from ..fault import EXIT_PREEMPT, EXIT_USAGE, describe_exit
 
 __all__ = ["launch", "main"]
 
@@ -56,14 +70,19 @@ def _parse_args(argv=None):
     p = argparse.ArgumentParser(
         prog="paddle_tpu.distributed.launch",
         description="launch a multi-host paddle_tpu training job")
-    p.add_argument("--nnodes", type=int, default=1,
-                   help="number of hosts in the job")
+    p.add_argument("--nnodes", default="1",
+                   help="number of hosts: 'N' (fixed, this process "
+                        "launches one host's workers) or 'MIN:MAX' "
+                        "(node-level elastic: this process becomes the "
+                        "coordinator of per-node agents)")
     p.add_argument("--node_rank", type=int,
                    default=int(os.environ.get("PADDLE_TPU_NODE_RANK", 0)),
                    help="rank of this host")
     p.add_argument("--master", default=os.environ.get(
         "PADDLE_TPU_COORDINATOR", "127.0.0.1:8476"),
-        help="coordinator address host:port (rank-0 host)")
+        help="coordinator address host:port (rank-0 host); a second "
+             "comma-separated candidate becomes the warm-standby "
+             "rendezvous registry for --nnodes MIN:MAX jobs")
     p.add_argument("--nproc_per_node", type=int, default=1,
                    help="processes per host (1 per host is the TPU model)")
     p.add_argument("--np", default=None, dest="np_spec", metavar="MIN:MAX",
@@ -84,6 +103,17 @@ def _parse_args(argv=None):
     p.add_argument("--max_elastic_events", type=int, default=16,
                    help="runaway guard for scale-event relaunches (scale "
                         "events do not consume --max_restarts)")
+    p.add_argument("--local_agents", type=int, default=-1,
+                   help="node agents this coordinator spawns locally for "
+                        "--nnodes MIN:MAX (default: MAX — the single-"
+                        "machine pod simulation; real pods run one "
+                        "launch.node_agent per host and pass 0)")
+    p.add_argument("--quarantine_window", type=float, default=300.0,
+                   help="sliding window (seconds) for flaky-node "
+                        "quarantine")
+    p.add_argument("--quarantine_threshold", type=int, default=2,
+                   help="blamed failures of one node inside the window "
+                        "that quarantine it")
     p.add_argument("--log_dir", default="log", help="per-rank log directory")
     p.add_argument("--max_restarts", type=int, default=0,
                    help="relaunch failed workers up to N times (elastic)")
@@ -286,6 +316,405 @@ class _ElasticState:
         return self.joins()
 
 
+class _NodeCoordinator:
+    """Rank-0-side control plane of a ``--nnodes MIN:MAX`` job: serves
+    the rendezvous registry (primary + warm standby), rendezvouses node
+    agents, publishes round specs, and turns node loss / join / flaky
+    repetition into scale / backfill / quarantine decisions. A whole node
+    is the unit of membership; worker-level supervision lives in the
+    agents."""
+
+    def __init__(self, args, extra_env, min_nodes, max_nodes):
+        from ..elastic import (NodeRegistry, QuarantineList,
+                               render_node_round)
+        from ..tcp_store import FailoverStore, TCPStore
+        self.args = args
+        self.extra_env = dict(extra_env)
+        self.min_nodes, self.max_nodes = min_nodes, max_nodes
+        self._render = render_node_round
+        cands = [c.strip() for c in args.master.split(",") if c.strip()]
+        self.master = cands[0]
+        host0, _, p0 = self.master.partition(":")
+        eps = [(host0 or "127.0.0.1",
+                args.elastic_port or int(p0 or 8476) + 1)]
+        for cand in cands[1:]:
+            h, _, p = cand.partition(":")
+            # a portless standby candidate inherits the primary's port
+            # (it lives on a different host) instead of dying on int('')
+            eps.append((h or "127.0.0.1", int(p or p0 or 8476) + 1))
+        self.eps = eps
+        # serve every locally bindable candidate (in tests both live
+        # here; in a real pod the standby is served on another host and
+        # the bind simply fails)
+        self.servers = []
+        for host, port in eps:
+            try:
+                self.servers.append(TCPStore(host, port, is_master=True))
+            except Exception as e:
+                self.servers.append(None)
+                print(f"[elastic] registry candidate {host}:{port} served "
+                      f"elsewhere ({e})", file=sys.stderr, flush=True)
+        self.current_spec = None
+        self._failover_at = None
+        self.store = FailoverStore(eps, on_failover=self._on_failover)
+        self.registry = NodeRegistry(self.store, args.job_id,
+                                     ttl=args.elastic_ttl)
+        self.quarantine = QuarantineList(args.quarantine_window,
+                                         args.quarantine_threshold)
+        self.known = []       # every node id ever seen, join order
+        self.events = 0
+        self.preempt_restarts = 0
+        self.agent_procs = []
+        self.settle = args.elastic_ttl + 1.0
+        self._loss_logged = set()
+
+    # ------------------------------------------------------------ setup
+    def _spawn_local_agents(self, count):
+        os.makedirs(self.args.log_dir, exist_ok=True)
+        store_arg = ",".join(f"{h}:{p}" for h, p in self.eps)
+        for i in range(count):
+            node_id = f"node{i}"
+            env = dict(os.environ)
+            env.update(self.extra_env)
+            paths = env.get("PYTHONPATH", "").split(os.pathsep)
+            if _PKG_ROOT not in paths:
+                env["PYTHONPATH"] = os.pathsep.join(
+                    [_PKG_ROOT] + [p for p in paths if p])
+            log_f = open(os.path.join(self.args.log_dir,
+                                      f"agentlog.{node_id}"), "w")
+            proc = subprocess.Popen(
+                [sys.executable, "-m",
+                 "paddle_tpu.distributed.launch.node_agent",
+                 "--node_id", node_id, "--ordinal", str(i),
+                 "--job_id", self.args.job_id,
+                 "--store", store_arg,
+                 "--nproc_per_node", str(self.args.nproc_per_node),
+                 "--ttl", str(self.args.elastic_ttl),
+                 "--terminate_grace", str(self.args.terminate_grace),
+                 "--log_dir", self.args.log_dir,
+                 self.args.training_script]
+                + self.args.training_script_args,
+                env=env, stdout=log_f, stderr=subprocess.STDOUT)
+            log_f.close()
+            self.agent_procs.append(proc)
+
+    def _on_failover(self, store, inc):
+        """Our own client re-homed to the standby: the registry contents
+        died with the primary, so reinstall the CURRENT round (same round
+        number — agents keep their workers running) and let agents
+        re-register on their own failovers."""
+        self._failover_at = time.monotonic()
+        print(f"[elastic] registry master lost: failed over to standby "
+              f"(store incarnation {inc})", file=sys.stderr, flush=True)
+        if self.current_spec is not None:
+            try:
+                self.registry.republish_round(self.current_spec)
+            except Exception as e:
+                print(f"[elastic] round republish failed: {e}",
+                      file=sys.stderr, flush=True)
+
+    def _inject_store_die(self):
+        from .. import fault as _fault
+        if _fault.maybe_inject("elastic_store") == "store_die":
+            print("[elastic] injected store_die: stopping the PRIMARY "
+                  "registry server (master-node death)", file=sys.stderr,
+                  flush=True)
+            if self.servers and self.servers[0] is not None:
+                self.servers[0].stop_server()
+
+    # ------------------------------------------------------- membership
+    def _scan_joins(self):
+        """New node ids from the join log, quarantined ones filtered."""
+        try:
+            joined = self.registry.joined()
+        except Exception:
+            return []
+        fresh = [n for n in joined if n not in self.known]
+        self.known.extend(fresh)
+        return [n for n in fresh if not self.quarantine.is_quarantined(n)]
+
+    def _live_capacity(self):
+        """Live, non-quarantined nodes in join order (standbys included:
+        a join held at max_nodes backfills a later loss)."""
+        try:
+            live = self.registry.live(self.known)
+        except Exception:
+            return []
+        return [n for n in self.known
+                if n in live and not self.quarantine.is_quarantined(n)]
+
+    def _rendezvous(self):
+        """Wait for agents: full width returns immediately; a partial
+        quorum >= MIN must hold stable for one ttl first, so stragglers
+        of a simultaneous start make round 1 instead of triggering an
+        immediate scale-out."""
+        deadline = time.time() + self.args.elastic_timeout
+        stable_since, last_n = time.time(), -1
+        while time.time() < deadline:
+            self._scan_joins()
+            cap = self._live_capacity()
+            if len(cap) >= self.max_nodes:
+                return cap[:self.max_nodes]
+            if len(cap) != last_n:
+                last_n, stable_since = len(cap), time.time()
+            if len(cap) >= self.min_nodes \
+                    and time.time() - stable_since >= self.args.elastic_ttl:
+                return cap
+            time.sleep(0.25)
+        return None
+
+    # ------------------------------------------------------ round watch
+    def _statuses(self, spec):
+        """node -> status for the CURRENT round: an agent's reported
+        status counts only once it applied this round; liveness always
+        counts. 'missing' records right after a store failover are given
+        a re-registration grace before they read as lost."""
+        now = time.time()
+        # post-failover grace: every agent is mid-re-home (a few seconds
+        # of blocked heartbeats + an empty standby), so missing or stale
+        # records must not read as node loss yet
+        grace = (self._failover_at is not None
+                 and time.monotonic() - self._failover_at
+                 <= 2 * self.args.elastic_ttl)
+        out = {}
+        for nid in spec["nodes"]:
+            rec = self.registry.record(nid)
+            if rec is None:
+                out[nid] = "pending" if grace else "lost"
+            elif now - float(rec.get("ts", 0)) > self.args.elastic_ttl:
+                out[nid] = "pending" if grace else "lost"
+            elif int(rec.get("round", 0)) != spec["round"]:
+                out[nid] = "pending"
+            else:
+                out[nid] = rec.get("status", "pending")
+        return out, now
+
+    def _blamed(self, spec, statuses):
+        """Nodes causally at fault: lost hosts, and nodes whose agents
+        reported a real worker failure EXIT (collateral signal deaths —
+        survivors shot by a broken collective — shed no blame)."""
+        blamed = []
+        for nid, st in statuses.items():
+            if st == "lost":
+                blamed.append(nid)
+            elif st == "failed":
+                rec = self.registry.record(nid) or {}
+                rcs = rec.get("rcs") or []
+                if any(isinstance(rc, int) and rc > 0
+                       and rc != EXIT_PREEMPT for rc in rcs):
+                    blamed.append(nid)
+        return blamed
+
+    def _watch_round(self, spec):
+        """Block until this round resolves. Returns (outcome, detail):
+        'done' | 'preempt' | 'scale_out' (detail: joiners) |
+        'failure' (detail: {statuses, blamed, rc})."""
+        first_bad = None
+        while True:
+            self._inject_store_die()
+            try:
+                statuses, now = self._statuses(spec)
+            except Exception as e:
+                print(f"[elastic] registry read failed: {e}",
+                      file=sys.stderr, flush=True)
+                time.sleep(0.5)
+                continue
+            bad = {n: s for n, s in statuses.items()
+                   if s in ("lost", "failed")}
+            for nid, st in bad.items():
+                if st == "lost" and nid not in self._loss_logged:
+                    self._loss_logged.add(nid)
+                    print(f"[elastic] node loss detected node={nid} "
+                          f"wall={time.time():.6f} "
+                          f"({self._domains.describe(nid)})",
+                          file=sys.stderr, flush=True)
+            if bad:
+                first_bad = first_bad or time.monotonic()
+                if time.monotonic() - first_bad >= self.settle:
+                    statuses, _ = self._statuses(spec)  # final word
+                    rcs = [rc for nid in spec["nodes"]
+                           for rc in ((self.registry.record(nid) or {})
+                                      .get("rcs") or [])
+                           if isinstance(rc, int) and rc > 0]
+                    return "failure", {
+                        "statuses": statuses,
+                        "blamed": self._blamed(spec, statuses),
+                        "rc": rcs[0] if rcs else 1,
+                    }
+                time.sleep(0.25)
+                continue
+            first_bad = None  # a cleared blip must not shorten the next
+            joiners = self._scan_joins()  # event's settle window
+            if joiners:
+                if len(spec["nodes"]) < self.max_nodes:
+                    return "scale_out", joiners
+                print(f"[elastic] join {joiners} held as standby: "
+                      f"already at max_nnodes={self.max_nodes}",
+                      file=sys.stderr, flush=True)
+            vals = set(statuses.values())
+            if vals == {"done"}:
+                return "done", None
+            if vals <= {"done", "preempted"} and "preempted" in vals:
+                return "preempt", None
+            time.sleep(0.25)
+
+    # -------------------------------------------------------------- run
+    def run(self):
+        try:
+            return self._run()
+        finally:
+            print(f"[elastic] quarantine_hits={self.quarantine.hits} "
+                  f"scale_events={self.events}", file=sys.stderr,
+                  flush=True)
+            self._cleanup()
+
+    def _run(self):
+        from ..topology import FailureDomainMap
+        n_local = self.args.local_agents
+        if n_local < 0:
+            n_local = self.max_nodes
+        if n_local:
+            self._spawn_local_agents(n_local)
+        participants = self._rendezvous()
+        if participants is None:
+            print(f"[elastic] rendezvous failed: fewer than "
+                  f"{self.min_nodes} agents registered within "
+                  f"{self.args.elastic_timeout:.0f}s", file=sys.stderr,
+                  flush=True)
+            return 1
+        while True:
+            self._domains = FailureDomainMap(participants)
+            spec = self._render(
+                participants, self.args.nproc_per_node, self.master,
+                quarantined=self.quarantine.quarantined(),
+                store_inc=self.store.incarnation)
+            os.makedirs(self.args.log_dir, exist_ok=True)
+            _clear_dumps(self.args.log_dir)
+            no = self.registry.publish_round(spec)
+            spec["round"] = no
+            self.current_spec = spec
+            print(f"[elastic] round {no}: nnodes={len(participants)} "
+                  f"world_size={spec['world']} nodes={participants} "
+                  f"(range {self.min_nodes}:{self.max_nodes})",
+                  file=sys.stderr, flush=True)
+            outcome, detail = self._watch_round(spec)
+            if outcome == "done":
+                self.registry.announce_complete()
+                print(f"[elastic] all {len(participants)} node(s) "
+                      "finished", file=sys.stderr, flush=True)
+                return 0
+            if outcome == "preempt":
+                self.preempt_restarts += 1
+                if self.preempt_restarts > self.args.max_preempt_restarts:
+                    print("[launch] preemption resume limit reached",
+                          file=sys.stderr, flush=True)
+                    return EXIT_PREEMPT
+                print(f"[elastic] graceful preemption: relaunching the "
+                      f"same {len(participants)} node(s) (preempt resume "
+                      f"{self.preempt_restarts}, does not consume "
+                      "max_restarts)", file=sys.stderr, flush=True)
+                continue
+            self.events += 1
+            if self.events > self.args.max_elastic_events:
+                print("[elastic] scale-event limit reached",
+                      file=sys.stderr, flush=True)
+                return 1
+            if outcome == "scale_out":
+                new = (participants + detail)[:self.max_nodes]
+                print(f"[elastic] node join {detail}: scaling "
+                      f"{len(participants)} -> {len(new)} node(s); new "
+                      "round (graceful save + relaunch)",
+                      file=sys.stderr, flush=True)
+                participants = new
+                continue
+            # failure: quarantine bookkeeping, then reform from live
+            # capacity (failed-but-alive agents rejoin; standbys
+            # backfill; lost/quarantined nodes drop out)
+            for nid in detail["blamed"]:
+                if self.quarantine.record_failure(nid):
+                    print(f"[elastic] quarantine node={nid} "
+                          f"({self.quarantine.threshold} failures within "
+                          f"{self.quarantine.window_s:.0f}s): excluded "
+                          "from subsequent rounds", file=sys.stderr,
+                          flush=True)
+            survivors = self._live_capacity()[:self.max_nodes]
+            print(f"[elastic] node scale event (statuses "
+                  f"{detail['statuses']}; blamed {detail['blamed']}): "
+                  f"{len(survivors)} node(s) survive",
+                  file=sys.stderr, flush=True)
+            if len(survivors) < self.min_nodes:
+                print(f"[elastic] live nodes {len(survivors)} below "
+                      f"min_nnodes={self.min_nodes}: HOLD "
+                      f"{self.args.elastic_timeout:.0f}s for joins",
+                      file=sys.stderr, flush=True)
+                deadline = time.time() + self.args.elastic_timeout
+                while time.time() < deadline:
+                    self._scan_joins()
+                    survivors = self._live_capacity()[:self.max_nodes]
+                    if len(survivors) >= self.min_nodes:
+                        break
+                    time.sleep(0.5)
+                if len(survivors) < self.min_nodes:
+                    print("[elastic] no joins arrived: exiting",
+                          file=sys.stderr, flush=True)
+                    return detail["rc"]
+            participants = survivors
+
+    def _cleanup(self):
+        # completion (or giving up) must not strand agents: the complete
+        # flag is best-effort (the registry may be gone), the SIGTERM
+        # sweep is the backstop
+        try:
+            self.registry.announce_complete()
+        except Exception:
+            pass
+        deadline = time.time() + max(5.0, 2 * self.args.elastic_ttl)
+        for proc in self.agent_procs:
+            while proc.poll() is None and time.time() < deadline:
+                time.sleep(0.1)
+        _terminate_survivors([(p, None) for p in self.agent_procs],
+                             self.args.terminate_grace)
+        for srv in self.servers:
+            try:
+                if srv is not None:
+                    srv.stop_server()
+            except Exception:
+                pass
+
+
+def _launch_node_elastic(args, extra_env, min_nodes, max_nodes):
+    if args.watchdog_timeout > 0 \
+            and not os.environ.get("PADDLE_TPU_WATCHDOG_TIMEOUT") \
+            and "PADDLE_TPU_WATCHDOG_TIMEOUT" not in extra_env:
+        # node-elastic jobs always relaunch: a hang must convert into an
+        # exit for the scale machinery to see it
+        extra_env["PADDLE_TPU_WATCHDOG_TIMEOUT"] = str(
+            args.watchdog_timeout)
+    return _NodeCoordinator(args, extra_env, min_nodes, max_nodes).run()
+
+
+def _usage_error(args, msg, hint):
+    """Flag-combination failure with a mapped cause + one-line hint —
+    and the workerlog dir exists, so post-mortem tooling pointed at
+    --log_dir finds a directory, not ENOENT (ISSUE satellite: this used
+    to die as a bare ValueError before any log dir was created)."""
+    os.makedirs(args.log_dir, exist_ok=True)
+    print(f"[launch] {msg} ({describe_exit(EXIT_USAGE)})",
+          file=sys.stderr, flush=True)
+    print(f"[launch] hint: {hint}", file=sys.stderr, flush=True)
+    return EXIT_USAGE
+
+
+def _parse_nnodes(spec):
+    """'N' or 'MIN:MAX' -> (min_nodes, max_nodes, is_elastic)."""
+    s = str(spec)
+    if ":" in s:
+        lo, hi = s.split(":")
+        return int(lo), int(hi), True
+    n = int(s)
+    return n, n, False
+
+
 def launch(argv=None):
     args = _parse_args(argv)
     # worker-only env (never mutate our own os.environ: launch() may run
@@ -302,12 +731,32 @@ def launch(argv=None):
         extra_env["PADDLE_TPU_FAULT_LEDGER"] = os.path.abspath(
             os.path.join(args.log_dir, "fault_ledger.txt"))
 
+    try:
+        min_nodes, max_nodes, node_elastic = _parse_nnodes(args.nnodes)
+    except ValueError:
+        return _usage_error(
+            args, f"--nnodes {args.nnodes!r} is not 'N' or 'MIN:MAX'",
+            "fixed multi-host: --nnodes N --node_rank R; node-level "
+            "elastic: --nnodes MIN:MAX (this launcher becomes the "
+            "coordinator of per-node agents)")
+    if args.np_spec and (node_elastic or max_nodes != 1):
+        return _usage_error(
+            args, f"--np {args.np_spec} cannot combine with "
+                  f"--nnodes {args.nnodes}: --np elastic mode drives a "
+                  "single-host process group",
+            "use --nnodes MIN:MAX (without --np) for multi-host elastic "
+            "— node agents become the unit of membership")
+    if node_elastic:
+        if min_nodes < 1 or max_nodes < min_nodes:
+            return _usage_error(
+                args, f"--nnodes {args.nnodes}: need 1 <= MIN <= MAX",
+                "example: --nnodes 2:3 --nproc_per_node 2")
+        return _launch_node_elastic(args, extra_env, min_nodes, max_nodes)
+    args.nnodes = max_nodes  # legacy fixed-nnodes path wants the int
+
     elastic = None
     cur_np = None
     if args.np_spec:
-        if args.nnodes != 1:
-            raise SystemExit("--np elastic mode drives a single-host "
-                             "process group (nnodes must be 1)")
         elastic = _ElasticState(args)
         cur_np = elastic.max_np  # rendezvous starts at full width
         extra_env.update(elastic.worker_env(args))

@@ -121,18 +121,22 @@ class ResumableTraining:
             self.step_in_epoch = int(target["step_in_epoch"])
             self.global_step = int(target["global_step"])
             self._last_saved_step = self.global_step
-            _fr.note_step(self.global_step)
             old_world = int(target.get("world_size", 0) or 0)
             new_world = int(getattr(self.lineage, "world_size", 1) or 1)
+            # ring marker: a post-mortem spanning the relaunch shows the
+            # exact step (and world change) this incarnation re-entered at
+            _fr.note_resume(self.global_step, old_world or None, new_world)
             if old_world and old_world != new_world:
                 # elastic scale event: a sharded sampler repartitions the
                 # dataset by world size, so the positional batch-prefix
                 # skip resumes at the right (epoch, step) but over a
                 # DIFFERENT sample partition — sample-exact resume holds
                 # only within an unchanged world
+                nid = os.environ.get("PADDLE_TPU_NODE_ID")
                 self._log(f"RESUMED_RESHARDED world={old_world}->"
                           f"{new_world} (partition changed; batch skip "
-                          "is positional, not sample-exact)")
+                          "is positional, not sample-exact)"
+                          + (f" node={nid}" if nid else ""))
             for k in self.extra_state:
                 self.extra_state[k] = target[k]
             self._log(f"RESUMED epoch={self.epoch} "

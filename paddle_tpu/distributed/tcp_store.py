@@ -11,12 +11,13 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
+import sys
 import threading
 import time
 
 from . import fault as _fault
 
-__all__ = ["TCPStore", "Watchdog", "StoreTimeoutError"]
+__all__ = ["TCPStore", "FailoverStore", "Watchdog", "StoreTimeoutError"]
 
 
 class StoreTimeoutError(RuntimeError):
@@ -72,7 +73,7 @@ class TCPStore:
     world_size, timeout)."""
 
     def __init__(self, host="127.0.0.1", port=6170, is_master=False,
-                 world_size=1, timeout=900):
+                 world_size=1, timeout=900, connect_deadline=None):
         lib = _load_lib()
         self._lib = lib
         self._server = None
@@ -80,6 +81,7 @@ class TCPStore:
         self._host = host
         self._port = int(port)
         self._timeout_ms = int(timeout * 1000)
+        self._connect_deadline = connect_deadline
         if is_master:
             self._server = lib.pd_store_server_start(port)
             if not self._server:
@@ -96,13 +98,20 @@ class TCPStore:
         """Connect with exponential backoff + deadline: a worker that comes
         up before the master has bound its port must outwait it instead of
         dying on the first refused connection (ISSUE tentpole (2))."""
-        deadline = min(self._timeout_ms / 1000.0,
-                       float(os.environ.get(
-                           "PADDLE_TPU_STORE_CONNECT_DEADLINE", "30")))
+        deadline = self._connect_deadline
+        if deadline is None:
+            deadline = min(self._timeout_ms / 1000.0,
+                           float(os.environ.get(
+                               "PADDLE_TPU_STORE_CONNECT_DEADLINE", "30")))
 
         def once():
+            # the native connect has its own retry-until-timeout loop:
+            # bound it by OUR deadline, or one attempt against a dead
+            # port blocks for the full store timeout (900s) and a
+            # FailoverStore can never rotate to its standby
             c = self._lib.pd_store_client_connect(
-                self._host.encode(), self._port, self._timeout_ms)
+                self._host.encode(), self._port,
+                min(self._timeout_ms, max(50, int(deadline * 1000))))
             if not c:
                 raise ConnectionError(
                     f"TCPStore could not connect "
@@ -228,6 +237,16 @@ class TCPStore:
         self.get(f"__barrier/{name}/done", timeout=timeout)
         _fr.record_complete(rec)
 
+    def stop_server(self):
+        """Stop the in-process master server, leaving clients (including
+        this object's own) to fail on their next op. This is how the
+        ``store_die`` chaos kind simulates the master node dying while
+        every client lives: the coordinator stops the PRIMARY registry
+        server and the FailoverStore clients re-home to the standby."""
+        if getattr(self, "_server", None):
+            self._lib.pd_store_server_stop(self._server)
+            self._server = None
+
     def __del__(self):
         try:
             if getattr(self, "_client", None):
@@ -236,6 +255,160 @@ class TCPStore:
                 self._lib.pd_store_server_stop(self._server)
         except Exception:
             pass
+
+
+class FailoverStore:
+    """Warm-standby failover client over an ordered list of TCPStore
+    master candidates (``"host:p1,host:p2"`` or a list of endpoints).
+
+    The control plane of a multi-host elastic job must itself be
+    survivable: when the node serving the rendezvous registry dies, every
+    agent re-homes to the next candidate with Backoff instead of losing
+    the job. Ops delegate to the active TCPStore; a connection failure
+    that exhausts the inner reconnect retries rotates through the
+    remaining candidates (short per-candidate connect deadline, overall
+    bound ``PADDLE_TPU_STORE_FAILOVER_DEADLINE``). Each successful
+    re-home bumps ``incarnation`` and notifies ``on_failover(store,
+    incarnation)`` — callers re-register whatever state the dead master
+    took with it (the standby is warm, not replicated) — and tells the
+    flight recorder so store-scoped barrier/signature keys can never
+    collide across store lifetimes.
+
+    A blocking-get :class:`StoreTimeoutError` is NOT a failover trigger:
+    the store answered, the key never arrived."""
+
+    def __init__(self, endpoints, world_size=1, timeout=900,
+                 connect_deadline=None, on_failover=None):
+        if isinstance(endpoints, str):
+            endpoints = [e for e in endpoints.split(",") if e.strip()]
+        eps = []
+        for ep in endpoints:
+            if isinstance(ep, (tuple, list)):
+                host, port = ep
+            else:
+                host, _, port = str(ep).strip().rpartition(":")
+            eps.append((host or "127.0.0.1", int(port)))
+        if not eps:
+            raise ValueError("FailoverStore needs at least one endpoint")
+        self._eps = eps
+        self._world_size = int(world_size)
+        self._timeout = timeout
+        self._probe_deadline = connect_deadline if connect_deadline \
+            is not None else float(os.environ.get(
+                "PADDLE_TPU_STORE_PROBE_DEADLINE", "3"))
+        self._on_failover = on_failover
+        self._lock = threading.RLock()  # re-entrant: on_failover may issue
+        self._idx = 0                   # store ops through this object
+        self._incarnation = 0
+        # initial connect also rotates: a client that starts AFTER the
+        # primary died (a backfill node joining post-failover) must home
+        # to whichever candidate is alive, not crash on the first. The
+        # first candidate keeps the generous first-connect patience (the
+        # master may bind late); later ones get the short probe deadline.
+        last = None
+        self._store = None
+        for idx, (host, port) in enumerate(eps):
+            try:
+                self._store = TCPStore(
+                    host, port, is_master=False, world_size=world_size,
+                    timeout=timeout,
+                    connect_deadline=None if idx == 0
+                    else self._probe_deadline)
+                self._idx = idx
+                self._incarnation = idx  # starting on a standby adopts
+                break                    # its incarnation ordinal
+            except Exception as e:
+                last = e
+        if self._store is None:
+            raise last
+        # RE-connects inside an op must fail fast so a dead master
+        # rotates to the standby instead of stalling the op for the
+        # store-wide connect deadline
+        self._store._connect_deadline = self._probe_deadline
+
+    @property
+    def incarnation(self) -> int:
+        return self._incarnation
+
+    @property
+    def active_endpoint(self):
+        return self._eps[self._idx]
+
+    def _failover_locked(self, err):
+        """Rotate to the next reachable candidate (starting after the
+        active one) within the failover deadline; bump the incarnation and
+        notify. Raises the original error when every candidate is down."""
+        deadline = time.monotonic() + float(os.environ.get(
+            "PADDLE_TPU_STORE_FAILOVER_DEADLINE", "20"))
+        n = len(self._eps)
+        start = self._idx
+        delays = _fault.Backoff(base=0.1, cap=1.0).delays()
+        while True:
+            for k in range(1, n + 1):
+                idx = (start + k) % n
+                host, port = self._eps[idx]
+                try:
+                    store = TCPStore(
+                        host, port, is_master=False,
+                        world_size=self._world_size, timeout=self._timeout,
+                        connect_deadline=self._probe_deadline)
+                except Exception:
+                    continue
+                self._store, self._idx = store, idx
+                self._incarnation += 1
+                print(f"[store] re-homed to standby {host}:{port} "
+                      f"(store incarnation {self._incarnation})",
+                      file=sys.stderr, flush=True)
+                from . import flight_recorder as _fr
+                _fr.note_store_incarnation(self._incarnation)
+                if self._on_failover is not None:
+                    try:
+                        self._on_failover(self, self._incarnation)
+                    except Exception as e:
+                        print(f"[store] on_failover callback failed: {e}",
+                              file=sys.stderr, flush=True)
+                return
+            if time.monotonic() >= deadline:
+                raise RuntimeError(
+                    f"every store candidate unreachable "
+                    f"({', '.join(f'{h}:{p}' for h, p in self._eps)})"
+                ) from err
+            time.sleep(next(delays, 1.0))
+
+    def _op(self, fn):
+        with self._lock:
+            last = None
+            for _ in range(len(self._eps) + 1):
+                try:
+                    return fn(self._store)
+                except StoreTimeoutError:
+                    raise
+                except (RuntimeError, ConnectionError, OSError) as e:
+                    last = e
+                    self._failover_locked(e)
+            raise last
+
+    def set(self, key, value):
+        return self._op(lambda s: s.set(key, value))
+
+    def get(self, key, timeout=None):
+        return self._op(lambda s: s.get(key, timeout=timeout))
+
+    def add(self, key, amount=1):
+        return self._op(lambda s: s.add(key, amount))
+
+    def check(self, key):
+        return self._op(lambda s: s.check(key))
+
+    def delete_key(self, key):
+        return self._op(lambda s: s.delete_key(key))
+
+    def wait(self, keys, timeout=None):
+        return self._op(lambda s: s.wait(keys, timeout=timeout))
+
+    def barrier(self, name, world_size, timeout=None):
+        return self._op(lambda s: s.barrier(name, world_size,
+                                            timeout=timeout))
 
 
 class Watchdog:

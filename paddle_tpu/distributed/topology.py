@@ -18,7 +18,8 @@ from jax.sharding import Mesh
 
 from .collective import Group
 
-__all__ = ["CommunicateTopology", "HybridCommunicateGroup", "build_mesh"]
+__all__ = ["CommunicateTopology", "HybridCommunicateGroup", "build_mesh",
+           "FailureDomainMap"]
 
 _AXES = ["data", "pipe", "sharding", "sep", "model"]
 
@@ -187,6 +188,52 @@ class HybridCommunicateGroup:
                 f"sep={self._sep_degree}, mp={self._mp_degree})")
 
     __repr__ = topology_description
+
+
+class FailureDomainMap:
+    """Node ↔ failure-domain metadata for multi-host pods.
+
+    Each node is one **ICI** island (its chips share the intra-slice ICI
+    mesh; losing the node loses that whole island at once) and nodes are
+    grouped ``dcn_group`` at a time into **DCN** domains — hosts behind
+    one data-center-network link/switch, the blast radius of a DCN flap
+    (the dominant multi-host failure mode alongside preemption; see
+    PAPERS.md pod-slice serving). The elastic coordinator logs the lost
+    node's domains and its correlated peers on every node-loss event, and
+    ``bench.py --chaos`` kills along node boundaries so the measured
+    detect-to-resume latency reflects whole-domain loss, not a lone
+    process. Pure metadata — no jax state — so the launcher can build it
+    before any worker exists."""
+
+    def __init__(self, nodes, dcn_group=2):
+        self._nodes = list(nodes)
+        self._dcn_group = max(1, int(dcn_group))
+
+    @property
+    def nodes(self):
+        return list(self._nodes)
+
+    def ici_domain(self, node) -> int:
+        return self._nodes.index(node)
+
+    def dcn_domain(self, node) -> int:
+        return self._nodes.index(node) // self._dcn_group
+
+    def nodes_in_dcn(self, domain) -> list:
+        lo = int(domain) * self._dcn_group
+        return self._nodes[lo:lo + self._dcn_group]
+
+    def correlated(self, node) -> list:
+        """Peers expected to fail together with ``node`` (same DCN link)."""
+        return [n for n in self.nodes_in_dcn(self.dcn_domain(node))
+                if n != node]
+
+    def describe(self, node) -> str:
+        peers = self.correlated(node)
+        tail = (f"; shares a DCN link with {', '.join(peers)}"
+                if peers else "")
+        return (f"{node}: ici_domain={self.ici_domain(node)} "
+                f"dcn_domain={self.dcn_domain(node)}{tail}")
 
 
 _hcg: HybridCommunicateGroup | None = None

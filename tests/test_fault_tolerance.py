@@ -1388,6 +1388,466 @@ def test_desync_chaos_fails_fast_with_rank_naming_diagnostic(tmp_path):
     assert "[post-mortem]" in r.stderr
 
 
+# ------------------------------------------- stream-module ring visibility
+
+def test_stream_collectives_record_ring_entries():
+    """Satellite: every stream variant records its own ``stream.<op>``
+    entry; the async (sync_op=False) form stays *issued* until wait() —
+    an async collective a rank never waited on shows up pending in its
+    dump instead of being invisible to the ring."""
+    rec = flight.enable(capacity=32)
+    t = paddle.to_tensor(np.ones((8, 2), "float32"))
+    dist.stream.all_reduce(t)
+    kinds = [e["kind"] for e in rec.entries()]
+    assert "stream.all_reduce" in kinds and "all_reduce" in kinds
+    se = [e for e in rec.entries() if e["kind"] == "stream.all_reduce"][0]
+    assert se["status"] == "completed" and se["sync_op"] is True
+    assert se["shape"] == [8, 2]
+    # async: pending until the task is waited
+    task = dist.stream.all_reduce(t, sync_op=False)
+    e = [x for x in rec.entries() if x["kind"] == "stream.all_reduce"][-1]
+    assert e["status"] == "issued" and not task.is_completed()
+    task.wait()
+    assert e["status"] == "completed" and task.is_completed()
+    # p2p stream send/recv (the ROADMAP open item names the p2p module)
+    task = dist.stream.send(t, dst=0, sync_op=False)
+    p = [x for x in rec.entries() if x["kind"] == "stream.send"][-1]
+    assert p["status"] == "issued"
+    task.wait()
+    assert p["status"] == "completed"
+    r = paddle.to_tensor(np.zeros((8, 2), "float32"))
+    dist.stream.recv(r, src=0)
+    assert [x for x in rec.entries()
+            if x["kind"] == "stream.recv"][-1]["status"] == "completed"
+    np.testing.assert_array_equal(r.numpy(), t.numpy())
+
+
+def test_stream_disabled_recorder_is_noop():
+    assert flight.get_recorder() is None
+    t = paddle.to_tensor(np.ones((8, 2), "float32"))
+    out = dist.stream.all_reduce(t)  # sync: plain result, no ring
+    assert out is t
+    task = dist.stream.all_reduce(t, sync_op=False)
+    task.wait()  # completes against a None entry without touching state
+    assert flight.get_recorder() is None
+
+
+# ------------------------------------- desync signature: post-placement
+
+def test_desync_signature_uses_post_placement_array():
+    """Satellite: the cross-rank signature describes the PLACED payload
+    (stacked global array committed onto the group mesh), so a
+    placement-stage shape divergence is named in the signature instead of
+    being caught by seq drift only."""
+    port = _free_port()
+    store = dist.TCPStore("127.0.0.1", port, is_master=True, timeout=15)
+    flight.enable(capacity=8, desync=True, store=store, world_size=2,
+                  rank=0)
+    g = dist.get_group()
+    gkey = f"{g.axis}:{g.id}"
+    n = g.nranks
+    dst = paddle.to_tensor(np.zeros((1, 3), "float32"))
+    lst = [paddle.to_tensor(np.ones((1, 3), "float32")) for _ in range(n)]
+    # the peer announces the POST-placement signature (stacked [n, 1, 3]
+    # global payload, not the [1, 3] output buffer): must AGREE
+    seq = flight.current_group_seq(f"op/{gkey}") + 1
+    placed = f"scatter|group={gkey}|shape=[{n}, 1, 3]|dtype=float32"
+    store.set(f"{flight.store_scope()}/sig/{gkey}/{seq}/1", placed.encode())
+    dist.scatter(dst, lst, src=0)  # no desync: signatures match
+    e = [x for x in flight.get_recorder().entries()
+         if x["kind"] == "scatter"][-1]
+    assert e["shape"] == [n, 1, 3]  # ring carries the placed shape too
+    # a peer whose placement produced a different payload is named with
+    # BOTH post-placement shapes
+    seq2 = flight.current_group_seq(f"op/{gkey}") + 1
+    other = f"scatter|group={gkey}|shape=[{n}, 1, 4]|dtype=float32"
+    store.set(f"{flight.store_scope()}/sig/{gkey}/{seq2}/1", other.encode())
+    with pytest.raises(dist.CollectiveDesyncError) as ei:
+        dist.scatter(dst, lst, src=0)
+    assert f"[{n}, 1, 3]" in str(ei.value)
+    assert f"[{n}, 1, 4]" in str(ei.value)
+
+
+# ----------------------- launcher flag validation (mapped usage errors)
+
+def test_nnodes_np_combination_fails_with_mapped_cause(tmp_path, capfd):
+    """Satellite: ``--np MIN:MAX`` + ``--nnodes 2`` used to die with a
+    bare error before any workerlog dir existed; now it exits with the
+    mapped EX_USAGE cause, a one-line hint, and the log dir created."""
+    from paddle_tpu.distributed.launch.main import launch
+    log_dir = tmp_path / "logs"
+    rc = launch(["--np", "1:2", "--nnodes", "2",
+                 "--log_dir", str(log_dir), "script.py"])
+    assert rc == fault.EXIT_USAGE == 64
+    err = capfd.readouterr().err
+    assert "rc=64: launcher usage error" in err
+    assert "hint:" in err and "--nnodes MIN:MAX" in err
+    assert log_dir.is_dir()  # post-mortem tooling finds a dir, not ENOENT
+    # garbage --nnodes maps the same way instead of a bare ValueError
+    rc = launch(["--nnodes", "two", "--log_dir", str(log_dir),
+                 "script.py"])
+    assert rc == fault.EXIT_USAGE
+    assert "not 'N' or 'MIN:MAX'" in capfd.readouterr().err
+    assert "usage" in fault.describe_exit(64)
+
+
+# ------------------------- multi-host elastic: node-scoped fault grammar
+
+def test_node_fault_kinds_grammar():
+    es = fault.parse_fault_spec(
+        "node_die@node_beat:3%2,agent_stall@node_beat:1,"
+        "store_die@elastic_store:5")
+    assert [e.key() for e in es] == [
+        "node_die@node_beat:3%2", "agent_stall@node_beat:1",
+        "store_die@elastic_store:5"]
+    # node-scoped kinds pinned to sites that cannot enact them are
+    # rejected at parse time (same rule as every cooperative kind)
+    with pytest.raises(ValueError):
+        fault.parse_fault_spec("node_die@step:1")
+    with pytest.raises(ValueError):
+        fault.parse_fault_spec("store_die@store:1")
+    with pytest.raises(ValueError):
+        fault.parse_fault_spec("agent_stall@ckpt:1")
+    # wildcards only fire at their honored sites
+    fault.set_fault_spec("node_die:1")
+    assert fault.maybe_inject("step") is None
+    assert fault.maybe_inject("store") is None
+    assert fault.maybe_inject("node_beat") == "node_die"
+    fault.set_fault_spec("store_die:1")
+    assert fault.maybe_inject("node_beat") is None
+    assert fault.maybe_inject("elastic_store") == "store_die"
+
+
+def test_agent_stall_sleeps_at_node_beat(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_FAULT_AGENT_STALL_S", "0.3")
+    fault.set_fault_spec("agent_stall@node_beat:1")
+    t0 = time.monotonic()
+    assert fault.maybe_inject("node_beat") is None  # executed, not returned
+    assert time.monotonic() - t0 >= 0.3
+
+
+# --------------------------- multi-host elastic: registry + quarantine
+
+def test_quarantine_list_sliding_window():
+    from paddle_tpu.distributed import QuarantineList
+    q = QuarantineList(window_s=10, threshold=2)
+    assert not q.record_failure("n1", now=0.0)
+    assert q.record_failure("n1", now=3.0)       # 2 inside the window
+    assert q.is_quarantined("n1") and q.hits == 1
+    assert not q.record_failure("n1", now=4.0)   # idempotent once in
+    assert not q.record_failure("n2", now=0.0)
+    assert not q.record_failure("n2", now=20.0)  # first stamp aged out
+    assert q.record_failure("n2", now=25.0)
+    assert q.quarantined() == ["n1", "n2"] and q.hits == 2
+
+
+def test_failure_domain_map_describes_blast_radius():
+    from paddle_tpu.distributed import FailureDomainMap
+    dm = FailureDomainMap(["node0", "node1", "node2", "node3"],
+                          dcn_group=2)
+    assert dm.ici_domain("node2") == 2 and dm.dcn_domain("node2") == 1
+    assert dm.nodes_in_dcn(0) == ["node0", "node1"]
+    assert dm.correlated("node2") == ["node3"]
+    assert "shares a DCN link with node3" in dm.describe("node2")
+
+
+def test_render_node_round_assigns_ranks_in_join_order():
+    from paddle_tpu.distributed import render_node_round
+    spec = render_node_round(["b", "a"], 2, "127.0.0.1:8476",
+                             quarantined=["c"], store_inc=1)
+    assert spec["nodes"] == {"b": 0, "a": 1}
+    assert spec["world"] == 4 and spec["nproc"] == 2
+    assert spec["quarantined"] == ["c"] and spec["store_inc"] == 1
+
+
+def test_node_registry_membership_and_rounds():
+    from paddle_tpu.distributed import NodeRegistry
+    port = _free_port()
+    master = dist.TCPStore("127.0.0.1", port, is_master=True, timeout=15)
+    reg = NodeRegistry(master, "jobx", ttl=2.0)
+    reg.register("nodeA", {"ord": 0, "status": "idle", "round": 0})
+    reg.register("nodeB", {"ord": 1, "status": "idle", "round": 0})
+    assert reg.joined() == ["nodeA", "nodeB"]
+    assert set(reg.live()) == {"nodeA", "nodeB"}
+    assert reg.record("nodeA")["ord"] == 0
+    assert reg.record("nodeC") is None
+    no = reg.publish_round({"nodes": {"nodeA": 0, "nodeB": 1},
+                            "nproc": 2, "world": 4, "master": "x:1"})
+    assert no == 1 and reg.round_no() == 1
+    assert reg.round(1)["world"] == 4
+    # a stale node drops out of live() after ttl
+    reg.beat("nodeB", {"ord": 1, "status": "running", "round": 1})
+    assert reg.live(now=time.time() + 3.0) == {}
+    assert not reg.is_complete()
+    reg.announce_complete()
+    assert reg.is_complete()
+
+
+def test_failover_store_rehomes_and_bumps_incarnation():
+    """Tentpole: master-node death re-homes clients onto the warm standby
+    with a bumped store incarnation; the flight-recorder key scope
+    rotates with it and the node registry invalidates its join cache (the
+    standby is empty until everyone re-registers)."""
+    from paddle_tpu.distributed import FailoverStore, NodeRegistry
+    p1, p2 = _free_port(), _free_port()
+    prim = dist.TCPStore("127.0.0.1", p1, is_master=True, timeout=15)
+    standby = dist.TCPStore("127.0.0.1", p2, is_master=True, timeout=15)
+    evts = []
+    fs = FailoverStore(f"127.0.0.1:{p1},127.0.0.1:{p2}", timeout=15,
+                       connect_deadline=2.0,
+                       on_failover=lambda s, i: evts.append(i))
+    reg = NodeRegistry(fs, "jobf", ttl=5.0)
+    reg.register("nodeA", {"ord": 0, "status": "idle", "round": 0})
+    assert fs.incarnation == 0
+    base_scope = flight.store_scope()
+    assert ".s" not in base_scope
+    prim.stop_server()  # master node dies; clients must survive
+    reg.beat("nodeA", {"ord": 0, "status": "running", "round": 1})
+    assert evts == [1] and fs.incarnation == 1
+    assert fs.active_endpoint == ("127.0.0.1", p2)
+    # key scope rotated with the store incarnation: no collisions
+    assert flight.store_scope() == base_scope + ".s1"
+    assert reg.joined() == []  # warm standby: empty until re-register
+    reg.register("nodeA", {"ord": 0, "status": "running", "round": 1})
+    assert reg.joined() == ["nodeA"]
+    assert standby.check("elastic/jobf/node/r/nodeA")
+
+
+# ------------------------- multi-host elastic: coordinator + agents
+
+def _node_script(tmp_path):
+    """Plain-python node worker (no jax import => cheap): prints its
+    re-rendered env, then behaves per NW_MODE."""
+    script = tmp_path / "nw.py"
+    script.write_text(
+        "import os, sys, time\n"
+        "rnd = int(os.environ.get('PADDLE_TPU_RESTART_NUM', '0'))\n"
+        "nid = os.environ.get('PADDLE_TPU_NODE_ID')\n"
+        "print('NW', rnd, os.environ['PADDLE_TPU_PROCESS_ID'],\n"
+        "      os.environ['PADDLE_TRAINERS_NUM'], nid,\n"
+        "      os.environ.get('PADDLE_TPU_NODE_RANK'),\n"
+        "      os.environ.get('PADDLE_TPU_NNODES'), flush=True)\n"
+        "mode = os.environ.get('NW_MODE', '')\n"
+        "if mode == 'crash_node1' and nid == 'node1' and rnd < 2:\n"
+        "    time.sleep(1.5)\n"
+        "    sys.exit(43)\n"
+        "if mode == 'sleep':\n"
+        "    time.sleep(float(os.environ.get('NW_SLEEP', '8')))\n"
+        "print('NW_DONE', flush=True)\n"
+        "sys.exit(0)\n")
+    return str(script)
+
+
+def _launch_nodes(tmp_path, nnodes, nproc, extra_argv=(), env=None,
+                  standby=False):
+    from paddle_tpu.distributed.launch.main import launch
+    master = f"127.0.0.1:{_free_port()}"
+    if standby:
+        master += f",127.0.0.1:{_free_port()}"
+    saved = {k: os.environ.get(k) for k in (env or {})}
+    os.environ.update(env or {})
+    try:
+        return launch(["--nnodes", nnodes, "--nproc_per_node", str(nproc),
+                       "--master", master,
+                       "--elastic_ttl", "2", "--terminate_grace", "2",
+                       "--log_dir", str(tmp_path / "logs"),
+                       *extra_argv, _node_script(tmp_path)])
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def _agent_log(tmp_path, node_id):
+    with open(os.path.join(str(tmp_path / "logs"),
+                           f"agentlog.{node_id}")) as f:
+        return f.read()
+
+
+def test_node_rendezvous_rerenders_ranks_across_agents(tmp_path, capfd):
+    """Satellite: multi-node rendezvous — agents as local subprocesses
+    with distinct simulated node ids; every worker sees the coordinator's
+    re-rendered PADDLE_TRAINERS_NUM / global rank / node_rank."""
+    rc = _launch_nodes(tmp_path, "2:2", 2)
+    assert rc == 0
+    err = capfd.readouterr().err
+    assert "round 1: nnodes=2 world_size=4" in err
+    assert "all 2 node(s) finished" in err
+    # 4 workers, ranks 0-3, each pinned to its node's rank block
+    seen = {}
+    for grank in range(4):
+        log = _read_worker_logs(str(tmp_path / "logs"), grank)
+        m = re.search(r"NW 0 (\d+) (\d+) (node\d) (\d) 2", log)
+        assert m, f"rank {grank} env not rendered:\n{log}"
+        assert int(m.group(1)) == grank and m.group(2) == "4"
+        seen.setdefault(m.group(3), []).append(grank)
+    assert sorted(len(v) for v in seen.values()) == [2, 2]
+    for nid, ranks in seen.items():
+        a = _agent_log(tmp_path, nid)
+        assert "REGISTERED" in a and "ROUND 1 world=4" in a
+        assert f"ranks={min(ranks)}-{max(ranks)}" in a
+        assert "NODE_DONE" in a and "AGENT_EXIT 0" in a
+
+
+@pytest.mark.slow
+def test_node_store_failover_training_continues(tmp_path, capfd,
+                                                monkeypatch):
+    """Chaos acceptance (b): the PRIMARY registry master dies mid-round
+    (injected ``store_die``); every agent re-homes to the warm standby
+    under a bumped store incarnation and the round keeps running — the
+    workers are never torn down and the job completes."""
+    monkeypatch.setenv("PADDLE_TPU_STORE_FAILOVER_DEADLINE", "15")
+    monkeypatch.setenv("PADDLE_TPU_STORE_PROBE_DEADLINE", "2")
+    fault.set_fault_spec("store_die@elastic_store:12")
+    rc = _launch_nodes(tmp_path, "2:2", 1, standby=True,
+                       env={"NW_MODE": "sleep", "NW_SLEEP": "10"})
+    assert rc == 0
+    err = capfd.readouterr().err
+    assert "injected store_die" in err
+    assert "re-homed to standby" in err
+    assert "store incarnation 1" in err
+    assert "all 2 node(s) finished" in err
+    for nid in ("node0", "node1"):
+        a = _agent_log(tmp_path, nid)
+        assert "STORE_FAILOVER 1" in a, a
+        assert "NODE_DONE" in a
+    # training continued: round 1 is the ONLY round (no relaunch), and
+    # both workers ran to completion through the failover
+    assert "round 2" not in err
+    assert glob.glob(os.path.join(str(tmp_path / "logs"),
+                                  "workerlog.*.restart*")) == []
+    for grank in range(2):
+        assert "NW_DONE" in _read_worker_logs(str(tmp_path / "logs"),
+                                              grank)
+
+
+@pytest.mark.slow
+def test_node_quarantine_after_two_failures_in_window(tmp_path, capfd):
+    """Chaos acceptance (c): the same node failing twice inside the
+    quarantine window is excluded from the next rendezvous round — the
+    job degrades to the surviving capacity instead of livelocking."""
+    rc = _launch_nodes(
+        tmp_path, "1:2", 1,
+        extra_argv=("--quarantine_window", "120",
+                    "--quarantine_threshold", "2"),
+        env={"NW_MODE": "crash_node1"})
+    assert rc == 0
+    err = capfd.readouterr().err
+    assert "quarantine node=node1" in err
+    assert "quarantine_hits=1" in err
+    # round 3 runs WITHOUT the flaky node: capacity degraded, job done
+    assert re.search(r"round 3: nnodes=1 world_size=1 nodes=\['node0'\]",
+                     err)
+    assert "all 1 node(s) finished" in err
+    a1 = _agent_log(tmp_path, "node1")
+    assert a1.count("NODE_FAILED") == 2
+    assert "QUARANTINED 3" in a1
+    a0 = _agent_log(tmp_path, "node0")
+    assert "NODE_DONE" in a0
+
+
+def test_node_agent_fences_itself_when_orphaned(tmp_path):
+    """An agent whose registry disappears for good (coordinator host
+    gone, no standby) must not run stale workers forever: past the
+    orphan deadline it fences itself — tears down local workers and
+    exits 3 with the AGENT_ORPHANED marker."""
+    port = _free_port()
+    master = dist.TCPStore("127.0.0.1", port, is_master=True, timeout=15)
+    script = tmp_path / "w.py"
+    script.write_text("import time\ntime.sleep(60)\n")
+    env = _clean_env({
+        "PADDLE_TPU_AGENT_ORPHAN_S": "4",
+        "PADDLE_TPU_STORE_FAILOVER_DEADLINE": "2",
+        "PADDLE_TPU_STORE_PROBE_DEADLINE": "1",
+    })
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch.node_agent",
+         "--node_id", "lone", "--store", f"127.0.0.1:{port}",
+         "--ttl", "2", "--terminate_grace", "1",
+         "--log_dir", str(tmp_path / "logs"), str(script)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, cwd=REPO)
+    try:
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if master.check("elastic/default/node/r/lone"):
+                break
+            time.sleep(0.2)
+        assert master.check("elastic/default/node/r/lone"), "never joined"
+        master.stop_server()  # the whole control plane dies, no standby
+        out, _ = proc.communicate(timeout=120)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert proc.returncode == 3, out
+    assert "AGENT_ORPHANED" in out
+    assert "registry poll failed" in out  # it saw the unreachability
+
+
+@pytest.mark.slow
+def test_node_sigkill_chaos_relaunches_and_resumes_resharded(tmp_path):
+    """THE node-loss acceptance run (chaos acceptance (a)): a simulated
+    3-node × 2-worker elastic job (``--nnodes 2:3``) loses a WHOLE node
+    to SIGKILL mid-epoch. The coordinator must detect the loss via the
+    node heartbeat, relaunch the two survivors at world_size=4 with
+    re-rendered ranks, and training must resume at the exact epoch/step
+    from the last verified snapshot, logging RESUMED_RESHARDED for the
+    6→4 repartition."""
+    log_dir = str(tmp_path / "logs")
+    env = _clean_env({
+        "PADDLE_TPU_CKPT_DIR": str(tmp_path / "ck_node"),
+        "PADDLE_TPU_FT_STORE_PORT": str(_free_port()),
+        "PADDLE_TPU_FT_EPOCHS": "2",
+        # 72 samples: the sharded sampler gives every rank 3 batches per
+        # epoch at world 6, so the kill below lands MID-epoch
+        "PADDLE_TPU_FT_BATCHES": "18",
+        "PADDLE_TPU_FT_INTERVAL": "1",
+        # grank 4 (the third node's first worker) SIGKILLs itself after 2
+        # executed batches; its agent converts that into whole-node death
+        "PADDLE_TPU_ELASTIC_KILL": "4:2",
+        "PADDLE_TPU_NODE_DIE_WITH_RANK": "4",
+    })
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nnodes", "2:3", "--nproc_per_node", "2",
+         "--master", f"127.0.0.1:{_free_port()}",
+         "--elastic_ttl", "3", "--terminate_grace", "5",
+         "--elastic_timeout", "120", "--log_dir", log_dir,
+         os.path.join(WORKERS, "elastic_worker.py")],
+        env=env, capture_output=True, text=True, timeout=600, cwd=REPO)
+    assert r.returncode == 0, r.stdout + r.stderr[-3000:]
+    assert "node loss detected" in r.stderr
+    assert re.search(r"round 2: nnodes=2 world_size=4", r.stderr), r.stderr
+    # the killed node really died as a unit: NODE_DIE marker in its agent
+    agents = ""
+    for p in glob.glob(os.path.join(log_dir, "agentlog.*")):
+        with open(p) as f:
+            agents += f.read()
+    assert "NODE_DIE" in agents
+    # the killed worker's own log shows the self-SIGKILL at world 6
+    k = _read_worker_logs(log_dir, 4)
+    assert "WORLD 6" in k and "SELF_SIGKILL" in k
+    for rank in range(4):
+        log = _read_worker_logs(log_dir, rank)
+        assert "WORLD 6" in log and "WORLD 4" in log, f"rank {rank}"
+        m = re.search(r"RESUMED epoch=(\d+) step=(\d+) global_step=(\d+)",
+                      log)
+        assert m, f"rank {rank} never resumed:\n{log[-2000:]}"
+        e, s, _ = (int(x) for x in m.groups())
+        assert "RESUMED_RESHARDED world=6->4" in log
+        round1 = log.split("WORLD 4", 1)[1]
+        batches = [tuple(int(x) for x in bm.groups())
+                   for bm in re.finditer(r"BATCH (\d+) (\d+) (\d+)",
+                                         round1)]
+        assert batches, f"rank {rank} ran no batches after resume"
+        assert (batches[0][0], batches[0][1]) == (e, s), \
+            f"rank {rank}: resumed at {(e, s)} but first batch was " \
+            f"{batches[0][:2]}"
+        assert "DONE" in round1
+
+
 def test_slow_io_injection_delays_async_writer(tmp_path):
     os.environ["PADDLE_TPU_FAULT_SLOW_IO_S"] = "0.3"
     try:

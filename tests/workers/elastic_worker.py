@@ -20,8 +20,11 @@ Env knobs: PADDLE_TPU_CKPT_DIR (required), PADDLE_TPU_FT_STORE_PORT
 (commit-barrier TCPStore, multi-process only), PADDLE_TPU_FT_EPOCHS /
 PADDLE_TPU_FT_BATCHES (loop shape), PADDLE_TPU_ELASTIC_KILL="rank:step"
 (SIGKILL self on that rank after that many executed batches, first
-incarnation only), PADDLE_TPU_FT_INTERVAL (snapshot every N steps),
-PADDLE_TPU_FT_ASYNC=1 (overlapped snapshots).
+incarnation only), PADDLE_TPU_NODE_CRASH="node_id:step:rc[:from_round]"
+(on that NODE, exit rc after that many executed batches in EVERY
+incarnation >= from_round — the flaky-host model that drives
+quarantine), PADDLE_TPU_FT_INTERVAL
+(snapshot every N steps), PADDLE_TPU_FT_ASYNC=1 (overlapped snapshots).
 """
 import os
 import signal
@@ -56,6 +59,15 @@ class _Markers(Callback):
         if kill:
             r, n = kill.split(":")
             self.kill_rank, self.kill_after = int(r), int(n)
+        crash = os.environ.get("PADDLE_TPU_NODE_CRASH", "")
+        self.crash_node = self.crash_after = self.crash_rc = None
+        self.crash_from = 0
+        if crash:
+            parts = crash.split(":")
+            self.crash_node, self.crash_after, self.crash_rc = \
+                parts[0], int(parts[1]), int(parts[2])
+            if len(parts) > 3:
+                self.crash_from = int(parts[3])
         self.epoch = 0
 
     def on_epoch_begin(self, epoch, logs=None):
@@ -73,6 +85,15 @@ class _Markers(Callback):
             print(f"SELF_SIGKILL {time.time():.6f}", flush=True)
             sys.stdout.flush()
             os.kill(os.getpid(), signal.SIGKILL)
+        if (self.crash_node is not None
+                and self.incarnation >= self.crash_from
+                and os.environ.get("PADDLE_TPU_NODE_ID") == self.crash_node
+                and self.executed == self.crash_after):
+            # flaky-host model: EVERY incarnation on this node fails the
+            # same way until the coordinator quarantines it
+            print(f"NODE_CRASH {time.time():.6f}", flush=True)
+            sys.stdout.flush()
+            os._exit(self.crash_rc)
 
 
 def main():

@@ -56,6 +56,7 @@ from .version import full_version as __version__  # noqa: F401
 from . import distributed  # noqa: F401
 from . import distribution  # noqa: F401
 from . import hapi  # noqa: F401
+from . import observability  # noqa: F401
 from . import metric  # noqa: F401
 from . import models  # noqa: F401
 from . import profiler  # noqa: F401

@@ -60,6 +60,27 @@ _op_profiler = None  # set by paddle_tpu.profiler to record per-op timing
 _cf_recorder = None  # set by jit.control_flow during branch discovery
 _static_graph_hook = None  # set by static.program under enable_static
 
+# observability: per-op dispatch-latency histogram, resolved lazily from
+# the env-gated metrics registry (PADDLE_TPU_METRICS=1). metrics.enable/
+# disable invalidate the cache through sys.modules so a later gate change
+# takes effect; when metrics are off the steady-state cost is one global
+# read + None check per op.
+_op_metrics = None
+_op_metrics_resolved = False
+
+
+def _resolve_op_metrics():
+    global _op_metrics, _op_metrics_resolved
+    _op_metrics_resolved = True
+    try:
+        from ..observability import metrics as _obs
+        reg = _obs.get_registry()
+        _op_metrics = reg.histogram("eager_dispatch_us") \
+            if reg is not None else None
+    except Exception:
+        _op_metrics = None
+    return _op_metrics
+
 
 def apply(name: str, fwd: Callable, inputs: Sequence[Any], nout: int = 1,
           has_aux: bool = False):
@@ -68,7 +89,8 @@ def apply(name: str, fwd: Callable, inputs: Sequence[Any], nout: int = 1,
         if recorded is not None:
             return recorded
     hook = _op_profiler
-    if hook is None:
+    om = _op_metrics if _op_metrics_resolved else _resolve_op_metrics()
+    if hook is None and om is None:
         result = _apply_impl(name, fwd, inputs, nout, has_aux)
         if _cf_recorder is not None:
             _cf_recorder.note(inputs, result)
@@ -82,7 +104,11 @@ def apply(name: str, fwd: Callable, inputs: Sequence[Any], nout: int = 1,
             _cf_recorder.note(inputs, result)
         return result
     finally:
-        hook(name, t0, time.perf_counter(), inputs, result)
+        t1 = time.perf_counter()
+        if om is not None:
+            om.observe((t1 - t0) * 1e6)
+        if hook is not None:
+            hook(name, t0, t1, inputs, result)
 
 
 def _apply_impl(name: str, fwd: Callable, inputs: Sequence[Any],

@@ -262,9 +262,17 @@ class Engine:
                 lineage, network=self.model, optimizer=self.optimizer,
                 interval=snapshot_interval, async_snapshot=async_snapshot)
             rt.restore()
+        # PADDLE_TPU_METRICS=1: the same per-step telemetry hapi fit gets
+        # (step-time breakdown, tokens/sec, MFU) on this bare loop
+        from ...observability import telemetry as _telemetry
+        tm = _telemetry.maybe_telemetry_callback(self.model)
+        if tm is not None:
+            tm.on_train_begin()
         history = []
         try:
             for epoch in range(rt.epoch if rt is not None else 0, epochs):
+                if tm is not None:
+                    tm.on_epoch_begin(epoch)
                 for i, batch in enumerate(train_data):
                     if steps_per_epoch is not None and i >= steps_per_epoch:
                         break
@@ -272,10 +280,17 @@ class Engine:
                         if rt.skip_batch(epoch, i):
                             continue
                         rt.poll_preempt(epoch, i)
+                    if tm is not None:
+                        tm.batch_ready(batch[0])
                     loss = self._step(*batch)
+                    _telemetry.mark_sync_begin()
                     history.append(float(np.asarray(loss.numpy())))
+                    if tm is not None:
+                        tm.on_train_batch_end(i)
                     if rt is not None:
                         rt.step_done(epoch, i)
+                        if tm is not None:
+                            tm.note_pause()  # ckpt time is not data wait
                 if rt is not None:
                     rt.epoch_done(epoch)
         except BaseException:
@@ -285,6 +300,9 @@ class Engine:
                 except Exception:
                     pass  # never mask the training error
             raise
+        finally:
+            if tm is not None:
+                tm.on_train_end()
         if rt is not None:
             rt.finalize()
         return history

@@ -110,10 +110,18 @@ def _collective_begin(site, kind, g, arr=None):
     :func:`_collective_ready` once the payload is placed, and completes
     the entry after the collective returns."""
     injected = _fault.maybe_inject(site)
+    extra = None
+    if arr is not None:
+        nbytes = int(getattr(arr, "nbytes", 0) or 0)
+        if nbytes:
+            # wire-volume accounting: observability's per-kind
+            # collective_bytes_total counter reads this off the entry
+            extra = {"nbytes": nbytes}
     e = _fr.record_issue(kind, group=f"{g.axis}:{g.id}",
                          shape=tuple(getattr(arr, "shape", ()) or ())
                          if arr is not None else None,
-                         dtype=getattr(arr, "dtype", None))
+                         dtype=getattr(arr, "dtype", None),
+                         extra=extra)
     return e, injected
 
 

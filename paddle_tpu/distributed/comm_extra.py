@@ -115,7 +115,9 @@ def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
             f"alltoall_single expects the global [nranks={n}, len] buffer, "
             f"got shape {tuple(arr.shape)}")
     rec = _fr.record_issue("alltoall_single", group=f"{g.axis}:{g.id}",
-                           shape=tuple(arr.shape), dtype=arr.dtype)
+                           shape=tuple(arr.shape), dtype=arr.dtype,
+                           extra={"nbytes": int(getattr(arr, "nbytes", 0)
+                                                or 0)})
     k = arr.shape[1] // n
     chunked = arr.reshape((n, n, k) + arr.shape[2:])
     out = jnp.swapaxes(chunked, 0, 1).reshape(arr.shape)
@@ -141,7 +143,10 @@ def send(tensor, dst=0, group=None, sync_op=True):
     from .env import get_rank, get_world_size
     rec = _fr.record_issue("send", group="p2p",
                            shape=tuple(tensor._data.shape),
-                           dtype=tensor._data.dtype, extra={"dst": dst})
+                           dtype=tensor._data.dtype,
+                           extra={"dst": dst,
+                                  "nbytes": int(getattr(
+                                      tensor._data, "nbytes", 0) or 0)})
     if get_world_size() > 1 and _store() is not None:
         key = f"p2p/{get_rank()}->{dst}"
         _store().set(key, pickle.dumps(np.asarray(tensor._data)))
@@ -155,7 +160,10 @@ def recv(tensor, src=0, group=None, sync_op=True):
     from .env import get_rank, get_world_size
     rec = _fr.record_issue("recv", group="p2p",
                            shape=tuple(tensor._data.shape),
-                           dtype=tensor._data.dtype, extra={"src": src})
+                           dtype=tensor._data.dtype,
+                           extra={"src": src,
+                                  "nbytes": int(getattr(
+                                      tensor._data, "nbytes", 0) or 0)})
     if get_world_size() > 1 and _store() is not None:
         key = f"p2p/{src}->{get_rank()}"
         _store().wait([key])

@@ -37,17 +37,44 @@ def _reduce(value, op):
 
 
 def sum(value, scope=None, util=None):  # noqa: A001
-    """Reference: fleet.metrics.sum — global sum of a local stat."""
+    """Reference: fleet.metrics.sum — global sum of a local stat.
+
+    Example::
+
+        >>> import numpy as np
+        >>> from paddle_tpu.distributed.fleet import metrics
+        >>> local_correct = np.array([3.0])       # this rank's count
+        >>> metrics.sum(local_correct)            # world sum, float64
+        array([3.])
+    """
     from ..collective import ReduceOp
     return _reduce(value, ReduceOp.SUM)
 
 
 def max(value, scope=None, util=None):  # noqa: A001
+    """Global elementwise max of a per-rank stat.
+
+    Example::
+
+        >>> import numpy as np
+        >>> from paddle_tpu.distributed.fleet import metrics
+        >>> metrics.max(np.array([0.25]))         # slowest rank wins
+        array([0.25])
+    """
     from ..collective import ReduceOp
     return _reduce(value, ReduceOp.MAX)
 
 
 def min(value, scope=None, util=None):  # noqa: A001
+    """Global elementwise min of a per-rank stat.
+
+    Example::
+
+        >>> import numpy as np
+        >>> from paddle_tpu.distributed.fleet import metrics
+        >>> metrics.min(np.array([7.0, 2.0]))
+        array([7., 2.])
+    """
     from ..collective import ReduceOp
     return _reduce(value, ReduceOp.MIN)
 
@@ -55,7 +82,15 @@ def min(value, scope=None, util=None):  # noqa: A001
 def auc(stat_pos, stat_neg, scope=None, util=None):
     """Reference: fleet.metrics.auc — merge per-rank positive/negative
     histogram buckets, then integrate the ROC curve exactly like the
-    reference's global_auc."""
+    reference's global_auc.
+
+    Example (two threshold buckets; all positives score high, all
+    negatives score low → perfect ranking)::
+
+        >>> from paddle_tpu.distributed.fleet import metrics
+        >>> metrics.auc([0.0, 10.0], [10.0, 0.0])
+        1.0
+    """
     pos = sum(stat_pos)
     neg = sum(stat_neg)
     # walk thresholds from high to low accumulating TP/FP

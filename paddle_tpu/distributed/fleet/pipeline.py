@@ -36,6 +36,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ...core.tensor import Tensor
 from ...core.autograd import backward as _tape_backward
 from ...nn import Layer, LayerList
+from ...observability import tracing as _tracing
 from .. import fault as _fault
 from .. import flight_recorder as _fr
 from ..topology import get_hybrid_communicate_group
@@ -296,29 +297,30 @@ class PipelineParallel(Layer):
             fre = _fr.record_issue("pp_forward", group="pipe",
                                    extra={"stage": s, "pp_chunk": chunk,
                                           "mb": mb})
-            seg = chunk * S + s
-            if seg == 0:
-                x_in = xs[mb]
-            else:
-                arr = act_ready[seg].pop(mb)
-                x_in = Tensor(arr, stop_gradient=False)
-                x_in.is_leaf_ = True
-            x = model._to_stage(x_in, s)
-            for layer in model.segment_layers(seg):
-                x = layer(x)
-            if seg == last_seg:
-                loss = loss_fn(x, ys[mb]) if loss_fn is not None else x
-                losses[mb] = loss.detach()
-                rec = _Saved(x_in, loss)
-            else:
-                act_ready[seg + 1][mb] = x._data
-                rec = _Saved(x_in, x)
-            saved[(seg, mb)] = rec
-            inflight[s] += 1
-            peak_inflight[s] = max(peak_inflight[s], inflight[s])
-            live_bytes += rec.bytes
-            peak_bytes = max(peak_bytes, live_bytes)
-            order.append(("F", s, chunk, mb))
+            with _tracing.span("fwd", stage=s, chunk=chunk, mb=mb):
+                seg = chunk * S + s
+                if seg == 0:
+                    x_in = xs[mb]
+                else:
+                    arr = act_ready[seg].pop(mb)
+                    x_in = Tensor(arr, stop_gradient=False)
+                    x_in.is_leaf_ = True
+                x = model._to_stage(x_in, s)
+                for layer in model.segment_layers(seg):
+                    x = layer(x)
+                if seg == last_seg:
+                    loss = loss_fn(x, ys[mb]) if loss_fn is not None else x
+                    losses[mb] = loss.detach()
+                    rec = _Saved(x_in, loss)
+                else:
+                    act_ready[seg + 1][mb] = x._data
+                    rec = _Saved(x_in, x)
+                saved[(seg, mb)] = rec
+                inflight[s] += 1
+                peak_inflight[s] = max(peak_inflight[s], inflight[s])
+                live_bytes += rec.bytes
+                peak_bytes = max(peak_bytes, live_bytes)
+                order.append(("F", s, chunk, mb))
             _fr.record_complete(fre)
 
         def run_backward(s, chunk, mb):
@@ -326,25 +328,27 @@ class PipelineParallel(Layer):
             fre = _fr.record_issue("pp_backward", group="pipe",
                                    extra={"stage": s, "pp_chunk": chunk,
                                           "mb": mb})
-            seg = chunk * S + s
-            rec = saved.pop((seg, mb))
-            if seg == last_seg:
-                scaled = rec.out * (1.0 / M)
-                if scaler is not None:
-                    scaled = scaler.scale(scaled)
-                _tape_backward([scaled], None)
-            else:
-                ct = grad_ready[seg].pop(mb)
-                _tape_backward([rec.out], [Tensor(ct, stop_gradient=True)])
-            if seg > 0:
-                g = rec.x_in._grad
-                assert g is not None, (
-                    f"stage {s} chunk {chunk} produced no input grad")
-                grad_ready[seg - 1][mb] = g
-                rec.x_in._grad = None
-            inflight[s] -= 1
-            live_bytes -= rec.bytes
-            order.append(("B", s, chunk, mb))
+            with _tracing.span("bwd", stage=s, chunk=chunk, mb=mb):
+                seg = chunk * S + s
+                rec = saved.pop((seg, mb))
+                if seg == last_seg:
+                    scaled = rec.out * (1.0 / M)
+                    if scaler is not None:
+                        scaled = scaler.scale(scaled)
+                    _tape_backward([scaled], None)
+                else:
+                    ct = grad_ready[seg].pop(mb)
+                    _tape_backward([rec.out],
+                                   [Tensor(ct, stop_gradient=True)])
+                if seg > 0:
+                    g = rec.x_in._grad
+                    assert g is not None, (
+                        f"stage {s} chunk {chunk} produced no input grad")
+                    grad_ready[seg - 1][mb] = g
+                    rec.x_in._grad = None
+                inflight[s] -= 1
+                live_bytes -= rec.bytes
+                order.append(("B", s, chunk, mb))
             _fr.record_complete(fre)
 
         progs = [self._stage_program(s, M) for s in range(S)]
@@ -392,20 +396,23 @@ class PipelineParallel(Layer):
         one optimizer step. Returns the mean micro-batch loss."""
         from .. import watchdog as _watchdog
         _watchdog.beat()
-        x, y = data
-        n = self._num_micro_batches
-        xs = self._split_micro(x, n)
-        ys = self._split_micro(y, n)
-        losses = self._run_schedule(xs, ys, scaler=scaler)
-        if scaler is not None:
-            scaler.step(optimizer)
-            scaler.update()
-        else:
-            optimizer.step()
-        optimizer.clear_grad()
-        if lr_scheduler is not None:
-            lr_scheduler.step()
-        mean_loss = sum(float(l.numpy()) for l in losses) / n
+        with _tracing.span("step", schedule=self._schedule,
+                           micro_batches=self._num_micro_batches):
+            x, y = data
+            n = self._num_micro_batches
+            xs = self._split_micro(x, n)
+            ys = self._split_micro(y, n)
+            losses = self._run_schedule(xs, ys, scaler=scaler)
+            with _tracing.span("opt"):
+                if scaler is not None:
+                    scaler.step(optimizer)
+                    scaler.update()
+                else:
+                    optimizer.step()
+                optimizer.clear_grad()
+                if lr_scheduler is not None:
+                    lr_scheduler.step()
+            mean_loss = sum(float(l.numpy()) for l in losses) / n
         return Tensor(np.asarray(mean_loss, np.float32))
 
     def eval_batch(self, data, compute_loss=True):

@@ -32,6 +32,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ...core import random as _random
 from ...core.tensor import Parameter, Tensor
 from ...nn import Layer
+from ...observability import tracing as _tracing
 from .. import fault as _fault
 from .. import flight_recorder as _fr
 from ..topology import get_hybrid_communicate_group
@@ -335,24 +336,26 @@ class CompiledPipelineParallel(Layer):
         rec = _fr.record_issue("pipeline_compiled_step", group="pipe",
                                shape=tuple(x.shape), dtype=x.dtype,
                                extra={"n_micro": M})
-        loss, (g_pre, g_blk, g_post) = step(
-            pre_arrs, blk_arrs, post_arrs, x._data, y._data,
-            _random.next_key(), scale)
-        _fr.record_complete(rec)
-        for p, g in zip(self._pre_params, g_pre):
-            p._grad = g if p._grad is None else p._grad + g
-        for p, g in zip(self._stacked, g_blk):
-            p._grad = g if p._grad is None else p._grad + g
-        for p, g in zip(self._post_params, g_post):
-            p._grad = g if p._grad is None else p._grad + g
-        if scaler is not None:
-            scaler.step(optimizer)
-            scaler.update()
-        else:
-            optimizer.step()
-        optimizer.clear_grad()
-        if lr_scheduler is not None:
-            lr_scheduler.step()
+        with _tracing.span("step", schedule="compiled", micro_batches=M):
+            loss, (g_pre, g_blk, g_post) = step(
+                pre_arrs, blk_arrs, post_arrs, x._data, y._data,
+                _random.next_key(), scale)
+            _fr.record_complete(rec)
+            for p, g in zip(self._pre_params, g_pre):
+                p._grad = g if p._grad is None else p._grad + g
+            for p, g in zip(self._stacked, g_blk):
+                p._grad = g if p._grad is None else p._grad + g
+            for p, g in zip(self._post_params, g_post):
+                p._grad = g if p._grad is None else p._grad + g
+            with _tracing.span("opt"):
+                if scaler is not None:
+                    scaler.step(optimizer)
+                    scaler.update()
+                else:
+                    optimizer.step()
+                optimizer.clear_grad()
+                if lr_scheduler is not None:
+                    lr_scheduler.step()
         return Tensor(loss, stop_gradient=True)
 
     def eval_batch(self, data, compute_loss=True):

@@ -44,6 +44,8 @@ import time
 import traceback
 
 from . import fault as _fault
+from ..observability import metrics as _obs_metrics
+from ..observability import tracing as _obs_tracing
 
 __all__ = [
     "FlightRecorder", "CollectiveDesyncError", "get_recorder", "enable",
@@ -209,6 +211,15 @@ class FlightRecorder:
         e["t_complete"] = time.time()
         e["status"] = "completed"
         self.last_completed = e
+        # the ring doubles as a metrics/trace source: each issue→complete
+        # pair feeds the per-kind×group latency histogram and (when
+        # tracing) a collective event. Both are one-None-check no-ops
+        # when the respective plane is off.
+        try:
+            _obs_metrics.observe_collective(e)
+            _obs_tracing.collective_event(e)
+        except Exception:
+            pass  # telemetry must never break a collective
 
     def entries(self):
         """Live ring contents, oldest first."""
@@ -245,6 +256,11 @@ def _load():
             cap = 0
         desync = os.environ.get("PADDLE_TPU_DESYNC_CHECK") == "1"
         if desync and cap <= 0:
+            cap = _DEFAULT_CAPACITY
+        if cap <= 0 and _obs_metrics.enabled():
+            # PADDLE_TPU_METRICS=1 alone must yield collective latency
+            # histograms: the histograms are fed from issue→complete
+            # pairs, so metrics-on implies a default-capacity recorder
             cap = _DEFAULT_CAPACITY
         _rec = FlightRecorder(capacity=cap, desync=desync) if cap > 0 \
             else None

@@ -172,16 +172,33 @@ def _spawn(args, local_rank, restart_count, extra_env=None, world_np=None):
 
 
 def _clear_dumps(log_dir):
-    """Drop flight-recorder dumps left by a previous spawn round (or a
-    previous job sharing this log dir): each round's post-mortem must
-    describe THAT round's failure, not blame a restart's crash on the
-    stale dumps of an earlier hang."""
+    """Drop flight-recorder dumps AND metrics snapshots left by a previous
+    spawn round (or a previous job sharing this log dir): each round's
+    post-mortem/run-report must describe THAT round, not blame a
+    restart's crash on the stale artifacts of an earlier incarnation."""
     import glob
-    for p in glob.glob(os.path.join(log_dir, "flight_recorder.*.json")):
-        try:
-            os.unlink(p)
-        except OSError:
-            pass
+    for pat in ("flight_recorder.*.json", "metrics.*.jsonl",
+                "trace.*.json"):
+        for p in glob.glob(os.path.join(log_dir, pat)):
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+
+
+def _run_report(log_dir):
+    """Aggregate the per-rank telemetry JSONL (PADDLE_TPU_METRICS=1
+    workers) into the one-screen cross-rank run report — slowest rank,
+    p50/p99 collective latency, MFU. Printed at round end and from the
+    failure post-mortem path; silent when no worker wrote metrics."""
+    try:
+        from ...observability import report as _report
+        text = _report.format_run_report(
+            _report.build_run_report(_report.read_rank_snapshots(log_dir)))
+    except Exception:
+        return
+    if text:
+        print(text, file=sys.stderr, flush=True)
 
 
 def _post_mortem(log_dir):
@@ -193,9 +210,12 @@ def _post_mortem(log_dir):
         from ..flight_recorder import collect_dumps, format_post_mortem
         text = format_post_mortem(collect_dumps(log_dir))
     except Exception:
-        return
+        text = None
     if text:
         print(text, file=sys.stderr, flush=True)
+    # the failure post-mortem doubles as a performance post-mortem: the
+    # last metrics snapshots often name the straggler before the hang
+    _run_report(log_dir)
 
 
 def _terminate_survivors(procs, grace):
@@ -602,6 +622,7 @@ class _NodeCoordinator:
                 self.registry.announce_complete()
                 print(f"[elastic] all {len(participants)} node(s) "
                       "finished", file=sys.stderr, flush=True)
+                _run_report(self.args.log_dir)
                 return 0
             if outcome == "preempt":
                 self.preempt_restarts += 1
@@ -823,6 +844,7 @@ def launch(argv=None):
             _terminate_survivors(procs, args.terminate_grace)
         if first_bad is None:
             print(f"[launch] all {len(procs)} worker(s) finished")
+            _run_report(args.log_dir)
             if elastic is not None:
                 try:
                     elastic.manager.complete()

@@ -63,7 +63,8 @@ def _run(kind, fn, tensor, group, sync_op, use_calc_stream, p2p=False):
         else None,
         dtype=getattr(data, "dtype", None),
         extra={"sync_op": bool(sync_op),
-               "use_calc_stream": bool(use_calc_stream)})
+               "use_calc_stream": bool(use_calc_stream),
+               "nbytes": int(getattr(data, "nbytes", 0) or 0)})
     try:
         out = fn()
     except BaseException:

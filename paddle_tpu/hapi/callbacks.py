@@ -38,18 +38,32 @@ class Callback:
 
 
 class ProgBarLogger(Callback):
+    """Reference: hapi/callbacks.py ProgBarLogger — plus throughput: every
+    log line carries ``ips`` (steps/sec) and the smoothed step time from
+    the telemetry clock (an EMA over batch-end intervals), not just the
+    loss."""
+
     def __init__(self, log_freq=1, verbose=2):
         self.log_freq = log_freq
         self.verbose = verbose
+        from ..observability.telemetry import EMATimer
+        self._timer = EMATimer()
 
     def on_epoch_begin(self, epoch, logs=None):
         self.epoch = epoch
+        # eval/checkpoint pauses at epoch boundaries are not step time
+        self._timer.reset()
 
     def on_train_batch_end(self, step, logs=None):
+        _, ema = self._timer.tick()
         if self.verbose and step % self.log_freq == 0:
+            shown = dict(logs or {})
+            if ema:
+                shown["step_ms"] = ema * 1e3
+                shown["ips"] = 1.0 / ema
             items = " - ".join(f"{k}: {v:.4f}" if isinstance(v, float)
                                else f"{k}: {v}"
-                               for k, v in (logs or {}).items())
+                               for k, v in shown.items())
             print(f"epoch {self.epoch} step {step}: {items}")
 
     def on_epoch_end(self, epoch, logs=None):

@@ -11,6 +11,8 @@ import numpy as np
 from ..core.tensor import Tensor
 from ..io import DataLoader, Dataset
 from ..jit.api import StaticFunction, to_static
+from ..observability import telemetry as _telemetry
+from ..observability import tracing as _tracing
 
 __all__ = ["Model"]
 
@@ -82,12 +84,17 @@ class Model:
         y = labels[0] if isinstance(labels, (list, tuple)) else labels
         if not update:
             # gradient accumulation: eager fwd/bwd without the staged update
-            out = self.network(x)
-            loss = self._loss(out, y)
-            loss.backward()
+            with _tracing.span("fwd"):
+                out = self.network(x)
+                loss = self._loss(out, y)
+            with _tracing.span("bwd"):
+                loss.backward()
         else:
             step = self._step_fn or self._build_step()
             loss, out = step(x, y)
+        # under async dispatch the fetch below is where the host really
+        # waits for the device: telemetry splits it out as sync time
+        _telemetry.mark_sync_begin()
         metrics = [float(loss.numpy())]
         for m in self._metrics:
             self._update_metric(m, out, y)
@@ -169,6 +176,15 @@ class Model:
         cbs = _as_list(callbacks)
         if verbose and not any(isinstance(c, ProgBarLogger) for c in cbs):
             cbs.append(ProgBarLogger(log_freq, verbose))
+        # per-step telemetry (PADDLE_TPU_METRICS=1): step-time breakdown,
+        # tokens/sec and MFU into the metrics registry; attach a
+        # TelemetryCallback yourself to override flops/tokens
+        tm = next((c for c in cbs
+                   if isinstance(c, _telemetry.TelemetryCallback)), None)
+        if tm is None:
+            tm = _telemetry.maybe_telemetry_callback()
+            if tm is not None:
+                cbs.append(tm)
         for c in cbs:
             c.set_model(self)
         loader = self._loader(train_data, batch_size, shuffle,
@@ -210,6 +226,10 @@ class Model:
                     if rt is not None:
                         rt.poll_preempt(epoch, step)
                     x, y = batch[0], batch[1]
+                    if tm is not None:
+                        tm.batch_ready(x)  # data wait ends here
+                    for c in cbs:
+                        c.on_train_batch_begin(step)
                     loss = self.train_batch(x, y)
                     epoch_losses.append(loss)
                     logs = {"loss": loss}
@@ -224,6 +244,10 @@ class Model:
                         except TypeError:  # unsized iterable loader
                             last = False
                         rt.step_done(epoch, step, defer_to_epoch=last)
+                        if tm is not None:
+                            # a sync interval snapshot must not read as
+                            # data wait in the next step's split
+                            tm.note_pause()
                 if not epoch_losses:
                     if rt is not None and epoch == rt.epoch \
                             and rt.step_in_epoch > 0:
@@ -259,6 +283,15 @@ class Model:
                 except Exception:
                     pass  # never mask the training error
             raise
+        finally:
+            if tm is not None:
+                # the error path must clear the module-global telemetry
+                # clock and flush the last window too (idempotent: the
+                # success path's on_train_end below becomes a no-op)
+                try:
+                    tm.on_train_end()
+                except Exception:
+                    pass
         for c in cbs:
             c.on_train_end()
         if rt is not None:

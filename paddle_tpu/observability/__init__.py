@@ -1,0 +1,31 @@
+"""paddle_tpu.observability — run telemetry for real training jobs.
+
+Four small, stdlib-only-at-import pieces:
+
+* :mod:`.metrics` — env-gated (``PADDLE_TPU_METRICS=1``) Counter/Gauge/
+  Histogram registry with per-rank JSONL snapshots in the workerlog dir.
+* :mod:`.telemetry` — per-step clock threaded through ``hapi.Model.fit``
+  / ``Engine.fit``: step-time breakdown (data-wait/compute/sync),
+  tokens/sec, MFU estimate.
+* :mod:`.tracing` — ``span("fwd")`` host spans + flight-recorder
+  collective events exported as Chrome-trace/Perfetto JSON
+  (``PADDLE_TPU_TRACE=1``), mergeable with the xplane device timeline
+  via ``python -m paddle_tpu.tools.merge_profiles``.
+* :mod:`.report` — launcher-side aggregation of the per-rank JSONL into
+  a one-screen cross-rank run report (slowest rank, p50/p99 collective
+  latency, comm/compute, MFU).
+
+Disabled (the default), every hook in the hot paths is a constant-time
+no-op — asserted by tests the same way as the flight recorder's disabled
+path.
+"""
+from . import metrics  # noqa: F401
+from . import report  # noqa: F401
+from . import telemetry  # noqa: F401
+from . import tracing  # noqa: F401
+from .metrics import MetricsRegistry, get_registry  # noqa: F401
+from .telemetry import TelemetryCallback  # noqa: F401
+from .tracing import span  # noqa: F401
+
+__all__ = ["metrics", "telemetry", "tracing", "report",
+           "MetricsRegistry", "TelemetryCallback", "get_registry", "span"]

@@ -1,0 +1,261 @@
+"""Cross-rank run report — the launcher's performance post-mortem.
+
+Reads the per-rank ``metrics.<rank>.jsonl`` snapshot files the metrics
+registry writes into the workerlog dir and renders a one-screen report:
+per-rank step time / data wait / tokens/sec / MFU, the slowest rank (and
+how many snapshot windows each rank was the straggler of — a rank that is
+slowest in every window is degrading hardware, one that is slowest once
+hit a GC pause), p50/p99 per-collective latency and the comm/compute
+ratio. The launcher prints it at round end AND from the failure
+post-mortem path, so the PR-4 node coordinator doubles as a live
+straggler detector.
+
+Also a CLI::
+
+    python -m paddle_tpu.observability.report <log_dir>
+
+Stdlib-only — the launcher imports this without loading jax.
+"""
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+
+from .metrics import hist_mean, hist_quantile, parse_metric_key
+
+__all__ = ["read_rank_snapshots", "build_run_report", "format_run_report",
+           "main"]
+
+
+def read_rank_snapshots(log_dir):
+    """-> {rank: [snapshot dict, ...]} from metrics.*.jsonl under
+    ``log_dir`` (unparseable lines are skipped, not fatal: a worker
+    killed mid-write leaves a torn last line)."""
+    out = {}
+    for p in sorted(glob.glob(os.path.join(log_dir, "metrics.*.jsonl"))):
+        try:
+            rank = int(os.path.basename(p).split(".")[1])
+        except (IndexError, ValueError):
+            continue
+        snaps = []
+        try:
+            with open(p) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        snaps.append(json.loads(line))
+                    except ValueError:
+                        pass
+        except OSError:
+            continue
+        if snaps:
+            out[rank] = snaps
+    return out
+
+
+def _merge_hist(a, b):
+    if a is None:
+        return dict(b)
+    if list(a.get("bounds", [])) != list(b.get("bounds", [])):
+        return a  # mismatched layouts: keep the first
+    a["counts"] = [x + y for x, y in zip(a["counts"], b["counts"])]
+    a["count"] = a.get("count", 0) + b.get("count", 0)
+    a["sum"] = a.get("sum", 0.0) + b.get("sum", 0.0)
+    for k, f in (("min", min), ("max", max)):
+        if b.get(k) is not None:
+            a[k] = b[k] if a.get(k) is None else f(a[k], b[k])
+    return a
+
+
+def _hist_delta(new, old):
+    """Window histogram between two cumulative snapshots of one rank."""
+    if old is None:
+        return dict(new)
+    if list(new.get("bounds", [])) != list(old.get("bounds", [])):
+        return dict(new)
+    return {"bounds": new["bounds"],
+            "counts": [n - o for n, o in zip(new["counts"],
+                                            old["counts"])],
+            "count": new.get("count", 0) - old.get("count", 0),
+            "sum": new.get("sum", 0.0) - old.get("sum", 0.0),
+            "min": new.get("min"), "max": new.get("max")}
+
+
+def build_run_report(per_rank):
+    """Aggregate per-rank snapshot lists into one report dict."""
+    ranks = {}
+    collectives = {}
+    straggler_windows = {}
+    compute_ms_total = 0.0
+    comm_us_total = 0.0
+    overlap_pcts = []
+    for rank, snaps in sorted(per_rank.items()):
+        last = snaps[-1]
+        hists = last.get("histograms", {})
+        gauges = last.get("gauges", {})
+        counters = last.get("counters", {})
+        st = hists.get("step_time_ms")
+        row = {"snapshots": len(snaps),
+               "steps": counters.get("steps_total", 0)}
+        if st:
+            row["step_ms_mean"] = hist_mean(st)
+            row["step_ms_p50"] = hist_quantile(st, 0.5)
+            row["step_ms_p99"] = hist_quantile(st, 0.99)
+        dw = hists.get("data_wait_ms")
+        if dw:
+            row["data_wait_ms_mean"] = hist_mean(dw)
+        cm = hists.get("compute_ms")
+        if cm:
+            compute_ms_total += cm.get("sum", 0.0)
+        for key in ("tokens_per_sec", "mfu_pct"):
+            if key in gauges:
+                row[key] = gauges[key]
+        if "comm_overlap_pct" in gauges:
+            overlap_pcts.append(gauges["comm_overlap_pct"])
+        ranks[rank] = row
+        # per-collective latency, merged across ranks. Store-backed
+        # control-plane waits (TCPStore commit barriers group="store",
+        # gloo barriers group="gloo", object collectives group="object"
+        # — blocking store rendezvous, not wire transfer) stay in the
+        # table — operators should see them — but are EXCLUDED from the
+        # comm total: one store-long checkpoint barrier would otherwise
+        # read as seconds of "communication"
+        for key, h in hists.items():
+            name, labels = parse_metric_key(key)
+            if name != "collective_latency_us":
+                continue
+            group = labels.get("group", "?")
+            ckey = (labels.get("kind", "?"), group)
+            collectives[ckey] = _merge_hist(collectives.get(ckey), h)
+            if group not in ("store", "gloo", "object"):
+                comm_us_total += h.get("sum", 0.0)
+        # straggler windows: mean step time per inter-snapshot window.
+        # Windows are aligned by snapshot INDEX, which assumes ranks
+        # flush on the same cadence (true under the interval flusher /
+        # step-count flush of a symmetric SPMD job); a rank with extra
+        # flushes shifts its later windows — the per-window attribution
+        # is a heuristic, the whole-run slowest_rank above is not.
+        prev = None
+        for i, snap in enumerate(snaps):
+            h = snap.get("histograms", {}).get("step_time_ms")
+            if h is None:
+                continue
+            win = _hist_delta(h, prev)
+            prev = h
+            m = hist_mean(win)
+            if m is not None:
+                straggler_windows.setdefault(i, {})[rank] = m
+
+    slowest = None
+    with_steps = {r: row for r, row in ranks.items()
+                  if row.get("step_ms_mean") is not None}
+    if len(with_steps) >= 1:
+        slowest = max(with_steps, key=lambda r:
+                      with_steps[r]["step_ms_mean"])
+    straggler_counts = {}
+    for _, by_rank in straggler_windows.items():
+        if len(by_rank) < 2:
+            continue
+        worst = max(by_rank, key=lambda r: by_rank[r])
+        straggler_counts[worst] = straggler_counts.get(worst, 0) + 1
+
+    coll_rows = {}
+    for (kind, group), h in sorted(collectives.items()):
+        coll_rows[f"{kind}|{group}"] = {
+            "count": h.get("count", 0),
+            "mean_us": hist_mean(h),
+            "p50_us": hist_quantile(h, 0.5),
+            "p99_us": hist_quantile(h, 0.99),
+        }
+
+    report = {"ranks": ranks, "slowest_rank": slowest,
+              "straggler_windows": straggler_counts,
+              "collectives": coll_rows}
+    if compute_ms_total > 0:
+        # host-visible (non-hidden) collective time vs compute time; the
+        # device-truth overlap gauge (xplane-derived) wins when present
+        report["comm_ms_total"] = comm_us_total / 1e3
+        report["compute_ms_total"] = compute_ms_total
+        report["comm_vs_compute_pct"] = (
+            100.0 * (comm_us_total / 1e3) / compute_ms_total)
+    if overlap_pcts:
+        report["comm_overlap_pct"] = sum(overlap_pcts) / len(overlap_pcts)
+    return report
+
+
+def _fmt(v, nd=1):
+    return "-" if v is None else f"{v:.{nd}f}"
+
+
+def format_run_report(report):
+    """One-screen text rendering; None when there is nothing to say."""
+    ranks = report.get("ranks") or {}
+    if not ranks:
+        return None
+    lines = [f"[telemetry] run report ({len(ranks)} rank(s)):"]
+    lines.append("[telemetry]   rank  steps  step_ms(mean/p50/p99)  "
+                 "data_wait_ms  tok/s     mfu%")
+    for rank, row in sorted(ranks.items()):
+        triple = "/".join(_fmt(row.get(k)) for k in
+                          ("step_ms_mean", "step_ms_p50", "step_ms_p99"))
+        lines.append(
+            "[telemetry]   %-5d %-6d %-22s %-12s %-9s %s" % (
+                rank, row.get("steps", 0), triple,
+                _fmt(row.get("data_wait_ms_mean"), 2),
+                _fmt(row.get("tokens_per_sec"), 0),
+                _fmt(row.get("mfu_pct"), 2)))
+    slowest = report.get("slowest_rank")
+    if slowest is not None and len(ranks) > 1:
+        row = ranks[slowest]
+        wins = report.get("straggler_windows", {}).get(slowest, 0)
+        lines.append(
+            f"[telemetry] slowest rank {slowest}: mean step "
+            f"{_fmt(row.get('step_ms_mean'))} ms"
+            + (f", straggler in {wins} window(s)" if wins else ""))
+    colls = report.get("collectives") or {}
+    if colls:
+        lines.append("[telemetry]   collective latency (us): "
+                     "count  p50  p99")
+        for key, row in sorted(colls.items()):
+            lines.append(
+                "[telemetry]     %-36s %-6d %-8s %s" % (
+                    key, row.get("count", 0), _fmt(row.get("p50_us")),
+                    _fmt(row.get("p99_us"))))
+    if report.get("comm_overlap_pct") is not None:
+        lines.append(f"[telemetry] comm/compute overlap: "
+                     f"{report['comm_overlap_pct']:.1f}% (device timeline)")
+    elif report.get("comm_vs_compute_pct") is not None:
+        lines.append(
+            f"[telemetry] host-visible comm vs compute: "
+            f"{report['comm_vs_compute_pct']:.1f}% "
+            f"({report['comm_ms_total']:.1f} / "
+            f"{report['compute_ms_total']:.1f} ms)")
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help"):
+        print("usage: python -m paddle_tpu.observability.report "
+              "<log_dir> [--json]", file=sys.stderr)
+        return 2
+    log_dir = argv[0]
+    report = build_run_report(read_rank_snapshots(log_dir))
+    if "--json" in argv:
+        print(json.dumps(report, indent=1, default=str))
+        return 0
+    text = format_run_report(report)
+    if text is None:
+        print(f"[telemetry] no metrics snapshots under {log_dir}",
+              file=sys.stderr)
+        return 1
+    print(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,224 @@
+"""Host-side span tracing — Chrome-trace/Perfetto JSON export.
+
+The xplane trace answers "what did the DEVICE do"; this module answers
+"what did the HOST do around it": ``span("fwd")`` context managers in the
+fit/pipeline paths become ``ph: "X"`` complete events, completed
+flight-recorder collectives become ``cat: "collective"`` events, and the
+export loads directly in chrome://tracing / ui.perfetto.dev. Merge with a
+device timeline via ``python -m paddle_tpu.tools.merge_profiles`` (which
+also accepts xplane log dirs).
+
+Gating mirrors the metrics core: ``PADDLE_TPU_TRACE=1`` (export path from
+``PADDLE_TPU_TRACE_PATH``, default ``trace.<rank>.json`` under
+``PADDLE_TPU_WORKERLOG_DIR``; ``PADDLE_TPU_TRACE=/path.json`` sets both),
+or programmatic :func:`start` / :func:`stop`. Disabled (the default),
+``span()`` yields immediately off one module-global ``None`` check and
+event feeds return without allocating.
+
+Timestamps are ``time.time()`` µs — the same wall clock the flight
+recorder stamps, so collective events and spans line up in one timeline.
+Nesting needs no explicit parent ids: Perfetto nests same-thread "X"
+events by interval containment.
+
+Stdlib-only at import time.
+"""
+from __future__ import annotations
+
+import atexit
+import contextlib
+import json
+import os
+import sys
+import threading
+import time
+
+__all__ = ["TraceBuffer", "span", "add_complete", "collective_event",
+           "enabled", "get_buffer", "start", "stop", "export",
+           "_reset_state"]
+
+_MAX_EVENTS = 200_000  # runaway guard: ~40MB of JSON at most
+
+
+class TraceBuffer:
+    """Append-only buffer of chrome-trace events for ONE process."""
+
+    def __init__(self, rank=None, path=None):
+        from .metrics import env_rank
+        self.rank = env_rank() if rank is None else int(rank)
+        self.path = path
+        self.events = []
+        self._lock = threading.Lock()
+        self.dropped = 0
+
+    def add(self, name, ts_s, dur_s, cat="host", tid=None, args=None):
+        ev = {"name": str(name), "ph": "X", "pid": self.rank,
+              "tid": tid if tid is not None else threading.get_ident(),
+              "ts": ts_s * 1e6, "dur": max(0.0, dur_s) * 1e6, "cat": cat}
+        if args:
+            ev["args"] = dict(args)
+        with self._lock:
+            if len(self.events) >= _MAX_EVENTS:
+                self.dropped += 1
+                return
+            self.events.append(ev)
+
+    def to_dict(self):
+        with self._lock:
+            events = list(self.events)
+            dropped = self.dropped
+        meta = [{"name": "process_name", "ph": "M", "pid": self.rank,
+                 "args": {"name": f"rank_{self.rank} host"}}]
+        d = {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+        if dropped:
+            d["droppedEvents"] = dropped
+        return d
+
+    def export(self, path=None):
+        path = path or self.path
+        if not path:
+            return None
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(self.to_dict(), f)
+        return path
+
+
+# ------------------------------------------------- module-level singleton
+
+_state_lock = threading.Lock()
+_TR: TraceBuffer | None = None
+_loaded = False
+_atexit_armed = False
+
+
+def _default_path(rank):
+    d = os.environ.get("PADDLE_TPU_WORKERLOG_DIR") or "."
+    return os.path.join(d, f"trace.{rank}.json")
+
+
+def _load():
+    global _TR, _loaded
+    with _state_lock:
+        if _loaded:
+            return _TR
+        raw = os.environ.get("PADDLE_TPU_TRACE", "")
+        if raw in ("", "0", "false", "False"):
+            _TR = None
+        else:
+            buf = TraceBuffer()
+            if raw not in ("1", "true", "True"):
+                buf.path = raw  # PADDLE_TPU_TRACE=/path.json
+            else:
+                buf.path = (os.environ.get("PADDLE_TPU_TRACE_PATH")
+                            or _default_path(buf.rank))
+            _TR = buf
+            _arm_atexit()
+        _loaded = True
+        return _TR
+
+
+def _arm_atexit():
+    global _atexit_armed
+    if not _atexit_armed:
+        _atexit_armed = True
+        atexit.register(_atexit_export)
+
+
+def _atexit_export():
+    buf = _TR
+    if buf is not None and buf.path:
+        try:
+            buf.export()
+        except Exception:
+            pass
+
+
+def get_buffer() -> TraceBuffer | None:
+    return _TR if _loaded else _load()
+
+
+def enabled() -> bool:
+    return get_buffer() is not None
+
+
+def start(path=None, rank=None) -> TraceBuffer:
+    """Programmatic gate (tests / bench) — replaces the singleton."""
+    global _TR, _loaded
+    with _state_lock:
+        _TR = TraceBuffer(rank=rank, path=path)
+        _loaded = True
+        _arm_atexit()
+        return _TR
+
+
+def stop(path=None):
+    """Export (when a path is known) and disable; returns the path."""
+    global _TR, _loaded
+    with _state_lock:
+        buf = _TR
+        _TR = None
+        _loaded = True
+    if buf is None:
+        return None
+    try:
+        return buf.export(path)
+    except Exception as e:
+        print(f"[trace] export failed: {e}", file=sys.stderr, flush=True)
+        return None
+
+
+def export(path=None):
+    buf = _TR if _loaded else _load()
+    return buf.export(path) if buf is not None else None
+
+
+def _reset_state():
+    """Test hook: back to the unresolved env-gated state."""
+    global _TR, _loaded
+    with _state_lock:
+        _TR = None
+        _loaded = False
+
+
+# ------------------------------------------------------------------ feeds
+
+@contextlib.contextmanager
+def span(name, cat="host", **args):
+    """Trace one host scope; a constant-time no-op when tracing is off."""
+    buf = _TR if _loaded else _load()
+    if buf is None:
+        yield None
+        return
+    t0 = time.time()
+    try:
+        yield buf
+    finally:
+        buf.add(name, t0, time.time() - t0, cat=cat, args=args or None)
+
+
+def add_complete(name, ts_s, dur_s, cat="host", tid=None, args=None):
+    buf = _TR if _loaded else _load()
+    if buf is not None:
+        buf.add(name, ts_s, dur_s, cat=cat, tid=tid, args=args)
+
+
+def collective_event(entry):
+    """Feed one completed flight-recorder entry as a trace event. Ring
+    bookkeeping markers (``step`` group) are skipped; pipeline
+    micro-batch entries keep their own category so the collective lane
+    stays collectives-only."""
+    buf = _TR if _loaded else _load()
+    if buf is None or entry is None:
+        return
+    group = entry.get("group")
+    if group == "step":
+        return
+    t0, t1 = entry.get("t_issue"), entry.get("t_complete")
+    if t0 is None or t1 is None:
+        return
+    cat = "pipeline" if group == "pipe" else "collective"
+    args = {"group": group, "seq": entry.get("seq"),
+            "gseq": entry.get("gseq")}
+    if entry.get("shape") is not None:
+        args["shape"] = str(entry["shape"])
+    buf.add(entry.get("kind", "?"), t0, t1 - t0, cat=cat, args=args)

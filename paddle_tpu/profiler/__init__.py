@@ -193,6 +193,42 @@ class Profiler:
                 f"p50: {np.percentile(arr, 50)*1e3:.2f} ms  "
                 f"max: {arr.max()*1e3:.2f} ms")
 
+    # -- memory timeline, public surface (ISSUE satellite: _OpTracer
+    # collected these but nothing machine-readable surfaced them) --
+    @property
+    def peak_bytes(self):
+        """Peak tracked live allocation bytes (profile_memory=True)."""
+        return self._op_tracer.peak_bytes
+
+    @property
+    def live_bytes(self):
+        """Currently tracked live allocation bytes."""
+        return self._op_tracer.live_bytes
+
+    def summary_dict(self):
+        """Machine-readable companion of :meth:`summary`: op table plus
+        the memory timeline peaks (``peak_bytes`` / ``live_bytes`` — the
+        host-side accounting; device HBM peaks ride the per-step rows
+        when the runtime exposes memory_stats)."""
+        t = self._op_tracer
+        out = {
+            "peak_bytes": t.peak_bytes,
+            "live_bytes": t.live_bytes,
+            "mem_events": len(t.mem_events),
+            "mem_table": dict(t.mem_table),
+            "op_table": {name: {"total_s": total, "calls": count,
+                                "max_s": mx}
+                         for name, (total, count, mx)
+                         in t.op_table().items()},
+            "steps": len(self._step_times),
+        }
+        if self._step_times:
+            out["avg_step_ms"] = (sum(self._step_times)
+                                  / len(self._step_times) * 1e3)
+        if self._step_device_mem:
+            out["device_mem"] = list(self._step_device_mem)
+        return out
+
     def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
                 time_unit="ms"):
         lines = ["---- paddle_tpu profiler summary ----"]
@@ -293,20 +329,26 @@ def load_profiler_result(path):
         "viewed with TensorBoard instead")
 
 
-def merge_profiler_results(paths, out_path=None):
+def merge_profiler_results(paths, out_path=None, labels=None):
     """Multi-rank trace merge (reference: CrossStackProfiler — the
     multi-node profiler aggregation tool). Each input chrome trace (one
-    per rank, as exported by Profiler.export on that rank) lands on its
-    own pid lane, labeled rank_N; a process_name metadata event names the
-    lane. Returns the merged dict (and writes it when out_path given)."""
+    per rank, as exported by Profiler.export on that rank, or a host-span
+    export from observability.tracing, or an xplane-derived device trace)
+    lands on its own pid lane, labeled ``labels[i]`` (default rank_N); a
+    process_name metadata event names the lane. Returns the merged dict
+    (and writes it when out_path given)."""
     merged = {"traceEvents": [], "displayTimeUnit": "ms"}
     for rank, p in enumerate(paths):
         d = p if isinstance(p, dict) else load_profiler_result(p)
+        label = labels[rank] if labels and rank < len(labels) \
+            else f"rank_{rank}"
         merged["traceEvents"].append({
             "name": "process_name", "ph": "M", "pid": rank,
-            "args": {"name": f"rank_{rank}"}})
+            "args": {"name": label}})
         for ev in d.get("traceEvents", []):
             ev = dict(ev)
+            if ev.get("ph") == "M" and ev.get("name") == "process_name":
+                continue  # the input's own lane label: superseded
             ev["pid"] = rank
             merged["traceEvents"].append(ev)
     if out_path:

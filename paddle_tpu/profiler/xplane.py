@@ -13,7 +13,7 @@ from __future__ import annotations
 import glob
 import os
 
-__all__ = ["parse_xplane", "comm_compute_breakdown"]
+__all__ = ["parse_xplane", "comm_compute_breakdown", "to_chrome_trace"]
 
 _COMM_MARKERS = ("all-reduce", "all-gather", "reduce-scatter",
                  "collective-permute", "all-to-all", "psum",
@@ -91,6 +91,32 @@ def _intersect(a, b):
         else:
             j += 1
     return out
+
+
+def to_chrome_trace(path_or_logdir, pid=0, label="device"):
+    """Convert the device-execution lines of an xplane trace into a
+    chrome-trace dict, mergeable with the host-span export of
+    :mod:`paddle_tpu.observability.tracing` via
+    ``python -m paddle_tpu.tools.merge_profiles`` (which accepts xplane
+    log dirs directly). Each device line becomes a tid lane; comm ops are
+    categorized ``collective`` so they share a color with the host-side
+    collective events."""
+    events = parse_xplane(path_or_logdir)
+    tids = {}
+    out = [{"name": "process_name", "ph": "M", "pid": pid,
+            "args": {"name": label}}]
+    for line_name, name, start_ps, dur_ps in events:
+        tid = tids.setdefault(line_name, len(tids))
+        lo = name.lower()
+        cat = "collective" if any(m in lo for m in _COMM_MARKERS) \
+            else "device"
+        out.append({"name": name, "ph": "X", "pid": pid, "tid": tid,
+                    "ts": start_ps / 1e6, "dur": dur_ps / 1e6,
+                    "cat": cat})
+    for line_name, tid in tids.items():
+        out.append({"name": "thread_name", "ph": "M", "pid": pid,
+                    "tid": tid, "args": {"name": line_name}})
+    return {"traceEvents": out, "displayTimeUnit": "ms"}
 
 
 def comm_compute_breakdown(path_or_logdir):

@@ -1,24 +1,48 @@
-"""Multi-rank profile merger CLI (reference: tools/CrossStackProfiler —
-merges per-node timelines into one chrome trace).
+"""Multi-rank / host+device profile merger CLI (reference:
+tools/CrossStackProfiler — merges per-node timelines into one chrome
+trace).
 
-    python -m paddle_tpu.tools.merge_profiles rank0.json rank1.json \
+Inputs may be chrome-trace JSON files (a rank's ``Profiler.export`` or an
+``observability.tracing`` host-span export) OR xplane log directories
+(``jax.profiler`` trace dirs) — the latter are converted device-side via
+``profiler.xplane.to_chrome_trace``, so one merged timeline shows host
+spans (step/fwd/bwd/opt/collective) above the device execution lanes::
+
+    python -m paddle_tpu.tools.merge_profiles trace.0.json /tmp/xplane_dir \
         -o merged.json
 """
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 
-__all__ = ["main"]
+__all__ = ["main", "load_input"]
+
+
+def load_input(path):
+    """-> (chrome-trace dict, lane label) for a JSON file or xplane dir."""
+    if os.path.isdir(path):
+        from ..profiler.xplane import to_chrome_trace
+        base = os.path.basename(os.path.normpath(path))
+        return (to_chrome_trace(path, label=f"device:{base}"),
+                f"device:{base}")
+    from ..profiler import load_profiler_result
+    return load_profiler_result(path), os.path.basename(path)
 
 
 def main(argv=None):
     ap = argparse.ArgumentParser(prog="paddle_tpu.tools.merge_profiles")
-    ap.add_argument("traces", nargs="+", help="per-rank chrome traces")
+    ap.add_argument("traces", nargs="+",
+                    help="per-rank chrome traces (.json) and/or xplane "
+                         "log directories")
     ap.add_argument("-o", "--out", required=True)
     args = ap.parse_args(argv)
     from ..profiler import merge_profiler_results
-    merged = merge_profiler_results(args.traces, out_path=args.out)
+    loaded = [load_input(p) for p in args.traces]
+    merged = merge_profiler_results([d for d, _ in loaded],
+                                    out_path=args.out,
+                                    labels=[l for _, l in loaded])
     print(f"merged {len(args.traces)} traces -> {args.out} "
           f"({len(merged['traceEvents'])} events)")
     return 0

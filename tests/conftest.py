@@ -62,6 +62,15 @@ def _seed():
     # env-gated default so an enabled recorder/desync mode can't leak
     from paddle_tpu.distributed import flight_recorder as _flight
     _flight._reset_state()
+    # same for the observability planes (metrics registry, trace buffer):
+    # a test that enables them must not leak histograms/spans into — or
+    # slow down — its successors
+    from paddle_tpu.observability import metrics as _obs_metrics
+    from paddle_tpu.observability import telemetry as _obs_telemetry
+    from paddle_tpu.observability import tracing as _obs_tracing
+    _obs_metrics._reset_state()
+    _obs_tracing._reset_state()
+    _obs_telemetry._active = None
     if os.environ.get("PADDLE_TPU_FAULTS") != saved_fault_env:
         if saved_fault_env is None:
             os.environ.pop("PADDLE_TPU_FAULTS", None)

@@ -1,0 +1,97 @@
+"""Telemetry smoke worker (tests/test_observability.py launcher smoke).
+
+Runs a tiny deterministic ``hapi.Model.fit`` with the metrics plane on
+(the test env sets ``PADDLE_TPU_METRICS=1``) plus a few eager collectives
+per epoch, so every rank writes a parseable ``metrics.<rank>.jsonl`` into
+the launcher's workerlog dir with step_time_ms / data_wait_ms /
+tokens_per_sec / mfu_pct and per-collective latency histograms — the
+input of the launcher's cross-rank run report.
+
+Ranks stay process-LOCAL on purpose (the coordinator env is dropped
+before any jax collective): the smoke must exercise the telemetry plane
+and the aggregation, not multi-controller gloo bring-up, so it stays
+inside the tier-1 budget. ``PADDLE_TPU_TM_SLEEP_RANK=<r>:<ms>`` makes
+rank r sleep that long per step — the deterministic straggler the report
+must name.
+
+Markers on stdout: ``TM_DONE <steps>`` on success.
+"""
+import os
+import sys
+import time
+
+# stay single-process: each rank runs its own 1-device CPU world (rank
+# identity for metrics/logs still comes from PADDLE_TPU_PROCESS_ID)
+os.environ.pop("PADDLE_TPU_COORDINATOR", None)
+os.environ.pop("PADDLE_TPU_NUM_PROCESSES", None)
+os.environ.pop("PADDLE_TPU_ELASTIC_JOB_ID", None)
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=1")
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.distributed as dist
+from paddle_tpu.hapi.callbacks import Callback
+from paddle_tpu.io import Dataset
+
+RANK = int(os.environ.get("PADDLE_TPU_PROCESS_ID", "0"))
+
+
+class _Straggle(Callback):
+    def __init__(self):
+        spec = os.environ.get("PADDLE_TPU_TM_SLEEP_RANK", "")
+        self.sleep_s = 0.0
+        if spec:
+            r, _, ms = spec.partition(":")
+            if int(r) == RANK:
+                self.sleep_s = float(ms or 20) / 1e3
+
+    def on_train_batch_begin(self, step, logs=None):
+        if self.sleep_s:
+            time.sleep(self.sleep_s)
+
+
+def main():
+    n_batches = int(os.environ.get("PADDLE_TPU_TM_BATCHES", "6"))
+    epochs = int(os.environ.get("PADDLE_TPU_TM_EPOCHS", "2"))
+
+    paddle.seed(0)
+    X = np.random.RandomState(42).randn(n_batches * 4, 16).astype("float32")
+    Y = X @ np.random.RandomState(7).randn(16, 4).astype("float32")
+
+    class DS(Dataset):
+        def __getitem__(self, i):
+            return X[i], Y[i]
+
+        def __len__(self):
+            return len(X)
+
+    net = nn.Linear(16, 4)
+    model = paddle.Model(net)
+    opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                parameters=net.parameters())
+    model.prepare(optimizer=opt, loss=nn.MSELoss())
+    model.fit(DS(), batch_size=4, epochs=epochs, shuffle=False, verbose=0,
+              callbacks=[_Straggle()])
+
+    # a couple of eager collectives (1-device world): their issue→complete
+    # latency lands in the per-kind histograms
+    t = paddle.to_tensor(np.ones((1, 4), "float32"))
+    for _ in range(3):
+        dist.all_reduce(t)
+    dist.barrier()
+
+    from paddle_tpu.observability import metrics
+    reg = metrics.get_registry()
+    assert reg is not None, "worker expected PADDLE_TPU_METRICS=1"
+    reg.flush()
+    print(f"TM_DONE {epochs * n_batches}", flush=True)
+    sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

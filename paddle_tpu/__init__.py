@@ -57,6 +57,7 @@ from . import distributed  # noqa: F401
 from . import distribution  # noqa: F401
 from . import hapi  # noqa: F401
 from . import observability  # noqa: F401
+from . import serving  # noqa: F401
 from . import metric  # noqa: F401
 from . import models  # noqa: F401
 from . import profiler  # noqa: F401

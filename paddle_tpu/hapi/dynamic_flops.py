@@ -81,6 +81,15 @@ def flops(net, input_size, custom_ops=None, print_detail=False):
         if fn is not None and not list(layer.children()):
             hooks.append(layer.register_forward_post_hook(
                 mk(name, layer, fn)))
+    if not hooks:
+        # bare-layer model: named_sublayers never yields the net itself,
+        # so a plain nn.Linear used as the whole network counted 0 (and
+        # telemetry read MFU=0). Hook the net when it is itself a leaf
+        # with a table entry.
+        fn = table.get(type(net).__name__)
+        if fn is not None and not list(net.children()):
+            hooks.append(net.register_forward_post_hook(
+                mk("net", net, fn)))
     was_training = net.training
     net.eval()
     try:

@@ -17,7 +17,19 @@ from .core.tensor import Tensor
 from .jit.save_load import load as _jit_load
 
 __all__ = ["Config", "Predictor", "create_predictor", "PredictorTensor",
-           "BatchingPredictor"]
+           "BatchingPredictor", "pick_bucket"]
+
+
+def pick_bucket(n, buckets):
+    """Smallest bucket >= n (the largest bucket when none fits) — ONE copy
+    of the pad-to-bucket rule, shared by :class:`BatchingPredictor` (batch
+    dim) and the serving engine's prefill (batch AND sequence dims): a
+    small bucket set keeps XLA's compile cache bounded while filling the
+    padded shape."""
+    for b in buckets:
+        if b >= n:
+            return b
+    return buckets[-1]
 
 
 class Config:
@@ -170,14 +182,16 @@ class BatchingPredictor:
         self._wait_s = max_wait_ms / 1e3
         self._q: "queue.Queue" = queue.Queue()
         self._stop = False
+        self._closed = False
+        # guards the closed-check+enqueue vs close's drain: without it a
+        # predict() preempted between the check and the put could enqueue
+        # into an already-drained queue and hang to its own timeout
+        self._close_lock = threading.Lock()
         self._worker = threading.Thread(target=self._loop, daemon=True)
         self._worker.start()
 
     def _bucket(self, n):
-        for b in self._buckets:
-            if b >= n:
-                return b
-        return self._buckets[-1]
+        return pick_bucket(n, self._buckets)
 
     def _loop(self):
         import queue
@@ -222,7 +236,10 @@ class BatchingPredictor:
         """Submit ONE example (no batch dim); blocks for the result."""
         import threading
         fut = {"event": threading.Event(), "result": None, "error": None}
-        self._q.put((example, fut))
+        with self._close_lock:
+            if self._closed:
+                raise RuntimeError("BatchingPredictor is closed")
+            self._q.put((example, fut))
         if not fut["event"].wait(timeout):
             raise TimeoutError("BatchingPredictor request timed out")
         if fut["error"] is not None:
@@ -230,6 +247,33 @@ class BatchingPredictor:
         res = fut["result"]
         return res[0] if len(res) == 1 else res
 
-    def close(self):
+    def close(self, timeout=5.0):
+        """Stop the worker and FAIL anything still queued. Before this
+        fix teardown leaked the daemon thread and silently dropped
+        in-flight requests: a waiter blocked in ``predict`` hung until
+        its own timeout with no cause. Now the worker drains its current
+        batch, queued futures get a ``RuntimeError``, and later
+        ``predict`` calls fail fast. Idempotent; also the context-manager
+        exit (``with BatchingPredictor(p) as bp: ...``)."""
+        import queue
+        with self._close_lock:
+            if self._closed:
+                return
+            self._closed = True
         self._stop = True
-        self._worker.join(timeout=2.0)
+        self._worker.join(timeout=timeout)
+        while True:
+            try:
+                _, fut = self._q.get_nowait()
+            except queue.Empty:
+                break
+            fut["error"] = RuntimeError(
+                "BatchingPredictor closed before the request ran")
+            fut["event"].set()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

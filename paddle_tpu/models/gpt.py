@@ -87,6 +87,35 @@ def _ids_write(buf, new, col):
     return apply("ids_write", fwd, [buf, new, col])
 
 
+def _pool_write(pool, new, block_tables, positions):
+    """Serving decode: scatter one token's K or V per row (`new`
+    [B, 1, H, Dh]) into the shared page pool [P, page, H, Dh] at each
+    row's (block_tables[b, pos // page], pos % page). Inactive slots
+    carry pos 0 + an all-scrap block table, so their write lands on the
+    reserved scrap page (never read)."""
+    def fwd(p, n, bt, pos):
+        page = p.shape[1]
+        idx = pos.astype(jnp.int32)
+        phys = jnp.take_along_axis(
+            bt.astype(jnp.int32), (idx // page)[:, None], axis=1)[:, 0]
+        return p.at[phys, idx % page].set(n[:, 0].astype(p.dtype))
+    return apply("paged_kv_write", fwd, [pool, new, block_tables,
+                                         positions])
+
+
+def _paged_attend(q, k_pool, v_pool, block_tables, positions, impl):
+    """Paged attention over the pool for query `q` [B, 1, H, Dh]; the
+    context length per row is positions + 1 (the query token's own KV was
+    just written). `impl` runs on raw arrays (the serving tier injects
+    the sharded / Pallas-gated variant)."""
+    def fwd(qa, ka, va, bta, pos):
+        out = impl(qa[:, 0], ka, va, bta.astype(jnp.int32),
+                   pos.astype(jnp.int32) + 1)
+        return out[:, None]
+    return apply("paged_attention", fwd,
+                 [q, k_pool, v_pool, block_tables, positions])
+
+
 def _sp_constrain(x, sequence_parallel):
     """Shard the [B, S, H] residual stream: batch over 'data', seq over
     'sep' (sequence/context parallel; SURVEY §5 long-context). Decode
@@ -148,6 +177,27 @@ class GPTAttention(nn.Layer):
             out = F.scaled_dot_product_attention(
                 q, kbuf, vbuf, attn_mask=mask, dropout_p=0.0,
                 training=False)
+        elif cache is not None and cache.get("paged"):
+            # serving decode over the paged KV pool (serving/ engine):
+            # one query token per row; this row's K/V goes into the page
+            # pool at its absolute position, then attention runs over the
+            # row's block table (Ragged Paged Attention shape). The attn
+            # impl is injected by the engine (XLA reference, Pallas
+            # kernel, or the KV-head-sharded shard_map variant).
+            if s != 1:
+                raise NotImplementedError(
+                    "paged attention decodes one token per step; prefill "
+                    "uses the dense causal path")
+            pos = cache["positions"]            # [B] int32: tokens cached
+            bt = cache["block_tables"]          # [B, max_pages] int32
+            kp = _pool_write(cache["k_pool"], k, bt, pos)
+            vp = _pool_write(cache["v_pool"], v, bt, pos)
+            cache["k_pool"], cache["v_pool"] = kp, vp
+            impl = cache.get("attn_impl")
+            if impl is None:
+                from ..ops.pallas.paged_attention import \
+                    paged_attention_reference as impl
+            out = _paged_attend(q, kp, vp, bt, pos, impl)
         elif cache is not None:
             from .. import ops
             if cache.get("k") is not None:
@@ -231,7 +281,12 @@ class GPTModel(nn.Layer):
     def forward(self, input_ids, caches=None, pos_offset=0):
         b, s = input_ids.shape
         from .. import ops
-        if isinstance(pos_offset, Tensor):
+        if isinstance(pos_offset, Tensor) and len(pos_offset.shape) == 1:
+            # per-row offsets [B] (serving decode: ragged absolute
+            # positions across the continuous batch)
+            pos = pos_offset.astype("int64").unsqueeze(1) \
+                + ops.arange(s, dtype="int64").unsqueeze(0)
+        elif isinstance(pos_offset, Tensor):
             # traced offset (compiled decode): arange over the static
             # length, shifted by the traced cursor
             pos = (ops.arange(s, dtype="int64")

@@ -15,6 +15,11 @@ Four small, stdlib-only-at-import pieces:
   a one-screen cross-rank run report (slowest rank, p50/p99 collective
   latency, comm/compute, MFU).
 
+The serving tier (``paddle_tpu/serving``) feeds the same registry:
+``serving_ttft_ms`` / ``serving_inter_token_ms`` / ``serving_e2e_ms``
+histograms plus QPS / tokens-per-sec / KV-occupancy gauges land in the
+per-rank JSONL next to the training metrics.
+
 Disabled (the default), every hook in the hot paths is a constant-time
 no-op — asserted by tests the same way as the flight recorder's disabled
 path.

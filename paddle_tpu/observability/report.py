@@ -85,11 +85,45 @@ def _hist_delta(new, old):
             "min": new.get("min"), "max": new.get("max")}
 
 
+def _bucket_windows(rank_windows, default_width_s=10.0):
+    """Align per-rank (ts, mean-step-ms) windows into wall-clock buckets:
+    ``{bucket_index: {rank: mean}}``. Bucket width = the median
+    inter-snapshot interval across all ranks (falling back to the 10s
+    default flush interval), anchored at the earliest snapshot. Two
+    windows of one rank landing in the same bucket (an extra step-count
+    flush) are averaged, and a rank that flushed late simply lands in the
+    later bucket instead of shifting every subsequent comparison."""
+    deltas = []
+    all_ts = []
+    for wins in rank_windows.values():
+        all_ts.extend(ts for ts, _ in wins)
+        deltas.extend(b - a for (a, _), (b, _) in zip(wins, wins[1:])
+                      if b > a)
+    if not all_ts:
+        return {}
+    if deltas:
+        deltas.sort()
+        width = deltas[len(deltas) // 2]
+    else:
+        width = default_width_s
+    width = max(width, 1e-3)
+    t0 = min(all_ts)
+    acc = {}   # bucket -> rank -> [sum, count]
+    for rank, wins in rank_windows.items():
+        for ts, m in wins:
+            b = int((ts - t0) / width + 0.5)
+            cell = acc.setdefault(b, {}).setdefault(rank, [0.0, 0])
+            cell[0] += m
+            cell[1] += 1
+    return {b: {r: s / c for r, (s, c) in by_rank.items()}
+            for b, by_rank in acc.items()}
+
+
 def build_run_report(per_rank):
     """Aggregate per-rank snapshot lists into one report dict."""
     ranks = {}
     collectives = {}
-    straggler_windows = {}
+    rank_windows = {}
     compute_ms_total = 0.0
     comm_us_total = 0.0
     overlap_pcts = []
@@ -133,22 +167,24 @@ def build_run_report(per_rank):
             collectives[ckey] = _merge_hist(collectives.get(ckey), h)
             if group not in ("store", "gloo", "object"):
                 comm_us_total += h.get("sum", 0.0)
-        # straggler windows: mean step time per inter-snapshot window.
-        # Windows are aligned by snapshot INDEX, which assumes ranks
-        # flush on the same cadence (true under the interval flusher /
-        # step-count flush of a symmetric SPMD job); a rank with extra
-        # flushes shifts its later windows — the per-window attribution
-        # is a heuristic, the whole-run slowest_rank above is not.
+        # straggler windows: mean step time per inter-snapshot window,
+        # stamped with the NEW snapshot's wall-clock ts. Cross-rank
+        # alignment happens below by TIMESTAMP bucket, not snapshot
+        # index: ranks flushing at different times (extra step-count
+        # flushes, a late joiner, a restarted worker) used to shift
+        # their later windows against everyone else's, corrupting the
+        # per-window straggler attribution.
         prev = None
-        for i, snap in enumerate(snaps):
+        for snap in snaps:
             h = snap.get("histograms", {}).get("step_time_ms")
             if h is None:
                 continue
             win = _hist_delta(h, prev)
             prev = h
             m = hist_mean(win)
-            if m is not None:
-                straggler_windows.setdefault(i, {})[rank] = m
+            ts = snap.get("ts")
+            if m is not None and ts is not None:
+                rank_windows.setdefault(rank, []).append((float(ts), m))
 
     slowest = None
     with_steps = {r: row for r, row in ranks.items()
@@ -157,7 +193,7 @@ def build_run_report(per_rank):
         slowest = max(with_steps, key=lambda r:
                       with_steps[r]["step_ms_mean"])
     straggler_counts = {}
-    for _, by_rank in straggler_windows.items():
+    for _, by_rank in _bucket_windows(rank_windows).items():
         if len(by_rank) < 2:
             continue
         worst = max(by_rank, key=lambda r: by_rank[r])

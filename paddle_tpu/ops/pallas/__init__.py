@@ -3,3 +3,6 @@
 These back the functional layer transparently; each has an XLA fallback.
 """
 from .flash_attention import flash_attention_bshd  # noqa: F401
+from .paged_attention import (  # noqa: F401
+    paged_attention, paged_attention_reference,
+)

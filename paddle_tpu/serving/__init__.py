@@ -1,0 +1,25 @@
+"""paddle_tpu.serving — continuous-batching inference over paged KV.
+
+The serving tier (SURVEY layer 11; ROADMAP item 3): a fixed-slot decode
+batch over a paged KV cache, iteration-level scheduling between decode
+steps, streaming token callbacks, A/B-gated paged-attention backends, and
+Poisson open-loop load tooling for the bench.
+
+    from paddle_tpu.serving import ServingEngine
+    eng = ServingEngine(model, page_size=16, num_pages=128, max_slots=8)
+    eng.start()
+    req = eng.submit(prompt_ids, max_new_tokens=64,
+                     on_token=lambda r, tok, fin: stream(tok))
+    tokens = req.result(timeout=60)
+"""
+from .kv_cache import BlockAllocator, OutOfPages, PagedKVCache, pages_for  # noqa: F401
+from .scheduler import (  # noqa: F401
+    ContinuousBatchingScheduler, EngineClosed, GenerationRequest, QueueFull,
+)
+from .decode import (  # noqa: F401
+    ab_compare, paged_decode_attention, resolve_backend,
+    sharded_paged_attention,
+)
+from .engine import ServingEngine  # noqa: F401
+from .metrics import ServingMetrics  # noqa: F401
+from .load import run_poisson_load, summarize_requests  # noqa: F401

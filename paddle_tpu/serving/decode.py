@@ -1,0 +1,143 @@
+"""Paged-attention decode step — XLA reference path, Pallas variant, A/B gate.
+
+One decode step attends ONE query token per sequence against that
+sequence's pages of the shared KV pool (Ragged Paged Attention,
+arxiv 2604.15464). Two interchangeable backends:
+
+* ``xla`` — :func:`paged_attention_reference` (ops/pallas/paged_attention):
+  a pure-jnp gather formulation XLA compiles on any device. Always correct;
+  the baseline every kernel must beat.
+* ``pallas`` — the scalar-prefetch Pallas kernel (same module): the page
+  table rides scalar prefetch so the DMA streams exactly the pages a
+  sequence owns. TPU-only (interpret mode is not a measurement).
+
+The **A/B gate** enforces the standing kernel rule (ROADMAP item 1): the
+Pallas path is used only where its measured time beats the XLA reference
+at the serving shape — :func:`ab_compare` times both and
+:func:`resolve_backend` turns ``auto`` into a decision, recorded by
+``bench.py --serving`` as ``serving_paged_attn_{xla,pallas}_ms``.
+``PADDLE_TPU_SERVING_ATTN=xla|pallas|auto`` overrides.
+
+Multi-chip serving shards along **KV heads** over the fleet mesh's
+``model`` axis (SNIPPETS.md [2] ``sharded_paged_attention``):
+:func:`sharded_paged_attention` wraps either backend in ``shard_map`` with
+the head dim partitioned; block tables and context lens replicate.
+"""
+from __future__ import annotations
+
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..ops.pallas.paged_attention import (
+    paged_attention as _pallas_paged_attention,
+    paged_attention_reference as _xla_paged_attention,
+)
+
+__all__ = ["paged_decode_attention", "sharded_paged_attention",
+           "resolve_backend", "ab_compare", "on_tpu"]
+
+BACKENDS = ("xla", "pallas", "auto")
+
+
+def on_tpu():
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+def paged_decode_attention(q, k_pool, v_pool, block_tables, context_lens,
+                           backend="xla", scale=None):
+    """One decode step. ``q`` [B, H, Dh]; pools [P, page, H, Dh];
+    ``block_tables`` [B, max_pages] int32; ``context_lens`` [B] int32.
+    Returns [B, H, Dh]."""
+    if backend == "pallas":
+        return _pallas_paged_attention(q, k_pool, v_pool, block_tables,
+                                       context_lens, scale=scale)
+    return _xla_paged_attention(q, k_pool, v_pool, block_tables,
+                                context_lens, scale=scale)
+
+
+def sharded_paged_attention(mesh, axis_name="model", backend="xla",
+                            scale=None):
+    """Decode attention sharded along KV heads over ``mesh[axis_name]``
+    (snippet [2] shape). Each shard attends its own head slice against its
+    head slice of every page; tables/lens replicate — no collective in the
+    step, the out_spec stitches heads back. Falls back to the unsharded
+    fn when the axis degree is 1."""
+    degree = int(mesh.shape.get(axis_name, 1))
+
+    def _impl(q, kp, vp, bt, lens):
+        return paged_decode_attention(q, kp, vp, bt, lens,
+                                      backend=backend, scale=scale)
+
+    if degree <= 1:
+        return _impl
+    in_specs = (
+        P(None, axis_name, None),         # q [B, H, Dh]
+        P(None, None, axis_name, None),   # k_pool [P, page, H, Dh]
+        P(None, None, axis_name, None),   # v_pool
+        P(),                              # block_tables (replicated)
+        P(),                              # context_lens (replicated)
+    )
+    out_specs = P(None, axis_name, None)
+    return jax.jit(jax.shard_map(_impl, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, check_rep=False))
+
+
+def resolve_backend(requested=None):
+    """Normalize the backend choice: explicit arg wins, then the
+    ``PADDLE_TPU_SERVING_ATTN`` env knob, default ``auto``."""
+    b = requested or os.environ.get("PADDLE_TPU_SERVING_ATTN") or "auto"
+    b = str(b).lower()
+    if b not in BACKENDS:
+        raise ValueError(
+            f"unknown serving attention backend {b!r}; pick from "
+            f"{BACKENDS}")
+    return b
+
+
+def _time_jitted(fn, args, repeats):
+    out = fn(*args)           # compile + warm
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(repeats):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / repeats * 1e3
+
+
+def ab_compare(q, k_pool, v_pool, block_tables, context_lens, scale=None,
+               repeats=20):
+    """Time the jitted XLA reference vs the Pallas kernel at this exact
+    serving shape and pick a winner. Off-TPU the Pallas leg is skipped
+    (interpret mode measures the emulator, not the chip) and XLA wins by
+    default. -> ``{"backend", "xla_ms", "pallas_ms", "reason"}``."""
+    args = (q, k_pool, v_pool, jnp.asarray(block_tables, jnp.int32),
+            jnp.asarray(context_lens, jnp.int32))
+    xla_ms = _time_jitted(
+        jax.jit(lambda *a: _xla_paged_attention(*a, scale=scale)),
+        args, repeats)
+    row = {"backend": "xla", "xla_ms": round(xla_ms, 4),
+           "pallas_ms": None, "reason": "xla reference"}
+    if not on_tpu():
+        row["reason"] = "pallas requires TPU (interpret-only here)"
+        return row
+    try:
+        pallas_ms = _time_jitted(
+            jax.jit(lambda *a: _pallas_paged_attention(*a, scale=scale)),
+            args, repeats)
+    except Exception as e:  # unsupported shape/dtype: gate stays on XLA
+        row["reason"] = f"pallas failed: {type(e).__name__}: {e}"[:160]
+        return row
+    row["pallas_ms"] = round(pallas_ms, 4)
+    if pallas_ms < xla_ms:
+        row["backend"] = "pallas"
+        row["reason"] = "pallas beat xla at this shape"
+    else:
+        row["reason"] = "xla beat pallas at this shape"
+    return row

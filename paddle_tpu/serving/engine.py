@@ -1,0 +1,415 @@
+"""Continuous-batching serving engine: prefill/decode split over paged KV.
+
+The millions-of-users tier (ROADMAP item 3; SURVEY layer 11). A
+:class:`ServingEngine` wraps a GPT-family ``models.gpt.GPTForCausalLM``
+and runs it as a concurrent serving loop:
+
+* **prefill** — newly admitted requests run the dense causal forward at
+  bucketed shapes (batch buckets AND sequence buckets share
+  ``inference.pick_bucket`` with :class:`~paddle_tpu.inference.
+  BatchingPredictor`, whose pad-to-bucket idea this generalizes), their
+  K/V is written into pages of the shared pool, and the first token
+  streams out (TTFT ends here).
+* **decode** — ONE fixed-shape step over all ``max_slots`` slots: embed
+  the last token of every row at its own absolute position, scatter its
+  K/V into the pool, paged attention over each row's block table, greedy
+  argmax on device (host-side temperature/top-k sampling per request when
+  asked). Compiled once with ``jax.jit`` — params, block tables and pools
+  are arguments, pools are donated on TPU, so steady-state decode is one
+  XLA program launch per token regardless of admission churn.
+* **scheduling** — between steps the
+  :class:`~.scheduler.ContinuousBatchingScheduler` finishes / evicts /
+  admits, so a request arriving mid-stream joins the next step without
+  stalling in-flight rows (the no-decode-gap acceptance test).
+
+The paged-attention backend is A/B gated (``serving/decode.py``): Pallas
+only where it measurably beats the XLA reference at the serving shape;
+``PADDLE_TPU_SERVING_ATTN`` overrides. Pass ``mesh=`` to shard the decode
+along KV heads over the fleet mesh's ``model`` axis for multi-chip
+serving.
+
+Metrics flow through the PR-5 registry via :class:`~.metrics.
+ServingMetrics`; ``bench.py --serving`` drives a Poisson open-loop load
+(``serving/load.py``) and records tokens/s + tail latency.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.autograd import no_grad
+from ..core.tensor import Tensor
+from ..inference import pick_bucket
+from . import decode as _decode
+from .kv_cache import PagedKVCache, pages_for
+from .metrics import ServingMetrics
+from .scheduler import (ContinuousBatchingScheduler, EngineClosed,
+                        GenerationRequest)
+
+__all__ = ["ServingEngine"]
+
+
+@contextlib.contextmanager
+def _swap_params(params, arrays):
+    """Temporarily back the model's Parameters with (traced) arrays so the
+    decode step jits with weights as real arguments — no giant closure
+    constants, donation-friendly."""
+    olds = [p._data for p in params]
+    for p, a in zip(params, arrays):
+        p._data = a
+    try:
+        yield
+    finally:
+        for p, o in zip(params, olds):
+            p._data = o
+
+
+def _select_token(logits_row, req):
+    """Host-side sampling for one request: greedy at temperature 0, else
+    temperature + optional top-k from the request's own seeded RNG (the
+    decode batch stays deterministic per request, not per step)."""
+    if req.temperature <= 0.0:
+        return int(np.argmax(logits_row))
+    z = logits_row.astype(np.float64) / max(req.temperature, 1e-6)
+    if req.top_k is not None:
+        kth = np.partition(z, -int(req.top_k))[-int(req.top_k)]
+        z = np.where(z < kth, -np.inf, z)
+    z = z - z.max()
+    p = np.exp(z)
+    p /= p.sum()
+    return int(req.rng().choice(len(p), p=p))
+
+
+class ServingEngine:
+    """Continuous-batching inference over a paged KV cache.
+
+    Synchronous use (tests, batch jobs)::
+
+        eng = ServingEngine(model, page_size=16, num_pages=64, max_slots=4)
+        tokens = eng.generate([1, 2, 3], max_new_tokens=8)
+
+    Concurrent serving (streaming callbacks + backpressure)::
+
+        with ServingEngine(model, ...) as eng:
+            eng.start()
+            req = eng.submit(prompt, on_token=lambda r, t, fin: push(t))
+            req.result(timeout=30)
+    """
+
+    def __init__(self, model, page_size=16, num_pages=64, max_slots=4,
+                 max_queue=256, prefill_seq_buckets=None,
+                 prefill_batch_buckets=None, attn_backend=None, mesh=None,
+                 mesh_axis="model", jit=True, registry=None):
+        cfg = model.config
+        self.model = model
+        self.model.eval()
+        self.cfg = cfg
+        self.page_size = int(page_size)
+        self.max_slots = int(max_slots)
+        self.max_pages = pages_for(cfg.max_seq_len, self.page_size)
+        H = cfg.num_heads
+        Dh = cfg.hidden_size // H
+        dt = model.gpt.wte.weight._data.dtype
+        self.kv = PagedKVCache(cfg.num_layers, int(num_pages),
+                               self.page_size, H, Dh, dtype=dt)
+        self.scheduler = ContinuousBatchingScheduler(
+            self.kv.allocator, self.max_slots, self.page_size,
+            cfg.max_seq_len, max_queue=max_queue)
+        self.metrics = ServingMetrics(registry=registry)
+        # seq buckets cap padding waste at ~2x; batch buckets keep the
+        # prefill compile cache small (one shape per bucket pair)
+        if prefill_seq_buckets is None:
+            prefill_seq_buckets, b = [], 16
+            while b < cfg.max_seq_len:
+                prefill_seq_buckets.append(b)
+                b *= 2
+            prefill_seq_buckets.append(cfg.max_seq_len)
+        self.prefill_seq_buckets = sorted(set(prefill_seq_buckets))
+        self.prefill_batch_buckets = sorted(set(
+            prefill_batch_buckets or [1, 2, 4, self.max_slots]))
+        # ---- paged-attention backend (A/B gated; standing kernel rule)
+        requested = _decode.resolve_backend(attn_backend)
+        self.attn_ab = None
+        if requested == "auto":
+            self.attn_ab = self._run_ab_gate()
+            self.attn_backend = self.attn_ab["backend"]
+        else:
+            self.attn_backend = requested
+        if mesh is not None and int(mesh.shape.get(mesh_axis, 1)) > 1 \
+                and H % int(mesh.shape[mesh_axis]) != 0:
+            raise ValueError(
+                f"{H} heads not divisible by mesh axis "
+                f"{mesh_axis}={mesh.shape[mesh_axis]}")
+        if mesh is not None:
+            self._attn_impl = _decode.sharded_paged_attention(
+                mesh, axis_name=mesh_axis, backend=self.attn_backend)
+        else:
+            backend = self.attn_backend
+            self._attn_impl = lambda q, kp, vp, bt, lens: \
+                _decode.paged_decode_attention(q, kp, vp, bt, lens,
+                                               backend=backend)
+        self._params = list(model.parameters())
+        self._param_arrays = [p._data for p in self._params]
+        self._jit = bool(jit)
+        self._step_fn = self._build_step()
+        self._steps = 0
+        self._decode_tokens = 0
+        self.capture_logits = None   # tests: a list collects per-step
+        # [S, V] decode logits (forces a host fetch; leave None in prod)
+        self._peak_occupancy = 0.0
+        self._thread = None
+        self._stop_evt = threading.Event()
+        self._wake = threading.Event()
+        self._closed = False
+
+    # ------------------------------------------------------------ A/B gate
+    def _run_ab_gate(self):
+        """Measure XLA vs Pallas at this engine's decode shape; 'auto'
+        resolves to the winner (Pallas never wins off-TPU)."""
+        H, Dh = self.cfg.num_heads, self.cfg.hidden_size // self.cfg.num_heads
+        key = jax.random.PRNGKey(0)
+        q = jax.random.normal(key, (self.max_slots, H, Dh),
+                              self.kv.dtype)
+        bt = np.zeros((self.max_slots, self.max_pages), np.int32)
+        lens = np.full((self.max_slots,),
+                       min(self.page_size, self.cfg.max_seq_len), np.int32)
+        return _decode.ab_compare(q, self.kv.k[0], self.kv.v[0], bt, lens)
+
+    # ----------------------------------------------------------- decode fn
+    def _build_step(self):
+        model, params = self.model, self._params
+        L = self.cfg.num_layers
+        attn_impl = self._attn_impl
+
+        def step(arrays, tokens, positions, bt, k_pools, v_pools):
+            with no_grad(), _swap_params(params, arrays):
+                caches = [{"paged": True,
+                           "k_pool": Tensor(k_pools[i]),
+                           "v_pool": Tensor(v_pools[i]),
+                           "block_tables": Tensor(bt),
+                           "positions": Tensor(positions),
+                           "attn_impl": attn_impl}
+                          for i in range(L)]
+                logits = model(Tensor(tokens[:, None]), caches=caches,
+                               pos_offset=Tensor(positions))
+                last = logits._data[:, -1]
+                nxt = jnp.argmax(last, axis=-1).astype(jnp.int32)
+                return (nxt, last,
+                        [c["k_pool"]._data for c in caches],
+                        [c["v_pool"]._data for c in caches])
+
+        if not self._jit:
+            return step
+        # donation saves the pool double-buffer on TPU; CPU/older
+        # backends warn and ignore it, so only ask where it works
+        if _decode.on_tpu():
+            return jax.jit(step, donate_argnums=(4, 5))
+        return jax.jit(step)
+
+    # ------------------------------------------------------------- prefill
+    def _prefill_admitted(self, admitted):
+        groups = {}
+        for req in admitted:
+            self.metrics.on_admit(req)
+            sb = pick_bucket(len(req.effective_prompt()),
+                             self.prefill_seq_buckets)
+            groups.setdefault(sb, []).append(req)
+        for sb, reqs in sorted(groups.items()):
+            i = 0
+            while i < len(reqs):
+                chunk = reqs[i:i + self.max_slots]
+                i += self.max_slots
+                self._prefill_batch(chunk, sb)
+
+    def _prefill_batch(self, reqs, seq_bucket):
+        """Dense causal forward at [batch_bucket, seq_bucket]; right
+        padding is causal-safe (position i never attends j > i), so each
+        row's first `len` K/V rows are exact."""
+        n = len(reqs)
+        nb = pick_bucket(n, self.prefill_batch_buckets)
+        ids = np.zeros((nb, seq_bucket), np.int64)
+        lens = []
+        for i, req in enumerate(reqs):
+            p = req.effective_prompt()
+            ids[i, :len(p)] = p
+            lens.append(len(p))
+        with no_grad():
+            caches = [{"k": None, "v": None}
+                      for _ in range(self.cfg.num_layers)]
+            logits = self.model(Tensor(jnp.asarray(ids)), caches=caches)
+        for i, req in enumerate(reqs):
+            ln = lens[i]
+            for layer, c in enumerate(caches):
+                self.kv.write_prefill(layer, c["k"]._data[i],
+                                      c["v"]._data[i], req.pages, ln)
+            req.num_cached = ln
+            row = np.asarray(logits._data[i, ln - 1])
+            tok = _select_token(row, req)
+            first = not req.generated
+            req.emit(tok)
+            if first:
+                self.metrics.on_first_token(req)
+            self.metrics.on_token(req)
+            if req.hit_stop():
+                self.scheduler.finish(req)
+                self.metrics.on_finish(req)
+
+    # ---------------------------------------------------------- decode step
+    def _decode_once(self, active):
+        S, maxp = self.max_slots, self.max_pages
+        tokens = np.zeros(S, np.int32)
+        positions = np.zeros(S, np.int32)
+        bt = np.zeros((S, maxp), np.int32)
+        any_sampling = False
+        for slot, req in active.items():
+            tokens[slot] = req.generated[-1]
+            positions[slot] = req.num_cached
+            bt[slot, :len(req.pages)] = req.pages
+            any_sampling |= req.temperature > 0.0
+        nxt, last, self.kv.k, self.kv.v = self._step_fn(
+            self._param_arrays, jnp.asarray(tokens),
+            jnp.asarray(positions), jnp.asarray(bt),
+            list(self.kv.k), list(self.kv.v))
+        nxt = np.asarray(nxt)
+        logits_np = np.asarray(last) \
+            if (any_sampling or self.capture_logits is not None) else None
+        if self.capture_logits is not None:
+            self.capture_logits.append(
+                (dict((s, r.request_id) for s, r in active.items()),
+                 logits_np))
+        by_slot = {}
+        for slot, req in active.items():
+            if req.temperature > 0.0:
+                by_slot[slot] = _select_token(logits_np[slot], req)
+            else:
+                by_slot[slot] = int(nxt[slot])
+        finished = self.scheduler.complete_step(by_slot)
+        for slot, req in active.items():
+            tt = req.token_times
+            self.metrics.on_token(
+                req, tt[-1] - tt[-2] if len(tt) >= 2 else None)
+        for req in finished:
+            self.metrics.on_finish(req)
+        self._decode_tokens += len(by_slot)
+        return len(by_slot)
+
+    # ------------------------------------------------------------ stepping
+    def step(self):
+        """One scheduler round: finish/admit/prefill, then ONE decode step
+        over every active slot. -> decode tokens emitted (0 when idle).
+        Admission rides the same round as decode, so in-flight requests
+        never skip a step while a newcomer prefills."""
+        if self._closed:
+            raise EngineClosed("engine is closed")
+        admitted = self.scheduler.schedule()
+        if admitted:
+            self._prefill_admitted(admitted)
+        _, evicted = self.scheduler.ensure_decode_capacity()
+        for req in evicted:
+            self.metrics.on_evict(req)
+        active = {slot: r for slot, r in self.scheduler.active.items()
+                  if r.state == "active"}
+        emitted = self._decode_once(active) if active else 0
+        occ = self.kv.occupancy_pct()
+        self._peak_occupancy = max(self._peak_occupancy, occ)
+        self.metrics.sample_state(len(self.scheduler.active),
+                                  self.scheduler.queue_depth(), occ)
+        self._steps += 1
+        return emitted
+
+    def run_until_idle(self, max_steps=100000):
+        steps = 0
+        while self.scheduler.has_work():
+            self.step()
+            steps += 1
+            if steps >= max_steps:
+                raise RuntimeError(
+                    f"run_until_idle exceeded {max_steps} steps")
+        return steps
+
+    # ------------------------------------------------------------- serving
+    def submit(self, prompt_ids, max_new_tokens=16, eos_token_id=None,
+               temperature=0.0, top_k=None, on_token=None, block=True,
+               timeout=10.0):
+        """Queue one request (backpressure: blocks up to ``timeout`` for
+        queue space, then raises :class:`~.scheduler.QueueFull`)."""
+        if self._closed:
+            raise EngineClosed("engine is closed")
+        req = GenerationRequest(prompt_ids, max_new_tokens=max_new_tokens,
+                                eos_token_id=eos_token_id,
+                                temperature=temperature, top_k=top_k,
+                                on_token=on_token)
+        self.scheduler.submit(req, block=block, timeout=timeout)
+        self._wake.set()
+        return req
+
+    def generate(self, prompt_ids, timeout=120.0, **kw):
+        """Synchronous helper: submit + drive (foreground when no serve
+        thread is running) + wait. -> generated token list."""
+        req = self.submit(prompt_ids, **kw)
+        if self._thread is None:
+            self.run_until_idle()
+        return req.result(timeout=timeout)
+
+    def start(self):
+        """Background serve loop (idempotent)."""
+        if self._thread is not None:
+            return
+        self._stop_evt.clear()
+        self._thread = threading.Thread(target=self._serve_loop,
+                                        name="paddle-tpu-serving",
+                                        daemon=True)
+        self._thread.start()
+
+    def _serve_loop(self):
+        while not self._stop_evt.is_set():
+            try:
+                if self.scheduler.has_work():
+                    self.step()
+                else:
+                    self._wake.wait(0.02)
+                    self._wake.clear()
+            except Exception as e:  # a broken step fails every waiter
+                self.scheduler.close(error=e)
+                break
+
+    def stop(self, timeout=10.0):
+        self._stop_evt.set()
+        self._wake.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout)
+
+    def close(self):
+        """Stop the loop and fail everything still queued or in flight —
+        same contract as ``BatchingPredictor.close``."""
+        if self._closed:
+            return
+        self._closed = True
+        self.stop()
+        self.scheduler.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # --------------------------------------------------------------- stats
+    def stats(self):
+        return {
+            "steps": self._steps,
+            "decode_tokens": self._decode_tokens,
+            "evictions": self.scheduler.total_evictions,
+            "kv_occupancy_pct": round(self.kv.occupancy_pct(), 2),
+            "kv_occupancy_peak_pct": round(self._peak_occupancy, 2),
+            "active": len(self.scheduler.active),
+            "queued": self.scheduler.queue_depth(),
+            "attn_backend": self.attn_backend,
+            "attn_ab": self.attn_ab,
+        }

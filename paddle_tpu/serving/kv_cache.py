@@ -1,0 +1,150 @@
+"""Paged KV cache — fixed-size blocks in a preallocated device pool.
+
+Reference capability: the block-table KV layout of
+``block_multi_head_attention`` (paddle/phi/kernels/fusion/gpu) and vLLM's
+PagedAttention; TPU-native shape per Ragged Paged Attention
+(arxiv 2604.15464): per-layer pools ``[num_pages, page_size, H, Dh]``, a
+per-request **block table** of physical page ids, and a host-side
+free-list allocator. This replaces the dense ``[B, T, H, Dh]`` buffers of
+``models/gpt.py``'s compiled decode for serving: memory is bounded by
+*tokens actually cached* (rounded up to one page), not by
+``batch × max_seq_len``, so slots with short requests don't reserve the
+worst case and the continuous-batching scheduler can admit until the pool
+— not the batch shape — is full.
+
+Physical page 0 is reserved as the **scrap page**: padded block-table
+entries and inactive decode slots point at it, so masked lanes of the
+batched decode step have a legal write/read target without branching.
+All pool updates are functional (``.at[].set``) so the decode step can be
+one jitted XLA program with donated pool buffers.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["BlockAllocator", "PagedKVCache", "pages_for", "OutOfPages"]
+
+
+class OutOfPages(RuntimeError):
+    """The pool cannot satisfy an allocation (caller may evict + retry)."""
+
+
+def pages_for(n_tokens, page_size):
+    """Pages needed to hold ``n_tokens`` (ceil division; 0 tokens -> 0)."""
+    return -(-int(n_tokens) // int(page_size))
+
+
+class BlockAllocator:
+    """Free-list page allocator over ``num_pages`` physical pages.
+
+    Page ids ``[0, reserved)`` are never handed out (page 0 is the scrap
+    page). Purely host-side — allocation happens between decode steps on
+    the scheduler thread, never inside the compiled step.
+    """
+
+    def __init__(self, num_pages, reserved=1):
+        if num_pages <= reserved:
+            raise ValueError(f"num_pages={num_pages} must exceed "
+                             f"reserved={reserved}")
+        self.num_pages = int(num_pages)
+        self.reserved = int(reserved)
+        # LIFO free list: recently-freed (still-warm) pages are reused first
+        self._free = list(range(self.num_pages - 1, self.reserved - 1, -1))
+
+    @property
+    def capacity(self):
+        """Allocatable pages (excludes the reserved scrap pages)."""
+        return self.num_pages - self.reserved
+
+    @property
+    def free_pages(self):
+        return len(self._free)
+
+    @property
+    def used_pages(self):
+        return self.capacity - len(self._free)
+
+    def occupancy_pct(self):
+        return 100.0 * self.used_pages / self.capacity if self.capacity \
+            else 0.0
+
+    def can_alloc(self, n):
+        return n <= len(self._free)
+
+    def alloc(self, n):
+        """-> list of ``n`` page ids; raises :class:`OutOfPages` when the
+        free list is short (all-or-nothing: no partial grants)."""
+        n = int(n)
+        if n > len(self._free):
+            raise OutOfPages(
+                f"need {n} page(s), {len(self._free)} free "
+                f"of {self.capacity}")
+        out = [self._free.pop() for _ in range(n)]
+        return out
+
+    def free(self, pages):
+        for p in pages:
+            p = int(p)
+            if p < self.reserved or p >= self.num_pages:
+                raise ValueError(f"page {p} outside allocatable range")
+            if p in self._free:
+                raise ValueError(f"double free of page {p}")
+            self._free.append(p)
+
+
+class PagedKVCache:
+    """Per-layer K/V page pools + the allocator that parcels them out.
+
+    ``k[l]`` / ``v[l]`` are jnp arrays ``[num_pages, page_size, H, Dh]``.
+    Decode-step writes happen *inside* the model's paged attention branch
+    (functional scatter, see ``models/gpt.py``); this class owns prefill
+    writes, the allocator, and test/debug gathers.
+    """
+
+    def __init__(self, num_layers, num_pages, page_size, num_heads,
+                 head_dim, dtype=jnp.float32, reserved=1):
+        self.num_layers = int(num_layers)
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        self.num_heads = int(num_heads)
+        self.head_dim = int(head_dim)
+        self.dtype = dtype
+        shape = (self.num_pages, self.page_size, self.num_heads,
+                 self.head_dim)
+        self.k = [jnp.zeros(shape, dtype) for _ in range(self.num_layers)]
+        self.v = [jnp.zeros(shape, dtype) for _ in range(self.num_layers)]
+        self.allocator = BlockAllocator(num_pages, reserved=reserved)
+
+    def nbytes(self):
+        return 2 * self.num_layers * self.k[0].size * self.k[0].dtype.itemsize
+
+    def occupancy_pct(self):
+        return self.allocator.occupancy_pct()
+
+    def write_prefill(self, layer, k_new, v_new, pages, length):
+        """Write one request's prefill K/V (``[S, H, Dh]`` with
+        ``S >= length``; rows past ``length`` are padding and dropped)
+        into its ``pages``. The tail of the last page stays whatever it
+        was — reads are masked by ``context_lens``."""
+        n = len(pages)
+        cap = n * self.page_size
+        if length > cap:
+            raise ValueError(f"{length} tokens > {n} page capacity {cap}")
+        idx = jnp.asarray(np.asarray(pages, np.int32))
+        for pool_list, new in ((self.k, k_new), (self.v, v_new)):
+            arr = jnp.asarray(new)[:length].astype(self.dtype)
+            pad = cap - length
+            if pad:
+                arr = jnp.pad(arr, ((0, pad), (0, 0), (0, 0)))
+            arr = arr.reshape(n, self.page_size, self.num_heads,
+                              self.head_dim)
+            pool_list[layer] = pool_list[layer].at[idx].set(arr)
+
+    def gather(self, layer, pages, length, which="k"):
+        """Debug/test readback: the first ``length`` tokens of a request's
+        pages as one dense ``[length, H, Dh]`` array."""
+        pool = (self.k if which == "k" else self.v)[layer]
+        idx = jnp.asarray(np.asarray(pages, np.int32))
+        dense = pool[idx].reshape(-1, self.num_heads, self.head_dim)
+        return dense[:length]

@@ -1,0 +1,95 @@
+"""Poisson open-loop load generator — the serving yardstick harness.
+
+Open-loop means arrivals follow a seeded Poisson process regardless of
+how fast the engine drains them (closed-loop generators hide tail latency
+by self-throttling; the Gemma-on-TPU serving study, arxiv 2605.25645, is
+the external comparison this mirrors). Drives a running
+:class:`~.engine.ServingEngine`, then reduces per-request timestamps into
+the tokens/s + TTFT + inter-token tail numbers ``bench.py --serving``
+records next to the training rows.
+"""
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+__all__ = ["run_poisson_load", "summarize_requests"]
+
+
+def _pct(values, q):
+    return float(np.percentile(np.asarray(values, np.float64), q)) \
+        if values else None
+
+
+def summarize_requests(requests, wall_s):
+    """Reduce finished requests -> the bench row dict (times in ms)."""
+    ok = [r for r in requests if r.error is None and r.t_done is not None]
+    # never-finished requests (result() deadline hit, engine wedged) are
+    # FAILURES — without this they vanish from both columns and a hung
+    # run reads as healthy
+    failed = [r for r in requests if r.error is not None
+              or r.t_done is None]
+    tokens = sum(len(r.generated) for r in ok)
+    ttft = [r.ttft_s() * 1e3 for r in ok if r.ttft_s() is not None]
+    itl = [dt * 1e3 for r in ok for dt in r.inter_token_s()]
+    e2e = [(r.t_done - r.t_submit) * 1e3 for r in ok]
+    out = {
+        "requests_ok": len(ok),
+        "requests_failed": len(failed),
+        "tokens": tokens,
+        "wall_s": round(wall_s, 3),
+        "tokens_per_sec": round(tokens / wall_s, 2) if wall_s > 0 else 0.0,
+        "qps_completed": round(len(ok) / wall_s, 2) if wall_s > 0 else 0.0,
+        "ttft_ms_p50": _pct(ttft, 50),
+        "ttft_ms_p99": _pct(ttft, 99),
+        "itl_ms_p50": _pct(itl, 50),
+        "itl_ms_p99": _pct(itl, 99),
+        "e2e_ms_p50": _pct(e2e, 50),
+        "e2e_ms_p99": _pct(e2e, 99),
+        "evictions": sum(r.evictions for r in requests),
+    }
+    for k, v in list(out.items()):
+        if isinstance(v, float) and v is not None and k.endswith(
+                ("p50", "p99")):
+            out[k] = round(v, 2)
+    return out
+
+
+def run_poisson_load(engine, n_requests=32, qps=10.0, prompt_len=(8, 24),
+                     max_new_tokens=12, eos_token_id=None, seed=0,
+                     timeout=300.0):
+    """Submit ``n_requests`` at Poisson arrivals of rate ``qps`` (prompts
+    are uniform-random token ids of uniform-random length in
+    ``prompt_len``), wait for completion, -> summary dict. The engine
+    must be ``start()``ed (open loop: submission never waits on decode).
+    Backpressure turns into measured queue wait, not dropped load — the
+    submit timeout is sized to the whole run."""
+    rng = np.random.RandomState(seed)
+    vocab = engine.cfg.vocab_size
+    lo, hi = prompt_len
+    gaps = rng.exponential(1.0 / qps, size=n_requests)
+    requests = []
+    t_start = time.perf_counter()
+    for i in range(n_requests):
+        target = t_start + float(gaps[:i + 1].sum())
+        delay = target - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        prompt = rng.randint(1, vocab, size=rng.randint(lo, hi + 1))
+        req = engine.submit(prompt.tolist(),
+                            max_new_tokens=int(max_new_tokens),
+                            eos_token_id=eos_token_id, timeout=timeout)
+        requests.append(req)
+    deadline = time.perf_counter() + timeout
+    for req in requests:
+        left = max(0.1, deadline - time.perf_counter())
+        try:
+            req.result(timeout=left)
+        except Exception:
+            pass  # summarized as failed below
+    wall_s = time.perf_counter() - t_start
+    out = summarize_requests(requests, wall_s)
+    out["qps_offered"] = float(qps)
+    out["n_requests"] = int(n_requests)
+    return out

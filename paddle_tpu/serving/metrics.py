@@ -1,0 +1,112 @@
+"""Serving metrics — QPS, TTFT, inter-token latency, KV-pool occupancy.
+
+Everything lands in the PR-5 observability registry
+(``PADDLE_TPU_METRICS=1``; see ``observability/metrics.py``) so serving
+runs share the JSONL snapshot/report plumbing with training. Names:
+
+* ``serving_requests_total{status=ok|failed|evicted}`` — counters
+  (``evicted`` counts preemptions, not terminal states)
+* ``serving_tokens_total`` — generated tokens
+* ``serving_ttft_ms`` / ``serving_inter_token_ms`` / ``serving_e2e_ms`` /
+  ``serving_queue_wait_ms`` — latency histograms
+* ``serving_qps`` — finished requests/s over a sliding window
+* ``serving_tokens_per_sec`` — decode throughput over the same window
+* ``serving_active_slots`` / ``serving_queue_depth`` /
+  ``serving_kv_occupancy_pct`` — gauges sampled every engine step
+
+Every hook is a no-op when the registry is off (one ``None`` check), so
+an un-instrumented engine pays nothing — same contract as the flight
+recorder and telemetry callbacks.
+"""
+from __future__ import annotations
+
+import time
+from collections import deque
+
+from ..observability import metrics as _metrics
+
+__all__ = ["ServingMetrics"]
+
+
+class ServingMetrics:
+    """Per-engine metrics frontend over the process registry."""
+
+    def __init__(self, registry=None, window_s=30.0):
+        self._reg = registry if registry is not None \
+            else _metrics.get_registry()
+        self.window_s = float(window_s)
+        self._finish_times: deque = deque()
+        self._token_times: deque = deque()
+
+    @property
+    def enabled(self):
+        return self._reg is not None
+
+    def _trim(self, dq, now):
+        cutoff = now - self.window_s
+        while dq and dq[0] < cutoff:
+            dq.popleft()
+
+    def on_admit(self, req):
+        reg = self._reg
+        if reg is None or req.t_admit is None:
+            return
+        # since the last (re-)enqueue: a re-admitted evicted request must
+        # not count its prior active service time as queueing
+        reg.histogram("serving_queue_wait_ms").observe(
+            (req.t_admit - req.t_enqueue) * 1e3)
+
+    def on_first_token(self, req):
+        reg = self._reg
+        if reg is None:
+            return
+        ttft = req.ttft_s()
+        if ttft is not None:
+            reg.histogram("serving_ttft_ms").observe(ttft * 1e3)
+
+    def on_token(self, req, dt_s=None):
+        reg = self._reg
+        if reg is None:
+            return
+        reg.counter("serving_tokens_total").inc()
+        if dt_s is not None:
+            reg.histogram("serving_inter_token_ms").observe(dt_s * 1e3)
+        now = time.perf_counter()
+        self._token_times.append(now)
+        self._trim(self._token_times, now)
+        span = now - self._token_times[0]
+        if len(self._token_times) > 1 and span > 0:
+            reg.gauge("serving_tokens_per_sec").set(
+                (len(self._token_times) - 1) / span)
+
+    def on_evict(self, req):
+        reg = self._reg
+        if reg is None:
+            return
+        reg.counter("serving_evictions_total").inc()
+        reg.counter("serving_requests_total", status="evicted").inc()
+
+    def on_finish(self, req):
+        reg = self._reg
+        if reg is None:
+            return
+        status = "failed" if req.error is not None else "ok"
+        reg.counter("serving_requests_total", status=status).inc()
+        if req.t_done is not None:
+            reg.histogram("serving_e2e_ms").observe(
+                (req.t_done - req.t_submit) * 1e3)
+        now = time.perf_counter()
+        self._finish_times.append(now)
+        self._trim(self._finish_times, now)
+        span = now - self._finish_times[0]
+        if len(self._finish_times) > 1 and span > 0:
+            reg.gauge("serving_qps").set(
+                (len(self._finish_times) - 1) / span)
+
+    def sample_state(self, active_slots, queue_depth, occupancy_pct):
+        reg = self._reg
+        if reg is None:
+            return
+        reg.gauge("serving_active_slots").set(active_slots)
+        reg.gauge("serving_queue_depth").set(queue_depth)
+        reg.gauge("serving_kv_occupancy_pct").set(occupancy_pct)

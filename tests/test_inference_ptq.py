@@ -117,3 +117,50 @@ def test_batching_predictor_dynamic_batching(tmp_path):
         np.testing.assert_allclose(results[i], want[i], rtol=1e-4,
                                    atol=1e-5, err_msg=f"req {i}")
     bp.close()
+
+
+def test_batching_predictor_close_lifecycle(tmp_path):
+    """ISSUE 6 satellite: close() stops the worker, FAILS queued futures
+    instead of silently dropping them, makes later predicts fail fast,
+    is idempotent, and doubles as the context-manager exit."""
+    import threading
+
+    from paddle_tpu.inference import BatchingPredictor, Predictor
+
+    net, path = _export(tmp_path)
+    bp = BatchingPredictor(Predictor(path), max_batch_size=2,
+                           max_wait_ms=5.0, batch_buckets=[2])
+    # stop the worker first so a queued request is provably undrained,
+    # then close() must fail it (not leave the caller hanging)
+    bp._stop = True
+    bp._worker.join(timeout=5.0)
+    assert not bp._worker.is_alive()
+    errors = []
+
+    def call():
+        try:
+            bp.predict(np.zeros(8, np.float32), timeout=30.0)
+        except Exception as e:
+            errors.append(e)
+
+    th = threading.Thread(target=call)
+    th.start()
+    while bp._q.empty():  # request is enqueued, nobody will serve it
+        pass
+    bp.close()
+    th.join(timeout=5.0)
+    assert not th.is_alive()
+    assert len(errors) == 1 and isinstance(errors[0], RuntimeError)
+    with pytest.raises(RuntimeError):
+        bp.predict(np.zeros(8, np.float32))
+    bp.close()  # idempotent
+    # context-manager form serves then tears down the worker thread
+    with BatchingPredictor(Predictor(path), max_batch_size=2,
+                           batch_buckets=[2]) as bp2:
+        worker = bp2._worker
+        out = bp2.predict(np.ones(8, np.float32), timeout=30.0)
+        assert np.asarray(out).shape == (4,)
+    worker.join(timeout=5.0)
+    assert not worker.is_alive()
+    with pytest.raises(RuntimeError):
+        bp2.predict(np.ones(8, np.float32))

@@ -142,6 +142,28 @@ def test_gpt_generate_cache_parity_and_sampling():
     np.testing.assert_array_equal(a, b)
 
 
+def test_gpt_per_row_pos_offset():
+    """ISSUE 6: a [B] pos_offset Tensor gives each batch row its OWN
+    absolute position (ragged serving decode batch) — row b must match a
+    scalar-offset forward of the same row."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.models import GPTModel, gpt_tiny
+    paddle.seed(3)
+    m = GPTModel(gpt_tiny())
+    m.eval()
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(rng.randint(0, 256, (3, 1)).astype("int64"))
+    offs = [0, 5, 11]
+    batched = m(ids, pos_offset=Tensor(
+        jnp.asarray(np.array(offs, np.int32)))).numpy()
+    for b, off in enumerate(offs):
+        solo = m(ids[b:b + 1], pos_offset=off).numpy()
+        np.testing.assert_allclose(batched[b:b + 1], solo, rtol=1e-5,
+                                   atol=1e-6, err_msg=f"row {b}")
+
+
 def test_nn_functional_vision_ops():
     import paddle_tpu.nn.functional as F
     rng = np.random.RandomState(0)

@@ -607,3 +607,91 @@ def test_node_coordinator_metrics_run_report(tmp_path):
     assert r.returncode == 0, r.stdout + r.stderr
     assert "[telemetry] run report (2 rank(s))" in r.stderr, r.stderr
     assert "slowest rank 1" in r.stderr, r.stderr
+
+
+def test_report_straggler_windows_timestamp_aligned():
+    """ISSUE 6 satellite: windows are keyed by wall-clock bucket, not
+    snapshot index. Rank 1 flushes one EXTRA early snapshot (startup
+    probe), which under index alignment shifted all its later windows by
+    one — blaming rank 1 for windows where rank 0 was the real
+    straggler. With ts bucketing the rank-0 spike at t=20 is attributed
+    to rank 0 and rank 1 is never the straggler."""
+    bounds = [1e9]
+
+    def snap(rank, ts, count, total_ms):
+        return {"ts": ts, "rank": rank, "seq": 0, "counters": {},
+                "gauges": {},
+                "histograms": {"step_time_ms": {
+                    "bounds": bounds, "counts": [count, 0],
+                    "count": count, "sum": total_ms,
+                    "min": 1.0, "max": 1e3}}}
+
+    # rank 0 flushes at t=10,20,30; window means 5, 100 (spike), 5
+    r0 = [snap(0, 10.0, 2, 10.0), snap(0, 20.0, 4, 210.0),
+          snap(0, 30.0, 6, 220.0)]
+    # rank 1 adds an extra flush at t=5 (mean 1000 warmup), then steady
+    # 4ms windows at the same wall times as rank 0
+    r1 = [snap(1, 5.0, 1, 1000.0), snap(1, 10.0, 3, 1008.0),
+          snap(1, 20.0, 5, 1016.0), snap(1, 30.0, 7, 1024.0)]
+    rep = report.build_run_report({0: r0, 1: r1})
+    # every 2-rank bucket blames rank 0 (5>4, 100>4, 5>4); the t=5
+    # warmup bucket has one rank and is skipped
+    assert rep["straggler_windows"] == {0: 3}, rep["straggler_windows"]
+
+
+def test_report_straggler_single_bucket_merge():
+    """A rank double-flushing inside one bucket is averaged, not
+    double-counted."""
+    bounds = [1e9]
+
+    def snap(rank, ts, count, total_ms):
+        return {"ts": ts, "rank": rank, "seq": 0, "counters": {},
+                "gauges": {},
+                "histograms": {"step_time_ms": {
+                    "bounds": bounds, "counts": [count, 0],
+                    "count": count, "sum": total_ms,
+                    "min": 1.0, "max": 1e3}}}
+
+    r0 = [snap(0, 10.0, 2, 20.0), snap(0, 20.0, 4, 40.0)]
+    r1 = [snap(1, 10.0, 2, 10.0), snap(1, 10.4, 3, 15.0),
+          snap(1, 20.0, 5, 25.0)]
+    rep = report.build_run_report({0: r0, 1: r1})
+    # rank 0 mean 10ms per window vs rank 1 5ms -> rank 0 in each bucket
+    assert rep["straggler_windows"] == {0: 2}, rep["straggler_windows"]
+
+
+# ------------------------------------------------ dynamic_flops fallback
+
+def test_flops_bare_layer_counts():
+    """ISSUE 6 satellite (PR-5 leftover): a bare leaf layer used as the
+    whole net gets hooked (named_sublayers never yields the net itself;
+    it used to count 0 and telemetry read MFU=0)."""
+    from paddle_tpu.hapi.dynamic_flops import flops
+    assert flops(nn.Linear(8, 4), [2, 8]) == 2 * 8 * 4
+    assert flops(nn.Linear(8, 4), [-1, 8]) == 8 * 4
+
+
+def test_telemetry_6n_tokens_fallback_no_table_model():
+    """A model with NO table-registered leaves falls back to the
+    6*N_params*tokens estimate instead of leaving MFU at 0."""
+    class AllCustom(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.w = self.create_parameter(shape=[8, 4])
+
+        def forward(self, x):
+            return paddle.matmul(x.astype("float32"), self.w)
+
+    reg = metrics.enable(out_dir=None, interval_s=0)
+    try:
+        net = AllCustom()
+        cb = telemetry.TelemetryCallback()
+        cb.set_model(net)
+        cb.on_train_begin()
+        x = paddle.to_tensor(np.zeros((2, 8), dtype="int64"))
+        cb.batch_ready(x)   # int [2, 8] input -> 16 tokens
+        assert cb.flops_per_step == 6 * 32 * 16
+        cb.on_train_batch_end(0)
+        assert reg.gauge("mfu_pct").value > 0
+    finally:
+        metrics.disable()

@@ -1,0 +1,118 @@
+"""Decode parity + load acceptance for the serving tier (ISSUE 6).
+
+The contract that makes paged serving safe to ship: the paged decode
+produces the SAME greedy tokens (and logits to float tolerance) as the
+dense compiled decode of ``models/gpt.py`` — including a request whose
+context spans a page boundary and one evicted + re-admitted mid-stream.
+The Poisson soak rides behind ``@pytest.mark.slow``.
+"""
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="module")
+def seeded_model():
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+    paddle.seed(1234)
+    m = GPTForCausalLM(gpt_tiny())
+    m.eval()
+    return m
+
+
+def _dense_greedy(model, prompt, n):
+    import paddle_tpu as paddle
+    ids = paddle.to_tensor(np.asarray([prompt], dtype="int64"))
+    out = model.generate(ids, max_new_tokens=n, temperature=0.0)
+    return out.numpy()[0, len(prompt):].tolist()
+
+
+def test_paged_vs_dense_greedy_parity_with_block_boundary(seeded_model):
+    """page_size=4 with an 11-token prompt + 8 new tokens: the context
+    crosses THREE page boundaries mid-stream; tokens must match the
+    dense compiled decode exactly and per-step decode logits must match
+    the incremental dense-cache logits to tolerance."""
+    import paddle_tpu as paddle
+    from paddle_tpu.serving import ServingEngine
+    rng = np.random.RandomState(0)
+    prompt = rng.randint(1, 256, size=11).tolist()
+    n = 8
+    eng = ServingEngine(seeded_model, page_size=4, num_pages=32,
+                        max_slots=2)
+    eng.capture_logits = []
+    req = eng.submit(prompt, max_new_tokens=n)
+    eng.run_until_idle()
+    got = req.result(10)
+    want = _dense_greedy(seeded_model, prompt, n)
+    assert got == want, (got, want)
+    # logits tolerance: dense eager full-context forward vs the captured
+    # paged step logits at the first step, a page-boundary-crossing step
+    # (position 12 = page 3's first slot) and the last step
+    checks = {0, 2, len(eng.capture_logits) - 1}
+    for i, (slot_map, logits) in enumerate(eng.capture_logits):
+        if i not in checks:
+            continue
+        slot = next(s for s, rid in slot_map.items()
+                    if rid == req.request_id)
+        ctx = prompt + want[:i + 1]
+        ids = paddle.to_tensor(np.asarray([ctx], dtype="int64"))
+        dense = seeded_model(ids).numpy()[0, -1]
+        np.testing.assert_allclose(logits[slot], dense, rtol=2e-3,
+                                   atol=2e-4)
+
+
+def test_evicted_readmitted_parity(seeded_model):
+    """A request preempted mid-stream (pages freed, recompute prefill on
+    re-admission) finishes with the same tokens as an uncontended run."""
+    from paddle_tpu.serving import ServingEngine
+    rng = np.random.RandomState(1)
+    p1 = rng.randint(1, 256, size=7).tolist()
+    p2 = rng.randint(1, 256, size=6).tolist()
+    # 5 usable pages (page 0 is scrap), page_size 4: two requests growing
+    # to 15-16 tokens cannot coexist -> someone gets evicted
+    eng = ServingEngine(seeded_model, page_size=4, num_pages=6,
+                        max_slots=2)
+    r1 = eng.submit(p1, max_new_tokens=8)
+    r2 = eng.submit(p2, max_new_tokens=8)
+    eng.run_until_idle()
+    assert eng.scheduler.total_evictions >= 1
+    assert r1.evictions + r2.evictions >= 1
+    assert r1.result(10) == _dense_greedy(seeded_model, p1, 8)
+    assert r2.result(10) == _dense_greedy(seeded_model, p2, 8)
+
+
+def test_concurrent_requests_do_not_cross_pollute(seeded_model):
+    """Three ragged-length requests decoded in ONE continuous batch each
+    match their solo dense decode (block tables isolate rows)."""
+    from paddle_tpu.serving import ServingEngine
+    rng = np.random.RandomState(2)
+    prompts = [rng.randint(1, 256, size=ln).tolist() for ln in (3, 9, 14)]
+    eng = ServingEngine(seeded_model, page_size=4, num_pages=64,
+                        max_slots=4)
+    reqs = [eng.submit(p, max_new_tokens=6) for p in prompts]
+    eng.run_until_idle()
+    for p, r in zip(prompts, reqs):
+        assert r.result(10) == _dense_greedy(seeded_model, p, 6)
+
+
+@pytest.mark.slow
+def test_poisson_soak_background_thread(seeded_model):
+    """Open-loop Poisson load against the threaded engine: everything
+    completes, tail stats are sane, and the pool drains to empty."""
+    from paddle_tpu.serving import ServingEngine, run_poisson_load
+    eng = ServingEngine(seeded_model, page_size=4, num_pages=48,
+                        max_slots=4)
+    eng.start()
+    try:
+        res = run_poisson_load(eng, n_requests=24, qps=40.0,
+                               prompt_len=(4, 16), max_new_tokens=6,
+                               seed=3, timeout=300.0)
+    finally:
+        eng.close()
+    assert res["requests_failed"] == 0
+    assert res["requests_ok"] == 24
+    assert res["tokens"] == 24 * 6
+    assert res["tokens_per_sec"] > 0
+    assert res["ttft_ms_p99"] >= res["ttft_ms_p50"] > 0
+    assert res["itl_ms_p99"] >= res["itl_ms_p50"] > 0
+    assert eng.kv.allocator.used_pages == 0

@@ -364,6 +364,44 @@ def bench_gpt(peak):
     return mfu, t, tokens / t, n_params
 
 
+# ONE copy of each jnp reference chain: the legacy kernel legs and the
+# A/B gate leg must time the SAME baseline formula, or a tweak to one
+# silently desynchronizes the verdicts from the r01+ trajectory rows.
+_ADAMW_ARGS = (1e-3, 0.9, 0.999, 1e-8, 0.01, 1.0 / (1 - 0.9),
+               1.0 / (1 - 0.999))
+
+
+def _jnp_adamw_ref(w, g, m, v, args=_ADAMW_ARGS):
+    lr, b1, b2, eps, wd, bc1, bc2 = args
+    w = w * (1 - lr * wd)
+    m = b1 * m + (1 - b1) * g
+    v = b2 * v + (1 - b2) * g * g
+    return w - lr * (m * bc1) / (jnp.sqrt(v * bc2) + eps), m, v
+
+
+def _jnp_rms_ref(x, w, eps=1e-6):
+    xf = x.astype(jnp.float32)
+    inv = jax.lax.rsqrt(jnp.mean(xf * xf, -1, keepdims=True) + eps)
+    return (xf * inv * w).astype(x.dtype)
+
+
+def _jnp_ln_ref(x, w, b, eps=1e-5):
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, -1, keepdims=True)
+    var = jnp.mean((xf - mu) ** 2, -1, keepdims=True)
+    return ((xf - mu) * jax.lax.rsqrt(var + eps) * w + b).astype(x.dtype)
+
+
+def _jnp_sdpa_ref(q, k, v):
+    qf, kf, vf = (jnp.swapaxes(t.astype(jnp.float32), 1, 2)
+                  for t in (q, k, v))
+    s = jnp.einsum("bhsd,bhtd->bhst", qf, kf) / np.sqrt(q.shape[-1])
+    mask = jnp.tril(jnp.ones(s.shape[-2:], bool))
+    s = jnp.where(mask, s, -1e30)
+    o = jnp.einsum("bhst,bhtd->bhsd", jax.nn.softmax(s, -1), vf)
+    return jnp.swapaxes(o, 1, 2).astype(q.dtype)
+
+
 def bench_fused_adamw():
     """Pallas fused AdamW vs the jnp composition, 8M-param update
     (reference capability: fused_adam_kernel.cu)."""
@@ -375,8 +413,7 @@ def bench_fused_adamw():
     g = jnp.asarray(rng.randn(n), jnp.float32)
     m = jnp.zeros(n, jnp.float32)
     v = jnp.zeros(n, jnp.float32)
-    args = (1e-3, 0.9, 0.999, 1e-8, 0.01, 1.0 / (1 - 0.9),
-            1.0 / (1 - 0.999))
+    args = _ADAMW_ARGS
 
     @jax.jit
     def run_fused(w, g, m, v):
@@ -385,18 +422,11 @@ def bench_fused_adamw():
             return fused_adamw(w, g, m, v, *args)
         return jax.lax.fori_loop(0, chain, body, (w, m, v))
 
-    def jnp_update(w, g, m, v):
-        lr, b1, b2, eps, wd, bc1, bc2 = args
-        w = w * (1 - lr * wd)
-        m = b1 * m + (1 - b1) * g
-        v = b2 * v + (1 - b2) * g * g
-        return w - lr * (m * bc1) / (jnp.sqrt(v * bc2) + eps), m, v
-
     @jax.jit
     def run_jnp(w, g, m, v):
         def body(i, c):
             w, m, v = c
-            return jnp_update(w, g, m, v)
+            return _jnp_adamw_ref(w, g, m, v)
         return jax.lax.fori_loop(0, chain, body, (w, m, v))
 
     t_fused = _timeit(lambda: run_fused(w, g, m, v)[0], 5) / chain
@@ -423,11 +453,7 @@ def bench_layer_norm():
     @jax.jit
     def run_jnp(x):
         def body(i, x):
-            xf = x.astype(jnp.float32)
-            mu = jnp.mean(xf, -1, keepdims=True)
-            var = jnp.mean((xf - mu) ** 2, -1, keepdims=True)
-            return ((xf - mu) * jax.lax.rsqrt(var + 1e-5) * w + b
-                    ).astype(x.dtype)
+            return _jnp_ln_ref(x, w, b)
         return jax.lax.fori_loop(0, chain, body, x)
 
     t_pallas = _timeit(lambda: run_pallas(x), 5) / chain
@@ -453,15 +479,139 @@ def bench_rms_norm():
     @jax.jit
     def run_jnp(x):
         def body(i, x):
-            xf = x.astype(jnp.float32)
-            inv = jax.lax.rsqrt(jnp.mean(xf * xf, -1, keepdims=True)
-                                + 1e-6)
-            return (xf * inv * w).astype(x.dtype)
+            return _jnp_rms_ref(x, w)
         return jax.lax.fori_loop(0, chain, body, x)
 
     t_pallas = _timeit(lambda: run_pallas(x), 5) / chain
     t_jnp = _timeit(lambda: run_jnp(x), 5) / chain
     return t_pallas * 1e3, t_jnp * 1e3
+
+
+def bench_kernels_ab():
+    """One A/B row + demotion verdict per Pallas kernel through the
+    generalized gate (ops/pallas/_common.ab_gate). Runs BEFORE the gpt
+    legs so a kernel that WINS at the bench shapes is promoted for them
+    under PADDLE_TPU_KERNELS=auto — and a kernel that loses is demoted
+    off the default path (acceptance: no losing Pallas kernel serves).
+    The legacy fused_adamw/rms_norm/layer_norm rows are kept unchanged
+    for r01–r05 trajectory continuity."""
+    from paddle_tpu.ops.pallas import _common as gate
+    from paddle_tpu.ops.pallas.flash_attention import flash_attention_bshd
+    from paddle_tpu.ops.pallas.fused_adamw import fused_adamw
+    from paddle_tpu.ops.pallas.layer_norm import layer_norm
+    from paddle_tpu.ops.pallas.rms_norm import rms_norm
+
+    rng = np.random.RandomState(0)
+    rows = {}
+
+    # fused AdamW at the 8M legacy shape plus 1M and 256k anchors: the
+    # optimizer gates per-param via nearest-verdict (same dtype, 4x size
+    # band), and the three bands [64k,1M]∪[256k,4M]∪[2M,32M] tile
+    # 64k..32M with no hole
+    for label, n in {"fused_adamw": 8 * 1024 * 1024,
+                     "fused_adamw_mid": 1024 * 1024,
+                     "fused_adamw_small": 256 * 1024}.items():
+        w = jnp.asarray(rng.randn(n), jnp.float32)
+        g = jnp.asarray(rng.randn(n), jnp.float32)
+        m = jnp.zeros(n, jnp.float32)
+        v = jnp.zeros(n, jnp.float32)
+        # recorded under the leading-operand sig the call sites query
+        # (optimizer._gate_allows uses shape_sig(w))
+        rows[label] = gate.ab_gate(
+            "fused_adamw", _jnp_adamw_ref,
+            lambda w, g, m, v: fused_adamw(w, g, m, v, *_ADAMW_ARGS),
+            (w, g, m, v), sig=gate.shape_sig(w))
+
+    # norms at the legacy [4096, 4096] bf16 shape
+    x = jnp.asarray(rng.randn(4096, 4096), jnp.bfloat16)
+    nw = jnp.asarray(rng.randn(4096), jnp.float32)
+    nb = jnp.asarray(rng.randn(4096), jnp.float32)
+    rows["rms_norm"] = gate.ab_gate(
+        "rms_norm", _jnp_rms_ref,
+        lambda x, w: rms_norm(x, w).astype(x.dtype), (x, nw),
+        sig=gate.shape_sig(x))
+    rows["layer_norm"] = gate.ab_gate(
+        "layer_norm", _jnp_ln_ref,
+        lambda x, w, b: layer_norm(x, w, b).astype(x.dtype), (x, nw, nb),
+        sig=gate.shape_sig(x))
+
+    # flash attention at BOTH whole-step attention shapes (gpt + gpt_large)
+    # so the auto gate covers the MFU legs that follow. Recorded under the
+    # (q, k) sig — the sig F.scaled_dot_product_attention's eligibility
+    # gate queries at the call site.
+    for label, (B, S, H, D) in {"flash_attention": (16, 512, 8, 64),
+                                "flash_attention_large": (8, 1024, 16, 64)
+                                }.items():
+        q = jnp.asarray(rng.randn(B, S, H, D), jnp.bfloat16)
+        k = jnp.asarray(rng.randn(B, S, H, D), jnp.bfloat16)
+        v = jnp.asarray(rng.randn(B, S, H, D), jnp.bfloat16)
+        rows[label] = gate.ab_gate(
+            "flash_attention", _jnp_sdpa_ref,
+            lambda q, k, v: flash_attention_bshd(q, k, v, causal=True),
+            (q, k, v), sig=gate.shape_sig(q, k))
+
+    # paged attention at a serving decode shape (shares the serving
+    # engine's verdict cache through decode.ab_compare)
+    from paddle_tpu.serving.decode import ab_compare
+    P, page, Hh, Dh, B = 256, 16, 8, 64, 8
+    qd = jnp.asarray(rng.randn(B, Hh, Dh), jnp.float32)
+    kp = jnp.asarray(rng.randn(P, page, Hh, Dh), jnp.float32)
+    vp = jnp.asarray(rng.randn(P, page, Hh, Dh), jnp.float32)
+    bt = rng.randint(1, P, (B, 8)).astype(np.int32)
+    lens = rng.randint(1, 8 * page, B).astype(np.int32)
+    rows["paged_attention"] = ab_compare(qd, kp, vp, bt, lens, repeats=10)
+    return rows
+
+
+def bench_fit_split(fast):
+    """Step split of the fused donated train step under hapi.Model.fit
+    with the amortized loss fetch — the PR-5 telemetry paying for itself:
+    compute_ms is now dispatch-only, sync_ms appears only on fetch steps,
+    and the p50s land in BENCH_RUN_REPORT.json as the before/after
+    evidence for each hot-path win."""
+    from paddle_tpu.io import Dataset
+    from paddle_tpu.observability import metrics as obsm
+    from paddle_tpu.observability.metrics import hist_quantile
+
+    paddle.seed(0)
+    if fast:
+        cfg = GPTConfig(vocab_size=512, hidden_size=128, num_layers=2,
+                        num_heads=4, max_seq_len=128, dropout=0.0)
+        B, S, steps = 4, 64, 8
+    else:
+        cfg = GPTConfig(vocab_size=4096, hidden_size=256, num_layers=4,
+                        num_heads=8, max_seq_len=256, dropout=0.0)
+        B, S, steps = 8, 256, 30
+    net = GPTForCausalLM(cfg)
+    crit = GPTPretrainingCriterion(cfg)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (steps * B, S + 1)).astype("int32")
+
+    class DS(Dataset):
+        def __getitem__(self, i):
+            return ids[i, :-1], ids[i, 1:].astype("int64")
+
+        def __len__(self):
+            return len(ids)
+
+    model = paddle.Model(net)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=net.parameters())
+    model.prepare(optimizer=opt, loss=lambda out, y: crit(out, y))
+    reg = obsm.get_registry()
+    # compile warmup outside the wall clock (the split histograms keep the
+    # two warm steps too — the p50s are robust to them)
+    model.fit(DS(), batch_size=B, epochs=1, shuffle=False, verbose=0,
+              num_iters=2)
+    t0 = time.perf_counter()
+    model.fit(DS(), batch_size=B, epochs=1, shuffle=False, verbose=0)
+    wall = time.perf_counter() - t0
+    out = {"gpt_fit_steps_per_sec": round(steps / wall, 2)}
+    for h in ("step_time_ms", "compute_ms", "sync_ms", "data_wait_ms"):
+        d = reg.histogram(h).to_dict()
+        if d.get("count"):
+            out[f"gpt_fit_{h}_p50"] = round(hist_quantile(d, 0.5), 3)
+    return out
 
 
 def bench_gpt_large(peak, amp_level="O1"):
@@ -1123,6 +1273,19 @@ def main():
         _log(f"[bench] layer norm: pallas {ln_ms:.3f}ms vs jnp "
              f"{ln_jnp_ms:.3f}ms")
 
+    def _kernels_ab():
+        rows = bench_kernels_ab()
+        for name, row in rows.items():
+            sub[f"kernel_ab_{name}_backend"] = row["backend"]
+            if row.get("xla_ms") is not None:
+                sub[f"kernel_ab_{name}_xla_ms"] = row["xla_ms"]
+            if row.get("pallas_ms") is not None:
+                sub[f"kernel_ab_{name}_pallas_ms"] = row["pallas_ms"]
+            sub[f"kernel_ab_{name}_gate"] = row["reason"]
+            _log(f"[bench] kernel A/B {name}: {row['backend']} "
+                 f"(xla {row.get('xla_ms')}ms / pallas "
+                 f"{row.get('pallas_ms')}ms — {row['reason']})")
+
     def _gpt():
         gpt_mfu, gpt_t, tok_s, n_params = bench_gpt(peak)
         sub["gpt_step_ms"] = round(gpt_t * 1e3, 2)
@@ -1171,15 +1334,29 @@ def main():
         guarded("fused_adamw", _fused)
         guarded("rms_norm", _rms)
         guarded("layer_norm", _ln)
+        # A/B gate rows BEFORE the gpt legs: a kernel that wins at these
+        # exact shapes is promoted for the MFU measurements that follow;
+        # a loser is demoted off their default path (auto mode)
+        guarded("kernels_ab", _kernels_ab)
     guarded("gpt", _gpt)
     if not _FAST and on_tpu:
         guarded("matmul_sweep", _matmul_sweep)
         guarded("gpt_large", _gpt_large)
         guarded("gpt_large_o2", _gpt_large_o2)
         guarded("generate", _generate)
-    # LAST on purpose: this is the first point the metrics registry is
+    def _fit_split():
+        # metrics-on fit of the fused donated train step: the amortized
+        # compute/sync split is this PR's before/after evidence (r05's
+        # per-step blocking loss fetch showed up as the sync regression)
+        _ensure_obsreg()
+        rows = bench_fit_split(_FAST or not on_tpu)
+        sub.update(rows)
+        _log(f"[bench] fit split: {rows}")
+
+    # LAST on purpose: these are the first points the metrics registry is
     # enabled, so no legacy leg above ever runs with per-op dispatch
     # instrumentation active (eager decode in _generate included)
+    guarded("fit_split", _fit_split)
     guarded("eager_dispatch_telemetry", _eager_telemetry)
     if "value" not in snap:
         snap.update(metric="gpt_train_step_mfu", value=0.0, unit="%",
@@ -1199,6 +1376,14 @@ def main():
             "eager_dispatch_us_per_op",
             "eager_dispatch_us_per_op_telemetry",
             "dp8_comm_overlap_pct") if k in sub}
+        # before/after step split for the perf round: the fused-step fit
+        # split rows + the whole-step wall time next to each other
+        rep["step_split"] = {k: sub[k] for k in sub
+                             if k.startswith("gpt_fit_")
+                             or k in ("gpt_step_ms", "gpt_tokens_per_sec",
+                                      "lenet_train_steps_per_sec")}
+        from paddle_tpu.ops.pallas._common import gate_report
+        rep["kernel_gate"] = gate_report()
         rpath = os.path.join(os.path.dirname(_SNAPSHOT),
                              "BENCH_RUN_REPORT.json")
         with open(rpath, "w") as f:

@@ -303,6 +303,11 @@ def _unwrap_hook_result(r):
 
 def backward(tensors, grad_tensors=None, retain_graph=False):
     """paddle.autograd.backward — reference: eager/backward.cc:428."""
+    from . import dispatch as _dispatch
+    if _dispatch._nan_pending:
+        # a widened FLAGS_check_nan_inf_window defers the blocking flag
+        # fetch; a backward pass is a natural sync point to surface it
+        _dispatch.flush_nan_checks()
     if not isinstance(tensors, (list, tuple)):
         tensors = [tensors]
     if grad_tensors is None:

@@ -6,6 +6,37 @@ one :func:`apply` call: unwrap ``jax.Array``s, run the jnp/lax implementation (X
 dispatches to the current device — kernel selection, data transform, and the kernel
 registry of the reference all collapse into PjRt), and, when autograd is live, record
 the ``jax.vjp`` pullback on the tape (replacing generated GradNodes).
+
+Fast path (ROADMAP item 4, the O(10 µs) target): with every instrumentation
+hook off, one taped op is
+
+* one read of each hook global (no imports, no registry resolution — the
+  Tensor class, AMP state and metrics handle are resolved once per process),
+* ONE dict lookup in the persistent compiled-callable cache
+  (:data:`_jit_cache`, keyed per (op name, fwd code identity, closure
+  constants, static-arg positions)), and
+* ONE call into the cached ``jax.jit`` wrapper — jax's C++ pjit fast path
+  keys on shape/dtype/device internally, so a shape, dtype or device change
+  retraces exactly that signature and nothing else.
+
+Python scalars in the input list are baked as jit static arguments, so a
+chained ``r * 1.0001`` loop ships NO per-op host constants to the device —
+this is what fixes the chained-dispatch row being slower than the single-op
+row (each chained op used to re-transfer its scalar operand). Ops are
+compiled on their SECOND occurrence (``_jit_seen``): one-shot signatures
+(sweeps over distinct closure constants) never pay a compile. Anything the
+cache cannot prove safe — unhashable closure cells, tracer inputs (an outer
+``to_static`` trace is already staging), zero-array creation ops, a fwd
+that needs concrete values — falls back to the direct eager call, which is
+exactly the pre-cache behavior.
+
+The NaN check (``FLAGS_check_nan_inf``) is evaluated OUTSIDE the compiled
+callable and can be batched: ``FLAGS_check_nan_inf_window=N`` defers the
+blocking device→host flag fetch until N results are pending (one stacked
+fetch instead of one sync per op), at the cost of the error surfacing up to
+N-1 ops late. The default window of 1 keeps the reference's raise-at-the-op
+semantics. Toggling the flag takes effect immediately — the check is not
+part of the compiled program, so cached entries survive the toggle.
 """
 from __future__ import annotations
 
@@ -13,25 +44,45 @@ from typing import Any, Callable, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from . import autograd
 from .dtype import is_complex, is_floating
+
+_Tensor = None  # resolved once (core.tensor imports ops which import us)
+
+
+def _tensor_cls():
+    global _Tensor
+    if _Tensor is None:
+        from .tensor import Tensor
+        _Tensor = Tensor
+    return _Tensor
 
 
 def _is_diff(t) -> bool:
     # complex counts: fft/complex-op chains carry gradients in the
     # reference (jax.vjp handles the conjugate conventions)
-    from .tensor import Tensor
-    return (isinstance(t, Tensor) and not t.stop_gradient
+    T = _Tensor or _tensor_cls()
+    return (isinstance(t, T) and not t.stop_gradient
             and (is_floating(t.dtype) or is_complex(t.dtype)))
 
 
 def _unwrap(t):
-    from .tensor import Tensor
-    return t._data if isinstance(t, Tensor) else t
+    T = _Tensor or _tensor_cls()
+    return t._data if isinstance(t, T) else t
 
 
+_amp_state = None  # the (threading.local) amp state object, resolved once
 _amp_dtype_for = None
+
+
+def _amp_enabled():
+    global _amp_state
+    if _amp_state is None:
+        from ..amp.auto_cast import _state
+        _amp_state = _state
+    return _amp_state.enabled
 
 
 def _amp_cast(name, inputs):
@@ -42,13 +93,13 @@ def _amp_cast(name, inputs):
     if _amp_dtype_for is None:
         from ..amp.auto_cast import amp_dtype_for as _f
         _amp_dtype_for = _f
-    from .tensor import Tensor
+    T = _Tensor or _tensor_cls()
     target = _amp_dtype_for(name)
     if target is None:
         return inputs
     out = []
     for t in inputs:
-        if isinstance(t, Tensor) and is_floating(t.dtype) \
+        if isinstance(t, T) and is_floating(t.dtype) \
                 and t.dtype != target and t.dtype != jnp.float64:
             out.append(t.astype(target))
         else:
@@ -111,6 +162,122 @@ def apply(name: str, fwd: Callable, inputs: Sequence[Any], nout: int = 1,
             hook(name, t0, t1, inputs, result)
 
 
+# ---- persistent compiled-callable cache ------------------------------------
+
+_jit_cache: dict = {}       # key -> (jitted fn, keepalive object)
+_jit_seen: set = set()      # keys seen once; compiled on 2nd occurrence
+_jit_blacklist: set = set()
+_jit_keepalive: dict = {}   # key -> keyed object, for seen/blacklisted
+# keys too: an id()-based key whose object was freed could be recycled by
+# a NEW callable and wrongly inherit the old key's seen/blacklist fate
+_JIT_CACHE_MAX = 1024
+_JIT_SEEN_MAX = 8192
+_STATIC_OK = (int, float, bool, str, bytes)
+_ARRAY_TYPES = (jax.Array, np.ndarray, np.generic)
+_TRACER = jax.core.Tracer
+
+
+def _reset_jit_cache():
+    """Drop every cached compiled callable (tests / debugging)."""
+    _jit_cache.clear()
+    _jit_seen.clear()
+    _jit_blacklist.clear()
+    _jit_keepalive.clear()
+
+
+def _fwd_key(name, fwd):
+    """(cache key, keepalive) for a fwd callable, or (None, None) when the
+    callable cannot be safely keyed: the key is the code object's identity
+    plus the closure's immutable-scalar constants — a per-call lambda built
+    from the same source with the same constants hits the same entry. The
+    keepalive pins the keyed object so its id can never be recycled."""
+    code = getattr(fwd, "__code__", None)
+    if code is None:
+        # builtin / ufunc (e.g. jnp.multiply): module-level, identity-keyed
+        return (name, id(fwd)), fwd
+    if getattr(fwd, "__self__", None) is not None:
+        # bound method: the receiver's state is neither in the code id nor
+        # the closure — two instances would collide on one entry
+        return None, None
+    cells = fwd.__closure__
+    if not cells:
+        return (name, id(code)), code
+    vals = []
+    for cell in cells:
+        try:
+            v = cell.cell_contents
+        except ValueError:  # empty cell
+            return None, None
+        if v is None or type(v) in _STATIC_OK:
+            # key by (type, repr): plain values would collide across
+            # numerically-equal types (1 == 1.0 == True) and signed zeros
+            # (0.0 == -0.0), silently serving a program traced with the
+            # other constant
+            vals.append((type(v).__name__, repr(v)))
+        else:  # arrays, Tensors, functions, mutables: not value-keyable
+            return None, None
+    return (name, id(code), tuple(vals)), code
+
+
+def _run_fwd(name, fwd, arrs):
+    """Execute an op forward through the compiled-callable cache (ONE dict
+    lookup + ONE pjit call on the steady-state path), falling back to the
+    plain eager call wherever caching cannot be proven safe."""
+    statics = None
+    for i, a in enumerate(arrs):
+        if isinstance(a, _TRACER):
+            return fwd(*arrs)  # outer trace in flight: it stages the op
+        if isinstance(a, _ARRAY_TYPES):
+            continue
+        if a is not None and not isinstance(a, _STATIC_OK):
+            return fwd(*arrs)  # unhashable operand: direct
+        if statics is None:
+            statics = [i]
+        else:
+            statics.append(i)
+    if statics is not None and len(statics) == len(arrs):
+        # creation-style op (no array operands): closure/static constants
+        # vary per call site — caching would churn compiles
+        return fwd(*arrs)
+    key, keep = _fwd_key(name, fwd)
+    if key is None:
+        return fwd(*arrs)
+    if statics is not None:
+        # static VALUES are keyed by jax.jit internally by ==/hash, which
+        # collides 1 with 1.0 with True and +0.0 with -0.0 — key the
+        # wrapper on (type, repr) per static so numerically-equal-but-
+        # distinct operands never share a traced program (repr splits the
+        # signed zeros; a loop reusing ONE scalar still hits one entry)
+        key = (key, tuple(statics),
+               tuple((type(arrs[i]).__name__, repr(arrs[i]))
+                     for i in statics))
+    entry = _jit_cache.get(key)
+    if entry is None:
+        if key in _jit_blacklist:
+            return fwd(*arrs)
+        if key not in _jit_seen:
+            if len(_jit_seen) < _JIT_SEEN_MAX:
+                _jit_seen.add(key)
+                _jit_keepalive[key] = keep
+            return fwd(*arrs)  # compile only on the 2nd occurrence
+        if len(_jit_cache) >= _JIT_CACHE_MAX:
+            return fwd(*arrs)
+        entry = (jax.jit(fwd, static_argnums=tuple(statics or ())), keep)
+        _jit_cache[key] = entry
+    try:
+        return entry[0](*arrs)
+    except Exception:
+        # anything the jitted wrapper cannot express (concrete-value
+        # control flow, unhashable static, jit-only tracing error) —
+        # drop the entry and re-run eagerly so real user errors surface
+        # from the exact code path they always did
+        _jit_cache.pop(key, None)
+        if len(_jit_blacklist) < _JIT_SEEN_MAX:
+            _jit_blacklist.add(key)
+            _jit_keepalive[key] = keep
+        return fwd(*arrs)
+
+
 def _apply_impl(name: str, fwd: Callable, inputs: Sequence[Any],
                 nout: int = 1, has_aux: bool = False):
     """Execute an eager op through the autograd tape.
@@ -122,39 +289,41 @@ def _apply_impl(name: str, fwd: Callable, inputs: Sequence[Any],
         non-differentiable (e.g. argmax indices).
     Returns Tensor or tuple of Tensors (diff outputs first, then aux).
     """
-    from .tensor import Tensor
+    Tensor = _Tensor or _tensor_cls()
 
-    inputs = _amp_cast(name, inputs)
-    arrs = [_unwrap(t) for t in inputs]
+    st = _amp_state
+    if st.enabled if st is not None else _amp_enabled():
+        inputs = _amp_cast(name, inputs)
+    arrs = [t._data if isinstance(t, Tensor) else t for t in inputs]
     grad_on = autograd.is_grad_enabled()
     diff_idx = [i for i, t in enumerate(inputs) if _is_diff(t)] if grad_on else []
 
     try:
         if not diff_idx:
-            out = fwd(*arrs)
+            out = _run_fwd(name, fwd, arrs)
             if has_aux:
                 primal, aux = out
                 primals = primal if isinstance(primal, tuple) else (primal,)
                 results = [Tensor(p, stop_gradient=True) for p in primals]
                 results += [Tensor(a, stop_gradient=True) for a in aux]
                 if _check_nan_inf:
-                    _nan_check(name, results)
+                    _nan_queue(name, results)
                 return results[0] if len(results) == 1 else tuple(results)
             if nout == 1 and not isinstance(out, tuple):
                 res = Tensor(out, stop_gradient=True)
                 if _check_nan_inf:
-                    _nan_check(name, [res])
+                    _nan_queue(name, [res])
                 return res
             results = tuple(Tensor(o, stop_gradient=True) for o in out)
             if _check_nan_inf:
-                _nan_check(name, results)
+                _nan_queue(name, results)
             return results
 
         # hot path (SURVEY §3.1): run ONLY the forward now; the pullback
         # is deferred to backward (autograd._materialize_vjp) — jax.vjp
         # here would trace+execute the op a second time, ~40x the cost of
         # the forward itself
-        out = fwd(*arrs)
+        out = _run_fwd(name, fwd, arrs)
         if has_aux:
             primal, aux = out
         else:
@@ -172,25 +341,56 @@ def _apply_impl(name: str, fwd: Callable, inputs: Sequence[Any],
                        has_aux=has_aux, lazy=True)
     results = diff_outputs + [Tensor(a, stop_gradient=True) for a in aux]
     if _check_nan_inf:
-        _nan_check(name, results)
+        _nan_queue(name, results)
     return results[0] if len(results) == 1 else tuple(results)
 
 
+# ---- NaN/Inf check (FLAGS_check_nan_inf) -----------------------------------
+
 _check_nan_inf = False  # toggled by FLAGS_check_nan_inf (framework/flags.py)
+_nan_window = 1         # FLAGS_check_nan_inf_window: results per host sync
+_nan_pending: list = []  # (op name, tensor, device-side finite flag)
 
 
-def _nan_check(name, tensors):
-    """Reference: FLAGS_check_nan_inf hook (eager/nan_inf_utils.h). Skipped
-    under tracing (tracers have no concrete values; use jax debug nans
-    for staged programs)."""
+def _nan_queue(name, tensors):
+    """Reference: FLAGS_check_nan_inf hook (eager/nan_inf_utils.h). The
+    finite reduction is issued asynchronously per op; the BLOCKING flag
+    fetch is deferred until ``_nan_window`` results are pending (window 1 =
+    the reference's raise-at-the-op semantics). Skipped under tracing
+    (tracers have no concrete values; use jax debug nans for staged
+    programs)."""
+    pend = _nan_pending
     for t in tensors:
-        if isinstance(t._data, jax.core.Tracer):
+        if isinstance(t._data, _TRACER):
             return
-        if is_floating(t.dtype) and not bool(jnp.all(jnp.isfinite(t._data))):
+        if is_floating(t.dtype):
+            pend.append((name, t, jnp.all(jnp.isfinite(t._data))))
+    if len(pend) >= _nan_window:
+        flush_nan_checks()
+
+
+def flush_nan_checks():
+    """Fetch every pending finite flag in ONE host sync and raise on the
+    first non-finite result (in issue order). No-op when nothing pends."""
+    global _nan_pending
+    if not _nan_pending:
+        return
+    pending, _nan_pending = _nan_pending, []
+    if len(pending) > 1:
+        if bool(jnp.all(jnp.stack([f for _, _, f in pending]))):
+            return
+    for name, t, flag in pending:
+        if not bool(flag):
             raise FloatingPointError(
                 f"(NaN/Inf) op '{name}' produced non-finite values "
                 f"(shape {t.shape}, dtype {t.dtype}); set "
                 "FLAGS_check_nan_inf=False to disable this check")
+
+
+def _nan_check(name, tensors):
+    """Back-compat alias: queue + flush immediately."""
+    _nan_queue(name, tensors)
+    flush_nan_checks()
 
 
 def _passthrough_errors():

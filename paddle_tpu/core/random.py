@@ -18,6 +18,7 @@ import threading
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 class Generator:
@@ -94,3 +95,44 @@ def next_key():
         _trace_rng.counter += 1
         return k
     return _default_generator.next_key()
+
+
+def next_key_spec():
+    """HOST-side step-key descriptor: a ``np.uint32[3]`` ``[seed_hi,
+    seed_lo, counter]`` array, advancing the global generator exactly like
+    :func:`next_key`.
+
+    The eager ``next_key()`` issues two device ops per call
+    (``jax.random.key`` + ``fold_in``) — several ms per step through a
+    remote-tunnel device. A compiled train step instead takes this numpy
+    spec as a plain input and derives the identical key IN-program via
+    :func:`derive_key`, so a step consumes zero eager dispatches for RNG.
+
+    The seed ships as the 64-bit two's-complement value split hi/lo (under
+    the default threefry impl these ARE the key words), so derivation is
+    bit-identical to the eager key for ANY integer seed, negative
+    included. Counters wrap at 2**32 (4B steps).
+    """
+    gen = _default_generator
+    s64 = int(gen._seed) & 0xFFFFFFFFFFFFFFFF
+    spec = np.asarray([s64 >> 32, s64 & 0xFFFFFFFF,
+                       gen._counter % (2 ** 32)], np.uint32)
+    gen._counter += 1
+    return spec  # numpy-only: zero device ops on the per-step path
+
+
+def derive_key(spec):
+    """In-trace twin of ``Generator.next_key``: rebuild the key from the
+    spec's seed words and fold in the step counter. Under the default
+    threefry impl the two words ARE the key data (``wrap_key_data`` — the
+    exact inverse of ``key(seed)``); under another jax_default_prng_impl
+    (e.g. ``rbg``, whose key data is uint32[4]) the 64-bit seed is
+    reassembled and fed to ``jax.random.key`` so the derivation stays
+    impl-generic. Bit-identical to the eager key either way."""
+    impl = getattr(jax.config, "jax_default_prng_impl", "threefry2x32")
+    if impl == "threefry2x32":
+        base = jax.random.wrap_key_data(spec[:2])
+    else:  # impl-generic: key() accepts a (traced) integer seed
+        seed = (spec[0].astype(jnp.int64) << 32) | spec[1].astype(jnp.int64)
+        base = jax.random.key(seed)
+    return jax.random.fold_in(base, spec[2])

@@ -15,9 +15,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import autograd
+from . import dispatch
 from .dtype import convert_dtype, get_default_dtype, is_floating
 
 _tensor_counter = [0]
+_ops_mod = None  # paddle_tpu.ops, resolved once by _binop (circular import)
 
 
 class Tensor:
@@ -86,9 +88,17 @@ class Tensor:
 
     # ---- value access ----
     def numpy(self) -> np.ndarray:
+        if dispatch._nan_pending:
+            # a widened FLAGS_check_nan_inf_window defers the NaN flag
+            # fetch; a host read is a sync point anyway, so surface the
+            # pending error here instead of dropping it in forward-only
+            # runs that never reach backward()
+            dispatch.flush_nan_checks()
         return np.asarray(self._data)
 
     def item(self):
+        if dispatch._nan_pending:
+            dispatch.flush_nan_checks()
         return self._data.item()
 
     def tolist(self):
@@ -317,8 +327,14 @@ class Tensor:
 
     # ---- arithmetic operators (delegate to ops.math through the tape) ----
     def _binop(self, other, opname, reverse=False):
-        from .. import ops
-        fn = getattr(ops, opname)
+        # the ops module is resolved ONCE (a per-op `from .. import ops`
+        # runs the import machinery on every arithmetic operator — the
+        # dispatch fast path budget is O(10 µs), imports don't fit)
+        global _ops_mod
+        if _ops_mod is None:
+            from .. import ops as _ops_mod_local
+            _ops_mod = _ops_mod_local
+        fn = getattr(_ops_mod, opname)
         return fn(other, self) if reverse else fn(self, other)
 
     def __add__(self, o):

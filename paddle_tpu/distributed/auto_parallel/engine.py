@@ -244,12 +244,16 @@ class Engine:
 
     def fit(self, train_data, epochs=1, batch_size=None, verbose=0,
             steps_per_epoch=None, lineage=None, snapshot_interval=None,
-            async_snapshot=False):
+            async_snapshot=False, loss_fetch_every=10):
         """``lineage`` (CheckpointLineage or root path) makes this bare
         loop resumable exactly like ``hapi.Model.fit``: restore model /
         optimizer / RNG / position, skip already-consumed batches of the
         resumed epoch, snapshot on the interval + epoch boundaries
-        (optionally overlapped), SIGTERM → save + exit 75."""
+        (optionally overlapped), SIGTERM → save + exit 75.
+
+        ``loss_fetch_every`` amortizes the blocking loss fetch (the host
+        otherwise drains the device pipeline every step); the returned
+        history is exact — lazy losses resolve in one sync at fit end."""
         import numpy as np
         if self.strategy is None:
             self.prepare()
@@ -283,8 +287,12 @@ class Engine:
                     if tm is not None:
                         tm.batch_ready(batch[0])
                     loss = self._step(*batch)
-                    _telemetry.mark_sync_begin()
-                    history.append(float(np.asarray(loss.numpy())))
+                    if loss_fetch_every <= 1 or \
+                            len(history) % loss_fetch_every == 0:
+                        _telemetry.mark_sync_begin()
+                        history.append(float(np.asarray(loss.numpy())))
+                    else:
+                        history.append(loss)  # lazy: resolved at fit end
                     if tm is not None:
                         tm.on_train_batch_end(i)
                     if rt is not None:
@@ -305,6 +313,9 @@ class Engine:
                 tm.on_train_end()
         if rt is not None:
             rt.finalize()
+        if any(not isinstance(v, float) for v in history):
+            from ...hapi.model import Model as _M
+            history = _M._resolve_losses(history)
         return history
 
     def evaluate(self, eval_data, steps=None):

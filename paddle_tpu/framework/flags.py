@@ -38,10 +38,23 @@ def _set_check_nan_inf(v):
     dispatch._check_nan_inf = bool(v)
 
 
+def _set_check_nan_inf_window(v):
+    from ..core import dispatch
+    w = int(v)
+    if w < 1:
+        raise ValueError("FLAGS_check_nan_inf_window must be >= 1")
+    dispatch._nan_window = w
+
+
 # ---- built-in flags (TPU-meaningful subset of the reference's set) ----
 register_flag("FLAGS_check_nan_inf", False,
               "check every eager op output for NaN/Inf "
               "(reference: eager/nan_inf_utils.h)", _set_check_nan_inf)
+register_flag("FLAGS_check_nan_inf_window", 1,
+              "results batched per blocking NaN-check host sync (1 = "
+              "raise at the op, the reference semantics; >1 amortizes "
+              "the device sync, the error may surface up to N-1 ops late)",
+              _set_check_nan_inf_window)
 register_flag("FLAGS_default_dtype", "float32",
               "default floating dtype for tensor creation",
               _set_default_dtype_flag)

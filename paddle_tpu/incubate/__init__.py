@@ -37,7 +37,10 @@ def paged_attention(q, k_cache, v_cache, block_tables, context_lens,
     from ..core.dispatch import apply
 
     if use_pallas is None:
-        use_pallas = interpret or _jax.default_backend() == "tpu"
+        from ..ops.pallas import _common as _gate
+        use_pallas = interpret or (
+            _jax.default_backend() == "tpu" and _gate.pallas_default(
+                "paged_attention", _gate.shape_sig(q), allow_nearest=True))
 
     def f(qa, ka, va, bt, cl):
         if use_pallas:
@@ -70,7 +73,12 @@ def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
     last_axis = begin_norm_axis in (-1, (x.ndim - 1 if hasattr(x, "ndim")
                                          else None))
     if use_pallas is None:
-        use_pallas = interpret or _jax.default_backend() == "tpu"
+        # auto: TPU + the demotion gate (PADDLE_TPU_KERNELS / measured
+        # A/B verdict) — BENCH_r05 showed the kernel losing on-chip
+        from ..ops.pallas import _common as _gate
+        use_pallas = interpret or (
+            _jax.default_backend() == "tpu" and _gate.pallas_default(
+                "rms_norm", _gate.shape_sig(x), allow_nearest=True))
     if use_pallas and last_axis:
         from ..ops.pallas.rms_norm import rms_norm as _pallas_rms
         ins = [x, norm_weight] + ([norm_bias] if norm_bias is not None
@@ -103,7 +111,10 @@ def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5,
 
     last_axis = begin_norm_axis in (-1, x.ndim - 1)
     if use_pallas is None:
-        use_pallas = interpret or _jax.default_backend() == "tpu"
+        from ..ops.pallas import _common as _gate
+        use_pallas = interpret or (
+            _jax.default_backend() == "tpu" and _gate.pallas_default(
+                "layer_norm", _gate.shape_sig(x), allow_nearest=True))
     if use_pallas and last_axis:
         from ..ops.pallas.layer_norm import layer_norm as _pallas_ln
         has_w = norm_weight is not None

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..core import random as _random
 from ..core.dispatch import apply
@@ -35,6 +36,46 @@ __all__ = ["to_static", "not_to_static", "InputSpec", "StaticFunction",
 
 class _EagerFallback(Exception):
     """Internal: this input signature graph-broke before — skip tracing."""
+
+
+def _aval(a):
+    """Abstract value for compiled_text()/aot avals. Mesh shardings matter
+    for SPMD lowering; single-device placements are left off (committed
+    single-device avals would conflict with mesh-sharded peers at lower()
+    time)."""
+    sh = getattr(a, "sharding", None)
+    if sh is not None and hasattr(sh, "mesh"):
+        try:
+            return jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=sh)
+        except Exception:
+            pass
+    return jax.ShapeDtypeStruct(a.shape, a.dtype)
+
+
+_STRUCT_VERSION = None
+
+
+def _struct_version():
+    """The nn.Layer structural version counter (lazy: jit.api must not
+    import nn at module load)."""
+    global _STRUCT_VERSION
+    if _STRUCT_VERSION is None:
+        from ..nn.layer.layers import STRUCT_VERSION
+        _STRUCT_VERSION = STRUCT_VERSION
+    return _STRUCT_VERSION
+
+
+_IDLE_SPEC = None
+
+
+def _idle_rng_spec():
+    """Shared RNG spec for steps whose trace consumed no randomness: the
+    global generator is neither advanced nor touched, and no per-step
+    host→device constant is created."""
+    global _IDLE_SPEC
+    if _IDLE_SPEC is None:
+        _IDLE_SPEC = np.zeros(3, np.uint32)
+    return _IDLE_SPEC
 
 
 class _break_key_scope:
@@ -127,11 +168,12 @@ def _tree_rebuild(skel, arrays, wrap):
 def _amp_key():
     """AMP autocast decisions are baked in at trace time, so the compile
     cache must be keyed on the active auto_cast state (ADVICE r2) —
-    including custom white/black op lists, which also steer amp_dtype_for."""
-    from ..amp.auto_cast import amp_state
-    st = amp_state()
-    return (st.enabled, str(st.dtype), st.level,
-            frozenset(st.white), frozenset(st.black))
+    including custom white/black op lists, which also steer amp_dtype_for.
+    The frozenset construction is cached per state identity (auto_cast
+    replaces, never mutates, the white/black sets) so the per-step key
+    probe is a tuple build, not two set copies."""
+    from ..amp.auto_cast import amp_key_cached
+    return amp_key_cached()
 
 
 def _static_key(skel, tensors, extra):
@@ -183,6 +225,8 @@ class StaticFunction:
         self._full_graph = full_graph
         self._broken_keys = set()  # input signatures that graph-broke
         self._cache = {}
+        self._state_cache = None   # cached _state() walk (invalidate())
+        self._fast_step = {}       # steady-state whole-step dispatch memo
         self._layer = None
         if isinstance(function, Layer):
             self._layer = function
@@ -196,6 +240,37 @@ class StaticFunction:
             self._fn = _convert_fn(self._fn)
 
     # -- state discovery --
+    def invalidate(self):
+        """Drop the cached state walk + fast-dispatch memo. Call after a
+        structural change to a captured module (adding/removing sublayers
+        or parameters, re-materializing optimizer state) — the staged step
+        otherwise keeps using the parameter set discovered at first call."""
+        self._state_cache = None
+        self._fast_step = {}
+
+    def _state_cached(self):
+        """The `_state()` walk (a full recursive parameters()/buffers()
+        traversal of every captured layer) costs O(model size) python per
+        call — caching it is a large slice of the per-step host-overhead
+        win. The cache is guarded by the process-wide Layer structural
+        version: any parameter/sublayer/buffer registration anywhere bumps
+        it, forcing a re-walk (and, if the captured module really changed,
+        a re-key + retrace) — :meth:`invalidate` remains for exotic edits
+        the registration hooks cannot see (direct `_parameters` dict
+        mutation)."""
+        st = getattr(self, "_state_cache", None)
+        if st is not None and _struct_version()[0] != self._state_version:
+            # a Layer somewhere gained/lost a param/sublayer/buffer since
+            # the walk — stale state must never reach the forward path OR
+            # the whole-step slow path, not just the fast memo
+            self.invalidate()
+            st = None
+        if st is None:
+            st = self._state()
+            self._state_cache = st
+            self._state_version = _struct_version()[0]
+        return st
+
     def _state(self):
         """(diff_params, buffers, opt_slots): every mutable tensor/array the
         traced function can read or write."""
@@ -281,7 +356,7 @@ class StaticFunction:
 
     # -- mode 1: compiled forward on the eager tape --
     def _call_forward(self, args, kwargs):
-        params, buffers, _, layers, _ = self._state()
+        params, buffers, _, layers, _ = self._state_cached()
         arg_tensors: list = []
         skel = _tree_flatten((args, tuple(sorted(kwargs.items()))),
                              arg_tensors, [])
@@ -299,7 +374,10 @@ class StaticFunction:
                                             len(arg_tensors))
                 self._cache[cache_key] = entry
             jitted, n_buf, meta = entry
-            rng_key = _random.next_key()
+            if meta.get("uses_rng", True):
+                rng_key = _random.next_key_spec()
+            else:
+                rng_key = _idle_rng_spec()
 
             ins = params + arg_tensors
             if n_buf:
@@ -323,7 +401,8 @@ class StaticFunction:
         fn = self._fn
         meta = {}  # per-cache-entry output skeleton (set during trace)
 
-        def pure(param_arrs, buf_arrs, arg_arrs, rng_key):
+        def pure(param_arrs, buf_arrs, arg_arrs, rng_spec):
+            rng_key = _random.derive_key(rng_spec)
             saved = [(t, t._data) for t in params + buffers]
             saved_grads = [(t, t._grad) for t in params]
             try:
@@ -336,6 +415,7 @@ class StaticFunction:
                     lambda a: Tensor(a, stop_gradient=True))
                 with _random.trace_key_scope(rng_key):
                     out = fn(*rebuilt_args, **dict(kw_items))
+                    meta["uses_rng"] = _random._trace_rng.counter > 0
                 out_tensors: list = []
                 meta["out_skel"] = _tree_flatten(out, out_tensors, [])
                 out_arrs = tuple(t._data for t in out_tensors)
@@ -353,8 +433,39 @@ class StaticFunction:
         return jax.jit(pure, static_argnums=()), len(buffers), meta
 
     # -- mode 2: whole train step (fwd+bwd+update) in one XLA program --
+    def _fast_sig(self, args, kwargs, layers):
+        """Cheap dispatch signature for the steady-state re-call: engages
+        only for the plain ``step(x, y, ...)`` calling convention (bare
+        Tensor positionals, no kwargs). Params/buffers/slots shapes are
+        covered by the full key once at entry build and assumed stable
+        thereafter (see :meth:`invalidate`)."""
+        if kwargs:
+            return None
+        sig = []
+        for a in args:
+            if isinstance(a, Tensor):
+                d = a._data
+                sig.append((d.shape, d.dtype))
+            else:
+                return None
+        return (tuple(sig), tuple(layer.training for layer in layers),
+                _amp_key())
+
     def _call_whole_step(self, args, kwargs):
-        params, buffers, slots, layers, opts = self._state()
+        fast = getattr(self, "_fast_step", None)
+        st = getattr(self, "_state_cache", None)
+        if fast and st is not None:
+            if _struct_version()[0] != self._state_version:
+                # some Layer somewhere gained/lost a param/sublayer since
+                # the state walk: re-discover. If the captured module is
+                # unchanged this re-memoizes without retracing.
+                self.invalidate()
+            else:
+                sig = self._fast_sig(args, kwargs, st[3])
+                hit = fast.get(sig) if sig is not None else None
+                if hit is not None:
+                    return self._exec_whole_step(hit, list(args), st)
+        params, buffers, slots, layers, opts = self._state_cached()
         if not getattr(self, "_materialized", False):
             # accumulators are created lazily — materialize each optimizer's
             # state up front so the whole step stages without an eager warmup
@@ -362,7 +473,8 @@ class StaticFunction:
                 if not opt._state_slots():
                     opt.materialize()
             self._materialized = True
-            params, buffers, slots, layers, opts = self._state()
+            self._state_cache = None
+            params, buffers, slots, layers, opts = self._state_cached()
         arg_tensors: list = []
         skel = _tree_flatten((args, tuple(sorted(kwargs.items()))),
                              arg_tensors, [])
@@ -379,37 +491,45 @@ class StaticFunction:
             entry = self._build_whole_step(skel, params, buffers, slots,
                                            opts, len(arg_tensors))
             self._cache[cache_key] = entry
+        with _break_key_scope(cache_key):  # tracing happens at this call
+            out = self._exec_whole_step(entry, arg_tensors,
+                                        (params, buffers, slots, layers,
+                                         opts))
+        # memoize AFTER a successful compiled execution so a graph-broken
+        # signature can never land in the fast memo
+        sig = self._fast_sig(args, kwargs, layers)
+        if sig is not None:
+            self._fast_step[sig] = entry
+        return out
+
+    def _exec_whole_step(self, entry, arg_tensors, state):
+        """Steady-state step dispatch: build the state list, call the ONE
+        compiled program, write results back. Zero eager device ops on the
+        host side — the RNG key is derived in-program from a numpy spec
+        (:func:`core.random.next_key_spec`) only when the traced step
+        actually consumed randomness, and the learning rates ride a numpy
+        array straight into the pjit call."""
         jitted, meta = entry
-        rng_key = _random.next_key()
-        lrs = jnp.asarray([opt.get_lr() for opt in opts], jnp.float32)
+        params, buffers, slots, layers, opts = state
+        if meta.get("uses_rng", True):
+            rng_spec = _random.next_key_spec()
+        else:
+            rng_spec = _idle_rng_spec()
+        lrs = np.asarray([opt.get_lr() for opt in opts], np.float32)
         state_in = [t._data for t in params] + [b._data for b in buffers] + \
             [cont[k] for cont, k in slots]
-        # keep only avals for compiled_text() — retaining the concrete
-        # arrays would pin a full copy of model+optimizer state; shapes are
-        # fixed per cache entry, so build them once per jitted fn
-        def _aval(a):
-            # mesh shardings matter for SPMD lowering; single-device
-            # placements are left off (committed single-device avals would
-            # conflict with mesh-sharded peers at lower() time)
-            sh = getattr(a, "sharding", None)
-            if sh is not None and hasattr(sh, "mesh"):
-                try:
-                    return jax.ShapeDtypeStruct(a.shape, a.dtype,
-                                                sharding=sh)
-                except Exception:
-                    pass
-            return jax.ShapeDtypeStruct(a.shape, a.dtype)
-
         last = getattr(self, "_last_exec", None)
         if last is None or last[0] is not jitted:
             self._last_exec = (jitted, ([_aval(a) for a in state_in],
                                         [_aval(t._data) for t in
                                          arg_tensors],
-                                        _aval(rng_key), _aval(lrs)))
-        with _break_key_scope(cache_key):  # tracing happens at this call
-            out_arrs, new_state = jitted(state_in,
-                                         [t._data for t in arg_tensors],
-                                         rng_key, lrs)
+                                        jax.ShapeDtypeStruct(
+                                            (3,), jnp.uint32),
+                                        jax.ShapeDtypeStruct(
+                                            lrs.shape, jnp.float32)))
+        out_arrs, new_state = jitted(state_in,
+                                     [t._data for t in arg_tensors],
+                                     rng_spec, lrs)
         if meta.get("unstaged_accumulators"):
             raise RuntimeError(
                 "optimizer state was created during tracing and cannot be "
@@ -432,7 +552,11 @@ class StaticFunction:
         fn = self._fn
         meta = {}  # per-cache-entry output skeleton (set during trace)
 
-        def pure(state_arrs, arg_arrs, rng_key, lrs):
+        def pure(state_arrs, arg_arrs, rng_spec, lrs):
+            # the step key is derived IN-program from the uint32
+            # [seed_hi, seed_lo, counter] spec — bit-identical to the
+            # eager next_key(), but zero eager device ops per step
+            rng_key = _random.derive_key(rng_spec)
             n_p, n_b = len(params), len(buffers)
             saved = [(t, t._data, t._grad) for t in params] + \
                 [(b, b._data, None) for b in buffers]
@@ -459,6 +583,9 @@ class StaticFunction:
                     lambda a: Tensor(a, stop_gradient=True))
                 with _random.trace_key_scope(rng_key):
                     out = fn(*rebuilt_args, **dict(kw_items))
+                    # consumed trace keys mean the step needs a FRESH spec
+                    # per call; otherwise dispatch reuses one idle spec
+                    meta["uses_rng"] = _random._trace_rng.counter > 0
                 out_tensors: list = []
                 meta["out_skel"] = _tree_flatten(out, out_tensors, [])
                 out_arrs = tuple(t._data for t in out_tensors)
@@ -511,21 +638,11 @@ class StaticFunction:
         jitted, meta = self._build_whole_step(skel, params, buffers, slots,
                                               opts, len(arg_tensors))
 
-        def _aval(a):
-            sh = getattr(a, "sharding", None)
-            if sh is not None and hasattr(sh, "mesh"):
-                try:
-                    return jax.ShapeDtypeStruct(a.shape, a.dtype,
-                                                sharding=sh)
-                except Exception:
-                    pass
-            return jax.ShapeDtypeStruct(a.shape, a.dtype)
-
         state_avals = [_aval(t._data) for t in params] + \
             [_aval(b._data) for b in buffers] + \
             [_aval(cont[k]) for cont, k in slots]
         arg_avals = [_aval(t._data) for t in arg_tensors]
-        rng_aval = jax.eval_shape(lambda: _random.next_key())
+        rng_aval = jax.ShapeDtypeStruct((3,), jnp.uint32)
         lrs_aval = jax.ShapeDtypeStruct((max(len(opts), 1),), jnp.float32)
         return jitted.lower(state_avals, arg_avals, rng_aval,
                             lrs_aval).compile()

@@ -116,6 +116,18 @@ def _paged_attend(q, k_pool, v_pool, block_tables, positions, impl):
                  [q, k_pool, v_pool, block_tables, positions])
 
 
+def _flash_constrain(x):
+    """Constrain a [B, S, H, Dh] attention operand to the sharded-flash
+    layout: batch over 'data', heads over 'model' (the shard_map in_spec,
+    snippet [2])."""
+    from ..distributed.topology import get_hybrid_communicate_group
+    hcg = get_hybrid_communicate_group()
+    spec = P("data", None, "model", None)
+    return apply("flash_shard_constraint",
+                 lambda a: jax.lax.with_sharding_constraint(
+                     a, NamedSharding(hcg.mesh, spec)), [x])
+
+
 def _sp_constrain(x, sequence_parallel):
     """Shard the [B, S, H] residual stream: batch over 'data', seq over
     'sep' (sequence/context parallel; SURVEY §5 long-context). Decode
@@ -133,11 +145,17 @@ def _sp_constrain(x, sequence_parallel):
 
 
 class GPTAttention(nn.Layer):
+    # test hook: swap the per-shard attention impl (the CPU mesh cannot
+    # run the real Pallas kernel, interpret mode is not a measurement)
+    _sharded_impl_override = None
+
     def __init__(self, config: GPTConfig):
         super().__init__()
         self.num_heads = config.num_heads
         self.head_dim = config.hidden_size // config.num_heads
         self.dropout = config.dropout
+        self._tp = config.tensor_parallel
+        self._sharded_fa = None  # (mesh id, shard_map'd kernel) cache
         h = config.hidden_size
         if config.tensor_parallel:
             from ..distributed import fleet
@@ -148,6 +166,40 @@ class GPTAttention(nn.Layer):
         else:
             self.qkv_proj = nn.Linear(h, 3 * h)
             self.out_proj = nn.Linear(h, h)
+
+    def _sharded_flash(self, q, k):
+        """The shard_map'd flash kernel for the training path (SNIPPETS
+        [1]–[3]): heads over the mesh 'model' axis, batch over 'data' —
+        or None when ineligible (no TP mesh, indivisible dims, mask/
+        dropout active, kernel demoted by the A/B gate). Built once per
+        mesh and cached."""
+        if not self._tp:
+            return None
+        override = GPTAttention._sharded_impl_override
+        if override is None:
+            from ..nn.functional.common import _flash_eligible
+            if not _flash_eligible(q, k, None, self.dropout, self.training,
+                                   True):
+                return None
+        try:
+            from ..distributed.topology import get_hybrid_communicate_group
+            mesh = get_hybrid_communicate_group().mesh
+        except Exception:
+            return None
+        m_deg = int(mesh.shape.get("model", 1))
+        d_deg = int(mesh.shape.get("data", 1))
+        if m_deg * d_deg <= 1:
+            return None  # single shard: F.sdpa already picks the kernel
+        b, _, h, _ = q.shape
+        if h % m_deg or b % d_deg:
+            return None
+        cached = self._sharded_fa
+        if cached is not None and cached[0] == id(mesh):
+            return cached[1]
+        from ..ops.pallas.flash_attention import sharded_flash_attention
+        fa = sharded_flash_attention(mesh, causal=True, impl=override)
+        self._sharded_fa = (id(mesh), fa)
+        return fa
 
     def forward(self, x, cache=None):
         """cache (decode): dict with 'k'/'v' Tensors [B, T, H, Dh] that new
@@ -212,9 +264,17 @@ class GPTAttention(nn.Layer):
             out = F.scaled_dot_product_attention(
                 q, k, v, is_causal=causal, dropout_p=0.0, training=False)
         else:
-            out = F.scaled_dot_product_attention(
-                q, k, v, is_causal=True, dropout_p=self.dropout,
-                training=self.training)
+            fa = self._sharded_flash(q, k)
+            if fa is not None:
+                # explicit placement before the manually-partitioned
+                # kernel (snippet [3]): q/k/v constrained to the
+                # shard_map in_specs so GSPMD never reshards around it
+                q, k, v = (_flash_constrain(t) for t in (q, k, v))
+                out = apply("sharded_flash_attention", fa, [q, k, v])
+            else:
+                out = F.scaled_dot_product_attention(
+                    q, k, v, is_causal=True, dropout_p=self.dropout,
+                    training=self.training)
         out = out.reshape([b, s, h])
         return self.out_proj(out)
 

@@ -204,7 +204,11 @@ def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
 def _flash_eligible(query, key, attn_mask, dropout_p, training, is_causal):
     """Use the Pallas flash-attention kernel when the configuration maps onto
     it: TPU device, no explicit mask, no dropout, head_dim ≤ 128 and (causal
-    or block-divisible keys)."""
+    or block-divisible keys) — AND the demotion gate agrees: under
+    ``PADDLE_TPU_KERNELS=auto`` a measured A/B verdict (bench kernels leg /
+    explicit ab_gate) at this or a nearby shape decides; with no verdict
+    the incumbent-winner default keeps the kernel serving (a measured LOSS
+    demotes it)."""
     from ...framework.flags import get_flags
     if not get_flags("FLAGS_use_flash_attention")["FLAGS_use_flash_attention"]:
         return False
@@ -217,7 +221,9 @@ def _flash_eligible(query, key, attn_mask, dropout_p, training, is_causal):
     from ...core.device import _platform_of
     if _platform_of(_jax.devices()[0]) != "tpu":
         return False
-    return True
+    from ...ops.pallas import _common as _gate
+    return _gate.pallas_default(
+        "flash_attention", _gate.shape_sig(query, key), allow_nearest=True)
 
 
 def scaled_dot_product_attention(query, key, value, attn_mask=None,

@@ -33,6 +33,15 @@ class HookRemoveHelper:
         self._hooks.pop(self._hook_id, None)
 
 
+# monotonic counter bumped on EVERY parameter/sublayer/buffer registration
+# or removal, process-wide. The staged train step's fast dispatch memo
+# (jit.api.StaticFunction) snapshots it at state-walk time and re-walks
+# when it moved — a structural edit to a captured module (progressive
+# unfreezing, growing a Sequential mid-fit) retraces instead of silently
+# replaying the old program without the new parameters.
+STRUCT_VERSION = [0]
+
+
 class Layer:
     """Base network module (reference Layer, nn/layer/layers.py:333)."""
 
@@ -120,6 +129,7 @@ class Layer:
         if parameter is not None and not isinstance(parameter, Parameter):
             raise TypeError(
                 f"add_parameter expects a Parameter, got {type(parameter)}")
+        STRUCT_VERSION[0] += 1
         self._parameters[name] = parameter
         return parameter
 
@@ -127,6 +137,7 @@ class Layer:
         if not isinstance(sublayer, Layer):
             raise TypeError(
                 f"add_sublayer expects a Layer, got {type(sublayer)}")
+        STRUCT_VERSION[0] += 1
         self._sub_layers[str(name)] = sublayer
         return sublayer
 
@@ -134,6 +145,7 @@ class Layer:
         if tensor is not None and not isinstance(tensor, Tensor):
             raise TypeError(
                 f"register_buffer expects a Tensor, got {type(tensor)}")
+        STRUCT_VERSION[0] += 1
         self._buffers[name] = tensor
         if persistable:
             self._non_persistable_buffer_names_set.discard(name)
@@ -153,6 +165,7 @@ class Layer:
             for registry in (layers, buffers):
                 if registry is not None:
                     registry.pop(name, None)
+            STRUCT_VERSION[0] += 1
             params[name] = value
         elif isinstance(value, Layer):
             if layers is None:
@@ -162,6 +175,7 @@ class Layer:
             for registry in (params, buffers):
                 if registry is not None:
                     registry.pop(name, None)
+            STRUCT_VERSION[0] += 1
             layers[name] = value
         elif buffers is not None and name in buffers:
             # assigning a Tensor over a registered buffer keeps buffer-ness
@@ -184,6 +198,7 @@ class Layer:
     def __delattr__(self, name):
         for registry in (self._parameters, self._sub_layers, self._buffers):
             if name in registry:
+                STRUCT_VERSION[0] += 1
                 del registry[name]
                 return
         object.__delattr__(self, name)

@@ -9,7 +9,14 @@ records into the metrics registry:
   split into ``data_wait_ms`` (loader/iterator stall before the batch was
   available), ``compute_ms`` (dispatching the train step) and
   ``sync_ms`` (the blocking device→host loss fetch — under jax's async
-  dispatch this is where the host actually waits for the device);
+  dispatch this is where the host actually waits for the device).
+  Under the fused donated train step the fit loop AMORTIZES that fetch
+  (``loss_fetch_every``): steps without a fetch observe ``sync_ms=0`` and
+  a dispatch-only ``compute_ms``, while the fetch step's ``sync_ms``
+  covers the whole window the device ran ahead — the split degrades
+  gracefully instead of forcing a per-step pipeline drain. ``step_time_ms``
+  (and therefore tokens/sec and MFU) is wall-clock between batch ends and
+  stays exact either way;
 * ``tokens_per_sec`` / ``tokens_total`` — tokens = batch×seq for integer
   token inputs, leading batch dim otherwise;
 * ``mfu_pct`` — achieved fraction of the chip's peak FLOP/s, estimated

@@ -334,6 +334,37 @@ def _fa_bwd(scale, causal, blocks, interpret, res, g):
 _flash_attention_bhsd.defvjp(_fa_fwd, _fa_bwd)
 
 
+def sharded_flash_attention(mesh, causal=True, scale=None,
+                            data_axis="data", model_axis="model",
+                            impl=None, block_q=None, block_k=None,
+                            interpret=False):
+    """Flash attention shard_map'd over the mesh (SNIPPETS [2]
+    ``sharded_flash_attention`` shape): q/k/v ``[B, S, H, D]`` partitioned
+    ``P(data, None, model, None)`` — batch over the data axis, heads over
+    the model axis. Attention is head-local, so every shard runs the full
+    kernel on its slice and NO collective appears in the step; the
+    out_spec stitches the heads back for GSPMD.
+
+    ``impl(q, k, v)`` defaults to the Pallas kernel; pass the jnp
+    reference chain for CPU parity tests (interpret mode measures the
+    emulator, not the chip). Degenerate meshes (both axis degrees 1)
+    return the plain impl."""
+    if impl is None:
+        def impl(q, k, v):
+            return flash_attention_bshd(q, k, v, causal=causal, scale=scale,
+                                        block_q=block_q, block_k=block_k,
+                                        interpret=interpret)
+    d_deg = int(mesh.shape.get(data_axis, 1))
+    m_deg = int(mesh.shape.get(model_axis, 1))
+    if d_deg * m_deg <= 1:
+        return impl
+    from jax.sharding import PartitionSpec as P
+    spec = P(data_axis, None, model_axis, None)
+    return jax.jit(jax.shard_map(impl, mesh=mesh,
+                                 in_specs=(spec, spec, spec),
+                                 out_specs=spec, check_rep=False))
+
+
 def flash_attention_bshd(q, k, v, causal=True, scale=None, block_q=None,
                          block_k=None, interpret=False):
     """Flash attention on [B, S, H, D] arrays (paddle layout). Returns the

@@ -81,7 +81,10 @@ class Adam(Optimizer):
             # spec, so the kernel shard_maps over the local shard (VERDICT
             # r3 weak #6: fused must not be disabled exactly where it
             # matters most)
-            return bool(self.use_fused) or _jax.default_backend() == "tpu"
+            if self.use_fused:
+                return True
+            return _jax.default_backend() == "tpu" and \
+                self._gate_allows(w)
         if self._dist_grad_hook is not None:
             # sharded state with no published spec: the GSPMD jnp path
             # partitions cleanly; a bare pallas_call would force a gather
@@ -93,7 +96,17 @@ class Adam(Optimizer):
             return False
         if self.use_fused:
             return True
-        return _jax.default_backend() == "tpu"
+        # auto: TPU + the demotion gate — BENCH_r05 measured the Pallas
+        # fused update LOSING to the XLA-fused jnp chain on the real chip,
+        # so the kernel serves only where an A/B verdict says it wins
+        # (nearest same-dtype/rank verdict within 4x: params sweep shapes)
+        return _jax.default_backend() == "tpu" and self._gate_allows(w)
+
+    @staticmethod
+    def _gate_allows(w):
+        from ..ops.pallas import _common as _gate
+        return _gate.pallas_default("fused_adamw", _gate.shape_sig(w),
+                                    allow_nearest=True)
 
     def _update(self, p, w, g, lr, group, fused_wd=0.0):
         m = self._get_accumulator("moment1", p)

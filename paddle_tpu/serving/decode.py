@@ -26,12 +26,13 @@ the head dim partitioned; block tables and context lens replicate.
 from __future__ import annotations
 
 import os
-import time
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from ..ops.pallas import _common as _gate
+from ..ops.pallas._common import on_tpu
 from ..ops.pallas.paged_attention import (
     paged_attention as _pallas_paged_attention,
     paged_attention_reference as _xla_paged_attention,
@@ -41,13 +42,6 @@ __all__ = ["paged_decode_attention", "sharded_paged_attention",
            "resolve_backend", "ab_compare", "on_tpu"]
 
 BACKENDS = ("xla", "pallas", "auto")
-
-
-def on_tpu():
-    try:
-        return jax.devices()[0].platform == "tpu"
-    except Exception:
-        return False
 
 
 def paged_decode_attention(q, k_pool, v_pool, block_tables, context_lens,
@@ -91,8 +85,10 @@ def sharded_paged_attention(mesh, axis_name="model", backend="xla",
 
 def resolve_backend(requested=None):
     """Normalize the backend choice: explicit arg wins, then the
-    ``PADDLE_TPU_SERVING_ATTN`` env knob, default ``auto``."""
-    b = requested or os.environ.get("PADDLE_TPU_SERVING_ATTN") or "auto"
+    ``PADDLE_TPU_SERVING_ATTN`` env knob, then the global
+    ``PADDLE_TPU_KERNELS`` gate knob, default ``auto``."""
+    b = requested or os.environ.get("PADDLE_TPU_SERVING_ATTN") \
+        or os.environ.get(_gate.KERNELS_ENV) or "auto"
     b = str(b).lower()
     if b not in BACKENDS:
         raise ValueError(
@@ -101,43 +97,21 @@ def resolve_backend(requested=None):
     return b
 
 
-def _time_jitted(fn, args, repeats):
-    out = fn(*args)           # compile + warm
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for _ in range(repeats):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / repeats * 1e3
-
-
 def ab_compare(q, k_pool, v_pool, block_tables, context_lens, scale=None,
                repeats=20):
     """Time the jitted XLA reference vs the Pallas kernel at this exact
-    serving shape and pick a winner. Off-TPU the Pallas leg is skipped
+    serving shape and pick a winner — now the generalized demotion gate
+    (``ops/pallas/_common.ab_gate``) with the verdict recorded under the
+    ``paged_attention`` kernel, so bench's kernels leg and the serving
+    engine share one verdict cache. Off-TPU the Pallas leg is skipped
     (interpret mode measures the emulator, not the chip) and XLA wins by
     default. -> ``{"backend", "xla_ms", "pallas_ms", "reason"}``."""
     args = (q, k_pool, v_pool, jnp.asarray(block_tables, jnp.int32),
             jnp.asarray(context_lens, jnp.int32))
-    xla_ms = _time_jitted(
-        jax.jit(lambda *a: _xla_paged_attention(*a, scale=scale)),
-        args, repeats)
-    row = {"backend": "xla", "xla_ms": round(xla_ms, 4),
-           "pallas_ms": None, "reason": "xla reference"}
-    if not on_tpu():
-        row["reason"] = "pallas requires TPU (interpret-only here)"
-        return row
-    try:
-        pallas_ms = _time_jitted(
-            jax.jit(lambda *a: _pallas_paged_attention(*a, scale=scale)),
-            args, repeats)
-    except Exception as e:  # unsupported shape/dtype: gate stays on XLA
-        row["reason"] = f"pallas failed: {type(e).__name__}: {e}"[:160]
-        return row
-    row["pallas_ms"] = round(pallas_ms, 4)
-    if pallas_ms < xla_ms:
-        row["backend"] = "pallas"
-        row["reason"] = "pallas beat xla at this shape"
-    else:
-        row["reason"] = "xla beat pallas at this shape"
-    return row
+    # recorded under the leading-operand (q) sig, matching what the
+    # incubate paged_attention auto path queries
+    return _gate.ab_gate(
+        "paged_attention",
+        lambda *a: _xla_paged_attention(*a, scale=scale),
+        lambda *a: _pallas_paged_attention(*a, scale=scale),
+        args, repeats=repeats, sig=_gate.shape_sig(q))

@@ -50,14 +50,28 @@ def _seed():
     saved_dtype = _dtype._default_dtype
     saved_flags = {k: f.value for k, f in _flags._registry.items()}
     saved_nan_check = _dispatch._check_nan_inf
+    saved_nan_window = _dispatch._nan_window
     saved_fault_env = os.environ.get("PADDLE_TPU_FAULTS")
     saved_fault_entries = _fault._entries
+    saved_kernels_env = os.environ.get("PADDLE_TPU_KERNELS")
     yield
     _dtype._default_dtype = saved_dtype
     for k, v in saved_flags.items():
         if k in _flags._registry:
             _flags._registry[k].value = v
     _dispatch._check_nan_inf = saved_nan_check
+    _dispatch._nan_window = saved_nan_window
+    _dispatch._nan_pending.clear()
+    # the Pallas demotion-gate verdict cache is process-global: a test
+    # that records a verdict (or forces PADDLE_TPU_KERNELS) must not
+    # steer kernel selection for its successors
+    from paddle_tpu.ops.pallas import _common as _pallas_gate
+    _pallas_gate._reset_state()
+    if os.environ.get("PADDLE_TPU_KERNELS") != saved_kernels_env:
+        if saved_kernels_env is None:
+            os.environ.pop("PADDLE_TPU_KERNELS", None)
+        else:
+            os.environ["PADDLE_TPU_KERNELS"] = saved_kernels_env
     # the flight recorder is process-wide too: drop back to the (disabled)
     # env-gated default so an enabled recorder/desync mode can't leak
     from paddle_tpu.distributed import flight_recorder as _flight

@@ -104,3 +104,96 @@ def test_flash_forward_unaligned_seq_noncausal():
     ref = _ref_attention(q, k, v, False)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3,
                                atol=2e-3)
+
+
+# -------------------------------------------- sharded flash (shard_map)
+
+def _mesh(shape, names):
+    devs = np.array(jax.devices()[:shape[0] * shape[1]]).reshape(shape)
+    return jax.sharding.Mesh(devs, names)
+
+
+def test_sharded_flash_matches_unsharded():
+    """Batch over 'data', heads over 'model' (SNIPPETS [2] shape): the
+    shard_map'd kernel is numerically identical to the unsharded impl —
+    attention is head-local, so sharding must not change a single bit."""
+    from paddle_tpu.ops.pallas.flash_attention import sharded_flash_attention
+    mesh = _mesh((2, 4), ("data", "model"))
+    rng = np.random.RandomState(0)
+    shape = (4, 32, 8, 32)
+    q = jnp.asarray(rng.randn(*shape), jnp.float32)
+    k = jnp.asarray(rng.randn(*shape), jnp.float32)
+    v = jnp.asarray(rng.randn(*shape), jnp.float32)
+
+    def impl(q, k, v):  # the CPU mesh cannot run the Mosaic kernel
+        return _ref_attention(q, k, v, True)
+
+    fa = sharded_flash_attention(mesh, impl=impl)
+    out = fa(q, k, v)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(impl(q, k, v)), rtol=1e-5,
+                               atol=1e-5)
+    # gradients flow through shard_map (training path requirement)
+    g = jax.grad(lambda a: jnp.sum(fa(a, k, v)))(q)
+    assert g.shape == shape and bool(jnp.all(jnp.isfinite(g)))
+
+
+def test_sharded_flash_degenerate_mesh_returns_impl():
+    from paddle_tpu.ops.pallas.flash_attention import sharded_flash_attention
+    mesh = _mesh((1, 1), ("data", "model"))
+
+    def impl(q, k, v):
+        return q
+
+    assert sharded_flash_attention(mesh, impl=impl) is impl
+
+
+def test_gpt_attention_uses_sharded_flash_under_tp():
+    """GPT's training attention routes through the shard_map'd flash path
+    when a TP mesh is active and the kernel is eligible — asserted by
+    injecting a marking impl through the test hook, and the loss stays
+    finite with gradients flowing to the TP-sharded qkv weights."""
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM, \
+        GPTPretrainingCriterion
+    from paddle_tpu.models.gpt import GPTAttention
+
+    paddle.seed(0)
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 4,
+                               "pp_degree": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+    from paddle_tpu.distributed.topology import \
+        get_hybrid_communicate_group
+    hcg = get_hybrid_communicate_group()
+    calls = {"n": 0}
+
+    def marking_impl(q, k, v):
+        calls["n"] += 1
+        return _ref_attention(q, k, v, True)
+
+    cfg = GPTConfig(vocab_size=128, hidden_size=64, num_layers=1,
+                    num_heads=8, max_seq_len=32, dropout=0.0,
+                    tensor_parallel=True)
+    GPTAttention._sharded_impl_override = marking_impl
+    try:
+        model = GPTForCausalLM(cfg)
+        crit = GPTPretrainingCriterion(cfg)
+        ids = paddle.to_tensor(
+            np.random.RandomState(0).randint(0, 128, (8, 16))
+            .astype("int32"))
+        loss = crit(model(ids), ids)
+        m_deg = int(hcg.mesh.shape.get("model", 1))
+        d_deg = int(hcg.mesh.shape.get("data", 1))
+        if m_deg * d_deg <= 1:
+            assert calls["n"] == 0  # degenerate mesh: plain path
+            return
+        assert calls["n"] >= 1, "sharded flash impl was not invoked"
+        assert np.isfinite(float(loss.numpy()))
+        loss.backward()
+        for p in model.parameters():
+            if p._grad is not None:
+                assert bool(jnp.all(jnp.isfinite(p._grad)))
+    finally:
+        GPTAttention._sharded_impl_override = None

@@ -248,12 +248,19 @@ print((time.perf_counter() - t0) / 300 * 1e6)
     return float(out.stdout.strip().splitlines()[-1])
 
 
-def bench_comm_overlap_cpu_mesh():
+def bench_comm_overlap_cpu_mesh(overlap_engine=False):
     """Compute/comm overlap %% of a dp8 GPT step from a real xplane trace
     (8 virtual CPU devices in a subprocess — collectives exist there; the
     single real chip has none). Reference capability:
-    allreduce_matmul_grad_overlapping pass + profiler overlap tables."""
+    allreduce_matmul_grad_overlapping pass + profiler overlap tables.
+    ``overlap_engine=True`` reruns the same step with the bucketed
+    grad-sync scheduler attached: the compiled program then carries one
+    psum per bucket at grad-production order (scheduling barriers
+    included), which is what XLA's async-collective pass overlaps on the
+    real chip."""
     import subprocess
+    dp_kwargs = ", comm_overlap=True, comm_buffer_size=0.25, " \
+        "last_comm_buffer_size=0.05" if overlap_engine else ""
     code = r"""
 import os, tempfile
 os.environ["JAX_PLATFORMS"] = "cpu"
@@ -270,8 +277,8 @@ paddle.seed(0)
 cfg = GPTConfig(vocab_size=512, hidden_size=128, num_layers=2, num_heads=4,
                 max_seq_len=128, dropout=0.0)
 model = GPTForCausalLM(cfg)
-model = dist.DataParallel(model)
-crit = GPTPretrainingCriterion(cfg)
+model = dist.DataParallel(model%s)
+crit = GPTPretrainingCriterion(cfg)""" % dp_kwargs + r"""
 opt = paddle.optimizer.AdamW(learning_rate=1e-4,
                              parameters=model.parameters())
 rng = np.random.RandomState(0)
@@ -301,6 +308,72 @@ print(f"{out['comm_overlap_pct']:.2f} {out['comm_us']:.1f} "
                          capture_output=True, text=True, timeout=900)
     vals = out.stdout.strip().splitlines()[-1].split()
     return float(vals[0]), float(vals[1]), float(vals[2])
+
+
+def bench_overlap_inrun():
+    """The overlap engine's measurement loop closed IN-RUN: eager bucketed
+    DP steps with the flight recorder + metrics registry on, reading the
+    ``comm_overlap_pct`` gauge the scheduler's issue/wait stamps feed (no
+    xplane trace collection) plus the per-bucket latency histograms.
+    Returns the parsed JSON row dict."""
+    import subprocess
+    code = r"""
+import os, json
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+import paddle_tpu.nn.functional as F
+from paddle_tpu.distributed import flight_recorder as fr
+from paddle_tpu.observability import metrics as om
+from paddle_tpu.models import GPTConfig, GPTForCausalLM, GPTPretrainingCriterion
+reg = om.enable(out_dir=None, interval_s=0)
+fr.enable(capacity=4096)
+paddle.seed(0)
+cfg = GPTConfig(vocab_size=512, hidden_size=128, num_layers=2, num_heads=4,
+                max_seq_len=128, dropout=0.0)
+model = GPTForCausalLM(cfg)
+dp = dist.DataParallel(model, comm_overlap=True, comm_buffer_size=0.25,
+                       last_comm_buffer_size=0.05)
+crit = GPTPretrainingCriterion(cfg)
+opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                             parameters=model.parameters())
+rng = np.random.RandomState(0)
+ids = paddle.to_tensor(rng.randint(0, 512, (8, 64)).astype("int32"))
+lab = paddle.to_tensor(rng.randint(0, 512, (8, 64)).astype("int64"))
+for _ in range(3):
+    loss = crit(dp(ids), lab)
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+snap = reg.snapshot()
+from paddle_tpu.observability.metrics import parse_metric_key, hist_quantile
+buckets = {}
+for key, h in snap["histograms"].items():
+    name, labels = parse_metric_key(key)
+    if name != "collective_latency_us" or \
+            not labels.get("kind", "").startswith("bucket."):
+        continue
+    b = labels.get("group", "?").rsplit(".", 1)[-1]
+    buckets[b] = {"count": h["count"],
+                  "p50_us": round(hist_quantile(h, 0.5) or 0, 1),
+                  "p99_us": round(hist_quantile(h, 0.99) or 0, 1)}
+print("JSON:" + json.dumps({
+    "overlap_pct": snap["gauges"].get("comm_overlap_pct"),
+    "bucket_collectives": int(dp._grad_sync.fired),
+    "buckets": buckets}))
+"""
+    env = dict(os.environ)
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=900)
+    for line in out.stdout.strip().splitlines()[::-1]:
+        if line.startswith("JSON:"):
+            return json.loads(line[5:])
+    raise RuntimeError(f"overlap in-run leg emitted no JSON row: "
+                       f"{out.stderr[-500:]}")
 
 
 def bench_lenet(peak):
@@ -1246,6 +1319,32 @@ def main():
         sub["dp8_compute_us"] = compute_us
         _log(f"[bench] dp8 comm overlap: {pct:.1f}% "
              f"(comm {comm_us:.0f}us / compute {compute_us:.0f}us)")
+        # same leg with the bucketed grad-sync engine attached: the
+        # compiled step now carries per-bucket psums at grad-production
+        # order — the schedule XLA's async-collective pass overlaps
+        pct_b, comm_b, compute_b = bench_comm_overlap_cpu_mesh(
+            overlap_engine=True)
+        sub["dp8_comm_overlap_pct_bucketed"] = pct_b
+        sub["dp8_comm_us_bucketed"] = comm_b
+        _log(f"[bench] dp8 comm overlap (bucketed engine): {pct_b:.1f}% "
+             f"(comm {comm_b:.0f}us / compute {compute_b:.0f}us)")
+
+    def _overlap_inrun():
+        # the in-run twin of the xplane rows above: the overlap engine's
+        # own comm_overlap_pct gauge (flight-recorder issue/wait stamps
+        # through the metrics registry — no trace collection) plus the
+        # per-bucket collective p50/p99 next to the legacy keys
+        row = bench_overlap_inrun()
+        if row.get("overlap_pct") is not None:
+            sub["dp8_comm_overlap_pct_inrun"] = round(row["overlap_pct"], 2)
+        sub["dp8_bucket_collectives"] = row.get("bucket_collectives", 0)
+        for b, r in sorted((row.get("buckets") or {}).items()):
+            sub[f"dp8_bucket_allreduce_{b}_p50_us"] = r["p50_us"]
+            sub[f"dp8_bucket_allreduce_{b}_p99_us"] = r["p99_us"]
+        _log(f"[bench] dp8 in-run overlap: "
+             f"{row.get('overlap_pct')}% over "
+             f"{row.get('bucket_collectives')} bucket collectives "
+             f"({len(row.get('buckets') or {})} buckets)")
 
     def _lenet():
         lenet_sps, lenet_t = bench_lenet(peak)
@@ -1329,6 +1428,7 @@ def main():
     guarded("eager_dispatch_host", _eager_host)
     if not _FAST:
         guarded("comm_overlap", _overlap)
+        guarded("comm_overlap_inrun", _overlap_inrun)
     guarded("lenet", _lenet)
     if on_tpu:  # Pallas kernels need the device (interpret-only on CPU)
         guarded("fused_adamw", _fused)
@@ -1375,7 +1475,9 @@ def main():
         rep["bench"] = {k: sub[k] for k in (
             "eager_dispatch_us_per_op",
             "eager_dispatch_us_per_op_telemetry",
-            "dp8_comm_overlap_pct") if k in sub}
+            "dp8_comm_overlap_pct",
+            "dp8_comm_overlap_pct_bucketed",
+            "dp8_comm_overlap_pct_inrun") if k in sub}
         # before/after step split for the perf round: the fused-step fit
         # split rows + the whole-step wall time next to each other
         rep["step_split"] = {k: sub[k] for k in sub

@@ -21,6 +21,7 @@ import jax.numpy as jnp
 __all__ = [
     "no_grad", "enable_grad", "is_grad_enabled", "set_grad_enabled",
     "TapeNode", "record_op", "backward", "grad",
+    "register_grad_sync", "unregister_grad_sync", "finalize_leaf_grad",
 ]
 
 
@@ -174,6 +175,55 @@ def record_op(name: str, diff_inputs: Sequence[Any], vjp_fn: Callable,
         o.stop_gradient = False
 
 
+# ------------------------------------------------------ grad-sync hooks
+# Registered by the communication-overlap engine (distributed/overlap.py
+# BucketedGradSync): a hook watches a set of leaf tensors (parameters) and
+# is notified the moment the walk finishes the LAST op consuming one —
+# the grad-ready boundary — so a bucketed all-reduce can fire *inside*
+# backward and overlap with the remaining compute. The empty-list fast
+# path is one truthiness check per backward (constant-time no-op,
+# structurally tested like the flight-recorder/metrics gates).
+#
+# Hook protocol: .active() -> bool, .param_ids() -> set[int],
+# .on_backward_begin() called before the walk starts (clear state a
+# previously-aborted backward left behind), .on_grad_ready(tensor,
+# grad_array) -> bool (True = consumed: the hook owns the leaf write,
+# performed later via finalize_leaf_grad), and .on_backward_end()
+# called after the walk's final leaf writes.
+#
+# The registry holds WEAK references: a scheduler strongly refs its
+# parameters (and thus the whole model), so a strong registry entry
+# would pin every DataParallel ever constructed with overlap on — and
+# keep its stale mesh/bucket config firing in later backwards. Dropping
+# the wrapper frees everything; dead refs are pruned on the next walk.
+_grad_sync_hooks: List[Any] = []
+
+
+def register_grad_sync(hook):
+    if not any(r() is hook for r in _grad_sync_hooks):
+        _grad_sync_hooks.append(weakref.ref(hook))
+    return hook
+
+
+def unregister_grad_sync(hook):
+    _grad_sync_hooks[:] = [r for r in _grad_sync_hooks
+                           if r() is not None and r() is not hook]
+
+
+def finalize_leaf_grad(t, g):
+    """Apply ``t``'s gradient hooks and accumulate ``g`` into ``t.grad`` —
+    the same finalization the end-of-walk leaf write performs, exported for
+    grad-sync hooks that consumed the leaf mid-walk (they call this with
+    the SYNCED gradient at backward end)."""
+    if t.stop_gradient:
+        return
+    for hook in t._grad_hooks:
+        newg = hook(_wrap_hook_arg(t, g))
+        if newg is not None:
+            g = _unwrap_hook_result(newg)
+    t._accumulate_grad(g)
+
+
 def _toposort(roots) -> List[TapeNode]:
     """Reverse-topological order of nodes reachable from root tensors' grad_fns."""
     visited = set()
@@ -236,7 +286,49 @@ def _run_backward(root_tensors, root_grads, retain_graph=False,
     wanted_ids = None if wanted is None else {id(t) for t in wanted}
     no_grad_ids = no_grad_ids or set()
 
-    for node in order:
+    # grad-ready boundaries for the communication-overlap engine: find, for
+    # each watched leaf, the LAST node in the walk that consumes it — once
+    # that node is processed the leaf's gradient is final and the sync hook
+    # may fire its bucket collective mid-backward. Empty-registry fast path
+    # is the single truthiness check below.
+    sync_hooks = None
+    ready_at: dict = {}
+    consumed: set = set()
+    if _grad_sync_hooks and accumulate_into_grad and not create_graph:
+        live = [h for h in (r() for r in _grad_sync_hooks)
+                if h is not None]
+        if len(live) < len(_grad_sync_hooks):  # prune dead wrappers
+            _grad_sync_hooks[:] = [r for r in _grad_sync_hooks
+                                   if r() is not None]
+        sync_hooks = [h for h in live if h.active()]
+        if sync_hooks:
+            for h in sync_hooks:
+                h.on_backward_begin()
+            watched: dict = {}
+            for h in sync_hooks:
+                for tid in h.param_ids():
+                    watched.setdefault(tid, None)
+            last_use: dict = {}
+            for i, node in enumerate(order):
+                for t in node.inputs:
+                    if t._grad_fn is None and id(t) in watched:
+                        last_use[id(t)] = (i, t)
+            for tid, (i, t) in last_use.items():
+                ready_at.setdefault(i, []).append(t)
+        else:
+            sync_hooks = None
+
+    def _fire_ready(i):
+        for t in ready_at.get(i, ()):
+            g = grads.get(id(t))
+            if g is None:
+                continue
+            for h in sync_hooks:
+                if id(t) in h.param_ids() and h.on_grad_ready(t, g):
+                    consumed.add(id(t))
+                    break
+
+    for node_i, node in enumerate(order):
         # gather output cotangents (zeros where never produced / outputs dead)
         cts = []
         any_ct = False
@@ -256,6 +348,8 @@ def _run_backward(root_tensors, root_grads, retain_graph=False,
                 grads.pop(id(o), None)
             cts.append(g)
         if not any_ct:
+            if ready_at:
+                _fire_ready(node_i)
             continue
         if create_graph:
             in_cts = node.taped_vjp(cts)
@@ -276,11 +370,15 @@ def _run_backward(root_tensors, root_grads, retain_graph=False,
         if not retain_graph and not create_graph:
             node.vjp_fn = None  # free residuals
             node.lazy = False   # a re-backward is an error, not a rebuild
+        if ready_at:
+            _fire_ready(node_i)
 
     # write .grad on leaves (paddle semantics: accumulate across backward calls)
     for tid, g in list(grads.items()):
         t = leaves.get(tid)
-        if t is None:
+        if t is None or tid in consumed:
+            # consumed leaves belong to a grad-sync hook: their write
+            # happens in on_backward_end from the SYNCED gradient
             continue
         if accumulate_into_grad and not t.stop_gradient:
             for hook in t._grad_hooks:
@@ -288,6 +386,9 @@ def _run_backward(root_tensors, root_grads, retain_graph=False,
                 if newg is not None:
                     g = newg if create_graph else _unwrap_hook_result(newg)
             t._accumulate_grad(g._data if create_graph else g)
+    if sync_hooks:
+        for h in sync_hooks:
+            h.on_backward_end()
     return grads
 
 

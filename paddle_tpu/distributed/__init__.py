@@ -41,6 +41,8 @@ from . import rpc  # noqa: F401
 from . import io  # noqa: F401
 from . import launch  # noqa: F401
 from . import stream  # noqa: F401
+from . import overlap  # noqa: F401
+from .overlap import BucketedGradSync  # noqa: F401
 from . import passes  # noqa: F401
 from . import fleet_executor  # noqa: F401
 from .comm_extra import (  # noqa: F401

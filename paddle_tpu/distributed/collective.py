@@ -374,34 +374,60 @@ def barrier(group=None):
     _fr.record_complete(rec)
 
 
-def all_reduce_quantized(tensor, group=None, bits=8, sync_op=True):
-    """Quantized all-reduce (EQuARX, arxiv 2506.17615): trade a little
-    gradient precision for ~4x less ICI wire volume (f32 -> int8 payload
-    plus one scale per rank). Per-rank blocks are symmetric-scale int8
-    quantized, exchanged, dequantized and summed — all inside ONE
-    compiled shard_map program so XLA schedules the collective on ICI
-    like any other.
+def quantize_int8_block(x):
+    """Symmetric per-block int8 quantization — the EQuARX wire format's
+    ONE implementation, shared by :func:`all_reduce_quantized` and the
+    bucket scheduler's int8 transport (overlap.py). Returns ``(q, safe)``:
+    the int8 payload and the zero-safe f32 scale such that
+    ``q.astype(f32) * safe`` is the local dequantization."""
+    qmax = 127.0
+    scale = jnp.max(jnp.abs(x)) / qmax
+    safe = jnp.where(scale > 0, scale, 1.0)
+    q = jnp.clip(jnp.round(x / safe), -qmax, qmax).astype(jnp.int8)
+    return q, safe
 
-    Semantics: approximate SUM all-reduce (rtol ~ 1/2^(bits-1) per rank
-    contribution). In-place like :func:`all_reduce`. Only bits=8 is
-    supported: int4 would need nibble packing to actually halve the wire
-    volume again, and without it lower bits only add error."""
-    if bits != 8:
-        raise ValueError(f"all_reduce_quantized supports bits=8 only "
-                         f"(int4 without nibble packing saves no "
-                         f"bandwidth), got {bits}")
+
+def all_reduce_quantized(tensor, group=None, bits=8, qtype=None,
+                         sync_op=True):
+    """Quantized all-reduce (EQuARX, arxiv 2506.17615): trade a little
+    gradient precision for 2-4x less ICI wire volume. Two transports:
+
+    * ``qtype="int8"`` (default, ``bits=8``): per-rank blocks are
+      symmetric-scale int8 quantized, exchanged (int8 payload + one f32
+      scale per rank, ~4x smaller), dequantized and summed.
+    * ``qtype="bf16"`` (``bits=16``): blocks are cast to bfloat16 on the
+      wire (~2x smaller) and summed in f32 on arrival — the
+      direct-cast transport the bucketed grad scheduler uses for its
+      low-loss mode.
+
+    All inside ONE compiled shard_map program so XLA schedules the
+    collective on ICI like any other. The flight-recorder entry carries
+    the COMPRESSED payload nbytes, so the per-kind wire-volume counter
+    and latency histograms see the reduction.
+
+    Semantics: approximate SUM all-reduce (int8 rtol ~ 1/127 per rank
+    contribution; bf16 ~ 2^-8). In-place like :func:`all_reduce`. Other
+    bit widths are rejected: int4 without nibble packing saves no
+    bandwidth and only adds error."""
+    if qtype is None:
+        qtype = {8: "int8", 16: "bf16"}.get(bits)
+    if qtype not in ("int8", "bf16"):
+        raise ValueError(
+            f"all_reduce_quantized supports qtype='int8' (bits=8) or "
+            f"'bf16' (bits=16), got bits={bits} qtype={qtype!r}")
     g = _as_group(group)
-    rec, inj = _collective_begin("allreduce", "all_reduce_quantized", g,
+    rec, inj = _collective_begin("allreduce",
+                                 f"all_reduce_quantized.{qtype}", g,
                                  tensor._data)
     arr = _placed(tensor._data, g)
     _collective_ready(rec, inj, arr)
-    qmax = float(2 ** (bits - 1) - 1)
+    if rec is not None and rec.get("nbytes"):
+        # the wire payload is the quantized block, not the f32 input
+        rec["nbytes"] = int(arr.size) * (1 if qtype == "int8" else 2)
 
-    def f(x):
+    def f_int8(x):
         # x: this rank's block [1, ...]. Symmetric per-rank scale.
-        scale = jnp.max(jnp.abs(x)) / qmax
-        safe = jnp.where(scale > 0, scale, 1.0)
-        q = jnp.clip(jnp.round(x / safe), -qmax, qmax).astype(jnp.int8)
+        q, safe = quantize_int8_block(x)
         # wire exchange: int8 payload + one f32 scale per rank
         qs = jax.lax.all_gather(q, g.axis)          # [N, 1, ...] int8
         ss = jax.lax.all_gather(safe, g.axis)       # [N]
@@ -409,7 +435,12 @@ def all_reduce_quantized(tensor, group=None, bits=8, sync_op=True):
             (-1,) + (1,) * (qs.ndim - 1))
         return jnp.sum(deq, axis=0).astype(x.dtype)
 
-    out = _rankdim_op(g, f, arr)
+    def f_bf16(x):
+        # wire exchange: bf16 payload; accumulate in f32 on arrival
+        qs = jax.lax.all_gather(x.astype(jnp.bfloat16), g.axis)
+        return jnp.sum(qs.astype(jnp.float32), axis=0).astype(x.dtype)
+
+    out = _rankdim_op(g, f_int8 if qtype == "int8" else f_bf16, arr)
     tensor._data = out
     _fr.record_complete(rec)
     return tensor

@@ -57,6 +57,13 @@ class DistributedStrategy:
         self.gradient_merge = False
         self.gradient_merge_configs = {}
         self.find_unused_parameters = False
+        # communication-overlap engine (distributed/overlap.py): bucketed
+        # async DP grad sync + quantized transport. Off by default; the
+        # env twins are PADDLE_TPU_DP_OVERLAP / PADDLE_TPU_DP_QUANT.
+        self.dp_comm_overlap = False
+        self.dp_comm_quant = None          # None/"off" | "int8" | "bf16"
+        self.comm_buffer_size = 25         # MB per grad bucket
+        self.last_comm_buffer_size = 1     # MB cap on the final bucket
 
 
 _fleet_initialized = False
@@ -81,12 +88,20 @@ def is_initialized():
 
 def distributed_model(model):
     """Reference: fleet/model.py:32. With mp/pp the parallel layers already
-    carry their shardings; pure-dp wraps in DataParallel."""
+    carry their shardings; pure-dp wraps in DataParallel, routing the
+    strategy's comm-overlap knobs (buffer sizes, overlap toggle, quantized
+    transport) into the bucket scheduler."""
     hcg = get_hybrid_communicate_group()
     if hcg.get_model_parallel_world_size() == 1 and \
             hcg.get_pipe_parallel_world_size() == 1:
         from ..parallel import DataParallel
-        return DataParallel(model, group=hcg.get_data_parallel_group())
+        s = _strategy
+        kw = {}
+        if s is not None:
+            kw = dict(comm_buffer_size=s.comm_buffer_size,
+                      last_comm_buffer_size=s.last_comm_buffer_size)
+        return DataParallel(model, strategy=s,
+                            group=hcg.get_data_parallel_group(), **kw)
     return model
 
 

@@ -56,6 +56,37 @@ def _last_dim_spec(ndim, axis):
     return P(*([_U] * (ndim - 1)), axis)
 
 
+def _maybe_chunked(layer, kernel, x):
+    """The latency-hiding decomposition for a TP matmul+collective pair
+    (overlap engine, ROADMAP item 2): chunk the matmul along the free
+    (sequence) dimension and interleave the per-chunk collectives so the
+    wire hides under the next chunk's compute. Serving policy mirrors the
+    Pallas demotion gate exactly — ``tp_overlap=None`` (auto) consults the
+    measured :func:`~paddle_tpu.distributed.overlap.measure_tp_overlap`
+    verdict at the EXACT shape and never serves off-TPU; ``True`` forces
+    (tests/bench); ``False`` disables. Returns the chunked output, or
+    None → caller takes the plain fused path."""
+    mode = layer._tp_overlap
+    if mode is False or x.ndim != 3:
+        return None
+    if mode is None:
+        key = (tuple(x.shape), str(x._data.dtype))
+        serve = layer._tp_overlap_cache.get(key)
+        if serve is None:
+            from ..overlap import tp_overlap_serves
+            from ...ops.pallas._common import shape_sig
+            serve = tp_overlap_serves(
+                kernel, shape_sig(x._data, layer.weight._data))
+            layer._tp_overlap_cache[key] = serve
+        if not serve:
+            return None
+    from ..overlap import chunked_linear
+    # both served pairs end replicated on the last dim (column
+    # gather-output's all-gather, row's partial-sum all-reduce)
+    return chunked_linear(x, layer.weight, layer.bias, layer._mesh,
+                          out_axis=None)
+
+
 class VocabParallelEmbedding(Layer):
     """Reference: mp_layers.py:47 — vocab dim sharded across the mp axis."""
 
@@ -82,11 +113,13 @@ class ColumnParallelLinear(Layer):
 
     def __init__(self, in_features, out_features, weight_attr=None,
                  has_bias=None, gather_output=True, fuse_matmul_bias=False,
-                 mp_group=None, name=None):
+                 mp_group=None, name=None, tp_overlap=None):
         super().__init__()
         mesh, axis = _mp_mesh(mp_group)
         self._mesh, self._axis = mesh, axis
         self._gather_output = gather_output
+        self._tp_overlap = tp_overlap
+        self._tp_overlap_cache = {}
         self.weight = self.create_parameter(
             shape=[in_features, out_features], attr=weight_attr)
         _place(self.weight, mesh, P(None, axis))
@@ -99,6 +132,11 @@ class ColumnParallelLinear(Layer):
             self.bias = None
 
     def forward(self, x):
+        if self._gather_output:
+            # the matmul→all-gather pair is the latency-hiding candidate
+            y = _maybe_chunked(self, "tp_overlap_column", x)
+            if y is not None:
+                return y
         y = F.linear(x, self.weight, self.bias)
         if self._gather_output:
             return _constrain(y, self._mesh, _last_dim_spec(y.ndim, None))
@@ -113,11 +151,13 @@ class RowParallelLinear(Layer):
 
     def __init__(self, in_features, out_features, weight_attr=None,
                  has_bias=True, input_is_parallel=False, fuse_matmul_bias=False,
-                 mp_group=None, name=None):
+                 mp_group=None, name=None, tp_overlap=None):
         super().__init__()
         mesh, axis = _mp_mesh(mp_group)
         self._mesh, self._axis = mesh, axis
         self._input_is_parallel = input_is_parallel
+        self._tp_overlap = tp_overlap
+        self._tp_overlap_cache = {}
         self.weight = self.create_parameter(
             shape=[in_features, out_features], attr=weight_attr)
         _place(self.weight, mesh, P(axis, None))
@@ -132,6 +172,11 @@ class RowParallelLinear(Layer):
         if not self._input_is_parallel:
             x = _constrain(x, self._mesh,
                            _last_dim_spec(x.ndim, self._axis))
+        # the partial-sum matmul→all-reduce pair is the latency-hiding
+        # candidate: each chunk's reduction rides under the next matmul
+        y = _maybe_chunked(self, "tp_overlap_row", x)
+        if y is not None:
+            return y
         y = F.linear(x, self.weight, self.bias)
         return _constrain(y, self._mesh, _last_dim_spec(y.ndim, None))
 

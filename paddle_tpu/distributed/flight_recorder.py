@@ -49,7 +49,8 @@ from ..observability import tracing as _obs_tracing
 
 __all__ = [
     "FlightRecorder", "CollectiveDesyncError", "get_recorder", "enable",
-    "disable", "record_issue", "record_complete", "note_step",
+    "disable", "record_issue", "record_complete", "note_wait_begin",
+    "note_step",
     "note_heartbeat", "note_resume", "check_desync", "verify_signatures",
     "wire_from_env",
     "next_group_seq", "current_group_seq", "reset_seqs", "incarnation",
@@ -320,6 +321,16 @@ def record_complete(entry):
     if rec is None or entry is None:
         return
     rec.complete(entry)
+
+
+def note_wait_begin(entry):
+    """Stamp the moment a consumer started WAITING on an async collective
+    (``_StreamTask.wait`` / the bucket scheduler's backward-end drain).
+    The in-run overlap sampler (observability.metrics.observe_collective)
+    reads ``t_issue → t_wait`` as the window the collective was in flight
+    while the host kept working — the hidden-communication credit."""
+    if entry is not None and "t_wait" not in entry:
+        entry["t_wait"] = time.time()
 
 
 def note_step(step):

@@ -1,0 +1,551 @@
+"""Communication-overlap engine — bucketed async gradient sync, quantized
+all-reduce transports with error feedback, and latency-hiding TP matmul
+decomposition.
+
+Motivation (ROADMAP item 2): the r05 bench measured ``dp8_comm_overlap_pct``
+= 1.81% — DP gradient synchronization ran essentially serial with backward.
+This module rebuilds the reference's ``EagerReducer`` bucketing
+(collective/reducer.cc:478) in the T3 style (arxiv 2401.16677: fuse/overlap
+producer→collective scheduling) with an EQuARX-style quantized transport
+(arxiv 2506.17615: trade ~2-4x wire volume for negligible quality loss):
+
+* :func:`build_buckets` partitions parameters into size-capped buckets in
+  reverse registration order (the order gradients become ready in backward),
+  honoring ``DataParallel(comm_buffer_size=, last_comm_buffer_size=)`` —
+  previously parsed but silently unused.
+* :class:`BucketedGradSync` registers a grad-sync hook with the eager
+  autograd walk (:func:`paddle_tpu.core.autograd.register_grad_sync`): the
+  moment the LAST consumer of a bucket's parameters has been processed
+  mid-backward, the bucket's gradients are flattened and an **async
+  all-reduce task** is fired (a :class:`~.stream._StreamTask`, so the
+  collective lands in the flight-recorder ring and the per-kind×group
+  latency histograms with ``t_issue``/``t_wait``/``t_complete`` stamps).
+  The tasks are awaited only at backward end — the device executes the
+  collective while the host keeps dispatching the remaining backward, which
+  is exactly the overlap window the in-run sampler measures
+  (:func:`paddle_tpu.observability.metrics.observe_collective` feeds the
+  ``comm_overlap_pct`` gauge from these stamps).
+* Under ``jit.to_static`` tracing the same schedule is expressed
+  **in-program**: each bucket becomes one ``psum`` placed at grad-production
+  order, pinned by ``lax.optimization_barrier`` so XLA's async-collective
+  pass can overlap it with the remaining backward compute instead of
+  sinking every reduction to the end of the program.
+* Transports (``PADDLE_TPU_DP_QUANT=int8|bf16|off``, or
+  ``DistributedStrategy.dp_comm_quant``): ``off`` is a plain mean
+  all-reduce; ``int8``/``bf16`` compress the wire payload (ring entries
+  carry the COMPRESSED nbytes so the collective-bytes guard sees the
+  volume drop) and keep a persistent per-bucket **error-feedback residual**
+  on device — the compression error accumulates into the next step's
+  payload instead of into the model. Quantized transports are eager-only
+  (the residual is cross-step state a traced program cannot carry); under
+  tracing they fall back to the exact transport with a one-time warning.
+
+Sharding semantics: on the single-controller mesh parameters are replicated
+and GSPMD already reduces each per-op gradient, so the bucket transport is
+the *mean over the group axis of per-device values* — numerically the
+identity on replicated inputs (bit-exact for power-of-two groups), while
+emitting one real wire collective per bucket whose schedule, size and
+dtype the overlap engine fully controls. Under multi-controller
+``jax.distributed`` the same program performs the real cross-host sync.
+
+Latency-hiding TP decomposition (:func:`chunked_linear`): the
+matmul+collective pairs in ``fleet/mp_layers.py`` (ColumnParallel forward
+all-gather, RowParallel forward all-reduce) are chunked along the free
+(sequence) dimension with scheduling barriers between chunks, so chunk
+i+1's matmul can run while chunk i's collective is on the wire. The
+chunked path serves ONLY behind a measured :func:`~paddle_tpu.ops.pallas.
+_common.ab_gate` win at the exact shape (never off-TPU) — the same
+demotion policy as the Pallas kernels.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..core import autograd as _autograd
+from . import flight_recorder as _fr
+from .stream import _StreamTask
+
+__all__ = [
+    "QUANT_ENV", "OVERLAP_ENV", "TP_CHUNKS_ENV", "GradBucket",
+    "build_buckets", "resolve_transport", "BucketedGradSync",
+    "chunked_linear", "measure_tp_overlap", "tp_overlap_serves",
+]
+
+QUANT_ENV = "PADDLE_TPU_DP_QUANT"
+OVERLAP_ENV = "PADDLE_TPU_DP_OVERLAP"
+TP_CHUNKS_ENV = "PADDLE_TPU_TP_CHUNKS"
+_TRANSPORTS = ("off", "int8", "bf16")
+
+
+def resolve_transport(value=None):
+    """Transport knob resolution: explicit argument > ``PADDLE_TPU_DP_QUANT``
+    env > ``off``. Quantization is opt-in — the default syncs exact fp32."""
+    v = value if value is not None else os.environ.get(QUANT_ENV) or "off"
+    v = str(v).lower()
+    if v in ("", "0", "none", "false"):
+        v = "off"
+    if v not in _TRANSPORTS:
+        raise ValueError(
+            f"{QUANT_ENV}={v!r}: pick from {_TRANSPORTS}")
+    return v
+
+
+def overlap_enabled_from_env():
+    return os.environ.get(OVERLAP_ENV, "") in ("1", "true", "True")
+
+
+def _check_cap(name, value):
+    try:
+        v = float(value)
+    except (TypeError, ValueError):
+        v = -1.0
+    if v <= 0:
+        raise ValueError(
+            f"DataParallel {name}={value!r}: the gradient comm buffer size "
+            "is in MB and must be > 0 (it caps how many gradients one "
+            "bucketed all-reduce carries)")
+    return v
+
+
+class GradBucket:
+    """One size-capped group of parameters whose gradients sync together."""
+
+    __slots__ = ("index", "params", "numels", "nbytes")
+
+    def __init__(self, index, params):
+        self.index = index
+        self.params = list(params)
+        self.numels = [int(np.prod(p.shape)) if len(p.shape) else 1
+                       for p in self.params]
+        self.nbytes = sum(n * jnp.dtype(p._data.dtype).itemsize
+                          for n, p in zip(self.numels, self.params))
+
+    def __repr__(self):
+        return (f"GradBucket(#{self.index}, {len(self.params)} params, "
+                f"{self.nbytes / 2**20:.2f} MB)")
+
+
+def build_buckets(params, comm_buffer_size=25, last_comm_buffer_size=1):
+    """Partition ``params`` into grad-sync buckets (reference:
+    EagerReducer ``assign_group_by_size``). Packing runs in REVERSE
+    registration order — backward produces gradients roughly output-to-
+    input, so the first bucket fills (and its collective fires) earliest.
+    Each bucket caps at ``comm_buffer_size`` MB; the LAST bucket (the
+    model's first parameters — the tail of backward) re-packs at
+    ``last_comm_buffer_size`` MB so the final flush never waits on one
+    oversized buffer. Both caps reject ≤ 0 with a clear error (they were
+    previously parsed but silently ignored)."""
+    cap = _check_cap("comm_buffer_size", comm_buffer_size) * 2**20
+    last_cap = _check_cap("last_comm_buffer_size",
+                          last_comm_buffer_size) * 2**20
+    ps = [p for p in params if p is not None and not p.stop_gradient]
+
+    def _pack(items, cap_bytes):
+        groups, cur, cur_bytes = [], [], 0
+        for p in items:
+            nb = (int(np.prod(p.shape)) if len(p.shape) else 1) \
+                * jnp.dtype(p._data.dtype).itemsize
+            if cur and cur_bytes + nb > cap_bytes:
+                groups.append(cur)
+                cur, cur_bytes = [], 0
+            cur.append(p)
+            cur_bytes += nb
+        if cur:
+            groups.append(cur)
+        return groups
+
+    groups = _pack(list(reversed(ps)), cap)
+    if groups and len(groups[-1]) > 1:
+        groups.extend(_pack(groups.pop(), last_cap))
+    return [GradBucket(i, g) for i, g in enumerate(groups)]
+
+
+class BucketedGradSync:
+    """Bucketed async DP gradient synchronization (the tentpole scheduler).
+
+    Eager: registers with the autograd walk; per-bucket async all-reduce
+    tasks fire at grad-ready boundaries inside backward and are awaited at
+    backward end (``on_backward_end``), which also writes the synced
+    gradients back through the normal leaf finalization (hooks +
+    accumulate). Traced (``to_static``): per-bucket ``psum`` at production
+    order behind an ``optimization_barrier``.
+
+    ``accumulating=True`` (set by ``DataParallel.no_sync``) suppresses
+    firing entirely — gradients take the default leaf write and NO
+    collective enters the ring until the boundary step.
+    """
+
+    def __init__(self, params, mesh, axis, comm_buffer_size=25,
+                 last_comm_buffer_size=1, transport=None, group_label=None):
+        self.mesh = mesh
+        self.axis = axis
+        self.nranks = int(mesh.shape[axis])
+        self.buckets = build_buckets(params, comm_buffer_size,
+                                     last_comm_buffer_size)
+        self.transport = resolve_transport(transport)
+        self.accumulating = False
+        self._label = group_label or f"{axis}:dp"
+        self._by_id = {}
+        for b in self.buckets:
+            for slot, p in enumerate(b.params):
+                self._by_id[id(p)] = (b, slot)
+        self._param_ids = frozenset(self._by_id)
+        self._pending = {}        # bucket index -> [grad or None] per slot
+        self._tasks = []          # (list[(param, numel)], task)
+        self._absorbed = set()    # param ids whose prior .grad rode the sync
+        self._residuals = {}      # bucket index -> flat f32 EF residual
+        self._fns = {}            # (transport, ef) -> jitted sync fn
+        self._attached = False
+        self._warned_traced_quant = False
+        self.fired = 0            # eager async bucket collectives issued
+        self.traced_fires = 0     # in-program bucket psums placed
+
+    # ------------------------------------------------------- hook protocol
+    def active(self):
+        return self._attached and not self.accumulating
+
+    def param_ids(self):
+        return self._param_ids
+
+    def attach(self):
+        if not self._attached:
+            self._attached = True
+            _autograd.register_grad_sync(self)
+        return self
+
+    def detach(self):
+        if self._attached:
+            self._attached = False
+            _autograd.unregister_grad_sync(self)
+
+    def on_grad_ready(self, t, g):
+        """Mid-backward, the walk finished the last op consuming ``t``:
+        its gradient is final. Stash it; fire the bucket once every slot
+        arrived. Returns True = consumed (the scheduler owns the leaf
+        write: it happens at ``on_backward_end`` from the SYNCED value).
+
+        A pre-existing ``t.grad`` (no_sync accumulation reaching its
+        boundary step, or plain repeated backwards) is folded INTO the
+        payload and cleared at writeback, so the collective syncs the
+        accumulated TOTAL — the reference skip-then-sync contract. On the
+        single-controller mesh this is an identity refinement; under
+        multi-controller it is what keeps ranks from diverging (the mean
+        is idempotent on already-synced content, so re-syncing a prior
+        synced gradient is harmless)."""
+        b, slot = self._by_id[id(t)]
+        prior = t._grad
+        if prior is not None:
+            g = g + prior
+            self._absorbed.add(id(t))
+        pend = self._pending.get(b.index)
+        if pend is None:
+            pend = self._pending[b.index] = [None] * len(b.params)
+        pend[slot] = g
+        if all(x is not None for x in pend):
+            del self._pending[b.index]
+            self._fire(b, pend)
+        return True
+
+    def on_backward_begin(self):
+        """A previous backward that raised mid-walk (NaN guard, a user
+        hook throwing) can leave half-filled buckets and un-awaited
+        tasks; firing them against THIS walk's gradients would all-reduce
+        a mix of two steps. Drain the stale tasks (completes their ring
+        entries; results discarded — they belong to the aborted walk)
+        and start clean."""
+        if not (self._pending or self._tasks or self._absorbed):
+            return
+        stale, self._tasks = self._tasks, []
+        self._pending.clear()
+        self._absorbed.clear()
+        for _, task in stale:
+            # abandon, don't wait: the issue→now gap is abort wall time
+            # and must not feed the latency p99s or the overlap gauge
+            task.abandon()
+
+    def on_backward_end(self):
+        """Backward walk finished: flush partially-filled buckets (a graph
+        that never touched some parameters — find_unused_parameters
+        semantics — must still sync what it produced), then await every
+        async task and finalize the leaves with the synced gradients."""
+        if self._pending:
+            for bidx in sorted(self._pending):
+                b = self.buckets[bidx]
+                self._fire(b, self._pending[bidx])
+            self._pending.clear()
+        tasks, self._tasks = self._tasks, []
+        for entries, task in tasks:
+            flat = task.wait()
+            self._writeback(entries, flat)
+
+    # ---------------------------------------------------------- transports
+    def _sync_fn(self, transport, ef):
+        """Build (once per transport×ef) the jitted shard_map collective:
+        the group-axis mean of per-device values. ``ef=True`` variants
+        also take/return the error-feedback residual."""
+        key = (transport, bool(ef))
+        fn = self._fns.get(key)
+        if fn is not None:
+            return fn
+        axis, n = self.axis, self.nranks
+
+        if transport == "bf16":
+            def body(x):
+                q = x.astype(jnp.bfloat16)
+                synced = jax.lax.psum(q, axis).astype(jnp.float32) / n
+                return synced, x - q.astype(jnp.float32)
+        elif transport == "int8":
+            from .collective import quantize_int8_block
+
+            def body(x):
+                q, safe = quantize_int8_block(x)
+                local = q.astype(jnp.float32) * safe
+                qs = jax.lax.all_gather(q, axis)       # int8 wire payload
+                ss = jax.lax.all_gather(safe, axis)    # one scale per rank
+                synced = jnp.sum(
+                    qs.astype(jnp.float32) * ss.reshape((-1, 1)),
+                    axis=0) / n
+                return synced, x - local
+        else:
+            def body(x):
+                return jax.lax.psum(x, axis) / n, None
+
+        if ef:
+            def f(x, r):
+                synced, new_r = body(x + r)
+                return synced, new_r
+
+            specs = (P(), P())
+            fn = jax.jit(shard_map(f, mesh=self.mesh, in_specs=specs,
+                                   out_specs=specs, check_vma=False))
+        else:
+            def f(x):
+                return body(x)[0]
+
+            fn = jax.jit(shard_map(f, mesh=self.mesh, in_specs=P(),
+                                   out_specs=P(), check_vma=False))
+        self._fns[key] = fn
+        return fn
+
+    def _wire_bytes(self, numel):
+        if self.transport == "int8":
+            return numel  # int8 payload (+ one f32 scale per rank)
+        if self.transport == "bf16":
+            return numel * 2
+        return numel * 4
+
+    def _kind(self):
+        base = "bucket.all_reduce"
+        return base if self.transport == "off" else \
+            f"{base}.{self.transport}"
+
+    # -------------------------------------------------------------- firing
+    def _fire(self, bucket, grads_list):
+        entries = [((p, n), g) for (p, n), g in
+                   zip(zip(bucket.params, bucket.numels), grads_list)
+                   if g is not None]
+        if not entries:
+            return
+        metas = [m for m, _ in entries]
+        flats = [jnp.ravel(g).astype(jnp.float32) for _, g in entries]
+        flat = flats[0] if len(flats) == 1 else jnp.concatenate(flats)
+        traced = isinstance(flat, jax.core.Tracer)
+        transport = self.transport
+        if traced and transport != "off":
+            if not self._warned_traced_quant:
+                self._warned_traced_quant = True
+                print("[overlap] quantized DP transport is eager-only (the "
+                      "error-feedback residual is cross-step state a traced "
+                      "program cannot carry); the compiled step uses the "
+                      "exact per-bucket psum schedule instead",
+                      file=sys.stderr, flush=True)
+            transport = "off"
+        if traced:
+            # in-program schedule: one psum per bucket, placed HERE (grad-
+            # production order) and pinned by an optimization barrier so
+            # XLA's async-collective pass overlaps it with the remaining
+            # backward instead of sinking it to the end of the program
+            self.traced_fires += 1
+            fn = self._sync_fn("off", ef=False)
+            synced = fn(jax.lax.optimization_barrier(flat))
+            self._writeback(metas, synced)
+            return
+        ef = transport != "off"
+        entry = _fr.record_issue(
+            self._kind(), group=f"{self._label}.b{bucket.index}",
+            shape=(int(flat.size),), dtype="float32",
+            extra={"nbytes": self._wire_bytes(int(flat.size)),
+                   "bucket": bucket.index, "transport": transport})
+        fn = self._sync_fn(transport, ef=ef)
+        if ef:
+            r = self._residuals.get(bucket.index)
+            if r is None or r.shape != flat.shape:
+                r = jnp.zeros_like(flat)
+            synced, new_r = fn(flat, r)
+            self._residuals[bucket.index] = new_r
+        else:
+            synced = fn(flat)
+        # async task: jax dispatch already returned; wait() stamps t_wait,
+        # blocks until the device result is ready, then completes the ring
+        # entry — the t_issue→t_wait window is the overlap the in-run
+        # sampler credits
+        task = _StreamTask(synced, entry,
+                           finalizer=lambda res: jax.block_until_ready(res))
+        self.fired += 1
+        self._tasks.append((metas, task))
+
+    def _writeback(self, metas, flat):
+        off = 0
+        for p, numel in metas:
+            piece = flat[off:off + numel]  # static indices: traces fine
+            off += numel
+            g = jnp.reshape(piece, p.shape).astype(p._data.dtype)
+            if id(p) in self._absorbed:
+                # the payload already contains the prior accumulation
+                # (on_grad_ready folded it in): replace, don't double it
+                self._absorbed.discard(id(p))
+                p._grad = None
+            _autograd.finalize_leaf_grad(p, g)
+
+    def residual(self, bucket_index=0):
+        """The error-feedback residual of one bucket (None before the
+        first quantized sync) — test/debug surface."""
+        return self._residuals.get(bucket_index)
+
+
+# --------------------------------------------------------------------------
+# Latency-hiding TP decomposition (tentpole 2)
+# --------------------------------------------------------------------------
+
+_U = P.UNCONSTRAINED
+
+
+def _tp_chunks(default=4):
+    try:
+        return max(1, int(os.environ.get(TP_CHUNKS_ENV, "") or default))
+    except ValueError:
+        return default
+
+
+@jax.custom_vjp
+def _sched_barrier(a, d):
+    """``optimization_barrier`` with a gradient rule (jax defines none):
+    forward ties ``a`` to the completion of ``d`` so XLA cannot re-fuse
+    the interleaved chunks; backward passes the cotangent straight
+    through to ``a`` (the dependency edge carries no gradient)."""
+    return jax.lax.optimization_barrier((a, d))[0]
+
+
+def _sched_barrier_fwd(a, d):
+    out = jax.lax.optimization_barrier((a, d))[0]
+    # residuals must be jax values: carry a zero of d's aval so bwd can
+    # emit the (gradient-free) dependency cotangent
+    return out, jnp.zeros_like(d)
+
+
+def _sched_barrier_bwd(res, g):
+    return g, res
+
+
+_sched_barrier.defvjp(_sched_barrier_fwd, _sched_barrier_bwd)
+
+
+def chunked_linear(x, weight, bias, mesh, out_axis, nsplit=None):
+    """Latency-hiding form of a TP matmul+collective pair: split ``x``
+    [B, S, H] along the free (sequence) dimension into ``nsplit`` chunks;
+    each chunk's linear is followed by its own sharding constraint —
+    GSPMD inserts one PER-CHUNK collective (all-gather for the column
+    gather-output case ``out_axis=None``-replicated, all-reduce for the
+    row partial-sum case), and a scheduling barrier chains chunk i's
+    output into chunk i+1's input so XLA keeps the interleaving: chunk
+    i+1's matmul overlaps chunk i's collective on the wire.
+
+    Returns None when ineligible (non-3D input or indivisible sequence) —
+    the caller falls back to the unchunked path."""
+    nsplit = nsplit or _tp_chunks()
+    if x.ndim != 3 or nsplit <= 1 or x.shape[1] % nsplit:
+        return None
+    from ..core.dispatch import apply
+    from ..nn import functional as F
+    from .. import ops
+    c = x.shape[1] // nsplit
+    spec = P(*([_U] * (x.ndim - 1)), out_axis)
+    sharding = NamedSharding(mesh, spec)
+    outs, prev = [], None
+    for i in range(nsplit):
+        xi = x[:, i * c:(i + 1) * c]
+        if prev is not None:
+            # data-dependence barrier: without it XLA's simplifier is free
+            # to re-fuse the chunks into one matmul + one collective
+            xi = apply("tp_sched_barrier", _sched_barrier, [xi, prev])
+        yi = F.linear(xi, weight, bias)
+        yi = apply("tp_chunk_constraint",
+                   lambda a: jax.lax.with_sharding_constraint(a, sharding),
+                   [yi])
+        outs.append(yi)
+        prev = yi
+    return ops.concat(outs, axis=1)
+
+
+def tp_overlap_serves(kernel, sig):
+    """Should the chunked TP path serve at this shape? Mirrors the Pallas
+    demotion policy exactly: only behind a measured A/B win at the exact
+    shape, never off-TPU, unmeasured defaults to the plain path."""
+    from ..ops.pallas._common import on_tpu, pallas_default
+    if not on_tpu():
+        return False
+    return pallas_default(kernel, sig)
+
+
+def measure_tp_overlap(kernel, x_arr, w_arr, b_arr, mesh, axis, out_axis,
+                       nsplit=None, repeats=10):
+    """Time the unchunked matmul+collective against the chunked
+    interleaving at this exact shape through the PR-7 ``ab_gate``
+    machinery (the chunked variant plays the "pallas" role: it can only
+    win on the real chip, and a loss keeps it demoted). Returns the
+    verdict row; :func:`tp_overlap_serves` consults the cached verdict."""
+    from ..ops.pallas._common import ab_gate, shape_sig
+    nsplit = nsplit or _tp_chunks()
+    if x_arr.ndim != 3 or x_arr.shape[1] % nsplit:
+        raise ValueError(
+            f"measure_tp_overlap: seq dim {x_arr.shape} must be 3-D and "
+            f"divide nsplit={nsplit} — an indivisible chunking would time "
+            "a truncated matmul and record a bogus verdict")
+    spec = P(*([_U] * (x_arr.ndim - 1)), out_axis)
+    sharding = NamedSharding(mesh, spec)
+
+    def plain(x, w, b):
+        y = jnp.einsum("bsh,ho->bso", x, w)
+        if b is not None:
+            y = y + b
+        return jax.lax.with_sharding_constraint(y, sharding)
+
+    def chunked(x, w, b):
+        c = x.shape[1] // nsplit
+        outs, prev = [], None
+        for i in range(nsplit):
+            xi = jax.lax.dynamic_slice_in_dim(x, i * c, c, 1)
+            if prev is not None:
+                xi = _sched_barrier(xi, prev)
+            yi = jnp.einsum("bsh,ho->bso", xi, w)
+            if b is not None:
+                yi = yi + b
+            yi = jax.lax.with_sharding_constraint(yi, sharding)
+            outs.append(yi)
+            prev = yi
+        return jnp.concatenate(outs, axis=1)
+
+    args = (x_arr, w_arr) if b_arr is None else (x_arr, w_arr, b_arr)
+    if b_arr is None:
+        return ab_gate(kernel, lambda x, w: plain(x, w, None),
+                       lambda x, w: chunked(x, w, None), args,
+                       repeats=repeats, sig=shape_sig(x_arr, w_arr))
+    return ab_gate(kernel, plain, chunked, args, repeats=repeats,
+                   sig=shape_sig(x_arr, w_arr))

@@ -29,18 +29,46 @@ __all__ = ["all_gather", "all_reduce", "alltoall", "alltoall_single",
 
 class _StreamTask:
     """Handle for a ``sync_op=False`` stream collective. ``wait()``
-    completes the ring entry and returns the underlying result."""
+    stamps the entry's ``t_wait`` (the overlap sampler credits the
+    issue→wait window as communication hidden under host work), runs the
+    optional ``finalizer`` (e.g. ``jax.block_until_ready`` for the
+    bucketed grad-sync tasks, so ``t_complete`` reflects the device
+    actually finishing), completes the ring entry and returns the
+    underlying result."""
 
-    def __init__(self, result, entry):
+    def __init__(self, result, entry, finalizer=None):
         self._result = result
         self._entry = entry
+        self._finalizer = finalizer
         self._done = False
 
     def wait(self):
         if not self._done:
             self._done = True
+            _fr.note_wait_begin(self._entry)
+            if self._finalizer is not None:
+                self._result = self._finalizer(self._result)
+                if self._entry is not None:
+                    # a finalizer that blocks on the device makes
+                    # t_complete device-true — only such entries feed the
+                    # overlap gauge (a bare bookkeeping wait() completes
+                    # instantly and would read as 100% hidden)
+                    self._entry["device_synced"] = True
             _fr.record_complete(self._entry)
         return self._result
+
+    def abandon(self):
+        """Close the ring entry for a task orphaned by an aborted step —
+        no device wait, no t_wait stamp, no overlap credit. The entry is
+        flagged so the metrics/trace feeds skip it: its issue→now gap is
+        abort wall time, not collective latency, and one such sample
+        would poison the p99 guard and the overlap gauge."""
+        if self._done:
+            return
+        self._done = True
+        if self._entry is not None:
+            self._entry["aborted"] = True
+        _fr.record_complete(self._entry)
 
     def is_completed(self):
         return self._done

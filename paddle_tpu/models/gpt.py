@@ -33,7 +33,8 @@ class GPTConfig:
                  num_heads=12, intermediate_size=None, max_seq_len=1024,
                  dropout=0.1, layer_norm_epsilon=1e-5, tensor_parallel=False,
                  sequence_parallel=False, use_rms_norm=False,
-                 tie_word_embeddings=True, recompute=False):
+                 tie_word_embeddings=True, recompute=False,
+                 tp_overlap=None):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -47,6 +48,10 @@ class GPTConfig:
         self.use_rms_norm = use_rms_norm
         self.tie_word_embeddings = tie_word_embeddings
         self.recompute = recompute
+        # latency-hiding TP matmul+collective decomposition (overlap
+        # engine): None = auto behind the measured ab_gate verdict at the
+        # exact shape (never off-TPU), True = force, False = plain fused
+        self.tp_overlap = tp_overlap
 
 
 def gpt_tiny(**kw):
@@ -161,8 +166,9 @@ class GPTAttention(nn.Layer):
             from ..distributed import fleet
             self.qkv_proj = fleet.ColumnParallelLinear(h, 3 * h,
                                                        gather_output=False)
-            self.out_proj = fleet.RowParallelLinear(h, h,
-                                                    input_is_parallel=True)
+            self.out_proj = fleet.RowParallelLinear(
+                h, h, input_is_parallel=True,
+                tp_overlap=config.tp_overlap)
         else:
             self.qkv_proj = nn.Linear(h, 3 * h)
             self.out_proj = nn.Linear(h, h)
@@ -287,8 +293,9 @@ class GPTMLP(nn.Layer):
             from ..distributed import fleet
             self.fc1 = fleet.ColumnParallelLinear(h, ffn,
                                                   gather_output=False)
-            self.fc2 = fleet.RowParallelLinear(ffn, h,
-                                               input_is_parallel=True)
+            self.fc2 = fleet.RowParallelLinear(
+                ffn, h, input_is_parallel=True,
+                tp_overlap=config.tp_overlap)
         else:
             self.fc1 = nn.Linear(h, ffn)
             self.fc2 = nn.Linear(ffn, h)

@@ -425,7 +425,7 @@ def observe_collective(entry):
     if reg is None or entry is None:
         return
     group = entry.get("group", "?")
-    if group == "step":
+    if group == "step" or entry.get("aborted"):
         return
     t0, t1 = entry.get("t_issue"), entry.get("t_complete")
     if t0 is None or t1 is None:
@@ -440,6 +440,28 @@ def observe_collective(entry):
         if nbytes:
             reg.counter("collective_bytes_total",
                         kind=kind).inc(int(nbytes))
+        # in-run overlap sampler (overlap engine, ROADMAP item 2): an
+        # AWAITED async collective carries t_wait — the t_issue→t_wait
+        # window is time the collective was in flight while the host kept
+        # dispatching work (communication hidden under compute); the
+        # t_wait→t_complete remainder is the blocking drain. The gauge is
+        # the cumulative hidden fraction, the same comm_overlap_pct key
+        # bench's xplane leg reports — but measured IN-RUN, from flight-
+        # recorder stamps, with no trace collection. Only device-synced
+        # entries count (the waiter blocked until the result was ready):
+        # a bookkeeping-only wait() stamps t_complete == t_wait and would
+        # pollute the gauge with fake 100%-hidden samples.
+        t_w = entry.get("t_wait")
+        if t_w is not None and entry.get("device_synced"):
+            inflight_us = (t1 - t0) * 1e6
+            hidden_us = min(max((t_w - t0) * 1e6, 0.0), inflight_us)
+            c_in = reg.counter("comm_inflight_us_total")
+            c_hid = reg.counter("comm_overlapped_us_total")
+            c_in.inc(inflight_us)
+            c_hid.inc(hidden_us)
+            if c_in.value > 0:
+                reg.gauge("comm_overlap_pct").set(
+                    100.0 * c_hid.value / c_in.value)
 
 
 # ---------------------------------------------------------- hardware table

@@ -127,6 +127,7 @@ def build_run_report(per_rank):
     compute_ms_total = 0.0
     comm_us_total = 0.0
     overlap_pcts = []
+    overlap_sources = set()
     for rank, snaps in sorted(per_rank.items()):
         last = snaps[-1]
         hists = last.get("histograms", {})
@@ -150,6 +151,13 @@ def build_run_report(per_rank):
                 row[key] = gauges[key]
         if "comm_overlap_pct" in gauges:
             overlap_pcts.append(gauges["comm_overlap_pct"])
+            # provenance: the overlap engine feeds the gauge in-run from
+            # flight-recorder issue/wait stamps (counters present); the
+            # bench xplane leg sets the bare gauge from a device trace
+            if "comm_inflight_us_total" in counters:
+                overlap_sources.add("in-run flight-recorder stamps")
+            else:
+                overlap_sources.add("device timeline")
         ranks[rank] = row
         # per-collective latency, merged across ranks. Store-backed
         # control-plane waits (TCPStore commit barriers group="store",
@@ -220,6 +228,7 @@ def build_run_report(per_rank):
             100.0 * (comm_us_total / 1e3) / compute_ms_total)
     if overlap_pcts:
         report["comm_overlap_pct"] = sum(overlap_pcts) / len(overlap_pcts)
+        report["comm_overlap_source"] = " + ".join(sorted(overlap_sources))
     return report
 
 
@@ -262,8 +271,9 @@ def format_run_report(report):
                     key, row.get("count", 0), _fmt(row.get("p50_us")),
                     _fmt(row.get("p99_us"))))
     if report.get("comm_overlap_pct") is not None:
+        src = report.get("comm_overlap_source") or "device timeline"
         lines.append(f"[telemetry] comm/compute overlap: "
-                     f"{report['comm_overlap_pct']:.1f}% (device timeline)")
+                     f"{report['comm_overlap_pct']:.1f}% ({src})")
     elif report.get("comm_vs_compute_pct") is not None:
         lines.append(
             f"[telemetry] host-visible comm vs compute: "

@@ -67,7 +67,14 @@ class TraceBuffer:
             events = list(self.events)
             dropped = self.dropped
         meta = [{"name": "process_name", "ph": "M", "pid": self.rank,
-                 "args": {"name": f"rank_{self.rank} host"}}]
+                 "args": {"name": f"rank_{self.rank} host"}},
+                # clock provenance for the merge tool's --align: host
+                # spans stamp time.time() µs (the same wall clock the
+                # flight recorder uses), so device lanes from another
+                # clock domain can be shifted onto this one
+                {"name": "clock_domain", "ph": "M", "pid": self.rank,
+                 "args": {"domain": "wall", "export_wall_us":
+                          time.time() * 1e6}}]
         d = {"traceEvents": meta + events, "displayTimeUnit": "ms"}
         if dropped:
             d["droppedEvents"] = dropped
@@ -211,7 +218,7 @@ def collective_event(entry):
     if buf is None or entry is None:
         return
     group = entry.get("group")
-    if group == "step":
+    if group == "step" or entry.get("aborted"):
         return
     t0, t1 = entry.get("t_issue"), entry.get("t_complete")
     if t0 is None or t1 is None:

@@ -19,12 +19,19 @@ gate generalizes ``serving/decode.py``'s A/B mechanism to every kernel:
   demoted, never promoted on faith. Measurement never happens implicitly
   inside user code or under tracing (you cannot time a tracer).
 
-Verdicts are process-local; :func:`nearest_verdict` lets size-polymorphic
-callers (the fused optimizer sweeping many param shapes) reuse a same-
-dtype/same-rank verdict within a 4x size band.
+Verdicts are process-local by default; :func:`nearest_verdict` lets size-
+polymorphic callers (the fused optimizer sweeping many param shapes) reuse
+a same-dtype/same-rank verdict within a 4x size band. Set
+``PADDLE_TPU_KERNELS_CACHE=<path>`` to persist verdicts ACROSS processes
+as JSON (PR-7 follow-up c): the file is loaded lazily on the first verdict
+query (in-memory measurements win over file rows), merged and atomically
+re-saved on every :func:`record_verdict` — so a bench run warms the cache
+and later user jobs start with measured verdicts instead of the
+demote-unproven default.
 """
 from __future__ import annotations
 
+import json
 import os
 import time
 
@@ -33,13 +40,16 @@ import jax.numpy as jnp
 
 __all__ = ["pad_rows_to_grid", "kernels_mode", "on_tpu", "shape_sig",
            "pallas_default", "ab_gate", "record_verdict", "get_verdict",
-           "nearest_verdict", "gate_report", "KERNELS_ENV"]
+           "nearest_verdict", "gate_report", "save_verdicts",
+           "KERNELS_ENV", "KERNELS_CACHE_ENV"]
 
 KERNELS_ENV = "PADDLE_TPU_KERNELS"
+KERNELS_CACHE_ENV = "PADDLE_TPU_KERNELS_CACHE"
 _MODES = ("xla", "pallas", "auto")
 
 # (kernel name, shape sig) -> {"backend", "xla_ms", "pallas_ms", "reason"}
 _verdicts: dict = {}
+_cache_loaded = False
 
 # auto-mode behavior when NO verdict (exact or nearest) exists for a shape.
 # flash_attention is the incumbent winner (it carried the MFU headline
@@ -52,8 +62,85 @@ _UNMEASURED_DEFAULT = {"flash_attention": True}
 
 
 def _reset_state():
-    """Drop every cached A/B verdict (tests)."""
+    """Drop every cached A/B verdict (tests) and forget whether the
+    persistent cache file was loaded."""
+    global _cache_loaded
     _verdicts.clear()
+    _cache_loaded = False
+
+
+# ----------------------------------------------- cross-process persistence
+
+def _sig_to_json(sig):
+    return [[list(s), d] for s, d in sig]
+
+
+def _sig_from_json(j):
+    return tuple((tuple(int(x) for x in s), str(d)) for s, d in j)
+
+
+def _load_cache():
+    """Lazy one-shot load of ``PADDLE_TPU_KERNELS_CACHE``. File rows never
+    override verdicts measured in THIS process (fresher hardware truth)."""
+    global _cache_loaded
+    if _cache_loaded:
+        return
+    _cache_loaded = True
+    path = os.environ.get(KERNELS_CACHE_ENV)
+    if not path or not os.path.exists(path):
+        return
+    try:
+        with open(path) as f:
+            rows = json.load(f)
+        for r in rows:
+            _verdicts.setdefault((r["kernel"], _sig_from_json(r["sig"])),
+                                 r["row"])
+    except Exception as e:
+        import sys
+        print(f"[kernels] {KERNELS_CACHE_ENV}={path}: load failed "
+              f"({type(e).__name__}: {e}); starting from empty verdicts",
+              file=sys.stderr, flush=True)
+
+
+def save_verdicts(path=None):
+    """Merge the in-memory verdicts into the cache file and atomically
+    replace it (tmp + ``os.replace`` — a concurrent reader never sees a
+    torn file). Rows already on disk for other shapes survive. Returns
+    the path, or None when no cache is configured.
+
+    Called on every :func:`record_verdict` by design: verdicts arrive
+    only from explicit measurement (a bench leg, serving startup —
+    dozens per process at most, never a hot loop), the file is KB-scale,
+    and saving immediately means a crash mid-sweep keeps everything
+    measured so far."""
+    path = path or os.environ.get(KERNELS_CACHE_ENV)
+    if not path:
+        return None
+    merged: dict = {}
+    try:
+        if os.path.exists(path):
+            with open(path) as f:
+                for r in json.load(f):
+                    merged[(r["kernel"], _sig_from_json(r["sig"]))] = \
+                        r["row"]
+    except Exception:
+        pass  # a corrupt file is replaced wholesale
+    merged.update(_verdicts)
+    rows = [{"kernel": k, "sig": _sig_to_json(s), "row": row}
+            for (k, s), row in sorted(merged.items(), key=str)]
+    try:
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(rows, f, indent=1)
+        os.replace(tmp, path)
+    except Exception as e:
+        import sys
+        print(f"[kernels] {KERNELS_CACHE_ENV}={path}: save failed "
+              f"({type(e).__name__}: {e})", file=sys.stderr, flush=True)
+        return None
+    return path
 
 
 def kernels_mode() -> str:
@@ -80,11 +167,15 @@ def shape_sig(*arrays):
 
 
 def get_verdict(kernel, sig):
+    _load_cache()
     return _verdicts.get((kernel, sig))
 
 
 def record_verdict(kernel, sig, row):
+    _load_cache()
     _verdicts[(kernel, sig)] = row
+    if os.environ.get(KERNELS_CACHE_ENV):
+        save_verdicts()
 
 
 def nearest_verdict(kernel, sig, size_band=4.0):
@@ -95,6 +186,7 @@ def nearest_verdict(kernel, sig, size_band=4.0):
     kernels care about total element count (bench measures fused AdamW on
     a flat 8M vector, real params are 2-D; norm call sites see [B, S, H]
     activations against a 2-D bench verdict)."""
+    _load_cache()
     if not sig:
         return None
     want_shape, want_dtype = sig[0]
@@ -130,6 +222,7 @@ def pallas_default(kernel, sig, allow_nearest=False):
         return True
     if mode == "xla":
         return False
+    _load_cache()
     row = _verdicts.get((kernel, sig))
     if row is None and allow_nearest:
         row = nearest_verdict(kernel, sig)
@@ -204,6 +297,7 @@ def ab_gate(kernel, xla_fn, pallas_fn, args, repeats=10, record=True,
 def gate_report():
     """Every cached verdict, keyed ``kernel[shapes]`` — the bench snapshot
     embeds this so each round records which kernels were demoted where."""
+    _load_cache()
     out = {}
     for (kernel, sig), row in sorted(_verdicts.items(), key=str):
         label = ",".join("x".join(map(str, s)) + f":{d}" for s, d in sig)

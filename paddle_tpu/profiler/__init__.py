@@ -329,17 +329,55 @@ def load_profiler_result(path):
         "viewed with TensorBoard instead")
 
 
-def merge_profiler_results(paths, out_path=None, labels=None):
+def _trace_min_ts(d):
+    return min((ev["ts"] for ev in d.get("traceEvents", [])
+                if ev.get("ph") == "X"), default=None)
+
+
+def _is_xplane_domain(d):
+    for ev in d.get("traceEvents", []):
+        if ev.get("ph") == "M" and ev.get("name") == "clock_domain" \
+                and (ev.get("args") or {}).get("domain") == "xplane":
+            return True
+    return False
+
+
+def merge_profiler_results(paths, out_path=None, labels=None, align=False,
+                           align_threshold_s=60.0):
     """Multi-rank trace merge (reference: CrossStackProfiler — the
     multi-node profiler aggregation tool). Each input chrome trace (one
     per rank, as exported by Profiler.export on that rank, or a host-span
     export from observability.tracing, or an xplane-derived device trace)
     lands on its own pid lane, labeled ``labels[i]`` (default rank_N); a
     process_name metadata event names the lane. Returns the merged dict
-    (and writes it when out_path given)."""
+    (and writes it when out_path given).
+
+    ``align=True`` performs trace/xplane clock alignment (overlap-engine
+    measurement loop): xplane-derived device traces stamp the profiler's
+    clock domain, host-span traces stamp ``time.time()`` — when the two
+    disagree by more than ``align_threshold_s`` (clearly different
+    domains, not real skew) every device lane is shifted so its earliest
+    event lands on the earliest host event, and the applied shift is
+    recorded in the lane's ``clock_domain`` metadata. Same-domain traces
+    are never touched (a shift there would falsify real cross-rank
+    skew)."""
     merged = {"traceEvents": [], "displayTimeUnit": "ms"}
-    for rank, p in enumerate(paths):
-        d = p if isinstance(p, dict) else load_profiler_result(p)
+    loaded = [(p if isinstance(p, dict) else load_profiler_result(p))
+              for p in paths]
+    shifts = [0.0] * len(loaded)
+    if align:
+        host_anchor = min(
+            (t for d, t in ((d, _trace_min_ts(d)) for d in loaded)
+             if t is not None and not _is_xplane_domain(d)), default=None)
+        if host_anchor is not None:
+            for i, d in enumerate(loaded):
+                if not _is_xplane_domain(d):
+                    continue
+                t0 = _trace_min_ts(d)
+                if t0 is not None and \
+                        abs(t0 - host_anchor) > align_threshold_s * 1e6:
+                    shifts[i] = host_anchor - t0
+    for rank, d in enumerate(loaded):
         label = labels[rank] if labels and rank < len(labels) \
             else f"rank_{rank}"
         merged["traceEvents"].append({
@@ -349,6 +387,12 @@ def merge_profiler_results(paths, out_path=None, labels=None):
             ev = dict(ev)
             if ev.get("ph") == "M" and ev.get("name") == "process_name":
                 continue  # the input's own lane label: superseded
+            if ev.get("ph") == "M" and ev.get("name") == "clock_domain" \
+                    and shifts[rank]:
+                ev["args"] = dict(ev.get("args") or {},
+                                  applied_shift_us=shifts[rank])
+            if shifts[rank] and "ts" in ev:
+                ev["ts"] = ev["ts"] + shifts[rank]
             ev["pid"] = rank
             merged["traceEvents"].append(ev)
     if out_path:

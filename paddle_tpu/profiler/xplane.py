@@ -93,25 +93,37 @@ def _intersect(a, b):
     return out
 
 
-def to_chrome_trace(path_or_logdir, pid=0, label="device"):
+def to_chrome_trace(path_or_logdir, pid=0, label="device", shift_us=0.0):
     """Convert the device-execution lines of an xplane trace into a
     chrome-trace dict, mergeable with the host-span export of
     :mod:`paddle_tpu.observability.tracing` via
     ``python -m paddle_tpu.tools.merge_profiles`` (which accepts xplane
     log dirs directly). Each device line becomes a tid lane; comm ops are
     categorized ``collective`` so they share a color with the host-side
-    collective events."""
+    collective events.
+
+    ``shift_us`` offsets every event timestamp — the clock-alignment
+    hook: xplane stamps come from the profiler's own clock domain (device
+    clocks calibrated to the XLA host timer), while host spans stamp
+    ``time.time()``; the merge tool's ``--align`` computes the shift so
+    both lanes line up in one Perfetto view. The returned dict carries
+    the applied shift and the raw first-event stamp in a
+    ``clock_domain`` metadata event so the alignment is auditable."""
     events = parse_xplane(path_or_logdir)
     tids = {}
     out = [{"name": "process_name", "ph": "M", "pid": pid,
             "args": {"name": label}}]
+    first_raw_us = min((s / 1e6 for _, _, s, _ in events), default=None)
+    out.append({"name": "clock_domain", "ph": "M", "pid": pid,
+                "args": {"domain": "xplane", "shift_us": float(shift_us),
+                         "first_event_raw_us": first_raw_us}})
     for line_name, name, start_ps, dur_ps in events:
         tid = tids.setdefault(line_name, len(tids))
         lo = name.lower()
         cat = "collective" if any(m in lo for m in _COMM_MARKERS) \
             else "device"
         out.append({"name": name, "ph": "X", "pid": pid, "tid": tid,
-                    "ts": start_ps / 1e6, "dur": dur_ps / 1e6,
+                    "ts": start_ps / 1e6 + shift_us, "dur": dur_ps / 1e6,
                     "cat": cat})
     for line_name, tid in tids.items():
         out.append({"name": "thread_name", "ph": "M", "pid": pid,

@@ -37,12 +37,17 @@ def main(argv=None):
                     help="per-rank chrome traces (.json) and/or xplane "
                          "log directories")
     ap.add_argument("-o", "--out", required=True)
+    ap.add_argument("--align", action="store_true",
+                    help="shift xplane device lanes onto the host-span "
+                         "wall clock when their clock domains disagree, "
+                         "so merged Perfetto lanes line up")
     args = ap.parse_args(argv)
     from ..profiler import merge_profiler_results
     loaded = [load_input(p) for p in args.traces]
     merged = merge_profiler_results([d for d, _ in loaded],
                                     out_path=args.out,
-                                    labels=[l for _, l in loaded])
+                                    labels=[l for _, l in loaded],
+                                    align=args.align)
     print(f"merged {len(args.traces)} traces -> {args.out} "
           f"({len(merged['traceEvents'])} events)")
     return 0

@@ -76,6 +76,15 @@ def _seed():
     # env-gated default so an enabled recorder/desync mode can't leak
     from paddle_tpu.distributed import flight_recorder as _flight
     _flight._reset_state()
+    # grad-sync hooks (overlap engine's bucket schedulers) are a process-
+    # global registry on the autograd walk: a test that attached one (or
+    # leaked a DataParallel with comm_overlap=True) must not keep firing
+    # collectives in its successors' backwards
+    from paddle_tpu.core import autograd as _autograd
+    try:
+        _autograd._grad_sync_hooks.clear()
+    except AttributeError:
+        pass  # a test monkeypatched the registry with a stand-in
     # same for the observability planes (metrics registry, trace buffer):
     # a test that enables them must not leak histograms/spans into — or
     # slow down — its successors

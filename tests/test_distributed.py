@@ -613,6 +613,14 @@ def test_all_reduce_quantized_approximates_sum(_env):
     t2 = paddle.to_tensor(data.copy())
     dist.all_reduce(t2)
     np.testing.assert_allclose(t2.numpy()[:1], want, rtol=1e-4)
+    # bf16 transport (bits=16): ~2x wire volume, ~2^-8 relative error
+    t3 = paddle.to_tensor(data.copy())
+    dist.collective.all_reduce_quantized(t3, bits=16)
+    got16 = t3.numpy()
+    tol16 = (np.abs(data).max(axis=1) * 2.0 ** -8).sum() + 1e-6
+    assert np.abs(got16 - want).max() < tol16
+    # int8 wire is noisier than bf16 at this payload
+    assert np.abs(got16 - want).max() <= np.abs(got - want).max() + 1e-6
     with pytest.raises(ValueError, match="bits"):
         dist.collective.all_reduce_quantized(
-            paddle.to_tensor(data.copy()), bits=16)
+            paddle.to_tensor(data.copy()), bits=4)

@@ -266,6 +266,30 @@ def test_continuous_admission_no_decode_gap(tiny_model):
     assert len(a.result(10)) == 8
 
 
+def test_prefill_jitted_per_bucket_bounded_compiles(tiny_model):
+    """ISSUE 8 satellite (ROADMAP item 3 follow-up): the prefill path is
+    compiled per (batch, seq) bucket — prompts of different lengths that
+    map to the same bucket share ONE program, the compile cache is
+    bounded by the bucket sets, and the jitted engine decodes the same
+    tokens as the eager one."""
+    eng = _engine(tiny_model, prefill_seq_buckets=[8, 16],
+                  prefill_batch_buckets=[1, 2])
+    rng = np.random.RandomState(4)
+    prompts = [rng.randint(1, 250, n).tolist() for n in (3, 5, 8, 11)]
+    jit_tokens = [eng.generate(p, max_new_tokens=3) for p in prompts]
+    # lengths 3/5/8 share the seq-8 bucket; 11 lands in seq-16 — exactly
+    # two compiled prefill programs, and never more than |batch|x|seq|
+    assert len(eng._prefill_fns) == 2
+    assert set(eng._prefill_fns) == {(1, 8), (1, 16)}
+    assert len(eng._prefill_fns) <= 2 * 2
+    eager = _engine(tiny_model, prefill_seq_buckets=[8, 16],
+                    prefill_batch_buckets=[1, 2], jit=False)
+    assert eager._prefill_fns == {} or all(
+        not hasattr(f, "lower") for f in eager._prefill_fns.values())
+    for p, jt in zip(prompts, jit_tokens):
+        assert eager.generate(p, max_new_tokens=3) == jt
+
+
 def test_streaming_callbacks_and_finish_order(tiny_model):
     tokens, finals = [], []
     eng = _engine(tiny_model)

@@ -358,23 +358,52 @@ class BucketedGradSync:
         flat = flats[0] if len(flats) == 1 else jnp.concatenate(flats)
         traced = isinstance(flat, jax.core.Tracer)
         transport = self.transport
-        if traced and transport != "off":
-            if not self._warned_traced_quant:
-                self._warned_traced_quant = True
-                print("[overlap] quantized DP transport is eager-only (the "
-                      "error-feedback residual is cross-step state a traced "
-                      "program cannot carry); the compiled step uses the "
-                      "exact per-bucket psum schedule instead",
-                      file=sys.stderr, flush=True)
-            transport = "off"
         if traced:
-            # in-program schedule: one psum per bucket, placed HERE (grad-
-            # production order) and pinned by an optimization barrier so
-            # XLA's async-collective pass overlaps it with the remaining
-            # backward instead of sinking it to the end of the program
+            # in-program schedule: one collective per bucket, placed HERE
+            # (grad-production order) and pinned by an optimization
+            # barrier so XLA's async-collective pass overlaps it with the
+            # remaining backward instead of sinking it to the end of the
+            # program. The QUANTIZED transport also serves here when the
+            # per-bucket error-feedback residual was staged as step state
+            # (jit.to_static discovers this scheduler's _state_slots and
+            # threads the residual through the compiled step like an
+            # optimizer accumulator) — the residual slot holds a tracer
+            # during the walk, proving the cross-step carry is wired.
             self.traced_fires += 1
-            fn = self._sync_fn("off", ef=False)
-            synced = fn(jax.lax.optimization_barrier(flat))
+            r = self._residuals.get(bucket.index)
+            r_traced = isinstance(r, jax.core.Tracer)
+            staged = (transport != "off" and r_traced
+                      and r.shape == flat.shape)
+            if staged:
+                fn = self._sync_fn(transport, ef=True)
+                synced, new_r = fn(jax.lax.optimization_barrier(flat), r)
+                self._residuals[bucket.index] = new_r
+            else:
+                if transport != "off" and not self._warned_traced_quant:
+                    self._warned_traced_quant = True
+                    if r_traced:
+                        # staged fine — but this graph produced only part
+                        # of the bucket's gradients (unused params), so
+                        # the full-size residual cannot align with the
+                        # partial payload
+                        print(f"[overlap] bucket {bucket.index} produced "
+                              f"a partial gradient payload ({flat.size} "
+                              f"of {sum(bucket.numels)} elements — some "
+                              "params have no grad in this graph); the "
+                              "error-feedback residual cannot align, so "
+                              "partial buckets sync with the exact psum "
+                              "instead of the quantized transport",
+                              file=sys.stderr, flush=True)
+                    else:
+                        print("[overlap] quantized DP transport under "
+                              "tracing needs the error-feedback residual "
+                              "staged as step state — stage the train "
+                              "step with jit.to_static(capture=...) (the "
+                              "residual then rides the compiled step); "
+                              "falling back to the exact per-bucket psum "
+                              "schedule", file=sys.stderr, flush=True)
+                fn = self._sync_fn("off", ef=False)
+                synced = fn(jax.lax.optimization_barrier(flat))
             self._writeback(metas, synced)
             return
         ef = transport != "off"
@@ -418,6 +447,24 @@ class BucketedGradSync:
         """The error-feedback residual of one bucket (None before the
         first quantized sync) — test/debug surface."""
         return self._residuals.get(bucket_index)
+
+    # ------------------------------------------------- compiled-step state
+    def _state_slots(self):
+        """[(container, key)] of the per-bucket error-feedback residuals —
+        the same protocol as ``Optimizer._state_slots``, discovered by
+        ``jit.to_static``'s state walk (ROADMAP item 2c): staging the
+        residual as step state lets the QUANTIZED transport serve inside
+        the compiled train step (it is cross-step device state the traced
+        program reads, updates, and returns). Residuals are materialized
+        as zeros up front so the program's input signature is stable from
+        the first trace."""
+        if self.transport == "off":
+            return []
+        for b in self.buckets:
+            if b.index not in self._residuals:
+                self._residuals[b.index] = jnp.zeros(
+                    (int(sum(b.numels)),), jnp.float32)
+        return [(self._residuals, b.index) for b in self.buckets]
 
 
 # --------------------------------------------------------------------------

@@ -307,6 +307,21 @@ class StaticFunction:
         slots = []
         for opt in opts:
             slots.extend(opt._state_slots())
+        # grad-sync schedulers (overlap engine) can carry cross-step device
+        # state of their own: the quantized transports' per-bucket error-
+        # feedback residuals. Any attached scheduler watching this step's
+        # parameters exposes the same _state_slots protocol as an
+        # optimizer — staging the residuals lets the quantized DP path
+        # serve inside the compiled step instead of falling back to the
+        # exact psum (ROADMAP item 2c).
+        from ..core.autograd import _grad_sync_hooks
+        pids = {id(p) for p in params}
+        for ref in list(_grad_sync_hooks):
+            hook = ref()
+            if hook is None or not hasattr(hook, "_state_slots"):
+                continue
+            if pids & set(hook.param_ids()):
+                slots.extend(hook._state_slots())
         return params, buffers, slots, layers, opts
 
     def __call__(self, *args, **kwargs):

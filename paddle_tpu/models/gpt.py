@@ -34,11 +34,22 @@ class GPTConfig:
                  dropout=0.1, layer_norm_epsilon=1e-5, tensor_parallel=False,
                  sequence_parallel=False, use_rms_norm=False,
                  tie_word_embeddings=True, recompute=False,
-                 tp_overlap=None):
+                 tp_overlap=None, num_kv_heads=None):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
         self.num_heads = num_heads
+        # grouped-query attention: num_kv_heads < num_heads shares each K/V
+        # head across a group of num_heads // num_kv_heads query heads —
+        # KV caches (dense AND paged serving pools) shrink by that factor,
+        # which directly raises how many concurrent requests a serving
+        # pool can hold. Default (None) = multi-head attention.
+        self.num_kv_heads = int(num_kv_heads or num_heads)
+        if num_heads % self.num_kv_heads:
+            raise ValueError(
+                f"num_heads={num_heads} must be divisible by "
+                f"num_kv_heads={self.num_kv_heads} (query heads are "
+                "grouped evenly over KV heads)")
         self.intermediate_size = intermediate_size or 4 * hidden_size
         self.max_seq_len = max_seq_len
         self.dropout = dropout
@@ -108,6 +119,40 @@ def _pool_write(pool, new, block_tables, positions):
                                          positions])
 
 
+def _pool_write_seq(pool, new, block_tables, positions, lens):
+    """Chunked prefill: scatter a chunk of `new` [B, S, KVH, Dh] into the
+    page pool — row b's token i lands at absolute position
+    positions[b] + i for i < lens[b]; padded tokens (i >= lens[b]) are
+    redirected to the reserved scrap page 0 (never read), so one fixed
+    [B, S] launch serves ragged chunk tails."""
+    def fwd(p, n, bt, pos, ln):
+        page = p.shape[1]
+        B, S = n.shape[0], n.shape[1]
+        i = jnp.arange(S, dtype=jnp.int32)[None, :]
+        idx = pos[:, None].astype(jnp.int32) + i          # [B, S] abs pos
+        valid = i < ln[:, None].astype(jnp.int32)
+        logical = jnp.clip(idx // page, 0, bt.shape[1] - 1)
+        phys = jnp.take_along_axis(bt.astype(jnp.int32), logical, axis=1)
+        phys = jnp.where(valid, phys, 0)                  # scrap redirect
+        flat = n.reshape((B * S,) + n.shape[2:]).astype(p.dtype)
+        return p.at[phys.reshape(-1), (idx % page).reshape(-1)].set(flat)
+    return apply("paged_kv_write_seq", fwd,
+                 [pool, new, block_tables, positions, lens])
+
+
+def _paged_prefill_attend(q, k_pool, v_pool, block_tables, positions,
+                          lens, impl):
+    """Partial-prefix attention for a prefill chunk `q` [B, S, H, Dh]:
+    query token i of row b sees pool positions <= positions[b] + i (its
+    own KV was just written). `impl` runs on raw arrays — the serving
+    tier injects the sharded variant for multi-chip prefill."""
+    def fwd(qa, ka, va, bta, pos, ln):
+        return impl(qa, ka, va, bta.astype(jnp.int32),
+                    pos.astype(jnp.int32), ln.astype(jnp.int32))
+    return apply("paged_prefill_attention", fwd,
+                 [q, k_pool, v_pool, block_tables, positions, lens])
+
+
 def _paged_attend(q, k_pool, v_pool, block_tables, positions, impl):
     """Paged attention over the pool for query `q` [B, 1, H, Dh]; the
     context length per row is positions + 1 (the query token's own KV was
@@ -157,21 +202,36 @@ class GPTAttention(nn.Layer):
     def __init__(self, config: GPTConfig):
         super().__init__()
         self.num_heads = config.num_heads
+        self.num_kv_heads = config.num_kv_heads
         self.head_dim = config.hidden_size // config.num_heads
         self.dropout = config.dropout
         self._tp = config.tensor_parallel
         self._sharded_fa = None  # (mesh id, shard_map'd kernel) cache
         h = config.hidden_size
+        # fused QKV: [q (H·Dh) | k (KVH·Dh) | v (KVH·Dh)] — collapses to
+        # the classic 3h projection when num_kv_heads == num_heads
+        qkv_out = h + 2 * self.num_kv_heads * self.head_dim
         if config.tensor_parallel:
             from ..distributed import fleet
-            self.qkv_proj = fleet.ColumnParallelLinear(h, 3 * h,
+            self.qkv_proj = fleet.ColumnParallelLinear(h, qkv_out,
                                                        gather_output=False)
             self.out_proj = fleet.RowParallelLinear(
                 h, h, input_is_parallel=True,
                 tp_overlap=config.tp_overlap)
         else:
-            self.qkv_proj = nn.Linear(h, 3 * h)
+            self.qkv_proj = nn.Linear(h, qkv_out)
             self.out_proj = nn.Linear(h, h)
+
+    def _expand_kv(self, t):
+        """Broadcast each KV head over its query-head group for the dense
+        attention paths ([B, S, KVH, Dh] -> [B, S, H, Dh]); the paged
+        serving path attends grouped instead (no expansion — that is the
+        GQA memory/bandwidth win)."""
+        groups = self.num_heads // self.num_kv_heads
+        if groups == 1:
+            return t
+        from .. import ops
+        return ops.repeat_interleave(t, groups, axis=2)
 
     def _sharded_flash(self, q, k):
         """The shard_map'd flash kernel for the training path (SNIPPETS
@@ -213,9 +273,13 @@ class GPTAttention(nn.Layer):
         cache_kv semantics)."""
         b, s, h = x.shape
         qkv = self.qkv_proj(x)
-        qkv = qkv.reshape([b, s, 3, self.num_heads, self.head_dim])
-        q, k, v = qkv.unbind(2) if hasattr(qkv, "unbind") else (
-            qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2])
+        h_q = self.num_heads * self.head_dim
+        kv_w = self.num_kv_heads * self.head_dim
+        q = qkv[:, :, :h_q].reshape([b, s, self.num_heads, self.head_dim])
+        k = qkv[:, :, h_q:h_q + kv_w].reshape(
+            [b, s, self.num_kv_heads, self.head_dim])
+        v = qkv[:, :, h_q + kv_w:].reshape(
+            [b, s, self.num_kv_heads, self.head_dim])
         if cache is not None and cache.get("static"):
             # fixed-shape KV buffers [B, T, H, Dh] + a traced write cursor:
             # the whole decode step keeps one shape, so lax.while_loop can
@@ -233,8 +297,8 @@ class GPTAttention(nn.Layer):
             q_pos = (ops.arange(s, dtype="int32") + ln).unsqueeze(1)
             mask = (key_pos <= q_pos).reshape([1, 1, s, T])
             out = F.scaled_dot_product_attention(
-                q, kbuf, vbuf, attn_mask=mask, dropout_p=0.0,
-                training=False)
+                q, self._expand_kv(kbuf), self._expand_kv(vbuf),
+                attn_mask=mask, dropout_p=0.0, training=False)
         elif cache is not None and cache.get("paged"):
             # serving decode over the paged KV pool (serving/ engine):
             # one query token per row; this row's K/V goes into the page
@@ -242,20 +306,38 @@ class GPTAttention(nn.Layer):
             # row's block table (Ragged Paged Attention shape). The attn
             # impl is injected by the engine (XLA reference, Pallas
             # kernel, or the KV-head-sharded shard_map variant).
-            if s != 1:
-                raise NotImplementedError(
-                    "paged attention decodes one token per step; prefill "
-                    "uses the dense causal path")
             pos = cache["positions"]            # [B] int32: tokens cached
             bt = cache["block_tables"]          # [B, max_pages] int32
-            kp = _pool_write(cache["k_pool"], k, bt, pos)
-            vp = _pool_write(cache["v_pool"], v, bt, pos)
-            cache["k_pool"], cache["v_pool"] = kp, vp
-            impl = cache.get("attn_impl")
-            if impl is None:
-                from ..ops.pallas.paged_attention import \
-                    paged_attention_reference as impl
-            out = _paged_attend(q, kp, vp, bt, pos, impl)
+            if s == 1:
+                kp = _pool_write(cache["k_pool"], k, bt, pos)
+                vp = _pool_write(cache["v_pool"], v, bt, pos)
+                cache["k_pool"], cache["v_pool"] = kp, vp
+                impl = cache.get("attn_impl")
+                if impl is None:
+                    from ..ops.pallas.paged_attention import \
+                        paged_attention_reference as impl
+                out = _paged_attend(q, kp, vp, bt, pos, impl)
+            else:
+                # chunked prefill: a chunk of s tokens per row is written
+                # into the row's pages at positions[b]..positions[b]+s-1
+                # (ragged tails via chunk_lens, padding to scrap), then
+                # attends causally over its own tokens PLUS the already-
+                # written prefix pages — partial-prefix attention
+                if "chunk_lens" not in cache:
+                    raise ValueError(
+                        "multi-token paged forward is chunked prefill "
+                        "and needs cache['chunk_lens'] ([B] valid tokens "
+                        "per row); single-token decode omits it")
+                lens = cache["chunk_lens"]      # [B] valid chunk tokens
+                kp = _pool_write_seq(cache["k_pool"], k, bt, pos, lens)
+                vp = _pool_write_seq(cache["v_pool"], v, bt, pos, lens)
+                cache["k_pool"], cache["v_pool"] = kp, vp
+                impl = cache.get("prefill_impl")
+                if impl is None:
+                    from ..ops.pallas.paged_attention import \
+                        paged_prefill_reference as impl
+                out = _paged_prefill_attend(q, kp, vp, bt, pos, lens,
+                                            impl)
         elif cache is not None:
             from .. import ops
             if cache.get("k") is not None:
@@ -268,8 +350,13 @@ class GPTAttention(nn.Layer):
             cache["k"], cache["v"] = k, v
             causal = s > 1  # prefill is causal; single-token decode
             out = F.scaled_dot_product_attention(
-                q, k, v, is_causal=causal, dropout_p=0.0, training=False)
+                q, self._expand_kv(k), self._expand_kv(v),
+                is_causal=causal, dropout_p=0.0, training=False)
         else:
+            # training/no-cache: dense attention over H query heads — KV
+            # heads broadcast over their groups up front so the flash /
+            # sdpa kernels see the classic equal-head layout
+            k, v = self._expand_kv(k), self._expand_kv(v)
             fa = self._sharded_flash(q, k)
             if fa is not None:
                 # explicit placement before the manually-partitioned
@@ -492,8 +579,8 @@ class GPTForCausalLM(nn.Layer):
         B, prompt = input_ids.shape
         total = prompt + max_new_tokens
         cfg = self.config
-        Hh = cfg.num_heads
-        Dh = cfg.hidden_size // Hh
+        Hh = cfg.num_kv_heads   # cache buffers hold KV heads (GQA-sized)
+        Dh = cfg.hidden_size // cfg.num_heads
         dt = self.gpt.wte.weight._data.dtype
         eos = -1 if eos_token_id is None else int(eos_token_id)
         was_training = self.training

@@ -11,10 +11,14 @@ replicated stat layout as flash_attention.py).
 
 Layouts:
     q            [B, H, D]          one decode token per sequence
-    k/v_cache    [num_pages, page_size, H, D]
+    k/v_cache    [num_pages, page_size, KVH, D]   (KVH <= H: GQA pools —
+                 query heads grouped G = H // KVH over shared KV heads)
     block_tables [B, max_pages]     physical page id per logical page
     context_lens [B]                valid KV length per sequence
 Returns o [B, H, D].
+
+:func:`paged_prefill_reference` is the chunked-prefill sibling: S query
+tokens per row attending the row's pages with a ragged causal mask.
 """
 from __future__ import annotations
 
@@ -30,35 +34,93 @@ LANES = 128
 NEG_INF = np.float32(-1e30)
 
 
+def _grouped(H, KVH):
+    """Query-head group size for GQA pools (KVH kv heads shared across H
+    query heads); identity when the pool is classic multi-head."""
+    if H % KVH:
+        raise ValueError(
+            f"{H} query heads not divisible by {KVH} KV heads")
+    return H // KVH
+
+
 def paged_attention_reference(q, k_cache, v_cache, block_tables,
                               context_lens, scale=None):
     """jnp formulation (always-correct path; XLA compiles the page gather).
-    Shapes as in the module docstring."""
+    Shapes as in the module docstring; GQA-aware — the pools may carry
+    ``KVH <= H`` KV heads, query heads grouped ``G = H // KVH``."""
     B, H, D = q.shape
+    KVH = k_cache.shape[2]
+    G = _grouped(H, KVH)
     page_size = k_cache.shape[1]
     scale = np.float32(scale if scale is not None else 1.0 / np.sqrt(D))
     # clamp sentinel-padded ids: OOB take fills NaN, and 0-weight * NaN
     # would poison the output; clamped pages are masked by context_lens
     block_tables = jnp.clip(block_tables, 0, k_cache.shape[0] - 1)
-    # gather each sequence's pages: [B, max_pages, page_size, H, D]
+    # gather each sequence's pages: [B, max_pages, page_size, KVH, D]
     k = jnp.take(k_cache, block_tables, axis=0)
     v = jnp.take(v_cache, block_tables, axis=0)
     S = block_tables.shape[1] * page_size
-    k = k.reshape(B, S, H, D)
-    v = v.reshape(B, S, H, D)
-    s = jnp.einsum("bhd,bshd->bhs", q.astype(jnp.float32),
+    k = k.reshape(B, S, KVH, D)
+    v = v.reshape(B, S, KVH, D)
+    qg = q.reshape(B, KVH, G, D).astype(jnp.float32)
+    s = jnp.einsum("bkgd,bskd->bkgs", qg,
                    k.astype(jnp.float32)) * scale
     valid = jnp.arange(S)[None, :] < context_lens[:, None]
-    s = jnp.where(valid[:, None, :], s, NEG_INF)
+    s = jnp.where(valid[:, None, None, :], s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
-    o = jnp.einsum("bhs,bshd->bhd", p, v.astype(jnp.float32))
+    o = jnp.einsum("bkgs,bskd->bkgd", p, v.astype(jnp.float32))
+    o = o.reshape(B, H, D)
     # a fully-masked row softmaxes to uniform: zero it (context_len == 0)
     o = jnp.where((context_lens > 0)[:, None, None], o, 0.0)
     return o.astype(q.dtype)
 
 
+def paged_prefill_reference(q, k_cache, v_cache, block_tables, q_start,
+                            q_lens, scale=None):
+    """Partial-prefix attention for **chunked prefill** (jnp gather
+    formulation; the always-correct path the serving engine's chunk step
+    compiles). A chunk of ``S`` query tokens per row starts at absolute
+    position ``q_start[b]`` and attends causally over the row's pages —
+    which already hold the previously-written prefix PLUS this chunk's own
+    K/V (the chunk is scattered into the pool before attending, mirroring
+    the decode step's write-then-attend order):
+
+        q            [B, S, H, D]     (rows past ``q_lens[b]`` are padding)
+        k/v_cache    [num_pages, page_size, KVH, D]
+        block_tables [B, max_pages]
+        q_start      [B]   tokens already in the pool before this chunk
+        q_lens       [B]   valid query tokens in this chunk
+
+    Query token ``i`` of row ``b`` sees pool positions
+    ``<= q_start[b] + i``. Returns ``[B, S, H, D]``; padded query rows
+    produce garbage the caller discards (their pool writes were routed to
+    the scrap page)."""
+    B, S, H, D = q.shape
+    KVH = k_cache.shape[2]
+    G = _grouped(H, KVH)
+    page_size = k_cache.shape[1]
+    scale = np.float32(scale if scale is not None else 1.0 / np.sqrt(D))
+    block_tables = jnp.clip(block_tables, 0, k_cache.shape[0] - 1)
+    k = jnp.take(k_cache, block_tables, axis=0)
+    v = jnp.take(v_cache, block_tables, axis=0)
+    T = block_tables.shape[1] * page_size
+    k = k.reshape(B, T, KVH, D)
+    v = v.reshape(B, T, KVH, D)
+    qg = q.reshape(B, S, KVH, G, D).astype(jnp.float32)
+    s = jnp.einsum("bskgd,btkd->bskgt", qg,
+                   k.astype(jnp.float32)) * scale
+    key_pos = jnp.arange(T, dtype=jnp.int32)[None, None, :]      # [1,1,T]
+    q_pos = (q_start[:, None].astype(jnp.int32)
+             + jnp.arange(S, dtype=jnp.int32)[None, :])[:, :, None]
+    visible = key_pos <= q_pos                                   # [B,S,T]
+    s = jnp.where(visible[:, :, None, None, :], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bskgt,btkd->bskgd", p, v.astype(jnp.float32))
+    return o.reshape(B, S, H, D).astype(q.dtype)
+
+
 def _kernel(blk_ref, len_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr,
-            acc_scr, *, scale, page_size):
+            acc_scr, *, scale, page_size, groups):
     b = pl.program_id(0)
     i = pl.program_id(1)
     n = pl.num_programs(1)
@@ -70,13 +132,18 @@ def _kernel(blk_ref, len_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr,
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
     q = q_ref[0].astype(jnp.float32)               # [H, D]
-    k = k_ref[0].astype(jnp.float32)               # [page, H, D]
+    k = k_ref[0].astype(jnp.float32)               # [page, KVH, D]
     v = v_ref[0].astype(jnp.float32)
-    kt = jnp.swapaxes(k, 0, 1)                     # [H, page, D]
+    H, D = q.shape
+    kvh = H // groups
+    kt = jnp.swapaxes(k, 0, 1)                     # [KVH, page, D]
     vt = jnp.swapaxes(v, 0, 1)
+    # grouped-query scores: query heads [KVH, G] batch over their shared
+    # KV head, then flatten back to the [H, page] stat layout
+    qg = q.reshape(kvh, groups, D)
     s = jax.lax.dot_general(
-        q[:, None, :], kt, (((2,), (2,)), ((0,), (0,))),
-        preferred_element_type=jnp.float32)[:, 0, :] * scale  # [H, page]
+        qg, kt, (((2,), (2,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32).reshape(H, -1) * scale
     pos = i * page_size + jax.lax.broadcasted_iota(
         jnp.int32, s.shape, 1)
     in_ctx = pos < len_ref[b]
@@ -93,8 +160,8 @@ def _kernel(blk_ref, len_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr,
     l_scr[:] = corr * l_scr[:] + jax.lax.broadcast_in_dim(
         p.sum(axis=1), m_prev.shape, (0,))
     pv = jax.lax.dot_general(
-        p[:, None, :], vt, (((2,), (1,)), ((0,), (0,))),
-        preferred_element_type=jnp.float32)[:, 0, :]  # [H, D]
+        p.reshape(kvh, groups, -1), vt, (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32).reshape(H, D)
     acc_scr[:] = corr[:, :1] * acc_scr[:] + pv
     m_scr[:] = m_new
 
@@ -111,6 +178,8 @@ def paged_attention(q, k_cache, v_cache, block_tables, context_lens,
     the scalar-prefetched page table, so the DMA streams each sequence's
     physical pages directly."""
     B, H, D = q.shape
+    KVH = k_cache.shape[2]
+    groups = _grouped(H, KVH)
     num_pages, page_size = k_cache.shape[0], k_cache.shape[1]
     max_pages = block_tables.shape[1]
     scale = np.float32(scale if scale is not None else 1.0 / np.sqrt(D))
@@ -121,14 +190,15 @@ def paged_attention(q, k_cache, v_cache, block_tables, context_lens,
         # mask already zeroes such pages' contribution
         return (jnp.clip(blk[b, i], 0, num_pages - 1), 0, 0, 0)
 
-    kernel = functools.partial(_kernel, scale=scale, page_size=page_size)
+    kernel = functools.partial(_kernel, scale=scale, page_size=page_size,
+                               groups=groups)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,   # block_tables, context_lens
         grid=(B, max_pages),
         in_specs=[
             pl.BlockSpec((1, H, D), lambda b, i, blk, ln: (b, 0, 0)),
-            pl.BlockSpec((1, page_size, H, D), _page),
-            pl.BlockSpec((1, page_size, H, D), _page),
+            pl.BlockSpec((1, page_size, KVH, D), _page),
+            pl.BlockSpec((1, page_size, KVH, D), _page),
         ],
         out_specs=pl.BlockSpec((1, H, D), lambda b, i, blk, ln: (b, 0, 0)),
         scratch_shapes=[

@@ -13,13 +13,16 @@ Poisson open-loop load tooling for the bench.
     tokens = req.result(timeout=60)
 """
 from .kv_cache import BlockAllocator, OutOfPages, PagedKVCache, pages_for  # noqa: F401
+from .prefix_cache import PrefixCache  # noqa: F401
 from .scheduler import (  # noqa: F401
     ContinuousBatchingScheduler, EngineClosed, GenerationRequest, QueueFull,
 )
 from .decode import (  # noqa: F401
-    ab_compare, paged_decode_attention, resolve_backend,
-    sharded_paged_attention,
+    ab_compare, paged_decode_attention, paged_prefill_attention,
+    resolve_backend, sharded_paged_attention, sharded_paged_prefill,
 )
 from .engine import ServingEngine  # noqa: F401
 from .metrics import ServingMetrics  # noqa: F401
-from .load import run_poisson_load, summarize_requests  # noqa: F401
+from .load import (  # noqa: F401
+    make_shared_prefix_prompts, run_poisson_load, summarize_requests,
+)

@@ -36,9 +36,11 @@ from ..ops.pallas._common import on_tpu
 from ..ops.pallas.paged_attention import (
     paged_attention as _pallas_paged_attention,
     paged_attention_reference as _xla_paged_attention,
+    paged_prefill_reference as _xla_paged_prefill,
 )
 
-__all__ = ["paged_decode_attention", "sharded_paged_attention",
+__all__ = ["paged_decode_attention", "paged_prefill_attention",
+           "sharded_paged_attention", "sharded_paged_prefill",
            "resolve_backend", "ab_compare", "on_tpu"]
 
 BACKENDS = ("xla", "pallas", "auto")
@@ -54,6 +56,45 @@ def paged_decode_attention(q, k_pool, v_pool, block_tables, context_lens,
                                        context_lens, scale=scale)
     return _xla_paged_attention(q, k_pool, v_pool, block_tables,
                                 context_lens, scale=scale)
+
+
+def paged_prefill_attention(q, k_pool, v_pool, block_tables, q_start,
+                            q_lens, scale=None):
+    """Partial-prefix attention for one **chunked-prefill** step: ``q``
+    [B, S, H, Dh] chunk tokens starting at absolute position
+    ``q_start[b]`` per row, attending causally over the row's pages
+    (which already hold the prefix AND this chunk — write-then-attend,
+    same order as decode). XLA gather formulation only: chunk prefill is
+    a batched matmul-shaped workload XLA handles well, so there is no
+    Pallas leg to gate."""
+    return _xla_paged_prefill(q, k_pool, v_pool, block_tables, q_start,
+                              q_lens, scale=scale)
+
+
+def sharded_paged_prefill(mesh, axis_name="model", scale=None):
+    """Chunked-prefill attention sharded along KV heads over
+    ``mesh[axis_name]`` — same partitioning as the decode step (query
+    heads ride with their KV-head group; tables/starts/lens replicate).
+    Falls back to the unsharded fn when the axis degree is 1."""
+    degree = int(mesh.shape.get(axis_name, 1))
+
+    def _impl(q, kp, vp, bt, start, lens):
+        return paged_prefill_attention(q, kp, vp, bt, start, lens,
+                                       scale=scale)
+
+    if degree <= 1:
+        return _impl
+    in_specs = (
+        P(None, None, axis_name, None),   # q [B, S, H, Dh]
+        P(None, None, axis_name, None),   # k_pool [P, page, KVH, Dh]
+        P(None, None, axis_name, None),   # v_pool
+        P(),                              # block_tables (replicated)
+        P(),                              # q_start
+        P(),                              # q_lens
+    )
+    out_specs = P(None, None, axis_name, None)
+    return jax.jit(jax.shard_map(_impl, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, check_rep=False))
 
 
 def sharded_paged_attention(mesh, axis_name="model", backend="xla",

@@ -19,6 +19,19 @@ and runs it as a concurrent serving loop:
   asked). Compiled once with ``jax.jit`` — params, block tables and pools
   are arguments, pools are donated on TPU, so steady-state decode is one
   XLA program launch per token regardless of admission churn.
+* **chunked prefill** (ISSUE 9) — ``prefill_chunk=C`` splits prompts
+  into C-token chunks advanced at most ``prefill_token_budget`` tokens
+  per scheduler round, interleaved with decode: each chunk scatters its
+  K/V into the request's pages and runs partial-prefix attention
+  (:func:`~.decode.paged_prefill_attention`) over itself + the already-
+  written prefix, so a long prompt arriving mid-stream never stalls
+  in-flight decodes (ITL p99 is bounded by the budget).
+* **prefix caching** (ISSUE 9, on by default) — full prompt pages are
+  indexed in a page-granular trie (:class:`~.prefix_cache.PrefixCache`);
+  an admission hit takes the shared head by refcounted reference
+  (skipping its prefill compute AND page writes — only the tail runs
+  the chunk step), shared pages are copy-on-write read-only, and
+  reclamation drains only refcount-0 cached pages, LRU-first.
 * **scheduling** — between steps the
   :class:`~.scheduler.ContinuousBatchingScheduler` finishes / evicts /
   admits, so a request arriving mid-stream joins the next step without
@@ -49,6 +62,7 @@ from ..inference import pick_bucket
 from . import decode as _decode
 from .kv_cache import PagedKVCache, pages_for
 from .metrics import ServingMetrics
+from .prefix_cache import PrefixCache
 from .scheduler import (ContinuousBatchingScheduler, EngineClosed,
                         GenerationRequest)
 
@@ -105,7 +119,9 @@ class ServingEngine:
     def __init__(self, model, page_size=16, num_pages=64, max_slots=4,
                  max_queue=256, prefill_seq_buckets=None,
                  prefill_batch_buckets=None, attn_backend=None, mesh=None,
-                 mesh_axis="model", jit=True, registry=None):
+                 mesh_axis="model", jit=True, registry=None,
+                 prefill_chunk=None, prefill_token_budget=None,
+                 prefix_cache=True):
         cfg = model.config
         self.model = model
         self.model.eval()
@@ -114,14 +130,42 @@ class ServingEngine:
         self.max_slots = int(max_slots)
         self.max_pages = pages_for(cfg.max_seq_len, self.page_size)
         H = cfg.num_heads
+        KVH = getattr(cfg, "num_kv_heads", None) or H
         Dh = cfg.hidden_size // H
         dt = model.gpt.wte.weight._data.dtype
+        # GQA pools carry only the KV heads — [pages, page, KVH, Dh] is an
+        # H/KVH memory cut that directly raises max concurrent requests
         self.kv = PagedKVCache(cfg.num_layers, int(num_pages),
-                               self.page_size, H, Dh, dtype=dt)
+                               self.page_size, KVH, Dh, dtype=dt)
+        self.num_kv_heads = KVH
+        # prefix cache: content-addressed page sharing across requests
+        # with a common prompt head (hits skip prefill compute AND page
+        # writes; pages are refcounted with page-granular copy-on-write)
+        self.prefix = PrefixCache(self.kv.allocator, self.page_size) \
+            if prefix_cache else None
         self.scheduler = ContinuousBatchingScheduler(
             self.kv.allocator, self.max_slots, self.page_size,
-            cfg.max_seq_len, max_queue=max_queue)
-        self.metrics = ServingMetrics(registry=registry)
+            cfg.max_seq_len, max_queue=max_queue,
+            prefix_cache=self.prefix)
+        self.metrics = ServingMetrics(registry=registry,
+                                      prefix_enabled=self.prefix
+                                      is not None)
+        # chunked prefill: split prompts into prefill_chunk-token chunks
+        # and interleave at most prefill_token_budget chunk-tokens per
+        # scheduler round with the decode step — a long prompt arriving
+        # mid-stream no longer stalls in-flight decodes (ITL p99 becomes
+        # bounded by the budget, not the longest prompt)
+        self.prefill_chunk = int(prefill_chunk) if prefill_chunk else None
+        if self.prefill_chunk is not None and self.prefill_chunk < 1:
+            raise ValueError("prefill_chunk must be >= 1")
+        if prefill_token_budget and self.prefill_chunk is None:
+            raise ValueError(
+                "prefill_token_budget only bounds CHUNKED prefill — pass "
+                "prefill_chunk= as well (without it, prompts prefill "
+                "whole and the budget would be silently ignored)")
+        self._prefill_budget = int(prefill_token_budget) \
+            if prefill_token_budget else (self.prefill_chunk or 0)
+        self._prefilling: list = []     # FIFO of mid-prefill requests
         # seq buckets cap padding waste at ~2x; batch buckets keep the
         # prefill compile cache small (one shape per bucket pair)
         if prefill_seq_buckets is None:
@@ -133,6 +177,17 @@ class ServingEngine:
         self.prefill_seq_buckets = sorted(set(prefill_seq_buckets))
         self.prefill_batch_buckets = sorted(set(
             prefill_batch_buckets or [1, 2, 4, self.max_slots]))
+        # chunk-step shapes: partial tail chunks bucket to powers of two
+        # below the chunk size (or the prefill seq buckets when chunking
+        # is off and only prefix-hit tails ride this path)
+        if self.prefill_chunk:
+            cb, b = {self.prefill_chunk}, 8
+            while b < self.prefill_chunk:
+                cb.add(b)
+                b *= 2
+            self._chunk_buckets = sorted(cb)
+        else:
+            self._chunk_buckets = list(self.prefill_seq_buckets)
         # ---- paged-attention backend (A/B gated; standing kernel rule)
         requested = _decode.resolve_backend(attn_backend)
         self.attn_ab = None
@@ -141,19 +196,24 @@ class ServingEngine:
             self.attn_backend = self.attn_ab["backend"]
         else:
             self.attn_backend = requested
-        if mesh is not None and int(mesh.shape.get(mesh_axis, 1)) > 1 \
-                and H % int(mesh.shape[mesh_axis]) != 0:
-            raise ValueError(
-                f"{H} heads not divisible by mesh axis "
-                f"{mesh_axis}={mesh.shape[mesh_axis]}")
+        if mesh is not None and int(mesh.shape.get(mesh_axis, 1)) > 1:
+            deg = int(mesh.shape[mesh_axis])
+            if H % deg or KVH % deg:
+                raise ValueError(
+                    f"heads ({H} query / {KVH} KV) not divisible by mesh "
+                    f"axis {mesh_axis}={deg} — GQA sharding splits both,"
+                    " keeping each query-head group with its KV head")
         if mesh is not None:
             self._attn_impl = _decode.sharded_paged_attention(
                 mesh, axis_name=mesh_axis, backend=self.attn_backend)
+            self._prefill_attn_impl = _decode.sharded_paged_prefill(
+                mesh, axis_name=mesh_axis)
         else:
             backend = self.attn_backend
             self._attn_impl = lambda q, kp, vp, bt, lens: \
                 _decode.paged_decode_attention(q, kp, vp, bt, lens,
                                                backend=backend)
+            self._prefill_attn_impl = _decode.paged_prefill_attention
         self._params = list(model.parameters())
         self._param_arrays = [p._data for p in self._params]
         self._jit = bool(jit)
@@ -166,8 +226,14 @@ class ServingEngine:
         # eager per-op tunnel that used to sit on TTFT (ROADMAP item 3)
         self._prefill_fn = self._build_prefill()
         self._prefill_fns = {}
+        # the chunk step doubles as the prefix-hit tail prefill (both are
+        # partial-prefix attention over already-written pages); one jitted
+        # callable, shape-specialized per (batch, chunk) bucket pair
+        self._chunk_fn = self._build_chunk_prefill()
+        self._chunk_fns = {}
         self._steps = 0
         self._decode_tokens = 0
+        self._chunk_tokens = 0
         self.capture_logits = None   # tests: a list collects per-step
         # [S, V] decode logits (forces a host fetch; leave None in prod)
         self._peak_occupancy = 0.0
@@ -222,18 +288,40 @@ class ServingEngine:
 
     # ------------------------------------------------------------- prefill
     def _prefill_admitted(self, admitted):
-        groups = {}
+        """Route newly-admitted requests to a prefill path:
+
+        * chunked mode — everything queues on ``_prefilling`` and advances
+          ``prefill_token_budget`` tokens per scheduler round, interleaved
+          with decode.
+        * unchunked + prefix hit — the non-shared tail runs the partial-
+          prefix chunk step once, whole-tail (shared head skipped).
+        * unchunked + miss — the legacy dense bucketed prefill.
+        """
+        dense = []
         for req in admitted:
             self.metrics.on_admit(req)
+            if self.prefill_chunk is not None or req.num_cached > 0:
+                req.state = "prefilling"
+                self._prefilling.append(req)
+            else:
+                dense.append(req)
+        groups = {}
+        for req in dense:
             sb = pick_bucket(len(req.effective_prompt()),
                              self.prefill_seq_buckets)
             groups.setdefault(sb, []).append(req)
+        step_rows = min(self.max_slots, self.prefill_batch_buckets[-1])
         for sb, reqs in sorted(groups.items()):
             i = 0
             while i < len(reqs):
-                chunk = reqs[i:i + self.max_slots]
-                i += self.max_slots
+                chunk = reqs[i:i + step_rows]
+                i += step_rows
                 self._prefill_batch(chunk, sb)
+        if self.prefill_chunk is None:
+            # prefix-hit tails finish within the admission round (only
+            # chunked mode spreads prefill across rounds)
+            while self._prefilling:
+                self._run_chunk_batch()
 
     def _build_prefill(self):
         """The compiled prefill: the dense causal forward with params as
@@ -253,6 +341,116 @@ class ServingEngine:
 
         return jax.jit(prefill) if self._jit else prefill
 
+    def _build_chunk_prefill(self):
+        """The compiled chunk step: write one chunk of tokens per row into
+        the row's pages, then partial-prefix attention over the pages
+        (chunk tokens + everything previously written). Same params-as-
+        arguments treatment as the decode step; pools are donated on TPU.
+        jax.jit specializes per (batch bucket, chunk bucket) shape."""
+        model, params = self.model, self._params
+        L = self.cfg.num_layers
+        prefill_impl = self._prefill_attn_impl
+        attn_impl = self._attn_impl
+
+        def chunk_step(arrays, tokens, positions, lens, bt, k_pools,
+                       v_pools):
+            with no_grad(), _swap_params(params, arrays):
+                caches = [{"paged": True,
+                           "k_pool": Tensor(k_pools[i]),
+                           "v_pool": Tensor(v_pools[i]),
+                           "block_tables": Tensor(bt),
+                           "positions": Tensor(positions),
+                           "chunk_lens": Tensor(lens),
+                           "attn_impl": attn_impl,
+                           "prefill_impl": prefill_impl}
+                          for i in range(L)]
+                logits = model(Tensor(tokens), caches=caches,
+                               pos_offset=Tensor(positions))
+                return (logits._data,
+                        [c["k_pool"]._data for c in caches],
+                        [c["v_pool"]._data for c in caches])
+
+        if not self._jit:
+            return chunk_step
+        if _decode.on_tpu():
+            return jax.jit(chunk_step, donate_argnums=(5, 6))
+        return jax.jit(chunk_step)
+
+    def _run_chunk_batch(self):
+        """Advance pending prefills by ONE batched chunk launch: up to
+        ``budget // chunk`` requests (FIFO) each contribute their next
+        chunk. Requests whose prompt completes emit their first token and
+        join the decode batch the same round."""
+        self._prefilling = [r for r in self._prefilling
+                            if r.state == "prefilling"]
+        pending = self._prefilling
+        if not pending:
+            return 0
+        cap = self.prefill_chunk
+        # never take more rows than the largest batch bucket can carry
+        # (pick_bucket clamps DOWN to its largest entry; a batch wider
+        # than that would index past the padded launch)
+        max_rows = min(self.max_slots, self.prefill_batch_buckets[-1])
+        if cap is not None:
+            rows = max(1, self._prefill_budget // cap)
+            batch = pending[:min(rows, max_rows)]
+        else:
+            batch = pending[:max_rows]
+        longest = max(len(r.effective_prompt()) - r.num_cached
+                      for r in batch)
+        want = min(cap, longest) if cap is not None else longest
+        sb = pick_bucket(want, self._chunk_buckets)
+        nb = pick_bucket(len(batch), self.prefill_batch_buckets)
+        tokens = np.zeros((nb, sb), np.int32)
+        positions = np.zeros(nb, np.int32)
+        lens = np.zeros(nb, np.int32)
+        bt = np.zeros((nb, self.max_pages), np.int32)
+        prompts = []
+        for i, req in enumerate(batch):
+            p = req.effective_prompt()
+            prompts.append(p)
+            take = len(p) - req.num_cached
+            if cap is not None:
+                take = min(take, cap)
+            take = min(take, sb)
+            seg = p[req.num_cached:req.num_cached + take]
+            tokens[i, :take] = seg
+            positions[i] = req.num_cached
+            lens[i] = take
+            bt[i, :len(req.pages)] = req.pages
+        self._chunk_fns.setdefault((nb, sb), self._chunk_fn)
+        logits_arr, self.kv.k, self.kv.v = self._chunk_fn(
+            self._param_arrays, jnp.asarray(tokens),
+            jnp.asarray(positions), jnp.asarray(lens), jnp.asarray(bt),
+            list(self.kv.k), list(self.kv.v))
+        spent = 0
+        for i, req in enumerate(batch):
+            take = int(lens[i])
+            req.num_cached += take
+            spent += take
+            if req.num_cached < len(prompts[i]):
+                continue
+            # prompt complete: last chunk's final logit row is the first
+            # generated token (TTFT ends here), and the prompt's full
+            # pages become shareable for future prefix-cache hits
+            row = np.asarray(logits_arr[i, take - 1])
+            tok = _select_token(row, req)
+            first = not req.generated
+            req.emit(tok)
+            if first:
+                self.metrics.on_first_token(req)
+            self.metrics.on_token(req)
+            req.state = "active"
+            self._prefilling.remove(req)
+            if self.prefix is not None:
+                self.prefix.insert(prompts[i], req.pages)
+            if req.hit_stop():
+                self.scheduler.finish(req)
+                self.metrics.on_finish(req)
+        self._chunk_tokens += spent
+        self.metrics.on_prefill_chunk(spent)
+        return spent
+
     def _prefill_batch(self, reqs, seq_bucket):
         """Dense causal forward at [batch_bucket, seq_bucket]; right
         padding is causal-safe (position i never attends j > i), so each
@@ -261,9 +459,10 @@ class ServingEngine:
         n = len(reqs)
         nb = pick_bucket(n, self.prefill_batch_buckets)
         ids = np.zeros((nb, seq_bucket), np.int64)
-        lens = []
+        lens, prompts = [], []
         for i, req in enumerate(reqs):
             p = req.effective_prompt()
+            prompts.append(p)
             ids[i, :len(p)] = p
             lens.append(len(p))
         self._prefill_fns.setdefault((nb, seq_bucket), self._prefill_fn)
@@ -282,6 +481,18 @@ class ServingEngine:
             if first:
                 self.metrics.on_first_token(req)
             self.metrics.on_token(req)
+            if self.prefix is not None:
+                # index the prompt's full pages for future shared-head
+                # hits (the request keeps its own refcount; insertion
+                # before finish so a finishing request's pages park in
+                # the reclaimable LRU instead of the free list). MUST use
+                # the pre-emit prompt: effective_prompt() now includes the
+                # just-generated token, whose KV is only written by the
+                # NEXT decode step — indexing it would let a (prompt+1)-
+                # page-multiple request publish a page with an unwritten
+                # slot (garbage KV for any future hit if this request
+                # finishes or evicts before that decode step runs)
+                self.prefix.insert(prompts[i], req.pages)
             if req.hit_stop():
                 self.scheduler.finish(req)
                 self.metrics.on_finish(req)
@@ -327,15 +538,21 @@ class ServingEngine:
 
     # ------------------------------------------------------------ stepping
     def step(self):
-        """One scheduler round: finish/admit/prefill, then ONE decode step
-        over every active slot. -> decode tokens emitted (0 when idle).
-        Admission rides the same round as decode, so in-flight requests
-        never skip a step while a newcomer prefills."""
+        """One scheduler round: finish/admit, advance pending prefills by
+        at most the chunk-token budget, then ONE decode step over every
+        active slot. -> decode tokens emitted (0 when idle). Admission and
+        chunked prefill ride the same round as decode, so in-flight
+        requests never skip a step while a newcomer prefills — the gap
+        between two decode steps is bounded by the chunk budget, not by
+        the longest prompt in the queue."""
         if self._closed:
             raise EngineClosed("engine is closed")
         admitted = self.scheduler.schedule()
         if admitted:
             self._prefill_admitted(admitted)
+        if self.prefill_chunk is not None and self._prefilling:
+            # budgeted interleave: one bounded chunk launch per round
+            self._run_chunk_batch()
         _, evicted = self.scheduler.ensure_decode_capacity()
         for req in evicted:
             self.metrics.on_evict(req)
@@ -344,8 +561,11 @@ class ServingEngine:
         emitted = self._decode_once(active) if active else 0
         occ = self.kv.occupancy_pct()
         self._peak_occupancy = max(self._peak_occupancy, occ)
-        self.metrics.sample_state(len(self.scheduler.active),
-                                  self.scheduler.queue_depth(), occ)
+        alloc = self.kv.allocator
+        self.metrics.sample_state(
+            len(self.scheduler.active), self.scheduler.queue_depth(), occ,
+            shared_pages=alloc.shared_pages() if self.prefix else None,
+            cached_pages=alloc.cached_pages if self.prefix else None)
         self._steps += 1
         return emitted
 
@@ -430,7 +650,7 @@ class ServingEngine:
 
     # --------------------------------------------------------------- stats
     def stats(self):
-        return {
+        out = {
             "steps": self._steps,
             "decode_tokens": self._decode_tokens,
             "evictions": self.scheduler.total_evictions,
@@ -440,4 +660,18 @@ class ServingEngine:
             "queued": self.scheduler.queue_depth(),
             "attn_backend": self.attn_backend,
             "attn_ab": self.attn_ab,
+            "num_kv_heads": self.num_kv_heads,
+            "prefill_chunk": self.prefill_chunk,
+            "prefill_chunk_tokens": self._chunk_tokens,
         }
+        if self.prefix is not None:
+            out.update({
+                "prefix_hits": self.prefix.hits,
+                "prefix_misses": self.prefix.misses,
+                "prefix_hit_rate": round(self.prefix.hit_rate(), 4),
+                "prefix_hit_tokens": self.prefix.hit_tokens,
+                "prefix_cached_pages": self.kv.allocator.cached_pages,
+                "prefix_shared_pages": self.kv.allocator.shared_pages(),
+                "prefix_reclaimed_pages": self.prefix.reclaimed_pages,
+            })
+        return out

@@ -36,11 +36,24 @@ def pages_for(n_tokens, page_size):
 
 
 class BlockAllocator:
-    """Free-list page allocator over ``num_pages`` physical pages.
+    """Refcounted free-list page allocator over ``num_pages`` physical
+    pages.
 
     Page ids ``[0, reserved)`` are never handed out (page 0 is the scrap
     page). Purely host-side — allocation happens between decode steps on
     the scheduler thread, never inside the compiled step.
+
+    **Refcounts + prefix sharing** (ISSUE 9): every live page carries a
+    refcount (1 at :meth:`alloc`; :meth:`ref` adds readers — prefix-cache
+    hits share one physical page across requests). :meth:`free` is a
+    *deref*: the page returns to circulation only when the last reader
+    drops it. A refcount-0 page whose content is still indexed by a
+    :class:`~.prefix_cache.PrefixCache` (``self.cache``) parks in a
+    **reclaimable LRU** instead of the free list — it stays a warm cache
+    hit until the pool runs dry, at which point :meth:`alloc` reclaims
+    LRU-oldest reclaimable pages (telling the cache to drop their index
+    entries). A page with live readers is NEVER reclaimed — eviction
+    pressure can only consume refcount-0 cached pages.
     """
 
     def __init__(self, num_pages, reserved=1):
@@ -51,6 +64,11 @@ class BlockAllocator:
         self.reserved = int(reserved)
         # LIFO free list: recently-freed (still-warm) pages are reused first
         self._free = list(range(self.num_pages - 1, self.reserved - 1, -1))
+        self._refs: dict[int, int] = {}      # page -> live reader count
+        # refcount-0 pages still holding indexed prefix-cache content,
+        # insertion order == LRU order (oldest first)
+        self._reclaimable: dict[int, None] = {}
+        self.cache = None                    # PrefixCache collaborator
 
     @property
     def capacity(self):
@@ -59,44 +77,111 @@ class BlockAllocator:
 
     @property
     def free_pages(self):
-        return len(self._free)
+        """Pages allocatable right now (truly free + reclaimable cached)."""
+        return len(self._free) + len(self._reclaimable)
 
     @property
     def used_pages(self):
-        return self.capacity - len(self._free)
+        """Pages held by live readers (cached-but-unreferenced excluded)."""
+        return self.capacity - self.free_pages
+
+    @property
+    def cached_pages(self):
+        """Refcount-0 pages parked for prefix-cache reuse."""
+        return len(self._reclaimable)
+
+    def refcount(self, page):
+        return self._refs.get(int(page), 0)
+
+    def shared_pages(self):
+        """Pages with more than one live reader (prefix-shared)."""
+        return sum(1 for rc in self._refs.values() if rc > 1)
 
     def occupancy_pct(self):
         return 100.0 * self.used_pages / self.capacity if self.capacity \
             else 0.0
 
     def can_alloc(self, n):
-        return n <= len(self._free)
+        return n <= self.free_pages
 
     def alloc(self, n):
-        """-> list of ``n`` page ids; raises :class:`OutOfPages` when the
-        free list is short (all-or-nothing: no partial grants)."""
+        """-> list of ``n`` page ids, each with refcount 1; raises
+        :class:`OutOfPages` when free + reclaimable pages are short
+        (all-or-nothing: no partial grants). Reclaims LRU-oldest cached
+        pages only after the free list is exhausted."""
         n = int(n)
-        if n > len(self._free):
+        if n > self.free_pages:
             raise OutOfPages(
-                f"need {n} page(s), {len(self._free)} free "
+                f"need {n} page(s), {self.free_pages} free "
                 f"of {self.capacity}")
-        out = [self._free.pop() for _ in range(n)]
+        out = []
+        for _ in range(n):
+            if self._free:
+                p = self._free.pop()
+            else:
+                p = next(iter(self._reclaimable))   # LRU oldest
+                del self._reclaimable[p]
+                if self.cache is not None:
+                    self.cache.on_reclaim(p)
+            self._refs[p] = 1
+            out.append(p)
         return out
 
+    def ref(self, pages):
+        """Add one reader to each live page (prefix-cache sharing)."""
+        for p in pages:
+            p = int(p)
+            rc = self._refs.get(p, 0)
+            if rc <= 0:
+                raise ValueError(
+                    f"ref on page {p} with no live reader (free or "
+                    "reclaimable pages must go through reuse_cached)")
+            self._refs[p] = rc + 1
+
+    def reuse_cached(self, page):
+        """A prefix-cache hit on ``page``: add a reader, reactivating it
+        from the reclaimable LRU if it was parked there. -> bool (False
+        when the page is no longer available — stale index entry)."""
+        page = int(page)
+        if page in self._reclaimable:
+            del self._reclaimable[page]
+            self._refs[page] = 1
+            return True
+        rc = self._refs.get(page, 0)
+        if rc > 0:
+            self._refs[page] = rc + 1
+            return True
+        return False
+
     def free(self, pages):
+        """Drop one reader per page. The last reader returns the page to
+        the free list — or parks it in the reclaimable LRU when the
+        prefix cache still indexes its content."""
         for p in pages:
             p = int(p)
             if p < self.reserved or p >= self.num_pages:
                 raise ValueError(f"page {p} outside allocatable range")
-            if p in self._free:
+            rc = self._refs.get(p, 0)
+            if rc <= 0:
                 raise ValueError(f"double free of page {p}")
-            self._free.append(p)
+            if rc > 1:
+                self._refs[p] = rc - 1
+                continue
+            del self._refs[p]
+            if self.cache is not None and self.cache.holds(p):
+                self._reclaimable[p] = None     # newest = LRU tail
+            else:
+                self._free.append(p)
 
 
 class PagedKVCache:
     """Per-layer K/V page pools + the allocator that parcels them out.
 
-    ``k[l]`` / ``v[l]`` are jnp arrays ``[num_pages, page_size, H, Dh]``.
+    ``k[l]`` / ``v[l]`` are jnp arrays ``[num_pages, page_size, H, Dh]``
+    where ``H`` is the model's **KV** head count — for GQA models
+    (``num_kv_heads < num_heads``) the pool carries only the KV heads, an
+    ``H/KVH`` memory cut that directly raises how many concurrent
+    requests the pool can hold.
     Decode-step writes happen *inside* the model's paged attention branch
     (functional scatter, see ``models/gpt.py``); this class owns prefill
     writes, the allocator, and test/debug gathers.

@@ -14,7 +14,8 @@ import time
 
 import numpy as np
 
-__all__ = ["run_poisson_load", "summarize_requests"]
+__all__ = ["run_poisson_load", "summarize_requests",
+           "make_shared_prefix_prompts"]
 
 
 def _pct(values, q):
@@ -34,6 +35,9 @@ def summarize_requests(requests, wall_s):
     ttft = [r.ttft_s() * 1e3 for r in ok if r.ttft_s() is not None]
     itl = [dt * 1e3 for r in ok for dt in r.inter_token_s()]
     e2e = [(r.t_done - r.t_submit) * 1e3 for r in ok]
+    # CUMULATIVE queue wait (pre-eviction segments included — an evicted
+    # request's early waiting must not vanish from the tail attribution)
+    qwait = [r.queue_wait_s * 1e3 for r in ok]
     out = {
         "requests_ok": len(ok),
         "requests_failed": len(failed),
@@ -47,7 +51,10 @@ def summarize_requests(requests, wall_s):
         "itl_ms_p99": _pct(itl, 99),
         "e2e_ms_p50": _pct(e2e, 50),
         "e2e_ms_p99": _pct(e2e, 99),
+        "queue_wait_ms_p50": _pct(qwait, 50),
+        "queue_wait_ms_p99": _pct(qwait, 99),
         "evictions": sum(r.evictions for r in requests),
+        "requests_evicted": sum(1 for r in requests if r.evictions > 0),
     }
     for k, v in list(out.items()):
         if isinstance(v, float) and v is not None and k.endswith(
@@ -56,19 +63,45 @@ def summarize_requests(requests, wall_s):
     return out
 
 
+def make_shared_prefix_prompts(n_requests, prompt_len, vocab,
+                               shared_prefix, seed=0):
+    """The ``shared_prefix`` workload: ONE common system-prompt head of
+    ``shared_prefix`` tokens (drawn once from the seed) followed by a
+    per-request random tail of length in ``prompt_len`` — the realistic
+    mix that drives a prefix cache (every production deployment fronts
+    requests with the same system prompt). Deterministic per seed, so a
+    prefix-cache engine and its cold twin see identical prompts."""
+    rng = np.random.RandomState(seed)
+    head = rng.randint(1, vocab, size=int(shared_prefix)).tolist()
+    lo, hi = prompt_len
+    return [head + rng.randint(1, vocab,
+                               size=rng.randint(lo, hi + 1)).tolist()
+            for _ in range(n_requests)]
+
+
 def run_poisson_load(engine, n_requests=32, qps=10.0, prompt_len=(8, 24),
                      max_new_tokens=12, eos_token_id=None, seed=0,
-                     timeout=300.0):
+                     timeout=300.0, shared_prefix=None):
     """Submit ``n_requests`` at Poisson arrivals of rate ``qps`` (prompts
     are uniform-random token ids of uniform-random length in
     ``prompt_len``), wait for completion, -> summary dict. The engine
     must be ``start()``ed (open loop: submission never waits on decode).
     Backpressure turns into measured queue wait, not dropped load — the
-    submit timeout is sized to the whole run."""
+    submit timeout is sized to the whole run.
+
+    ``shared_prefix=N`` switches to the shared-system-prompt workload:
+    every prompt is one common ``N``-token head plus the random tail
+    (:func:`make_shared_prefix_prompts`), so the engine's prefix cache —
+    when enabled — sees a realistic hit mix; ``prompt_len`` then sizes
+    the per-request tail."""
     rng = np.random.RandomState(seed)
     vocab = engine.cfg.vocab_size
     lo, hi = prompt_len
     gaps = rng.exponential(1.0 / qps, size=n_requests)
+    prompts = None
+    if shared_prefix:
+        prompts = make_shared_prefix_prompts(
+            n_requests, prompt_len, vocab, shared_prefix, seed=seed)
     requests = []
     t_start = time.perf_counter()
     for i in range(n_requests):
@@ -76,8 +109,9 @@ def run_poisson_load(engine, n_requests=32, qps=10.0, prompt_len=(8, 24),
         delay = target - time.perf_counter()
         if delay > 0:
             time.sleep(delay)
-        prompt = rng.randint(1, vocab, size=rng.randint(lo, hi + 1))
-        req = engine.submit(prompt.tolist(),
+        prompt = prompts[i] if prompts is not None else \
+            rng.randint(1, vocab, size=rng.randint(lo, hi + 1)).tolist()
+        req = engine.submit(list(prompt),
                             max_new_tokens=int(max_new_tokens),
                             eos_token_id=eos_token_id, timeout=timeout)
         requests.append(req)

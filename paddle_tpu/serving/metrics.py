@@ -13,6 +13,16 @@ runs share the JSONL snapshot/report plumbing with training. Names:
 * ``serving_tokens_per_sec`` — decode throughput over the same window
 * ``serving_active_slots`` / ``serving_queue_depth`` /
   ``serving_kv_occupancy_pct`` — gauges sampled every engine step
+* ``serving_prefix_{hits,misses,hit_tokens}_total`` — prefix-cache
+  admission counters; ``serving_prefix_shared_pages`` /
+  ``serving_prefix_cached_pages`` — live-shared and reclaimable-cached
+  page gauges
+* ``serving_prefill_chunk_tokens_total`` — chunk-tokens processed by the
+  budgeted chunked-prefill interleave
+
+``serving_queue_wait_ms`` observes each request's **cumulative** queue
+wait once, at its terminal state (re-admissions carry their pre-eviction
+wait forward; prefix hit/miss counters fire on the first admission only).
 
 Every hook is a no-op when the registry is off (one ``None`` check), so
 an un-instrumented engine pays nothing — same contract as the flight
@@ -31,10 +41,15 @@ __all__ = ["ServingMetrics"]
 class ServingMetrics:
     """Per-engine metrics frontend over the process registry."""
 
-    def __init__(self, registry=None, window_s=30.0):
+    def __init__(self, registry=None, window_s=30.0, prefix_enabled=True):
         self._reg = registry if registry is not None \
             else _metrics.get_registry()
         self.window_s = float(window_s)
+        # engines without a prefix cache must not export the prefix
+        # metric family at all (every request would read as a miss — a
+        # nonexistent cache reporting 0% hit rate poisons hot/cold
+        # comparisons)
+        self.prefix_enabled = bool(prefix_enabled)
         self._finish_times: deque = deque()
         self._token_times: deque = deque()
 
@@ -51,10 +66,17 @@ class ServingMetrics:
         reg = self._reg
         if reg is None or req.t_admit is None:
             return
-        # since the last (re-)enqueue: a re-admitted evicted request must
-        # not count its prior active service time as queueing
-        reg.histogram("serving_queue_wait_ms").observe(
-            (req.t_admit - req.t_enqueue) * 1e3)
+        # request-level prefix hit/miss: counted on the FIRST admission
+        # only — an evicted request re-hitting its own cached head on
+        # readmission must not inflate the hit rate (the recompute it
+        # saves is already visible in the eviction rows)
+        if self.prefix_enabled and req.evictions == 0:
+            if req.prefix_hit_tokens > 0:
+                reg.counter("serving_prefix_hits_total").inc()
+                reg.counter("serving_prefix_hit_tokens_total").inc(
+                    req.prefix_hit_tokens)
+            else:
+                reg.counter("serving_prefix_misses_total").inc()
 
     def on_first_token(self, req):
         reg = self._reg
@@ -92,6 +114,14 @@ class ServingMetrics:
             return
         status = "failed" if req.error is not None else "ok"
         reg.counter("serving_requests_total", status=status).inc()
+        # CUMULATIVE queue wait, observed ONCE per request at its
+        # terminal state: the total covers every waiting segment across
+        # eviction/readmission (the pre-eviction time used to vanish when
+        # t_enqueue was reset), and observing only here keeps the
+        # histogram sum exact — per-admission samples of a running total
+        # would double-count the earlier segments
+        reg.histogram("serving_queue_wait_ms").observe(
+            req.queue_wait_s * 1e3)
         if req.t_done is not None:
             reg.histogram("serving_e2e_ms").observe(
                 (req.t_done - req.t_submit) * 1e3)
@@ -103,10 +133,21 @@ class ServingMetrics:
             reg.gauge("serving_qps").set(
                 (len(self._finish_times) - 1) / span)
 
-    def sample_state(self, active_slots, queue_depth, occupancy_pct):
+    def sample_state(self, active_slots, queue_depth, occupancy_pct,
+                     shared_pages=None, cached_pages=None):
         reg = self._reg
         if reg is None:
             return
         reg.gauge("serving_active_slots").set(active_slots)
         reg.gauge("serving_queue_depth").set(queue_depth)
         reg.gauge("serving_kv_occupancy_pct").set(occupancy_pct)
+        if shared_pages is not None:
+            reg.gauge("serving_prefix_shared_pages").set(shared_pages)
+        if cached_pages is not None:
+            reg.gauge("serving_prefix_cached_pages").set(cached_pages)
+
+    def on_prefill_chunk(self, n_tokens):
+        reg = self._reg
+        if reg is None:
+            return
+        reg.counter("serving_prefill_chunk_tokens_total").inc(n_tokens)

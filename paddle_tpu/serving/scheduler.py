@@ -70,15 +70,18 @@ class GenerationRequest:
         self.seed = int(seed)
         self.on_token = on_token
         self.generated: list[int] = []
-        self.state = "waiting"       # waiting|active|finished|failed
+        self.state = "waiting"   # waiting|prefilling|active|finished|failed
         self.error = None
         self.slot = None
         self.pages: list[int] = []
         self.num_cached = 0          # tokens currently in the KV pool
+        self.prefix_hit_tokens = 0   # prompt head served from the cache
         self.evictions = 0
         self.t_submit = time.perf_counter()
-        self.t_enqueue = self.t_submit   # reset on eviction: queue-wait
-        # measures time since the LAST (re-)enqueue, not since submit
+        self.t_enqueue = self.t_submit   # reset on eviction; the total
+        # across re-admissions accumulates in queue_wait_s (an evicted
+        # request's pre-eviction queue time must not vanish from the tail)
+        self.queue_wait_s = 0.0
         self.t_admit = None
         self.t_first_token = None
         self.t_done = None
@@ -153,8 +156,9 @@ class ContinuousBatchingScheduler:
     """Owns the waiting queue, the slot map, and page accounting."""
 
     def __init__(self, allocator, max_slots, page_size, max_seq_len,
-                 max_queue=256):
+                 max_queue=256, prefix_cache=None):
         self.allocator = allocator
+        self.prefix_cache = prefix_cache
         self.max_slots = int(max_slots)
         self.page_size = int(page_size)
         self.max_seq_len = int(max_seq_len)
@@ -213,16 +217,33 @@ class ContinuousBatchingScheduler:
                 if not self.waiting:
                     break
                 req = self.waiting[0]
-                need = pages_for(len(req.effective_prompt()) + 1,
-                                 self.page_size)
+                prompt = req.effective_prompt()
+                shared, n_shared = [], 0
+                if self.prefix_cache is not None:
+                    # prefix-cache hit: the shared head's pages are taken
+                    # by reference (no prefill compute, no page writes) —
+                    # only the tail needs private pages
+                    shared, n_shared = self.prefix_cache.lookup(prompt)
+                need = pages_for(len(prompt) + 1, self.page_size) \
+                    - len(shared)
                 if not self.allocator.can_alloc(need):
+                    if shared:    # un-ref the speculative hit
+                        self.allocator.free(shared)
                     break
                 self.waiting.popleft()
                 self._space.notify_all()
-            req.pages = self.allocator.alloc(need)
+            req.pages = shared + self.allocator.alloc(need)
+            req.num_cached = n_shared
+            req.prefix_hit_tokens = n_shared
+            if self.prefix_cache is not None and req.evictions == 0:
+                # request-level hit/miss: first admission only — a
+                # readmission re-hitting its own cached head would
+                # double-count the request in the hit rate
+                self.prefix_cache.record(n_shared)
             req.slot = self._free_slots.pop()
             req.state = "active"
             req.t_admit = time.perf_counter()
+            req.queue_wait_s += req.t_admit - req.t_enqueue
             self.active[req.slot] = req
             admitted.append(req)
         return admitted

@@ -1,0 +1,100 @@
+"""Summarize per-test durations from a tier-1 pytest log.
+
+The tier-1 suite runs against a hard wall-clock budget (870s; see
+ROADMAP.md) and history shows it creeps: every PR adds "a few seconds" of
+not-slow tests until one run on a loaded host trips the timeout at 92%
+with zero failures. This tool makes the creep visible per PR: point it at
+the tier-1 log (the verify command tees ``/tmp/_t1.log`` and passes
+``--durations=N`` so pytest appends its slowest-durations section) and it
+aggregates the call/setup/teardown rows into a per-test and per-file
+ranking plus the budget headroom.
+
+    python -m paddle_tpu.tools.slowest_tests /tmp/_t1.log
+    python -m paddle_tpu.tools.slowest_tests /tmp/_t1.log -n 30 --by-file
+
+Reads only what pytest already printed — no re-run, no plugins.
+"""
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+from collections import defaultdict
+
+__all__ = ["parse_durations", "summarize", "main"]
+
+# "0.12s call     tests/test_x.py::test_y[param]"
+_ROW = re.compile(
+    r"^\s*(\d+(?:\.\d+)?)s\s+(call|setup|teardown)\s+(\S+)\s*$")
+# "855 passed, 24 deselected in 712.34s (0:11:52)"
+_TOTAL = re.compile(r" in (\d+(?:\.\d+)?)s")
+
+
+def parse_durations(lines):
+    """-> ({test_id: seconds (call+setup+teardown)}, wall_seconds|None)."""
+    per_test = defaultdict(float)
+    wall = None
+    for line in lines:
+        m = _ROW.match(line)
+        if m:
+            per_test[m.group(3)] += float(m.group(1))
+            continue
+        if ("passed" in line or "failed" in line) and " in " in line:
+            t = _TOTAL.search(line)
+            if t:
+                wall = float(t.group(1))
+    return dict(per_test), wall
+
+
+def summarize(per_test, top=20, by_file=False):
+    """-> list of (name, seconds) ranked slowest-first."""
+    if by_file:
+        per_file = defaultdict(float)
+        for test_id, s in per_test.items():
+            per_file[test_id.split("::")[0]] += s
+        items = per_file.items()
+    else:
+        items = per_test.items()
+    return sorted(items, key=lambda kv: -kv[1])[:top]
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Rank the slowest tests in a tier-1 pytest log "
+                    "(requires the log to contain pytest's --durations "
+                    "section)")
+    ap.add_argument("log", help="pytest log file (e.g. /tmp/_t1.log)")
+    ap.add_argument("-n", "--top", type=int, default=20)
+    ap.add_argument("--by-file", action="store_true",
+                    help="aggregate per test file instead of per test")
+    ap.add_argument("--budget", type=float, default=870.0,
+                    help="tier-1 wall-clock budget in seconds")
+    args = ap.parse_args(argv)
+    try:
+        with open(args.log, errors="replace") as f:
+            per_test, wall = parse_durations(f)
+    except OSError as e:
+        print(f"slowest_tests: cannot read {args.log}: {e}",
+              file=sys.stderr)
+        return 2
+    if not per_test:
+        print("slowest_tests: no durations section in the log — run the "
+              "suite with --durations=50 (the ROADMAP tier-1 command "
+              "includes it) so pytest appends per-test timings",
+              file=sys.stderr)
+        return 1
+    rows = summarize(per_test, top=args.top, by_file=args.by_file)
+    unit = "file" if args.by_file else "test"
+    timed = sum(per_test.values())
+    print(f"slowest {len(rows)} {unit}s "
+          f"(timed {timed:.1f}s across {len(per_test)} tests"
+          + (f"; run wall {wall:.1f}s of {args.budget:.0f}s budget, "
+             f"{args.budget - wall:.1f}s headroom" if wall else "")
+          + "):")
+    for name, secs in rows:
+        print(f"{secs:9.2f}s  {name}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -95,6 +95,216 @@ def test_concurrent_requests_do_not_cross_pollute(seeded_model):
         assert r.result(10) == _dense_greedy(seeded_model, p, 6)
 
 
+def test_chunked_vs_unchunked_prefill_parity_mid_page_chunk(seeded_model):
+    """ISSUE 9: chunked prefill (chunk=6 on page_size=4 — every chunk
+    boundary lands MID-page) decodes token-identically to the unchunked
+    engine and to the dense compiled decode, for prompts that end mid-
+    chunk, mid-page, and on exact chunk multiples."""
+    from paddle_tpu.serving import ServingEngine
+    rng = np.random.RandomState(3)
+    # 11 = ends mid-chunk AND mid-page, 12 = exact chunk multiple
+    prompts = [rng.randint(1, 256, size=n).tolist() for n in (11, 12)]
+    chunked = ServingEngine(seeded_model, page_size=4, num_pages=64,
+                            max_slots=4, prefill_chunk=6,
+                            prefix_cache=False, attn_backend="xla")
+    reqs = [chunked.submit(p, max_new_tokens=6) for p in prompts]
+    chunked.run_until_idle()
+    assert chunked.stats()["prefill_chunk_tokens"] == sum(
+        len(p) for p in prompts)
+    # bounded-compile contract (same observable surface as _prefill_fns):
+    # every chunk launch shape came from the (batch, chunk-bucket) grid
+    assert set(chunked._chunk_fns) <= {
+        (nb, sb) for nb in chunked.prefill_batch_buckets
+        for sb in chunked._chunk_buckets}
+    for p, r in zip(prompts, reqs):
+        assert r.result(10) == _dense_greedy(seeded_model, p, 6)
+
+
+@pytest.mark.slow
+def test_shared_prefix_parity_and_cow_divergence(seeded_model):
+    """Prefix-cache hits (shared system-prompt head) must decode token-
+    identically to a cold prefill, and two requests diverging after the
+    shared head must not corrupt each other (page-granular COW: the
+    divergent tails live in private pages)."""
+    from paddle_tpu.serving import ServingEngine
+    rng = np.random.RandomState(4)
+    head = rng.randint(1, 256, size=8).tolist()          # 2 full pages
+    tail_a = head + rng.randint(1, 256, size=5).tolist()
+    tail_b = head + rng.randint(1, 256, size=5).tolist()
+    eng = ServingEngine(seeded_model, page_size=4, num_pages=64,
+                        max_slots=2)
+    ra = eng.submit(tail_a, max_new_tokens=6)
+    eng.run_until_idle()                                 # A seeds the cache
+    rb = eng.submit(tail_b, max_new_tokens=6)            # hit + diverge
+    rc = eng.submit(tail_a, max_new_tokens=6)            # hit, same tail
+    eng.run_until_idle()
+    st = eng.stats()
+    assert st["prefix_hits"] == 2 and rb.prefix_hit_tokens == 8
+    assert ra.result(10) == _dense_greedy(seeded_model, tail_a, 6)
+    assert rb.result(10) == _dense_greedy(seeded_model, tail_b, 6)
+    assert rc.result(10) == ra.result(10)
+
+
+@pytest.mark.slow
+def test_eviction_pressure_spares_refcounted_shared_page(seeded_model):
+    """Under pool pressure a refcounted shared page is never reclaimed
+    out from under its live reader: the evicted victim's PRIVATE pages
+    fund the senior request, the shared head survives, and both requests
+    finish with dense-parity tokens."""
+    from paddle_tpu.serving import ServingEngine
+    rng = np.random.RandomState(5)
+    head = rng.randint(1, 256, size=4).tolist()          # 1 full page
+    p1 = head + rng.randint(1, 256, size=3).tolist()
+    p2 = head + rng.randint(1, 256, size=2).tolist()
+    # 5 usable pages: two requests growing to ~15 tokens cannot coexist
+    eng = ServingEngine(seeded_model, page_size=4, num_pages=6,
+                        max_slots=2)
+    r1 = eng.submit(p1, max_new_tokens=8)
+    r2 = eng.submit(p2, max_new_tokens=8)
+    eng.run_until_idle()
+    assert eng.scheduler.total_evictions >= 1
+    assert r1.result(10) == _dense_greedy(seeded_model, p1, 8)
+    assert r2.result(10) == _dense_greedy(seeded_model, p2, 8)
+    # the cumulative-queue-wait bugfix: the evicted request's recorded
+    # wait covers BOTH waiting segments (pre-eviction wait included)
+    evicted = r1 if r1.evictions else r2
+    assert evicted.queue_wait_s > 0
+
+
+def test_prefix_insert_never_indexes_unwritten_page_slot(seeded_model):
+    """Regression (review finding): with prompt+1 landing exactly on a
+    page boundary and max_new_tokens=1, the finishing request's first
+    generated token has NO KV written (no decode step ever runs) — the
+    prefix index must cover only the PROMPT's full pages, or a follow-up
+    request hitting the over-indexed page would attend garbage."""
+    from paddle_tpu.serving import ServingEngine
+    rng = np.random.RandomState(10)
+    prompt = rng.randint(1, 256, size=7).tolist()   # 7 + 1 = 2 full pages
+    eng = ServingEngine(seeded_model, page_size=4, num_pages=32,
+                        max_slots=2, attn_backend="xla")
+    first = eng.generate(prompt, max_new_tokens=1)  # finishes at prefill
+    # only the prompt's single full page may be indexed — page 1 holds
+    # prompt tokens 4..6 plus the UNWRITTEN slot for the generated token
+    assert eng.prefix.indexed_pages() == 1
+    follow = prompt + first + rng.randint(1, 256, size=3).tolist()
+    r = eng.submit(follow, max_new_tokens=6)
+    eng.run_until_idle()
+    assert r.prefix_hit_tokens == 4                 # head page only
+    assert r.result(10) == _dense_greedy(seeded_model, follow, 6)
+
+
+@pytest.fixture(scope="module")
+def gqa_model():
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+    paddle.seed(4321)
+    m = GPTForCausalLM(gpt_tiny(num_kv_heads=2))
+    m.eval()
+    return m
+
+
+def test_gqa_paged_vs_dense_parity(gqa_model):
+    """A num_kv_heads < num_heads config serves over [*, *, KVH, Dh]
+    pools with grouped-query paged attention, token-identical to its own
+    dense compiled decode — including a chunked + prefix-shared run."""
+    from paddle_tpu.serving import ServingEngine
+    rng = np.random.RandomState(6)
+    prompt = rng.randint(1, 256, size=11).tolist()
+    eng = ServingEngine(gqa_model, page_size=4, num_pages=32, max_slots=2,
+                        prefill_chunk=6, attn_backend="xla")
+    assert eng.kv.k[0].shape[2] == 2        # KVH, not H=4
+    want = _dense_greedy(gqa_model, prompt, 8)
+    r1 = eng.submit(prompt, max_new_tokens=8)
+    eng.run_until_idle()
+    r2 = eng.submit(prompt, max_new_tokens=8)   # prefix-shared twin
+    eng.run_until_idle()
+    assert r1.result(10) == want
+    assert r2.result(10) == want
+    assert eng.stats()["prefix_hits"] == 1
+
+
+def test_gqa_sharded_paged_decode_parity():
+    """KV-head sharding with query-head grouping: the 2-device 'model'
+    mesh reproduces the unsharded grouped decode (each shard keeps its
+    query-head groups with their KV heads)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+    from paddle_tpu.serving import (paged_decode_attention,
+                                    sharded_paged_attention)
+    rng = np.random.RandomState(7)
+    B, H, KVH, D, P, page, maxp = 3, 8, 2, 8, 8, 4, 4
+    q = jnp.asarray(rng.randn(B, H, D).astype("float32"))
+    kp = jnp.asarray(rng.randn(P, page, KVH, D).astype("float32"))
+    vp = jnp.asarray(rng.randn(P, page, KVH, D).astype("float32"))
+    bt = jnp.asarray(rng.randint(1, P, size=(B, maxp)).astype("int32"))
+    lens = jnp.asarray(np.array([3, 7, 12], dtype="int32"))
+    ref = np.asarray(paged_decode_attention(q, kp, vp, bt, lens))
+    mesh = Mesh(np.array(jax.devices()[:2]), ("model",))
+    out = np.asarray(sharded_paged_attention(mesh)(q, kp, vp, bt, lens))
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.slow
+def test_chunked_long_prompt_bounds_itl(seeded_model):
+    """Slow acceptance: a near-max-seq prompt injected mid-stream. The
+    chunked engine's steady-request ITL p99 stays well below the
+    unchunked engine's (which stalls a full prefill into one gap), with
+    token-identical output."""
+    from paddle_tpu.serving import ServingEngine
+    rng = np.random.RandomState(8)
+    steady_p = [rng.randint(1, 256, size=5).tolist() for _ in range(2)]
+    long_p = rng.randint(1, 256, size=56).tolist()
+
+    def run(chunk):
+        eng = ServingEngine(seeded_model, page_size=4, num_pages=64,
+                            max_slots=4, prefill_chunk=chunk,
+                            prefix_cache=False)
+        try:
+            eng.generate(long_p[:55], max_new_tokens=2)   # warm shapes
+            eng.generate([1, 2, 3], max_new_tokens=2)
+            steady = [eng.submit(p, max_new_tokens=14) for p in steady_p]
+            for _ in range(4):
+                eng.step()
+            late = eng.submit(long_p, max_new_tokens=3)
+            eng.run_until_idle()
+            itl = [dt for r in steady for dt in r.inter_token_s()]
+            toks = [r.result(30) for r in steady] + [late.result(30)]
+        finally:
+            eng.close()
+        return max(itl), toks
+
+    gap_un, toks_un = run(None)
+    gap_ch, toks_ch = run(8)
+    assert toks_un == toks_ch
+    assert gap_ch < gap_un
+
+
+@pytest.mark.slow
+def test_shared_prefix_poisson_soak(seeded_model):
+    """Open-loop shared-system-prompt soak on the chunked + prefix
+    engine: everything completes, the hit rate is real, and the pool
+    drains (used_pages counts live readers only — cached pages park in
+    the reclaimable LRU)."""
+    from paddle_tpu.serving import ServingEngine, run_poisson_load
+    eng = ServingEngine(seeded_model, page_size=4, num_pages=48,
+                        max_slots=4, prefill_chunk=8)
+    eng.start()
+    try:
+        res = run_poisson_load(eng, n_requests=24, qps=40.0,
+                               prompt_len=(4, 10), max_new_tokens=6,
+                               seed=9, timeout=300.0, shared_prefix=12)
+        stats = eng.stats()
+    finally:
+        eng.close()
+    assert res["requests_failed"] == 0
+    assert res["requests_ok"] == 24
+    assert stats["prefix_hit_rate"] > 0.5
+    assert res["queue_wait_ms_p99"] is not None
+    assert eng.kv.allocator.used_pages == 0
+    assert eng.kv.allocator.cached_pages > 0
+
+
 @pytest.mark.slow
 def test_poisson_soak_background_thread(seeded_model):
     """Open-loop Poisson load against the threaded engine: everything

@@ -67,3 +67,35 @@ def test_merge_profiles_cli(tmp_path):
     lanes = [e for e in merged["traceEvents"] if e.get("ph") == "M"]
     assert len(ops) == 2 and len(lanes) == 2
     assert {e["pid"] for e in ops} == {0, 1}
+
+
+def test_slowest_tests_parser_and_cli(tmp_path, capsys):
+    """ISSUE 9 suite-health satellite: the tier-1 log's --durations
+    section aggregates into per-test (call+setup summed) and per-file
+    rankings with budget headroom; a log without the section exits 1
+    with the re-run hint."""
+    log = tmp_path / "t1.log"
+    log.write_text(
+        "......\n"
+        "= slowest durations =\n"
+        "10.50s call     tests/test_big.py::test_heavy\n"
+        "0.50s setup    tests/test_big.py::test_heavy\n"
+        "2.00s call     tests/test_big.py::test_medium\n"
+        "3.00s call     tests/test_small.py::test_x\n"
+        "(21 durations < 0.005s hidden.)\n"
+        "855 passed, 24 deselected in 712.30s (0:11:52)\n")
+    from paddle_tpu.tools.slowest_tests import (main, parse_durations,
+                                                summarize)
+    per_test, wall = parse_durations(log.read_text().splitlines())
+    assert per_test["tests/test_big.py::test_heavy"] == 11.0
+    assert wall == 712.3
+    top = summarize(per_test, top=2)
+    assert top[0] == ("tests/test_big.py::test_heavy", 11.0)
+    by_file = dict(summarize(per_test, top=5, by_file=True))
+    assert by_file["tests/test_big.py"] == 13.0
+    assert main([str(log), "-n", "2"]) == 0
+    out = capsys.readouterr().out
+    assert "test_heavy" in out and "headroom" in out
+    empty = tmp_path / "empty.log"
+    empty.write_text("all good\n")
+    assert main([str(empty)]) == 1

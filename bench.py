@@ -1054,6 +1054,97 @@ def run_node_chaos(epochs=2, batches=6):
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def run_controlplane_chaos():
+    """``--chaos`` control-plane leg (ISSUE 10): SIGKILL the PRIMARY
+    coordinator mid-round — its in-process primary registry store dies
+    with it, so one injected ``coordinator_die`` kills BOTH halves of the
+    control plane at once. The shadow coordinator (standby registry +
+    log shipper) must adopt the published round spec after the lease
+    expires and supervise the SAME round to completion: zero
+    re-rendezvous, zero worker relaunches. Records
+    ``controlplane_failover_s`` (COORDINATOR_DIE stamp → SHADOW_ADOPTED
+    stamp) and ``controlplane_rounds_preserved`` so control-plane
+    takeover latency regressions show up in the trajectory."""
+    import glob
+    import re
+    import shutil
+    import subprocess
+    import tempfile
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    workers_dir = os.path.join(repo, "tests", "workers")
+    if workers_dir not in sys.path:
+        sys.path.insert(0, workers_dir)
+    from ft_markers import free_port as _free_port
+    tmp = tempfile.mkdtemp(prefix="pd_cplane_")
+    log_dir = os.path.join(tmp, "logs")
+    worker = os.path.join(tmp, "nw.py")
+    with open(worker, "w") as f:
+        f.write("import os, time\n"
+                "print('NW', os.environ.get('PADDLE_TPU_RESTART_NUM'),"
+                " flush=True)\n"
+                "time.sleep(20)\n"
+                "print('NW_DONE', flush=True)\n")
+    env = _chaos_child_env(repo)
+    env.update({
+        "PADDLE_TPU_STORE_FAILOVER_DEADLINE": "10",
+        "PADDLE_TPU_STORE_PROBE_DEADLINE": "1",
+    })
+    # the primary's lease beats at ttl/3; beat 10 lands mid-round, after
+    # round 1 + the coordinator state checkpoint were published
+    prim_env = dict(env,
+                    PADDLE_TPU_FAULTS="coordinator_die@coord_beat:10")
+    master = f"127.0.0.1:{_free_port()},127.0.0.1:{_free_port()}"
+    base = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+            "--nnodes", "2:2", "--nproc_per_node", "1",
+            "--master", master, "--elastic_ttl", "2",
+            "--terminate_grace", "2", "--log_dir", log_dir, worker]
+    shadow = prim = None
+    try:
+        shadow = subprocess.Popen(
+            base[:-1] + ["--coordinator_role", "shadow",
+                         "--local_agents", "0", worker],
+            env=env, cwd=repo, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True)
+        time.sleep(1.0)
+        prim = subprocess.Popen(
+            base[:-1] + ["--coordinator_role", "primary",
+                         "--local_agents", "2", worker],
+            env=prim_env, cwd=repo, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True)
+        pout, _ = prim.communicate(timeout=180)
+        sout, _ = shadow.communicate(timeout=240)
+        die = re.search(r"COORDINATOR_DIE ([\d.]+)", pout)
+        adopt = re.search(r"SHADOW_ADOPTED round=(\d+) term=\d+ "
+                          r"wall=([\d.]+)", sout)
+        preserved = bool(adopt) and adopt.group(1) == "1" \
+            and "round 2" not in sout and "round 2" not in pout \
+            and not glob.glob(os.path.join(log_dir,
+                                           "workerlog.*.restart*"))
+        ok = (prim.returncode == -9 and shadow.returncode == 0
+              and die is not None and preserved
+              and "all 2 node(s) finished" in sout)
+        out = {"controlplane_ok": ok,
+               "controlplane_rounds_preserved": int(preserved)}
+        if die and adopt:
+            out["controlplane_failover_s"] = round(
+                float(adopt.group(2)) - float(die.group(1)), 3)
+        if not ok:
+            out["controlplane_error"] = (
+                "prim_rc=%s shadow_rc=%s die=%s adopt=%s: %s" % (
+                    prim.returncode, shadow.returncode, bool(die),
+                    bool(adopt), (sout or "")[-300:]))
+        return out
+    finally:
+        # the kill sweep lives HERE, not in an inner block after both
+        # spawns: a failed primary Popen must not orphan the already-
+        # started shadow polling forever for a lease that never comes
+        for p in (prim, shadow):
+            if p is not None and p.poll() is None:
+                p.kill()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def run_serving_bench(n_requests=None, qps=None):
     """``--serving`` leg: the continuous-batching engine under a Poisson
     OPEN-loop load (arrivals don't wait for the engine — tail latency is
@@ -1373,10 +1464,16 @@ def main_chaos():
     except Exception as e:  # prior legs' JSON stays on the wire
         sub.update({"node_elastic_ok": False,
                     "node_error": repr(e)[-300:]})
+    try:
+        sub.update(run_controlplane_chaos())
+    except Exception as e:  # prior legs' JSON stays on the wire
+        sub.update({"controlplane_ok": False,
+                    "controlplane_error": repr(e)[-300:]})
     ok = bool(sub.get("chaos_resume_ok")) \
         and bool(sub.get("elastic_scale_ok")) \
         and bool(sub.get("hang_postmortem_ok")) \
-        and bool(sub.get("node_elastic_ok"))
+        and bool(sub.get("node_elastic_ok")) \
+        and bool(sub.get("controlplane_ok"))
     print(json.dumps({
         "metric": "chaos_recovery_s",
         "value": sub.get("chaos_recovery_s", 0.0),

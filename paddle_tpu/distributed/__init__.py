@@ -31,7 +31,9 @@ from .flight_recorder import (  # noqa: F401
     CollectiveDesyncError, FlightRecorder,
 )
 from .tcp_store import (  # noqa: F401
-    FailoverStore, StoreTimeoutError, TCPStore, Watchdog,
+    FailoverStore, LogShipper, StoreCandidatesExhausted,
+    StoreConnectionRefused, StoreFencedError,
+    StoreTimeoutError, TCPStore, Watchdog,
 )
 from .watchdog import (  # noqa: F401
     start_step_watchdog, stop_step_watchdog, get_step_watchdog,

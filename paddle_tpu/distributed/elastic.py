@@ -359,14 +359,31 @@ class NodeRegistry:
         failure — unlike the defensive readers above. The agent's orphan
         fencing must SEE unreachability: an exception-swallowing poll
         would let a node whose control plane is gone run stale workers
-        forever."""
+        forever.
+
+        The raise DISTINGUISHES gone from re-homed (ISSUE 10 satellite):
+        a clean failover to a standby candidate completes *inside* the
+        FailoverStore and this poll returns normally (the caller sees
+        ``store.incarnation`` moved); only
+        :class:`~paddle_tpu.distributed.tcp_store.
+        StoreCandidatesExhausted` — every candidate down for the full
+        failover deadline — means the control plane is gone. The agent
+        arms its ``PADDLE_TPU_AGENT_ORPHAN_S`` self-fence clock on THAT
+        type alone, so a healthy node is never fenced mid-failover."""
         complete = bool(self.store.check(f"{self._prefix}/complete"))
         return complete, int(self.store.add(f"{self._prefix}/round_seq", 0))
 
-    def round(self, no):
+    def round(self, no, probe=False):
+        """Round spec ``no`` or None. ``probe=True`` checks existence
+        first so an ABSENT round returns None immediately instead of
+        blocking the full store timeout in ``get`` — the failover
+        gap-filler probes an un-replicated standby exactly when stalling
+        the coordinator's lease beats would be most damaging."""
+        key = f"{self._prefix}/round/{no}"
         try:
-            return json.loads(
-                self.store.get(f"{self._prefix}/round/{no}").decode())
+            if probe and not self.store.check(key):
+                return None
+            return json.loads(self.store.get(key).decode())
         except Exception:
             return None
 
@@ -435,3 +452,38 @@ class QuarantineList:
 
     def quarantined(self):
         return sorted(self._quarantined)
+
+    def to_dict(self, now=None):
+        """Checkpoint the ledger for the replicated coordinator state.
+        Stamps are serialized as AGES (seconds before the checkpoint):
+        monotonic-clock readings are meaningless in another process, so
+        the shadow re-anchors them onto its own clock at restore."""
+        now = time.monotonic() if now is None else now
+        return {
+            "window_s": self.window_s,
+            "threshold": self.threshold,
+            "hits": self.hits,
+            "quarantined": {nid: now - t
+                            for nid, t in self._quarantined.items()},
+            "failures": {nid: [now - t for t in ts]
+                         for nid, ts in self._failures.items()},
+        }
+
+    def restore(self, state, now=None):
+        """Adopt a checkpointed ledger (coordinator shadow takeover):
+        quarantined nodes stay excluded and in-window failure stamps keep
+        counting toward the threshold across the takeover."""
+        if not state:
+            return self
+        now = time.monotonic() if now is None else now
+        self.window_s = float(state.get("window_s", self.window_s))
+        self.threshold = max(1, int(state.get("threshold",
+                                              self.threshold)))
+        self.hits = int(state.get("hits", 0))
+        self._quarantined = {
+            nid: now - float(age)
+            for nid, age in (state.get("quarantined") or {}).items()}
+        self._failures = {
+            nid: [now - float(a) for a in ages]
+            for nid, ages in (state.get("failures") or {}).items()}
+        return self

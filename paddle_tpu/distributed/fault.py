@@ -65,7 +65,8 @@ import time
 
 __all__ = [
     "EXIT_FAULT", "EXIT_PREEMPT", "EXIT_WATCHDOG", "EXIT_HANG",
-    "EXIT_DESYNC", "EXIT_USAGE", "EXIT_CAUSES", "describe_exit",
+    "EXIT_DESYNC", "EXIT_USAGE", "EXIT_DEPOSED", "EXIT_CAUSES",
+    "describe_exit",
     "FaultEntry",
     "parse_fault_spec", "set_fault_spec", "maybe_inject", "fault_rank",
     "Backoff", "retry", "atomic_write", "atomic_write_bytes",
@@ -82,6 +83,9 @@ EXIT_DESYNC = 21     # collective desync detected pre-issue (fail-fast,
                      # distributed/flight_recorder.py)
 EXIT_USAGE = 64      # launcher flag combination rejected (EX_USAGE) —
                      # mapped + hinted instead of a bare traceback
+EXIT_DEPOSED = 76    # control-plane coordinator deposed (EX_PROTOCOL):
+                     # a shadow took over the lease term; this instance
+                     # yielded instead of split-braining the round
 
 # The one copy of the worker exit-code -> human cause mapping (launcher
 # failure summaries, tests). Negative codes are death-by-signal and are
@@ -98,6 +102,8 @@ EXIT_CAUSES = {
                  "before issue (fail-fast)",
     EXIT_USAGE: "launcher usage error — incompatible flag combination "
                 "(see the hint printed above it)",
+    EXIT_DEPOSED: "coordinator deposed — a shadow coordinator took over "
+                  "the lease term; this instance yielded (writes fenced)",
 }
 
 
@@ -115,7 +121,8 @@ def describe_exit(rc) -> str:
 
 _KINDS = ("crash", "hang", "torn_write", "store_drop", "slow_io",
           "async_torn", "commit_stall", "desync",
-          "node_die", "agent_stall", "store_die")
+          "node_die", "agent_stall", "store_die",
+          "coordinator_die", "wal_torn")
 # a site-less (wildcard) cooperative entry only fires at sites whose
 # callers honor the returned kind — anywhere else it would burn its
 # trigger silently; crash/hang/slow_io/commit_stall wildcards fire at
@@ -139,7 +146,20 @@ _WILDCARD_SITES = {"store_drop": ("store",), "torn_write": ("ckpt",),
                    "async_torn": ("async_ckpt",), "desync": _DESYNC_SITES,
                    "node_die": ("node_beat",),
                    "agent_stall": ("node_beat",),
-                   "store_die": ("elastic_store",)}
+                   "store_die": ("elastic_store",),
+                   # control-plane replication kinds (ISSUE 10):
+                   # ``coordinator_die`` is cooperative at the
+                   # coordinator's lease-beat site — the coordinator
+                   # enacts a sudden SIGKILL of itself (its in-process
+                   # primary registry server dies with it, so ONE kind
+                   # kills both halves of the control plane);
+                   # ``wal_torn`` is cooperative at the log shipper's
+                   # replication site — the shipper tears the entry it is
+                   # applying to the standby (truncated set / dropped
+                   # add), proving the on_failover gap-filler heals the
+                   # un-replicated tail
+                   "coordinator_die": ("coord_beat",),
+                   "wal_torn": ("replication",)}
 
 _lock = threading.Lock()
 _entries: list | None = None  # parsed spec; None = not yet loaded from env

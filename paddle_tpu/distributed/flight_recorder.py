@@ -54,7 +54,7 @@ __all__ = [
     "note_heartbeat", "note_resume", "check_desync", "verify_signatures",
     "wire_from_env",
     "next_group_seq", "current_group_seq", "reset_seqs", "incarnation",
-    "note_store_incarnation", "store_incarnation",
+    "note_store_incarnation", "note_fenced", "store_incarnation",
     "store_scope", "dump", "dump_path", "watchdog_escalation",
     "collect_dumps", "rows_from_dumps", "blame_rows", "format_post_mortem",
 ]
@@ -123,8 +123,35 @@ def note_store_incarnation(n: int):
     re-home to a standby master. Keys derived from :func:`store_scope`
     rotate with it, so a process that outlived a store failover can never
     collide with keys a slow peer wrote under the previous store lifetime
-    (or with a restarted primary's leftovers)."""
+    (or with a restarted primary's leftovers). When the recorder is
+    enabled the rotation also leaves a completed ``store_failover`` ring
+    marker, so a post-mortem spanning a control-plane failover can name
+    which store epoch any surrounding entry belongs to."""
+    changed = int(n) > _store_inc[0]
     _store_inc[0] = max(_store_inc[0], int(n))
+    if not changed:
+        return
+    rec = _rec if _loaded else _load()
+    if rec is not None:
+        rec.complete(rec.issue("store_failover", group="step",
+                               extra={"incarnation": int(n)}))
+
+
+def note_fenced(kind, old_epoch, new_epoch, detail=None):
+    """Ring marker for a fenced write: a deposed writer (an old store
+    epoch or a deposed coordinator term) tried to mutate the control
+    plane and was rejected. The marker names BOTH epochs so post-mortems
+    can attribute a stray write to the lifetime it came from. ``kind`` is
+    ``store_fenced`` (FailoverStore epoch fence), ``coord_fenced``
+    (coordinator lease term) or ``wal_replay_fenced`` (log shipper
+    rejected a deposed primary's late WAL entry)."""
+    rec = _rec if _loaded else _load()
+    if rec is None:
+        return
+    extra = {"old_epoch": int(old_epoch), "new_epoch": int(new_epoch)}
+    if detail is not None:
+        extra["detail"] = str(detail)
+    rec.complete(rec.issue(kind, group="step", extra=extra))
 
 
 def store_incarnation() -> int:

@@ -49,15 +49,24 @@ the standby under a bumped store incarnation.
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import signal
 import subprocess
 import sys
 import time
 
-from ..fault import EXIT_PREEMPT, EXIT_USAGE, describe_exit
+from ..fault import (EXIT_DEPOSED, EXIT_PREEMPT, EXIT_USAGE,
+                     describe_exit)
 
-__all__ = ["launch", "main"]
+__all__ = ["launch", "main", "CoordinatorDeposedError"]
+
+
+class CoordinatorDeposedError(RuntimeError):
+    """This coordinator's lease term was superseded: a shadow adopted the
+    round while we were partitioned/presumed dead. The only safe move is
+    to yield (exit ``EXIT_DEPOSED``) — two coordinators publishing rounds
+    would split-brain the agents."""
 
 # repo/install root that contains the paddle_tpu package: workers must be
 # able to `import paddle_tpu` regardless of their script's directory
@@ -103,6 +112,18 @@ def _parse_args(argv=None):
     p.add_argument("--max_elastic_events", type=int, default=16,
                    help="runaway guard for scale-event relaunches (scale "
                         "events do not consume --max_restarts)")
+    p.add_argument("--coordinator_role", default="auto",
+                   choices=("auto", "primary", "shadow"),
+                   help="control-plane role for --nnodes MIN:MAX with a "
+                        "standby --master candidate: 'auto' (default) "
+                        "serves every locally bindable registry candidate "
+                        "(single-machine pod simulation); 'primary' "
+                        "serves only the first candidate and holds the "
+                        "coordinator lease; 'shadow' (run on the standby "
+                        "host) serves the standby candidate(s), tails "
+                        "the primary's replication log, and adopts the "
+                        "published round when the primary's lease "
+                        "expires — takeover without re-rendezvous")
     p.add_argument("--local_agents", type=int, default=-1,
                    help="node agents this coordinator spawns locally for "
                         "--nnodes MIN:MAX (default: MAX — the single-"
@@ -347,10 +368,11 @@ class _NodeCoordinator:
     def __init__(self, args, extra_env, min_nodes, max_nodes):
         from ..elastic import (NodeRegistry, QuarantineList,
                                render_node_round)
-        from ..tcp_store import FailoverStore, TCPStore
+        from ..tcp_store import FailoverStore, LogShipper, TCPStore
         self.args = args
         self.extra_env = dict(extra_env)
         self.min_nodes, self.max_nodes = min_nodes, max_nodes
+        self.role = getattr(args, "coordinator_role", "auto")
         self._render = render_node_round
         cands = [c.strip() for c in args.master.split(",") if c.strip()]
         self.master = cands[0]
@@ -363,11 +385,22 @@ class _NodeCoordinator:
             # (it lives on a different host) instead of dying on int('')
             eps.append((h or "127.0.0.1", int(p or p0 or 8476) + 1))
         self.eps = eps
-        # serve every locally bindable candidate (in tests both live
-        # here; in a real pod the standby is served on another host and
-        # the bind simply fails)
+        # which candidates this process serves: 'auto' = every locally
+        # bindable one (single-machine pod simulation; in a real pod the
+        # other host's bind simply fails), 'primary' = only the first
+        # (a shadow on the standby host serves the rest), 'shadow' =
+        # everything BUT the first
+        if self.role == "primary":
+            mine = {0}
+        elif self.role == "shadow":
+            mine = set(range(1, len(eps)))
+        else:
+            mine = set(range(len(eps)))
         self.servers = []
-        for host, port in eps:
+        for i, (host, port) in enumerate(eps):
+            if i not in mine:
+                self.servers.append(None)
+                continue
             try:
                 self.servers.append(TCPStore(host, port, is_master=True))
             except Exception as e:
@@ -377,6 +410,14 @@ class _NodeCoordinator:
         self.current_spec = None
         self._failover_at = None
         self.store = FailoverStore(eps, on_failover=self._on_failover)
+        # the coordinator's authority is the lease TERM, not the store
+        # epoch: a shadow that deposed a slow-but-alive primary sits on
+        # its own standby when the agents re-home onto it and bump the
+        # fence epoch — without this resolver it would fence ITSELF out
+        # of the lifetime it just adopted (and the job would lose both
+        # coordinators). The resolver re-reads the term per event, so a
+        # genuinely deposed coordinator still raises.
+        self.store._fence_resolver = self._still_holds_term
         self.registry = NodeRegistry(self.store, args.job_id,
                                      ttl=args.elastic_ttl)
         self.quarantine = QuarantineList(args.quarantine_window,
@@ -387,6 +428,30 @@ class _NodeCoordinator:
         self.agent_procs = []
         self.settle = args.elastic_ttl + 1.0
         self._loss_logged = set()
+        # control-plane replication (ISSUE 10): whoever serves a STANDBY
+        # candidate ships the primary's op log onto it, so a promoted
+        # standby already holds round history/membership/join-seq
+        self._shippers = []
+        if len(eps) > 1:
+            primary_ep = f"{eps[0][0]}:{eps[0][1]}"
+            standbys = list(range(1, len(eps)))
+            for i in standbys:
+                if self.servers[i] is None:
+                    continue
+                sh = LogShipper(primary_ep,
+                                f"{eps[i][0]}:{eps[i][1]}",
+                                standby_index=i, peer_indices=standbys)
+                sh.start()
+                self._shippers.append(sh)
+        # coordinator lease: only meaningful when a standby exists (a
+        # shadow watches it); single-candidate jobs skip every lease op
+        # so the legacy hot path is untouched
+        self._lease_on = len(eps) > 1
+        self._term = 0
+        self._lease_next = 0.0
+        self._adopted = False
+        self._deposed = False
+        self._coord_prefix = f"elastic/{args.job_id}/coord"
 
     # ------------------------------------------------------------ setup
     def _spawn_local_agents(self, count):
@@ -419,19 +484,138 @@ class _NodeCoordinator:
             self.agent_procs.append(proc)
 
     def _on_failover(self, store, inc):
-        """Our own client re-homed to the standby: the registry contents
-        died with the primary, so reinstall the CURRENT round (same round
-        number — agents keep their workers running) and let agents
-        re-register on their own failovers."""
+        """Our own client re-homed to the standby. With log-shipped
+        replication the promoted standby usually already holds the
+        current round (the shipper tailed it over) — the republish below
+        is then skipped and this callback is a pure gap-filler for the
+        un-acked WAL tail; only an un-replicated (or badly lagged)
+        standby gets the full same-round reinstall. Either way the round
+        NUMBER never changes, so agents keep their workers running."""
         self._failover_at = time.monotonic()
         print(f"[elastic] registry master lost: failed over to standby "
               f"(store incarnation {inc})", file=sys.stderr, flush=True)
-        if self.current_spec is not None:
-            try:
-                self.registry.republish_round(self.current_spec)
-            except Exception as e:
-                print(f"[elastic] round republish failed: {e}",
-                      file=sys.stderr, flush=True)
+        if self.current_spec is None:
+            return
+        no = int(self.current_spec["round"])
+        try:
+            there = self.registry.round(no, probe=True)
+            if there is not None and int(there.get("round", -1)) == no:
+                print(f"[elastic] round {no} preserved by replication "
+                      "(no republish needed; gap-filling the un-acked "
+                      "tail only)", file=sys.stderr, flush=True)
+                return
+        except Exception:
+            pass
+        try:
+            self.registry.republish_round(self.current_spec)
+        except Exception as e:
+            print(f"[elastic] round republish failed: {e}",
+                  file=sys.stderr, flush=True)
+
+    # ----------------------------------------------------- lease + state
+    def _coord_key(self, leaf):
+        return f"{self._coord_prefix}/{leaf}"
+
+    def _still_holds_term(self):
+        """Fence resolver for the coordinator's store client: True when
+        this coordinator's lease term is still the current one (so a
+        store-epoch move under it — the agents re-homing onto the store
+        it adopted — must be adopted, not treated as deposition)."""
+        if not self._lease_on or self._term <= 0:
+            return False
+        try:
+            return int(self.store.add(self._coord_key("term"), 0)) \
+                == self._term
+        except Exception:
+            return False
+
+    def _acquire_lease(self):
+        """Take the next coordinator term and publish the first lease.
+        The term counter is the fence: a shadow adopting the round bumps
+        it, and every later renewal by the deposed holder is rejected."""
+        if not self._lease_on:
+            return
+        self._term = int(self.store.add(self._coord_key("term"), 1))
+        self._publish_lease()
+
+    def _publish_lease(self):
+        # cadence (ttl/3) is owned by _coord_beat's own throttle; every
+        # direct caller wants the publish NOW
+        if not self._lease_on:
+            return
+        self._lease_next = time.monotonic() + self.args.elastic_ttl / 3.0
+        cur = int(self.store.add(self._coord_key("term"), 0))
+        if cur != self._term:
+            from ..flight_recorder import note_fenced
+            note_fenced("coord_fenced", self._term, cur)
+            raise CoordinatorDeposedError(
+                f"coordinator lease term moved {self._term} -> {cur}: a "
+                "shadow adopted the round while this coordinator was "
+                "presumed dead")
+        self.store.set(self._coord_key("lease"), json.dumps({
+            "term": self._term, "ts": time.time(), "pid": os.getpid(),
+            "role": self.role}).encode())
+
+    def _coord_beat(self):
+        """One control-plane heartbeat, throttled to the lease cadence
+        (ttl/3): the ``coord_beat`` chaos site (``coordinator_die`` =
+        sudden SIGKILL of this process, taking its in-process primary
+        registry server with it — trigger N is the Nth lease beat, so
+        chaos timing is deterministic in beats, not loop iterations)
+        plus the lease renewal with its deposed-term fence."""
+        if not self._lease_on or time.monotonic() < self._lease_next:
+            return
+        from .. import fault as _fault
+        if _fault.maybe_inject("coord_beat") == "coordinator_die":
+            print(f"COORDINATOR_DIE {time.time():.6f}", flush=True)
+            print("[elastic] injected coordinator_die: SIGKILL self (the "
+                  "in-process primary registry server dies with it)",
+                  file=sys.stderr, flush=True)
+            sys.stdout.flush()
+            sys.stderr.flush()
+            os.kill(os.getpid(), signal.SIGKILL)
+        self._publish_lease()
+
+    def _sweep_term(self):
+        """Best-effort STONITH for the coordinator lease: push the
+        adopted term onto every candidate DIRECTLY, not just the one our
+        own client happens to be homed on. The takeover's term bump
+        lands on the shadow's active store; a deposed-but-alive primary
+        reads the term from ITS active store at every lease renewal —
+        without the sweep, a takeover triggered by replication lag or a
+        slow primary (rather than primary death) would leave the healthy
+        primary supervising a second world. With it, the primary sees
+        the moved term at its next beat and yields (exit 76). Still
+        best-effort by design: a candidate on the far side of a true
+        network partition stays unswept until the partition heals —
+        closing THAT window needs quorum writes, which this control
+        plane deliberately trades for a 2-candidate footprint (the
+        agents' store fence still rejects the deposed lifetime's writes
+        on re-home)."""
+        from ..tcp_store import sweep_counter
+        sweep_counter(self.eps, self._coord_key("term"), self._term,
+                      name="coord-term-sweep")
+
+    def _publish_coord_state(self):
+        """Checkpoint the round state into the replicated store so a
+        shadow can adopt it: the spec, the join-order roster, the
+        quarantine ledger and the event budgets."""
+        if not self._lease_on or self.current_spec is None:
+            return
+        state = {"spec": self.current_spec, "known": list(self.known),
+                 "quarantine": self.quarantine.to_dict(),
+                 "events": self.events,
+                 "preempt_restarts": self.preempt_restarts,
+                 "term": self._term, "ts": time.time()}
+        from ..tcp_store import StoreFencedError
+        try:
+            self.store.set(self._coord_key("state"),
+                           json.dumps(state).encode())
+        except StoreFencedError:
+            raise
+        except Exception as e:
+            print(f"[elastic] coordinator state checkpoint failed: {e}",
+                  file=sys.stderr, flush=True)
 
     def _inject_store_die(self):
         from .. import fault as _fault
@@ -471,6 +655,7 @@ class _NodeCoordinator:
         deadline = time.time() + self.args.elastic_timeout
         stable_since, last_n = time.time(), -1
         while time.time() < deadline:
+            self._coord_beat()
             self._scan_joins()
             cap = self._live_capacity()
             if len(cap) >= self.max_nodes:
@@ -532,6 +717,7 @@ class _NodeCoordinator:
         first_bad = None
         while True:
             self._inject_store_die()
+            self._coord_beat()
             try:
                 statuses, now = self._statuses(spec)
             except Exception as e:
@@ -580,8 +766,17 @@ class _NodeCoordinator:
 
     # -------------------------------------------------------------- run
     def run(self):
+        from ..tcp_store import StoreFencedError
         try:
+            if self.role == "shadow":
+                return self._run_shadow()
             return self._run()
+        except (CoordinatorDeposedError, StoreFencedError) as e:
+            self._deposed = True
+            print(f"[elastic] deposed: {e}; yielding "
+                  f"({describe_exit(EXIT_DEPOSED)})", file=sys.stderr,
+                  flush=True)
+            return EXIT_DEPOSED
         finally:
             print(f"[elastic] quarantine_hits={self.quarantine.hits} "
                   f"scale_events={self.events}", file=sys.stderr,
@@ -589,7 +784,7 @@ class _NodeCoordinator:
             self._cleanup()
 
     def _run(self):
-        from ..topology import FailureDomainMap
+        self._acquire_lease()
         n_local = self.args.local_agents
         if n_local < 0:
             n_local = self.max_nodes
@@ -602,21 +797,130 @@ class _NodeCoordinator:
                   f"{self.args.elastic_timeout:.0f}s", file=sys.stderr,
                   flush=True)
             return 1
+        return self._run_rounds(participants)
+
+    def _run_shadow(self):
+        """Shadow coordinator: serve the standby registry, ship the
+        primary's op log onto it, watch the primary's lease, and on
+        expiry adopt the last published round spec — resuming heartbeat
+        supervision of the live agents with NO re-rendezvous and no new
+        round (the agents' orphan window is our takeover budget)."""
+        grace = float(os.environ.get("PADDLE_TPU_COORD_LEASE_GRACE_S", 0)
+                      or 3 * self.args.elastic_ttl)
+        lease_key = self._coord_key("lease")
+        state_key = self._coord_key("state")
+        print(f"[elastic] shadow coordinator standing by "
+              f"(lease grace {grace:.0f}s, candidates "
+              f"{', '.join(f'{h}:{p}' for h, p in self.eps)})",
+              file=sys.stderr, flush=True)
+        # lease staleness is measured on OUR monotonic clock since the
+        # last observed CHANGE of the lease stamp — never by differencing
+        # two hosts' wall clocks, where ordinary NTP skew greater than
+        # the grace window would read every fresh lease as expired and
+        # depose a healthy primary on sight
+        last_ts, fresh_at = None, None
+        while True:
+            try:
+                if self.registry.is_complete():
+                    print("[elastic] shadow: job completed under the "
+                          "primary coordinator", file=sys.stderr,
+                          flush=True)
+                    return 0
+                lease = json.loads(self.store.get(lease_key).decode()) \
+                    if self.store.check(lease_key) else None
+            except Exception as e:
+                print(f"[elastic] shadow lease read failed: {e}",
+                      file=sys.stderr, flush=True)
+                time.sleep(0.5)
+                continue
+            if lease is None:
+                time.sleep(0.5)  # primary not up yet
+                continue
+            ts = lease.get("ts")
+            if ts != last_ts or fresh_at is None:
+                last_ts, fresh_at = ts, time.monotonic()
+            age = time.monotonic() - fresh_at
+            if age <= grace:
+                time.sleep(min(1.0, self.args.elastic_ttl / 3.0))
+                continue
+            try:
+                raw = self.store.get(state_key) \
+                    if self.store.check(state_key) else None
+            except Exception:
+                raw = None
+            if raw is None:
+                # lease expired before any round was published: nothing
+                # to adopt — keep waiting (the primary may still come up
+                # and rendezvous; a dead pre-round primary means the
+                # operator restarts the job)
+                print("[elastic] shadow: lease stale but no coordinator "
+                      "state published yet; waiting", file=sys.stderr,
+                      flush=True)
+                time.sleep(1.0)
+                continue
+            state = json.loads(raw.decode())
+            break
+        # ---- takeover: fence the deposed term, adopt the round
+        for sh in self._shippers:
+            sh.stop()
+        try:
+            # our client may have homed on the standby from construction
+            # and never failed over — adopt the store's CURRENT fence
+            # epoch (the agents' re-home bumped it) or our own first
+            # lease publish would depose us under the stale pin
+            self.store.adopt_epoch()
+        except Exception:
+            pass
+        self._term = int(self.store.add(self._coord_key("term"), 1))
+        self._sweep_term()
+        spec = state["spec"]
+        self.known = list(state.get("known") or [])
+        self.quarantine.restore(state.get("quarantine") or {})
+        self.events = int(state.get("events") or 0)
+        self.preempt_restarts = int(state.get("preempt_restarts") or 0)
+        self.current_spec = spec
+        self._failover_at = time.monotonic()  # re-home grace for agents
+        self._adopted = True
+        print(f"SHADOW_ADOPTED round={spec['round']} term={self._term} "
+              f"wall={time.time():.6f}", flush=True)
+        print(f"[elastic] shadow adopted round {spec['round']} "
+              f"(deposed term {int(state.get('term') or 0)} -> "
+              f"{self._term}; lease was {age:.1f}s stale): resuming "
+              "supervision of live agents without re-rendezvous",
+              file=sys.stderr, flush=True)
+        self._publish_lease()
+        participants = [nid for nid, _ in
+                        sorted(spec["nodes"].items(),
+                               key=lambda kv: kv[1])]
+        return self._run_rounds(participants, resume_spec=spec)
+
+    def _run_rounds(self, participants, resume_spec=None):
+        from ..topology import FailureDomainMap
         while True:
             self._domains = FailureDomainMap(participants)
-            spec = self._render(
-                participants, self.args.nproc_per_node, self.master,
-                quarantined=self.quarantine.quarantined(),
-                store_inc=self.store.incarnation)
-            os.makedirs(self.args.log_dir, exist_ok=True)
-            _clear_dumps(self.args.log_dir)
-            no = self.registry.publish_round(spec)
-            spec["round"] = no
-            self.current_spec = spec
-            print(f"[elastic] round {no}: nnodes={len(participants)} "
-                  f"world_size={spec['world']} nodes={participants} "
-                  f"(range {self.min_nodes}:{self.max_nodes})",
-                  file=sys.stderr, flush=True)
+            if resume_spec is not None:
+                # adopted from the replicated store: the agents are
+                # already running this round — supervise it as-is, never
+                # republish (a bumped round number would relaunch every
+                # worker for nothing)
+                spec, resume_spec = resume_spec, None
+                self._publish_coord_state()
+            else:
+                spec = self._render(
+                    participants, self.args.nproc_per_node, self.master,
+                    quarantined=self.quarantine.quarantined(),
+                    store_inc=self.store.incarnation)
+                os.makedirs(self.args.log_dir, exist_ok=True)
+                _clear_dumps(self.args.log_dir)
+                no = self.registry.publish_round(spec)
+                spec["round"] = no
+                self.current_spec = spec
+                self._publish_coord_state()
+                print(f"[elastic] round {no}: "
+                      f"nnodes={len(participants)} "
+                      f"world_size={spec['world']} nodes={participants} "
+                      f"(range {self.min_nodes}:{self.max_nodes})",
+                      file=sys.stderr, flush=True)
             outcome, detail = self._watch_round(spec)
             if outcome == "done":
                 self.registry.announce_complete()
@@ -658,6 +962,10 @@ class _NodeCoordinator:
                           f"{self.quarantine.window_s:.0f}s): excluded "
                           "from subsequent rounds", file=sys.stderr,
                           flush=True)
+            # checkpoint the quarantine hit NOW, not at the next round
+            # publish: a coordinator dying in between must not hand the
+            # shadow a ledger that forgot the failure
+            self._publish_coord_state()
             survivors = self._live_capacity()[:self.max_nodes]
             print(f"[elastic] node scale event (statuses "
                   f"{detail['statuses']}; blamed {detail['blamed']}): "
@@ -670,6 +978,7 @@ class _NodeCoordinator:
                       file=sys.stderr, flush=True)
                 deadline = time.time() + self.args.elastic_timeout
                 while time.time() < deadline:
+                    self._coord_beat()
                     self._scan_joins()
                     survivors = self._live_capacity()[:self.max_nodes]
                     if len(survivors) >= self.min_nodes:
@@ -682,19 +991,31 @@ class _NodeCoordinator:
             participants = survivors
 
     def _cleanup(self):
+        for sh in self._shippers:
+            try:
+                sh.stop()
+            except Exception:
+                pass
         # completion (or giving up) must not strand agents: the complete
         # flag is best-effort (the registry may be gone), the SIGTERM
-        # sweep is the backstop
-        try:
-            self.registry.announce_complete()
-        except Exception:
-            pass
-        deadline = time.time() + max(5.0, 2 * self.args.elastic_ttl)
-        for proc in self.agent_procs:
-            while proc.poll() is None and time.time() < deadline:
-                time.sleep(0.1)
-        _terminate_survivors([(p, None) for p in self.agent_procs],
-                             self.args.terminate_grace)
+        # sweep is the backstop. Two exceptions own the job elsewhere:
+        # a DEPOSED coordinator (the shadow supervises the live agents
+        # now — announcing complete or SIGTERMing them would kill a
+        # healthy round) and a shadow that never ADOPTED (the primary is
+        # still running it).
+        yielded = self._deposed or (self.role == "shadow"
+                                    and not self._adopted)
+        if not yielded:
+            try:
+                self.registry.announce_complete()
+            except Exception:
+                pass
+            deadline = time.time() + max(5.0, 2 * self.args.elastic_ttl)
+            for proc in self.agent_procs:
+                while proc.poll() is None and time.time() < deadline:
+                    time.sleep(0.1)
+            _terminate_survivors([(p, None) for p in self.agent_procs],
+                                 self.args.terminate_grace)
         for srv in self.servers:
             try:
                 if srv is not None:
@@ -767,6 +1088,19 @@ def launch(argv=None):
                   "single-host process group",
             "use --nnodes MIN:MAX (without --np) for multi-host elastic "
             "— node agents become the unit of membership")
+    if args.coordinator_role != "auto" and not node_elastic:
+        return _usage_error(
+            args, f"--coordinator_role {args.coordinator_role} only "
+                  "applies to --nnodes MIN:MAX jobs",
+            "the primary/shadow pair replicates the node-elastic "
+            "control plane; fixed-nnodes jobs have no coordinator")
+    if args.coordinator_role != "auto" \
+            and len([c for c in args.master.split(",") if c.strip()]) < 2:
+        return _usage_error(
+            args, f"--coordinator_role {args.coordinator_role} needs a "
+                  "standby --master candidate",
+            "pass --master host:p1,host:p2 — the second candidate is "
+            "the replicated standby registry the shadow serves")
     if node_elastic:
         if min_nodes < 1 or max_nodes < min_nodes:
             return _usage_error(

@@ -60,7 +60,7 @@ import time
 
 from .. import fault as _fault
 from ..elastic import NodeRegistry
-from ..tcp_store import FailoverStore
+from ..tcp_store import FailoverStore, StoreCandidatesExhausted
 from .main import _PKG_ROOT, _terminate_survivors
 
 __all__ = ["NodeAgent", "main"]
@@ -128,12 +128,27 @@ class NodeAgent:
 
     # --------------------------------------------------------- heartbeat
     def _beat_loop(self):
+        from ..tcp_store import StoreFencedError
         while not self._stop.wait(self.args.ttl / 3.0):
             kind = _fault.maybe_inject("node_beat")
             if kind == "node_die":
                 self._node_die()
             try:
                 self.registry.beat(self.node_id, self._record())
+            except StoreFencedError as e:
+                # this agent kept writing to a deposed store lifetime
+                # (asymmetric partition: everyone else failed over and
+                # the fence swept back here). Agents are interchangeable
+                # writers: re-home to the current lifetime, adopt its
+                # epoch and re-register — only coordinators yield.
+                print(f"[agent {self.node_id}] heartbeat fenced: {e}; "
+                      "re-homing to the current store lifetime",
+                      file=sys.stderr, flush=True)
+                try:
+                    self.registry.store.rehome(e)
+                except Exception as e2:
+                    print(f"[agent {self.node_id}] rehome failed: {e2}",
+                          file=sys.stderr, flush=True)
             except Exception as e:
                 print(f"[agent {self.node_id}] heartbeat failed: {e}",
                       file=sys.stderr, flush=True)
@@ -302,15 +317,22 @@ class NodeAgent:
         beat = threading.Thread(target=self._beat_loop, daemon=True,
                                 name="node-agent-beat")
         beat.start()
-        # orphan fencing: a registry that stays unreachable past every
-        # candidate for this long means the control plane is GONE (the
-        # coordinator died or this node is partitioned) — running stale
-        # workers forever would be the split-brain zombie the round
-        # fencing exists to prevent, so the node fences itself
+        # orphan fencing: a registry whose EVERY candidate stays
+        # unreachable past this long means the control plane is GONE
+        # (the coordinator died with no standby, or this node is
+        # partitioned) — running stale workers forever would be the
+        # split-brain zombie the round fencing exists to prevent, so the
+        # node fences itself. Only StoreCandidatesExhausted arms the
+        # clock (ISSUE 10 satellite): a clean failover re-homes INSIDE
+        # poll() and returns normally, and transient wobble (one slow op
+        # mid-failover) must never count toward fencing a healthy node —
+        # with a live standby the orphan window is the shadow
+        # coordinator's takeover budget, not a cluster-wide suicide pact.
         env_orphan = os.environ.get("PADDLE_TPU_AGENT_ORPHAN_S")
         orphan_s = float(env_orphan) if env_orphan \
             else max(60.0, 6 * self.args.ttl)
-        last_ok = time.monotonic()
+        exhausted_since = None
+        failing_since = None  # ANY-failure fallback clock (3x window)
         try:
             while True:
                 try:
@@ -324,19 +346,40 @@ class NodeAgent:
                         spec = self.registry.round(cur)
                         if spec is not None:
                             self._apply_round(spec)
-                    last_ok = time.monotonic()
+                    exhausted_since = failing_since = None
                 except SystemExit:
                     raise
-                except Exception as e:
-                    # registry wobble (mid-failover): keep supervising,
-                    # the FailoverStore recovers or keeps raising
+                except StoreCandidatesExhausted as e:
                     print(f"[agent {self.node_id}] registry poll failed: "
-                          f"{e}", file=sys.stderr, flush=True)
-                    if time.monotonic() - last_ok > orphan_s:
+                          f"{e} (all candidates exhausted)",
+                          file=sys.stderr, flush=True)
+                    now = time.monotonic()
+                    exhausted_since = exhausted_since or now
+                    failing_since = failing_since or now
+                    if now - exhausted_since > orphan_s:
                         self._teardown(
                             f"[agent {self.node_id}] registry unreachable "
                             f"for {orphan_s:.0f}s: control plane presumed "
                             "gone; fencing this node")
+                        print("AGENT_ORPHANED", flush=True)
+                        return 3
+                except Exception as e:
+                    # registry wobble (mid-failover, a re-homed standby
+                    # warming up): keep supervising without arming the
+                    # FAST orphan clock — the FailoverStore recovers or
+                    # escalates to StoreCandidatesExhausted above. The
+                    # 3x fallback clock still runs: a wedged store that
+                    # accepts connects but fails every op forever must
+                    # not keep stale workers alive indefinitely.
+                    print(f"[agent {self.node_id}] registry poll failed: "
+                          f"{e}", file=sys.stderr, flush=True)
+                    failing_since = failing_since or time.monotonic()
+                    if time.monotonic() - failing_since > 3 * orphan_s:
+                        self._teardown(
+                            f"[agent {self.node_id}] registry unhealthy "
+                            f"(every poll failing) for {3 * orphan_s:.0f}"
+                            "s: control plane presumed wedged; fencing "
+                            "this node")
                         print("AGENT_ORPHANED", flush=True)
                         return 3
                 self._poll_workers()

@@ -15,7 +15,7 @@ import queue as queue_mod
 import numpy as np
 
 from ..core.tensor import Tensor
-from .dataset import Dataset, IterableDataset
+from .dataset import Dataset, IterableDataset, TensorDataset
 from .sampler import BatchSampler
 
 __all__ = ["DataLoader", "default_collate_fn"]
@@ -70,6 +70,26 @@ def _to_tensors(obj):
     return obj
 
 
+class _NumpyTensorDataset(Dataset):
+    """Fork-safe twin of TensorDataset for multiprocess workers: indexes
+    HOST numpy snapshots, so a worker never issues a jax op.
+    TensorDataset.__getitem__ slices device Tensors — in a fork-child
+    that is an XLA compile against compiler state forked from the
+    parent, which can deadlock outright on a small host (2-core CI: the
+    child sleeps in backend_compile forever). The module's design rule
+    is that jax/XLA stays OUT of forked children; this snapshot (taken
+    once, in the parent) is how TensorDataset honors it."""
+
+    def __init__(self, arrays):
+        self.arrays = arrays
+
+    def __getitem__(self, idx):
+        return tuple(a[idx] for a in self.arrays)
+
+    def __len__(self):
+        return self.arrays[0].shape[0]
+
+
 def _worker_loop(dataset, index_queue, data_queue, collate_fn,
                  worker_init_fn=None, worker_id=0):
     """Reference: io/dataloader/worker.py _worker_loop."""
@@ -95,12 +115,22 @@ class _MultiprocessIter:
         self.index_queue = ctx.Queue()
         self.data_queue = ctx.Queue()
         collate = loader._worker_collate
+        dataset = loader.dataset
+        if type(dataset) is TensorDataset:
+            # snapshot device tensors to host numpy BEFORE forking so the
+            # workers' __getitem__ never touches jax (see
+            # _NumpyTensorDataset: a fork-child compile deadlocks).
+            # Exact-type check: a SUBCLASS may override __getitem__
+            # (transforms, label mapping) and must keep its own behavior
+            # — it is then responsible for staying jax-free in workers.
+            dataset = _NumpyTensorDataset(
+                [np.asarray(t._data) for t in dataset.tensors])
         # paddle semantics: timeout=0 waits indefinitely
         self.timeout = loader.timeout if loader.timeout else None
         self.workers = []
         for wid in range(loader.num_workers):
             w = ctx.Process(target=_worker_loop,
-                            args=(loader.dataset, self.index_queue,
+                            args=(dataset, self.index_queue,
                                   self.data_queue, collate,
                                   loader.worker_init_fn, wid))
             w.daemon = True

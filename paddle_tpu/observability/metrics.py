@@ -41,8 +41,8 @@ __all__ = [
     "exp_buckets",
     "get_registry", "enabled", "enable", "disable", "metric_key",
     "parse_metric_key", "counter", "gauge", "histogram", "observe",
-    "observe_collective", "flush", "hist_quantile", "hist_mean",
-    "peak_flops",
+    "observe_collective", "observe_replication", "flush", "hist_quantile",
+    "hist_mean", "peak_flops",
 ]
 
 
@@ -462,6 +462,24 @@ def observe_collective(entry):
             if c_in.value > 0:
                 reg.gauge("comm_overlap_pct").set(
                     100.0 * c_hid.value / c_in.value)
+
+
+def observe_replication(head_seq, acked_seq, shipped=0, torn=0):
+    """Replication-plane telemetry for the log-shipped registry failover
+    (ISSUE 10): ``store_replication_lag`` gauge (primary WAL head minus
+    the standby's acked seq — the ops a failover right now would hand to
+    the on_failover gap-filler) plus shipped/torn counters. Called from
+    ``tcp_store.LogShipper.ship_once``; one ``None`` check when metrics
+    are off, same contract as :func:`observe_collective`."""
+    reg = _REG if _loaded else _load()
+    if reg is None:
+        return
+    reg.gauge("store_replication_lag").set(
+        max(0, int(head_seq) - int(acked_seq)))
+    if shipped:
+        reg.counter("store_wal_shipped_total").inc(int(shipped))
+    if torn:
+        reg.counter("store_wal_torn_total").inc(int(torn))
 
 
 # ---------------------------------------------------------- hardware table

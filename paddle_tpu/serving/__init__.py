@@ -15,7 +15,8 @@ Poisson open-loop load tooling for the bench.
 from .kv_cache import BlockAllocator, OutOfPages, PagedKVCache, pages_for  # noqa: F401
 from .prefix_cache import PrefixCache  # noqa: F401
 from .scheduler import (  # noqa: F401
-    ContinuousBatchingScheduler, EngineClosed, GenerationRequest, QueueFull,
+    ContinuousBatchingScheduler, EngineClosed, EngineShuttingDown,
+    GenerationRequest, QueueFull,
 )
 from .decode import (  # noqa: F401
     ab_compare, paged_decode_attention, paged_prefill_attention,

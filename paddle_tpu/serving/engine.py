@@ -50,7 +50,10 @@ ServingMetrics`; ``bench.py --serving`` drives a Poisson open-loop load
 from __future__ import annotations
 
 import contextlib
+import os
+import sys
 import threading
+import time
 
 import jax
 import jax.numpy as jnp
@@ -64,7 +67,7 @@ from .kv_cache import PagedKVCache, pages_for
 from .metrics import ServingMetrics
 from .prefix_cache import PrefixCache
 from .scheduler import (ContinuousBatchingScheduler, EngineClosed,
-                        GenerationRequest)
+                        EngineShuttingDown, GenerationRequest)
 
 __all__ = ["ServingEngine"]
 
@@ -241,6 +244,15 @@ class ServingEngine:
         self._stop_evt = threading.Event()
         self._wake = threading.Event()
         self._closed = False
+        self._draining = False
+        self._loop_error = None  # terminal serve-loop crash (unhealthy)
+        self._shutdown_lock = threading.Lock()
+        # serializes actual scheduler rounds: the engine contract is one
+        # driving thread, but a SIGTERM drain (watcher thread) can land
+        # while a foreground generate()/run_until_idle() is mid-step —
+        # without this, two steppers pop the same slot / double-alloc
+        # pages. Re-entrant so the serve loop's own step nests freely.
+        self._step_lock = threading.RLock()
 
     # ------------------------------------------------------------ A/B gate
     def _run_ab_gate(self):
@@ -545,29 +557,36 @@ class ServingEngine:
         requests never skip a step while a newcomer prefills — the gap
         between two decode steps is bounded by the chunk budget, not by
         the longest prompt in the queue."""
+        if self._loop_error is not None:
+            raise EngineClosed(
+                f"engine unhealthy: serve loop crashed with "
+                f"{type(self._loop_error).__name__}: {self._loop_error}"
+            ) from self._loop_error
         if self._closed:
             raise EngineClosed("engine is closed")
-        admitted = self.scheduler.schedule()
-        if admitted:
-            self._prefill_admitted(admitted)
-        if self.prefill_chunk is not None and self._prefilling:
-            # budgeted interleave: one bounded chunk launch per round
-            self._run_chunk_batch()
-        _, evicted = self.scheduler.ensure_decode_capacity()
-        for req in evicted:
-            self.metrics.on_evict(req)
-        active = {slot: r for slot, r in self.scheduler.active.items()
-                  if r.state == "active"}
-        emitted = self._decode_once(active) if active else 0
-        occ = self.kv.occupancy_pct()
-        self._peak_occupancy = max(self._peak_occupancy, occ)
-        alloc = self.kv.allocator
-        self.metrics.sample_state(
-            len(self.scheduler.active), self.scheduler.queue_depth(), occ,
-            shared_pages=alloc.shared_pages() if self.prefix else None,
-            cached_pages=alloc.cached_pages if self.prefix else None)
-        self._steps += 1
-        return emitted
+        with self._step_lock:
+            admitted = self.scheduler.schedule()
+            if admitted:
+                self._prefill_admitted(admitted)
+            if self.prefill_chunk is not None and self._prefilling:
+                # budgeted interleave: one bounded chunk launch per round
+                self._run_chunk_batch()
+            _, evicted = self.scheduler.ensure_decode_capacity()
+            for req in evicted:
+                self.metrics.on_evict(req)
+            active = {slot: r for slot, r in self.scheduler.active.items()
+                      if r.state == "active"}
+            emitted = self._decode_once(active) if active else 0
+            occ = self.kv.occupancy_pct()
+            self._peak_occupancy = max(self._peak_occupancy, occ)
+            alloc = self.kv.allocator
+            self.metrics.sample_state(
+                len(self.scheduler.active), self.scheduler.queue_depth(),
+                occ,
+                shared_pages=alloc.shared_pages() if self.prefix else None,
+                cached_pages=alloc.cached_pages if self.prefix else None)
+            self._steps += 1
+            return emitted
 
     def run_until_idle(self, max_steps=100000):
         steps = 0
@@ -585,6 +604,13 @@ class ServingEngine:
                timeout=10.0):
         """Queue one request (backpressure: blocks up to ``timeout`` for
         queue space, then raises :class:`~.scheduler.QueueFull`)."""
+        if self._draining:
+            raise EngineShuttingDown("engine is shutting down")
+        if self._loop_error is not None:
+            raise EngineClosed(
+                f"engine unhealthy: serve loop crashed with "
+                f"{type(self._loop_error).__name__}: {self._loop_error}"
+            ) from self._loop_error
         if self._closed:
             raise EngineClosed("engine is closed")
         req = GenerationRequest(prompt_ids, max_new_tokens=max_new_tokens,
@@ -621,8 +647,17 @@ class ServingEngine:
                 else:
                     self._wake.wait(0.02)
                     self._wake.clear()
-            except Exception as e:  # a broken step fails every waiter
+            except Exception as e:
+                # a broken step is terminal, not a silent hang: fail every
+                # queued + in-flight waiter with the ACTUAL error and mark
+                # the engine unhealthy so later submit()s fail fast naming
+                # it (graceful degradation — callers can route elsewhere)
+                self._loop_error = e
+                self._closed = True
                 self.scheduler.close(error=e)
+                print(f"[serving] serve loop crashed; engine unhealthy: "
+                      f"{type(e).__name__}: {e}", file=sys.stderr,
+                      flush=True)
                 break
 
     def stop(self, timeout=10.0):
@@ -640,6 +675,115 @@ class ServingEngine:
         self._closed = True
         self.stop()
         self.scheduler.close()
+
+    # -------------------------------------------------- graceful shutdown
+    def shutdown(self, drain_s=30.0):
+        """SIGTERM-grade graceful shutdown (ISSUE 10 satellite), the
+        serving twin of the training tier's exit-75 preemption save:
+
+        1. stop admitting — later ``submit``\\ s and every QUEUED request
+           fail with the named :class:`~.scheduler.EngineShuttingDown`
+           status (they never started; safe to retry elsewhere), not the
+           indiscriminate bare close;
+        2. drain in-flight decodes up to ``drain_s`` seconds — requests
+           mid-generation finish normally;
+        3. fail whatever missed the deadline, then flush the serving
+           metrics JSONL so the final counters land on disk before exit.
+
+        Idempotent; returns a summary dict. ``close()`` afterwards is a
+        no-op. Serialized: a concurrent second call (the SIGTERM watcher
+        racing a user-initiated shutdown) blocks until the in-progress
+        drain finishes, then sees ``_closed`` and returns the empty
+        summary — two threads must never both drive ``step()``."""
+        with self._shutdown_lock:
+            return self._shutdown_locked(drain_s)
+
+    def _shutdown_locked(self, drain_s):
+        if self._closed:
+            return {"drained_tokens": 0, "failed_queued": 0,
+                    "failed_inflight": 0}
+        self._draining = True
+        self.stop()  # join the serve loop; we drive the drain inline
+        queued = self.scheduler.begin_shutdown()
+        for req in queued:
+            # rejected-at-queue is a terminal state too: the flushed
+            # counters must show these requests, not a clean drain
+            self.metrics.on_finish(req)
+        deadline = time.monotonic() + max(0.0, float(drain_s))
+        drained = 0
+        # drain on has_work, not just active: KV pressure can EVICT an
+        # in-flight request back onto the waiting queue mid-drain, and it
+        # deserves its remaining budget (schedule() re-admits it — the
+        # shutdown gate closed submit(), not the internal readmit path)
+        while self.scheduler.has_work() and time.monotonic() < deadline:
+            drained += self.step()
+        missed = [r for r in self.scheduler.active.values()
+                  if r.state in ("active", "prefilling")]
+        # evicted mid-drain and never re-admitted: close out the pending
+        # queue-wait segment (same honesty rule as begin_shutdown) before
+        # close() stamps them failed
+        now = time.perf_counter()
+        for req in self.scheduler.waiting:
+            req.queue_wait_s += now - req.t_enqueue
+        missed += list(self.scheduler.waiting)
+        self._closed = True
+        self.scheduler.close(error=EngineShuttingDown(
+            f"engine shut down before this request finished "
+            f"(drain deadline {drain_s:.0f}s)"))
+        for req in missed:
+            self.metrics.on_finish(req)
+        reg = self.metrics._reg
+        if reg is not None:
+            try:
+                reg.flush()
+            except Exception:
+                pass
+        out = {"drained_tokens": drained, "failed_queued": len(queued),
+               "failed_inflight": len(missed)}
+        print(f"[serving] graceful shutdown: {out}", flush=True)
+        return out
+
+    def install_sigterm(self, drain_s=None):
+        """Wire SIGTERM to the training-tier convention: graceful drain
+        (:meth:`shutdown`), then exit ``EXIT_PREEMPT`` (75) so the same
+        launcher/orchestrator policy that resumes preempted trainers
+        treats a drained server as resumable, not failed. ``drain_s``
+        defaults to ``PADDLE_TPU_SERVING_DRAIN_S`` (30). Returns True if
+        the handler was installed (main thread only).
+
+        The handler itself only sets the preemption flag (the fault
+        module's safe flag-only mode); the drain runs on a dedicated
+        watcher thread. Running ``shutdown()`` inside the signal frame
+        would self-deadlock if SIGTERM lands while the interrupted main
+        thread holds the scheduler's (non-reentrant) admission lock —
+        the exact hazard ``install_preemption_handler``'s docstring
+        names for mid-collective saves."""
+        from ..distributed import fault as _fault
+        if drain_s is None:
+            drain_s = float(os.environ.get(
+                "PADDLE_TPU_SERVING_DRAIN_S", "30"))
+        if not _fault.install_preemption_handler():
+            return False
+
+        def _watch():
+            while not self._closed:
+                if _fault.preempted():
+                    # the exit must happen even if the drain raises (a
+                    # racing close(), a decode error): a dead watcher
+                    # thread would swallow the SIGTERM entirely and the
+                    # orchestrator's grace window would end in SIGKILL
+                    # with no metrics flush and no exit-75 classification
+                    try:
+                        self.shutdown(drain_s=drain_s)
+                    finally:
+                        sys.stdout.flush()
+                        sys.stderr.flush()
+                        os._exit(_fault.EXIT_PREEMPT)
+                time.sleep(0.1)
+
+        threading.Thread(target=_watch, daemon=True,
+                         name="serving-sigterm-drain").start()
+        return True
 
     def __enter__(self):
         return self

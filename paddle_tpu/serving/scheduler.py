@@ -43,6 +43,14 @@ class EngineClosed(RuntimeError):
     """Submitted to / waited on an engine that has been closed."""
 
 
+class EngineShuttingDown(EngineClosed):
+    """The engine began a graceful shutdown (SIGTERM drain): admission is
+    closed and queued requests are failed with THIS status — a named,
+    retryable verdict the caller can route to another replica — while
+    in-flight decodes drain up to the deadline. Distinct from the bare
+    :class:`EngineClosed` a hard ``close()`` hands out."""
+
+
 _rid = itertools.count()
 
 
@@ -169,6 +177,7 @@ class ContinuousBatchingScheduler:
         self._lock = threading.Lock()
         self._space = threading.Condition(self._lock)
         self._closed = False
+        self._shutting_down = False
         self.total_evictions = 0
 
     # ---- producer side --------------------------------------------------
@@ -185,7 +194,7 @@ class ContinuousBatchingScheduler:
                 f"pool has {self.allocator.capacity} — it could never run")
         with self._space:
             if self._closed:
-                raise EngineClosed("engine is closed")
+                raise self._closed_error()
             if len(self.waiting) >= self.max_queue and block:
                 deadline = time.perf_counter() + timeout
                 while len(self.waiting) >= self.max_queue \
@@ -195,7 +204,7 @@ class ContinuousBatchingScheduler:
                         break
                     self._space.wait(left)
                 if self._closed:
-                    raise EngineClosed("engine is closed")
+                    raise self._closed_error()
             if len(self.waiting) >= self.max_queue:
                 raise QueueFull(
                     f"waiting queue at capacity ({self.max_queue})")
@@ -325,9 +334,39 @@ class ContinuousBatchingScheduler:
         with self._lock:
             return bool(self.waiting) or bool(self.active)
 
+    def _closed_error(self):
+        return EngineShuttingDown("engine is shutting down") \
+            if self._shutting_down else EngineClosed("engine is closed")
+
+    def begin_shutdown(self, error=None):
+        """Graceful half of teardown: stop admitting (later submits raise
+        :class:`EngineShuttingDown`), fail every QUEUED request with that
+        named status, keep the in-flight ones — the engine drains them
+        with further decode steps up to its deadline, then ``close()``\\ s
+        whatever remains. Returns the failed queued requests (the caller
+        records their terminal metrics — they must not vanish from the
+        flushed counters)."""
+        err = error or EngineShuttingDown(
+            "engine is shutting down: request was queued, not started — "
+            "safe to retry on another replica")
+        with self._space:
+            self._closed = True
+            self._shutting_down = True
+            waiting = list(self.waiting)
+            self.waiting.clear()
+            self._space.notify_all()
+        now = time.perf_counter()
+        for req in waiting:
+            # a rejected-at-queue request's whole life was queue wait:
+            # close out the pending segment so the cumulative-wait
+            # histogram sample observed at its terminal state is honest
+            req.queue_wait_s += now - req.t_enqueue
+            req.finish(err)
+        return waiting
+
     def close(self, error=None):
         """Fail everything still queued or in flight (engine teardown)."""
-        err = error or EngineClosed("engine is closed")
+        err = error or self._closed_error()
         with self._space:
             self._closed = True
             waiting = list(self.waiting)

@@ -12,6 +12,18 @@ ranking plus the budget headroom.
     python -m paddle_tpu.tools.slowest_tests /tmp/_t1.log
     python -m paddle_tpu.tools.slowest_tests /tmp/_t1.log -n 30 --by-file
 
+As a post-verify GATE (ISSUE 10 satellite), ``--fail-over-pct N`` exits
+non-zero (rc 3) when the measured wall crosses N% of the budget — so
+timing creep fails loudly per PR instead of being discovered as a
+mysterious timeout months later::
+
+    python -m paddle_tpu.tools.slowest_tests /tmp/_t1.log \
+        --budget 870 --fail-over-pct 95
+
+A log whose durations section exists but whose summary line is missing
+(pytest was killed by the timeout before printing it) also fails the
+gate: that IS the over-budget case.
+
 Reads only what pytest already printed — no re-run, no plugins.
 """
 from __future__ import annotations
@@ -69,6 +81,12 @@ def main(argv=None):
                     help="aggregate per test file instead of per test")
     ap.add_argument("--budget", type=float, default=870.0,
                     help="tier-1 wall-clock budget in seconds")
+    ap.add_argument("--fail-over-pct", type=float, default=None,
+                    dest="fail_over_pct", metavar="N",
+                    help="exit 3 when the measured wall exceeds N%% of "
+                         "--budget (or when the log has no summary line "
+                         "at all — a timeout-killed run); wire as a "
+                         "post-verify step so creep fails per PR")
     args = ap.parse_args(argv)
     try:
         with open(args.log, errors="replace") as f:
@@ -93,6 +111,24 @@ def main(argv=None):
           + "):")
     for name, secs in rows:
         print(f"{secs:9.2f}s  {name}")
+    if args.fail_over_pct is not None:
+        thresh = args.budget * args.fail_over_pct / 100.0
+        if wall is None:
+            print(f"slowest_tests: BUDGET GATE FAILED — the log has a "
+                  "durations section but no summary line: pytest never "
+                  "finished (timeout-killed run counts as over budget)",
+                  file=sys.stderr)
+            return 3
+        if wall > thresh:
+            print(f"slowest_tests: BUDGET GATE FAILED — wall "
+                  f"{wall:.1f}s > {thresh:.1f}s "
+                  f"({args.fail_over_pct:.0f}% of the "
+                  f"{args.budget:.0f}s budget); trim or @slow-mark the "
+                  "slowest tests above before merging", file=sys.stderr)
+            return 3
+        print(f"slowest_tests: budget gate ok — wall {wall:.1f}s <= "
+              f"{thresh:.1f}s ({args.fail_over_pct:.0f}% of "
+              f"{args.budget:.0f}s)")
     return 0
 
 

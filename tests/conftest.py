@@ -76,6 +76,11 @@ def _seed():
     # env-gated default so an enabled recorder/desync mode can't leak
     from paddle_tpu.distributed import flight_recorder as _flight
     _flight._reset_state()
+    # control-plane replication writer ids (claim-key namespace for the
+    # WAL's exactly-once adds) restart per test: deterministic op ids,
+    # and no claim collisions against a recycled store port
+    from paddle_tpu.distributed import tcp_store as _tcp_store
+    _tcp_store._reset_replication_state()
     # grad-sync hooks (overlap engine's bucket schedulers) are a process-
     # global registry on the autograd walk: a test that attached one (or
     # leaked a DataParallel with comm_overlap=True) must not keep firing

@@ -149,6 +149,7 @@ def test_precision_recall_auc():
 
 
 # ---------------- hapi Model ----------------
+@pytest.mark.slow  # ~8s: tier-1 sits at the 870s budget edge (slowest_tests gate); full coverage stays in the slow suite
 def test_hapi_model_fit_evaluate_predict(tmp_path):
     from paddle_tpu.io import TensorDataset
     paddle.seed(1)

@@ -516,6 +516,7 @@ def test_group_sharded_offload_masters_on_host():
     _set_hcg(None)
 
 
+@pytest.mark.slow  # ~8s: tier-1 sits at the 870s budget edge (slowest_tests gate); full coverage stays in the slow suite
 def test_dgc_momentum_converges_and_sparsifies():
     """Reference: fleet/meta_optimizers/dgc_optimizer.py — top-k sparse
     updates with error feedback must still converge; during rampup it is

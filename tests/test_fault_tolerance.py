@@ -10,6 +10,7 @@ and reproduce the uninterrupted loss trajectory step-for-step (<= 1e-6),
 and a corrupted shard must be rejected by checksum, never loaded.
 """
 import glob
+import json
 import os
 import re
 import signal
@@ -1709,6 +1710,10 @@ def test_node_store_failover_training_continues(tmp_path, capfd,
     assert "re-homed to standby" in err
     assert "store incarnation 1" in err
     assert "all 2 node(s) finished" in err
+    # ISSUE 10: the shipper had already replicated the round onto the
+    # standby — the coordinator's on_failover found it there and skipped
+    # the from-scratch republish (gap-filling the un-acked tail only)
+    assert "preserved by replication" in err
     for nid in ("node0", "node1"):
         a = _agent_log(tmp_path, nid)
         assert "STORE_FAILOVER 1" in a, a
@@ -1862,3 +1867,568 @@ def test_slow_io_injection_delays_async_writer(tmp_path):
         dckpt.verify_checkpoint(str(tmp_path / "ck"))
     finally:
         os.environ.pop("PADDLE_TPU_FAULT_SLOW_IO_S", None)
+
+
+# ------------------- replicated control plane (ISSUE 10) -------------------
+
+def test_controlplane_fault_kinds_grammar():
+    """``coordinator_die`` is cooperative at the coordinator's lease-beat
+    site; ``wal_torn`` at the log shipper's replication site — both
+    parse, carry triggers, and are rejected at unhonorable sites."""
+    es = fault.parse_fault_spec(
+        "coordinator_die@coord_beat:3,wal_torn@replication:2")
+    assert [e.key() for e in es] == ["coordinator_die@coord_beat:3",
+                                    "wal_torn@replication:2"]
+    # wildcards only fire at their one honoring site
+    fault.set_fault_spec("coordinator_die:1")
+    assert fault.maybe_inject("step") is None
+    assert fault.maybe_inject("replication") is None
+    assert fault.maybe_inject("coord_beat") == "coordinator_die"
+    fault.set_fault_spec("wal_torn:1")
+    assert fault.maybe_inject("coord_beat") is None
+    assert fault.maybe_inject("replication") == "wal_torn"
+    with pytest.raises(ValueError):
+        fault.parse_fault_spec("coordinator_die@step:1")
+    with pytest.raises(ValueError):
+        fault.parse_fault_spec("wal_torn@ckpt:1")
+
+
+def _two_masters():
+    p1, p2 = _free_port(), _free_port()
+    prim = dist.TCPStore("127.0.0.1", p1, is_master=True, timeout=15)
+    standby = dist.TCPStore("127.0.0.1", p2, is_master=True, timeout=15)
+    return p1, p2, prim, standby
+
+
+def test_log_shipper_replicates_registry_ops():
+    """Tentpole unit: every mutating registry-scope op rides the WAL and
+    the shipper applies it onto the standby — sets verbatim, adds through
+    the claim protocol (re-shipping is idempotent), deletes removed."""
+    from paddle_tpu.distributed import FailoverStore, LogShipper
+    p1, p2, prim, standby = _two_masters()
+    fs = FailoverStore(f"127.0.0.1:{p1},127.0.0.1:{p2}", timeout=15,
+                       connect_deadline=2.0)
+    assert fs.replicated and fs.epoch == 0
+    sh = LogShipper(f"127.0.0.1:{p1}", f"127.0.0.1:{p2}", timeout=15)
+    fs.set("elastic/j/node/r/a", b"rec-a")
+    v = fs.add("elastic/j/join_seq", 1)
+    assert v == 1
+    fs.set("elastic/j/round/1", b"{}")
+    fs.delete_key("elastic/j/round/1")
+    assert sh.ship_once() == 4
+    assert standby.get("elastic/j/node/r/a") == b"rec-a"
+    assert int(standby.add("elastic/j/join_seq", 0)) == 1
+    assert not standby.check("elastic/j/round/1")
+    # idempotent: nothing new to ship, and re-applying the same add via
+    # its claim id cannot double-increment
+    assert sh.ship_once() == 0
+    assert int(standby.add("elastic/j/join_seq", 0)) == 1
+    assert sh.shipped_total == 4
+    prim.stop_server()
+    standby.stop_server()
+
+
+def test_writer_self_trims_wal_without_shipper(monkeypatch):
+    """Review-hardening: the WAL stays bounded even with NO shipper
+    consuming it (standby served on an unreachable host, or the
+    post-takeover promoted store) — the writer GCs the entry
+    _WRITER_TRIM_KEEP ops behind each append, claim/result pairs
+    included; a published shipper cursor gates the trim so a
+    live-but-lagging shipper is never gapped."""
+    from paddle_tpu.distributed import FailoverStore
+    monkeypatch.setattr(FailoverStore, "_WRITER_TRIM_KEEP", 8)
+    p1, p2, prim, standby = _two_masters()
+    fs = FailoverStore(f"127.0.0.1:{p1},127.0.0.1:{p2}", timeout=15,
+                       connect_deadline=2.0)
+    for i in range(20):
+        fs.set(f"elastic/j/k{i}", str(i))
+        assert fs.add("elastic/j/ctr", 1) == i + 1
+    head = int(prim.add("__wal/seq", 0))
+    assert head == 40
+    # no cursor published anywhere -> unconditional trim at the KEEP
+    assert not prim.check("__wal/1")
+    assert not prim.check(f"__wal/{head - 8}")
+    assert prim.check(f"__wal/{head}")
+    # trimmed adds lose their claim/result bookkeeping too
+    assert not prim.check(f"__wal/claim/{fs._writer}.1")
+    assert not prim.check(f"__wal/result/{fs._writer}.1")
+    prim.stop_server()
+    standby.stop_server()
+    # with a cursor published, the trim never passes it
+    p3, p4, prim2, standby2 = _two_masters()
+    fs2 = FailoverStore(f"127.0.0.1:{p3},127.0.0.1:{p4}", timeout=15,
+                        connect_deadline=2.0)
+    prim2.set("__wal/cursor/1", "5")
+    for i in range(20):
+        fs2.set(f"elastic/j/k{i}", str(i))
+        fs2.add("elastic/j/ctr", 1)
+    assert not prim2.check("__wal/5")  # at/below the cursor: trimmed
+    assert prim2.check("__wal/6")      # beyond it: preserved
+    prim2.stop_server()
+    standby2.stop_server()
+
+
+def test_promoted_standby_preserves_round_history():
+    """THE tentpole assertion, inverted from PR 4's empty-standby test:
+    with the shipper tailing, a promoted standby already holds the join
+    log, membership records and round history — on_failover becomes a
+    gap-filler, not a from-scratch rebuild."""
+    from paddle_tpu.distributed import (FailoverStore, LogShipper,
+                                        NodeRegistry)
+    p1, p2, prim, standby = _two_masters()
+    evts = []
+    fs = FailoverStore(f"127.0.0.1:{p1},127.0.0.1:{p2}", timeout=15,
+                       connect_deadline=2.0,
+                       on_failover=lambda s, i: evts.append(i))
+    reg = NodeRegistry(fs, "jobr", ttl=5.0)
+    reg.register("nodeA", {"ord": 0, "status": "idle", "round": 0})
+    reg.register("nodeB", {"ord": 1, "status": "idle", "round": 0})
+    no = reg.publish_round({"nodes": {"nodeA": 0, "nodeB": 1},
+                            "nproc": 2, "world": 4, "master": "x:1"})
+    assert no == 1
+    sh = LogShipper(f"127.0.0.1:{p1}", f"127.0.0.1:{p2}", timeout=15)
+    while sh.ship_once():
+        pass
+    prim.stop_server()  # primary host dies mid-round
+    reg.beat("nodeA", {"ord": 0, "status": "running", "round": 1})
+    assert evts == [1] and fs.incarnation == 1 and fs.epoch == 1
+    # round history, membership and join order SURVIVED the failover
+    assert reg.joined() == ["nodeA", "nodeB"]
+    assert reg.round_no() == 1
+    assert reg.round(1)["world"] == 4
+    assert reg.record("nodeB")["ord"] == 1
+    standby.stop_server()
+
+
+def test_fence_resolver_outranks_epoch_for_term_holder():
+    """Review-hardening: a writer whose fence_resolver affirms its
+    higher-level authority (the coordinator still holding its lease
+    term) ADOPTS a moved store epoch instead of deposing itself — the
+    shadow that took over a slow-but-alive primary must survive the
+    agents re-homing onto its store and bumping the epoch. A resolver
+    that denies (term lost) still raises."""
+    from paddle_tpu.distributed import FailoverStore, StoreFencedError
+    p1, p2, prim, standby = _two_masters()
+    fs = FailoverStore(f"127.0.0.1:{p1},127.0.0.1:{p2}", timeout=15,
+                       connect_deadline=2.0)
+    assert fs.epoch == 0
+    prim.add("__fence/epoch", 1)  # an agent re-homed and bumped it
+    holds = [True]
+    fs._fence_resolver = lambda: holds[0]
+    fs.set("elastic/j/lease", b"x")  # adopted, not deposed
+    assert fs.epoch == 1
+    prim.add("__fence/epoch", 1)
+    holds[0] = False  # term lost: the fence wins again
+    with pytest.raises(StoreFencedError):
+        fs.set("elastic/j/lease", b"y")
+    prim.stop_server()
+    standby.stop_server()
+
+
+def test_dead_candidate_fast_fails_to_standby():
+    """ISSUE satellite, timed: an op against a DEAD candidate (server
+    process gone -> connection refused) rotates to the standby bounded
+    by detection, not by the reconnect Backoff budget. Before the
+    fast-fail the same op burned ~3 connect-backoff rounds x the probe
+    deadline (~6-10s) before rotating; refused now surfaces
+    StoreConnectionRefused immediately and the whole failover — detect,
+    promote, epoch bump, replay the op — lands in well under 2s."""
+    from paddle_tpu.distributed import FailoverStore
+    p1, p2, prim, standby = _two_masters()
+    fs = FailoverStore(f"127.0.0.1:{p1},127.0.0.1:{p2}", timeout=15,
+                       connect_deadline=2.0)
+    fs.set("elastic/j/warm", b"1")  # homed on the primary, socket warm
+    prim.stop_server()
+    t0 = time.monotonic()
+    fs.set("elastic/j/after", b"2")
+    took = time.monotonic() - t0
+    assert fs.incarnation == 1 and fs.epoch == 1
+    assert standby.get("elastic/j/after") == b"2"
+    assert took < 2.0, f"dead-candidate failover took {took:.2f}s"
+    standby.stop_server()
+
+
+def test_quarantine_hits_survive_midwindow_rehome():
+    """ISSUE satellite: quarantine strikes recorded through the
+    replicated registry survive a mid-window primary death — the
+    promoted standby still sees the in-window strike and the NEXT
+    failure crosses the threshold, exactly as if the primary had
+    lived."""
+    from paddle_tpu.distributed import FailoverStore, LogShipper
+    from paddle_tpu.distributed.elastic import QuarantineList
+    p1, p2, prim, standby = _two_masters()
+    fs = FailoverStore(f"127.0.0.1:{p1},127.0.0.1:{p2}", timeout=15,
+                       connect_deadline=2.0)
+    q = QuarantineList(window_s=300.0, threshold=2)
+    q.record_failure("flaky", now=100.0)  # one strike, in window
+    fs.set("elastic/j/quarantine", json.dumps(q.to_dict(now=120.0)))
+    sh = LogShipper(f"127.0.0.1:{p1}", f"127.0.0.1:{p2}", timeout=15)
+    while sh.ship_once():
+        pass
+    prim.stop_server()  # primary dies mid-window
+    restored = QuarantineList().restore(
+        json.loads(fs.get("elastic/j/quarantine")), now=5000.0)
+    assert fs.incarnation == 1  # the read itself re-homed
+    # the surviving strike still counts: one more in-window failure
+    # quarantines on the successor's clock
+    assert restored.record_failure("flaky", now=5100.0) is True
+    assert restored.quarantined() == ["flaky"]
+    standby.stop_server()
+
+
+def test_deposed_primary_fence_rejected_with_ring_marker():
+    """Acceptance: a writer still pinned to the pre-failover epoch (the
+    deposed coordinator on the partitioned primary) gets its mutating
+    ops rejected with StoreFencedError, and the flight-recorder ring
+    names the old epoch the stray write came from."""
+    from paddle_tpu.distributed import FailoverStore, StoreFencedError
+    p1, p2, prim, standby = _two_masters()
+    flight.enable(capacity=16)
+    deposed = FailoverStore(f"127.0.0.1:{p1},127.0.0.1:{p2}", timeout=15,
+                            connect_deadline=2.0)
+    other = FailoverStore(f"127.0.0.1:{p1},127.0.0.1:{p2}", timeout=15,
+                          connect_deadline=2.0)
+    assert deposed.epoch == 0
+    # `other` is partitioned from the (alive) primary and fails over:
+    # the promotion bumps the fence epoch on the standby and the sweep
+    # pushes it back onto the still-alive primary
+    other._failover_locked(RuntimeError("partition"))
+    assert other.epoch == 1
+    deadline = time.monotonic() + 10
+    while int(prim.add("__fence/epoch", 0)) < 1:
+        assert time.monotonic() < deadline, "fence sweep never landed"
+        time.sleep(0.05)
+    # the deposed writer's late write is rejected, not silently applied
+    with pytest.raises(StoreFencedError):
+        deposed.set("elastic/j/round/2", b"stray")
+    assert not prim.check("elastic/j/round/2")
+    kinds = [e["kind"] for e in flight.get_recorder().entries()]
+    assert "store_fenced" in kinds
+    entry = [e for e in flight.get_recorder().entries()
+             if e["kind"] == "store_fenced"][-1]
+    assert entry["old_epoch"] == 0
+    assert entry["new_epoch"] == 1
+    prim.stop_server()
+    standby.stop_server()
+
+
+def test_failover_rehome_concurrent_writers_exactly_once(monkeypatch):
+    """Satellite: two writers race mutating adds across the failover
+    window. Exactly-once at store granularity: no op applied twice (the
+    claim protocol), no acked op lost (returned counter values are
+    strictly unique and the promoted standby's final value equals the
+    total number of successful adds)."""
+    import threading as _threading
+    from paddle_tpu.distributed import FailoverStore, LogShipper
+    monkeypatch.setenv("PADDLE_TPU_STORE_FAILOVER_DEADLINE", "15")
+    monkeypatch.setenv("PADDLE_TPU_STORE_PROBE_DEADLINE", "1")
+    p1, p2, prim, standby = _two_masters()
+    eps = f"127.0.0.1:{p1},127.0.0.1:{p2}"
+    wa = FailoverStore(eps, timeout=15, connect_deadline=2.0)
+    wb = FailoverStore(eps, timeout=15, connect_deadline=2.0)
+    sh = LogShipper(f"127.0.0.1:{p1}", f"127.0.0.1:{p2}", timeout=15)
+    key, per_phase = "elastic/j/ctr", 8
+    results = {"a": [], "b": []}
+
+    def adds(fs, name):
+        for _ in range(per_phase):
+            results[name].append(fs.add(key, 1))
+
+    def race():
+        ts = [_threading.Thread(target=adds, args=(fs, nm))
+              for fs, nm in ((wa, "a"), (wb, "b"))]
+        [t.start() for t in ts]
+        [t.join(60) for t in ts]
+
+    race()
+    while sh.ship_once():  # drain the WAL: lag 0 before the kill
+        pass
+    # one mid-op-failover retry candidate: an op whose ack was lost
+    lost_ack = wa.add(key, 1, _opid="race.lost.1")
+    while sh.ship_once():
+        pass
+    prim.stop_server()  # primary dies; both writers race the re-home
+    race()
+    # the retried op ADOPTS the shipped result instead of re-applying
+    assert wa.add(key, 1, _opid="race.lost.1") == lost_ack
+    total = 2 * per_phase * 2 + 1
+    vals = results["a"] + results["b"] + [lost_ack]
+    assert len(vals) == total
+    assert len(set(vals)) == total, "an op was applied twice or lost"
+    assert int(wa.add(key, 0)) == total
+    assert wa.incarnation == 1 and wb.incarnation == 1
+    assert wa.epoch == 1 and wb.epoch == 1
+    standby.stop_server()
+
+
+def test_replication_disabled_single_candidate_noop():
+    """Acceptance: with a single --master candidate replication is OFF
+    and the store hot path is the same one delegated call as before —
+    structurally asserted by recording every key the underlying client
+    sees (no __wal/__fence traffic, no extra ops)."""
+    from paddle_tpu.distributed import FailoverStore
+    port = _free_port()
+    master = dist.TCPStore("127.0.0.1", port, is_master=True, timeout=15)
+    fs = FailoverStore(f"127.0.0.1:{port}", timeout=15,
+                       connect_deadline=2.0)
+    assert fs.replicated is False
+
+    class Recorder:
+        def __init__(self, inner):
+            self._inner, self.keys = inner, []
+
+        def __getattr__(self, name):
+            fn = getattr(self._inner, name)
+
+            def wrap(key, *a, **kw):
+                self.keys.append(key)
+                return fn(key, *a, **kw)
+            return wrap
+
+    rec = Recorder(fs._store)
+    fs._store = rec
+    fs.set("elastic/j/k", b"v")
+    fs.add("elastic/j/ctr", 1)
+    fs.get("elastic/j/k")
+    fs.check("elastic/j/k")
+    assert rec.keys == ["elastic/j/k", "elastic/j/ctr", "elastic/j/k",
+                        "elastic/j/k"]
+    master.stop_server()
+
+
+def test_replication_env_kill_switch(monkeypatch):
+    """PADDLE_TPU_STORE_REPLICATION=0 disables the WAL even with a
+    standby candidate; and the counter-READ idiom (add amount=0, the
+    registry poll hot path) never touches the WAL when replication is
+    on."""
+    from paddle_tpu.distributed import FailoverStore
+    p1, p2, prim, standby = _two_masters()
+    monkeypatch.setenv("PADDLE_TPU_STORE_REPLICATION", "0")
+    fs_off = FailoverStore(f"127.0.0.1:{p1},127.0.0.1:{p2}", timeout=15,
+                           connect_deadline=2.0)
+    assert fs_off.replicated is False
+    monkeypatch.delenv("PADDLE_TPU_STORE_REPLICATION")
+    fs_on = FailoverStore(f"127.0.0.1:{p1},127.0.0.1:{p2}", timeout=15,
+                          connect_deadline=2.0)
+    head0 = int(prim.add("__wal/seq", 0))
+    for _ in range(5):
+        fs_on.add("elastic/j/round_seq", 0)  # poll reads: no WAL append
+    assert int(prim.add("__wal/seq", 0)) == head0
+    fs_on.add("elastic/j/round_seq", 1)      # a real mutation: one entry
+    assert int(prim.add("__wal/seq", 0)) == head0 + 1
+    prim.stop_server()
+    standby.stop_server()
+
+
+def test_wal_torn_injection_and_gap_fill_heals():
+    """``wal_torn@replication`` tears exactly one shipped application on
+    the standby (truncated set payload); the writer's own post-failover
+    re-set — the on_failover gap-filler path — heals it."""
+    from paddle_tpu.distributed import FailoverStore, LogShipper
+    p1, p2, prim, standby = _two_masters()
+    fault.set_fault_spec("wal_torn@replication:1")
+    fs = FailoverStore(f"127.0.0.1:{p1},127.0.0.1:{p2}", timeout=15,
+                       connect_deadline=2.0)
+    sh = LogShipper(f"127.0.0.1:{p1}", f"127.0.0.1:{p2}", timeout=15)
+    fs.set("elastic/j/node/r/a", b"full-record-payload")
+    fs.set("elastic/j/node/r/b", b"other")
+    assert sh.ship_once() == 2
+    assert sh.torn_total == 1
+    torn = standby.get("elastic/j/node/r/a")
+    assert torn != b"full-record-payload" \
+        and torn == b"full-record-payload"[:len(torn)]
+    assert standby.get("elastic/j/node/r/b") == b"other"
+    prim.stop_server()
+    fs.set("elastic/j/node/r/a", b"full-record-payload")  # gap-filler
+    assert fs.incarnation == 1
+    assert standby.get("elastic/j/node/r/a") == b"full-record-payload"
+    standby.stop_server()
+
+
+@pytest.mark.slow
+def test_registry_poll_distinguishes_rehomed_from_gone(monkeypatch):
+    """Satellite: NodeRegistry.poll() through a clean failover returns
+    normally (incarnation moved, no raise) — only an exhausted candidate
+    list raises StoreCandidatesExhausted, the one type the node agent's
+    orphan self-fence clock arms on. (@slow: the exhaustion raise must
+    burn the real retry/probe budgets; the fast tier covers both halves
+    end-to-end via the orphan-fence and store-failover launcher tests.)"""
+    from paddle_tpu.distributed import (FailoverStore, NodeRegistry,
+                                        StoreCandidatesExhausted)
+    monkeypatch.setenv("PADDLE_TPU_STORE_FAILOVER_DEADLINE", "3")
+    monkeypatch.setenv("PADDLE_TPU_STORE_PROBE_DEADLINE", "1")
+    p1, p2, prim, standby = _two_masters()
+    # short op timeout: a dead-candidate op must fail fast, not burn its
+    # full retry budget, for the exhaustion raise to be test-sized
+    fs = FailoverStore(f"127.0.0.1:{p1},127.0.0.1:{p2}", timeout=3,
+                       connect_deadline=2.0)
+    reg = NodeRegistry(fs, "jobp", ttl=2.0)
+    assert reg.poll() == (False, 0)
+    prim.stop_server()
+    # clean failover: poll returns NORMALLY — a healthy agent must not
+    # arm its self-fence clock here
+    assert reg.poll() == (False, 0)
+    assert fs.incarnation == 1
+    standby.stop_server()
+    with pytest.raises(StoreCandidatesExhausted):
+        reg.poll()
+
+
+def test_quarantine_ledger_checkpoint_roundtrip():
+    """Coordinator-shadow state: the quarantine ledger serializes its
+    monotonic stamps as ages and the restoring shadow re-anchors them —
+    quarantined nodes stay excluded and in-window failures keep counting
+    toward the threshold across the takeover."""
+    from paddle_tpu.distributed.elastic import QuarantineList
+    q = QuarantineList(window_s=300.0, threshold=2)
+    q.record_failure("flaky", now=100.0)
+    q.record_failure("flaky", now=110.0)   # -> quarantined
+    q.record_failure("wobbly", now=115.0)  # one strike, in window
+    assert q.is_quarantined("flaky") and q.hits == 1
+    state = q.to_dict(now=120.0)
+    shadow = QuarantineList().restore(state, now=5000.0)
+    assert shadow.quarantined() == ["flaky"]
+    assert shadow.hits == 1
+    assert shadow.window_s == 300.0 and shadow.threshold == 2
+    # wobbly's strike survived with its age intact: one more failure
+    # inside the window quarantines it on the SHADOW's clock
+    assert shadow.record_failure("wobbly", now=5100.0) is True
+    assert shadow.quarantined() == ["flaky", "wobbly"]
+    # an out-of-window second strike would NOT have (age re-anchored)
+    fresh = QuarantineList().restore(state, now=5000.0)
+    assert fresh.record_failure("wobbly", now=5500.0) is False
+
+
+def test_replication_lag_gauge_through_registry():
+    """store_replication_lag rides the PR-5 metrics registry from
+    ship_once (head - acked) with shipped/torn counters."""
+    from paddle_tpu.distributed import FailoverStore, LogShipper
+    from paddle_tpu.observability import metrics as obsm
+    p1, p2, prim, standby = _two_masters()
+    reg = obsm.enable(out_dir=None, interval_s=0)
+    try:
+        fs = FailoverStore(f"127.0.0.1:{p1},127.0.0.1:{p2}", timeout=15,
+                           connect_deadline=2.0)
+        sh = LogShipper(f"127.0.0.1:{p1}", f"127.0.0.1:{p2}", timeout=15)
+        fs.set("elastic/j/a", b"1")
+        fs.set("elastic/j/b", b"2")
+        sh.ship_once()
+        snap = reg.snapshot()
+        assert snap["gauges"]["store_replication_lag"] == 0.0
+        assert snap["counters"]["store_wal_shipped_total"] == 2
+        assert "store_wal_torn_total" not in snap["counters"]
+    finally:
+        obsm.disable()
+    prim.stop_server()
+    standby.stop_server()
+
+
+def test_coordinator_role_usage_errors(tmp_path, capfd):
+    """--coordinator_role outside --nnodes MIN:MAX, or without a standby
+    --master candidate, is a mapped usage error (64) with a hint."""
+    from paddle_tpu.distributed.launch.main import launch
+    script = tmp_path / "w.py"
+    script.write_text("print('hi')\n")
+    rc = launch(["--np", "1", "--coordinator_role", "shadow",
+                 "--master", f"127.0.0.1:{_free_port()}",
+                 "--log_dir", str(tmp_path / "l1"), str(script)])
+    assert rc == fault.EXIT_USAGE
+    rc = launch(["--nnodes", "2:2", "--coordinator_role", "primary",
+                 "--master", f"127.0.0.1:{_free_port()}",
+                 "--log_dir", str(tmp_path / "l2"), str(script)])
+    assert rc == fault.EXIT_USAGE
+    err = capfd.readouterr().err
+    assert "only applies to --nnodes" in err
+    assert "needs a standby --master candidate" in err
+
+
+@pytest.mark.slow
+def test_coordinator_die_shadow_adopts_without_relaunch(tmp_path):
+    """THE coordinator-loss acceptance run: a primary coordinator (with
+    its in-process primary registry) is SIGKILLed mid-round by injected
+    ``coordinator_die``; the shadow coordinator on the "second host"
+    re-homes to its own standby registry (already replicated), watches
+    the lease expire, adopts the published round spec and supervises the
+    SAME round to completion — zero re-rendezvous, zero worker
+    relaunches. The agents' orphan window was the takeover budget, not a
+    suicide pact."""
+    script = _node_script(tmp_path)
+    p1, p2 = _free_port(), _free_port()
+    master = f"127.0.0.1:{p1},127.0.0.1:{p2}"
+    log_dir = str(tmp_path / "logs")
+    env = _clean_env({
+        "PADDLE_TPU_STORE_FAILOVER_DEADLINE": "10",
+        "PADDLE_TPU_STORE_PROBE_DEADLINE": "1",
+        "NW_MODE": "sleep", "NW_SLEEP": "18",
+    })
+    prim_env = dict(env, PADDLE_TPU_FAULTS="coordinator_die@coord_beat:10")
+    base = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+            "--nnodes", "2:2", "--nproc_per_node", "1",
+            "--master", master, "--elastic_ttl", "2",
+            "--terminate_grace", "2", "--log_dir", log_dir]
+    shadow = subprocess.Popen(
+        base + ["--coordinator_role", "shadow", "--local_agents", "0",
+                script],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, cwd=REPO)
+    try:
+        time.sleep(1.0)
+        prim = subprocess.Popen(
+            base + ["--coordinator_role", "primary", "--local_agents",
+                    "2", script],
+            env=prim_env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True, cwd=REPO)
+        pout, _ = prim.communicate(timeout=120)
+        sout, _ = shadow.communicate(timeout=180)
+    finally:
+        for p in (shadow, locals().get("prim")):
+            if p is not None and p.poll() is None:
+                p.kill()
+    assert prim.returncode == -signal.SIGKILL, pout[-2000:]
+    die = re.search(r"COORDINATOR_DIE ([\d.]+)", pout)
+    assert die, pout[-2000:]
+    assert shadow.returncode == 0, sout[-3000:]
+    adopt = re.search(r"SHADOW_ADOPTED round=1 term=(\d+) wall=([\d.]+)",
+                      sout)
+    assert adopt, sout[-3000:]
+    takeover_s = float(adopt.group(2)) - float(die.group(1))
+    assert 0 < takeover_s < 60, takeover_s
+    assert "resuming supervision of live agents without re-rendezvous" \
+        in sout
+    assert "all 2 node(s) finished" in sout
+    # the SAME round ran to completion: no round 2, no worker relaunch
+    assert "round 2" not in sout and "round 2" not in pout
+    assert glob.glob(os.path.join(log_dir, "workerlog.*.restart*")) == []
+    for grank in range(2):
+        assert "NW_DONE" in _read_worker_logs(log_dir, grank)
+    # no agent fenced itself during the takeover window
+    for nid in ("node0", "node1"):
+        assert "AGENT_ORPHANED" not in _agent_log(tmp_path, nid)
+
+
+def test_transient_wobble_reconnects_without_promotion():
+    """Review-hardening: a transient op failure against a HEALTHY active
+    store heals on a fresh connection — no promotion, no incarnation
+    bump, no fence-epoch advance. One client's socket wobble must never
+    depose a live primary and fence every other writer."""
+    from paddle_tpu.distributed import FailoverStore
+    p1, p2, prim, standby = _two_masters()
+    evts = []
+    fs = FailoverStore(f"127.0.0.1:{p1},127.0.0.1:{p2}", timeout=15,
+                       connect_deadline=2.0,
+                       on_failover=lambda s, i: evts.append(i))
+    fs.set("elastic/j/k", b"v1")
+
+    class Wobble:  # a broken cached client; the endpoint is fine
+        def __getattr__(self, name):
+            def boom(*a, **kw):
+                raise RuntimeError("connection reset by peer")
+            return boom
+
+    fs._store = Wobble()
+    fs.set("elastic/j/k", b"v2")          # heals via reconnect
+    assert fs.incarnation == 0 and fs.epoch == 0 and evts == []
+    assert int(prim.add("__fence/epoch", 0)) == 0  # primary not fenced
+    assert prim.get("elastic/j/k") == b"v2"
+    prim.stop_server()
+    standby.stop_server()

@@ -148,6 +148,7 @@ def test_sharded_flash_degenerate_mesh_returns_impl():
     assert sharded_flash_attention(mesh, impl=impl) is impl
 
 
+@pytest.mark.slow  # ~8s: tier-1 sits at the 870s budget edge (slowest_tests gate); full coverage stays in the slow suite
 def test_gpt_attention_uses_sharded_flash_under_tp():
     """GPT's training attention routes through the shard_map'd flash path
     when a TP mesh is active and the kernel is eligible — asserted by

@@ -30,6 +30,7 @@ def test_fused_linear_matches_matmul():
         rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow  # ~8s: tier-1 sits at the 870s budget edge (slowest_tests gate); full coverage stays in the slow suite
 def test_encoder_layer_trains():
     paddle.seed(1)
     layer = inn.FusedTransformerEncoderLayer(32, 4, 64, dropout_rate=0.0)

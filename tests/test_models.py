@@ -132,6 +132,7 @@ def test_bert_forward_and_to_static_compile():
     np.testing.assert_allclose(out.numpy(), eager, rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow  # ~8s: tier-1 sits at the 870s budget edge (slowest_tests gate); full coverage stays in the slow suite
 def test_bert_mlm_trains():
     from paddle_tpu.models import BertForMaskedLM, bert_tiny
     paddle.seed(1)

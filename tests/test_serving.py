@@ -6,6 +6,9 @@ continuous-batching admission/eviction/backpressure, the no-decode-gap
 acceptance, streaming callbacks, and the metrics-registry rows. Load/soak
 runs live in test_serving_parity.py behind ``@pytest.mark.slow``.
 """
+import os
+import time
+
 import numpy as np
 import pytest
 
@@ -489,6 +492,33 @@ def test_engine_background_thread_and_close(tiny_model):
         eng.submit([1, 2], max_new_tokens=2)
 
 
+def test_serve_loop_crash_fails_waiters_and_marks_unhealthy(tiny_model):
+    """ISSUE satellite: an exception escaping the background serve loop
+    must not leave submitted requests waiting forever — every queued +
+    in-flight waiter fails with the ACTUAL error, and the engine goes
+    unhealthy so later submit()s fail fast naming the crash."""
+    from paddle_tpu.serving import EngineClosed
+    eng = _engine(tiny_model)
+    boom = RuntimeError("decode step exploded")
+
+    def broken_schedule(*a, **k):
+        raise boom
+
+    eng.scheduler.schedule = broken_schedule
+    eng.start()
+    req = eng.submit([5, 4, 3], max_new_tokens=4)
+    with pytest.raises(RuntimeError, match="decode step exploded"):
+        req.result(timeout=30)
+    # unhealthy, not silently idle: immediate fail-fast naming the crash
+    t0 = time.monotonic()
+    with pytest.raises(EngineClosed, match="decode step exploded"):
+        eng.submit([1, 2], max_new_tokens=2)
+    assert time.monotonic() - t0 < 1.0
+    with pytest.raises(EngineClosed, match="unhealthy"):
+        eng.step()
+    eng.close()  # idempotent after a crash
+
+
 def test_engine_eos_stops_early(tiny_model):
     """eos emitted by the model freezes the row and frees its slot."""
     eng = _engine(tiny_model)
@@ -589,3 +619,108 @@ def test_engine_sampling_request(tiny_model):
                       top_k=20)
     assert len(t1) == 5
     assert all(0 <= t < tiny_model.config.vocab_size for t in t1)
+
+
+# --------------------------------------- graceful shutdown (ISSUE 10)
+
+def test_scheduler_begin_shutdown_names_queued_keeps_inflight():
+    """begin_shutdown fails only the QUEUED requests with the named
+    retryable EngineShuttingDown status; in-flight ones stay active for
+    the drain, and later submits raise the same named status."""
+    from paddle_tpu.serving import EngineShuttingDown, QueueFull
+    sched = _mk_sched()
+    r1 = _req(4)
+    sched.submit(r1)
+    sched.schedule()                       # r1 in flight
+    r2 = _req(4)
+    sched.submit(r2)                       # r2 queued
+    assert [r.request_id for r in sched.begin_shutdown()] \
+        == [r2.request_id]
+    with pytest.raises(EngineShuttingDown):
+        r2.result(timeout=1)
+    assert r1.state == "active"            # kept for the drain
+    with pytest.raises(EngineShuttingDown):
+        sched.submit(_req(4))
+    # the final close fails the drain stragglers with the same status
+    sched.close()
+    with pytest.raises(EngineShuttingDown):
+        r1.result(timeout=1)
+    assert sched.allocator.used_pages == 0
+
+
+def test_engine_graceful_shutdown_drains_inflight(tiny_model):
+    """SIGTERM-grade drain: in-flight decodes run to completion, queued
+    requests fail with EngineShuttingDown, shutdown is idempotent and
+    close() afterwards is a no-op."""
+    from paddle_tpu.serving import EngineShuttingDown
+    eng = _engine(tiny_model)              # max_slots=2
+    r1 = eng.submit([1, 2, 3], max_new_tokens=4)
+    r2 = eng.submit([4, 5, 6], max_new_tokens=4)
+    eng.step()                             # both admitted into slots
+    r3 = eng.submit([7, 8], max_new_tokens=2)  # queued behind full slots
+    out = eng.shutdown(drain_s=60.0)
+    assert out["failed_queued"] == 1 and out["failed_inflight"] == 0
+    assert out["drained_tokens"] > 0
+    assert len(r1.result(timeout=1)) == 4
+    assert len(r2.result(timeout=1)) == 4
+    with pytest.raises(EngineShuttingDown):
+        r3.result(timeout=1)
+    with pytest.raises(EngineShuttingDown):
+        eng.submit([1], max_new_tokens=1)
+    assert eng.shutdown() == {"drained_tokens": 0, "failed_queued": 0,
+                              "failed_inflight": 0}
+    eng.close()                            # no-op after shutdown
+
+
+def test_engine_shutdown_deadline_fails_inflight_and_flushes(tiny_model,
+                                                             tmp_path):
+    """A zero drain budget fails the in-flight request with the named
+    status (naming the deadline) and still flushes the serving metrics
+    JSONL before returning."""
+    import json as _json
+    from paddle_tpu.observability import metrics as obsm
+    from paddle_tpu.serving import EngineShuttingDown
+    reg = obsm.enable(out_dir=str(tmp_path), interval_s=0)
+    try:
+        eng = _engine(tiny_model, registry=reg)
+        req = eng.submit([5, 6, 7, 8], max_new_tokens=50)
+        eng.step()                         # in flight, far from done
+        out = eng.shutdown(drain_s=0.0)
+        assert out["failed_inflight"] == 1
+        with pytest.raises(EngineShuttingDown) as ei:
+            req.result(timeout=1)
+        assert "drain deadline" in str(ei.value)
+        files = list(tmp_path.glob("metrics.*.jsonl"))
+        assert files, "shutdown must flush the metrics JSONL"
+        rows = [_json.loads(l) for l in
+                files[0].read_text().splitlines() if l.strip()]
+        assert any("serving_requests_total" in k
+                   for r in rows for k in r.get("counters", {}))
+    finally:
+        obsm.disable()
+
+
+def test_engine_install_sigterm_drains_and_exits_75(tiny_model,
+                                                    monkeypatch):
+    """install_sigterm wires the training-tier preemption convention:
+    SIGTERM -> graceful drain -> exit 75 (resumable), through the one
+    fault.install_preemption_handler path."""
+    import signal as _signal
+    from paddle_tpu.distributed import fault as _fault
+    exits = []
+    monkeypatch.setattr(_fault.os, "_exit",
+                        lambda rc: exits.append(rc))
+    prev = _signal.getsignal(_signal.SIGTERM)
+    try:
+        eng = _engine(tiny_model)
+        assert eng.install_sigterm(drain_s=30.0) is True
+        req = eng.submit([3, 1, 4], max_new_tokens=3)
+        eng.step()
+        os.kill(os.getpid(), _signal.SIGTERM)
+        deadline = time.time() + 30
+        while not exits and time.time() < deadline:
+            time.sleep(0.05)
+        assert exits == [_fault.EXIT_PREEMPT]
+        assert len(req.result(timeout=1)) == 3  # drained, not dropped
+    finally:
+        _signal.signal(_signal.SIGTERM, prev)

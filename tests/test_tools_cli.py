@@ -99,3 +99,35 @@ def test_slowest_tests_parser_and_cli(tmp_path, capsys):
     empty = tmp_path / "empty.log"
     empty.write_text("all good\n")
     assert main([str(empty)]) == 1
+
+
+def test_slowest_tests_budget_gate(tmp_path, capsys):
+    """ISSUE 10 satellite: --fail-over-pct turns the durations summary
+    into a post-verify gate — rc 3 when the measured wall crosses the
+    threshold, rc 0 under it, and rc 3 for a durations-bearing log whose
+    summary line never printed (pytest was timeout-killed: that IS the
+    over-budget case)."""
+    from paddle_tpu.tools.slowest_tests import main
+    log = tmp_path / "t1.log"
+    log.write_text(
+        "= slowest durations =\n"
+        "10.00s call     tests/test_big.py::test_heavy\n"
+        "850 passed in 840.00s (0:14:00)\n")
+    # 840 > 95% of 870 (826.5) -> gate trips
+    assert main([str(log), "--budget", "870",
+                 "--fail-over-pct", "95"]) == 3
+    assert "BUDGET GATE FAILED" in capsys.readouterr().err
+    # comfortably under: gate passes and says so
+    assert main([str(log), "--budget", "870",
+                 "--fail-over-pct", "99"]) == 0
+    assert "budget gate ok" in capsys.readouterr().out
+    # no gate flag: informational only, over-budget wall still rc 0
+    assert main([str(log), "--budget", "870"]) == 0
+    killed = tmp_path / "killed.log"
+    killed.write_text(
+        "= slowest durations =\n"
+        "10.00s call     tests/test_big.py::test_heavy\n")
+    assert main([str(killed), "--budget", "870",
+                 "--fail-over-pct", "95"]) == 3
+    err = capsys.readouterr().err
+    assert "no summary line" in err

@@ -426,6 +426,7 @@ class BucketedGradSync:
         # entry — the t_issue→t_wait window is the overlap the in-run
         # sampler credits
         task = _StreamTask(synced, entry,
+                           # tpu-lint: ok[HS001] finalizer runs at wait(), backward end — the device-true completion stamp, not a per-fire sync
                            finalizer=lambda res: jax.block_until_ready(res))
         self.fired += 1
         self._tasks.append((metas, task))

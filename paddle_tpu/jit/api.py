@@ -530,6 +530,7 @@ class StaticFunction:
             rng_spec = _random.next_key_spec()
         else:
             rng_spec = _idle_rng_spec()
+        # tpu-lint: ok[HS002] operands are python floats — host numpy rides into pjit with no device fetch (PR 7 zero-eager-op design)
         lrs = np.asarray([opt.get_lr() for opt in opts], np.float32)
         state_in = [t._data for t in params] + [b._data for b in buffers] + \
             [cont[k] for cont, k in slots]

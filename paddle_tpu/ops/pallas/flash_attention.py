@@ -362,7 +362,7 @@ def sharded_flash_attention(mesh, causal=True, scale=None,
     spec = P(data_axis, None, model_axis, None)
     return jax.jit(jax.shard_map(impl, mesh=mesh,
                                  in_specs=(spec, spec, spec),
-                                 out_specs=spec, check_rep=False))
+                                 out_specs=spec, check_vma=False))
 
 
 def flash_attention_bshd(q, k, v, causal=True, scale=None, block_q=None,

@@ -94,7 +94,7 @@ def sharded_paged_prefill(mesh, axis_name="model", scale=None):
     )
     out_specs = P(None, None, axis_name, None)
     return jax.jit(jax.shard_map(_impl, mesh=mesh, in_specs=in_specs,
-                                 out_specs=out_specs, check_rep=False))
+                                 out_specs=out_specs, check_vma=False))
 
 
 def sharded_paged_attention(mesh, axis_name="model", backend="xla",
@@ -121,7 +121,7 @@ def sharded_paged_attention(mesh, axis_name="model", backend="xla",
     )
     out_specs = P(None, axis_name, None)
     return jax.jit(jax.shard_map(_impl, mesh=mesh, in_specs=in_specs,
-                                 out_specs=out_specs, check_rep=False))
+                                 out_specs=out_specs, check_vma=False))
 
 
 def resolve_backend(requested=None):

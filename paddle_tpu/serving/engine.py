@@ -445,6 +445,7 @@ class ServingEngine:
             # prompt complete: last chunk's final logit row is the first
             # generated token (TTFT ends here), and the prompt's full
             # pages become shareable for future prefix-cache hits
+            # tpu-lint: ok[HS002] designed sync: host-side sampling consumes this logit row once per completed prompt
             row = np.asarray(logits_arr[i, take - 1])
             tok = _select_token(row, req)
             first = not req.generated
@@ -486,6 +487,7 @@ class ServingEngine:
                 self.kv.write_prefill(layer, ks[layer][i],
                                       vs[layer][i], req.pages, ln)
             req.num_cached = ln
+            # tpu-lint: ok[HS002] designed sync: host-side sampling consumes this logit row once per prefilled request
             row = np.asarray(logits_arr[i, ln - 1])
             tok = _select_token(row, req)
             first = not req.generated
@@ -525,7 +527,9 @@ class ServingEngine:
             self._param_arrays, jnp.asarray(tokens),
             jnp.asarray(positions), jnp.asarray(bt),
             list(self.kv.k), list(self.kv.v))
+        # tpu-lint: ok[HS002] designed sync: ONE batched token fetch per decode round feeds host-side sampling
         nxt = np.asarray(nxt)
+        # tpu-lint: ok[HS002] designed sync: the logits rows ride the same per-round host sampling fetch
         logits_np = np.asarray(last) \
             if (any_sampling or self.capture_logits is not None) else None
         if self.capture_logits is not None:

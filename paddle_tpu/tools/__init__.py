@@ -6,5 +6,11 @@ timeline merger. Exposed as python -m entry points:
 
     python -m paddle_tpu.tools.op_benchmark --op matmul --shapes 256x256,256x256
     python -m paddle_tpu.tools.merge_profiles rank*.json -o merged.json
+    python -m paddle_tpu.tools.slowest_tests /tmp/_t1.log --budget 870
+    python -m paddle_tpu.tools.analyze            # tpu-lint static analysis
+
+Nothing here may import jax at module level: the tpu-lint CLI boots this
+package with paddle_tpu's framework init SKIPPED (see the boot guard in
+paddle_tpu/__init__) so a full-tree scan stays parse-time only.
 """
 from . import merge_profiles, op_benchmark  # noqa: F401

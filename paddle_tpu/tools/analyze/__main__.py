@@ -1,0 +1,127 @@
+"""tpu-lint CLI — ``python -m paddle_tpu.tools.analyze``.
+
+Scans the paddle_tpu tree (or explicit paths) with the five rule families
+and gates against the checked-in ratcheting baseline: pre-existing findings
+ride, any NEW finding exits :data:`EXIT_NEW_FINDINGS` (7).  Designed to run
+as the post-verify gate next to ``tools/slowest_tests.py``.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+from . import DEFAULT_BASELINE
+from .engine import (EXIT_NEW_FINDINGS, all_rules, analyze_paths,
+                     diff_against_baseline, format_finding, load_baseline,
+                     package_root, save_baseline)
+
+
+def _list_rules() -> str:
+    rows = [("rule", "family", "severity", "title"), ("-" * 6,) * 4]
+    for rid, (family, sev, title) in sorted(all_rules().items()):
+        rows.append((rid, family, sev, title))
+    widths = [max(len(r[i]) for r in rows) for i in range(3)]
+    return "\n".join(
+        "  ".join(c.ljust(w) for c, w in zip(r[:3], widths)) + "  " + r[3]
+        for r in rows)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.tools.analyze",
+        description="tpu-lint: pure-AST static analysis for paddle_tpu "
+                    "(collective-order, trace-purity, host-sync, jax-compat, "
+                    "donation) with a ratcheting baseline gate.")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to scan (default: the paddle_tpu "
+                         "package root)")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline JSON to ratchet against")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding; exit 7 when any exist")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline from this scan's findings")
+    ap.add_argument("--families", default=None,
+                    help="comma-separated family slugs to run (default all)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit findings as one JSON object on stdout")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule table and exit")
+    ap.add_argument("--assert-no-jax", action="store_true",
+                    help="fail if jax was imported into this process "
+                         "(CI guard for the parse-only contract)")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        print(_list_rules())
+        return 0
+
+    paths = args.paths or [package_root()]
+    families = None
+    if args.families:
+        families = {f.strip() for f in args.families.split(",") if f.strip()}
+        known = {fam for fam, _sev, _t in all_rules().values()} \
+            - {"suppression", "engine"}
+        bad = families - known
+        if bad:
+            print(f"tpu-lint: unknown families {sorted(bad)} — known: "
+                  f"{sorted(known)}", file=sys.stderr)
+            return 2
+        if args.update_baseline:
+            print("tpu-lint: --update-baseline with --families would "
+                  "rewrite the baseline from a PARTIAL scan, deleting "
+                  "every other family's entries — run it unfiltered",
+                  file=sys.stderr)
+            return 2
+    t0 = time.perf_counter()
+    findings = analyze_paths(paths, families=families)
+    elapsed = time.perf_counter() - t0
+
+    if args.update_baseline:
+        try:
+            save_baseline(args.baseline, findings)
+        except ValueError as e:
+            print(f"tpu-lint: {e}", file=sys.stderr)
+            return 2
+        print(f"tpu-lint: baseline updated with {len(findings)} finding(s) "
+              f"-> {args.baseline}")
+        return 0
+
+    if args.no_baseline or not os.path.exists(args.baseline):
+        new, old = list(findings), []
+        if not args.no_baseline:
+            print(f"tpu-lint: baseline {args.baseline} missing — treating "
+                  "every finding as new", file=sys.stderr)
+    else:
+        new, old = diff_against_baseline(findings, load_baseline(args.baseline))
+
+    if args.as_json:
+        out = {
+            "elapsed_s": round(elapsed, 3),
+            "scanned": paths,
+            "new": [vars(f) for f in new],
+            "preexisting": [vars(f) for f in old],
+        }
+        print(json.dumps(out, indent=1, sort_keys=True))
+    else:
+        for f in old:
+            print(format_finding(f))
+        for f in new:
+            print(format_finding(f, new=True))
+        print(f"tpu-lint: {len(findings)} finding(s), {len(new)} new vs "
+              f"baseline, scanned in {elapsed:.2f}s")
+
+    if args.assert_no_jax and "jax" in sys.modules:
+        print("tpu-lint: jax was imported during the scan — the analyzer "
+              "must stay parse-only. The jax-free boot is auto-detected "
+              "via /proc/self/cmdline (Linux); on hosts without procfs "
+              "run with PADDLE_TPU_LINT_BOOT=1", file=sys.stderr)
+        return 2
+    return EXIT_NEW_FINDINGS if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

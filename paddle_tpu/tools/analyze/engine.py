@@ -1,0 +1,405 @@
+"""tpu-lint engine — pure-AST static analysis over the paddle_tpu tree.
+
+The runtime correctness machinery (flight-recorder desync exit 21, watchdog
+hang post-mortem exit 19, the A/B kernel gates) diagnoses bug classes at run
+time; this engine catches the same classes BEFORE a run, on every PR, from
+nothing but the source text: it never imports jax (or paddle_tpu), so a full
+scan of the package costs parse time only and fits inside the tier-1 budget.
+
+Structure:
+
+* every rule family is a module exposing ``FAMILY`` (slug), ``RULES``
+  (id -> (severity, title)) and ``run(ctx) -> list[Finding]``;
+* :class:`FileContext` is parsed once per file and shared by all families
+  (AST with parent links, raw lines, suppression table, hot-path marker);
+* suppressions are ``# tpu-lint: ok[RULE] reason`` comments on the finding
+  line or the line above — RULE is a rule id or a family slug.  A
+  suppression without a reason is itself a finding (SUP001) and a
+  suppression matching nothing is flagged stale (SUP002), so the
+  annotation layer ratchets with the code;
+* the baseline (:func:`load_baseline` / :func:`diff_against_baseline`)
+  fingerprints findings by (file, rule, normalized source line) so line
+  drift never invalidates it, while any genuinely new finding does.
+"""
+from __future__ import annotations
+
+import ast
+import io
+import json
+import os
+import re
+import tokenize
+from collections import Counter
+from dataclasses import dataclass, field
+
+__all__ = [
+    "Finding", "FileContext", "analyze_paths", "analyze_file",
+    "iter_py_files", "load_baseline", "save_baseline",
+    "diff_against_baseline", "finding_key", "format_finding",
+    "FAMILIES", "all_rules", "EXIT_NEW_FINDINGS",
+]
+
+# distinct from the launcher's fault contract (17/19/21/43/64/75/76) and
+# from slowest_tests' budget gate (3)
+EXIT_NEW_FINDINGS = 7
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*tpu-lint:\s*ok\[([A-Za-z0-9_,\s-]+)\]\s*(.*?)\s*$")
+_HOT_MARK_RE = re.compile(r"#\s*tpu-lint:\s*hot-path\b")
+
+
+@dataclass
+class Finding:
+    file: str          # path relative to the repo/package parent when possible
+    line: int
+    col: int
+    rule: str          # e.g. "CO001"
+    family: str        # e.g. "collective-order"
+    severity: str      # "error" | "warning"
+    message: str
+    hint: str = ""
+    source_line: str = ""
+
+
+@dataclass
+class Suppression:
+    line: int
+    rules: tuple
+    reason: str
+    used: bool = False
+
+
+@dataclass
+class FileContext:
+    path: str
+    relpath: str            # stable id used in findings + baseline keys
+    pkg_relpath: str        # relative to the paddle_tpu package root, or ""
+    tree: ast.AST
+    lines: list
+    suppressions: dict = field(default_factory=dict)  # line -> Suppression
+    hot_file: bool = False
+    # FunctionDef/AsyncFunctionDef node -> dotted qualname
+    qualnames: dict = field(default_factory=dict)
+    nodes: list = field(default_factory=list)  # every AST node, DFS order
+
+    def src(self, node) -> str:
+        """One-line source snippet for a node (its first line, stripped)."""
+        try:
+            return self.lines[node.lineno - 1].strip()
+        except Exception:
+            return ""
+
+    def line_text(self, lineno: int) -> str:
+        try:
+            return self.lines[lineno - 1].strip()
+        except Exception:
+            return ""
+
+
+# ---- shared AST helpers (used by the rule modules) --------------------------
+
+def index_tree(tree: ast.AST):
+    """ONE DFS over the tree: attach parent links, collect the flat node
+    list the rule modules iterate (instead of each re-walking), and compute
+    dotted qualnames for named defs."""
+    nodes = []
+    qualnames = {}
+    stack = [(tree, "")]
+    while stack:
+        node, prefix = stack.pop()
+        nodes.append(node)
+        for child in ast.iter_child_nodes(node):
+            child._tpulint_parent = node  # type: ignore[attr-defined]
+            cprefix = prefix
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                cprefix = f"{prefix}.{child.name}" if prefix else child.name
+                if not isinstance(child, ast.ClassDef):
+                    qualnames[child] = cprefix
+            stack.append((child, cprefix))
+    return nodes, qualnames
+
+
+def parent(node):
+    return getattr(node, "_tpulint_parent", None)
+
+
+def parents(node):
+    p = parent(node)
+    while p is not None:
+        yield p
+        p = parent(p)
+
+
+def terminal_name(func) -> str:
+    """Last path component of a call target: ``a.b.c(...)`` -> ``"c"``."""
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return ""
+
+
+def dotted(node) -> str:
+    """Dotted source path of a Name/Attribute chain, "" when not a chain."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def enclosing_function(node):
+    for p in parents(node):
+        if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return p
+    return None
+
+
+# ---- file parsing -----------------------------------------------------------
+
+def _parse_suppressions(source: str):
+    """Suppression table from REAL comment tokens only — a `# tpu-lint:`
+    example inside a docstring or string literal never counts."""
+    sup = {}
+    hot = False
+    if "tpu-lint" not in source:
+        return sup, hot
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT or "tpu-lint" not in tok.string:
+                continue
+            i = tok.start[0]
+            if _HOT_MARK_RE.search(tok.string):
+                hot = True
+            m = _SUPPRESS_RE.search(tok.string)
+            if m:
+                rules = tuple(r.strip() for r in m.group(1).split(",")
+                              if r.strip())
+                sup[i] = Suppression(line=i, rules=rules,
+                                     reason=m.group(2).strip())
+    except tokenize.TokenError:
+        pass  # the ast parse already produced PARSE001 for real breakage
+    return sup, hot
+
+
+def build_context(path: str, relpath: str, pkg_relpath: str):
+    """Parse one file into a FileContext, or (None, error_finding)."""
+    try:
+        with open(path, "rb") as f:
+            source = f.read().decode("utf-8", errors="replace")
+        tree = ast.parse(source, filename=path)
+    except (SyntaxError, OSError) as e:
+        lineno = getattr(e, "lineno", 1) or 1
+        return None, Finding(
+            file=relpath, line=lineno, col=0, rule="PARSE001",
+            family="engine", severity="error",
+            message=f"file does not parse: {e}",
+            hint="tpu-lint needs parseable sources; fix the syntax error")
+    lines = source.splitlines()
+    nodes, qualnames = index_tree(tree)
+    sup, hot = _parse_suppressions(source)
+    ctx = FileContext(path=path, relpath=relpath, pkg_relpath=pkg_relpath,
+                      tree=tree, lines=lines, suppressions=sup, hot_file=hot,
+                      qualnames=qualnames, nodes=nodes)
+    return ctx, None
+
+
+# ---- rule registry ----------------------------------------------------------
+
+def _families():
+    from . import (rules_collective, rules_donation, rules_hostsync,
+                   rules_jaxcompat, rules_purity)
+    return [rules_collective, rules_purity, rules_hostsync,
+            rules_jaxcompat, rules_donation]
+
+
+FAMILIES = ("collective-order", "trace-purity", "host-sync", "jax-compat",
+            "donation")
+
+_SUP_RULES = {
+    "SUP001": ("error", "suppression without a reason"),
+    "SUP002": ("warning", "stale suppression (matches no finding)"),
+}
+
+
+def all_rules() -> dict:
+    """rule id -> (family, severity, title) for every registered rule."""
+    out = {}
+    for mod in _families():
+        for rid, (sev, title) in mod.RULES.items():
+            out[rid] = (mod.FAMILY, sev, title)
+    for rid, (sev, title) in _SUP_RULES.items():
+        out[rid] = ("suppression", sev, title)
+    out["PARSE001"] = ("engine", "error", "unparseable file")
+    return out
+
+
+# ---- suppression application ------------------------------------------------
+
+def _ran(ref: str, families) -> bool:
+    """Did the rule/family a suppression references actually run?  With a
+    family filter active, staleness is only judgeable for refs whose
+    family ran — a host-sync suppression is not stale just because a
+    collective-order-only scan produced no host-sync findings."""
+    if families is None:
+        return True
+    if ref in families:
+        return True
+    info = all_rules().get(ref)
+    return info is not None and info[0] in families
+
+
+def _apply_suppressions(ctx: FileContext, findings, families=None):
+    kept = []
+    for f in findings:
+        suppressed = False
+        for ln in (f.line, f.line - 1):
+            s = ctx.suppressions.get(ln)
+            if s and (f.rule in s.rules or f.family in s.rules):
+                s.used = True
+                if s.reason:
+                    suppressed = True
+                # a reason-less suppression does NOT suppress: the finding
+                # stays AND the bare annotation is flagged below
+        if not suppressed:
+            kept.append(f)
+    for s in ctx.suppressions.values():
+        if not s.reason:
+            kept.append(Finding(
+                file=ctx.relpath, line=s.line, col=0, rule="SUP001",
+                family="suppression", severity="error",
+                message=f"suppression ok[{','.join(s.rules)}] carries no "
+                        "reason — bare allowlisting is not allowed",
+                hint="append why the site is sanctioned: "
+                     "# tpu-lint: ok[RULE] <reason>",
+                source_line=ctx.line_text(s.line)))
+        elif not s.used and all(_ran(r, families) for r in s.rules):
+            kept.append(Finding(
+                file=ctx.relpath, line=s.line, col=0, rule="SUP002",
+                family="suppression", severity="warning",
+                message=f"suppression ok[{','.join(s.rules)}] matches no "
+                        "finding on its line — stale, delete it",
+                hint="the code it sanctioned changed; remove the comment",
+                source_line=ctx.line_text(s.line)))
+    return kept
+
+
+# ---- walking ----------------------------------------------------------------
+
+_SKIP_DIRS = {"__pycache__", "native", ".git"}
+
+
+def iter_py_files(root: str):
+    if os.path.isfile(root):
+        yield root
+        return
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames if d not in _SKIP_DIRS)
+        for name in sorted(filenames):
+            if name.endswith(".py"):
+                yield os.path.join(dirpath, name)
+
+
+def package_root() -> str:
+    """The paddle_tpu package directory (…/paddle_tpu)."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def _rel_ids(path: str):
+    """(relpath, pkg_relpath) — stable ids for findings + hot-path lookup."""
+    ap = os.path.abspath(path)
+    pkg = package_root()
+    base = os.path.dirname(pkg)
+    pkg_rel = ""
+    if ap.startswith(pkg + os.sep):
+        pkg_rel = os.path.relpath(ap, pkg).replace(os.sep, "/")
+    if ap.startswith(base + os.sep):
+        rel = os.path.relpath(ap, base).replace(os.sep, "/")
+    else:
+        rel = path.replace(os.sep, "/")
+    return rel, pkg_rel
+
+
+def analyze_file(path: str, families=None):
+    relpath, pkg_rel = _rel_ids(path)
+    ctx, err = build_context(path, relpath, pkg_rel)
+    if err is not None:
+        return [err]
+    findings = []
+    for mod in _families():
+        if families and mod.FAMILY not in families:
+            continue
+        findings.extend(mod.run(ctx))
+    findings = _apply_suppressions(ctx, findings, families=families)
+    findings.sort(key=lambda f: (f.line, f.col, f.rule))
+    return findings
+
+
+def analyze_paths(paths, families=None):
+    findings = []
+    for root in paths:
+        for path in iter_py_files(root):
+            findings.extend(analyze_file(path, families=families))
+    findings.sort(key=lambda f: (f.file, f.line, f.col, f.rule))
+    return findings
+
+
+# ---- baseline ratchet -------------------------------------------------------
+
+def finding_key(f: Finding):
+    text = re.sub(r"\s+", " ", f.source_line).strip()
+    return (f.file, f.rule, text)
+
+
+def load_baseline(path: str) -> Counter:
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    counts = Counter()
+    for e in data.get("entries", []):
+        counts[(e["file"], e["rule"], e["text"])] += int(e.get("count", 1))
+    return counts
+
+
+def save_baseline(path: str, findings) -> None:
+    bare = [f for f in findings if f.rule == "SUP001"]
+    if bare:
+        raise ValueError(
+            "refusing to baseline SUP001 (bare suppression) findings — "
+            "suppressions must carry reasons: " +
+            ", ".join(f"{f.file}:{f.line}" for f in bare[:5]))
+    counts = Counter(finding_key(f) for f in findings)
+    entries = [{"file": k[0], "rule": k[1], "text": k[2], "count": n}
+               for k, n in sorted(counts.items())]
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump({"version": 1, "entries": entries}, fh, indent=1,
+                  sort_keys=True)
+        fh.write("\n")
+
+
+def diff_against_baseline(findings, baseline: Counter):
+    """Partition findings into (new, preexisting) against the baseline.
+
+    Per fingerprint key, up to the baselined count rides; any excess is new.
+    """
+    seen = Counter()
+    new, old = [], []
+    for f in findings:
+        k = finding_key(f)
+        seen[k] += 1
+        (old if seen[k] <= baseline.get(k, 0) else new).append(f)
+    return new, old
+
+
+# ---- reporting --------------------------------------------------------------
+
+def format_finding(f: Finding, new: bool = False) -> str:
+    tag = " NEW" if new else ""
+    hint = f"\n      hint: {f.hint}" if f.hint else ""
+    return (f"{f.file}:{f.line}:{f.col}: {f.rule} [{f.severity}]{tag} "
+            f"{f.message}{hint}")

@@ -1,0 +1,190 @@
+"""collective-order (CO) — collectives under divergent control flow.
+
+The flight recorder's desync detector (exit 21) catches a rank issuing a
+different collective sequence at run time; these rules catch the shapes that
+produce one statically: a collective issue site reached under rank-dependent,
+data-dependent, or exception-dependent control flow.
+
+Sanctioned shapes the rules know:
+
+* ranked point-to-point (``send``/``recv``/``isend``/``irecv``) is EXPECTED
+  to branch on rank — exempt from CO001/CO004;
+* host-state guards that are identical across ranks by construction
+  (``no_sync()`` accumulation flags, partial-bucket flush at backward end)
+  contain no rank/data reference and are never flagged;
+* genuinely rank-guarded sites that are safe for a documented reason carry
+  ``# tpu-lint: ok[CO001] <reason>``.
+"""
+from __future__ import annotations
+
+import ast
+
+from .engine import Finding, parent, parents, terminal_name
+
+FAMILY = "collective-order"
+
+RULES = {
+    "CO001": ("error", "collective under a rank-dependent branch"),
+    "CO002": ("error", "collective issued inside an exception handler"),
+    "CO003": ("error", "collective under a device-data-dependent branch"),
+    "CO004": ("error", "collective after a rank-dependent early exit"),
+}
+
+COLLECTIVES = {
+    "all_reduce", "all_gather", "all_gather_object", "reduce",
+    "reduce_scatter", "broadcast", "broadcast_object_list", "scatter",
+    "scatter_object_list", "all_to_all", "alltoall", "alltoall_single",
+    "barrier", "gloo_barrier", "all_reduce_quantized",
+}
+P2P = {"send", "recv", "isend", "irecv"}
+
+_RANK_NAMES = {
+    "rank", "local_rank", "node_rank", "rank_id", "global_rank",
+    "cur_rank", "src_rank", "dst_rank", "self_rank", "world_rank",
+}
+_RANK_CALLS = {"get_rank", "get_group_rank", "get_world_rank"}
+_FETCH_CALLS = {"item", "numpy"}
+
+
+def _test_flags(test) -> tuple:
+    """(rank_dependent, data_dependent) for a branch test expression."""
+    rank = data = False
+    for node in ast.walk(test):
+        if isinstance(node, ast.Name) and node.id in _RANK_NAMES:
+            rank = True
+        elif isinstance(node, ast.Attribute) and node.attr in _RANK_NAMES:
+            rank = True
+        elif isinstance(node, ast.Call):
+            t = terminal_name(node.func)
+            if t in _RANK_CALLS:
+                rank = True
+            elif t in _FETCH_CALLS:
+                data = True
+    return rank, data
+
+
+def _collective_calls(tree):
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            t = terminal_name(node.func)
+            if t in COLLECTIVES or t in P2P:
+                yield node, t
+
+
+def _branch_context(call):
+    """Walk outward from a call collecting the branches that condition it."""
+    rank_if = data_if = except_handler = None
+    node = call
+    for p in parents(call):
+        if isinstance(p, (ast.If, ast.While)):
+            # the test itself is evaluated unconditionally; only the body
+            # and orelse are conditioned on it
+            if node is not p.test:
+                rank, data = _test_flags(p.test)
+                if rank and rank_if is None:
+                    rank_if = p
+                if data and data_if is None:
+                    data_if = p
+        elif isinstance(p, ast.IfExp):
+            if node is not p.test:
+                rank, data = _test_flags(p.test)
+                if rank and rank_if is None:
+                    rank_if = p
+                if data and data_if is None:
+                    data_if = p
+        elif isinstance(p, ast.ExceptHandler):
+            if except_handler is None:
+                except_handler = p
+        elif isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda)):
+            break  # conditions outside the enclosing function don't count
+        node = p
+    return rank_if, data_if, except_handler
+
+
+def _is_rank_early_exit(node) -> bool:
+    """An If with a rank-dependent test whose body unconditionally leaves
+    the function/loop (return/break/continue) — everything after it runs on
+    a rank-dependent subset of ranks."""
+    if not isinstance(node, ast.If) or not node.body or node.orelse:
+        return False
+    if not isinstance(node.body[-1], (ast.Return, ast.Break, ast.Continue)):
+        return False
+    rank, _ = _test_flags(node.test)
+    return rank
+
+
+def _statements_after(block_stmt):
+    """Statements that execute after ``block_stmt`` in its enclosing body."""
+    p = parent(block_stmt)
+    if p is None:
+        return []
+    after = []
+    for field in ("body", "orelse", "finalbody"):
+        seq = getattr(p, field, None)
+        if isinstance(seq, list) and block_stmt in seq:
+            after = seq[seq.index(block_stmt) + 1:]
+            break
+    return after
+
+
+def run(ctx):
+    findings = []
+    calls = [(n, terminal_name(n.func)) for n in ctx.nodes
+             if isinstance(n, ast.Call)]
+    calls = [(n, t) for n, t in calls if t in COLLECTIVES or t in P2P]
+    for call, name in calls:
+        p2p = name in P2P
+        rank_if, data_if, except_handler = _branch_context(call)
+        if rank_if is not None and not p2p:
+            findings.append(Finding(
+                file=ctx.relpath, line=call.lineno, col=call.col_offset,
+                rule="CO001", family=FAMILY, severity="error",
+                message=f"collective '{name}' issued under a rank-dependent "
+                        f"branch (`{ctx.src(rank_if)}`) — ranks reaching "
+                        "different branches issue different sequences "
+                        "(desync exit-21 class)",
+                hint="hoist the collective out of the branch, use ranked "
+                     "p2p send/recv, or suppress with the reason all ranks "
+                     "agree on the predicate",
+                source_line=ctx.src(call)))
+        if except_handler is not None:
+            findings.append(Finding(
+                file=ctx.relpath, line=call.lineno, col=call.col_offset,
+                rule="CO002", family=FAMILY, severity="error",
+                message=f"collective '{name}' issued inside an exception "
+                        "handler — only ranks that raised reach it",
+                hint="move the collective outside try/except, or suppress "
+                     "with the reason the raise is rank-symmetric",
+                source_line=ctx.src(call)))
+        if data_if is not None:
+            findings.append(Finding(
+                file=ctx.relpath, line=call.lineno, col=call.col_offset,
+                rule="CO003", family=FAMILY, severity="error",
+                message=f"collective '{name}' issued under a branch that "
+                        "fetches device data "
+                        f"(`{ctx.src(data_if)}`) — per-rank values can "
+                        "diverge and split the collective schedule",
+                hint="decide on replicated host state, or all_reduce the "
+                     "predicate first",
+                source_line=ctx.src(call)))
+    # CO004: collective lexically after a rank-gated early exit
+    for exit_if in ctx.nodes:
+        if _is_rank_early_exit(exit_if):
+            after = _statements_after(exit_if)
+            for stmt in after:
+                for call, name in _collective_calls(stmt):
+                    if name in P2P:
+                        continue
+                    findings.append(Finding(
+                        file=ctx.relpath, line=call.lineno,
+                        col=call.col_offset,
+                        rule="CO004", family=FAMILY, severity="error",
+                        message=f"collective '{name}' is unreachable for "
+                                "ranks taking the early exit at line "
+                                f"{exit_if.lineno} "
+                                f"(`{ctx.src(exit_if)}`)",
+                        hint="issue the collective before the rank gate, "
+                             "or restructure so every rank reaches it",
+                        source_line=ctx.src(call)))
+    return findings

@@ -1,0 +1,220 @@
+"""donation (DN) — reads of a buffer after it was donated to a jitted call.
+
+``jax.jit(..., donate_argnums=...)`` invalidates the argument buffer the
+moment the call dispatches; a later read of the same binding either raises
+a deleted-buffer error on device or silently reads garbage through an alias.
+The fused train step and the serving decode step both donate their state
+(params, opt state, KV pools) — these rules catch the lexical shape where a
+donated binding is still read afterwards.
+
+Scope is deliberately conservative (pure-AST, single function scope, simple
+name bindings): a callable whose donated positions are knowable statically
+(``f = jax.jit(g, donate_argnums=(1,))`` then ``f(a, b)``) is tracked; a
+donation smuggled through returns/containers is not — the runtime error
+still covers those.
+"""
+from __future__ import annotations
+
+import ast
+
+from .engine import Finding, dotted, parents, terminal_name
+
+FAMILY = "donation"
+
+RULES = {
+    "DN001": ("error", "binding read after being donated to a jitted call"),
+    "DN002": ("warning", "donated binding never rebound inside its loop"),
+}
+
+
+def _donate_positions(call) -> tuple:
+    """Constant donate_argnums positions of a jax.jit(...) call, else ()."""
+    if terminal_name(call.func) not in ("jit", "pjit"):
+        return ()
+    for kw in call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, int):
+            return (v.value,)
+        if isinstance(v, (ast.Tuple, ast.List)):
+            out = []
+            for elt in v.elts:
+                if isinstance(elt, ast.Constant) and isinstance(elt.value,
+                                                                int):
+                    out.append(elt.value)
+                else:
+                    return ()
+            return tuple(out)
+        return ()
+    return ()
+
+
+def _direct_walk(scope):
+    """Walk a scope without descending into nested function scopes."""
+    stack = list(ast.iter_child_nodes(scope))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def _enclosing_scope(node, tree):
+    for p in parents(node):
+        if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return p
+    return tree
+
+
+def _name_events_after(scope, name, line):
+    """(kind, node) events for ``name`` after ``line``, in lexical order."""
+    events = []
+    for node in _direct_walk(scope):
+        if isinstance(node, ast.Name) and node.id == name \
+                and node.lineno > line:
+            kind = "store" if isinstance(node.ctx, (ast.Store, ast.Del)) \
+                else "load"
+            events.append((node.lineno, node.col_offset, kind, node))
+    events.sort(key=lambda e: (e[0], e[1]))
+    return events
+
+
+def _call_stmt_targets(call) -> set:
+    """Names the donated call's OWN statement rebinds (``x = step(x)``)."""
+    stmt = None
+    for p in parents(call):
+        if isinstance(p, ast.stmt):
+            stmt = p
+            break
+    out = set()
+    if isinstance(stmt, ast.Assign):
+        for tgt in stmt.targets:
+            for sub in ast.walk(tgt):
+                if isinstance(sub, ast.Name):
+                    out.add(sub.id)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)) \
+            and isinstance(stmt.target, ast.Name):
+        out.add(stmt.target.id)
+    return out
+
+
+def _exclusive_branches(call, ev) -> bool:
+    """True when ``ev`` sits in the opposite branch of an If from ``call``
+    — lexically after it, but on a path that can never execute once the
+    donating dispatch has run."""
+    child_of = {}
+    node = call
+    for p in parents(call):
+        child_of[id(p)] = node
+        node = p
+    node = ev
+    for p in parents(ev):
+        if id(p) in child_of:
+            if isinstance(p, ast.If):
+                a, b = child_of[id(p)], node
+
+                def branch(c, if_node=p):
+                    if any(c is s for s in if_node.body):
+                        return "body"
+                    if any(c is s for s in if_node.orelse):
+                        return "orelse"
+                    return "test"
+
+                ba, bb = branch(a), branch(b)
+                return ba != bb and "test" not in (ba, bb)
+            return False
+        node = p
+    return False
+
+
+def _enclosing_loop(call, scope):
+    """Innermost for/while between ``call`` and its enclosing scope."""
+    for p in parents(call):
+        if p is scope:
+            return None
+        if isinstance(p, (ast.For, ast.While)):
+            return p
+    return None
+
+
+def _loop_stores(loop, name) -> bool:
+    for node in ast.walk(loop):
+        if isinstance(node, ast.Name) and node.id == name \
+                and isinstance(node.ctx, ast.Store):
+            return True
+        if isinstance(node, ast.arg) and node.arg == name:
+            return True
+    return False
+
+
+def run(ctx):
+    # binding (name or dotted self.attr) -> donated positions, per scope
+    callables_by_scope = {}
+    for node in ctx.nodes:
+        if not isinstance(node, ast.Assign) \
+                or not isinstance(node.value, ast.Call):
+            continue
+        pos = _donate_positions(node.value)
+        if not pos:
+            continue
+        scope = _enclosing_scope(node, ctx.tree)
+        for tgt in node.targets:
+            key = tgt.id if isinstance(tgt, ast.Name) else dotted(tgt)
+            if key:
+                callables_by_scope.setdefault(scope, {})[key] = pos
+
+    findings = []
+    for node in ctx.nodes:
+        if not isinstance(node, ast.Call):
+            continue
+        scope = _enclosing_scope(node, ctx.tree)
+        pos = ()
+        if isinstance(node.func, ast.Call):
+            # inline form: jax.jit(f, donate_argnums=...)(args)
+            pos = _donate_positions(node.func)
+        else:
+            key = node.func.id if isinstance(node.func, ast.Name) \
+                else dotted(node.func)
+            pos = callables_by_scope.get(scope, {}).get(key, ())
+        if pos:
+            donated = [node.args[i] for i in pos if i < len(node.args)]
+            donated_names = [a.id for a in donated
+                             if isinstance(a, ast.Name)]
+            loop = _enclosing_loop(node, scope)
+            own = {id(sub) for sub in ast.walk(node)}  # the call's operands
+            rebound = _call_stmt_targets(node)
+            for name in donated_names:
+                if name in rebound:
+                    continue  # x = step(x): the result replaces the buffer
+                events = _name_events_after(scope, name, node.lineno)
+                for _ln, _col, kind, ev in events:
+                    if id(ev) in own:
+                        continue  # a multi-line call's own argument
+                    if _exclusive_branches(node, ev):
+                        continue  # sibling if/else branch: unreachable
+                    if kind == "store":
+                        break
+                    findings.append(Finding(
+                        file=ctx.relpath, line=ev.lineno, col=ev.col_offset,
+                        rule="DN001", family=FAMILY, severity="error",
+                        message=f"'{name}' is read after being donated to "
+                                f"the jitted call at line {node.lineno} — "
+                                "the buffer is invalidated at dispatch",
+                        hint="rebind the call's result over the donated "
+                             "name, or drop it from donate_argnums",
+                        source_line=ctx.src(ev)))
+                    break
+                if loop is not None and not _loop_stores(loop, name):
+                    findings.append(Finding(
+                        file=ctx.relpath, line=node.lineno,
+                        col=node.col_offset,
+                        rule="DN002", family=FAMILY, severity="warning",
+                        message=f"'{name}' is donated inside a loop but "
+                                "never rebound in the loop body — the next "
+                                "iteration passes an invalidated buffer",
+                        hint="rebind the donated operand from the call "
+                             "result each iteration",
+                        source_line=ctx.src(node)))
+    return findings

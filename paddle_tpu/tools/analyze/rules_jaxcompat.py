@@ -1,0 +1,91 @@
+"""jax-compat (JC) — jax surfaces that must route through core/jax_compat.
+
+``core/jax_compat.py`` shims this image's jax 0.4.x: it publishes top-level
+``jax.shard_map`` (adapting the ``check_vma`` kwarg to the old ``check_rep``
+spelling), ``jax.lax.pcast``, and ``jax.enable_x64``.  Code that bypasses
+the shim — importing ``jax.experimental.shard_map`` directly, or passing
+``check_rep=`` straight through — works on exactly one runtime generation
+and breaks on the other.  These rules enforce the ROADMAP standing note
+mechanically: the shimmed spelling is the only one that works everywhere.
+"""
+from __future__ import annotations
+
+import ast
+
+from .engine import Finding, dotted, terminal_name
+
+FAMILY = "jax-compat"
+
+RULES = {
+    "JC001": ("error", "direct jax.experimental.shard_map import"),
+    "JC002": ("error", "check_rep= passed to shard_map (pre-shim kwarg)"),
+    "JC003": ("error", "direct jax.experimental enable_x64 import"),
+}
+
+_SHIM_FILE = "core/jax_compat.py"  # the one place the raw surface is legal
+
+
+def run(ctx):
+    if ctx.pkg_relpath == _SHIM_FILE:
+        return []
+    findings = []
+    for node in ctx.nodes:
+        if isinstance(node, ast.ImportFrom) and node.module:
+            if node.module.startswith("jax.experimental.shard_map"):
+                findings.append(Finding(
+                    file=ctx.relpath, line=node.lineno, col=node.col_offset,
+                    rule="JC001", family=FAMILY, severity="error",
+                    message="direct `jax.experimental.shard_map` import "
+                            "bypasses core/jax_compat — only the shimmed "
+                            "`from jax import shard_map` works on every "
+                            "supported runtime",
+                    hint="use `from jax import shard_map` (the shim "
+                         "publishes the alias at package import)",
+                    source_line=ctx.src(node)))
+            elif node.module == "jax.experimental" and any(
+                    a.name == "enable_x64" for a in node.names):
+                findings.append(Finding(
+                    file=ctx.relpath, line=node.lineno, col=node.col_offset,
+                    rule="JC003", family=FAMILY, severity="error",
+                    message="direct `jax.experimental.enable_x64` import "
+                            "bypasses core/jax_compat — modern runtimes "
+                            "promoted it to `jax.enable_x64`",
+                    hint="use `jax.enable_x64` (the shim back-fills it on "
+                         "0.4.x)",
+                    source_line=ctx.src(node)))
+        elif isinstance(node, ast.Attribute) \
+                and node.attr in ("shard_map", "enable_x64"):
+            # the terminal attr gates the (comparatively pricey) chain walk
+            if dotted(node).startswith("jax.experimental.shard_map"):
+                findings.append(Finding(
+                    file=ctx.relpath, line=node.lineno, col=node.col_offset,
+                    rule="JC001", family=FAMILY, severity="error",
+                    message="attribute use of `jax.experimental.shard_map` "
+                            "bypasses core/jax_compat",
+                    hint="use `jax.shard_map` / `from jax import shard_map`",
+                    source_line=ctx.src(node)))
+            elif dotted(node) == "jax.experimental.enable_x64":
+                findings.append(Finding(
+                    file=ctx.relpath, line=node.lineno, col=node.col_offset,
+                    rule="JC003", family=FAMILY, severity="error",
+                    message="attribute use of `jax.experimental.enable_x64` "
+                            "bypasses core/jax_compat",
+                    hint="use `jax.enable_x64`",
+                    source_line=ctx.src(node)))
+        elif isinstance(node, ast.Call) \
+                and terminal_name(node.func) == "shard_map":
+            for kw in node.keywords:
+                if kw.arg == "check_rep":
+                    findings.append(Finding(
+                        file=ctx.relpath, line=kw.value.lineno,
+                        col=kw.value.col_offset,
+                        rule="JC002", family=FAMILY, severity="error",
+                        message="`check_rep=` is the pre-shim kwarg — on a "
+                                "modern jax the native `jax.shard_map` "
+                                "rejects it with a TypeError; the shim "
+                                "adapts `check_vma=` to whichever runtime "
+                                "is installed",
+                        hint="pass `check_vma=` and let core/jax_compat "
+                             "translate",
+                        source_line=ctx.src(node)))
+    return findings

@@ -1,0 +1,169 @@
+"""trace-purity (TP) — side effects inside traced/staged program bodies.
+
+A ``@to_static`` body, a function handed to ``jax.jit``/``shard_map``, and a
+dispatch-cacheable op forward (the ``fwd`` callable of ``core.dispatch.apply``)
+all execute ONCE at trace time and then replay as a compiled program — the
+exact hazard PR 7's persistent ``_jit_cache`` turns into silent stale-program
+replays: a global mutated at trace time never mutates again, ``numpy.random``
+draws become baked constants, wall-clock reads freeze, and a blocking fetch
+either aborts the trace or constant-folds a device value.
+"""
+from __future__ import annotations
+
+import ast
+
+from .engine import Finding, dotted, parents, terminal_name
+
+FAMILY = "trace-purity"
+
+RULES = {
+    "TP001": ("error", "global/nonlocal mutation inside a traced body"),
+    "TP002": ("error", "numpy global RNG inside a traced body"),
+    "TP003": ("warning", "wall-clock read inside a traced body"),
+    "TP004": ("error", "blocking fetch inside a traced body"),
+}
+
+_TRACE_WRAPPERS = {"jit", "pjit", "shard_map", "to_static", "checkpoint",
+                   "remat"}
+_CLOCK_CHAINS = {
+    "time.time", "time.perf_counter", "time.monotonic", "time.time_ns",
+    "time.perf_counter_ns", "datetime.now", "datetime.utcnow",
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+}
+_FETCHES = {"numpy", "item", "block_until_ready", "device_get"}
+
+
+def _is_to_static_decorator(dec) -> bool:
+    t = terminal_name(dec.func) if isinstance(dec, ast.Call) else \
+        terminal_name(dec)
+    return t in ("to_static", "not_to_static") and t == "to_static"
+
+
+def _enclosing_scope(node, tree):
+    for p in parents(node):
+        if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return p
+    return tree
+
+
+def _traced_regions(ctx):
+    """Yield (region node, how) for every statically-detectable traced body:
+
+    * ``@to_static``-decorated defs;
+    * local defs/lambdas passed (first arg) to jit/pjit/shard_map/remat;
+    * lambdas/local defs passed as the ``fwd`` argument of ``apply(...)``.
+    """
+    # name -> def, per direct enclosing scope (one pass over the index)
+    defs_by_scope = {}
+    for node in ctx.nodes:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            scope = _enclosing_scope(node, ctx.tree)
+            defs_by_scope.setdefault(scope, {}).setdefault(node.name, node)
+
+    def resolve(name_node):
+        scope = _enclosing_scope(name_node, ctx.tree)
+        while True:
+            d = defs_by_scope.get(scope, {})
+            if name_node.id in d:
+                return d[name_node.id]
+            if scope is ctx.tree:
+                return None
+            scope = _enclosing_scope(scope, ctx.tree)
+
+    seen = set()
+    for node in ctx.nodes:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if _is_to_static_decorator(dec) and id(node) not in seen:
+                    seen.add(id(node))
+                    yield node, "@to_static"
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        t = terminal_name(node.func)
+        first = node.args[0]
+        if t in _TRACE_WRAPPERS and t != "to_static":
+            target = None
+            if isinstance(first, ast.Lambda):
+                target = first
+            elif isinstance(first, ast.Name):
+                target = resolve(first)
+            if target is not None and id(target) not in seen:
+                seen.add(id(target))
+                yield target, t
+        elif t == "apply" and len(node.args) >= 2 \
+                and isinstance(first, ast.Constant) \
+                and isinstance(first.value, str):
+            fwd = node.args[1]
+            target = None
+            if isinstance(fwd, ast.Lambda):
+                target = fwd
+            elif isinstance(fwd, ast.Name):
+                target = resolve(fwd)
+            if target is not None and id(target) not in seen:
+                seen.add(id(target))
+                yield target, "dispatch fwd"
+
+
+def _region_body(region):
+    if isinstance(region, ast.Lambda):
+        return [region.body]
+    return region.body
+
+
+def run(ctx):
+    findings = []
+    for region, how in _traced_regions(ctx):
+        label = region.name if hasattr(region, "name") else "<lambda>"
+        for stmt in _region_body(region):
+            for node in ast.walk(stmt):
+                if isinstance(node, (ast.Global, ast.Nonlocal)):
+                    findings.append(Finding(
+                        file=ctx.relpath, line=node.lineno,
+                        col=node.col_offset, rule="TP001", family=FAMILY,
+                        severity="error",
+                        message=f"{type(node).__name__.lower()} statement "
+                                f"inside traced body '{label}' ({how}) — "
+                                "the mutation runs once at trace time, then "
+                                "the cached program replays without it",
+                        hint="thread the value through inputs/outputs or "
+                             "host callbacks; traced bodies must be pure",
+                        source_line=ctx.src(node)))
+                elif isinstance(node, ast.Call):
+                    chain = dotted(node.func)
+                    t = terminal_name(node.func)
+                    if chain.startswith(("np.random.", "numpy.random.")):
+                        findings.append(Finding(
+                            file=ctx.relpath, line=node.lineno,
+                            col=node.col_offset, rule="TP002", family=FAMILY,
+                            severity="error",
+                            message=f"numpy global RNG `{chain}` inside "
+                                    f"traced body '{label}' ({how}) — the "
+                                    "draw is baked at trace time and every "
+                                    "replay reuses it",
+                            hint="use the in-program RNG spec "
+                                 "(core.random.derive_key) or pass keys in",
+                            source_line=ctx.src(node)))
+                    elif chain in _CLOCK_CHAINS:
+                        findings.append(Finding(
+                            file=ctx.relpath, line=node.lineno,
+                            col=node.col_offset, rule="TP003", family=FAMILY,
+                            severity="warning",
+                            message=f"wall-clock read `{chain}` inside "
+                                    f"traced body '{label}' ({how}) — "
+                                    "freezes to the trace-time value",
+                            hint="time outside the traced body",
+                            source_line=ctx.src(node)))
+                    elif t in _FETCHES and isinstance(node.func,
+                                                      (ast.Attribute,
+                                                       ast.Name)):
+                        findings.append(Finding(
+                            file=ctx.relpath, line=node.lineno,
+                            col=node.col_offset, rule="TP004", family=FAMILY,
+                            severity="error",
+                            message=f"blocking fetch `.{t}()` inside traced "
+                                    f"body '{label}' ({how}) — aborts the "
+                                    "trace or constant-folds a device value",
+                            hint="return the value from the traced body and "
+                                 "fetch outside",
+                            source_line=ctx.src(node)))
+    return findings

@@ -1,0 +1,38 @@
+"""tpu-lint fixture: sanctioned collective shapes — zero findings expected.
+
+Covers the patterns the rules must know: ranked p2p, the ``no_sync()``
+accumulation guard, and the partial-bucket flush at backward end (both
+host-state guards identical across ranks, no rank/data reference).
+"""
+
+
+def ranked_p2p(rank, x):
+    # src/dst-ranked point-to-point is EXPECTED to branch on rank
+    if rank == 0:
+        dist.send(x, dst=1)  # noqa: F821
+    else:
+        dist.recv(x, src=0)  # noqa: F821
+
+
+class BucketSync:
+    def __init__(self):
+        self.accumulating = False
+        self._pending = {}
+
+    def on_grad_ready(self, bucket, grads):
+        # no_sync() suppression: host flag set identically on every rank
+        if self.accumulating:
+            return
+        dist.all_reduce(grads)  # noqa: F821
+
+    def on_backward_end(self):
+        # partial-bucket flush: pending counts deterministic across ranks
+        for bucket, grads in self._pending.items():
+            if grads:
+                dist.all_reduce(grads)  # noqa: F821
+
+
+def unconditional_schedule(xs):
+    for x in xs:
+        dist.all_reduce(x)  # noqa: F821
+    dist.barrier()  # noqa: F821

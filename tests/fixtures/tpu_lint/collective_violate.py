@@ -1,0 +1,34 @@
+"""tpu-lint fixture: every collective-order violation shape (CO001-CO004).
+
+Scanned by tests/test_static_analysis.py — NOT imported at test time, so the
+undefined names (dist, loss) are deliberate: the analyzer is pure-AST.
+"""
+
+
+def rank_branched_broadcast(rank, x):  # CO001
+    if rank == 0:
+        dist.broadcast(x, src=0)  # noqa: F821
+
+
+def nested_rank_branch(rank, x):  # CO001 through an intermediate if
+    if x is not None:
+        if rank != 0:
+            dist.all_reduce(x)  # noqa: F821
+
+
+def collective_in_handler(x):  # CO002
+    try:
+        prepare(x)  # noqa: F821
+    except ValueError:
+        dist.all_reduce(x)  # noqa: F821
+
+
+def data_dependent_barrier(loss, x):  # CO003
+    if loss.item() > 5.0:
+        dist.barrier()  # noqa: F821
+
+
+def barrier_after_rank_exit(rank):  # CO004
+    if rank != 0:
+        return
+    dist.barrier()  # noqa: F821

@@ -1,0 +1,16 @@
+"""tpu-lint fixture: donated-buffer reuse (DN001/DN002)."""
+import jax
+
+
+def read_after_donation(train_step, params, batch):
+    step = jax.jit(train_step, donate_argnums=(0,))
+    loss = step(params, batch)
+    return loss, params["w"]  # DN001: params was invalidated at dispatch
+
+
+def stale_loop_operand(train_step, params, batches):
+    step = jax.jit(train_step, donate_argnums=(0,))
+    out = None
+    for batch in batches:
+        out = step(params, batch)  # DN002: params never rebound in the loop
+    return out

@@ -1,0 +1,15 @@
+# tpu-lint: hot-path
+"""tpu-lint fixture: blocking fetches on a marker-designated hot path."""
+
+
+def decode_round(engine, reqs):
+    for req in reqs:
+        loss = engine.step(req)
+        if loss.item() > 0:  # HS001: per-request host sync in the round
+            req.finish()
+
+
+def drain(results):
+    import numpy as np
+    rows = [np.asarray(r) for r in results]  # HS002: device operands
+    return [jax.block_until_ready(r) for r in rows]  # noqa: F821  HS001

@@ -1,0 +1,9 @@
+"""tpu-lint fixture: jax surfaces that must route through core/jax_compat."""
+from jax.experimental.shard_map import shard_map  # JC001
+from jax.experimental import enable_x64  # JC003
+
+
+def build(mesh, impl, spec):
+    # JC002: pre-shim kwarg breaks on a modern jax
+    return shard_map(impl, mesh=mesh, in_specs=(spec,), out_specs=spec,
+                     check_rep=False)

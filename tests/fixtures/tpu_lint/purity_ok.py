@@ -1,0 +1,23 @@
+"""tpu-lint fixture: pure traced bodies — zero findings expected."""
+import time
+
+import numpy as np
+
+
+@to_static  # noqa: F821
+def keyed_step(x, key):  # randomness threaded through inputs
+    return x + jax.random.normal(key, x.shape)  # noqa: F821
+
+
+def build_pure_fwd():
+    def fwd(x):
+        return x * 2 + 1
+    return jax.jit(fwd)  # noqa: F821
+
+
+def timed_outside(x):
+    # impure work OUTSIDE the traced body is fine
+    t0 = time.perf_counter()
+    rng = np.random.default_rng(0)
+    out = apply("mul", lambda a, b: a * b, [x, x])  # noqa: F821
+    return out, time.perf_counter() - t0, rng

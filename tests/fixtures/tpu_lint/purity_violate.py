@@ -1,0 +1,34 @@
+"""tpu-lint fixture: trace-purity violations (TP001-TP004).
+
+Each shape bakes a side effect into a program that traces once and replays
+from a cache — the stale-replay class PR 7's persistent ``_jit_cache``
+turned from a perf bug into a correctness bug.
+"""
+import time
+
+import numpy as np
+
+_step_count = 0
+
+
+@to_static  # noqa: F821
+def counted_step(x):  # TP001: mutation runs at trace time only
+    global _step_count
+    _step_count += 1
+    return x * 2
+
+
+def build_noisy_fwd():
+    def fwd(x):  # TP002: the draw is baked into the traced program
+        return x + np.random.rand()
+    return jax.jit(fwd)  # noqa: F821
+
+
+@to_static  # noqa: F821
+def stamped_step(x):  # TP003: freezes to the trace-time clock
+    return x * time.time()
+
+
+def fetching_op(x):
+    # TP004: dispatch-cacheable fwd blocks on a device value mid-trace
+    return apply("bad_fetch", lambda a: a * a.item(), [x])  # noqa: F821

@@ -1,0 +1,270 @@
+"""tpu-lint (ISSUE 12): per-rule fixtures, suppression/baseline semantics,
+the tier-1 self-scan against the committed baseline, and the CLI contract
+(exit 7 on new findings, no jax import, <10s full-tree scan)."""
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from paddle_tpu.tools.analyze import (DEFAULT_BASELINE, EXIT_NEW_FINDINGS,
+                                      analyze_file, analyze_paths,
+                                      diff_against_baseline, load_baseline,
+                                      package_root, save_baseline)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "tpu_lint")
+
+
+def rules_of(path):
+    return [f.rule for f in analyze_file(os.path.join(FIXTURES, path))]
+
+
+# ---- per-rule fixtures ------------------------------------------------------
+
+def test_collective_order_fixtures():
+    assert rules_of("collective_violate.py") == [
+        "CO001", "CO001", "CO002", "CO003", "CO004"]
+    # ranked p2p, no_sync guard, partial-bucket flush: all sanctioned
+    assert rules_of("collective_ok.py") == []
+
+
+def test_trace_purity_fixtures():
+    assert rules_of("purity_violate.py") == [
+        "TP001", "TP002", "TP003", "TP004"]
+    assert rules_of("purity_ok.py") == []
+
+
+def test_host_sync_fixtures():
+    # file designated hot by the `# tpu-lint: hot-path` marker
+    assert rules_of("hostsync_violate.py") == ["HS001", "HS002", "HS001"]
+    # loss_fetch_every-amortized fetch rides on a reasoned suppression
+    assert rules_of("hostsync_ok.py") == []
+
+
+def test_jax_compat_fixtures():
+    assert rules_of("jaxcompat_violate.py") == ["JC001", "JC003", "JC002"]
+    assert rules_of("jaxcompat_ok.py") == []
+
+
+def test_donation_fixtures():
+    assert rules_of("donation_violate.py") == ["DN001", "DN002"]
+    assert rules_of("donation_ok.py") == []
+
+
+# ---- suppression semantics --------------------------------------------------
+
+def _scan_source(tmp_path, source):
+    p = tmp_path / "snippet.py"
+    p.write_text(source)
+    return analyze_file(str(p))
+
+
+def test_reasoned_suppression_suppresses(tmp_path):
+    fs = _scan_source(tmp_path, (
+        "def f(rank, x):\n"
+        "    if rank == 0:\n"
+        "        # tpu-lint: ok[CO001] every rank computes rank==0 False-"
+        "identically here\n"
+        "        dist.broadcast(x, src=0)\n"))
+    assert [f.rule for f in fs] == []
+
+
+def test_family_slug_suppression(tmp_path):
+    fs = _scan_source(tmp_path, (
+        "def f(rank, x):\n"
+        "    if rank == 0:\n"
+        "        dist.broadcast(x, src=0)  "
+        "# tpu-lint: ok[collective-order] sanctioned for this test\n"))
+    assert [f.rule for f in fs] == []
+
+
+def test_bare_suppression_is_finding_and_does_not_suppress(tmp_path):
+    fs = _scan_source(tmp_path, (
+        "def f(rank, x):\n"
+        "    if rank == 0:\n"
+        "        dist.broadcast(x, src=0)  # tpu-lint: ok[CO001]\n"))
+    assert sorted(f.rule for f in fs) == ["CO001", "SUP001"]
+
+
+def test_stale_suppression_flagged(tmp_path):
+    fs = _scan_source(tmp_path, (
+        "def f(x):\n"
+        "    return x  # tpu-lint: ok[CO001] nothing here anymore\n"))
+    assert [f.rule for f in fs] == ["SUP002"]
+
+
+def test_suppression_inside_string_literal_ignored(tmp_path):
+    fs = _scan_source(tmp_path, (
+        'DOC = "example: # tpu-lint: ok[CO001] reason"\n'))
+    assert fs == []  # no SUP002: not a real comment token
+
+
+def test_unparseable_file_reports_parse001(tmp_path):
+    fs = _scan_source(tmp_path, "def broken(:\n")
+    assert [f.rule for f in fs] == ["PARSE001"]
+
+
+# ---- baseline ratchet -------------------------------------------------------
+
+def test_baseline_ratchet_roundtrip(tmp_path):
+    viol = tmp_path / "v.py"
+    viol.write_text("def f(rank, x):\n"
+                    "    if rank == 0:\n"
+                    "        dist.broadcast(x, src=0)\n")
+    findings = analyze_paths([str(viol)])
+    assert len(findings) == 1
+    bl = tmp_path / "baseline.json"
+    save_baseline(str(bl), findings)
+    # the pre-existing finding rides...
+    new, old = diff_against_baseline(analyze_paths([str(viol)]),
+                                     load_baseline(str(bl)))
+    assert new == [] and len(old) == 1
+    # ...the fingerprint survives line drift (comment shifts it down)...
+    viol.write_text("# a new leading comment\n" + viol.read_text())
+    new, old = diff_against_baseline(analyze_paths([str(viol)]),
+                                     load_baseline(str(bl)))
+    assert new == [] and len(old) == 1
+    # ...and a second, genuinely new finding fails the ratchet
+    viol.write_text(viol.read_text() +
+                    "\n\ndef g(rank, y):\n"
+                    "    if rank == 1:\n"
+                    "        dist.all_reduce(y)\n")
+    new, old = diff_against_baseline(analyze_paths([str(viol)]),
+                                     load_baseline(str(bl)))
+    assert len(new) == 1 and len(old) == 1
+
+
+def test_baseline_refuses_bare_suppressions(tmp_path):
+    snip = tmp_path / "s.py"
+    snip.write_text("x = 1  # tpu-lint: ok[CO001]\n")
+    with pytest.raises(ValueError, match="SUP001"):
+        save_baseline(str(tmp_path / "b.json"), analyze_paths([str(snip)]))
+
+
+# ---- the committed tree ----------------------------------------------------
+
+def test_self_scan_no_new_findings_vs_committed_baseline():
+    t0 = time.perf_counter()
+    findings = analyze_paths([package_root()])
+    elapsed = time.perf_counter() - t0
+    new, _old = diff_against_baseline(findings,
+                                      load_baseline(DEFAULT_BASELINE))
+    assert new == [], "new tpu-lint findings vs committed baseline:\n" + \
+        "\n".join(f"{f.file}:{f.line}: {f.rule} {f.message}" for f in new)
+    # in-process scan must stay WELL under the tier-1 headroom; the CLI
+    # acceptance bound (<10s incl. boot) is asserted in the CLI test below
+    assert elapsed < 30.0, f"self-scan took {elapsed:.1f}s"
+
+
+def test_critical_families_have_zero_baseline_entries():
+    # ISSUE 12 acceptance: collective-order, host-sync and donation end the
+    # PR with ZERO baseline entries (sanctioned sites use reasoned
+    # suppressions instead of riding the ratchet)
+    with open(DEFAULT_BASELINE) as fh:
+        entries = json.load(fh)["entries"]
+    critical = [e for e in entries
+                if e["rule"].startswith(("CO", "HS", "DN"))]
+    assert critical == []
+
+
+def test_analyzer_modules_never_import_jax():
+    import ast
+    adir = os.path.join(package_root(), "tools", "analyze")
+    for name in sorted(os.listdir(adir)):
+        if not name.endswith(".py"):
+            continue
+        tree = ast.parse(open(os.path.join(adir, name)).read())
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                mods = [a.name for a in node.names]
+            elif isinstance(node, ast.ImportFrom):
+                mods = [node.module or ""]
+            else:
+                continue
+            for m in mods:
+                assert not (m == "jax" or m.startswith("jax.")), \
+                    f"{name} imports {m} — the analyzer must stay pure-AST"
+
+
+# ---- CLI contract -----------------------------------------------------------
+
+def _run_cli(*args):
+    env = dict(os.environ)
+    env.pop("PADDLE_TPU_LINT_BOOT", None)
+    return subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.tools.analyze", *args],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=120)
+
+
+def test_cli_clean_fast_and_jax_free():
+    t0 = time.perf_counter()
+    res = _run_cli("--assert-no-jax")
+    wall = time.perf_counter() - t0
+    assert res.returncode == 0, res.stdout + res.stderr
+    # --assert-no-jax exits 2 if jax sneaks into the process, so rc 0 also
+    # proves the boot guard skipped framework init
+    assert "0 new vs baseline" in res.stdout
+    assert wall < 10.0, f"CLI scan took {wall:.1f}s (acceptance: <10s)"
+
+
+def test_family_filter_does_not_invent_stale_suppressions():
+    # review-hardening: a collective-order-only scan must not flag the
+    # tree's reasoned host-sync suppressions as stale (their rules never
+    # ran, so staleness is not judgeable)
+    findings = analyze_paths([package_root()],
+                             families={"collective-order"})
+    assert [f for f in findings if f.rule == "SUP002"] == []
+
+
+def test_dn001_skips_mutually_exclusive_branch(tmp_path):
+    fs = _scan_source(tmp_path, (
+        "import jax\n"
+        "def f(train_step, x, use_fast):\n"
+        "    step = jax.jit(train_step, donate_argnums=(0,))\n"
+        "    if use_fast:\n"
+        "        y = step(x)\n"
+        "    else:\n"
+        "        y = x + 1\n"  # never executes after the donating call
+        "    return y\n"))
+    assert [f.rule for f in fs] == []
+
+
+def test_cli_rejects_bad_family_and_partial_baseline_update():
+    assert _run_cli("--families", "hostsync").returncode == 2  # typo
+    res = _run_cli("--families", "collective-order", "--update-baseline")
+    assert res.returncode == 2  # partial scan must never rewrite baseline
+    assert "PARTIAL" in res.stderr
+
+
+def test_cli_exits_7_on_injected_violation():
+    res = _run_cli(os.path.join("tests", "fixtures", "tpu_lint",
+                                "collective_violate.py"))
+    assert res.returncode == EXIT_NEW_FINDINGS, res.stdout + res.stderr
+    assert "CO001" in res.stdout
+
+
+# ---- regression: the three real findings the first scan surfaced -----------
+
+def test_check_vma_routes_through_shim():
+    # serving/decode.py + ops/pallas/flash_attention.py passed check_rep=
+    # straight through; the fix passes check_vma= which core/jax_compat
+    # translates on 0.4.x and modern jax accepts natively — prove the
+    # shimmed call shape works on THIS runtime
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+    mesh = Mesh(np.array(jax.devices("cpu")[:1]), ("x",))
+    f = jax.shard_map(lambda a: a * 2, mesh=mesh, in_specs=(P(),),
+                      out_specs=P(), check_vma=False)
+    out = f(jax.numpy.arange(4.0))
+    assert out.tolist() == [0.0, 2.0, 4.0, 6.0]
+
+
+def test_fixed_files_scan_clean_for_jax_compat():
+    for rel in ("serving/decode.py", "ops/pallas/flash_attention.py"):
+        path = os.path.join(package_root(), rel)
+        fs = [f for f in analyze_file(path) if f.family == "jax-compat"]
+        assert fs == [], f"{rel} regressed: {[f.rule for f in fs]}"

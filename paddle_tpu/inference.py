@@ -20,15 +20,26 @@ __all__ = ["Config", "Predictor", "create_predictor", "PredictorTensor",
            "BatchingPredictor", "pick_bucket"]
 
 
-def pick_bucket(n, buckets):
-    """Smallest bucket >= n (the largest bucket when none fits) — ONE copy
-    of the pad-to-bucket rule, shared by :class:`BatchingPredictor` (batch
-    dim) and the serving engine's prefill (batch AND sequence dims): a
-    small bucket set keeps XLA's compile cache bounded while filling the
-    padded shape."""
+def pick_bucket(n, buckets, strict=False):
+    """Smallest bucket >= n — ONE copy of the pad-to-bucket rule, shared
+    by :class:`BatchingPredictor` (batch dim) and the serving engine's
+    bucketed fallback (batch AND sequence dims): a small bucket set keeps
+    XLA's compile cache bounded while filling the padded shape.
+
+    When ``n`` exceeds the largest bucket the default is the historical
+    clamp-down (callers like BatchingPredictor split oversize batches
+    themselves). ``strict=True`` raises instead (ISSUE 13 satellite): a
+    serving launch sized by a clamped-down bucket would index past its
+    padding and silently truncate the round — callers that cannot split
+    must fail loudly."""
     for b in buckets:
         if b >= n:
             return b
+    if strict:
+        raise ValueError(
+            f"batch of {n} exceeds the largest configured bucket "
+            f"{buckets[-1]} — split the round or widen the bucket set "
+            "(a clamped-down launch would truncate the round)")
     return buckets[-1]
 
 

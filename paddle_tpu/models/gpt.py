@@ -140,6 +140,45 @@ def _pool_write_seq(pool, new, block_tables, positions, lens):
                  [pool, new, block_tables, positions, lens])
 
 
+def _pool_write_ragged(pool, new, block_tables, row_starts, row_lens,
+                       kv_lens):
+    """Ragged serving round: scatter the FLAT token stream's K or V
+    (`new` [1, T, KVH, Dh]) into the page pool — flat token t belongs to
+    row ``row_ids[t]`` at absolute position ``positions[t]`` (segment
+    decomposition via ``ragged_row_index``, one copy with the attention
+    reference); pad tokens are redirected to the reserved scrap page 0
+    (never read), so one launch serves any prefill/decode mix."""
+    def fwd(p, n, bt, rs, rl, kl):
+        from ..ops.pallas.ragged_attention import ragged_row_index
+        T = n.shape[1]
+        page = p.shape[1]
+        rid, pos, valid = ragged_row_index(rs, rl, kl, T)
+        logical = jnp.clip(pos // page, 0, bt.shape[1] - 1)
+        phys = bt.astype(jnp.int32)[rid, logical]
+        phys = jnp.where(valid, phys, 0)                  # scrap redirect
+        slot = jnp.where(valid, pos % page, 0)
+        return p.at[phys, slot].set(n[0].astype(p.dtype))
+    return apply("ragged_kv_write", fwd,
+                 [pool, new, block_tables, row_starts, row_lens, kv_lens])
+
+
+def _ragged_attend(q, k_pool, v_pool, block_tables, row_starts, row_lens,
+                   kv_lens, impl):
+    """Ragged paged attention over the flat stream `q` [1, T, H, Dh]:
+    token t attends causally over its OWN row's pages up to its absolute
+    position (its K/V was just written — write-then-attend, same order
+    as the decode step). `impl` runs on raw arrays — the serving tier
+    injects the A/B-gated / KV-head-sharded variant."""
+    def fwd(qa, ka, va, bta, rs, rl, kl):
+        out = impl(qa[0], ka, va, rs.astype(jnp.int32),
+                   rl.astype(jnp.int32), kl.astype(jnp.int32),
+                   bta.astype(jnp.int32))
+        return out[None]
+    return apply("ragged_attention", fwd,
+                 [q, k_pool, v_pool, block_tables, row_starts, row_lens,
+                  kv_lens])
+
+
 def _paged_prefill_attend(q, k_pool, v_pool, block_tables, positions,
                           lens, impl):
     """Partial-prefix attention for a prefill chunk `q` [B, S, H, Dh]:
@@ -299,6 +338,26 @@ class GPTAttention(nn.Layer):
             out = F.scaled_dot_product_attention(
                 q, self._expand_kv(kbuf), self._expand_kv(vbuf),
                 attn_mask=mask, dropout_p=0.0, training=False)
+        elif cache is not None and cache.get("ragged"):
+            # ragged serving round (ONE launch for the whole scheduler
+            # round — Ragged Paged Attention shape): x is the FLAT token
+            # stream [1, T, h]; per-row metadata maps each token to its
+            # row's pages and absolute position. K/V scatter and the
+            # ragged attention happen in the same program, so mixed
+            # decode rows + prefill chunks share one launch with no
+            # bucket padding beyond the padded T itself.
+            rs = cache["row_starts"]            # [R] int32
+            rl = cache["row_lens"]              # [R] int32
+            kl = cache["kv_lens"]               # [R] int32 (post-write)
+            bt = cache["block_tables"]          # [R, max_pages] int32
+            kp = _pool_write_ragged(cache["k_pool"], k, bt, rs, rl, kl)
+            vp = _pool_write_ragged(cache["v_pool"], v, bt, rs, rl, kl)
+            cache["k_pool"], cache["v_pool"] = kp, vp
+            impl = cache.get("attn_impl")
+            if impl is None:
+                from ..ops.pallas.ragged_attention import \
+                    ragged_paged_attention_reference as impl
+            out = _ragged_attend(q, kp, vp, bt, rs, rl, kl, impl)
         elif cache is not None and cache.get("paged"):
             # serving decode over the paged KV pool (serving/ engine):
             # one query token per row; this row's K/V goes into the page
@@ -435,7 +494,12 @@ class GPTModel(nn.Layer):
     def forward(self, input_ids, caches=None, pos_offset=0):
         b, s = input_ids.shape
         from .. import ops
-        if isinstance(pos_offset, Tensor) and len(pos_offset.shape) == 1:
+        if isinstance(pos_offset, Tensor) and len(pos_offset.shape) == 2:
+            # per-token absolute positions [B, S] (ragged serving round:
+            # the flat token stream mixes rows at arbitrary offsets, so
+            # positions arrive precomputed rather than as an arange)
+            pos = pos_offset.astype("int64")
+        elif isinstance(pos_offset, Tensor) and len(pos_offset.shape) == 1:
             # per-row offsets [B] (serving decode: ragged absolute
             # positions across the continuous batch)
             pos = pos_offset.astype("int64").unsqueeze(1) \

@@ -1,8 +1,10 @@
 """paddle_tpu.serving — continuous-batching inference over paged KV.
 
-The serving tier (SURVEY layer 11; ROADMAP item 3): a fixed-slot decode
-batch over a paged KV cache, iteration-level scheduling between decode
-steps, streaming token callbacks, A/B-gated paged-attention backends, and
+The serving tier (SURVEY layer 11; ROADMAP items 2+3): ONE ragged paged
+attention launch per scheduler round (mixed decode rows + prefill chunks
+over a paged KV cache — no bucket-compile matrix; ``ragged=False`` keeps
+the bucketed fixed-slot fallback), iteration-level scheduling between
+rounds, streaming token callbacks, A/B-gated attention backends, and
 Poisson open-loop load tooling for the bench.
 
     from paddle_tpu.serving import ServingEngine
@@ -22,8 +24,13 @@ from .decode import (  # noqa: F401
     ab_compare, paged_decode_attention, paged_prefill_attention,
     resolve_backend, sharded_paged_attention, sharded_paged_prefill,
 )
+from .ragged_attention import (  # noqa: F401
+    ab_compare_ragged, pad_total_tokens, ragged_paged_attention,
+    sharded_ragged_attention,
+)
 from .engine import ServingEngine  # noqa: F401
 from .metrics import ServingMetrics  # noqa: F401
 from .load import (  # noqa: F401
-    make_shared_prefix_prompts, run_poisson_load, summarize_requests,
+    make_mixed_length_prompts, make_shared_prefix_prompts,
+    run_poisson_load, summarize_requests,
 )

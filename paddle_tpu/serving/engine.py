@@ -1,8 +1,24 @@
-"""Continuous-batching serving engine: prefill/decode split over paged KV.
+"""Continuous-batching serving engine: one ragged launch per round.
 
 The millions-of-users tier (ROADMAP item 3; SURVEY layer 11). A
 :class:`ServingEngine` wraps a GPT-family ``models.gpt.GPTForCausalLM``
-and runs it as a concurrent serving loop:
+and runs it as a concurrent serving loop.
+
+* **ragged serving (default; ISSUE 13)** — every scheduler round is ONE
+  launch of one jitted program (Ragged Paged Attention, arxiv
+  2604.15464): single-token decode rows, budgeted prefill chunks and
+  prefix-hit prompt tails flatten into a ``[total_tokens]`` token stream
+  with per-row metadata (``row_starts``/``row_lens``/``kv_lens``/block
+  tables); K/V scatter into pages and causal ragged attention happen in
+  the same program. Only ``total_tokens`` is padded (power-of-two
+  schedule) — the (batch, seq) prefill bucket matrix, the per-(batch,
+  chunk) chunk-step compiles, and the fixed-slot decode program collapse
+  into a handful of shape-specializations of ONE callable, counted by
+  ``serving_compiles_total`` / ``serving_distinct_programs``.
+  ``PADDLE_TPU_SERVING_RAGGED=0`` (or ``ragged=False``) falls back to
+  the bucketed paths below, which the bucket knobs now exist for.
+
+The bucketed fallback keeps the pre-ISSUE-13 shape:
 
 * **prefill** — newly admitted requests run the dense causal forward at
   bucketed shapes (batch buckets AND sequence buckets share
@@ -63,6 +79,12 @@ from ..core.autograd import no_grad
 from ..core.tensor import Tensor
 from ..inference import pick_bucket
 from . import decode as _decode
+from .ragged_attention import (ab_compare_ragged as _ab_compare_ragged,
+                               pad_total_tokens as _pad_total_tokens,
+                               ragged_paged_attention
+                               as _ragged_attention,
+                               sharded_ragged_attention
+                               as _sharded_ragged_attention)
 from .kv_cache import PagedKVCache, pages_for
 from .metrics import ServingMetrics
 from .prefix_cache import PrefixCache
@@ -124,7 +146,7 @@ class ServingEngine:
                  prefill_batch_buckets=None, attn_backend=None, mesh=None,
                  mesh_axis="model", jit=True, registry=None,
                  prefill_chunk=None, prefill_token_budget=None,
-                 prefix_cache=True):
+                 prefix_cache=True, ragged=None):
         cfg = model.config
         self.model = model
         self.model.eval()
@@ -191,11 +213,19 @@ class ServingEngine:
             self._chunk_buckets = sorted(cb)
         else:
             self._chunk_buckets = list(self.prefill_seq_buckets)
+        # ragged serving (ISSUE 13): the whole scheduler round is ONE
+        # launch of one jitted program; the bucketed paths (and their
+        # bucket knobs above) stay as the explicit fallback
+        if ragged is None:
+            ragged = os.environ.get("PADDLE_TPU_SERVING_RAGGED",
+                                    "1") not in ("0", "false", "off")
+        self.ragged = bool(ragged)
         # ---- paged-attention backend (A/B gated; standing kernel rule)
         requested = _decode.resolve_backend(attn_backend)
         self.attn_ab = None
         if requested == "auto":
-            self.attn_ab = self._run_ab_gate()
+            self.attn_ab = self._run_ab_gate_ragged() if self.ragged \
+                else self._run_ab_gate()
             self.attn_backend = self.attn_ab["backend"]
         else:
             self.attn_backend = requested
@@ -211,12 +241,17 @@ class ServingEngine:
                 mesh, axis_name=mesh_axis, backend=self.attn_backend)
             self._prefill_attn_impl = _decode.sharded_paged_prefill(
                 mesh, axis_name=mesh_axis)
+            self._ragged_attn_impl = _sharded_ragged_attention(
+                mesh, axis_name=mesh_axis, backend=self.attn_backend)
         else:
             backend = self.attn_backend
             self._attn_impl = lambda q, kp, vp, bt, lens: \
                 _decode.paged_decode_attention(q, kp, vp, bt, lens,
                                                backend=backend)
             self._prefill_attn_impl = _decode.paged_prefill_attention
+            self._ragged_attn_impl = lambda q, kp, vp, rs, rl, kl, bt: \
+                _ragged_attention(q, kp, vp, rs, rl, kl, bt,
+                                  backend=backend)
         self._params = list(model.parameters())
         self._param_arrays = [p._data for p in self._params]
         self._jit = bool(jit)
@@ -234,6 +269,16 @@ class ServingEngine:
         # callable, shape-specialized per (batch, chunk) bucket pair
         self._chunk_fn = self._build_chunk_prefill()
         self._chunk_fns = {}
+        # the ragged round: ONE callable; jax.jit shape-specializes it
+        # per padded total_tokens only (pad_total_tokens schedule). The
+        # pads it has served live in _ragged_shapes; every installed
+        # shape-specialized program — ragged pad, prefill/chunk bucket
+        # pair, the fixed-slot decode step — lands in _programs, feeding
+        # serving_compiles_total / serving_distinct_programs (the
+        # bucket-matrix elimination as a measured number)
+        self._ragged_fn = self._build_ragged_step()
+        self._ragged_shapes: set = set()
+        self._programs: set = set()
         self._steps = 0
         self._decode_tokens = 0
         self._chunk_tokens = 0
@@ -267,6 +312,33 @@ class ServingEngine:
                        min(self.page_size, self.cfg.max_seq_len), np.int32)
         return _decode.ab_compare(q, self.kv.k[0], self.kv.v[0], bt, lens)
 
+    def _run_ab_gate_ragged(self):
+        """Measure XLA vs Pallas at this engine's ragged launch shape
+        (a full round: every slot a decode row, padded to the schedule);
+        'auto' resolves to the winner (Pallas never wins off-TPU)."""
+        H = self.cfg.num_heads
+        Dh = self.cfg.hidden_size // H
+        R = self.max_slots
+        T = _pad_total_tokens(R + self._prefill_budget)
+        key = jax.random.PRNGKey(0)
+        q = jax.random.normal(key, (T, H, Dh), self.kv.dtype)
+        rs = np.arange(R, dtype=np.int32)
+        rl = np.ones(R, np.int32)
+        kl = np.full(R, min(self.page_size, self.cfg.max_seq_len),
+                     np.int32)
+        bt = np.zeros((R, self.max_pages), np.int32)
+        return _ab_compare_ragged(q, self.kv.k[0], self.kv.v[0],
+                                         rs, rl, kl, bt)
+
+    def _note_program(self, key):
+        """Record the installation of a new shape-specialized callable
+        (ragged pad, prefill/chunk bucket pair, decode step) — the
+        bounded-compile contract as a measured number."""
+        if key in self._programs:
+            return
+        self._programs.add(key)
+        self.metrics.on_compile(len(self._programs))
+
     # ----------------------------------------------------------- decode fn
     def _build_step(self):
         model, params = self.model, self._params
@@ -298,7 +370,246 @@ class ServingEngine:
             return jax.jit(step, donate_argnums=(4, 5))
         return jax.jit(step)
 
+    # -------------------------------------------------------- ragged round
+    def _build_ragged_step(self):
+        """ONE program for the whole scheduler round: embed the flat
+        token stream at per-token positions, scatter every row's K/V into
+        its pages, run ragged paged attention, and hand back one
+        next-token + logit row per batch row (the row's LAST valid
+        token's logits — a decode row's next token, a completing prefill
+        row's first token). Params are real arguments (no giant closure
+        constants), pools are donated on TPU; jax.jit specializes per
+        padded total_tokens ONLY."""
+        model, params = self.model, self._params
+        L = self.cfg.num_layers
+        attn_impl = self._ragged_attn_impl
+        from ..ops.pallas.ragged_attention import ragged_row_index
+
+        def rstep(arrays, tokens, row_starts, row_lens, kv_lens, bt,
+                  k_pools, v_pools):
+            with no_grad(), _swap_params(params, arrays):
+                T = tokens.shape[0]
+                _, pos, valid = ragged_row_index(row_starts, row_lens,
+                                                 kv_lens, T)
+                positions = jnp.where(valid, pos, 0).astype(jnp.int32)
+                caches = [{"ragged": True,
+                           "k_pool": Tensor(k_pools[i]),
+                           "v_pool": Tensor(v_pools[i]),
+                           "block_tables": Tensor(bt),
+                           "row_starts": Tensor(row_starts),
+                           "row_lens": Tensor(row_lens),
+                           "kv_lens": Tensor(kv_lens),
+                           "attn_impl": attn_impl}
+                          for i in range(L)]
+                logits = model(Tensor(tokens[None, :]), caches=caches,
+                               pos_offset=Tensor(positions[None, :]))
+                # each row's last valid token carries the round's output
+                # logit; unused rows clip to garbage the host ignores
+                last = jnp.clip(row_starts + row_lens - 1, 0, T - 1)
+                row_logits = logits._data[0, last]
+                nxt = jnp.argmax(row_logits, axis=-1).astype(jnp.int32)
+                return (nxt, row_logits,
+                        [c["k_pool"]._data for c in caches],
+                        [c["v_pool"]._data for c in caches])
+
+        if not self._jit:
+            return rstep
+        if _decode.on_tpu():
+            return jax.jit(rstep, donate_argnums=(6, 7))
+        return jax.jit(rstep)
+
+    def warm_ragged(self, max_tokens=None):
+        """Pre-compile the ragged program at every token pad up to
+        ``max_tokens``. A pad first seen mid-run costs one XLA compile
+        inside a serving round — an ITL spike the schedule makes rare
+        but warmup makes impossible. The default covers the engine's
+        true worst-case round: every slot decoding plus one prefill
+        budget of chunk tokens when chunking is on, or every slot
+        carrying a whole max-length prompt when it is off (unchunked
+        engines serving known-short prompts should pass a tighter
+        ``max_tokens`` rather than compile the full ladder). The warm
+        launches carry zero valid rows: every token is padding, so the
+        writes land on the reserved scrap page and no request state is
+        touched. Serialized against concurrent rounds — the launches
+        consume (and on TPU donate) the live pools. -> the list of pads
+        compiled."""
+        if not self.ragged:
+            return []
+        if max_tokens is None:
+            if self.prefill_chunk is not None:
+                # a round carries max(1, budget // chunk) prefill rows of
+                # up to chunk tokens EACH — with budget < chunk that one
+                # row still takes a whole chunk, so the worst case is the
+                # row count times the chunk, not the budget itself
+                rows = max(1, self._prefill_budget // self.prefill_chunk)
+                max_tokens = self.max_slots + rows * self.prefill_chunk
+            else:
+                max_tokens = self.max_slots * self.cfg.max_seq_len
+        max_tokens = min(int(max_tokens),
+                         self.max_slots * self.cfg.max_seq_len)
+        pads, t = [], 1
+        while True:
+            p = _pad_total_tokens(t)
+            pads.append(p)
+            if p >= max_tokens:
+                break
+            t = p + 1
+        R = self.max_slots
+        with self._step_lock:
+            for p in pads:
+                if p in self._ragged_shapes:
+                    continue
+                self._ragged_shapes.add(p)
+                self._note_program(("ragged", p))
+                _, _, self.kv.k, self.kv.v = self._ragged_fn(
+                    self._param_arrays, jnp.zeros(p, jnp.int32),
+                    jnp.full(R, p, jnp.int32), jnp.zeros(R, jnp.int32),
+                    jnp.zeros(R, jnp.int32),
+                    jnp.zeros((R, self.max_pages), jnp.int32),
+                    list(self.kv.k), list(self.kv.v))
+        return pads
+
+    def _step_ragged(self):
+        """One ragged scheduler round: admit, grow/evict, then assemble
+        decode rows + prefill chunks (budget-bounded FIFO, chunk-boundary
+        semantics identical to the bucketed chunk step) into ONE flat
+        launch. -> decode tokens emitted."""
+        admitted = self.scheduler.schedule()
+        for req in admitted:
+            self.metrics.on_admit(req)
+            req.state = "prefilling"
+            self._prefilling.append(req)
+        _, evicted = self.scheduler.ensure_decode_capacity()
+        for req in evicted:
+            self.metrics.on_evict(req)
+        self._prefilling = [r for r in self._prefilling
+                            if r.state == "prefilling"]
+        decode_rows = sorted(
+            (r for r in self.scheduler.active.values()
+             if r.state == "active"), key=lambda r: r.slot)
+        # prefill rows: FIFO, at most budget // chunk rows per round each
+        # contributing one chunk (same budget spreading as the bucketed
+        # chunk step — ITL stays bounded by the budget); unchunked mode
+        # takes every pending row's whole remaining tail
+        if self.prefill_chunk is not None:
+            n_rows = max(1, self._prefill_budget // self.prefill_chunk)
+            prefill_rows = self._prefilling[:n_rows]
+        else:
+            prefill_rows = list(self._prefilling)
+        plan = [(req, 1, req.generated[-1:]) for req in decode_rows]
+        prompts = {}
+        for req in prefill_rows:
+            p = req.effective_prompt()
+            prompts[req.request_id] = p
+            take = len(p) - req.num_cached
+            if self.prefill_chunk is not None:
+                take = min(take, self.prefill_chunk)
+            plan.append((req, take,
+                         p[req.num_cached:req.num_cached + take]))
+        if not plan:
+            return 0
+        R, maxp = self.max_slots, self.max_pages
+        total = sum(take for _, take, _ in plan)
+        T = _pad_total_tokens(total)
+        tokens = np.zeros(T, np.int32)
+        row_starts = np.full(R, T, np.int32)   # unused rows: sentinel T
+        row_lens = np.zeros(R, np.int32)
+        kv_lens = np.zeros(R, np.int32)
+        bt = np.zeros((R, maxp), np.int32)
+        cursor = 0
+        for i, (req, take, seg) in enumerate(plan):
+            tokens[cursor:cursor + take] = seg
+            row_starts[i] = cursor
+            row_lens[i] = take
+            kv_lens[i] = req.num_cached + take
+            bt[i, :len(req.pages)] = req.pages
+            cursor += take
+        if T not in self._ragged_shapes:
+            self._ragged_shapes.add(T)
+            self._note_program(("ragged", T))
+        nxt, row_logits, self.kv.k, self.kv.v = self._ragged_fn(
+            self._param_arrays, jnp.asarray(tokens),
+            jnp.asarray(row_starts), jnp.asarray(row_lens),
+            jnp.asarray(kv_lens), jnp.asarray(bt),
+            list(self.kv.k), list(self.kv.v))
+        completing = [req for req, take, _ in plan[len(decode_rows):]
+                      if req.num_cached + take
+                      >= len(prompts[req.request_id])]
+        any_sampling = any(r.temperature > 0.0
+                           for r in decode_rows + completing)
+        # tpu-lint: ok[HS002] designed sync: ONE batched token fetch per ragged round feeds host-side scheduling/sampling
+        nxt = np.asarray(nxt)
+        # tpu-lint: ok[HS002] designed sync: the logit rows ride the same per-round host sampling fetch
+        logits_np = np.asarray(row_logits) \
+            if (any_sampling or self.capture_logits is not None) else None
+        if self.capture_logits is not None and decode_rows:
+            cap = np.zeros((self.max_slots,) + logits_np.shape[1:],
+                           logits_np.dtype)
+            for i, req in enumerate(decode_rows):
+                cap[req.slot] = logits_np[i]
+            self.capture_logits.append(
+                (dict((r.slot, r.request_id) for r in decode_rows), cap))
+        # decode rows: account through the scheduler like the fixed-slot
+        # step did (num_cached advance, emit, finish)
+        by_slot = {}
+        for i, req in enumerate(decode_rows):
+            if req.temperature > 0.0:
+                by_slot[req.slot] = _select_token(logits_np[i], req)
+            else:
+                by_slot[req.slot] = int(nxt[i])
+        finished = self.scheduler.complete_step(by_slot)
+        for req in decode_rows:
+            tt = req.token_times
+            self.metrics.on_token(
+                req, tt[-1] - tt[-2] if len(tt) >= 2 else None)
+        for req in finished:
+            self.metrics.on_finish(req)
+        # prefill rows: advance the cursor; a row whose prompt completed
+        # emits its first token this round (TTFT ends here) and decodes
+        # as a decode row from the NEXT round on
+        spent = 0
+        for j, (req, take, _) in enumerate(plan[len(decode_rows):]):
+            i = len(decode_rows) + j
+            prompt = prompts[req.request_id]
+            req.num_cached += take
+            spent += take
+            if req.num_cached < len(prompt):
+                continue
+            tok = _select_token(logits_np[i], req) \
+                if req.temperature > 0.0 else int(nxt[i])
+            self._finish_prompt(req, prompt, tok)
+        if spent:
+            self._chunk_tokens += spent
+            self.metrics.on_prefill_chunk(spent)
+        self._decode_tokens += len(by_slot)
+        return len(by_slot)
+
     # ------------------------------------------------------------- prefill
+    def _finish_prompt(self, req, prompt, tok):
+        """Prompt-completion protocol — ONE copy for the dense, chunked
+        and ragged prefill paths: emit the first generated token (TTFT
+        ends here), flip the row to decoding, index the PRE-emit
+        prompt's pages for prefix sharing, and finish if the budget is
+        already met. ``prompt`` MUST be the pre-emit prompt:
+        ``effective_prompt()`` after emit includes the generated token,
+        whose KV is only written by the NEXT decode step — indexing it
+        would let a (prompt+1)-page-multiple request publish a page with
+        an unwritten slot (garbage KV for any future hit if this request
+        finishes or evicts before that step runs)."""
+        first = not req.generated
+        req.emit(tok)
+        if first:
+            self.metrics.on_first_token(req)
+        self.metrics.on_token(req)
+        req.state = "active"
+        if req in self._prefilling:
+            self._prefilling.remove(req)
+        if self.prefix is not None:
+            self.prefix.insert(prompt, req.pages)
+        if req.hit_stop():
+            self.scheduler.finish(req)
+            self.metrics.on_finish(req)
+
     def _prefill_admitted(self, admitted):
         """Route newly-admitted requests to a prefill path:
 
@@ -312,7 +623,14 @@ class ServingEngine:
         dense = []
         for req in admitted:
             self.metrics.on_admit(req)
-            if self.prefill_chunk is not None or req.num_cached > 0:
+            if (self.prefill_chunk is not None or req.num_cached > 0
+                    or len(req.effective_prompt())
+                    > self.prefill_seq_buckets[-1]):
+                # the third arm is the pick_bucket clamp-down fix (ISSUE
+                # 13 satellite): a prompt longer than the largest
+                # configured seq bucket used to clamp DOWN and blow up
+                # mid-launch — route it through the partial-prefix chunk
+                # step instead, which splits it across launches
                 req.state = "prefilling"
                 self._prefilling.append(req)
             else:
@@ -412,7 +730,11 @@ class ServingEngine:
                       for r in batch)
         want = min(cap, longest) if cap is not None else longest
         sb = pick_bucket(want, self._chunk_buckets)
-        nb = pick_bucket(len(batch), self.prefill_batch_buckets)
+        # batch was pre-clamped to the largest batch bucket above;
+        # strict turns any future violation into a loud error instead of
+        # a silent clamp-down that truncates the round
+        nb = pick_bucket(len(batch), self.prefill_batch_buckets,
+                         strict=True)
         tokens = np.zeros((nb, sb), np.int32)
         positions = np.zeros(nb, np.int32)
         lens = np.zeros(nb, np.int32)
@@ -431,6 +753,7 @@ class ServingEngine:
             lens[i] = take
             bt[i, :len(req.pages)] = req.pages
         self._chunk_fns.setdefault((nb, sb), self._chunk_fn)
+        self._note_program(("chunk", nb, sb))
         logits_arr, self.kv.k, self.kv.v = self._chunk_fn(
             self._param_arrays, jnp.asarray(tokens),
             jnp.asarray(positions), jnp.asarray(lens), jnp.asarray(bt),
@@ -443,23 +766,11 @@ class ServingEngine:
             if req.num_cached < len(prompts[i]):
                 continue
             # prompt complete: last chunk's final logit row is the first
-            # generated token (TTFT ends here), and the prompt's full
-            # pages become shareable for future prefix-cache hits
+            # generated token, and the prompt's full pages become
+            # shareable for future prefix-cache hits
             # tpu-lint: ok[HS002] designed sync: host-side sampling consumes this logit row once per completed prompt
             row = np.asarray(logits_arr[i, take - 1])
-            tok = _select_token(row, req)
-            first = not req.generated
-            req.emit(tok)
-            if first:
-                self.metrics.on_first_token(req)
-            self.metrics.on_token(req)
-            req.state = "active"
-            self._prefilling.remove(req)
-            if self.prefix is not None:
-                self.prefix.insert(prompts[i], req.pages)
-            if req.hit_stop():
-                self.scheduler.finish(req)
-                self.metrics.on_finish(req)
+            self._finish_prompt(req, prompts[i], _select_token(row, req))
         self._chunk_tokens += spent
         self.metrics.on_prefill_chunk(spent)
         return spent
@@ -470,7 +781,9 @@ class ServingEngine:
         row's first `len` K/V rows are exact. Jitted per bucket pair —
         prompts of different lengths share the bucket's one program."""
         n = len(reqs)
-        nb = pick_bucket(n, self.prefill_batch_buckets)
+        # strict: the caller split the round by the largest batch bucket,
+        # so a clamp-down here could only mean indexing past the pad
+        nb = pick_bucket(n, self.prefill_batch_buckets, strict=True)
         ids = np.zeros((nb, seq_bucket), np.int64)
         lens, prompts = [], []
         for i, req in enumerate(reqs):
@@ -479,6 +792,7 @@ class ServingEngine:
             ids[i, :len(p)] = p
             lens.append(len(p))
         self._prefill_fns.setdefault((nb, seq_bucket), self._prefill_fn)
+        self._note_program(("prefill", nb, seq_bucket))
         logits_arr, ks, vs = self._prefill_fn(self._param_arrays,
                                               jnp.asarray(ids))
         for i, req in enumerate(reqs):
@@ -489,30 +803,11 @@ class ServingEngine:
             req.num_cached = ln
             # tpu-lint: ok[HS002] designed sync: host-side sampling consumes this logit row once per prefilled request
             row = np.asarray(logits_arr[i, ln - 1])
-            tok = _select_token(row, req)
-            first = not req.generated
-            req.emit(tok)
-            if first:
-                self.metrics.on_first_token(req)
-            self.metrics.on_token(req)
-            if self.prefix is not None:
-                # index the prompt's full pages for future shared-head
-                # hits (the request keeps its own refcount; insertion
-                # before finish so a finishing request's pages park in
-                # the reclaimable LRU instead of the free list). MUST use
-                # the pre-emit prompt: effective_prompt() now includes the
-                # just-generated token, whose KV is only written by the
-                # NEXT decode step — indexing it would let a (prompt+1)-
-                # page-multiple request publish a page with an unwritten
-                # slot (garbage KV for any future hit if this request
-                # finishes or evicts before that decode step runs)
-                self.prefix.insert(prompts[i], req.pages)
-            if req.hit_stop():
-                self.scheduler.finish(req)
-                self.metrics.on_finish(req)
+            self._finish_prompt(req, prompts[i], _select_token(row, req))
 
     # ---------------------------------------------------------- decode step
     def _decode_once(self, active):
+        self._note_program(("decode",))
         S, maxp = self.max_slots, self.max_pages
         tokens = np.zeros(S, np.int32)
         positions = np.zeros(S, np.int32)
@@ -553,14 +848,31 @@ class ServingEngine:
         return len(by_slot)
 
     # ------------------------------------------------------------ stepping
+    def _step_bucketed(self):
+        """The bucketed fallback round (pre-ISSUE-13 shape): dense/chunk
+        prefill launches, then ONE fixed-slot decode step."""
+        admitted = self.scheduler.schedule()
+        if admitted:
+            self._prefill_admitted(admitted)
+        if self.prefill_chunk is not None and self._prefilling:
+            # budgeted interleave: one bounded chunk launch per round
+            self._run_chunk_batch()
+        _, evicted = self.scheduler.ensure_decode_capacity()
+        for req in evicted:
+            self.metrics.on_evict(req)
+        active = {slot: r for slot, r in self.scheduler.active.items()
+                  if r.state == "active"}
+        return self._decode_once(active) if active else 0
+
     def step(self):
-        """One scheduler round: finish/admit, advance pending prefills by
-        at most the chunk-token budget, then ONE decode step over every
-        active slot. -> decode tokens emitted (0 when idle). Admission and
-        chunked prefill ride the same round as decode, so in-flight
-        requests never skip a step while a newcomer prefills — the gap
-        between two decode steps is bounded by the chunk budget, not by
-        the longest prompt in the queue."""
+        """One scheduler round -> decode tokens emitted (0 when idle).
+        Ragged (default): admission, budgeted prefill chunks and every
+        active row's decode token ride ONE flat launch of one program.
+        Bucketed fallback: dense/chunked prefill launches then the
+        fixed-slot decode step. Either way a newcomer prefilling never
+        stalls in-flight rows — the gap between two decode steps is
+        bounded by the chunk budget, not by the longest prompt in the
+        queue."""
         if self._loop_error is not None:
             raise EngineClosed(
                 f"engine unhealthy: serve loop crashed with "
@@ -569,18 +881,8 @@ class ServingEngine:
         if self._closed:
             raise EngineClosed("engine is closed")
         with self._step_lock:
-            admitted = self.scheduler.schedule()
-            if admitted:
-                self._prefill_admitted(admitted)
-            if self.prefill_chunk is not None and self._prefilling:
-                # budgeted interleave: one bounded chunk launch per round
-                self._run_chunk_batch()
-            _, evicted = self.scheduler.ensure_decode_capacity()
-            for req in evicted:
-                self.metrics.on_evict(req)
-            active = {slot: r for slot, r in self.scheduler.active.items()
-                      if r.state == "active"}
-            emitted = self._decode_once(active) if active else 0
+            emitted = self._step_ragged() if self.ragged \
+                else self._step_bucketed()
             occ = self.kv.occupancy_pct()
             self._peak_occupancy = max(self._peak_occupancy, occ)
             alloc = self.kv.allocator
@@ -811,6 +1113,9 @@ class ServingEngine:
             "num_kv_heads": self.num_kv_heads,
             "prefill_chunk": self.prefill_chunk,
             "prefill_chunk_tokens": self._chunk_tokens,
+            "ragged": self.ragged,
+            "distinct_programs": len(self._programs),
+            "ragged_token_pads": sorted(self._ragged_shapes),
         }
         if self.prefix is not None:
             out.update({

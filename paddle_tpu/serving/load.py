@@ -15,7 +15,7 @@ import time
 import numpy as np
 
 __all__ = ["run_poisson_load", "summarize_requests",
-           "make_shared_prefix_prompts"]
+           "make_shared_prefix_prompts", "make_mixed_length_prompts"]
 
 
 def _pct(values, q):
@@ -79,9 +79,41 @@ def make_shared_prefix_prompts(n_requests, prompt_len, vocab,
             for _ in range(n_requests)]
 
 
+def make_mixed_length_prompts(n_requests, prompt_len, vocab,
+                              decode_heavy=0.5, max_new_tokens=(4, 24),
+                              seed=0):
+    """The ragged stress workload (ISSUE 13): prompt lengths drawn
+    **log-uniform** over ``prompt_len=(lo, hi)`` — the long-tailed mix
+    where a bucketed engine pads worst (most prompts are short, the
+    bucket grid is sized for the long tail) — with a
+    ``decode_heavy``-probability knob: a decode-heavy request keeps its
+    prompt at the short end (capped at the geometric midpoint) and
+    generates ``max_new_tokens[1]`` tokens; a prefill-heavy request
+    keeps its log-uniform length and generates only ``max_new_tokens[0]``.
+    Deterministic per seed, so the ragged engine and its bucketed twin
+    see identical load. -> ``(prompts, max_new_tokens_per_request)``."""
+    rng = np.random.RandomState(seed)
+    lo, hi = int(prompt_len[0]), int(prompt_len[1])
+    if not 1 <= lo <= hi:
+        raise ValueError(f"prompt_len {prompt_len!r} must be 1 <= lo <= hi")
+    mid = int(np.sqrt(lo * hi))
+    n_lo, n_hi = int(max_new_tokens[0]), int(max_new_tokens[1])
+    prompts, news = [], []
+    for _ in range(int(n_requests)):
+        ln = int(round(np.exp(rng.uniform(np.log(lo), np.log(hi)))))
+        ln = min(max(ln, lo), hi)
+        if rng.rand() < decode_heavy:
+            ln, new = min(ln, max(mid, lo)), n_hi
+        else:
+            new = n_lo
+        prompts.append(rng.randint(1, vocab, size=ln).tolist())
+        news.append(new)
+    return prompts, news
+
+
 def run_poisson_load(engine, n_requests=32, qps=10.0, prompt_len=(8, 24),
                      max_new_tokens=12, eos_token_id=None, seed=0,
-                     timeout=300.0, shared_prefix=None):
+                     timeout=300.0, shared_prefix=None, prompts=None):
     """Submit ``n_requests`` at Poisson arrivals of rate ``qps`` (prompts
     are uniform-random token ids of uniform-random length in
     ``prompt_len``), wait for completion, -> summary dict. The engine
@@ -93,15 +125,26 @@ def run_poisson_load(engine, n_requests=32, qps=10.0, prompt_len=(8, 24),
     every prompt is one common ``N``-token head plus the random tail
     (:func:`make_shared_prefix_prompts`), so the engine's prefix cache —
     when enabled — sees a realistic hit mix; ``prompt_len`` then sizes
-    the per-request tail."""
+    the per-request tail.
+
+    ``prompts=`` overrides generation entirely (a pre-built workload like
+    :func:`make_mixed_length_prompts`); ``max_new_tokens`` may then be a
+    per-request sequence of the same length."""
     rng = np.random.RandomState(seed)
     vocab = engine.cfg.vocab_size
     lo, hi = prompt_len
+    if prompts is not None:
+        n_requests = len(prompts)
     gaps = rng.exponential(1.0 / qps, size=n_requests)
-    prompts = None
-    if shared_prefix:
+    if prompts is None and shared_prefix:
         prompts = make_shared_prefix_prompts(
             n_requests, prompt_len, vocab, shared_prefix, seed=seed)
+    per_req_new = max_new_tokens if hasattr(max_new_tokens, "__len__") \
+        else [max_new_tokens] * n_requests
+    if len(per_req_new) != n_requests:
+        raise ValueError(
+            f"max_new_tokens sequence has {len(per_req_new)} entries for "
+            f"{n_requests} requests")
     requests = []
     t_start = time.perf_counter()
     for i in range(n_requests):
@@ -112,7 +155,7 @@ def run_poisson_load(engine, n_requests=32, qps=10.0, prompt_len=(8, 24),
         prompt = prompts[i] if prompts is not None else \
             rng.randint(1, vocab, size=rng.randint(lo, hi + 1)).tolist()
         req = engine.submit(list(prompt),
-                            max_new_tokens=int(max_new_tokens),
+                            max_new_tokens=int(per_req_new[i]),
                             eos_token_id=eos_token_id, timeout=timeout)
         requests.append(req)
     deadline = time.perf_counter() + timeout

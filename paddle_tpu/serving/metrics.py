@@ -19,6 +19,10 @@ runs share the JSONL snapshot/report plumbing with training. Names:
   page gauges
 * ``serving_prefill_chunk_tokens_total`` — chunk-tokens processed by the
   budgeted chunked-prefill interleave
+* ``serving_compiles_total`` — counter: every shape-specialized callable
+  the engine installs (ragged token pad, prefill/chunk bucket pair,
+  decode step); ``serving_distinct_programs`` — gauge: how many are live
+  (the ISSUE-13 bucket-matrix elimination as a measured number)
 
 ``serving_queue_wait_ms`` observes each request's **cumulative** queue
 wait once, at its terminal state (re-admissions carry their pre-eviction
@@ -151,3 +155,16 @@ class ServingMetrics:
         if reg is None:
             return
         reg.counter("serving_prefill_chunk_tokens_total").inc(n_tokens)
+
+    def on_compile(self, distinct_programs):
+        """The engine installed a NEW shape-specialized callable (ragged
+        token pad, prefill/chunk bucket pair, or the decode step) — the
+        compile-count observability of ISSUE 13: the ragged rebuild's
+        bucket-matrix elimination must be a measured number, and a
+        regression (a knob reintroducing a bucket grid) must show up in
+        the snapshot JSON."""
+        reg = self._reg
+        if reg is None:
+            return
+        reg.counter("serving_compiles_total").inc()
+        reg.gauge("serving_distinct_programs").set(distinct_programs)

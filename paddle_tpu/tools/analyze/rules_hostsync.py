@@ -37,6 +37,8 @@ HOT_PATHS = {
     },
     "serving/engine.py": {
         "ServingEngine.step",
+        "ServingEngine._step_ragged",
+        "ServingEngine._step_bucketed",
         "ServingEngine._decode_once",
         "ServingEngine._run_chunk_batch",
         "ServingEngine._prefill_batch",

@@ -240,6 +240,40 @@ def test_paged_attention_padded_table_and_zero_context():
                                rtol=1e-6, atol=1e-6)
 
 
+def test_ragged_paged_attention_kernel_matches_reference():
+    """ISSUE 13: the flat-token ragged kernel (interpret mode) matches
+    the gather/segment reference on a mixed launch — a decode row, a
+    whole-prompt prefill, a mid-stream chunk continuation, GQA pools,
+    an unused row and padded tail tokens (zeroed, never NaN)."""
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas.ragged_attention import (
+        ragged_paged_attention, ragged_paged_attention_reference,
+        ragged_row_index,
+    )
+    rng = np.random.RandomState(4)
+    H, KVH, D, PS, NP, MP, T = 4, 2, 32, 4, 16, 4, 16
+    q = jnp.asarray(rng.randn(T, H, D), jnp.float32)
+    kc = jnp.asarray(rng.randn(NP, PS, KVH, D), jnp.float32)
+    vc = jnp.asarray(rng.randn(NP, PS, KVH, D), jnp.float32)
+    bt = jnp.asarray(rng.randint(1, NP, size=(4, MP)), jnp.int32)
+    rs = jnp.asarray([0, 1, 6, T], jnp.int32)   # row 3 unused (sentinel)
+    rl = jnp.asarray([1, 5, 3, 0], jnp.int32)
+    kl = jnp.asarray([7, 5, 9, 0], jnp.int32)
+    ref = np.asarray(
+        ragged_paged_attention_reference(q, kc, vc, rs, rl, kl, bt))
+    out = np.asarray(
+        ragged_paged_attention(q, kc, vc, rs, rl, kl, bt,
+                               interpret=True))
+    assert np.isfinite(out).all()
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(out[9:], 0.0)    # padded tail zeroed
+    # the shared segment decomposition is the contract both sides use
+    rid, pos, valid = ragged_row_index(rs, rl, kl, T)
+    assert np.asarray(rid)[:9].tolist() == [0, 1, 1, 1, 1, 1, 2, 2, 2]
+    assert np.asarray(pos)[:9].tolist() == [6, 0, 1, 2, 3, 4, 6, 7, 8]
+    assert not bool(np.asarray(valid)[9:].any())
+
+
 def test_asp_indivisible_dim_warns():
     """Advisor r3: non-divisible last dim silently skipped pruning."""
     import warnings

@@ -20,6 +20,23 @@ def test_pick_bucket_shared_helper():
     assert pick_bucket(1, [1, 2, 4]) == 1
     assert pick_bucket(3, [1, 2, 4]) == 4
     assert pick_bucket(9, [1, 2, 4]) == 4  # clamp to the largest
+    # ISSUE 13 satellite: serving launch sites that cannot split must
+    # fail loudly instead of clamping down and truncating the round
+    with pytest.raises(ValueError, match="largest configured bucket"):
+        pick_bucket(9, [1, 2, 4], strict=True)
+    assert pick_bucket(4, [1, 2, 4], strict=True) == 4
+
+
+def test_ragged_token_pad_schedule():
+    from paddle_tpu.serving import pad_total_tokens
+    assert pad_total_tokens(1) == 8      # floor: tiny rounds share one
+    assert pad_total_tokens(8) == 8
+    assert pad_total_tokens(9) == 16
+    assert pad_total_tokens(100) == 128
+    # the whole contract: distinct programs over a lifetime are the
+    # log2 of the round-size range, not a bucket-grid product
+    pads = {pad_total_tokens(t) for t in range(1, 129)}
+    assert pads == {8, 16, 32, 64, 128}
 
 
 # ------------------------------------------------------------- allocator
@@ -363,6 +380,34 @@ def test_shared_prefix_workload_generator():
     assert any(p[12:] != a[0][12:] for p in a[1:])  # tails differ
 
 
+def test_make_mixed_length_prompts_deterministic_and_knobbed():
+    """ISSUE 13 satellite: the ragged stress workload — seeded log-
+    uniform prompt lengths, and the decode-heavy/prefill-heavy knob
+    moves both the generation budget and the prompt-length mass."""
+    from paddle_tpu.serving import make_mixed_length_prompts
+    a, na = make_mixed_length_prompts(16, (4, 64), vocab=512,
+                                      decode_heavy=0.5,
+                                      max_new_tokens=(2, 12), seed=5)
+    b, nb = make_mixed_length_prompts(16, (4, 64), vocab=512,
+                                      decode_heavy=0.5,
+                                      max_new_tokens=(2, 12), seed=5)
+    assert (a, na) == (b, nb)
+    assert len(a) == 16 and all(4 <= len(p) <= 64 for p in a)
+    assert set(na) <= {2, 12}
+    assert len({len(p) for p in a}) > 3     # genuinely mixed lengths
+    dec, nd = make_mixed_length_prompts(32, (4, 64), vocab=512,
+                                        decode_heavy=1.0,
+                                        max_new_tokens=(2, 12), seed=5)
+    pre, np_ = make_mixed_length_prompts(32, (4, 64), vocab=512,
+                                         decode_heavy=0.0,
+                                         max_new_tokens=(2, 12), seed=5)
+    assert set(nd) == {12} and set(np_) == {2}
+    mean = lambda ps: sum(len(p) for p in ps) / len(ps)  # noqa: E731
+    assert mean(dec) < mean(pre)            # decode-heavy = short prompts
+    with pytest.raises(ValueError):
+        make_mixed_length_prompts(4, (0, 8), vocab=32)
+
+
 def test_scheduler_close_fails_waiters():
     from paddle_tpu.serving import EngineClosed
     sched = _mk_sched()
@@ -433,9 +478,10 @@ def test_prefill_jitted_per_bucket_bounded_compiles(tiny_model):
     compiled per (batch, seq) bucket — prompts of different lengths that
     map to the same bucket share ONE program, the compile cache is
     bounded by the bucket sets, and the jitted engine decodes the same
-    tokens as the eager one."""
+    tokens as the eager one. (Bucketed FALLBACK path since ISSUE 13 —
+    pinned with ragged=False.)"""
     eng = _engine(tiny_model, prefill_seq_buckets=[8, 16],
-                  prefill_batch_buckets=[1, 2])
+                  prefill_batch_buckets=[1, 2], ragged=False)
     rng = np.random.RandomState(4)
     prompts = [rng.randint(1, 250, n).tolist() for n in (3, 5, 8, 11)]
     jit_tokens = [eng.generate(p, max_new_tokens=3) for p in prompts]
@@ -445,7 +491,7 @@ def test_prefill_jitted_per_bucket_bounded_compiles(tiny_model):
     assert set(eng._prefill_fns) == {(1, 8), (1, 16)}
     assert len(eng._prefill_fns) <= 2 * 2
     eager = _engine(tiny_model, prefill_seq_buckets=[8, 16],
-                    prefill_batch_buckets=[1, 2], jit=False)
+                    prefill_batch_buckets=[1, 2], jit=False, ragged=False)
     assert eager._prefill_fns == {} or all(
         not hasattr(f, "lower") for f in eager._prefill_fns.values())
     for p, jt in zip(prompts, jit_tokens):
@@ -536,7 +582,9 @@ def test_chunked_prefill_no_decode_stall(tiny_model):
     engine round while A is active still yields A a token, even the
     rounds that are chunk-prefilling B's 40-token prompt; and B's prompt
     takes several rounds (budget-bounded) instead of one monolithic
-    prefill."""
+    prefill. (Bucketed-path cadence — a chunk-completion round emits the
+    first token AND the same round's decode token; pinned ragged=False,
+    the ragged twin asserts its one-token-per-launch cadence.)"""
     with pytest.raises(ValueError, match="prefill_token_budget"):
         _engine(tiny_model, prefill_token_budget=64)   # budget sans chunk
     # regression (review finding): a batch-bucket set whose largest entry
@@ -544,14 +592,14 @@ def test_chunked_prefill_no_decode_stall(tiny_model):
     # the padded batch
     narrow = _engine(tiny_model, max_slots=4, num_pages=64,
                      prefill_batch_buckets=[1, 2], prefill_chunk=8,
-                     prefill_token_budget=32)
+                     prefill_token_budget=32, ragged=False)
     rng_n = np.random.RandomState(6)
     reqs = [narrow.submit(rng_n.randint(1, 256, 5).tolist(),
                           max_new_tokens=2) for _ in range(4)]
     narrow.run_until_idle()
     assert [len(r.result(10)) for r in reqs] == [2, 2, 2, 2]
     eng = _engine(tiny_model, num_pages=48, prefill_chunk=8,
-                  prefix_cache=False)
+                  prefix_cache=False, ragged=False)
     rng = np.random.RandomState(5)
     a = eng.submit(rng.randint(1, 256, 5).tolist(), max_new_tokens=10)
     eng.step()  # A chunk-prefills (5 < 8 budget), emits its first token,
@@ -575,6 +623,158 @@ def test_chunked_prefill_no_decode_stall(tiny_model):
     eng.run_until_idle()
     assert len(b.result(10)) == 3
     assert eng.stats()["prefill_chunk_tokens"] >= 40
+
+
+def test_ragged_round_no_decode_stall_and_budget_spread(tiny_model):
+    """ISSUE 13 tentpole acceptance shape, ragged cadence: with the
+    single-launch round, a LONG prompt arriving mid-stream still never
+    stalls an in-flight decode — every round while A is active yields A
+    exactly one token, even the rounds carrying B's 40-token prompt as
+    budget-bounded chunk segments of the SAME launch; and B's prefill
+    really is spread over multiple rounds, never exceeding the chunk
+    budget per round."""
+    eng = _engine(tiny_model, num_pages=48, prefill_chunk=8,
+                  prefix_cache=False)
+    assert eng.ragged
+    eng.warm_ragged()
+    rng = np.random.RandomState(5)
+    a = eng.submit(rng.randint(1, 256, 5).tolist(), max_new_tokens=10)
+    eng.step()   # A's whole 5-token prompt rides one launch: first token
+    assert len(a.generated) == 1
+    b = eng.submit(rng.randint(1, 256, 40).tolist(), max_new_tokens=3)
+    gaps, spent_per_round, rounds_b_pending = [], [], 0
+    while not a.done():
+        before = len(a.generated)
+        chunk_before = eng.stats()["prefill_chunk_tokens"]
+        eng.step()
+        gaps.append(len(a.generated) - before)
+        spent_per_round.append(
+            eng.stats()["prefill_chunk_tokens"] - chunk_before)
+        if not b.generated:
+            rounds_b_pending += 1
+    # A decoded every single round (the no-stall contract of the ONE
+    # ragged launch)...
+    assert all(g == 1 for g in gaps[:-1]), gaps
+    # ...each round's prefill share never exceeded the chunk budget...
+    assert all(s <= 8 for s in spent_per_round), spent_per_round
+    # ...and B's 40-token prompt was spread over >= 5 budgeted rounds
+    assert rounds_b_pending >= 4
+    eng.run_until_idle()
+    assert len(b.result(10)) == 3
+    assert eng.stats()["prefill_chunk_tokens"] >= 45  # A's 5 + B's 40
+    # the compile surface: every program this test ran is a ragged pad
+    st = eng.stats()
+    assert st["distinct_programs"] == len(st["ragged_token_pads"])
+    assert st["distinct_programs"] <= 4
+
+
+def test_compile_counter_flows_through_registry(tiny_model):
+    """ISSUE 13 satellite: every shape-specialized callable the engine
+    installs increments serving_compiles_total and updates the
+    serving_distinct_programs gauge — the bucket-matrix elimination is a
+    measured number on BOTH paths."""
+    from paddle_tpu.observability import metrics as obsm
+    reg = obsm.enable(out_dir=None, interval_s=0)
+    try:
+        eng = _engine(tiny_model, registry=reg, prefill_chunk=6)
+        eng.generate([7] * 11, max_new_tokens=4)
+        snap = reg.snapshot()
+        st = eng.stats()
+        assert st["ragged"] and st["distinct_programs"] >= 1
+        assert snap["counters"]["serving_compiles_total"] \
+            == st["distinct_programs"] == len(st["ragged_token_pads"])
+        assert snap["gauges"]["serving_distinct_programs"] \
+            == st["distinct_programs"]
+        # a repeat at the same shapes installs nothing new
+        eng.generate([9] * 11, max_new_tokens=4)
+        snap2 = reg.snapshot()
+        assert snap2["counters"]["serving_compiles_total"] \
+            == snap["counters"]["serving_compiles_total"]
+    finally:
+        obsm.disable()
+    reg2 = obsm.enable(out_dir=None, interval_s=0)
+    try:
+        # bucketed twin: the counter sees the (batch, seq) grid + decode
+        buck = _engine(tiny_model, registry=reg2, ragged=False,
+                       prefill_seq_buckets=[8, 16],
+                       prefill_batch_buckets=[1, 2])
+        buck.generate([7] * 5, max_new_tokens=2)
+        buck.generate([7] * 11, max_new_tokens=2)
+        snap = reg2.snapshot()
+        st = buck.stats()
+        assert not st["ragged"] and st["ragged_token_pads"] == []
+        # (1, 8) + (1, 16) prefill programs + the decode step
+        assert snap["counters"]["serving_compiles_total"] \
+            == st["distinct_programs"] == 3
+    finally:
+        obsm.disable()
+
+
+def test_oversized_prompt_routes_through_chunk_step_not_clampdown(
+        tiny_model):
+    """pick_bucket clamp-down regression (ISSUE 13 satellite): on the
+    bucketed fallback, a prompt LONGER than the largest configured seq
+    bucket used to clamp down and blow up mid-launch — it now routes
+    through the partial-prefix chunk step, which splits it across
+    launches, token-identical to the dense decode."""
+    from paddle_tpu.serving import ServingEngine
+    rng = np.random.RandomState(9)
+    prompt = rng.randint(1, 256, size=20).tolist()
+    eng = ServingEngine(tiny_model, page_size=4, num_pages=32,
+                        max_slots=2, prefill_seq_buckets=[8],
+                        attn_backend="xla", ragged=False)
+    got = eng.generate(prompt, max_new_tokens=4)
+    # the dense bucket path never ran (it cannot hold 20 > 8 tokens);
+    # the chunk step carried the whole prompt in 8-token slices
+    assert eng._prefill_fns == {}
+    assert all(sb <= 8 for _, sb in eng._chunk_fns)
+    ref = ServingEngine(tiny_model, page_size=4, num_pages=32,
+                        max_slots=2, attn_backend="xla")
+    assert got == ref.generate(prompt, max_new_tokens=4)
+
+
+def test_ragged_backend_gate_auto_demotes_off_tpu(tiny_model,
+                                                  monkeypatch):
+    """ISSUE 13 acceptance: under auto resolution the ragged engine runs
+    the A/B gate at its own launch shape, and off-TPU the Pallas ragged
+    kernel never serves (interpret mode is not a measurement)."""
+    monkeypatch.delenv("PADDLE_TPU_SERVING_ATTN", raising=False)
+    monkeypatch.delenv("PADDLE_TPU_KERNELS", raising=False)
+    eng = _engine(tiny_model, attn_backend=None)   # auto -> gate runs
+    assert eng.ragged
+    assert eng.attn_backend == "xla"
+    assert eng.attn_ab is not None
+    assert eng.attn_ab["pallas_ms"] is None
+    assert "TPU" in eng.attn_ab["reason"] or "xla" in eng.attn_ab["reason"]
+
+
+def test_warm_ragged_precompiles_pad_schedule(tiny_model):
+    """warm_ragged compiles every pad the engine can serve up front (a
+    pad first seen mid-run is one XLA compile inside a round — an ITL
+    spike), touches no request state, and is idempotent."""
+    eng = _engine(tiny_model, prefill_chunk=8, prefill_token_budget=8)
+    pads = eng.warm_ragged()
+    # max round = 2 slots decoding + 8 chunk tokens = 10 -> pads {8, 16}
+    assert pads == [8, 16]
+    st = eng.stats()
+    assert st["distinct_programs"] == 2
+    assert eng.kv.allocator.used_pages == 0
+    eng.warm_ragged()
+    assert eng.stats()["distinct_programs"] == 2   # idempotent
+    # serving after warmup installs nothing new
+    eng.generate([3, 1, 4, 1, 5], max_new_tokens=4)
+    assert eng.stats()["distinct_programs"] == 2
+    # review regression: budget < chunk still carries ONE whole chunk
+    # per round — the default warm coverage must include that pad
+    from paddle_tpu.serving import pad_total_tokens
+    wide = _engine(tiny_model, max_slots=4, num_pages=64,
+                   prefill_chunk=32, prefill_token_budget=8)
+    pads = wide.warm_ragged()
+    assert pads[-1] >= pad_total_tokens(4 + 32)
+    before = wide.stats()["distinct_programs"]
+    rng = np.random.RandomState(0)
+    wide.generate(rng.randint(1, 250, 30).tolist(), max_new_tokens=3)
+    assert wide.stats()["distinct_programs"] == before  # no mid-run compile
 
 
 def test_prefix_metrics_flow_through_registry(tiny_model):

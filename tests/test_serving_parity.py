@@ -245,6 +245,140 @@ def test_gqa_sharded_paged_decode_parity():
     np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
 
 
+def test_ragged_vs_bucketed_mixed_rounds_token_parity(seeded_model):
+    """ISSUE 13 acceptance: the ragged single-launch round is token-
+    identical to the bucketed path on mixed prefill+decode rounds —
+    staggered admissions so in-flight decodes share launches with chunk
+    segments whose boundaries land mid-page (chunk=6 on page_size=4),
+    plus a prefix-cache hit on a repeated prompt."""
+    from paddle_tpu.serving import ServingEngine
+    rng = np.random.RandomState(12)
+    prompts = [rng.randint(1, 256, size=n).tolist()
+               for n in (11, 12, 3, 9)]
+
+    def run(ragged):
+        eng = ServingEngine(seeded_model, page_size=4, num_pages=64,
+                            max_slots=4, prefill_chunk=6,
+                            prefill_token_budget=12, attn_backend="xla",
+                            ragged=ragged)
+        r0 = eng.submit(prompts[0], max_new_tokens=6)
+        eng.step()                       # r0 mid-prefill / first decode
+        rest = [eng.submit(p, max_new_tokens=6) for p in prompts[1:]]
+        eng.run_until_idle()
+        rep = eng.submit(prompts[0], max_new_tokens=6)   # prefix hit
+        eng.run_until_idle()
+        assert eng.stats()["prefix_hits"] >= 1
+        return [r.result(10) for r in [r0] + rest + [rep]]
+
+    ragged, bucketed = run(True), run(False)
+    assert ragged == bucketed
+    for p, toks in zip(prompts + [prompts[0]], ragged):
+        assert toks == _dense_greedy(seeded_model, p, 6)
+
+
+def test_sharded_ragged_attention_parity():
+    """KV-head sharding over a 2-device 'model' mesh reproduces the
+    unsharded ragged launch (query-head groups stay with their KV head;
+    metadata replicates — the sharded_paged_attention partitioning on
+    the flat-token layout)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+    from paddle_tpu.serving import (ragged_paged_attention,
+                                    sharded_ragged_attention)
+    rng = np.random.RandomState(13)
+    H, KVH, D, P, page, maxp, R, T = 8, 2, 8, 16, 4, 4, 3, 16
+    q = jnp.asarray(rng.randn(T, H, D).astype("float32"))
+    kp = jnp.asarray(rng.randn(P, page, KVH, D).astype("float32"))
+    vp = jnp.asarray(rng.randn(P, page, KVH, D).astype("float32"))
+    bt = jnp.asarray(rng.randint(1, P, size=(R, maxp)).astype("int32"))
+    # a decode row, a fresh 5-token prefill, a chunk continuation at 6
+    rs = jnp.asarray(np.array([0, 1, 6], np.int32))
+    rl = jnp.asarray(np.array([1, 5, 3], np.int32))
+    kl = jnp.asarray(np.array([7, 5, 9], np.int32))
+    ref = np.asarray(ragged_paged_attention(q, kp, vp, rs, rl, kl, bt))
+    mesh = Mesh(np.array(jax.devices()[:2]), ("model",))
+    out = np.asarray(
+        sharded_ragged_attention(mesh)(q, kp, vp, rs, rl, kl, bt))
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.slow
+def test_ragged_kills_bucket_matrix_on_mixed_length_workload(
+        seeded_model):
+    """ISSUE 13 acceptance: on a mixed-length workload the dense
+    bucketed path compiles a >= 8 program (batch, seq)-bucket matrix;
+    the ragged path serves the SAME workload token-identically with
+    <= 4 programs — asserted via the serving_compiles_total counter."""
+    from paddle_tpu.observability import metrics as obsm
+    from paddle_tpu.serving import ServingEngine
+    rng = np.random.RandomState(14)
+    burst1 = [rng.randint(1, 256, size=n).tolist()
+              for n in (3, 9, 17, 33)]    # one per seq bucket
+    burst2 = [rng.randint(1, 256, size=n).tolist()
+              for n in (4, 4, 10, 10, 18, 18)]   # nb=2 bucket groups
+
+    def run(ragged):
+        reg = obsm.enable(out_dir=None, interval_s=0)
+        try:
+            eng = ServingEngine(
+                seeded_model, page_size=4, num_pages=64, max_slots=4,
+                prefill_seq_buckets=[8, 16, 32, 64],
+                prefill_batch_buckets=[1, 2, 4], prefix_cache=False,
+                attn_backend="xla", ragged=ragged)
+            out = []
+            for burst in (burst1, burst2):
+                reqs = [eng.submit(p, max_new_tokens=2) for p in burst]
+                eng.run_until_idle()
+                out += [r.result(10) for r in reqs]
+            snap = reg.snapshot()
+            st = eng.stats()
+            assert snap["counters"]["serving_compiles_total"] \
+                == st["distinct_programs"]
+        finally:
+            obsm.disable()
+        return out, st
+
+    toks_rag, st_rag = run(True)
+    toks_buck, st_buck = run(False)
+    assert toks_rag == toks_buck
+    assert st_buck["distinct_programs"] >= 8      # the bucket matrix
+    assert st_rag["distinct_programs"] <= 4       # the ragged schedule
+
+
+@pytest.mark.slow
+def test_ragged_mixed_length_poisson_soak(seeded_model):
+    """ISSUE 13 bench-shaped acceptance: the seeded mixed-length Poisson
+    soak (log-uniform prompts, decode-heavy mix) on the ragged chunked
+    engine — everything completes, the bounded-compile contract holds
+    (<= 4 distinct programs, all of them ragged pads), and the pool
+    drains."""
+    from paddle_tpu.serving import (ServingEngine,
+                                    make_mixed_length_prompts,
+                                    run_poisson_load)
+    prompts, news = make_mixed_length_prompts(
+        24, (3, 48), vocab=256, decode_heavy=0.6,
+        max_new_tokens=(2, 8), seed=11)
+    eng = ServingEngine(seeded_model, page_size=4, num_pages=64,
+                        max_slots=4, prefill_chunk=8,
+                        attn_backend="xla")
+    eng.warm_ragged()
+    eng.start()
+    try:
+        res = run_poisson_load(eng, qps=40.0, prompts=prompts,
+                               max_new_tokens=news, seed=11,
+                               timeout=300.0)
+        st = eng.stats()
+    finally:
+        eng.close()
+    assert res["requests_failed"] == 0
+    assert res["requests_ok"] == 24
+    assert res["tokens"] == sum(news)
+    assert st["distinct_programs"] <= 4
+    assert st["distinct_programs"] == len(st["ragged_token_pads"])
+    assert eng.kv.allocator.used_pages == 0
+
+
 @pytest.mark.slow
 def test_chunked_long_prompt_bounds_itl(seeded_model):
     """Slow acceptance: a near-max-seq prompt injected mid-stream. The
@@ -259,7 +393,7 @@ def test_chunked_long_prompt_bounds_itl(seeded_model):
     def run(chunk):
         eng = ServingEngine(seeded_model, page_size=4, num_pages=64,
                             max_slots=4, prefill_chunk=chunk,
-                            prefix_cache=False)
+                            prefix_cache=False, ragged=False)
         try:
             eng.generate(long_p[:55], max_new_tokens=2)   # warm shapes
             eng.generate([1, 2, 3], max_new_tokens=2)

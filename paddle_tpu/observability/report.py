@@ -123,6 +123,8 @@ def build_run_report(per_rank):
     """Aggregate per-rank snapshot lists into one report dict."""
     ranks = {}
     collectives = {}
+    serving_hists = {}     # (engine, name) -> merged histogram
+    serving_scalars = {}   # engine -> {row: value} (counters + gauges)
     rank_windows = {}
     compute_ms_total = 0.0
     comm_us_total = 0.0
@@ -168,6 +170,15 @@ def build_run_report(per_rank):
         # read as seconds of "communication"
         for key, h in hists.items():
             name, labels = parse_metric_key(key)
+            if name in ("serving_ttft_ms", "serving_inter_token_ms",
+                        "serving_e2e_ms", "serving_queue_wait_ms"):
+                # per-engine serving tails (ISSUE 14 satellite): the
+                # engine label makes N engines in one job attributable —
+                # unlabeled single-engine runs aggregate under "-"
+                skey = (labels.get("engine", "-"), name)
+                serving_hists[skey] = _merge_hist(
+                    serving_hists.get(skey), h)
+                continue
             if name != "collective_latency_us":
                 continue
             group = labels.get("group", "?")
@@ -175,6 +186,18 @@ def build_run_report(per_rank):
             collectives[ckey] = _merge_hist(collectives.get(ckey), h)
             if group not in ("store", "gloo", "object"):
                 comm_us_total += h.get("sum", 0.0)
+        for key, v in counters.items():
+            name, labels = parse_metric_key(key)
+            if name == "serving_tokens_total":
+                eng = labels.get("engine", "-")
+                row = serving_scalars.setdefault(eng, {})
+                row["tokens"] = row.get("tokens", 0) + int(v)
+            elif name == "serving_requests_total":
+                eng = labels.get("engine", "-")
+                st = labels.get("status", "?")
+                row = serving_scalars.setdefault(eng, {})
+                k = f"requests_{st}"
+                row[k] = row.get(k, 0) + int(v)
         # straggler windows: mean step time per inter-snapshot window,
         # stamped with the NEW snapshot's wall-clock ts. Cross-rank
         # alignment happens below by TIMESTAMP bucket, not snapshot
@@ -216,9 +239,25 @@ def build_run_report(per_rank):
             "p99_us": hist_quantile(h, 0.99),
         }
 
+    serving_rows = {}
+    _short = {"serving_ttft_ms": "ttft_ms",
+              "serving_inter_token_ms": "itl_ms",
+              "serving_e2e_ms": "e2e_ms",
+              "serving_queue_wait_ms": "queue_wait_ms"}
+    for (eng, name), h in sorted(serving_hists.items()):
+        row = serving_rows.setdefault(eng, {})
+        base = _short[name]
+        row[f"{base}_p50"] = hist_quantile(h, 0.5)
+        row[f"{base}_p99"] = hist_quantile(h, 0.99)
+        row[f"{base}_count"] = h.get("count", 0)
+    for eng, scal in serving_scalars.items():
+        serving_rows.setdefault(eng, {}).update(scal)
+
     report = {"ranks": ranks, "slowest_rank": slowest,
               "straggler_windows": straggler_counts,
               "collectives": coll_rows}
+    if serving_rows:
+        report["serving"] = serving_rows
     if compute_ms_total > 0:
         # host-visible (non-hidden) collective time vs compute time; the
         # device-truth overlap gauge (xplane-derived) wins when present
@@ -270,6 +309,17 @@ def format_run_report(report):
                 "[telemetry]     %-36s %-6d %-8s %s" % (
                     key, row.get("count", 0), _fmt(row.get("p50_us")),
                     _fmt(row.get("p99_us"))))
+    serving = report.get("serving") or {}
+    if serving:
+        lines.append("[telemetry]   serving engines: "
+                     "tokens  reqs_ok  ttft_p99_ms  itl_p99_ms")
+        for eng, row in sorted(serving.items()):
+            lines.append(
+                "[telemetry]     %-10s %-7d %-8d %-12s %s" % (
+                    eng, row.get("tokens", 0),
+                    row.get("requests_ok", 0),
+                    _fmt(row.get("ttft_ms_p99"), 2),
+                    _fmt(row.get("itl_ms_p99"), 2)))
     if report.get("comm_overlap_pct") is not None:
         src = report.get("comm_overlap_source") or "device timeline"
         lines.append(f"[telemetry] comm/compute overlap: "

@@ -18,7 +18,7 @@ from .kv_cache import BlockAllocator, OutOfPages, PagedKVCache, pages_for  # noq
 from .prefix_cache import PrefixCache  # noqa: F401
 from .scheduler import (  # noqa: F401
     ContinuousBatchingScheduler, EngineClosed, EngineShuttingDown,
-    GenerationRequest, QueueFull,
+    GenerationRequest, OutOfSlots, QueueFull,
 )
 from .decode import (  # noqa: F401
     ab_compare, paged_decode_attention, paged_prefill_attention,
@@ -31,6 +31,10 @@ from .ragged_attention import (  # noqa: F401
 from .engine import ServingEngine  # noqa: F401
 from .metrics import ServingMetrics  # noqa: F401
 from .load import (  # noqa: F401
-    make_mixed_length_prompts, make_shared_prefix_prompts,
-    run_poisson_load, summarize_requests,
+    make_mixed_length_prompts, make_session_prompts,
+    make_shared_prefix_prompts, run_poisson_load, summarize_requests,
 )
+# the fleet tier (router / page sharing / disaggregation) lives in the
+# .fleet subpackage — imported lazily by ServingEngine(page_share=) and
+# explicitly by fleet users, so single-engine serving pays nothing
+

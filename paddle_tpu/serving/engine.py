@@ -146,11 +146,16 @@ class ServingEngine:
                  prefill_batch_buckets=None, attn_backend=None, mesh=None,
                  mesh_axis="model", jit=True, registry=None,
                  prefill_chunk=None, prefill_token_budget=None,
-                 prefix_cache=True, ragged=None):
+                 prefix_cache=True, ragged=None, engine_id=None,
+                 page_share=None):
         cfg = model.config
         self.model = model
         self.model.eval()
         self.cfg = cfg
+        # fleet identity: labels this engine's metric rows (two engines in
+        # one job used to collide in one registry family) and names it in
+        # the router/registry; None keeps the legacy unlabeled rows
+        self.engine_id = engine_id
         self.page_size = int(page_size)
         self.max_slots = int(max_slots)
         self.max_pages = pages_for(cfg.max_seq_len, self.page_size)
@@ -165,16 +170,25 @@ class ServingEngine:
         self.num_kv_heads = KVH
         # prefix cache: content-addressed page sharing across requests
         # with a common prompt head (hits skip prefill compute AND page
-        # writes; pages are refcounted with page-granular copy-on-write)
-        self.prefix = PrefixCache(self.kv.allocator, self.page_size) \
-            if prefix_cache else None
+        # writes; pages are refcounted with page-granular copy-on-write).
+        # With a fleet PageShareClient attached the trie becomes fleet-
+        # wide: a local miss consults the store-published index and
+        # imports the hot pages (system prompts prefill once per FLEET)
+        if not prefix_cache:
+            self.prefix = None
+        elif page_share is not None:
+            from .fleet.page_share import SharedPrefixCache
+            self.prefix = SharedPrefixCache(self.kv, self.page_size,
+                                            page_share)
+        else:
+            self.prefix = PrefixCache(self.kv.allocator, self.page_size)
         self.scheduler = ContinuousBatchingScheduler(
             self.kv.allocator, self.max_slots, self.page_size,
             cfg.max_seq_len, max_queue=max_queue,
             prefix_cache=self.prefix)
         self.metrics = ServingMetrics(registry=registry,
                                       prefix_enabled=self.prefix
-                                      is not None)
+                                      is not None, engine=engine_id)
         # chunked prefill: split prompts into prefill_chunk-token chunks
         # and interleave at most prefill_token_budget chunk-tokens per
         # scheduler round with the decode step — a long prompt arriving
@@ -609,6 +623,22 @@ class ServingEngine:
         if req.hit_stop():
             self.scheduler.finish(req)
             self.metrics.on_finish(req)
+            return
+        hook = req.migrate_hook
+        if hook is not None:
+            # prefill/decode disaggregation (fleet): the prompt is done
+            # but the budget has more to go — hand the request (and its
+            # KV pages) to a decode-designated engine. The hook owns the
+            # release/adopt; True means the request left this engine. A
+            # failed hook degrades gracefully: the row keeps decoding
+            # here, never stranding the caller.
+            try:
+                if hook(self, req):
+                    self.metrics.on_migrate_out(req)
+            except Exception as e:
+                print(f"[serving] migrate hook failed for request "
+                      f"{req.request_id}: {type(e).__name__}: {e} — "
+                      "decoding locally", file=sys.stderr, flush=True)
 
     def _prefill_admitted(self, admitted):
         """Route newly-admitted requests to a prefill path:
@@ -886,11 +916,15 @@ class ServingEngine:
             occ = self.kv.occupancy_pct()
             self._peak_occupancy = max(self._peak_occupancy, occ)
             alloc = self.kv.allocator
+            share = getattr(self.prefix, "share", None)
             self.metrics.sample_state(
                 len(self.scheduler.active), self.scheduler.queue_depth(),
                 occ,
                 shared_pages=alloc.shared_pages() if self.prefix else None,
-                cached_pages=alloc.cached_pages if self.prefix else None)
+                cached_pages=alloc.cached_pages if self.prefix else None,
+                remote_hits=share.remote_hits if share else None,
+                remote_hit_tokens=share.remote_hit_tokens
+                if share else None)
             self._steps += 1
             return emitted
 
@@ -907,9 +941,16 @@ class ServingEngine:
     # ------------------------------------------------------------- serving
     def submit(self, prompt_ids, max_new_tokens=16, eos_token_id=None,
                temperature=0.0, top_k=None, on_token=None, block=True,
-               timeout=10.0):
+               timeout=10.0, on_done=None):
         """Queue one request (backpressure: blocks up to ``timeout`` for
         queue space, then raises :class:`~.scheduler.QueueFull`)."""
+        req = GenerationRequest(prompt_ids, max_new_tokens=max_new_tokens,
+                                eos_token_id=eos_token_id,
+                                temperature=temperature, top_k=top_k,
+                                on_token=on_token, on_done=on_done)
+        return self.submit_request(req, block=block, timeout=timeout)
+
+    def _check_accepting(self):
         if self._draining:
             raise EngineShuttingDown("engine is shutting down")
         if self._loop_error is not None:
@@ -919,11 +960,78 @@ class ServingEngine:
             ) from self._loop_error
         if self._closed:
             raise EngineClosed("engine is closed")
-        req = GenerationRequest(prompt_ids, max_new_tokens=max_new_tokens,
-                                eos_token_id=eos_token_id,
-                                temperature=temperature, top_k=top_k,
-                                on_token=on_token)
+
+    def submit_request(self, req, block=True, timeout=10.0):
+        """Queue an already-built :class:`~.scheduler.GenerationRequest`
+        (the fleet router builds its own legs so it can wire ``on_done``
+        re-dispatch before the engine ever sees them)."""
+        self._check_accepting()
         self.scheduler.submit(req, block=block, timeout=timeout)
+        self._wake.set()
+        return req
+
+    # --------------------------------------------------- fleet migration
+    def snapshot_kv(self, req):
+        """Host copy of one request's written KV (``req.num_cached``
+        tokens): ``(k_layers, v_layers, length)`` with each layer a
+        ``[length, KVH, Dh]`` numpy array. Read-only on the pools (shared
+        prefix pages included), serialized against rounds — the page
+        migration payload of the disaggregated fleet."""
+        with self._step_lock:
+            length = int(req.num_cached)
+            # tpu-lint: ok[HS002] page migration IS the designed host roundtrip: one gather per layer moves this request's KV off-device
+            ks = [np.asarray(self.kv.gather(l, req.pages, length, "k"))
+                  for l in range(self.cfg.num_layers)]
+            # tpu-lint: ok[HS002] second half of the same migration payload (V pools ride the same deliberate roundtrip)
+            vs = [np.asarray(self.kv.gather(l, req.pages, length, "v"))
+                  for l in range(self.cfg.num_layers)]
+        return ks, vs, length
+
+    def release_request(self, req):
+        """Detach a migrating request from this engine: free its slot and
+        pages (a deref — shared prefix pages keep their other readers)
+        WITHOUT finishing it. The caller adopts it elsewhere."""
+        with self._step_lock:
+            self.scheduler.release_for_migration(req)
+            if req in self._prefilling:
+                self._prefilling.remove(req)
+
+    def adopt_request(self, req, k_layers, v_layers, length):
+        """Admit a migrated request with its KV pages pre-populated: the
+        block-table rebind half of fleet page migration. Allocates pages
+        for ``length`` tokens, writes the payload into this engine's
+        pools, and joins the decode batch directly — the continuation
+        consumes ``req.generated[-1]`` at position ``length``, exactly
+        the step the source engine would have run next. Raises
+        :class:`~.kv_cache.OutOfPages` / :class:`~.scheduler.OutOfSlots`
+        when this pool/batch cannot take it (caller falls back to
+        :meth:`readmit_request`)."""
+        from .kv_cache import pages_for as _pages_for
+        with self._step_lock:
+            self._check_accepting()
+            pages = self.kv.allocator.alloc(
+                max(1, _pages_for(length, self.page_size)))
+            try:
+                for layer in range(self.cfg.num_layers):
+                    self.kv.write_prefill(layer, k_layers[layer],
+                                          v_layers[layer], pages, length)
+                req.pages = pages
+                req.num_cached = int(length)
+                self.scheduler.admit_prepared(req)
+            except Exception:
+                self.kv.allocator.free(pages)
+                req.pages = []
+                raise
+            self.metrics.on_adopt(req)
+        self._wake.set()
+        return req
+
+    def readmit_request(self, req):
+        """Recompute fallback for a migrated request: re-queue it at the
+        front — admission re-prefills ``effective_prompt()`` (greedy
+        continuation is token-identical, same contract as eviction)."""
+        self._check_accepting()
+        self.scheduler.readmit(req)
         self._wake.set()
         return req
 
@@ -1101,6 +1209,7 @@ class ServingEngine:
     # --------------------------------------------------------------- stats
     def stats(self):
         out = {
+            "engine_id": self.engine_id,
             "steps": self._steps,
             "decode_tokens": self._decode_tokens,
             "evictions": self.scheduler.total_evictions,
@@ -1127,4 +1236,11 @@ class ServingEngine:
                 "prefix_shared_pages": self.kv.allocator.shared_pages(),
                 "prefix_reclaimed_pages": self.prefix.reclaimed_pages,
             })
+            share = getattr(self.prefix, "share", None)
+            if share is not None:
+                out.update({
+                    "prefix_remote_hits": share.remote_hits,
+                    "prefix_remote_hit_tokens": share.remote_hit_tokens,
+                    "prefix_published_pages": share.published,
+                })
         return out

@@ -1,0 +1,28 @@
+"""paddle_tpu.serving.fleet — N engines, one serving system (ISSUE 14).
+
+The millions-of-users tier over the PR 1-5/10 control plane: a
+:class:`FleetRouter` front-end (session affinity, queue-depth-aware
+balancing, backpressure propagation, engine-loss re-dispatch), fleet-wide
+prefix-cache sharing through the TCPStore
+(:class:`~.page_share.SharedPrefixCache` — system prompts prefill once
+per FLEET), prefill/decode disaggregation with KV page migration
+(:func:`~.disagg.migrate_request` — the Gemma-on-TPU serving topology,
+arxiv 2605.25645), store-backed engine registration/liveness
+(:class:`~.registry.EngineRegistry`) and a store-RPC transport for
+multi-process fleets (:mod:`~.remote`).
+
+    from paddle_tpu.serving.fleet import FleetRouter
+    router = FleetRouter()
+    router.add_engine(engine_a, "e0")
+    router.add_engine(engine_b, "e1")
+    router.start()
+    req = router.submit(prompt_ids, max_new_tokens=64)
+    tokens = req.result(timeout=60)
+"""
+from .router import (  # noqa: F401
+    FleetRequest, FleetRouter, FleetSaturated, LocalEngineHandle,
+)
+from .page_share import PageShareClient, SharedPrefixCache  # noqa: F401
+from .disagg import MigrationFailed, migrate_request  # noqa: F401
+from .registry import EngineRegistry  # noqa: F401
+from .remote import RemoteEngineHandle, serve_over_store  # noqa: F401

@@ -1,0 +1,406 @@
+"""Store-RPC serving — drive a remote ``ServingEngine`` over the TCPStore.
+
+The multi-process half of the fleet: each engine replica runs in its own
+process (its own XLA client, its own pools) and serves a tiny RPC
+protocol over the control-plane store — the same transport the registry,
+page-share index and elastic rendezvous already ride, so a fleet needs
+exactly ONE listening port.
+
+Protocol (keys under ``serving/<job>/eng/<eid>/``):
+
+* ``in_seq`` counter + ``in/<seq>`` JSON — submissions (the router's
+  client handle appends; the engine process tails);
+* ``out_seq`` counter + ``out/<seq>`` JSON — completions (tokens or a
+  typed, retryability-preserving error: ``QueueFull`` /
+  ``EngineShuttingDown`` / ``EngineClosed`` rebuild client-side so the
+  router's retry-elsewhere logic treats remote engines exactly like
+  local ones);
+* ``stop`` — graceful server exit (drain + final stats publish).
+
+Worker entry point (used by ``bench.py --serving-fleet``)::
+
+    python -m paddle_tpu.serving.fleet.remote --store 127.0.0.1:6200 \
+        --engine-id e0 --job bench --seed 0 [--role any] [--share]
+
+Per-request streaming does NOT cross the store (tokens land client-side
+at completion); per-engine TTFT/ITL tails come from the engine process's
+own labeled metrics JSONL (``--metrics-dir``), which is the fleet's
+observability story anyway.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+
+from ..scheduler import (EngineClosed, EngineShuttingDown,
+                         GenerationRequest, QueueFull)
+
+__all__ = ["serve_over_store", "RemoteEngineHandle", "main"]
+
+_ERRORS = {"QueueFull": QueueFull,
+           "EngineShuttingDown": EngineShuttingDown,
+           "EngineClosed": EngineClosed}
+
+
+def _result_record(rid, req=None, error=None):
+    if error is None and req is not None and req.error is not None:
+        error = req.error
+    rec = {"rid": rid,
+           "tokens": list(req.generated) if req is not None else [],
+           "error": None}
+    if error is not None:
+        rec["error"] = {"type": type(error).__name__, "msg": str(error)}
+    if req is not None:
+        rec["queue_wait_s"] = req.queue_wait_s
+        rec["evictions"] = req.evictions
+        ttft = req.ttft_s()
+        if ttft is not None:
+            rec["ttft_s"] = ttft
+    return rec
+
+
+def serve_over_store(engine, store, engine_id, job="fleet",
+                     registry=None, role="any", poll_s=0.04,
+                     idle_timeout=None):
+    """Serve one engine until the ``stop`` key appears (or
+    ``idle_timeout`` seconds pass with no traffic). The engine must be
+    ``start()``ed; completions are published from this thread only (one
+    store client, one writer). Every store op this loop makes steals
+    CPU from the engine's own core, so the polls are deliberately lean:
+    one ``in_seq`` read per tick, stop keys every few ticks."""
+    prefix = f"serving/{job}/eng/{engine_id}"
+    done_lock = threading.Lock()
+    done_queue = []          # results ready to publish
+
+    def on_done(req):
+        with done_lock:
+            done_queue.append(_result_record(req._rid, req))
+
+    consumed = 0
+    tick = 0
+    last_traffic = time.monotonic()
+    last_publish = 0.0
+    while True:
+        tick += 1
+        if tick % 5 == 1 and (store.check(f"{prefix}/stop")
+                              or store.check(f"serving/{job}/stop")):
+            break
+        if idle_timeout is not None \
+                and time.monotonic() - last_traffic > idle_timeout:
+            break
+        head = int(store.add(f"{prefix}/in_seq", 0))
+        while consumed < head:
+            consumed += 1
+            try:
+                msg = json.loads(store.get(f"{prefix}/in/{consumed}",
+                                           timeout=10))
+            except Exception:
+                continue  # torn submission: the client will time out
+            last_traffic = time.monotonic()
+            rid = msg["rid"]
+            try:
+                req = GenerationRequest(
+                    msg["prompt"],
+                    max_new_tokens=int(msg.get("max_new_tokens", 16)),
+                    eos_token_id=msg.get("eos_token_id"),
+                    temperature=float(msg.get("temperature", 0.0)),
+                    top_k=msg.get("top_k"), on_done=on_done)
+                req._rid = rid
+                engine.submit_request(req, block=False)
+            except Exception as e:
+                with done_lock:
+                    done_queue.append(_result_record(rid, error=e))
+        with done_lock:
+            ready, done_queue[:] = list(done_queue), []
+        for rec in ready:
+            last_traffic = time.monotonic()
+            seq = int(store.add(f"{prefix}/out_seq", 1))
+            store.set(f"{prefix}/out/{seq}", json.dumps(rec))
+        # load-stats refresh rides this loop THROTTLED (the registry's
+        # own heartbeat thread already proves liveness at ttl/3; a
+        # publish per poll tick would burn a store write every 20ms per
+        # engine — measurable CPU on a small fleet host)
+        now = time.monotonic()
+        if registry is not None and now - last_publish > 0.25:
+            last_publish = now
+            try:
+                registry.publish(engine_id, engine, role)
+            except Exception:
+                pass
+        time.sleep(poll_s)
+
+
+class _RemoteLeg:
+    """Duck-typed stand-in for the engine-side GenerationRequest: the
+    router treats it exactly like a local leg (state/error/on_done/
+    accounting), completed by the handle's poller thread."""
+
+    def __init__(self, rid, prompt, on_token=None, on_done=None):
+        self.request_id = rid
+        self.prompt_ids = list(prompt)
+        self.generated = []
+        self.state = "active"
+        self.error = None
+        self.queue_wait_s = 0.0
+        self.evictions = 0
+        self.on_token = on_token
+        self.on_done = on_done
+        self.migrate_hook = None
+
+    def _complete(self, rec):
+        err = rec.get("error")
+        self.generated = [int(t) for t in rec.get("tokens", [])]
+        self.queue_wait_s = float(rec.get("queue_wait_s", 0.0))
+        self.evictions = int(rec.get("evictions", 0))
+        cb = self.on_token
+        if cb is not None:
+            # replay emitted tokens even on a retryable failure: the
+            # router's re-dispatch carries the continuation prompt from
+            # fr.generated, which only this callback populates — a
+            # drained engine's 30 emitted tokens must not be recomputed
+            # (final=True only on a clean finish)
+            for i, t in enumerate(self.generated):
+                try:
+                    cb(self, t,
+                       err is None and i == len(self.generated) - 1)
+                except Exception:
+                    pass
+        if err is not None:
+            cls = _ERRORS.get(err.get("type"), RuntimeError)
+            self.error = cls(err.get("msg", "remote engine error"))
+            self.state = "failed"
+        else:
+            self.state = "finished"
+        done = self.on_done
+        if done is not None:
+            try:
+                done(self)
+            except Exception:
+                pass
+
+
+class RemoteEngineHandle:
+    """Router-side handle to one store-served engine process.
+
+    ``store_factory`` builds a fresh store client per internal thread
+    (the native client is not shared across threads). Health/load come
+    from the registry heartbeat — a dead engine process shows up as a
+    stale beat, and its in-flight legs fail by client timeout, which the
+    router re-dispatches."""
+
+    remote = True
+    engine = None
+
+    def __init__(self, store_factory, engine_id, job="fleet",
+                 registry=None, role="any", poll_s=0.04,
+                 record_ttl=0.2):
+        self.engine_id = str(engine_id)
+        self.role = role
+        self.job = job
+        self.registry = registry
+        self.forced_down = False
+        self.pending = 0                # router-side in-flight count
+        self._rec_cache = (0.0, None)   # (fetched_at, record)
+        self._rec_ttl = float(record_ttl)
+        self._prefix = f"serving/{job}/eng/{self.engine_id}"
+        self._submit_store = store_factory()
+        self._poll_store = store_factory()
+        self._poll_s = float(poll_s)
+        self._pending = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._poll_loop,
+                                        daemon=True,
+                                        name=f"fleet-remote-{engine_id}")
+        self._thread.start()
+
+    # ---- router handle surface -----------------------------------------
+    def healthy(self):
+        if self.forced_down:
+            return False
+        rec = self._record()
+        return rec is not None and rec.get("role") != "gone"
+
+    def load(self):
+        rec = self._record() or {}
+        return int(rec.get("queue_depth", 0)) \
+            + int(rec.get("active_slots", 0))
+
+    def occupancy(self):
+        rec = self._record() or {}
+        return float(rec.get("kv_occupancy_pct", 0.0))
+
+    def _record(self):
+        if self.registry is None:
+            return {"role": self.role}
+        ts, rec = self._rec_cache
+        now = time.monotonic()
+        if now - ts < self._rec_ttl:
+            return rec
+        rec = self.registry.engines().get(self.engine_id)
+        self._rec_cache = (now, rec)
+        return rec
+
+    def submit(self, leg):
+        """Ship one router leg (a GenerationRequest OR a prebuilt
+        _RemoteLeg-shaped object) to the engine process."""
+        rid = f"{self.engine_id}-{id(leg)}-{time.monotonic_ns()}"
+        remote = _RemoteLeg(rid, leg.prompt_ids,
+                            on_token=leg.on_token, on_done=leg.on_done)
+        remote._handle_id = self.engine_id
+        remote._fleet = getattr(leg, "_fleet", None)
+        if remote._fleet is not None:
+            remote._fleet._leg = remote
+        msg = {"rid": rid, "prompt": list(leg.prompt_ids),
+               "max_new_tokens": leg.max_new_tokens,
+               "eos_token_id": leg.eos_token_id,
+               "temperature": leg.temperature, "top_k": leg.top_k}
+        with self._lock:
+            self._pending[rid] = remote
+        seq = int(self._submit_store.add(f"{self._prefix}/in_seq", 1))
+        self._submit_store.set(f"{self._prefix}/in/{seq}",
+                               json.dumps(msg))
+        return remote
+
+    def start(self):
+        pass  # the engine process runs its own serve loop
+
+    def close(self):
+        self._stop.set()
+        try:
+            self._submit_store.set(f"{self._prefix}/stop", b"1")
+        except Exception:
+            pass
+
+    # ---- completion poller ---------------------------------------------
+    def _poll_loop(self):
+        consumed = 0
+        tick = 0
+        stale = 0
+        while not self._stop.is_set():
+            tick += 1
+            try:
+                head = int(self._poll_store.add(
+                    f"{self._prefix}/out_seq", 0))
+                while consumed < head:
+                    consumed += 1
+                    rec = json.loads(self._poll_store.get(
+                        f"{self._prefix}/out/{consumed}", timeout=10))
+                    with self._lock:
+                        leg = self._pending.pop(rec.get("rid"), None)
+                    if leg is not None:
+                        leg._complete(rec)
+            except Exception:
+                pass  # store hiccup: retry next tick
+            # engine-loss sweep: a killed worker process publishes
+            # nothing, so its in-flight legs would wait forever — when
+            # the registry heartbeat goes stale for several consecutive
+            # checks, fail them with the retryable EngineClosed verdict
+            # (the router's on_done re-dispatch picks them up)
+            if self.registry is not None and tick % 25 == 0 \
+                    and self._pending:
+                stale = 0 if self.healthy() else stale + 1
+                if stale >= 3:
+                    stale = 0
+                    with self._lock:
+                        legs, self._pending = \
+                            list(self._pending.values()), {}
+                    err = {"type": "EngineClosed",
+                           "msg": f"remote engine {self.engine_id} "
+                                  "lost (heartbeat stale)"}
+                    for leg in legs:
+                        leg._complete({"rid": leg.request_id,
+                                       "tokens": leg.generated,
+                                       "error": err})
+            self._stop.wait(self._poll_s)
+
+
+def main(argv=None):
+    """Engine-process entry: build the (seeded, fleet-identical) model,
+    serve it over the store, publish labeled metrics."""
+    p = argparse.ArgumentParser(prog="paddle_tpu.serving.fleet.remote")
+    p.add_argument("--store", required=True, help="host:port")
+    p.add_argument("--engine-id", required=True)
+    p.add_argument("--job", default="fleet")
+    p.add_argument("--role", default="any",
+                   choices=["any", "prefill", "decode"])
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--vocab", type=int, default=4096)
+    p.add_argument("--hidden", type=int, default=128)
+    p.add_argument("--layers", type=int, default=2)
+    p.add_argument("--heads", type=int, default=4)
+    p.add_argument("--kv-heads", type=int, default=None)
+    p.add_argument("--seq", type=int, default=128)
+    p.add_argument("--page", type=int, default=8)
+    p.add_argument("--pool", type=int, default=96)
+    p.add_argument("--slots", type=int, default=4)
+    p.add_argument("--chunk", type=int, default=None)
+    p.add_argument("--share", action="store_true",
+                   help="cross-engine prefix-page sharing via the store")
+    p.add_argument("--metrics-dir", default=None)
+    p.add_argument("--rank", type=int, default=0)
+    p.add_argument("--ttl", type=float, default=5.0)
+    p.add_argument("--idle-timeout", type=float, default=300.0)
+    args = p.parse_args(argv)
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.tcp_store import TCPStore
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+    from paddle_tpu.observability import metrics as obsm
+    from paddle_tpu.serving import ServingEngine
+    from .page_share import PageShareClient
+    from .registry import EngineRegistry
+
+    host, _, port = args.store.rpartition(":")
+    store = TCPStore(host or "127.0.0.1", int(port), is_master=False)
+    reg = None
+    if args.metrics_dir:
+        reg = obsm.enable(out_dir=args.metrics_dir, interval_s=0,
+                          rank=args.rank)
+
+    paddle.seed(args.seed)
+    cfg = GPTConfig(vocab_size=args.vocab, hidden_size=args.hidden,
+                    num_layers=args.layers, num_heads=args.heads,
+                    num_kv_heads=args.kv_heads, max_seq_len=args.seq,
+                    dropout=0.0)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    share = None
+    if args.share:
+        share = PageShareClient(TCPStore(host or "127.0.0.1", int(port)),
+                                args.engine_id, job=args.job)
+    eng = ServingEngine(model, page_size=args.page, num_pages=args.pool,
+                        max_slots=args.slots, prefill_chunk=args.chunk,
+                        engine_id=args.engine_id, page_share=share,
+                        registry=reg)
+    eng.warm_ragged()
+    eng.generate([1, 2, 3], max_new_tokens=2)  # warm the short tail too
+    eng.start()
+
+    registry = EngineRegistry(TCPStore(host or "127.0.0.1", int(port)),
+                              job=args.job, ttl=args.ttl)
+    registry.register(args.engine_id, engine=eng, role=args.role)
+    print(f"[fleet] engine {args.engine_id} serving "
+          f"(job={args.job}, role={args.role})", flush=True)
+    try:
+        serve_over_store(eng, store, args.engine_id, job=args.job,
+                         registry=registry, role=args.role,
+                         idle_timeout=args.idle_timeout)
+    finally:
+        try:
+            eng.shutdown(drain_s=10.0)
+        except Exception:
+            pass
+        registry.publish(args.engine_id, eng, args.role)  # final stats
+        registry.close()
+        if reg is not None:
+            reg.flush()
+    print(f"[fleet] engine {args.engine_id} stopped", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

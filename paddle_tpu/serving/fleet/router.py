@@ -1,0 +1,563 @@
+# tpu-lint: hot-path
+"""Fleet router — one serving front-end over N ``ServingEngine`` replicas.
+
+The dispatch tier of ISSUE 14: callers talk to ONE :class:`FleetRouter`;
+behind it N engines (in-process replicas, or store-RPC remotes via
+:mod:`.remote`) share the load:
+
+* **session affinity** — requests whose prompt opens with the same full
+  first page (or an explicit ``session=`` key) stick to the engine that
+  already holds those pages, so the prefix cache hits locally instead of
+  paying a cross-engine import per request;
+* **least-loaded balancing** — candidates are ordered by queue depth +
+  active slots (the same numbers the engines' ``active_slots``/
+  ``kv_occupancy`` gauges export), KV occupancy breaking ties;
+* **backpressure propagation** — an engine's ``QueueFull`` rotates to
+  the next candidate; when EVERY engine is saturated the caller gets
+  :class:`FleetSaturated` (a ``QueueFull``) after the submit timeout —
+  open-loop producers see honest fleet-wide pressure, never a silent
+  drop;
+* **health + re-dispatch** — an engine that crashes (serve-loop error),
+  closes, or begins a graceful shutdown is drained from rotation; its
+  failed legs re-dispatch to healthy engines carrying the tokens already
+  emitted (the continuation re-prefills ``prompt + generated`` — greedy
+  decode is token-identical), so a retryable ``EngineShuttingDown``
+  surfaces to the *fleet*, not to the user;
+* **prefill/decode disaggregation** — engines registered with
+  ``role="prefill"`` hand completed prefills to ``role="decode"``
+  engines via :func:`.disagg.migrate_request` (KV page migration; the
+  same machinery ``remove_engine(migrate=True)`` uses for planned
+  engine loss).
+
+Liveness can additionally ride the TCPStore registry
+(:class:`.registry.EngineRegistry`): handles constructed from registry
+records (remote engines) report health from heartbeats instead of
+in-process state.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+
+from ..scheduler import (EngineClosed, EngineShuttingDown,
+                         GenerationRequest, QueueFull)
+from . import disagg as _disagg
+
+__all__ = ["FleetRouter", "FleetRequest", "FleetSaturated",
+           "LocalEngineHandle"]
+
+
+class FleetSaturated(QueueFull):
+    """Every healthy engine's admission queue is full — fleet-wide
+    backpressure. Retryable by the caller (it is a ``QueueFull``)."""
+
+
+_fid = itertools.count()
+
+
+class FleetRequest:
+    """The caller's handle to one fleet-routed generation.
+
+    Mirrors :class:`~..scheduler.GenerationRequest`'s caller surface
+    (``result``/``done``/``ttft_s``/``inter_token_s`` plus the fields
+    ``load.summarize_requests`` reads), while the engine-side legs behind
+    it may be re-dispatched across engines or migrated between them —
+    ``engine_ids`` records the itinerary.
+    """
+
+    def __init__(self, prompt_ids, max_new_tokens=16, eos_token_id=None,
+                 temperature=0.0, top_k=None, on_token=None):
+        self.request_id = f"fleet-{next(_fid)}"
+        self.prompt_ids = [int(t) for t in prompt_ids]
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_token_id = eos_token_id
+        self.temperature = float(temperature)
+        self.top_k = top_k
+        self.on_token = on_token
+        self.generated: list = []
+        self.token_times: list = []
+        self.state = "waiting"
+        self.error = None
+        self.engine_id = None
+        self.engine_ids: list = []       # every engine this request rode
+        self.redispatches = 0
+        self.migrations = 0
+        self.queue_wait_s = 0.0
+        self.evictions = 0
+        self.t_submit = time.perf_counter()
+        self.t_first_token = None
+        self.t_done = None
+        self._done = threading.Event()
+        self._leg = None
+
+    # ---- engine-leg plumbing (router-internal) -------------------------
+    def _attach(self, leg, engine_id):
+        self._leg = leg
+        self.engine_id = engine_id
+        if not self.engine_ids or self.engine_ids[-1] != engine_id:
+            self.engine_ids.append(engine_id)
+        self.state = "active"
+
+    def _leg_token(self, leg, token, fin):
+        now = time.perf_counter()
+        if self.t_first_token is None:
+            self.t_first_token = now
+        self.token_times.append(now)
+        self.generated.append(int(token))
+        cb = self.on_token
+        if cb is not None:
+            try:
+                cb(self, int(token), bool(fin))
+            except Exception:
+                pass
+
+    def _absorb(self, leg):
+        """Fold a finished/abandoned leg's accounting into the fleet
+        totals (tokens already arrived through ``_leg_token``)."""
+        self.queue_wait_s += leg.queue_wait_s
+        self.evictions += leg.evictions
+
+    def _finish(self, error=None):
+        if self._done.is_set():
+            return
+        self.state = "failed" if error is not None else "finished"
+        self.error = error
+        self.t_done = time.perf_counter()
+        self._done.set()
+
+    # ---- caller surface -------------------------------------------------
+    def done(self):
+        return self._done.is_set()
+
+    def result(self, timeout=60.0):
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"request {self.request_id} not done in {timeout}s "
+                f"(state={self.state}, engine={self.engine_id})")
+        if self.error is not None:
+            raise self.error
+        return list(self.generated)
+
+    def ttft_s(self):
+        return (self.t_first_token - self.t_submit) \
+            if self.t_first_token else None
+
+    def inter_token_s(self):
+        return [b - a for a, b in zip(self.token_times,
+                                      self.token_times[1:])]
+
+
+class LocalEngineHandle:
+    """Router-side view of one in-process :class:`ServingEngine`."""
+
+    remote = False
+
+    def __init__(self, engine, engine_id, role="any"):
+        self.engine = engine
+        self.engine_id = str(engine_id)
+        self.role = role
+        self.forced_down = False
+        # router-side in-flight count: incremented at dispatch,
+        # decremented at leg completion/migration. Engine-reported
+        # loads lag (remote heartbeats especially) — during a burst the
+        # router's own unacknowledged traffic is the freshest signal.
+        self.pending = 0
+
+    def healthy(self):
+        e = self.engine
+        return not (self.forced_down or e._closed or e._draining
+                    or e._loop_error is not None)
+
+    def load(self):
+        s = self.engine.scheduler
+        return s.queue_depth() + len(s.active)
+
+    def occupancy(self):
+        return self.engine.kv.occupancy_pct()
+
+    def submit(self, leg):
+        """Non-blocking admission (the router owns retry-elsewhere)."""
+        leg._handle_id = self.engine_id
+        return self.engine.submit_request(leg, block=False)
+
+    def start(self):
+        self.engine.start()
+
+    def close(self):
+        self.engine.close()
+
+
+class FleetRouter:
+    """Dispatch over engine handles with affinity, balancing, health."""
+
+    # sticky-session map cap: beyond this the oldest entries age out
+    # (LRU — refreshing a session moves it to the tail), so a stream of
+    # unique prompts can't grow the dispatch tier without bound
+    MAX_AFFINITY = 4096
+
+    def __init__(self, max_redispatch=3, registry=None,
+                 affinity_spill=4):
+        self._handles = {}
+        self._affinity = {}        # head key -> engine_id (LRU order)
+        self._lock = threading.Lock()
+        self.max_redispatch = int(max_redispatch)
+        # affinity yields when the affine engine is this many requests
+        # MORE loaded than the lightest candidate: a hot session must
+        # spill to a second engine (where cross-engine prefix sharing
+        # picks up the head) instead of dogpiling one replica
+        self.affinity_spill = int(affinity_spill)
+        self.registry = registry
+        self.page_size = None
+        self.cfg = None            # first engine's model config (loadgen)
+        # fleet-level counters (bench/tests)
+        self.dispatched = 0
+        self.redispatched = 0
+        self.migrations = 0
+        self.saturated = 0
+        self.affinity_hits = 0
+
+    # ------------------------------------------------------------ roster
+    def add_engine(self, engine, engine_id=None, role="any", handle=None):
+        """Register one engine replica. ``role``: "any" (prefill AND
+        decode), "prefill" or "decode" (disaggregated fleets). Pass a
+        prebuilt ``handle`` for remote engines."""
+        if handle is None:
+            engine_id = engine_id if engine_id is not None \
+                else (engine.engine_id or f"e{len(self._handles)}")
+            handle = LocalEngineHandle(engine, engine_id, role=role)
+        with self._lock:
+            if handle.engine_id in self._handles:
+                raise ValueError(
+                    f"engine id {handle.engine_id!r} already registered")
+            self._handles[handle.engine_id] = handle
+        eng = getattr(handle, "engine", None)
+        if eng is not None:
+            if self.page_size is None:
+                self.page_size = eng.page_size
+            if self.cfg is None:
+                self.cfg = eng.cfg
+        elif self.page_size is None:
+            self.page_size = getattr(handle, "page_size", None)
+            self.cfg = getattr(handle, "cfg", None)
+        if self.registry is not None and eng is not None:
+            self.registry.register(handle.engine_id, engine=eng,
+                                   role=role)
+        return handle
+
+    def handles(self):
+        with self._lock:
+            return dict(self._handles)
+
+    def engine(self, engine_id):
+        return self._handles[engine_id].engine
+
+    # --------------------------------------------------------- selection
+    def _head_key(self, prompt, session=None):
+        if session is not None:
+            return ("s", session)
+        ps = self.page_size or 0
+        if ps and len(prompt) > ps:
+            # only a FULL first page can ever be prefix-shared (the
+            # cache indexes full pages; the hit cap leaves the last
+            # token computed), so shorter prompts have no affinity
+            return ("p", tuple(prompt[:ps]))
+        return None
+
+    def _candidates(self, head=None, stage="prefill", exclude=(),
+                    pin=None):
+        with self._lock:
+            hs = [h for h in self._handles.values()
+                  if h.engine_id not in exclude]
+        if pin is not None:
+            return [h for h in hs if h.engine_id == pin and h.healthy()]
+        roles = ("any", "prefill") if stage == "prefill" \
+            else ("any", "decode")
+        hs = [h for h in hs if h.healthy() and h.role in roles]
+        # the effective load blends the engine's own report with the
+        # router's in-flight count: reported numbers lag by a heartbeat,
+        # and during an arrival burst every stale 0 would tie-break to
+        # the same engine
+        loads = {h.engine_id: max(h.load(), h.pending) for h in hs}
+        hs.sort(key=lambda h: (loads[h.engine_id], h.occupancy(),
+                               h.engine_id))
+        if head is not None and hs:
+            aff = self._affinity.get(head)
+            lightest = loads[hs[0].engine_id]
+            for i, h in enumerate(hs):
+                if h.engine_id == aff:
+                    # prefer the page-holding engine — but spill once it
+                    # is affinity_spill requests deeper than the
+                    # lightest replica (the session's next requests
+                    # prefix-hit remotely there instead of queueing here)
+                    if i and loads[aff] <= lightest + self.affinity_spill:
+                        hs.insert(0, hs.pop(i))
+                    break
+        return hs
+
+    def _has_decode_pool(self):
+        with self._lock:
+            return any(h.role == "decode" for h in self._handles.values())
+
+    # ------------------------------------------------------------ submit
+    def submit(self, prompt_ids, max_new_tokens=16, eos_token_id=None,
+               temperature=0.0, top_k=None, on_token=None, block=True,
+               timeout=10.0, session=None, engine=None):
+        """Same surface as ``ServingEngine.submit`` (so the Poisson
+        loadgen drives a fleet unchanged), plus ``session=`` (explicit
+        affinity key) and ``engine=`` (pin to one engine id — tests and
+        the bench's cross-engine warm path). -> :class:`FleetRequest`."""
+        fr = FleetRequest(prompt_ids, max_new_tokens=max_new_tokens,
+                          eos_token_id=eos_token_id,
+                          temperature=temperature, top_k=top_k,
+                          on_token=on_token)
+        deadline = time.perf_counter() + (float(timeout) if block else 0.0)
+        first = True
+        while True:
+            if self._dispatch(fr, session=session, pin=engine):
+                return fr
+            self.saturated += bool(first)
+            first = False
+            if time.perf_counter() >= deadline:
+                raise FleetSaturated(
+                    "every engine's admission queue is full "
+                    f"({len(self._handles)} engine(s))")
+            time.sleep(0.005)
+
+    def _dispatch(self, fr, session=None, pin=None, exclude=()):
+        """One placement attempt over the candidate order. -> bool."""
+        prompt = fr.prompt_ids + fr.generated
+        remaining = fr.max_new_tokens - len(fr.generated)
+        if remaining <= 0:       # redispatch raced the last token
+            fr._finish(None)
+            return True
+        head = self._head_key(prompt, session)
+        disagg = self._has_decode_pool()
+        for h in self._candidates(head=head, stage="prefill",
+                                  exclude=exclude, pin=pin):
+            leg = GenerationRequest(
+                prompt, max_new_tokens=remaining,
+                eos_token_id=fr.eos_token_id,
+                temperature=fr.temperature, top_k=fr.top_k,
+                on_token=fr._leg_token,
+                on_done=self._on_leg_done)
+            leg._fleet = fr
+            if disagg and h.role == "prefill":
+                leg.migrate_hook = self._migrate_after_prefill
+            # attach AND count BEFORE submitting: a fast engine thread
+            # can finish the leg (and fire on_done, which decrements
+            # pending) before this thread returns from submit — both
+            # sides of the bookkeeping must already be in place
+            fr._leg = leg
+            with self._lock:
+                h.pending += 1
+            try:
+                # a remote handle substitutes its own wire-side leg —
+                # the returned object is the one that will finish
+                leg = h.submit(leg) or leg
+            except QueueFull:
+                with self._lock:
+                    h.pending = max(0, h.pending - 1)
+                continue
+            except EngineClosed:
+                with self._lock:
+                    h.pending = max(0, h.pending - 1)
+                continue  # raced a shutdown: next candidate
+            with self._lock:
+                if head is not None:
+                    if self._affinity.get(head) == h.engine_id:
+                        self.affinity_hits += 1
+                    self._affinity.pop(head, None)    # move to LRU tail
+                    self._affinity[head] = h.engine_id
+                    while len(self._affinity) > self.MAX_AFFINITY:
+                        del self._affinity[next(iter(self._affinity))]
+                self.dispatched += 1
+            fr._attach(leg, h.engine_id)
+            return True
+        return False
+
+    # ----------------------------------------------------- leg lifecycle
+    def _on_leg_done(self, leg):
+        if leg.state != "migrating":
+            hid = getattr(leg, "_handle_id", None)
+            if hid is not None:
+                with self._lock:
+                    h = self._handles.get(hid)
+                    if h is not None and h.pending > 0:
+                        h.pending -= 1
+        fr = getattr(leg, "_fleet", None)
+        if fr is None or fr.done() or leg is not fr._leg:
+            return
+        if leg.state == "migrating":
+            return  # moved engines, not finished
+        fr._absorb(leg)
+        if leg.error is None:
+            fr._finish(None)
+            return
+        err = leg.error
+        handle = self._handles.get(fr.engine_id)
+        retryable = isinstance(err, (EngineShuttingDown, EngineClosed,
+                                     QueueFull)) \
+            or (handle is not None and not handle.healthy())
+        if not retryable or fr.redispatches >= self.max_redispatch:
+            fr._finish(err)
+            return
+        fr.redispatches += 1
+        self.redispatched += 1
+        # retry-elsewhere with the tokens already emitted carried in the
+        # continuation prompt; the retry window is SHORT because this
+        # runs inline on whatever thread delivered the completion (an
+        # engine serve thread, a remote handle's poller, a drain loop) —
+        # blocking it starves every other completion behind it
+        deadline = time.perf_counter() + 1.0
+        while not self._dispatch(fr, exclude=(fr.engine_id,)):
+            if time.perf_counter() >= deadline:
+                fr._finish(FleetSaturated(
+                    "re-dispatch found no engine with queue space"))
+                return
+            time.sleep(0.02)
+
+    def _migrate_after_prefill(self, src_engine, leg):
+        """``migrate_hook`` body: the prompt completed on a prefill
+        engine — move the KV pages to the least-loaded decode engine.
+        False (= stay) when no decode engine can take it."""
+        fr = getattr(leg, "_fleet", None)
+        cands = self._candidates(stage="decode",
+                                 exclude=(getattr(fr, "engine_id", None)
+                                          or src_engine.engine_id,))
+        cands = [c for c in cands if c.role == "decode"
+                 and getattr(c, "engine", None) is not None]
+        for dst in cands:
+            try:
+                outcome = _disagg.migrate_request(src_engine, dst.engine,
+                                                  leg)
+            except _disagg.MigrationFailed:
+                continue  # a detached leg retries the next candidate
+            if outcome == "skipped":
+                return False
+            self._move_pending(leg, dst)
+            self.migrations += 1
+            if fr is not None:
+                fr.migrations += 1
+                fr._attach(leg, dst.engine_id)
+            return True
+        if leg.state == "migrating":
+            # every candidate refused AFTER a failed attempt detached
+            # the leg from the source — it must not dangle in no
+            # engine: requeue on the source (recompute locally), or
+            # fail with a typed error as the last resort
+            try:
+                src_engine.readmit_request(leg)
+            except Exception as e:
+                leg.finish(e)
+            return False
+        return False
+
+    def _move_pending(self, leg, dst_handle):
+        """Re-home the in-flight accounting of a migrated leg. A leg the
+        router never dispatched (direct engine use swept up by a drain)
+        has no pending count to move — and must not gain one: nothing
+        would ever decrement it."""
+        if getattr(leg, "_handle_id", None) is None:
+            return
+        with self._lock:
+            old = self._handles.get(leg._handle_id)
+            if old is not None and old.pending > 0:
+                old.pending -= 1
+            dst_handle.pending += 1
+        leg._handle_id = dst_handle.engine_id
+
+    # ----------------------------------------------------- engine drain
+    def remove_engine(self, engine_id, migrate=True):
+        """Take one engine out of rotation (planned loss, upgrade,
+        graceful shutdown): queued requests fail with the retryable
+        ``EngineShuttingDown`` and re-dispatch through ``on_done``;
+        in-flight requests migrate their pages to healthy engines when
+        ``migrate=True`` (recompute fallback built in), else drain
+        through the engine's own close (re-dispatch recomputes). Returns
+        ``{request_id: outcome}`` for the migrated set."""
+        h = self._handles.get(engine_id)
+        if h is None:
+            raise KeyError(f"unknown engine {engine_id!r}")
+        h.forced_down = True
+        with self._lock:
+            # dead engine ids must not linger as affinity targets (they
+            # would defeat every future affinity check for those heads)
+            for k in [k for k, v in self._affinity.items()
+                      if v == engine_id]:
+                del self._affinity[k]
+        eng = getattr(h, "engine", None)
+        out = {}
+        if eng is None:
+            return out
+        queued = eng.scheduler.begin_shutdown()
+        for req in queued:
+            eng.metrics.on_finish(req)
+        if migrate:
+            def pick(req):
+                for c in self._candidates(stage="decode",
+                                          exclude=(engine_id,)):
+                    if getattr(c, "engine", None) is not None:
+                        return c.engine
+                return None
+
+            def moved(req, dst_engine, outcome):
+                fr = getattr(req, "_fleet", None)
+                dst_h = next(
+                    (h for h in self.handles().values()
+                     if getattr(h, "engine", None) is dst_engine), None)
+                if dst_h is not None:
+                    self._move_pending(req, dst_h)
+                self.migrations += 1
+                if fr is not None:
+                    fr.migrations += 1
+                    fr._attach(req, dst_h.engine_id if dst_h is not None
+                               else dst_engine.engine_id)
+
+            out = _disagg.drain_active(eng, pick, on_moved=moved)
+        eng.close()
+        if self.registry is not None:
+            try:
+                self.registry.deregister(engine_id)
+            except Exception:
+                pass
+        return out
+
+    def mark_unhealthy(self, engine_id):
+        h = self._handles.get(engine_id)
+        if h is not None:
+            h.forced_down = True
+
+    # ------------------------------------------------------------ helpers
+    def start(self):
+        for h in self.handles().values():
+            h.start()
+
+    def close(self):
+        for h in self.handles().values():
+            try:
+                h.close()
+            except Exception:
+                pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def stats(self):
+        with self._lock:
+            hs = dict(self._handles)
+        return {
+            "engines": {eid: {"healthy": h.healthy(), "role": h.role,
+                              "load": h.load()}
+                        for eid, h in hs.items()},
+            "dispatched": self.dispatched,
+            "redispatched": self.redispatched,
+            "migrations": self.migrations,
+            "saturated": self.saturated,
+            "affinity_hits": self.affinity_hits,
+            "affinity_sessions": len(self._affinity),
+        }

@@ -15,7 +15,8 @@ import time
 import numpy as np
 
 __all__ = ["run_poisson_load", "summarize_requests",
-           "make_shared_prefix_prompts", "make_mixed_length_prompts"]
+           "make_shared_prefix_prompts", "make_mixed_length_prompts",
+           "make_session_prompts"]
 
 
 def _pct(values, q):
@@ -23,8 +24,14 @@ def _pct(values, q):
         if values else None
 
 
-def summarize_requests(requests, wall_s):
-    """Reduce finished requests -> the bench row dict (times in ms)."""
+def summarize_requests(requests, wall_s, by_engine=False):
+    """Reduce finished requests -> the bench row dict (times in ms).
+
+    ``by_engine=True`` adds per-engine breakdown rows (requests that
+    carry an ``engine_id`` — fleet-routed :class:`~.fleet.router.
+    FleetRequest`\\ s do) so a fleet run shows WHERE the load landed:
+    router balancing is only verifiable when no engine idles while
+    another queues."""
     ok = [r for r in requests if r.error is None and r.t_done is not None]
     # never-finished requests (result() deadline hit, engine wedged) are
     # FAILURES — without this they vanish from both columns and a hung
@@ -60,6 +67,31 @@ def summarize_requests(requests, wall_s):
         if isinstance(v, float) and v is not None and k.endswith(
                 ("p50", "p99")):
             out[k] = round(v, 2)
+    if by_engine:
+        groups = {}
+        for r in requests:
+            eid = getattr(r, "engine_id", None)
+            groups.setdefault(eid if eid is not None else "?",
+                              []).append(r)
+        rows = {}
+        for eid, reqs in sorted(groups.items()):
+            g_ok = [r for r in reqs if r.error is None
+                    and r.t_done is not None]
+            g_ttft = [r.ttft_s() * 1e3 for r in g_ok
+                      if r.ttft_s() is not None]
+            g_itl = [dt * 1e3 for r in g_ok for dt in r.inter_token_s()]
+            rows[eid] = {
+                "requests_ok": len(g_ok),
+                "requests_failed": len(reqs) - len(g_ok),
+                "tokens": sum(len(r.generated) for r in g_ok),
+                "ttft_ms_p99": _pct(g_ttft, 99),
+                "itl_ms_p99": _pct(g_itl, 99),
+                "redispatches": sum(getattr(r, "redispatches", 0)
+                                    for r in reqs),
+                "migrations": sum(getattr(r, "migrations", 0)
+                                  for r in reqs),
+            }
+        out["by_engine"] = rows
     return out
 
 
@@ -111,9 +143,40 @@ def make_mixed_length_prompts(n_requests, prompt_len, vocab,
     return prompts, news
 
 
+def make_session_prompts(n_sessions, requests_per_session, head_len,
+                         tail_len, vocab, seed=0, interleave=True):
+    """The FLEET workload (ISSUE 14): ``n_sessions`` sessions, each with
+    its own ``head_len``-token head shared by that session's
+    ``requests_per_session`` requests (per-request random tails of
+    length in ``tail_len``), arrivals interleaved round-robin across
+    sessions — affinity has to hold mid-stream with other sessions'
+    requests landing in between, and a session spilling to a second
+    engine exercises cross-engine prefix sharing on the SAME seeded
+    workload. Deterministic per seed. -> ``(prompts, session_ids)``."""
+    rng = np.random.RandomState(seed)
+    lo, hi = tail_len
+    heads = [rng.randint(1, vocab, size=int(head_len)).tolist()
+             for _ in range(int(n_sessions))]
+    per = [[heads[s] + rng.randint(
+        1, vocab, size=rng.randint(lo, hi + 1)).tolist()
+        for _ in range(int(requests_per_session))]
+        for s in range(int(n_sessions))]
+    if interleave:
+        prompts = [per[s][r] for r in range(int(requests_per_session))
+                   for s in range(int(n_sessions))]
+        sids = [s for _ in range(int(requests_per_session))
+                for s in range(int(n_sessions))]
+    else:
+        prompts = [p for sess in per for p in sess]
+        sids = [s for s in range(int(n_sessions))
+                for _ in range(int(requests_per_session))]
+    return prompts, sids
+
+
 def run_poisson_load(engine, n_requests=32, qps=10.0, prompt_len=(8, 24),
                      max_new_tokens=12, eos_token_id=None, seed=0,
-                     timeout=300.0, shared_prefix=None, prompts=None):
+                     timeout=300.0, shared_prefix=None, prompts=None,
+                     by_engine=False):
     """Submit ``n_requests`` at Poisson arrivals of rate ``qps`` (prompts
     are uniform-random token ids of uniform-random length in
     ``prompt_len``), wait for completion, -> summary dict. The engine
@@ -166,7 +229,7 @@ def run_poisson_load(engine, n_requests=32, qps=10.0, prompt_len=(8, 24),
         except Exception:
             pass  # summarized as failed below
     wall_s = time.perf_counter() - t_start
-    out = summarize_requests(requests, wall_s)
+    out = summarize_requests(requests, wall_s, by_engine=by_engine)
     out["qps_offered"] = float(qps)
     out["n_requests"] = int(n_requests)
     return out

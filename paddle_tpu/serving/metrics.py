@@ -28,6 +28,16 @@ runs share the JSONL snapshot/report plumbing with training. Names:
 wait once, at its terminal state (re-admissions carry their pre-eviction
 wait forward; prefix hit/miss counters fire on the first admission only).
 
+**Fleet identity** (ISSUE 14): construct with ``engine="e0"`` and every
+row above carries an ``engine`` label (``serving_ttft_ms{engine=e0}``),
+so N engines sharing one registry/JSONL stream stay attributable —
+``observability/report.py`` aggregates the labeled families into
+per-engine tail rows. ``engine=None`` (the default) keeps the legacy
+unlabeled names. Fleet-only rows: ``serving_prefix_remote_hits`` /
+``serving_prefix_remote_hit_tokens`` gauges (cross-engine prefix
+imports) and ``serving_migrations_{in,out}_total`` counters (page
+migration legs of the disaggregated fleet).
+
 Every hook is a no-op when the registry is off (one ``None`` check), so
 an un-instrumented engine pays nothing — same contract as the flight
 recorder and telemetry callbacks.
@@ -45,10 +55,16 @@ __all__ = ["ServingMetrics"]
 class ServingMetrics:
     """Per-engine metrics frontend over the process registry."""
 
-    def __init__(self, registry=None, window_s=30.0, prefix_enabled=True):
+    def __init__(self, registry=None, window_s=30.0, prefix_enabled=True,
+                 engine=None):
         self._reg = registry if registry is not None \
             else _metrics.get_registry()
         self.window_s = float(window_s)
+        # fleet identity: every row carries engine=<id> so two engines in
+        # one job (one process or one JSONL dir) never collide in one
+        # family; None keeps the legacy unlabeled names
+        self._labels = {"engine": str(engine)} if engine is not None \
+            else {}
         # engines without a prefix cache must not export the prefix
         # metric family at all (every request would read as a miss — a
         # nonexistent cache reporting 0% hit rate poisons hot/cold
@@ -66,6 +82,17 @@ class ServingMetrics:
         while dq and dq[0] < cutoff:
             dq.popleft()
 
+    # engine-labeled children (the engine label rides every row this
+    # frontend emits; extra labels like status compose with it)
+    def _counter(self, name, **extra):
+        return self._reg.counter(name, **self._labels, **extra)
+
+    def _gauge(self, name):
+        return self._reg.gauge(name, **self._labels)
+
+    def _hist(self, name):
+        return self._reg.histogram(name, **self._labels)
+
     def on_admit(self, req):
         reg = self._reg
         if reg is None or req.t_admit is None:
@@ -76,11 +103,11 @@ class ServingMetrics:
         # saves is already visible in the eviction rows)
         if self.prefix_enabled and req.evictions == 0:
             if req.prefix_hit_tokens > 0:
-                reg.counter("serving_prefix_hits_total").inc()
-                reg.counter("serving_prefix_hit_tokens_total").inc(
+                self._counter("serving_prefix_hits_total").inc()
+                self._counter("serving_prefix_hit_tokens_total").inc(
                     req.prefix_hit_tokens)
             else:
-                reg.counter("serving_prefix_misses_total").inc()
+                self._counter("serving_prefix_misses_total").inc()
 
     def on_first_token(self, req):
         reg = self._reg
@@ -88,73 +115,95 @@ class ServingMetrics:
             return
         ttft = req.ttft_s()
         if ttft is not None:
-            reg.histogram("serving_ttft_ms").observe(ttft * 1e3)
+            self._hist("serving_ttft_ms").observe(ttft * 1e3)
 
     def on_token(self, req, dt_s=None):
         reg = self._reg
         if reg is None:
             return
-        reg.counter("serving_tokens_total").inc()
+        self._counter("serving_tokens_total").inc()
         if dt_s is not None:
-            reg.histogram("serving_inter_token_ms").observe(dt_s * 1e3)
+            self._hist("serving_inter_token_ms").observe(dt_s * 1e3)
         now = time.perf_counter()
         self._token_times.append(now)
         self._trim(self._token_times, now)
         span = now - self._token_times[0]
         if len(self._token_times) > 1 and span > 0:
-            reg.gauge("serving_tokens_per_sec").set(
+            self._gauge("serving_tokens_per_sec").set(
                 (len(self._token_times) - 1) / span)
 
     def on_evict(self, req):
         reg = self._reg
         if reg is None:
             return
-        reg.counter("serving_evictions_total").inc()
-        reg.counter("serving_requests_total", status="evicted").inc()
+        self._counter("serving_evictions_total").inc()
+        self._counter("serving_requests_total", status="evicted").inc()
+
+    def on_adopt(self, req):
+        """A migrated request joined this engine with its KV pre-written
+        (fleet page migration, the decode half)."""
+        reg = self._reg
+        if reg is None:
+            return
+        self._counter("serving_migrations_in_total").inc()
+
+    def on_migrate_out(self, req):
+        """A request left this engine for a decode-designated one (the
+        prefill half of the disaggregated fleet)."""
+        reg = self._reg
+        if reg is None:
+            return
+        self._counter("serving_migrations_out_total").inc()
 
     def on_finish(self, req):
         reg = self._reg
         if reg is None:
             return
         status = "failed" if req.error is not None else "ok"
-        reg.counter("serving_requests_total", status=status).inc()
+        self._counter("serving_requests_total", status=status).inc()
         # CUMULATIVE queue wait, observed ONCE per request at its
         # terminal state: the total covers every waiting segment across
         # eviction/readmission (the pre-eviction time used to vanish when
         # t_enqueue was reset), and observing only here keeps the
         # histogram sum exact — per-admission samples of a running total
         # would double-count the earlier segments
-        reg.histogram("serving_queue_wait_ms").observe(
+        self._hist("serving_queue_wait_ms").observe(
             req.queue_wait_s * 1e3)
         if req.t_done is not None:
-            reg.histogram("serving_e2e_ms").observe(
+            self._hist("serving_e2e_ms").observe(
                 (req.t_done - req.t_submit) * 1e3)
         now = time.perf_counter()
         self._finish_times.append(now)
         self._trim(self._finish_times, now)
         span = now - self._finish_times[0]
         if len(self._finish_times) > 1 and span > 0:
-            reg.gauge("serving_qps").set(
+            self._gauge("serving_qps").set(
                 (len(self._finish_times) - 1) / span)
 
     def sample_state(self, active_slots, queue_depth, occupancy_pct,
-                     shared_pages=None, cached_pages=None):
+                     shared_pages=None, cached_pages=None,
+                     remote_hits=None, remote_hit_tokens=None):
         reg = self._reg
         if reg is None:
             return
-        reg.gauge("serving_active_slots").set(active_slots)
-        reg.gauge("serving_queue_depth").set(queue_depth)
-        reg.gauge("serving_kv_occupancy_pct").set(occupancy_pct)
+        self._gauge("serving_active_slots").set(active_slots)
+        self._gauge("serving_queue_depth").set(queue_depth)
+        self._gauge("serving_kv_occupancy_pct").set(occupancy_pct)
         if shared_pages is not None:
-            reg.gauge("serving_prefix_shared_pages").set(shared_pages)
+            self._gauge("serving_prefix_shared_pages").set(shared_pages)
         if cached_pages is not None:
-            reg.gauge("serving_prefix_cached_pages").set(cached_pages)
+            self._gauge("serving_prefix_cached_pages").set(cached_pages)
+        if remote_hits is not None:
+            self._gauge("serving_prefix_remote_hits").set(remote_hits)
+        if remote_hit_tokens is not None:
+            self._gauge("serving_prefix_remote_hit_tokens").set(
+                remote_hit_tokens)
 
     def on_prefill_chunk(self, n_tokens):
         reg = self._reg
         if reg is None:
             return
-        reg.counter("serving_prefill_chunk_tokens_total").inc(n_tokens)
+        self._counter("serving_prefill_chunk_tokens_total").inc(n_tokens)
 
     def on_compile(self, distinct_programs):
         """The engine installed a NEW shape-specialized callable (ragged
@@ -166,5 +215,5 @@ class ServingMetrics:
         reg = self._reg
         if reg is None:
             return
-        reg.counter("serving_compiles_total").inc()
-        reg.gauge("serving_distinct_programs").set(distinct_programs)
+        self._counter("serving_compiles_total").inc()
+        self._gauge("serving_distinct_programs").set(distinct_programs)

@@ -163,8 +163,13 @@ class PrefixCache:
             if key is not None:
                 self._drop_entry(key, p)
             for k in self._children.pop(p, ()):  # subtree unreachable
-                child = self._index.pop(k, None)
-                if child is not None:
-                    self._entry.pop(child, None)
-                    stack.append(child)
+                child = self._index.get(k)
+                if child is None:
+                    self._index.pop(k, None)
+                    continue
+                # route through _drop_entry: subclasses hook it (the
+                # fleet's SharedPrefixCache unpublishes dropped pages
+                # from the store-wide index there)
+                self._drop_entry(k, child)
+                stack.append(child)
         self.reclaimed_pages += 1

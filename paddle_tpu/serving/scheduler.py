@@ -32,11 +32,17 @@ from collections import deque
 from .kv_cache import OutOfPages, pages_for
 
 __all__ = ["GenerationRequest", "ContinuousBatchingScheduler",
-           "QueueFull", "EngineClosed"]
+           "QueueFull", "EngineClosed", "OutOfSlots"]
 
 
 class QueueFull(RuntimeError):
     """Admission queue at capacity (open-loop producer outran the engine)."""
+
+
+class OutOfSlots(RuntimeError):
+    """No free decode slot for a direct admission (fleet page migration
+    adopting a request bypasses the queue; the caller falls back to
+    recompute-on-readmit)."""
 
 
 class EngineClosed(RuntimeError):
@@ -65,7 +71,7 @@ class GenerationRequest:
 
     def __init__(self, prompt_ids, max_new_tokens=16, eos_token_id=None,
                  temperature=0.0, top_k=None, seed=0, on_token=None,
-                 request_id=None):
+                 request_id=None, on_done=None):
         self.request_id = request_id if request_id is not None \
             else next(_rid)
         self.prompt_ids = [int(t) for t in prompt_ids]
@@ -77,6 +83,13 @@ class GenerationRequest:
         self.top_k = top_k
         self.seed = int(seed)
         self.on_token = on_token
+        # fires once, at the terminal state (fleet router: re-dispatch a
+        # retryable failure to another engine without polling result())
+        self.on_done = on_done
+        # fleet migration hook: set by the router on prefill-designated
+        # engines — called from _finish_prompt when the prompt completes
+        # but the token budget has more to go (see disagg.migrate_request)
+        self.migrate_hook = None
         self.generated: list[int] = []
         self.state = "waiting"   # waiting|prefilling|active|finished|failed
         self.error = None
@@ -128,6 +141,12 @@ class GenerationRequest:
         self.error = error
         self.t_done = time.perf_counter()
         self._done.set()
+        cb = self.on_done
+        if cb is not None:
+            try:
+                cb(self)
+            except Exception:
+                pass  # a broken observer must not stall the engine
 
     def hit_stop(self):
         """Generation-complete test: token budget or eos."""
@@ -294,13 +313,46 @@ class ContinuousBatchingScheduler:
 
     def _evict(self, req):
         self._release(req)
+        req.evictions += 1
+        self.total_evictions += 1
+        self.readmit(req)
+
+    def readmit(self, req):
+        """Re-queue an already-released request at the FRONT of the
+        waiting queue with its context reset — it re-prefills its
+        ``effective_prompt()`` on admission (greedy continuation is
+        token-identical). The eviction path and the fleet's
+        recompute-on-migrate fallback share this one copy."""
         req.state = "waiting"
         req.num_cached = 0
         req.t_enqueue = time.perf_counter()
-        req.evictions += 1
-        self.total_evictions += 1
         with self._lock:
             self.waiting.appendleft(req)
+
+    def admit_prepared(self, req):
+        """Adopt a request whose pages are ALREADY allocated and whose KV
+        is already written into this engine's pools (fleet page
+        migration): take a free slot and join the decode batch directly —
+        no queue, no prefill. Raises :class:`OutOfSlots` when every slot
+        is taken (the caller falls back to :meth:`readmit`)."""
+        with self._lock:
+            if self._closed:
+                raise self._closed_error()
+            if not self._free_slots:
+                raise OutOfSlots(
+                    f"all {self.max_slots} slots busy — migrated request "
+                    "must recompute from the queue instead")
+            req.slot = self._free_slots.pop()
+        req.state = "active"
+        req.t_admit = time.perf_counter()
+        self.active[req.slot] = req
+
+    def release_for_migration(self, req):
+        """Free a migrating request's slot + pages WITHOUT finishing it:
+        the request object itself moves to another engine, and its
+        waiters keep waiting on the same done event."""
+        self._release(req)
+        req.state = "migrating"
 
     def _release(self, req):
         if req.pages:

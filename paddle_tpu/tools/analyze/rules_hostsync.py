@@ -44,6 +44,14 @@ HOT_PATHS = {
         "ServingEngine._prefill_batch",
         "ServingEngine._prefill_admitted",
         "ServingEngine._serve_loop",
+        "ServingEngine.snapshot_kv",
+        "ServingEngine.adopt_request",
+    },
+    # fleet migration path (router.py designates itself whole-file via
+    # the in-file hot-path marker)
+    "serving/fleet/disagg.py": {
+        "migrate_request",
+        "drain_active",
     },
     "distributed/overlap.py": {
         "BucketedGradSync.on_grad_ready",

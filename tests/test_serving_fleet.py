@@ -1,0 +1,601 @@
+"""Serving fleet (ISSUE 14) — router, cross-engine prefix sharing,
+prefill/decode disaggregation, engine-loss re-dispatch.
+
+Fast tier-1 coverage for ``paddle_tpu/serving/fleet/``. Engines here are
+mostly ``jit=False`` (eager steps on gpt_tiny are milliseconds and skip
+the per-engine compile) and are driven by MANUAL stepping so scheduling
+is deterministic; the concurrent Poisson soak and the multi-process
+store-RPC roundtrip are ``@slow``.
+"""
+import socket
+import time
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+    paddle.seed(7)
+    m = GPTForCausalLM(gpt_tiny())
+    m.eval()
+    return m
+
+
+def _engine(model, **kw):
+    from paddle_tpu.serving import ServingEngine
+    kw.setdefault("page_size", 4)
+    kw.setdefault("num_pages", 32)
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("attn_backend", "xla")
+    kw.setdefault("jit", False)
+    return ServingEngine(model, **kw)
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _drive(*engines, until=None, max_steps=200):
+    """Step every engine round-robin until ``until()`` (or idle)."""
+    for _ in range(max_steps):
+        for e in engines:
+            if not e._closed:
+                e.step()
+        if until is not None:
+            if until():
+                return
+        elif not any(e.scheduler.has_work() for e in engines
+                     if not e._closed):
+            return
+    raise AssertionError("fleet did not converge within max_steps")
+
+
+# ---------------------------------------------------------------- workload
+
+def test_make_session_prompts_deterministic_and_interleaved():
+    from paddle_tpu.serving import make_session_prompts
+    p1, s1 = make_session_prompts(3, 4, head_len=8, tail_len=(2, 5),
+                                  vocab=100, seed=5)
+    p2, s2 = make_session_prompts(3, 4, head_len=8, tail_len=(2, 5),
+                                  vocab=100, seed=5)
+    assert p1 == p2 and s1 == s2           # seeded determinism
+    assert len(p1) == 12
+    assert s1[:3] == [0, 1, 2]             # interleaved round-robin
+    heads = {}
+    for p, s in zip(p1, s1):
+        heads.setdefault(s, p[:8])
+        assert p[:8] == heads[s]           # one head per session
+    assert len({tuple(h) for h in heads.values()}) == 3
+    # requests within a session differ past the head
+    assert p1[0] != p1[3]
+
+
+def test_summarize_by_engine_breakdown():
+    from paddle_tpu.serving import summarize_requests
+
+    class R:
+        def __init__(self, eng, toks, err=None):
+            self.error = err
+            self.t_done = 1.0 if err is None else None
+            self.t_submit = 0.0
+            self.generated = toks
+            self.queue_wait_s = 0.0
+            self.evictions = 0
+            self.engine_id = eng
+            self.redispatches = 1 if err else 0
+            self.migrations = 0
+
+        def ttft_s(self):
+            return 0.1
+
+        def inter_token_s(self):
+            return [0.01] * max(0, len(self.generated) - 1)
+
+    reqs = [R("e0", [1, 2]), R("e0", [3]), R("e1", [4, 5, 6]),
+            R("e1", [], err=RuntimeError("x"))]
+    out = summarize_requests(reqs, 1.0, by_engine=True)
+    by = out["by_engine"]
+    assert by["e0"]["requests_ok"] == 2 and by["e0"]["tokens"] == 3
+    assert by["e1"]["requests_ok"] == 1 and by["e1"]["tokens"] == 3
+    assert by["e1"]["requests_failed"] == 1
+    assert by["e1"]["redispatches"] == 1
+    assert out["requests_failed"] == 1
+
+
+# ------------------------------------------------------------------ router
+
+def test_router_least_loaded_balancing_and_affinity(tiny_model):
+    from paddle_tpu.serving.fleet import FleetRouter
+    a = _engine(tiny_model, engine_id="e0")
+    b = _engine(tiny_model, engine_id="e1")
+    r = FleetRouter()
+    r.add_engine(a, "e0")
+    r.add_engine(b, "e1")
+    rng = np.random.RandomState(0)
+    reqs = [r.submit(rng.randint(1, 250, 6).tolist(), max_new_tokens=1)
+            for _ in range(6)]
+    # least-loaded spreads the un-stepped queue across both engines
+    assert {q.engine_id for q in reqs} == {"e0", "e1"}
+    _drive(a, b)
+    for q in reqs:
+        assert len(q.result(10)) == 1
+    # affinity: same full-first-page head sticks to one engine even when
+    # load would otherwise alternate
+    head = rng.randint(1, 250, 5).tolist()  # > page_size=4 -> affinity key
+    s1 = r.submit(head + [1], max_new_tokens=1)
+    s2 = r.submit(head + [2], max_new_tokens=1)
+    s3 = r.submit(head + [3], max_new_tokens=1)
+    assert s1.engine_id == s2.engine_id == s3.engine_id
+    _drive(a, b)
+    assert r.stats()["affinity_hits"] >= 2
+    a.close()
+    b.close()
+
+
+def test_router_backpressure_fleet_saturated(tiny_model):
+    from paddle_tpu.serving.fleet import FleetRouter, FleetSaturated
+    from paddle_tpu.serving import QueueFull
+    a = _engine(tiny_model, engine_id="e0", max_queue=1)
+    b = _engine(tiny_model, engine_id="e1", max_queue=1)
+    r = FleetRouter()
+    r.add_engine(a, "e0")
+    r.add_engine(b, "e1")
+    for i in range(2):  # fill both queues (no one is stepping)
+        r.submit([1, 2, 3], max_new_tokens=2, block=False)
+    with pytest.raises(FleetSaturated):
+        r.submit([4, 5, 6], max_new_tokens=2, block=False)
+    # FleetSaturated IS a QueueFull: callers' retry logic composes
+    assert issubclass(FleetSaturated, QueueFull)
+    _drive(a, b)
+    a.close()
+    b.close()
+
+
+def test_router_engine_crash_redispatch_token_identical(tiny_model):
+    """Engine loss mid-stream, RECOMPUTE path: kill one engine of a
+    2-engine fleet with a request in flight — the router re-dispatches
+    carrying the emitted tokens, greedy continuation token-identical;
+    the user never sees the engine failure."""
+    from paddle_tpu.serving.fleet import FleetRouter
+    rng = np.random.RandomState(3)
+    prompt = rng.randint(1, 250, 9).tolist()
+    solo = _engine(tiny_model)
+    base = solo.generate(prompt, max_new_tokens=6)
+    solo.close()
+
+    a = _engine(tiny_model, engine_id="e0")
+    b = _engine(tiny_model, engine_id="e1")
+    r = FleetRouter()
+    r.add_engine(a, "e0")
+    r.add_engine(b, "e1")
+    fr = r.submit(prompt, max_new_tokens=6, engine="e0")
+    a.step()
+    a.step()  # prefill + partial decode on e0
+    assert 0 < len(fr.generated) < 6
+    a.close()  # crash: in-flight fails -> on_done re-dispatch to e1
+    _drive(b, until=fr.done)
+    assert fr.result(10) == base
+    assert fr.engine_ids == ["e0", "e1"] and fr.redispatches == 1
+    b.close()
+
+
+def test_router_shutdown_drain_redispatches_queued(tiny_model):
+    """begin_shutdown drain through the router: queued requests fail
+    engine-side with the retryable EngineShuttingDown and re-dispatch —
+    the retryable verdict surfaces to the FLEET, never to the user —
+    while in-flight requests migrate their pages (migrate path of
+    engine loss)."""
+    from paddle_tpu.serving.fleet import FleetRouter
+    rng = np.random.RandomState(4)
+    prompt = rng.randint(1, 250, 9).tolist()
+    solo = _engine(tiny_model)
+    base = solo.generate(prompt, max_new_tokens=6)
+    solo.close()
+
+    a = _engine(tiny_model, engine_id="e0")
+    b = _engine(tiny_model, engine_id="e1")
+    r = FleetRouter()
+    r.add_engine(a, "e0")
+    r.add_engine(b, "e1")
+    inflight = r.submit(prompt, max_new_tokens=6, engine="e0")
+    a.step()
+    a.step()
+    pre_migrate = list(inflight.generated)
+    assert pre_migrate  # mid-stream
+    queued = [r.submit(rng.randint(1, 250, 5).tolist(),
+                       max_new_tokens=2, engine="e0") for _ in range(3)]
+    out = r.remove_engine("e0", migrate=True)
+    assert "migrated" in out.values()  # the in-flight request moved pages
+    _drive(b)
+    assert inflight.result(10) == base          # token-identical
+    assert inflight.migrations == 1
+    assert inflight.engine_ids == ["e0", "e1"]
+    for q in queued:                            # user never sees shutdown
+        assert len(q.result(10)) == 2
+        assert q.engine_ids == ["e0", "e1"] and q.redispatches == 1
+    assert not a.scheduler.has_work()
+    b.close()
+
+
+# --------------------------------------------------------------- migration
+
+@pytest.mark.slow
+def test_migrate_request_token_identical_across_page_boundary(tiny_model):
+    """Page migration mid-decode: extraction -> transfer -> write_prefill
+    -> block-table rebind is token-identical, including when the
+    migration point straddles a page boundary. (Depth sweep — the fast
+    tier's shutdown-drain test already asserts one migrate-path parity;
+    suite budget note in ROADMAP.)"""
+    from paddle_tpu.serving.fleet import migrate_request
+    rng = np.random.RandomState(5)
+    prompt = rng.randint(1, 250, 7).tolist()  # 7 + n tokens cross page=4
+    solo = _engine(tiny_model)
+    base = solo.generate(prompt, max_new_tokens=8)
+    solo.close()
+    for steps in (1, 2, 3):  # num_cached 7,8,9: mid-page, boundary, new
+        src = _engine(tiny_model, engine_id="s")
+        dst = _engine(tiny_model, engine_id="d")
+        req = src.submit(prompt, max_new_tokens=8)
+        for _ in range(steps):
+            src.step()
+        assert migrate_request(src, dst, req) == "migrated"
+        assert req.pages and req.num_cached == 6 + steps
+        _drive(dst)
+        assert req.result(10) == base, f"diverged at steps={steps}"
+        src.close()
+        dst.close()
+
+
+@pytest.mark.slow
+def test_migrate_request_gqa_and_prefix_hit(tiny_model):
+    """Migration parity with GQA pools and with a prefix-hit head: the
+    source's shared pages keep their other readers (refcount intact) and
+    the continuation is token-identical. (@slow: builds its own GQA
+    model.)"""
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+    from paddle_tpu.serving.fleet import migrate_request
+    paddle.seed(11)
+    gqa = GPTForCausalLM(gpt_tiny(num_kv_heads=2))
+    gqa.eval()
+    rng = np.random.RandomState(6)
+    head = rng.randint(1, 250, 8).tolist()      # 2 full pages
+    prompt = head + rng.randint(1, 250, 3).tolist()
+    solo = _engine(gqa)
+    base = solo.generate(prompt, max_new_tokens=6)
+    solo.close()
+
+    src = _engine(gqa, engine_id="s")
+    dst = _engine(gqa, engine_id="d")
+    warm = src.submit(head + [5, 6], max_new_tokens=2)
+    _drive(src)
+    warm.result(10)                              # indexes the head pages
+    req = src.submit(prompt, max_new_tokens=6)
+    src.step()
+    assert req.prefix_hit_tokens == 8            # admission hit the head
+    src.step()
+    shared_page = req.pages[0]
+    assert src.kv.allocator.refcount(shared_page) >= 1
+    assert migrate_request(src, dst, req) == "migrated"
+    # the shared head pages stayed behind, still indexed for future hits
+    assert src.prefix.holds(shared_page)
+    _drive(dst)
+    assert req.result(10) == base
+    assert dst.stats()["num_kv_heads"] == 2
+    src.close()
+    dst.close()
+
+
+def test_migrate_fallback_recompute_when_target_full(tiny_model):
+    """Adopt fails on a saturated target (OutOfSlots/OutOfPages) -> the
+    request recomputes from the target's queue, still token-identical."""
+    from paddle_tpu.serving.fleet import migrate_request
+    rng = np.random.RandomState(8)
+    prompt = rng.randint(1, 250, 9).tolist()
+    solo = _engine(tiny_model)
+    base = solo.generate(prompt, max_new_tokens=6)
+    solo.close()
+    src = _engine(tiny_model, engine_id="s")
+    dst = _engine(tiny_model, engine_id="d", max_slots=1)
+    blocker = dst.submit(rng.randint(1, 250, 5).tolist(),
+                         max_new_tokens=12)
+    dst.step()  # blocker occupies dst's only slot
+    req = src.submit(prompt, max_new_tokens=6)
+    src.step()
+    src.step()
+    assert migrate_request(src, dst, req) == "recompute"
+    assert req.num_cached == 0 and req.state == "waiting"
+    _drive(dst)
+    assert req.result(20) == base
+    blocker.result(10)
+    src.close()
+    dst.close()
+
+
+def test_disagg_roles_migrate_after_prefill(tiny_model):
+    """Prefill/decode disaggregation through the router: a prefill-
+    designated engine hands every completed prefill to the decode
+    engine; the prefill engine never decodes, tokens match the
+    single-engine baseline."""
+    from paddle_tpu.serving.fleet import FleetRouter
+    rng = np.random.RandomState(9)
+    prompts = [rng.randint(1, 250, n).tolist() for n in (5, 9, 7)]
+    solo = _engine(tiny_model)
+    base = [solo.generate(p, max_new_tokens=5) for p in prompts]
+    solo.close()
+
+    pf = _engine(tiny_model, engine_id="pf")
+    dc = _engine(tiny_model, engine_id="dc")
+    r = FleetRouter()
+    r.add_engine(pf, "pf", role="prefill")
+    r.add_engine(dc, "dc", role="decode")
+    frs = [r.submit(p, max_new_tokens=5) for p in prompts]
+    _drive(pf, dc, until=lambda: all(f.done() for f in frs))
+    assert [f.result(10) for f in frs] == base
+    assert all(f.migrations == 1 and f.engine_ids == ["pf", "dc"]
+               for f in frs)
+    assert pf._decode_tokens == 0          # the prefill engine never decoded
+    assert dc._decode_tokens > 0
+    assert r.stats()["migrations"] == 3
+    pf.close()
+    dc.close()
+
+
+# -------------------------------------------------- cross-engine page share
+
+def test_page_share_remote_hit_skips_prefill_and_parity(tiny_model):
+    """ISSUE 14 acceptance: engine B's first request of a session whose
+    head engine A published hits the remotely-published pages (remote-hit
+    counter > 0), skips the head's prefill compute, and decodes
+    token-identically."""
+    from paddle_tpu.distributed.tcp_store import TCPStore
+    from paddle_tpu.serving.fleet import PageShareClient
+    port = _free_port()
+    master = TCPStore("127.0.0.1", port, is_master=True)
+    shA = PageShareClient(TCPStore("127.0.0.1", port), "A", job="t1")
+    shB = PageShareClient(TCPStore("127.0.0.1", port), "B", job="t1")
+    ea = _engine(tiny_model, engine_id="A", page_share=shA)
+    eb = _engine(tiny_model, engine_id="B", page_share=shB)
+    rng = np.random.RandomState(10)
+    head = rng.randint(1, 250, 8).tolist()      # 2 full shareable pages
+    pa = head + [7, 8, 9]
+    ta = ea.generate(pa, max_new_tokens=4)
+    assert shA.published == 2                    # full head pages only
+    req = eb.submit(head + [7, 8, 9], max_new_tokens=4)
+    eb.step()
+    # admission imported the head: only the tail was left to compute
+    assert req.prefix_hit_tokens == 8
+    assert shB.remote_hits == 1 and shB.remote_hit_tokens == 8
+    _drive(eb)
+    assert req.result(10) == ta
+    stats = eb.stats()
+    assert stats["prefix_remote_hits"] == 1
+    assert stats["prefix_hit_tokens"] == 8
+    ea.close()
+    eb.close()
+    del master
+
+
+def test_page_share_reclaim_invalidates_store_index(tiny_model):
+    """Refcount/reclaim invariants under pressure: when the owner's page
+    is reclaimed, the store index entry is dropped (on_reclaim ->
+    unpublish) and a late reader degrades to a clean miss — no
+    stale-page resurrection, locally or remotely."""
+    from paddle_tpu.distributed.tcp_store import TCPStore
+    from paddle_tpu.serving.fleet import PageShareClient, SharedPrefixCache
+    from paddle_tpu.serving import PagedKVCache
+    port = _free_port()
+    master = TCPStore("127.0.0.1", port, is_master=True)
+    sh = PageShareClient(TCPStore("127.0.0.1", port), "A", job="t2")
+    kv = PagedKVCache(1, 6, 4, 2, 4)            # tiny pool: 5 usable pages
+    pc = SharedPrefixCache(kv, 4, sh)
+    prompt = list(range(100, 108))              # 2 full pages
+    pages = kv.allocator.alloc(2)
+    pc.insert(prompt, pages)
+    assert sh.published == 2
+    h0 = pc._published[pages[0]]
+    assert sh.store.check(f"{sh.prefix}/idx/{h0}")
+    kv.allocator.free(pages)                    # parks reclaimable
+    got = kv.allocator.alloc(5)                 # pressure: reclaims both
+    assert pc.indexed_pages() == 0
+    # owner dropped the whole chain from the store on reclaim (the
+    # invalidation is deferred off the engine's hot path — drain it)
+    assert sh.drain_unpublish()
+    assert not sh.store.check(f"{sh.prefix}/idx/{h0}")
+    assert sh.unpublished == 2
+    # a reader now sees a clean miss (content-addressed: never stale)
+    shB = PageShareClient(TCPStore("127.0.0.1", port), "B", job="t2")
+    assert shB.fetch(h0) is None
+    kv.allocator.free(got)
+    # clear() unpublishes whatever this engine still owns
+    pages = kv.allocator.alloc(1)
+    pc.insert(prompt[:4], pages)
+    assert sh.published == 3
+    pc.clear()
+    assert sh.unpublished == 3
+    kv.allocator.free(pages)
+    del master
+
+
+# ------------------------------------------------- metrics + registry rows
+
+def test_metrics_engine_label_families(tiny_model):
+    """ISSUE 14 satellite: ServingMetrics rows carry the engine label so
+    two engines in one registry stay attributable; engine_id=None keeps
+    the legacy unlabeled names."""
+    from paddle_tpu.observability import metrics as obsm
+    reg = obsm.enable(out_dir=None, interval_s=0)
+    try:
+        a = _engine(tiny_model, engine_id="e0", registry=reg)
+        b = _engine(tiny_model, engine_id="e1", registry=reg)
+        a.generate([3, 1, 4, 1], max_new_tokens=3)
+        b.generate([3, 1, 4, 1], max_new_tokens=2)
+        snap = reg.snapshot()
+        c = snap["counters"]
+        assert c["serving_tokens_total{engine=e0}"] == 3
+        assert c["serving_tokens_total{engine=e1}"] == 2
+        assert c["serving_requests_total{engine=e0,status=ok}"] == 1
+        assert snap["histograms"]["serving_ttft_ms{engine=e0}"]["count"] \
+            == 1
+        assert "serving_active_slots{engine=e1}" in snap["gauges"]
+        # unlabeled engine: legacy names, no label collision
+        u = _engine(tiny_model, registry=reg)
+        u.generate([9, 9], max_new_tokens=1)
+        snap = reg.snapshot()
+        assert snap["counters"]["serving_tokens_total"] == 1
+        a.close(); b.close(); u.close()
+    finally:
+        obsm.disable()
+
+
+def test_report_serving_per_engine_section():
+    from paddle_tpu.observability import metrics as obsm
+    from paddle_tpu.observability.report import (build_run_report,
+                                                 format_run_report)
+    reg = obsm.MetricsRegistry(rank=0)
+    for eng, n in (("e0", 4), ("e1", 2)):
+        for i in range(n):
+            reg.histogram("serving_ttft_ms", engine=eng).observe(
+                10.0 * (i + 1))
+            reg.histogram("serving_inter_token_ms", engine=eng).observe(
+                2.0)
+        reg.counter("serving_tokens_total", engine=eng).inc(10 * n)
+        reg.counter("serving_requests_total", engine=eng,
+                    status="ok").inc(n)
+    rep = build_run_report({0: [reg.snapshot()]})
+    srv = rep["serving"]
+    assert set(srv) == {"e0", "e1"}
+    assert srv["e0"]["tokens"] == 40 and srv["e1"]["tokens"] == 20
+    assert srv["e0"]["requests_ok"] == 4
+    assert srv["e0"]["ttft_ms_count"] == 4
+    assert srv["e0"]["ttft_ms_p99"] is not None
+    text = format_run_report(rep)
+    assert "serving engines" in text and "e0" in text
+
+
+def test_engine_registry_liveness_over_store():
+    from paddle_tpu.distributed.tcp_store import TCPStore
+    from paddle_tpu.serving.fleet import EngineRegistry
+    port = _free_port()
+    master = TCPStore("127.0.0.1", port, is_master=True)
+    reg = EngineRegistry(TCPStore("127.0.0.1", port), job="t3", ttl=0.6)
+    reg.register("e0", heartbeat=True, extra={"x": 1})
+    reg.register("e1", heartbeat=False)
+    assert reg.joined() == ["e0", "e1"]
+    live = reg.engines()
+    assert set(live) == {"e0", "e1"} and live["e0"]["x"] == 1
+    time.sleep(0.9)          # e1 never beats -> stale; e0 keeps beating
+    live = reg.engines()
+    assert "e0" in live and "e1" not in live
+    reg.deregister("e0")     # explicit deregistration -> role "gone"
+    assert reg.record("e0")["role"] == "gone"
+    reg.close()
+    del master
+
+
+# ------------------------------------------------------------------- slow
+
+@pytest.mark.slow
+def test_fleet_concurrent_poisson_balanced(tiny_model):
+    """Concurrent serve loops: 2 jitted engines behind the router under
+    the Poisson open-loop session workload — all requests land, both
+    engines serve, the per-engine breakdown adds up."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+    from paddle_tpu.serving import ServingEngine, run_poisson_load
+    from paddle_tpu.serving import make_session_prompts
+    from paddle_tpu.serving.fleet import FleetRouter
+    models = []
+    for _ in range(2):       # identical weights, no shared mutable state
+        paddle.seed(7)
+        m = GPTForCausalLM(gpt_tiny())
+        m.eval()
+        models.append(m)
+    engines = [ServingEngine(models[i], page_size=4, num_pages=32,
+                             max_slots=2, attn_backend="xla",
+                             engine_id=f"e{i}") for i in range(2)]
+    for e in engines:
+        e.warm_ragged()
+        e.generate([1, 2, 3], max_new_tokens=2)
+    r = FleetRouter()
+    for i, e in enumerate(engines):
+        r.add_engine(e, f"e{i}")
+    r.start()
+    prompts, _ = make_session_prompts(3, 4, head_len=8, tail_len=(3, 6),
+                                      vocab=250, seed=2)
+    # near-burst arrivals: an idle engine legitimately absorbs a trickle
+    # (least-loaded!), so balancing is only observable with a backlog
+    res = run_poisson_load(r, qps=500.0, prompts=prompts,
+                           max_new_tokens=8, timeout=120.0,
+                           by_engine=True)
+    r.close()
+    assert res["requests_failed"] == 0
+    by = res["by_engine"]
+    assert len(by) == 2
+    assert all(row["tokens"] > 0 for row in by.values())
+    assert sum(row["tokens"] for row in by.values()) == res["tokens"]
+
+
+@pytest.mark.slow
+def test_remote_engine_over_store_roundtrip(tmp_path):
+    """Store-RPC transport: one engine worker process serves over the
+    TCPStore; the router drives it through a RemoteEngineHandle, typed
+    errors and results cross the wire, and the labeled metrics JSONL
+    lands for the report."""
+    import os
+    import subprocess
+    import sys as _sys
+    from paddle_tpu.distributed.tcp_store import TCPStore
+    from paddle_tpu.observability import report as obsrep
+    from paddle_tpu.serving.fleet import (EngineRegistry, FleetRouter,
+                                          RemoteEngineHandle)
+    port = _free_port()
+    master = TCPStore("127.0.0.1", port, is_master=True)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("PADDLE_TPU_", "PADDLE_TRAINER"))}
+    env.pop("JAX_COMPILATION_CACHE_DIR", None)
+    env.update({"JAX_PLATFORMS": "cpu", "PYTHONPATH": repo})
+    md = str(tmp_path)
+    proc = subprocess.Popen(
+        [_sys.executable, "-m", "paddle_tpu.serving.fleet.remote",
+         "--store", f"127.0.0.1:{port}", "--engine-id", "e0",
+         "--job", "t4", "--seed", "3", "--vocab", "256", "--hidden",
+         "64", "--layers", "2", "--heads", "4", "--seq", "64",
+         "--page", "4", "--pool", "32", "--slots", "2",
+         "--metrics-dir", md],
+        env=env, cwd=repo, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True)
+    try:
+        reg = EngineRegistry(TCPStore("127.0.0.1", port), job="t4")
+        deadline = time.time() + 180
+        while not reg.engines():
+            assert proc.poll() is None, proc.communicate()[0][-1500:]
+            assert time.time() < deadline, "worker never registered"
+            time.sleep(0.5)
+        r = FleetRouter()
+        r.add_engine(None, handle=RemoteEngineHandle(
+            lambda: TCPStore("127.0.0.1", port), "e0", job="t4",
+            registry=EngineRegistry(TCPStore("127.0.0.1", port),
+                                    job="t4")))
+        r.page_size = 4
+        toks = []
+        frs = [r.submit([5, 6, 7, 8], max_new_tokens=3,
+                        on_token=lambda fr, t, fin: toks.append(t),
+                        timeout=60) for _ in range(2)]
+        outs = [f.result(120) for f in frs]
+        assert outs[0] == outs[1] and len(outs[0]) == 3  # greedy, remote
+        assert toks  # streaming callbacks crossed completion
+        master.set("serving/t4/stop", b"1")
+        assert proc.wait(60) == 0
+        rep = obsrep.build_run_report(obsrep.read_rank_snapshots(md))
+        assert rep["serving"]["e0"]["tokens"] >= 6
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    del master

@@ -194,6 +194,7 @@ def _fwd_key(name, fwd):
     code = getattr(fwd, "__code__", None)
     if code is None:
         # builtin / ufunc (e.g. jnp.multiply): module-level, identity-keyed
+        # tpu-lint: ok[RC002] returned keepalive pins fwd for the entry's lifetime (_jit_keepalive) so its id cannot be recycled
         return (name, id(fwd)), fwd
     if getattr(fwd, "__self__", None) is not None:
         # bound method: the receiver's state is neither in the code id nor
@@ -201,6 +202,7 @@ def _fwd_key(name, fwd):
         return None, None
     cells = fwd.__closure__
     if not cells:
+        # tpu-lint: ok[RC002] returned keepalive pins the code object so its id cannot be recycled
         return (name, id(code)), code
     vals = []
     for cell in cells:
@@ -216,6 +218,7 @@ def _fwd_key(name, fwd):
             vals.append((type(v).__name__, repr(v)))
         else:  # arrays, Tensors, functions, mutables: not value-keyable
             return None, None
+    # tpu-lint: ok[RC002] returned keepalive pins the code object so its id cannot be recycled
     return (name, id(code), tuple(vals)), code
 
 

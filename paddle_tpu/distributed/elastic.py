@@ -27,6 +27,7 @@ import threading
 import time
 
 from .tcp_store import TCPStore
+from . import keyspace as ks
 
 __all__ = ["ElasticManager", "ElasticStatus", "worker_from_env",
            "NodeRegistry", "QuarantineList", "render_node_round"]
@@ -60,7 +61,7 @@ class ElasticManager:
         self.store = TCPStore(host=host, port=port, is_master=is_master,
                               world_size=self.max_np, timeout=timeout)
         self.ttl = float(ttl)
-        self._prefix = f"elastic/{job_id}"
+        self._prefix = ks.elastic_job(job_id)
         self._name = None
         self._beat_thread = None
         self._stop = threading.Event()
@@ -259,7 +260,7 @@ class NodeRegistry:
     def __init__(self, store, job_id, ttl=10.0):
         self.store = store
         self.ttl = float(ttl)
-        self._prefix = f"elastic/{job_id}/node"
+        self._prefix = ks.elastic_node(job_id)
         self._join_cache = {}
         self._inc_seen = getattr(store, "incarnation", 0)
 

@@ -497,14 +497,15 @@ def check_desync(entry, injected=False):
     if store is None:
         return
     sig = signature_of(entry, perturbed=injected)
-    base = f"{store_scope()}/sig/{entry['group']}/{entry['gseq']}"
+    sig_prefix = f"{store_scope()}/sig/{entry['group']}/{entry['gseq']}"
     sigs = {rec.rank: sig}
-    store.set(f"{base}/{rec.rank}", sig.encode())
+    store.set(f"{sig_prefix}/{rec.rank}", sig.encode())
     for r in range(rec.world_size):
         if r == rec.rank:
             continue
         try:
-            sigs[r] = store.get(f"{base}/{r}", timeout=timeout).decode()
+            sigs[r] = store.get(f"{sig_prefix}/{r}",
+                                timeout=timeout).decode()
         except Exception:
             sigs[r] = f"<rank {r} never announced seq {entry['gseq']} " \
                       f"within {timeout:.0f}s>"

@@ -56,6 +56,7 @@ import subprocess
 import sys
 import time
 
+from .. import keyspace
 from ..fault import (EXIT_DEPOSED, EXIT_PREEMPT, EXIT_USAGE,
                      describe_exit)
 
@@ -451,7 +452,7 @@ class _NodeCoordinator:
         self._lease_next = 0.0
         self._adopted = False
         self._deposed = False
-        self._coord_prefix = f"elastic/{args.job_id}/coord"
+        self._coord_prefix = keyspace.elastic_coord(args.job_id)
 
     # ------------------------------------------------------------ setup
     def _spawn_local_agents(self, count):

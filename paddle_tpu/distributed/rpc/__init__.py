@@ -16,6 +16,7 @@ import os
 import pickle
 import socket
 import struct
+from .. import keyspace
 import threading
 
 from ..tcp_store import TCPStore
@@ -105,13 +106,13 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
                               daemon=True)
     thread.start()
 
-    store.set(f"rpc/worker/{name}", f"{rank},127.0.0.1,{my_port}")
-    store.set(f"rpc/rank/{rank}", name)
+    store.set(keyspace.rpc_worker(name), f"{rank},127.0.0.1,{my_port}")
+    store.set(keyspace.rpc_rank(rank), name)
     store.barrier("rpc_init", world_size)
     workers = {}
     for r in range(world_size):
-        wname = store.get(f"rpc/rank/{r}").decode()
-        rr, ip, p = store.get(f"rpc/worker/{wname}").decode().split(",")
+        wname = store.get(keyspace.rpc_rank(r)).decode()
+        rr, ip, p = store.get(keyspace.rpc_worker(wname)).decode().split(",")
         workers[wname] = WorkerInfo(wname, int(rr), ip, int(p))
     _state.update(name=name, rank=rank, world_size=world_size,
                   store=store, server=server, pool=pool, thread=thread,

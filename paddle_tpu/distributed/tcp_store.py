@@ -21,6 +21,7 @@ import time
 import uuid
 
 from . import fault as _fault
+from . import keyspace as ks
 
 __all__ = ["TCPStore", "FailoverStore", "LogShipper", "Watchdog",
            "StoreTimeoutError", "StoreFencedError",
@@ -361,14 +362,14 @@ def _trim_wal_entry(store, seq):
     the shipper's trim and the writer's self-trim — the entry is far
     enough in the past that no writer retry or shipper pump can still
     want it."""
-    key = f"__wal/{seq}"
+    key = ks.wal_entry(seq)
     try:
         if store.check(key):
             entry = json.loads(store.get(key, timeout=5))
             opid = entry.get("id")
             if opid:
-                store.delete_key(f"__wal/claim/{opid}")
-                store.delete_key(f"__wal/result/{opid}")
+                store.delete_key(ks.wal_claim(opid))
+                store.delete_key(ks.wal_result(opid))
         store.delete_key(key)
     except Exception:
         pass
@@ -488,7 +489,7 @@ class FailoverStore:
             # add(0) is an atomic read); writes from this pin are valid
             # until a promotion moves the epoch past it
             try:
-                self._epoch = int(self._store.add("__fence/epoch", 0))
+                self._epoch = int(self._store.add(ks.FENCE_EPOCH, 0))
                 self._pinned = True
             except Exception:
                 pass  # fence pins lazily on the first mutating op
@@ -536,7 +537,7 @@ class FailoverStore:
                     # every op must NOT be promoted — it exhausts the
                     # candidate list instead, which is the verdict the
                     # agent's orphan self-fence arms on
-                    store.add("__fence/epoch", 0)
+                    store.add(ks.FENCE_EPOCH, 0)
                 except Exception:
                     continue
                 self._store, self._idx = store, idx
@@ -546,7 +547,7 @@ class FailoverStore:
                     old_epoch = self._epoch
                     try:
                         self._sync_epoch_after_rehome(store, old_epoch)
-                        acked = int(store.add("__wal/acked", 0))
+                        acked = int(store.add(ks.WAL_ACKED, 0))
                     except Exception as e:
                         print(f"[store] epoch sync on promotion failed: "
                               f"{e}", file=sys.stderr, flush=True)
@@ -580,13 +581,13 @@ class FailoverStore:
         everyone pins the new value. A deposed writer still pinned to
         ``old_epoch`` is rejected by :meth:`_check_fence` from then on."""
         target = old_epoch + 1
-        if int(store.add(f"__fence/promo/e{old_epoch}", 1)) == 1:
-            cur = int(store.add("__fence/epoch", 0))
+        if int(store.add(ks.fence_promo(old_epoch), 1)) == 1:
+            cur = int(store.add(ks.FENCE_EPOCH, 0))
             if cur < target:
-                store.add("__fence/epoch", target - cur)
+                store.add(ks.FENCE_EPOCH, target - cur)
         deadline = time.monotonic() + 5.0
         while True:
-            cur = int(store.add("__fence/epoch", 0))
+            cur = int(store.add(ks.FENCE_EPOCH, 0))
             if cur >= target or time.monotonic() >= deadline:
                 break
             time.sleep(0.05)
@@ -599,7 +600,7 @@ class FailoverStore:
         partition heals) from a daemon thread, so a writer that never
         noticed the failover gets :class:`StoreFencedError` on its next
         mutating op instead of silently diverging a dead lifetime."""
-        sweep_counter(self._eps, "__fence/epoch", self._epoch,
+        sweep_counter(self._eps, ks.FENCE_EPOCH, self._epoch,
                       probe_deadline=self._probe_deadline,
                       timeout=self._timeout, exclude=exclude,
                       name="store-fence-sweep")
@@ -614,7 +615,7 @@ class FailoverStore:
         if not self._replicate:
             return self._epoch
         with self._lock:
-            self._epoch = int(self._store.add("__fence/epoch", 0))
+            self._epoch = int(self._store.add(ks.FENCE_EPOCH, 0))
             self._pinned = True
             return self._epoch
 
@@ -647,7 +648,7 @@ class FailoverStore:
                              timeout=self._timeout,
                              connect_deadline=self._probe_deadline,
                              fail_fast_refused=True)
-            store.add("__fence/epoch", 0)  # round-trip proof
+            store.add(ks.FENCE_EPOCH, 0)  # round-trip proof
         except Exception:
             return False
         store._connect_deadline = self._probe_deadline
@@ -685,7 +686,7 @@ class FailoverStore:
         return self._replicate and not key.startswith("__")
 
     def _check_fence(self, s):
-        cur = int(s.add("__fence/epoch", 0))
+        cur = int(s.add(ks.FENCE_EPOCH, 0))
         if not self._pinned:
             # the connect-time pin never landed (store was unreachable at
             # construction): adopt the CURRENT epoch on the first
@@ -732,8 +733,8 @@ class FailoverStore:
 
     def _wal_append(self, s, entry):
         entry["e"] = self._epoch
-        seq = int(s.add("__wal/seq", 1))
-        s.set(f"__wal/{seq}", json.dumps(entry).encode())
+        seq = int(s.add(ks.WAL_SEQ, 1))
+        s.set(ks.wal_entry(seq), json.dumps(entry).encode())
         self._wal_self_trim(s, seq)
         return seq
 
@@ -759,7 +760,7 @@ class FailoverStore:
             floor = float("inf")
             try:
                 for i in range(1, len(self._eps)):
-                    k = f"__wal/cursor/{i}"
+                    k = ks.wal_cursor(i)
                     if s.check(k):
                         floor = min(floor, int(s.get(k, timeout=5)))
             except Exception:
@@ -798,14 +799,14 @@ class FailoverStore:
 
         def do(s):
             self._check_fence(s)
-            if int(s.add(f"__wal/claim/{opid}", 1)) > 1:
+            if int(s.add(ks.wal_claim(opid), 1)) > 1:
                 # this op was already claimed — an earlier attempt the
                 # ack got lost for, or the shipper replayed it onto the
                 # promoted standby: adopt the recorded result, never
                 # apply twice (the exactly-once half of the fence)
                 raw = None
                 try:
-                    raw = s.get(f"__wal/result/{opid}",
+                    raw = s.get(ks.wal_result(opid),
                                 timeout=5).decode()
                 except StoreTimeoutError:
                     pass
@@ -834,9 +835,9 @@ class FailoverStore:
             # pre-apply marker: shrinks the ambiguous retry window to
             # exactly the increment op — absent result = never applied,
             # "?" = unknown, value = applied
-            s.set(f"__wal/result/{opid}", "?")
+            s.set(ks.wal_result(opid), "?")
             v = int(s.add(key, amount))
-            s.set(f"__wal/result/{opid}", str(v))
+            s.set(ks.wal_result(opid), str(v))
             return v
 
         return self._op(do)
@@ -939,7 +940,7 @@ class LogShipper:
     def _apply(self, stand, entry, torn):
         op = entry.get("op")
         epoch = int(entry.get("e", 0))
-        cur = int(stand.add("__fence/epoch", 0))
+        cur = int(stand.add(ks.FENCE_EPOCH, 0))
         if epoch < cur:
             from . import flight_recorder as _fr
             _fr.note_fenced("wal_replay_fenced", epoch, cur,
@@ -958,15 +959,15 @@ class LogShipper:
             if torn:
                 return  # the ship is lost mid-air: the add never lands
             opid = entry.get("id")
-            if int(stand.add(f"__wal/claim/{opid}", 1)) == 1:
+            if int(stand.add(ks.wal_claim(opid), 1)) == 1:
                 # same pre-apply "?" marker as FailoverStore.add: if THIS
                 # process dies between the increment and the result
                 # write, the writer's orphaned-claim recovery must see
                 # "unknown", not "never applied" — absent-result =
                 # safe-to-rerun is an invariant both appliers share
-                stand.set(f"__wal/result/{opid}", "?")
+                stand.set(ks.wal_result(opid), "?")
                 v = int(stand.add(entry["k"], int(entry.get("n", 1))))
-                stand.set(f"__wal/result/{opid}", str(v))
+                stand.set(ks.wal_result(opid), str(v))
             # else: the writer already gap-filled this op on the standby
         elif op == "del":
             stand.delete_key(entry["k"])
@@ -975,8 +976,8 @@ class LogShipper:
         # trim the mirror on the same window, or a multi-day job grows
         # the standby (the host that must stay healthy for failover)
         # without bound
-        seq = int(stand.add("__wal/seq", 1))
-        stand.set(f"__wal/{seq}", json.dumps(entry).encode())
+        seq = int(stand.add(ks.WAL_SEQ, 1))
+        stand.set(ks.wal_entry(seq), json.dumps(entry).encode())
         if seq > self._TRIM_KEEP:
             self._trim_entry(stand, seq - self._TRIM_KEEP)
 
@@ -996,19 +997,19 @@ class LogShipper:
         try:
             # mirror the fence epoch first: late entries from a deposed
             # lifetime must find the fence already advanced
-            pe = int(prim.add("__fence/epoch", 0))
-            se = int(stand.add("__fence/epoch", 0))
+            pe = int(prim.add(ks.FENCE_EPOCH, 0))
+            se = int(stand.add(ks.FENCE_EPOCH, 0))
             if se < pe:
-                stand.add("__fence/epoch", pe - se)
-            acked = int(stand.add("__wal/acked", 0))
-            head = int(prim.add("__wal/seq", 0))
+                stand.add(ks.FENCE_EPOCH, pe - se)
+            acked = int(stand.add(ks.WAL_ACKED, 0))
+            head = int(prim.add(ks.WAL_SEQ, 0))
         except Exception:
             self._prim = None
             raise
         shipped = torn_n = 0
         peer_floor = None
         for seq in range(acked + 1, head + 1):
-            key = f"__wal/{seq}"
+            key = ks.wal_entry(seq)
             try:
                 if not prim.check(key):
                     if seq <= head - self._HOLE_GRACE_WINDOW:
@@ -1018,7 +1019,7 @@ class LogShipper:
                         # append — skip WITHOUT the 1s grace, or a
                         # 100k-op catch-up stalls replication for
                         # hours while everyone believes it is on
-                        acked = int(stand.add("__wal/acked", 1))
+                        acked = int(stand.add(ks.WAL_ACKED, 1))
                         continue
                     # seq bumped but entry not yet written (writer mid-
                     # append, or it died in that window): grace, then
@@ -1039,15 +1040,15 @@ class LogShipper:
                             rec.complete(rec.issue(
                                 "wal_hole_skipped", group="step",
                                 extra={"wal_seq": seq}))
-                        acked = int(stand.add("__wal/acked", 1))
+                        acked = int(stand.add(ks.WAL_ACKED, 1))
                         continue
                 entry = json.loads(prim.get(key, timeout=5))
             except (ValueError, StoreTimeoutError):
-                acked = int(stand.add("__wal/acked", 1))
+                acked = int(stand.add(ks.WAL_ACKED, 1))
                 continue  # torn/corrupt source entry: skip, never stall
             torn = _fault.maybe_inject("replication") == "wal_torn"
             self._apply(stand, entry, torn)
-            acked = int(stand.add("__wal/acked", 1))
+            acked = int(stand.add(ks.WAL_ACKED, 1))
             shipped += 1
             torn_n += int(torn)
             if peer_floor is None:  # once per round: cursors only move
@@ -1057,7 +1058,7 @@ class LogShipper:
                 self._trim_entry(prim, seq - self._TRIM_KEEP)
         if shipped:
             try:
-                prim.set(f"__wal/cursor/{self._standby_index}",
+                prim.set(ks.wal_cursor(self._standby_index),
                          str(acked))
             except Exception:
                 pass  # cursor is advisory; primary may be dying
@@ -1076,7 +1077,7 @@ class LogShipper:
         floor = float("inf")
         for i in self._peer_indices:
             try:
-                key = f"__wal/cursor/{i}"
+                key = ks.wal_cursor(i)
                 if prim.check(key):
                     floor = min(floor, int(prim.get(key, timeout=5)))
             except Exception:

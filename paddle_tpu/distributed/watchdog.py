@@ -128,6 +128,7 @@ def stop_step_watchdog():
     env var afterwards (a finished train loop followed by slow eval or
     checkpointing must not be shot by a stale timeout)."""
     global _disabled
+    # tpu-lint: ok[LK003] atexit disarm runs on the main thread; the lock brackets a short flag flip + native stop, never blocking work
     with _lock:
         _stop_locked()
         _disabled = True

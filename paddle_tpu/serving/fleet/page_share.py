@@ -44,6 +44,7 @@ import time
 import numpy as np
 
 from ..prefix_cache import PrefixCache, _ROOT
+from ...distributed import keyspace
 
 __all__ = ["PageShareClient", "SharedPrefixCache"]
 
@@ -68,7 +69,7 @@ class PageShareClient:
                              "index records which engine owns each page")
         self.store = store
         self.engine_id = str(engine_id)
-        self.prefix = f"pshare/{job}"
+        self.prefix = keyspace.page_share(job)
         self.max_publish_pages = int(max_publish_pages)
         self.fetch_timeout = float(fetch_timeout)
         # counters (engine.stats() + the fleet bench read these)

@@ -16,6 +16,7 @@ import json
 import os
 import threading
 import time
+from ...distributed import keyspace
 
 __all__ = ["EngineRegistry"]
 
@@ -27,7 +28,7 @@ class EngineRegistry:
         self.store = store
         self.job = str(job)
         self.ttl = float(ttl)
-        self._prefix = f"serving/{self.job}"
+        self._prefix = keyspace.fleet_registry(self.job)
         self._beats = {}         # engine_id -> (stop event, thread)
         self._join_cache = {}    # join-log idx -> engine_id (immutable)
         # ONE store client, many callers (the heartbeat thread + every

@@ -35,6 +35,7 @@ import sys
 import threading
 import time
 
+from ...distributed import keyspace
 from ..scheduler import (EngineClosed, EngineShuttingDown,
                          GenerationRequest, QueueFull)
 
@@ -71,7 +72,8 @@ def serve_over_store(engine, store, engine_id, job="fleet",
     store client, one writer). Every store op this loop makes steals
     CPU from the engine's own core, so the polls are deliberately lean:
     one ``in_seq`` read per tick, stop keys every few ticks."""
-    prefix = f"serving/{job}/eng/{engine_id}"
+    prefix = keyspace.fleet_engine_rpc(job, engine_id)
+    fleet_stop = f"{keyspace.fleet_registry(job)}/stop"
     done_lock = threading.Lock()
     done_queue = []          # results ready to publish
 
@@ -86,7 +88,7 @@ def serve_over_store(engine, store, engine_id, job="fleet",
     while True:
         tick += 1
         if tick % 5 == 1 and (store.check(f"{prefix}/stop")
-                              or store.check(f"serving/{job}/stop")):
+                              or store.check(fleet_stop)):
             break
         if idle_timeout is not None \
                 and time.monotonic() - last_traffic > idle_timeout:
@@ -205,7 +207,7 @@ class RemoteEngineHandle:
         self.pending = 0                # router-side in-flight count
         self._rec_cache = (0.0, None)   # (fetched_at, record)
         self._rec_ttl = float(record_ttl)
-        self._prefix = f"serving/{job}/eng/{self.engine_id}"
+        self._prefix = keyspace.fleet_engine_rpc(job, self.engine_id)
         self._submit_store = store_factory()
         self._poll_store = store_factory()
         self._poll_s = float(poll_s)

@@ -102,6 +102,7 @@ def sharded_ragged_attention(mesh, axis_name="model", backend="xla",
         P(),                              # block_tables
     )
     out_specs = P(None, axis_name, None)
+    # tpu-lint: ok[RC001] built once per engine at a fixed shape and invoked inside the engine's jitted round (nested jit inlines) — the round program is counted at its _note_program install site
     return jax.jit(jax.shard_map(_impl, mesh=mesh, in_specs=in_specs,
                                  out_specs=out_specs, check_vma=False))
 

@@ -245,19 +245,33 @@ class ContinuousBatchingScheduler:
                 if not self.waiting:
                     break
                 req = self.waiting[0]
-                prompt = req.effective_prompt()
-                shared, n_shared = [], 0
-                if self.prefix_cache is not None:
-                    # prefix-cache hit: the shared head's pages are taken
-                    # by reference (no prefill compute, no page writes) —
-                    # only the tail needs private pages
-                    shared, n_shared = self.prefix_cache.lookup(prompt)
-                need = pages_for(len(prompt) + 1, self.page_size) \
-                    - len(shared)
-                if not self.allocator.can_alloc(need):
-                    if shared:    # un-ref the speculative hit
+            # prefix lookup + page accounting OUTSIDE the lock: a fleet
+            # SharedPrefixCache lookup is a store round-trip (up to its
+            # fetch timeout), and producers block on this very lock in
+            # submit() — holding it here would stall every caller for
+            # the duration (tpu-lint LK002). Pages/slots are engine-
+            # thread-owned, so only the deque needs the lock.
+            prompt = req.effective_prompt()
+            shared, n_shared = [], 0
+            if self.prefix_cache is not None:
+                # prefix-cache hit: the shared head's pages are taken
+                # by reference (no prefill compute, no page writes) —
+                # only the tail needs private pages
+                shared, n_shared = self.prefix_cache.lookup(prompt)
+            need = pages_for(len(prompt) + 1, self.page_size) \
+                - len(shared)
+            if not self.allocator.can_alloc(need):
+                if shared:    # un-ref the speculative hit
+                    self.allocator.free(shared)
+                break
+            with self._lock:
+                if not self.waiting or self.waiting[0] is not req:
+                    # a readmission (eviction / migration fallback, maybe
+                    # from another engine's thread) jumped the queue head
+                    # while the lock was dropped: un-ref and re-examine
+                    if shared:
                         self.allocator.free(shared)
-                    break
+                    continue
                 self.waiting.popleft()
                 self._space.notify_all()
             req.pages = shared + self.allocator.alloc(need)

@@ -1,20 +1,27 @@
-"""tpu-lint — framework-native static analysis for paddle_tpu (ISSUE 12).
+"""tpu-lint — framework-native static analysis for paddle_tpu (ISSUE 12,
+project-wide two-pass analysis since ISSUE 15).
 
-Five pure-AST rule families catch, before a run, the bug classes the
+Eight pure-AST rule families catch, before a run, the bug classes the
 runtime machinery diagnoses after one:
 
 * ``collective-order`` (CO) — collectives under rank-/data-/exception-
-  dependent control flow (the desync exit-21 class);
+  dependent control flow (the desync exit-21 class), including the
+  interprocedural CO005 through the project call graph;
 * ``trace-purity`` (TP) — side effects baked into traced/cached programs
   (the stale `_jit_cache` replay class);
 * ``host-sync`` (HS) — blocking fetches on designated hot paths;
 * ``jax-compat`` (JC) — jax surfaces that must route through
   ``core/jax_compat``;
-* ``donation`` (DN) — reads of buffers already donated to a jitted call.
+* ``donation`` (DN) — reads of buffers already donated to a jitted call;
+* ``locks`` (LK) — ABBA lock order, blocking calls under contended
+  locks, signal/atexit-reachable acquisitions;
+* ``store-keys`` (SK) — the distributed/keyspace.py key protocol;
+* ``bounded-compile`` (RC) — the serving compile-count contract.
 
 CLI::
 
     python -m paddle_tpu.tools.analyze                 # scan, gate on baseline
+    python -m paddle_tpu.tools.analyze --changed-only  # pre-commit loop
     python -m paddle_tpu.tools.analyze --update-baseline
     python -m paddle_tpu.tools.analyze path/to/file.py --no-baseline
 
@@ -27,8 +34,9 @@ paddle_tpu framework modules.
 """
 from .engine import (  # noqa: F401
     EXIT_NEW_FINDINGS, FAMILIES, Finding, all_rules, analyze_file,
-    analyze_paths, diff_against_baseline, finding_key, format_finding,
-    iter_py_files, load_baseline, package_root, save_baseline,
+    analyze_paths, diff_against_baseline, finding_key, fingerprint,
+    format_finding, iter_py_files, load_baseline, package_root,
+    save_baseline,
 )
 
 DEFAULT_BASELINE = __file__.rsplit("/", 1)[0] + "/baseline.json"

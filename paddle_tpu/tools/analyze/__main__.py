@@ -1,22 +1,30 @@
 """tpu-lint CLI — ``python -m paddle_tpu.tools.analyze``.
 
-Scans the paddle_tpu tree (or explicit paths) with the five rule families
+Scans the paddle_tpu tree (or explicit paths) with the eight rule families
 and gates against the checked-in ratcheting baseline: pre-existing findings
 ride, any NEW finding exits :data:`EXIT_NEW_FINDINGS` (7).  Designed to run
 as the post-verify gate next to ``tools/slowest_tests.py``.
+
+``--changed-only`` scopes the scan to the files git says differ from HEAD
+(staged, unstaged and untracked), reusing the summary DB for everything
+else — the pre-commit loop runs in well under 2 s while the project-level
+rules still see the whole call graph.
 """
 from __future__ import annotations
 
 import argparse
 import json
 import os
+import subprocess
 import sys
 import time
 
 from . import DEFAULT_BASELINE
 from .engine import (EXIT_NEW_FINDINGS, all_rules, analyze_paths,
-                     diff_against_baseline, format_finding, load_baseline,
-                     package_root, save_baseline)
+                     diff_against_baseline, fingerprint, format_finding,
+                     load_baseline, package_root, save_baseline)
+
+JSON_SCHEMA = 2
 
 
 def _list_rules() -> str:
@@ -29,12 +37,46 @@ def _list_rules() -> str:
         for r in rows)
 
 
+def _git_changed(repo: str):
+    """Repo-relative paths of files differing from HEAD (staged +
+    unstaged + untracked) — or None when git is unusable (the caller
+    falls back to a full scan; scoping is an accelerator, not a gate)."""
+    try:
+        # --relative makes diff output cwd-relative, matching BOTH
+        # ls-files (always cwd-relative) and _rel_ids()'s package-parent
+        # base — without it a checkout nested inside a larger git repo
+        # emits toplevel-relative names that never match, and the scoped
+        # gate passes vacuously
+        diff = subprocess.run(
+            ["git", "diff", "--name-only", "--relative", "HEAD"], cwd=repo,
+            capture_output=True, text=True, timeout=10)
+        untracked = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard"],
+            cwd=repo, capture_output=True, text=True, timeout=10)
+        if diff.returncode != 0:
+            return None
+        # splitlines, not split: a path with a space is one name
+        names = set(diff.stdout.splitlines())
+        if untracked.returncode == 0:
+            names |= set(untracked.stdout.splitlines())
+        return {n.strip() for n in names if n.strip().endswith(".py")}
+    except Exception:
+        return None
+
+
+def _finding_json(f) -> dict:
+    d = dict(vars(f))
+    d["fingerprint"] = fingerprint(f)
+    return d
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m paddle_tpu.tools.analyze",
-        description="tpu-lint: pure-AST static analysis for paddle_tpu "
-                    "(collective-order, trace-purity, host-sync, jax-compat, "
-                    "donation) with a ratcheting baseline gate.")
+        description="tpu-lint: pure-AST two-pass project analysis for "
+                    "paddle_tpu (collective-order, trace-purity, host-sync, "
+                    "jax-compat, donation, locks, store-keys, "
+                    "bounded-compile) with a ratcheting baseline gate.")
     ap.add_argument("paths", nargs="*",
                     help="files/dirs to scan (default: the paddle_tpu "
                          "package root)")
@@ -44,10 +86,15 @@ def main(argv=None) -> int:
                     help="report every finding; exit 7 when any exist")
     ap.add_argument("--update-baseline", action="store_true",
                     help="rewrite the baseline from this scan's findings")
+    ap.add_argument("--changed-only", action="store_true",
+                    help="scan only files git reports changed vs HEAD; "
+                         "unchanged files feed the call graph from the "
+                         "summary DB (pre-commit loop, sub-2s)")
     ap.add_argument("--families", default=None,
                     help="comma-separated family slugs to run (default all)")
     ap.add_argument("--json", action="store_true", dest="as_json",
-                    help="emit findings as one JSON object on stdout")
+                    help="emit findings as one JSON object on stdout "
+                         "(schema 2: rule, fingerprint, qualname, callpath)")
     ap.add_argument("--list-rules", action="store_true",
                     help="print the rule table and exit")
     ap.add_argument("--assert-no-jax", action="store_true",
@@ -76,8 +123,23 @@ def main(argv=None) -> int:
                   "every other family's entries — run it unfiltered",
                   file=sys.stderr)
             return 2
+    changed = None
+    if args.changed_only:
+        if args.update_baseline:
+            print("tpu-lint: --update-baseline with --changed-only would "
+                  "rewrite the baseline from a PARTIAL scan — run it "
+                  "unfiltered", file=sys.stderr)
+            return 2
+        repo = os.path.dirname(package_root())
+        changed = _git_changed(repo)
+        # git unusable -> silent full scan (never crash the loop)
+    # only default full-tree scans refresh the summary DB — a scan of an
+    # explicit path subset (scoped or not) must not shrink the cache the
+    # next --changed-only run depends on (save_db replaces the file map)
+    persist = not args.paths
     t0 = time.perf_counter()
-    findings = analyze_paths(paths, families=families)
+    findings = analyze_paths(paths, families=families, changed=changed,
+                             persist_db=persist)
     elapsed = time.perf_counter() - t0
 
     if args.update_baseline:
@@ -100,10 +162,12 @@ def main(argv=None) -> int:
 
     if args.as_json:
         out = {
+            "schema": JSON_SCHEMA,
             "elapsed_s": round(elapsed, 3),
             "scanned": paths,
-            "new": [vars(f) for f in new],
-            "preexisting": [vars(f) for f in old],
+            "changed_only": bool(args.changed_only),
+            "new": [_finding_json(f) for f in new],
+            "preexisting": [_finding_json(f) for f in old],
         }
         print(json.dumps(out, indent=1, sort_keys=True))
     else:
@@ -111,8 +175,9 @@ def main(argv=None) -> int:
             print(format_finding(f))
         for f in new:
             print(format_finding(f, new=True))
+        scope = " (changed-only)" if args.changed_only else ""
         print(f"tpu-lint: {len(findings)} finding(s), {len(new)} new vs "
-              f"baseline, scanned in {elapsed:.2f}s")
+              f"baseline, scanned in {elapsed:.2f}s{scope}")
 
     if args.assert_no_jax and "jax" in sys.modules:
         print("tpu-lint: jax was imported during the scan — the analyzer "

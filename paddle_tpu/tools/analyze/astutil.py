@@ -1,0 +1,180 @@
+"""Shared pure-AST helpers for tpu-lint (no local imports, stdlib only).
+
+One copy of the node-walking primitives and the domain tables (collective
+names, rank spellings, store-op names) used by the per-file rule modules,
+the pass-1 summarizer, and the project-level (pass-2) rules.  Everything
+here must stay importable with nothing but the stdlib — the analyzer's
+zero-jax contract starts at this module.
+"""
+from __future__ import annotations
+
+import ast
+
+# ---- node indexing ---------------------------------------------------------
+
+
+def index_tree(tree: ast.AST):
+    """ONE DFS over the tree: attach parent links, collect the flat node
+    list the rule modules iterate (instead of each re-walking), and compute
+    dotted qualnames for named defs."""
+    nodes = []
+    qualnames = {}
+    stack = [(tree, "")]
+    while stack:
+        node, prefix = stack.pop()
+        nodes.append(node)
+        for child in ast.iter_child_nodes(node):
+            child._tpulint_parent = node  # type: ignore[attr-defined]
+            cprefix = prefix
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                cprefix = f"{prefix}.{child.name}" if prefix else child.name
+                if not isinstance(child, ast.ClassDef):
+                    qualnames[child] = cprefix
+            stack.append((child, cprefix))
+    return nodes, qualnames
+
+
+def parent(node):
+    return getattr(node, "_tpulint_parent", None)
+
+
+def parents(node):
+    p = parent(node)
+    while p is not None:
+        yield p
+        p = parent(p)
+
+
+def terminal_name(func) -> str:
+    """Last path component of a call target: ``a.b.c(...)`` -> ``"c"``."""
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return ""
+
+
+def dotted(node) -> str:
+    """Dotted source path of a Name/Attribute chain, "" when not a chain."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def enclosing_function(node):
+    for p in parents(node):
+        if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return p
+    return None
+
+
+def enclosing_class_name(node) -> str:
+    """Name of the nearest enclosing ClassDef, or ""."""
+    for p in parents(node):
+        if isinstance(p, ast.ClassDef):
+            return p.name
+    return ""
+
+
+# ---- domain tables ---------------------------------------------------------
+
+COLLECTIVES = {
+    "all_reduce", "all_gather", "all_gather_object", "reduce",
+    "reduce_scatter", "broadcast", "broadcast_object_list", "scatter",
+    "scatter_object_list", "all_to_all", "alltoall", "alltoall_single",
+    "barrier", "gloo_barrier", "all_reduce_quantized",
+}
+P2P = {"send", "recv", "isend", "irecv"}
+
+RANK_NAMES = {
+    "rank", "local_rank", "node_rank", "rank_id", "global_rank",
+    "cur_rank", "src_rank", "dst_rank", "self_rank", "world_rank",
+}
+RANK_CALLS = {"get_rank", "get_group_rank", "get_world_rank"}
+FETCH_CALLS = {"item", "numpy"}
+
+# TCPStore-shaped client surface (blocking network round-trips)
+STORE_OPS = {"get", "set", "add", "check", "delete_key", "wait",
+             "multi_get", "multi_set", "compare_set"}
+# mutating subset (``add(k, 0)`` is the counter-READ idiom, handled at
+# the call site)
+STORE_WRITE_OPS = {"set", "add", "delete_key", "compare_set", "multi_set"}
+
+
+def is_store_chain(chain: str) -> bool:
+    """A dotted receiver that is (or holds) a store client:
+    ``self.store.get`` / ``store.set`` / ``self._store.add``."""
+    parts = chain.split(".")
+    return any("store" in p.lower() for p in parts[:-1])
+
+
+def test_flags(test) -> tuple:
+    """(rank_dependent, data_dependent) for a branch test expression."""
+    rank = data = False
+    for node in ast.walk(test):
+        if isinstance(node, ast.Name) and node.id in RANK_NAMES:
+            rank = True
+        elif isinstance(node, ast.Attribute) and node.attr in RANK_NAMES:
+            rank = True
+        elif isinstance(node, ast.Call):
+            t = terminal_name(node.func)
+            if t in RANK_CALLS:
+                rank = True
+            elif t in FETCH_CALLS:
+                data = True
+    return rank, data
+
+
+def branch_context(call):
+    """Walk outward from a call collecting the branches that condition it:
+    -> (rank_if, data_if, except_handler) nodes (or None each)."""
+    rank_if = data_if = except_handler = None
+    node = call
+    for p in parents(call):
+        if isinstance(p, (ast.If, ast.While)):
+            # the test itself is evaluated unconditionally; only the body
+            # and orelse are conditioned on it
+            if node is not p.test:
+                rank, data = test_flags(p.test)
+                if rank and rank_if is None:
+                    rank_if = p
+                if data and data_if is None:
+                    data_if = p
+        elif isinstance(p, ast.IfExp):
+            if node is not p.test:
+                rank, data = test_flags(p.test)
+                if rank and rank_if is None:
+                    rank_if = p
+                if data and data_if is None:
+                    data_if = p
+        elif isinstance(p, ast.ExceptHandler):
+            if except_handler is None:
+                except_handler = p
+        elif isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda)):
+            break  # conditions outside the enclosing function don't count
+        node = p
+    return rank_if, data_if, except_handler
+
+
+def joined_leading_text(node) -> str:
+    """Static leading text of a string expression: the whole value for a
+    str Constant, the text before the first interpolation for a JoinedStr,
+    "" otherwise."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr):
+        out = []
+        for part in node.values:
+            if isinstance(part, ast.Constant) and isinstance(part.value, str):
+                out.append(part.value)
+            else:
+                break
+        return "".join(out)
+    return ""

@@ -1,0 +1,163 @@
+"""Pass 2 — the project call graph over pass-1 summaries.
+
+Resolution is deliberately *first-order*: a call site resolves to a
+project def through its dotted source spelling only —
+
+* ``self.foo(...)`` -> a def ``<CallerClass>.foo`` in the same file, else
+  any unique ``*.foo`` in the same file;
+* ``foo(...)`` -> a def named ``foo`` in the same file (module level or a
+  unique nested one), else a unique project-wide ``foo``;
+* ``a.b.foo(...)`` -> project defs whose qualname ends in ``.foo``, kept
+  only when at most :data:`MAX_CANDIDATES` candidates exist (a bounded
+  stand-in for dynamic dispatch: ``prefix_cache.lookup`` legitimately
+  means either PrefixCache.lookup or SharedPrefixCache.lookup).
+
+Anything else (callables held in variables, getattr dispatch, callbacks)
+is *unresolved* — the README documents this boundary.  Reachability is
+therefore an under-approximation: good for linting (no hallucinated
+paths), never a proof of absence.
+
+Nodes are ``(relpath, qualname)`` pairs.
+"""
+from __future__ import annotations
+
+from collections import defaultdict
+
+MAX_CANDIDATES = 3   # ambiguity bound for dotted-attribute resolution
+MAX_DEPTH = 4        # closure depth bound (call edges, not lines)
+
+_SKIP_TERMS = {
+    # high-fan-in / stdlib-shadowing names that would connect everything
+    # to everything: never resolve a bare/dotted call to these through
+    # the suffix map
+    "get", "set", "add", "check", "wait", "close", "run", "start", "stop",
+    "append", "pop", "items", "keys", "values", "update", "join", "put",
+    "flush", "write", "read", "send", "recv", "clear", "copy", "sort",
+    "split", "strip", "format", "encode", "decode", "acquire", "release",
+    "register", "record", "result", "to_dict", "from_dict", "reset",
+    "__init__", "__call__",
+}
+
+
+class CallGraph:
+    def __init__(self, summaries: dict):
+        """``summaries``: {relpath: FileSummary}."""
+        self.summaries = summaries
+        # name -> [(relpath, qualname)] by final path component
+        self._by_final = defaultdict(list)
+        # (relpath, name) -> [qualname] within one file
+        self._file_final = defaultdict(list)
+        for rel, s in summaries.items():
+            for qual in s.defs:
+                final = qual.rsplit(".", 1)[-1]
+                self._by_final[final].append((rel, qual))
+                self._file_final[(rel, final)].append(qual)
+        # STRICT adjacency (closures walk only these): a call contributes
+        # an edge only when it resolves to exactly ONE project def — the
+        # ambiguous (<= MAX_CANDIDATES) resolution is reserved for the
+        # FIRST hop at a rule's own call site, where the rule reports the
+        # candidate it matched.  Loose suffix matching transitively would
+        # connect stdlib calls (``sys.stdout.flush``) to project defs and
+        # drown the lock rules in phantom paths.
+        self.edges = defaultdict(list)
+        for rel, s in summaries.items():
+            for call in s.calls:
+                targets = self.resolve(rel, call)
+                if len(targets) == 1:
+                    self.edges[(rel, call["caller"])].append(
+                        (targets[0], call))
+
+    # ------------------------------------------------------- resolution
+    def resolve(self, relpath: str, call: dict) -> list:
+        """-> [(relpath, qualname)] candidate defs for one call record
+        (empty when unresolved)."""
+        callee, term = call["callee"], call["term"]
+        if term in _SKIP_TERMS:
+            return []
+        s = self.summaries.get(relpath)
+        caller_cls = ""
+        if s is not None:
+            info = s.defs.get(call["caller"])
+            if info:
+                caller_cls = info.get("class", "")
+            elif "." in call["caller"]:
+                caller_cls = call["caller"].split(".", 1)[0]
+        if callee.startswith("self."):
+            rest = callee[len("self."):]
+            if "." in rest:   # self.obj.meth: fall through to dotted
+                return self._dotted(term)
+            if caller_cls:
+                qual = f"{caller_cls}.{rest}"
+                if s is not None and qual in s.defs:
+                    return [(relpath, qual)]
+            cands = self._file_final.get((relpath, rest), [])
+            if len(cands) == 1:
+                return [(relpath, cands[0])]
+            return []
+        if "." not in callee:
+            cands = self._file_final.get((relpath, callee), [])
+            # prefer module-level defs over same-named methods
+            mod = [q for q in cands if "." not in q]
+            if len(mod) == 1:
+                return [(relpath, mod[0])]
+            if len(cands) == 1:
+                return [(relpath, cands[0])]
+            globl = self._by_final.get(callee, [])
+            if len(globl) == 1:
+                return list(globl)
+            return []
+        return self._dotted(term)
+
+    def _dotted(self, term: str) -> list:
+        cands = self._by_final.get(term, [])
+        if 0 < len(cands) <= MAX_CANDIDATES:
+            return list(cands)
+        return []
+
+    # ----------------------------------------------------- reachability
+    def reach(self, targets: dict, max_depth: int = MAX_DEPTH) -> dict:
+        """Reverse-BFS from target nodes.
+
+        ``targets``: {node: payload} — e.g. every function that lexically
+        contains a blocking op, payload describing the op.  Returns
+        {node: (payload, path)} for every node that can reach a target
+        through resolved edges within ``max_depth``, where ``path`` is a
+        witness chain ``[qualname, ..., target_qualname]``.  Target nodes
+        themselves are included with a single-element path.
+        """
+        # build reverse adjacency once
+        rev = defaultdict(list)
+        for src, outs in self.edges.items():
+            for (dst, _call) in outs:
+                rev[dst].append(src)
+        out = {n: (p, [n[1]]) for n, p in targets.items()}
+        frontier = list(targets)
+        for _ in range(max_depth):
+            nxt = []
+            for node in frontier:
+                payload, path = out[node]
+                for pred in rev.get(node, ()):
+                    if pred in out:
+                        continue
+                    out[pred] = (payload, [pred[1]] + path)
+                    nxt.append(pred)
+            if not nxt:
+                break
+            frontier = nxt
+        return out
+
+    def callees(self, node, max_depth: int = MAX_DEPTH) -> set:
+        """Forward closure: every node reachable FROM ``node``."""
+        seen = {node}
+        frontier = [node]
+        for _ in range(max_depth):
+            nxt = []
+            for n in frontier:
+                for (dst, _call) in self.edges.get(n, ()):
+                    if dst not in seen:
+                        seen.add(dst)
+                        nxt.append(dst)
+            if not nxt:
+                break
+            frontier = nxt
+        return seen

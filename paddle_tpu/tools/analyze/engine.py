@@ -1,17 +1,32 @@
 """tpu-lint engine — pure-AST static analysis over the paddle_tpu tree.
 
 The runtime correctness machinery (flight-recorder desync exit 21, watchdog
-hang post-mortem exit 19, the A/B kernel gates) diagnoses bug classes at run
-time; this engine catches the same classes BEFORE a run, on every PR, from
-nothing but the source text: it never imports jax (or paddle_tpu), so a full
-scan of the package costs parse time only and fits inside the tier-1 budget.
+hang post-mortem exit 19, the A/B kernel gates, the serving compile
+counters) diagnoses bug classes at run time; this engine catches the same
+classes BEFORE a run, on every PR, from nothing but the source text: it
+never imports jax (or paddle_tpu), so a full scan of the package costs
+parse time only and fits inside the tier-1 budget.
+
+Since ISSUE 15 the scan is a TWO-PASS project analysis, not a per-file
+lexical one:
+
+* **pass 1** parses each file once into a :class:`FileContext` shared by
+  the per-file rule families, and extracts a JSON-serializable
+  :class:`~.summary.FileSummary` (defs, call edges with lock/branch
+  context, lock acquisitions, store-key literals, jit install sites);
+* **pass 2** resolves a project call graph over the summaries
+  (:class:`~.callgraph.CallGraph` — first-order dotted calls only) and
+  runs the project-level rules: interprocedural collective-order (CO005),
+  lock-order/deadlock (LK), store-key protocol (SK) and bounded-compile
+  (RC) families.  Pass-2 rules consume summaries only, so a cached,
+  unchanged file participates in the graph without being re-parsed —
+  that is what makes ``--changed-only`` sub-2s.
 
 Structure:
 
 * every rule family is a module exposing ``FAMILY`` (slug), ``RULES``
-  (id -> (severity, title)) and ``run(ctx) -> list[Finding]``;
-* :class:`FileContext` is parsed once per file and shared by all families
-  (AST with parent links, raw lines, suppression table, hot-path marker);
+  (id -> (severity, title)) and ``run(ctx) -> list[Finding]``; project
+  families additionally expose ``run_project(project)``;
 * suppressions are ``# tpu-lint: ok[RULE] reason`` comments on the finding
   line or the line above — RULE is a rule id or a family slug.  A
   suppression without a reason is itself a finding (SUP001) and a
@@ -24,6 +39,7 @@ Structure:
 from __future__ import annotations
 
 import ast
+import hashlib
 import io
 import json
 import os
@@ -32,10 +48,17 @@ import tokenize
 from collections import Counter
 from dataclasses import dataclass, field
 
+from .astutil import (  # noqa: F401  (re-exported for the rule modules)
+    COLLECTIVES, P2P, dotted, enclosing_function, index_tree, parent,
+    parents, terminal_name,
+)
+from .callgraph import CallGraph
+from .summary import fresh, load_db, save_db, summarize
+
 __all__ = [
-    "Finding", "FileContext", "analyze_paths", "analyze_file",
-    "iter_py_files", "load_baseline", "save_baseline",
-    "diff_against_baseline", "finding_key", "format_finding",
+    "Finding", "FileContext", "ProjectContext", "analyze_paths",
+    "analyze_file", "iter_py_files", "load_baseline", "save_baseline",
+    "diff_against_baseline", "finding_key", "fingerprint", "format_finding",
     "FAMILIES", "all_rules", "EXIT_NEW_FINDINGS",
 ]
 
@@ -59,6 +82,8 @@ class Finding:
     message: str
     hint: str = ""
     source_line: str = ""
+    qualname: str = ""              # enclosing function, when known
+    callpath: list = field(default_factory=list)  # interprocedural witness
 
 
 @dataclass
@@ -66,7 +91,6 @@ class Suppression:
     line: int
     rules: tuple
     reason: str
-    used: bool = False
 
 
 @dataclass
@@ -96,67 +120,11 @@ class FileContext:
             return ""
 
 
-# ---- shared AST helpers (used by the rule modules) --------------------------
-
-def index_tree(tree: ast.AST):
-    """ONE DFS over the tree: attach parent links, collect the flat node
-    list the rule modules iterate (instead of each re-walking), and compute
-    dotted qualnames for named defs."""
-    nodes = []
-    qualnames = {}
-    stack = [(tree, "")]
-    while stack:
-        node, prefix = stack.pop()
-        nodes.append(node)
-        for child in ast.iter_child_nodes(node):
-            child._tpulint_parent = node  # type: ignore[attr-defined]
-            cprefix = prefix
-            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
-                                  ast.ClassDef)):
-                cprefix = f"{prefix}.{child.name}" if prefix else child.name
-                if not isinstance(child, ast.ClassDef):
-                    qualnames[child] = cprefix
-            stack.append((child, cprefix))
-    return nodes, qualnames
-
-
-def parent(node):
-    return getattr(node, "_tpulint_parent", None)
-
-
-def parents(node):
-    p = parent(node)
-    while p is not None:
-        yield p
-        p = parent(p)
-
-
-def terminal_name(func) -> str:
-    """Last path component of a call target: ``a.b.c(...)`` -> ``"c"``."""
-    if isinstance(func, ast.Attribute):
-        return func.attr
-    if isinstance(func, ast.Name):
-        return func.id
-    return ""
-
-
-def dotted(node) -> str:
-    """Dotted source path of a Name/Attribute chain, "" when not a chain."""
-    parts = []
-    while isinstance(node, ast.Attribute):
-        parts.append(node.attr)
-        node = node.value
-    if isinstance(node, ast.Name):
-        parts.append(node.id)
-        return ".".join(reversed(parts))
-    return ""
-
-
-def enclosing_function(node):
-    for p in parents(node):
-        if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
-            return p
-    return None
+@dataclass
+class ProjectContext:
+    """Everything pass-2 rules see: summaries + the resolved call graph."""
+    summaries: dict              # relpath -> FileSummary
+    graph: CallGraph
 
 
 # ---- file parsing -----------------------------------------------------------
@@ -212,14 +180,16 @@ def build_context(path: str, relpath: str, pkg_relpath: str):
 # ---- rule registry ----------------------------------------------------------
 
 def _families():
-    from . import (rules_collective, rules_donation, rules_hostsync,
-                   rules_jaxcompat, rules_purity)
+    from . import (rules_collective, rules_compile, rules_donation,
+                   rules_hostsync, rules_jaxcompat, rules_locks,
+                   rules_purity, rules_storekeys)
     return [rules_collective, rules_purity, rules_hostsync,
-            rules_jaxcompat, rules_donation]
+            rules_jaxcompat, rules_donation, rules_locks,
+            rules_storekeys, rules_compile]
 
 
 FAMILIES = ("collective-order", "trace-purity", "host-sync", "jax-compat",
-            "donation")
+            "donation", "locks", "store-keys", "bounded-compile")
 
 _SUP_RULES = {
     "SUP001": ("error", "suppression without a reason"),
@@ -254,38 +224,49 @@ def _ran(ref: str, families) -> bool:
     return info is not None and info[0] in families
 
 
-def _apply_suppressions(ctx: FileContext, findings, families=None):
+def _apply_suppressions(findings, table, line_text, relpath, emit_sup,
+                        families=None):
+    """Apply one file's suppression table to its findings.
+
+    ``table``: {line: Suppression}; ``line_text``: lineno -> stripped
+    source (for the SUP findings' own fingerprints); ``emit_sup``: only
+    files whose per-file rules ran get SUP001/SUP002 findings (a cached
+    file in a --changed-only scan is not judgeable).
+    """
     kept = []
+    used = set()
     for f in findings:
         suppressed = False
         for ln in (f.line, f.line - 1):
-            s = ctx.suppressions.get(ln)
+            s = table.get(ln)
             if s and (f.rule in s.rules or f.family in s.rules):
-                s.used = True
+                used.add(ln)
                 if s.reason:
                     suppressed = True
                 # a reason-less suppression does NOT suppress: the finding
                 # stays AND the bare annotation is flagged below
         if not suppressed:
             kept.append(f)
-    for s in ctx.suppressions.values():
+    if not emit_sup:
+        return kept
+    for ln, s in table.items():
         if not s.reason:
             kept.append(Finding(
-                file=ctx.relpath, line=s.line, col=0, rule="SUP001",
+                file=relpath, line=ln, col=0, rule="SUP001",
                 family="suppression", severity="error",
                 message=f"suppression ok[{','.join(s.rules)}] carries no "
                         "reason — bare allowlisting is not allowed",
                 hint="append why the site is sanctioned: "
                      "# tpu-lint: ok[RULE] <reason>",
-                source_line=ctx.line_text(s.line)))
-        elif not s.used and all(_ran(r, families) for r in s.rules):
+                source_line=line_text(ln)))
+        elif ln not in used and all(_ran(r, families) for r in s.rules):
             kept.append(Finding(
-                file=ctx.relpath, line=s.line, col=0, rule="SUP002",
+                file=relpath, line=ln, col=0, rule="SUP002",
                 family="suppression", severity="warning",
                 message=f"suppression ok[{','.join(s.rules)}] matches no "
                         "finding on its line — stale, delete it",
                 hint="the code it sanctioned changed; remove the comment",
-                source_line=ctx.line_text(s.line)))
+                source_line=line_text(ln)))
     return kept
 
 
@@ -326,28 +307,105 @@ def _rel_ids(path: str):
     return rel, pkg_rel
 
 
-def analyze_file(path: str, families=None):
-    relpath, pkg_rel = _rel_ids(path)
-    ctx, err = build_context(path, relpath, pkg_rel)
-    if err is not None:
-        return [err]
+# ---- the two-pass scan ------------------------------------------------------
+
+def analyze_paths(paths, families=None, changed=None, db_path=None,
+                  persist_db=False):
+    """Scan ``paths`` with both passes.
+
+    ``changed``: None for a full scan; else a set of relpaths (repo-root
+    relative) — only those files are parsed + rule-checked, every other
+    file contributes its (cached, or silently re-built) pass-1 summary to
+    the project graph.  ``persist_db`` refreshes the summary DB after the
+    scan (the CLI does; library/test scans of scratch files do not).
+    """
+    files = []
+    for root in paths:
+        for path in iter_py_files(root):
+            rel, pkg = _rel_ids(path)
+            files.append((path, rel, pkg))
+    # the DB is only a READ input for scoped scans; a full scan rebuilds
+    # every summary anyway and would parse the multi-MB JSON for nothing
+    cached = load_db(db_path) if changed is not None else {}
+
+    contexts = {}     # relpath -> FileContext (files whose rules run)
+    summaries = {}    # relpath -> FileSummary (every file)
+    scanned = set()   # relpaths whose per-file rules ran
+    parse_failed = set()
     findings = []
+    for path, rel, pkg in files:
+        is_scanned = changed is None or rel in changed or path in changed
+        if not is_scanned:
+            cs = cached.get(rel)
+            if cs is not None and fresh(cs, path):
+                summaries[rel] = cs
+                continue
+        ctx, err = build_context(path, rel, pkg)
+        if err is not None:
+            if is_scanned:
+                findings.append(err)
+                parse_failed.add(rel)
+            continue
+        summaries[rel] = summarize(ctx)
+        if is_scanned:
+            contexts[rel] = ctx
+            scanned.add(rel)
+
+    # pass-1 (per-file) rules
+    for rel in scanned:
+        ctx = contexts[rel]
+        for mod in _families():
+            if families and mod.FAMILY not in families:
+                continue
+            run = getattr(mod, "run", None)  # project-only families skip
+            if run is not None:
+                findings.extend(run(ctx))
+
+    # pass-2 (project) rules over ALL summaries
+    project = ProjectContext(summaries=summaries,
+                             graph=CallGraph(summaries))
     for mod in _families():
         if families and mod.FAMILY not in families:
             continue
-        findings.extend(mod.run(ctx))
-    findings = _apply_suppressions(ctx, findings, families=families)
-    findings.sort(key=lambda f: (f.line, f.col, f.rule))
-    return findings
+        runp = getattr(mod, "run_project", None)
+        if runp is not None:
+            findings.extend(runp(project))
+    if changed is not None:
+        # scoped scan: only findings landing in the changed files report
+        # (PARSE001 files never reach `scanned` but ARE changed work) —
+        # filtered BEFORE suppression application, so everything below
+        # deals only in files whose suppression tables exist (scanned
+        # files have a live ctx; parse-failed files can have none)
+        findings = [f for f in findings
+                    if f.file in scanned or f.file in parse_failed]
+
+    # suppressions, per file.  Scanned files with ZERO findings still
+    # need their SUP001/SUP002 checks, so iterate the union.
+    by_file = {}
+    for f in findings:
+        by_file.setdefault(f.file, []).append(f)
+    out = []
+    for rel in set(by_file) | scanned:
+        fs = by_file.get(rel, [])
+        ctx = contexts.get(rel)
+        if ctx is not None:
+            table, line_text = ctx.suppressions, ctx.line_text
+        else:   # parse-failed: nothing to suppress, nothing to judge
+            table, line_text = {}, (lambda _ln: "")
+        out.extend(_apply_suppressions(fs, table, line_text, rel,
+                                       emit_sup=rel in scanned,
+                                       families=families))
+
+    if persist_db:
+        save_db(summaries, db_path)
+    out.sort(key=lambda f: (f.file, f.line, f.col, f.rule))
+    return out
 
 
-def analyze_paths(paths, families=None):
-    findings = []
-    for root in paths:
-        for path in iter_py_files(root):
-            findings.extend(analyze_file(path, families=families))
-    findings.sort(key=lambda f: (f.file, f.line, f.col, f.rule))
-    return findings
+def analyze_file(path: str, families=None):
+    """Scan ONE file (a one-file project: the per-file families plus the
+    project families over the single-file graph)."""
+    return analyze_paths([path], families=families)
 
 
 # ---- baseline ratchet -------------------------------------------------------
@@ -355,6 +413,12 @@ def analyze_paths(paths, families=None):
 def finding_key(f: Finding):
     text = re.sub(r"\s+", " ", f.source_line).strip()
     return (f.file, f.rule, text)
+
+
+def fingerprint(f: Finding) -> str:
+    """Stable short hex id of a finding's baseline key (the --json
+    schema's machine-readable handle)."""
+    return hashlib.sha1("|".join(finding_key(f)).encode()).hexdigest()[:12]
 
 
 def load_baseline(path: str) -> Counter:
@@ -401,5 +465,8 @@ def diff_against_baseline(findings, baseline: Counter):
 def format_finding(f: Finding, new: bool = False) -> str:
     tag = " NEW" if new else ""
     hint = f"\n      hint: {f.hint}" if f.hint else ""
+    path = ""
+    if f.callpath:
+        path = f"\n      via: {' -> '.join(f.callpath)}"
     return (f"{f.file}:{f.line}:{f.col}: {f.rule} [{f.severity}]{tag} "
-            f"{f.message}{hint}")
+            f"{f.message}{hint}{path}")

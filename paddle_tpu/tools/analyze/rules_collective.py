@@ -5,21 +5,30 @@ different collective sequence at run time; these rules catch the shapes that
 produce one statically: a collective issue site reached under rank-dependent,
 data-dependent, or exception-dependent control flow.
 
+CO001-004 are per-file (the issue site and the divergent branch are in the
+same function).  CO005 is the project-level closure of the same hazard: a
+helper that (transitively) issues a collective, CALLED under a
+rank-dependent branch — possibly two files away — splits the schedule just
+as surely, but no single-file scan can see it.  Resolution follows the
+pass-2 call graph (first-order dotted calls only; see callgraph.py).
+
 Sanctioned shapes the rules know:
 
 * ranked point-to-point (``send``/``recv``/``isend``/``irecv``) is EXPECTED
-  to branch on rank — exempt from CO001/CO004;
+  to branch on rank — exempt from CO001/CO004/CO005;
 * host-state guards that are identical across ranks by construction
   (``no_sync()`` accumulation flags, partial-bucket flush at backward end)
   contain no rank/data reference and are never flagged;
 * genuinely rank-guarded sites that are safe for a documented reason carry
-  ``# tpu-lint: ok[CO001] <reason>``.
+  ``# tpu-lint: ok[CO001] <reason>`` (or ok[CO005] at a call site).
 """
 from __future__ import annotations
 
 import ast
 
-from .engine import Finding, parent, parents, terminal_name
+from .astutil import (COLLECTIVES, P2P, branch_context, parent, parents,
+                      terminal_name, test_flags)
+from .engine import Finding
 
 FAMILY = "collective-order"
 
@@ -28,39 +37,10 @@ RULES = {
     "CO002": ("error", "collective issued inside an exception handler"),
     "CO003": ("error", "collective under a device-data-dependent branch"),
     "CO004": ("error", "collective after a rank-dependent early exit"),
+    "CO005": ("error",
+              "collective-reaching helper called under a rank-dependent "
+              "branch (interprocedural)"),
 }
-
-COLLECTIVES = {
-    "all_reduce", "all_gather", "all_gather_object", "reduce",
-    "reduce_scatter", "broadcast", "broadcast_object_list", "scatter",
-    "scatter_object_list", "all_to_all", "alltoall", "alltoall_single",
-    "barrier", "gloo_barrier", "all_reduce_quantized",
-}
-P2P = {"send", "recv", "isend", "irecv"}
-
-_RANK_NAMES = {
-    "rank", "local_rank", "node_rank", "rank_id", "global_rank",
-    "cur_rank", "src_rank", "dst_rank", "self_rank", "world_rank",
-}
-_RANK_CALLS = {"get_rank", "get_group_rank", "get_world_rank"}
-_FETCH_CALLS = {"item", "numpy"}
-
-
-def _test_flags(test) -> tuple:
-    """(rank_dependent, data_dependent) for a branch test expression."""
-    rank = data = False
-    for node in ast.walk(test):
-        if isinstance(node, ast.Name) and node.id in _RANK_NAMES:
-            rank = True
-        elif isinstance(node, ast.Attribute) and node.attr in _RANK_NAMES:
-            rank = True
-        elif isinstance(node, ast.Call):
-            t = terminal_name(node.func)
-            if t in _RANK_CALLS:
-                rank = True
-            elif t in _FETCH_CALLS:
-                data = True
-    return rank, data
 
 
 def _collective_calls(tree):
@@ -71,37 +51,6 @@ def _collective_calls(tree):
                 yield node, t
 
 
-def _branch_context(call):
-    """Walk outward from a call collecting the branches that condition it."""
-    rank_if = data_if = except_handler = None
-    node = call
-    for p in parents(call):
-        if isinstance(p, (ast.If, ast.While)):
-            # the test itself is evaluated unconditionally; only the body
-            # and orelse are conditioned on it
-            if node is not p.test:
-                rank, data = _test_flags(p.test)
-                if rank and rank_if is None:
-                    rank_if = p
-                if data and data_if is None:
-                    data_if = p
-        elif isinstance(p, ast.IfExp):
-            if node is not p.test:
-                rank, data = _test_flags(p.test)
-                if rank and rank_if is None:
-                    rank_if = p
-                if data and data_if is None:
-                    data_if = p
-        elif isinstance(p, ast.ExceptHandler):
-            if except_handler is None:
-                except_handler = p
-        elif isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef,
-                            ast.Lambda)):
-            break  # conditions outside the enclosing function don't count
-        node = p
-    return rank_if, data_if, except_handler
-
-
 def _is_rank_early_exit(node) -> bool:
     """An If with a rank-dependent test whose body unconditionally leaves
     the function/loop (return/break/continue) — everything after it runs on
@@ -110,7 +59,7 @@ def _is_rank_early_exit(node) -> bool:
         return False
     if not isinstance(node.body[-1], (ast.Return, ast.Break, ast.Continue)):
         return False
-    rank, _ = _test_flags(node.test)
+    rank, _ = test_flags(node.test)
     return rank
 
 
@@ -135,7 +84,7 @@ def run(ctx):
     calls = [(n, t) for n, t in calls if t in COLLECTIVES or t in P2P]
     for call, name in calls:
         p2p = name in P2P
-        rank_if, data_if, except_handler = _branch_context(call)
+        rank_if, data_if, except_handler = branch_context(call)
         if rank_if is not None and not p2p:
             findings.append(Finding(
                 file=ctx.relpath, line=call.lineno, col=call.col_offset,
@@ -187,4 +136,54 @@ def run(ctx):
                         hint="issue the collective before the rank gate, "
                              "or restructure so every rank reaches it",
                         source_line=ctx.src(call)))
+    return findings
+
+
+# ---- CO005: interprocedural ------------------------------------------------
+
+def run_project(project):
+    """A rank-gated call site whose (transitively resolved) callee issues
+    a collective: the same desync class CO001 catches in one function,
+    across the call graph."""
+    graph = project.graph
+    # every function that LEXICALLY issues a non-p2p collective
+    targets = {}
+    for rel, s in project.summaries.items():
+        for c in s.collectives:
+            if c["name"] in P2P:
+                continue
+            targets.setdefault((rel, c["fn"]),
+                               {"name": c["name"], "line": c["line"]})
+    if not targets:
+        return []
+    reach = graph.reach(targets)
+    findings = []
+    for rel, s in project.summaries.items():
+        for call in s.calls:
+            if not call.get("rank_gated"):
+                continue
+            term = call["term"]
+            if term in COLLECTIVES or term in P2P:
+                continue  # the direct site: CO001's jurisdiction
+            for node in graph.resolve(rel, call):
+                hit = reach.get(node)
+                if hit is None:
+                    continue
+                payload, path = hit
+                findings.append(Finding(
+                    file=rel, line=call["line"], col=call["col"],
+                    rule="CO005", family=FAMILY, severity="error",
+                    message=f"'{call['callee']}' reaches collective "
+                            f"'{payload['name']}' "
+                            f"({path[-1]}, {node[0]}:{payload['line']}) "
+                            "but is called under a rank-dependent branch "
+                            "— ranks skipping the call skip the "
+                            "collective (desync exit-21 class)",
+                    hint="hoist the call out of the rank gate, or "
+                         "suppress with the reason all ranks agree on "
+                         "the predicate",
+                    source_line=call["text"],
+                    qualname=call["caller"],
+                    callpath=[call["caller"]] + path))
+                break  # one finding per call site, not per candidate
     return findings

@@ -1,0 +1,82 @@
+"""bounded-compile (RC) — the serving compile contract, statically.
+
+The ragged serving round (PR 13) collapsed the bucket matrix to <= 4
+programs per mixed round and made the count OBSERVABLE:
+``ServingMetrics.on_compile`` feeds ``serving_compiles_total`` /
+``serving_distinct_programs`` from ``_note_program`` at every install
+site.  A shape-specialized ``jax.jit`` added to a serving path without
+that accounting re-opens the blowup invisibly — the counters stay flat
+while XLA compiles behind the scheduler's back, and the bench's
+``distinct <= 4`` gate reads a lie.  These rules keep every install site
+on the books:
+
+* **RC001** — a ``jax.jit``/``pjit`` install site in the serving
+  subsystem (or a ``# tpu-lint: hot-path`` file) whose surrounding class
+  (or module scope) never touches ``_note_program``/``on_compile``.
+* **RC002** — a cache key built from ``id(obj)`` (or any
+  identity-hashed object) without a visible keepalive: a freed object's
+  id is recycled, and the NEW callable silently inherits the OLD entry's
+  compiled program (the exact dispatch-cache hazard PR 7 hardened
+  against — keyed objects must be pinned).
+"""
+from __future__ import annotations
+
+from .engine import Finding
+
+FAMILY = "bounded-compile"
+
+RULES = {
+    "RC001": ("error", "unaccounted jit install on a serving path"),
+    "RC002": ("warning", "identity-keyed cache without a visible "
+                         "keepalive"),
+}
+
+
+def _class_of(qualname: str) -> str:
+    return qualname.split(".", 1)[0] if "." in qualname else ""
+
+
+def run_project(project):
+    findings = []
+    for rel, s in project.summaries.items():
+        serving = (s.pkg_relpath or "").startswith("serving/") or s.hot_file
+        if serving:
+            noted_classes = {_class_of(q) for q in s.notes_compile}
+            noted_module = bool(s.notes_compile)
+            for rec in s.jit_sites:
+                cls = _class_of(rec["fn"])
+                accounted = (cls in noted_classes) if cls \
+                    else noted_module
+                if accounted:
+                    continue
+                findings.append(Finding(
+                    file=rel, line=rec["line"], col=rec["col"],
+                    rule="RC001", family=FAMILY, severity="error",
+                    message=f"`{rec['wrapper']}` install in "
+                            f"'{rec['fn']}' with no _note_program/"
+                            "on_compile anywhere in its "
+                            f"{'class' if cls else 'module'} — a "
+                            "shape-specialized program the "
+                            "serving_compiles_total contract never "
+                            "sees (bounded-compile gate reads a lie)",
+                    hint="thread the install through "
+                         "ServingEngine._note_program (or call "
+                         "metrics.on_compile), or suppress with the "
+                         "reason the program is compile-time-bounded "
+                         "elsewhere",
+                    source_line=rec["text"], qualname=rec["fn"]))
+        # RC002 applies tree-wide: identity-keyed caches alias recycled
+        # ids wherever they live
+        for rec in s.idkey_sites:
+            findings.append(Finding(
+                file=rel, line=rec["line"], col=rec["col"],
+                rule="RC002", family=FAMILY, severity="warning",
+                message=f"cache key built from `id(...)` in "
+                        f"'{rec['fn']}' — once the keyed object is "
+                        "freed its id is recycled and a NEW callable "
+                        "inherits the OLD entry's compiled program",
+                hint="pin the keyed object in a keepalive map for the "
+                     "entry's lifetime (dispatch.py's _jit_keepalive "
+                     "shape), then suppress with that reason",
+                source_line=rec["text"], qualname=rec["fn"]))
+    return findings

@@ -1,0 +1,210 @@
+"""locks (LK) — cross-thread deadlock shapes, project-wide.
+
+The serving/fleet tier is the first genuinely multi-threaded subsystem in
+the tree (engine serve loop + SIGTERM drain watcher + router dispatch +
+registry heartbeats + page-share daemons), and PRs 10/13/14 each burned a
+review round on a lock bug the per-file lint could not see: the
+SIGTERM-drain-vs-foreground-step race, the signal-frame self-deadlock, a
+store round-trip under the scheduler lock.  These rules catch the three
+static shapes behind those bugs, using the pass-2 summaries + call graph:
+
+* **LK001** — two code paths acquire the same two locks in opposite
+  nesting order (the classic ABBA deadlock).  Order pairs come from
+  lexical nesting AND from calls made while a lock is held, resolved
+  through the project call graph.
+* **LK002** — a blocking call (TCPStore round-trip, collective,
+  ``.result()``) made while holding a lock that other threads contend on.
+  Locks whose NAME marks them as store-serialization locks
+  (``*store*``) exist precisely to bracket store round-trips and are
+  exempt.
+* **LK003** — a lock acquisition reachable from a signal handler (error)
+  or an atexit callback (warning).  A signal frame interrupts the very
+  thread that may already hold the lock: acquiring it re-entrantly is a
+  self-deadlock (the PR-10 fix moved the SIGTERM drain to a watcher
+  thread for exactly this reason — this rule keeps it moved).
+
+Identity: ``self._x`` canonicalizes to ``Class._x`` — the same lock
+attribute on every instance path through the class.  Distinct instances
+of one class sharing a canonical id can over-approximate (two routers'
+private locks are not one lock); that costs a rare suppression, never a
+missed deadlock.
+"""
+from __future__ import annotations
+
+from .engine import Finding
+from .summary import lock_is_exempt
+
+FAMILY = "locks"
+
+RULES = {
+    "LK001": ("error", "inconsistent nested lock-acquisition order"),
+    "LK002": ("error", "blocking call while holding a contended lock"),
+    "LK003": ("error", "lock acquired in a signal/atexit-reachable "
+                       "function"),
+}
+
+
+def _locks_by_fn(project):
+    """(relpath, fn) -> [lock acquisition records]."""
+    out = {}
+    for rel, s in project.summaries.items():
+        for rec in s.locks:
+            out.setdefault((rel, rec["fn"]), []).append(rec)
+    return out
+
+
+def _blocking_targets(project):
+    """(relpath, fn) -> first lexical blocking-op record.  A store op
+    bracketed by its own exempt ``_store_lock`` is still a blocking op
+    for a CALLER holding some other lock — the exemption only silences
+    the direct (same-function) finding, never the reach target."""
+    out = {}
+    for rel, s in project.summaries.items():
+        for rec in s.blocking:
+            out.setdefault((rel, rec["fn"]), rec)
+    return out
+
+
+def _order_pairs(project, locks_by_fn):
+    """{(outer, inner): [site]} — every observed nesting order, lexical
+    and through calls made with a lock held."""
+    pairs = {}
+
+    def note(outer, inner, rel, rec, via=None):
+        if outer == inner:
+            return  # re-entrant same-lock: RLock territory, not ABBA
+        site = {"rel": rel, "line": rec["line"], "col": rec["col"],
+                "text": rec["text"], "fn": rec["fn"], "via": via or []}
+        pairs.setdefault((outer, inner), []).append(site)
+
+    for rel, s in project.summaries.items():
+        for rec in s.locks:
+            for outer in rec["held"]:
+                note(outer, rec["lock"], rel, rec)
+        for call in s.calls:
+            if not call["held"]:
+                continue
+            for target in project.graph.resolve(rel, call):
+                for node in project.graph.callees(target):
+                    for lrec in locks_by_fn.get(node, ()):
+                        for outer in call["held"]:
+                            note(outer, lrec["lock"], rel, call,
+                                 via=[call["caller"], node[1]])
+    return pairs
+
+
+def run_project(project):
+    findings = []
+    locks_by_fn = _locks_by_fn(project)
+
+    # ---- LK001: conflicting orders
+    pairs = _order_pairs(project, locks_by_fn)
+    flagged = set()
+    for (a, b), sites in pairs.items():
+        if (b, a) not in pairs or (b, a) in flagged:
+            continue
+        flagged.add((a, b))
+        other = pairs[(b, a)][0]
+        for site in sites[:1] + pairs[(b, a)][:1]:
+            o1, o2 = ((a, b) if site in sites else (b, a))
+            peer = other if site in sites else sites[0]
+            findings.append(Finding(
+                file=site["rel"], line=site["line"], col=site["col"],
+                rule="LK001", family=FAMILY, severity="error",
+                message=f"lock order {o1} -> {o2} here, but "
+                        f"{peer['rel']}:{peer['line']} ({peer['fn']}) "
+                        f"takes {o2} -> {o1} — two threads on these "
+                        "paths can deadlock (ABBA)",
+                hint="pick one global order for the two locks and "
+                     "restructure the minority path",
+                source_line=site["text"], qualname=site["fn"],
+                callpath=site["via"]))
+
+    # ---- LK002: blocking under a contended lock
+    btargets = _blocking_targets(project)
+    breach = project.graph.reach(btargets)
+    direct_flagged = set()   # (rel, fn) that got a DIRECT finding below
+    for rel, s in project.summaries.items():
+        # direct: the blocking op itself sits in a lock region
+        for rec in s.blocking:
+            held = [h for h in rec["held"] if not lock_is_exempt(h)]
+            if not held:
+                continue
+            direct_flagged.add((rel, rec["fn"]))
+            findings.append(Finding(
+                file=rel, line=rec["line"], col=rec["col"],
+                rule="LK002", family=FAMILY, severity="error",
+                message=f"blocking {rec['kind']} call "
+                        f"`{rec['chain']}` while holding {held[-1]} — "
+                        "every thread contending on the lock stalls for "
+                        "the full round-trip (and a store outage turns "
+                        "the lock region into a deadlock)",
+                hint="move the blocking call outside the lock region, "
+                     "or suppress with the reason the round-trip is "
+                     "bounded and the lock is not on a hot path",
+                source_line=rec["text"], qualname=rec["fn"]))
+        # interprocedural: a call made under the lock reaches one
+        for call in s.calls:
+            held = [h for h in call["held"] if not lock_is_exempt(h)]
+            if not held:
+                continue
+            if (rel, call["caller"]) in direct_flagged:
+                # the direct finding above already names this function's
+                # hazard — mere btargets membership (an UNLOCKED lexical
+                # blocking op elsewhere in the fn) must not skip it
+                continue
+            for target in project.graph.resolve(rel, call):
+                hit = breach.get(target)
+                if hit is None:
+                    continue
+                payload, path = hit
+                findings.append(Finding(
+                    file=rel, line=call["line"], col=call["col"],
+                    rule="LK002", family=FAMILY, severity="error",
+                    message=f"'{call['callee']}' reaches blocking "
+                            f"{payload['kind']} call "
+                            f"`{payload['chain']}` but is called while "
+                            f"holding {held[-1]} — the lock is held "
+                            "across a network round-trip",
+                    hint="move the call outside the lock region, or "
+                         "suppress with the reason the round-trip is "
+                         "bounded and acceptable under this lock",
+                    source_line=call["text"], qualname=call["caller"],
+                    callpath=[call["caller"]] + path))
+                break
+    # ---- LK003: locks reachable from signal/atexit frames
+    for rel, s in project.summaries.items():
+        for reg in s.handlers:
+            h = reg["handler"]
+            node_list = project.graph.resolve(
+                rel, {"callee": h, "term": h.rsplit(".", 1)[-1],
+                      "caller": "<module>"})
+            sev = "error" if reg["kind"] == "signal" else "warning"
+            for handler_node in node_list:
+                for node in project.graph.callees(handler_node):
+                    for lrec in locks_by_fn.get(node, ()):
+                        findings.append(Finding(
+                            file=node[0], line=lrec["line"],
+                            col=lrec["col"],
+                            rule="LK003", family=FAMILY, severity=sev,
+                            message=f"lock {lrec['lock']} acquired in "
+                                    f"'{node[1]}', reachable from the "
+                                    f"{reg['kind']} handler '{h}' "
+                                    f"({rel}:{reg['line']}) — a signal "
+                                    "frame interrupting the holder "
+                                    "self-deadlocks"
+                                    if reg["kind"] == "signal" else
+                                    f"lock {lrec['lock']} acquired in "
+                                    f"'{node[1]}', reachable from the "
+                                    f"atexit callback '{h}' "
+                                    f"({rel}:{reg['line']}) — exit-time "
+                                    "teardown can wedge behind a thread "
+                                    "that died holding it",
+                            hint="handlers should only set flags; do the "
+                                 "locked work on a watcher thread "
+                                 "(PR-10's SIGTERM-drain shape), or "
+                                 "suppress with the reason the lock "
+                                 "cannot be held at handler time",
+                            source_line=lrec["text"], qualname=node[1],
+                            callpath=[h, node[1]]))
+    return findings

@@ -1,0 +1,131 @@
+"""store-keys (SK) — the control-plane keyspace protocol, machine-checked.
+
+The replicated control plane (PR 10) turned the TCPStore key namespace
+into a PROTOCOL: ``__``-internal keys skip the WAL, registry-scope keys
+ride it, counters are claim-bracketed, and failover rotates
+incarnation-scoped keys.  That protocol used to live in ~48 raw string
+literals across tcp_store.py, elastic.py and serving/fleet/ — one typo'd
+prefix away from a silent replication gap.  ISSUE 15 consolidates the
+literals into ``distributed/keyspace.py``; these rules keep them there:
+
+* **SK001** — a key literal with a known root (``__wal/``, ``__fence/``,
+  ``elastic/``, ``serving/``, ``pshare/``) anywhere OUTSIDE the keyspace
+  module.  Keys must come from the shared builders, so every subsystem
+  agrees on the wire spelling.
+* **SK002** — two different subsystems (top-level package dirs) WRITING
+  under the same key root: a collision class no single file can see
+  (the WAL applies both writers' mutations to one namespace).
+* **SK003** — a mutating store op whose key is an ad-hoc inline string
+  that routes through NO funnel (no keyspace builder, no
+  ``*prefix*``/``*scope*``/``_k`` helper): failover re-homing and
+  incarnation rotation only cover keys built through the funnels.
+"""
+from __future__ import annotations
+
+from .engine import Finding
+from .summary import KEYSPACE_FILE
+
+FAMILY = "store-keys"
+
+RULES = {
+    "SK001": ("error", "store-key literal outside distributed/keyspace.py"),
+    "SK002": ("error", "same key root written from two subsystems"),
+    "SK003": ("warning", "mutating store key built without a "
+                         "builder/scope funnel"),
+}
+
+
+def _exempt(s) -> bool:
+    """The keyspace module owns the literals; the analyzer/tooling tree
+    (``tools/``) mentions key spellings as DATA (rule tables, docs),
+    never as wire traffic."""
+    return s.pkg_relpath == KEYSPACE_FILE \
+        or (s.pkg_relpath or "").startswith("tools/")
+
+
+def run_project(project):
+    findings = []
+    # builder name -> root, read off the keyspace module's own summary
+    builder_roots = {}
+    for s in project.summaries.values():
+        if s.pkg_relpath == KEYSPACE_FILE:
+            builder_roots = dict(s.key_builders)
+
+    # ---- SK001: raw literals outside the keyspace module
+    for rel, s in project.summaries.items():
+        if _exempt(s):
+            continue
+        for rec in s.store_keys:
+            findings.append(Finding(
+                file=rel, line=rec["line"], col=rec["col"],
+                rule="SK001", family=FAMILY, severity="error",
+                message=f"raw store-key literal under root "
+                        f"'{rec['root']}/' — the keyspace protocol lives "
+                        "in distributed/keyspace.py; a drifted spelling "
+                        "here silently splits the namespace",
+                hint="import the matching keyspace builder/constant "
+                     "(distributed.keyspace) instead of inlining the key",
+                source_line=rec["text"], qualname=rec["fn"]))
+
+    # ---- SK002: one root written from two subsystems
+    # file-level: a file writes root R when it (a) performs mutating
+    # store ops and (b) references R via a raw literal or a keyspace
+    # builder call.  Builder references are found on the call edges.
+    writers = {}   # root -> {subsystem: [site]}
+    for rel, s in project.summaries.items():
+        if _exempt(s):
+            continue
+        if not s.store_writes:
+            continue
+        roots = {}
+        for rec in s.store_keys:
+            roots.setdefault(rec["root"], rec)
+        for call in s.calls:
+            root = builder_roots.get(call["term"])
+            if root:
+                roots.setdefault(root, call)
+        for root, rec in roots.items():
+            writers.setdefault(root, {}).setdefault(
+                s.subsystem, []).append((rel, rec))
+    for root, by_sub in writers.items():
+        if len(by_sub) < 2:
+            continue
+        subs = sorted(by_sub)
+        for sub in subs:
+            rel, rec = by_sub[sub][0]
+            others = ", ".join(x for x in subs if x != sub)
+            findings.append(Finding(
+                file=rel, line=rec["line"], col=rec["col"],
+                rule="SK002", family=FAMILY, severity="error",
+                message=f"subsystem '{sub}' writes store keys under root "
+                        f"'{root}/' which '{others}' also writes — "
+                        "cross-subsystem writers collide in one replicated "
+                        "namespace",
+                hint="give each subsystem its own root (add a builder to "
+                     "distributed/keyspace.py), or suppress with the "
+                     "reason the shared namespace is the design",
+                source_line=rec["text"], qualname=rec["fn"]))
+
+    # ---- SK003: ad-hoc mutating keys
+    for rel, s in project.summaries.items():
+        if _exempt(s):
+            continue
+        for rec in s.store_writes:
+            if rec["funneled"] or rec["root"]:
+                # builder/variable/prefix funnels are fine; known-root
+                # literals are SK001's jurisdiction (one finding, not two)
+                continue
+            findings.append(Finding(
+                file=rel, line=rec["line"], col=rec["col"],
+                rule="SK003", family=FAMILY, severity="warning",
+                message=f"store `{rec['op']}` on an ad-hoc inline key — "
+                        "no keyspace builder, prefix or scope helper in "
+                        "sight: incarnation rotation and failover "
+                        "re-homing only rotate funneled keys, so this one "
+                        "survives into the next incarnation and collides",
+                hint="build the key through distributed.keyspace or the "
+                     "owning class's prefix/_k helper (or "
+                     "flight_recorder.store_scope() for per-incarnation "
+                     "state)",
+                source_line=rec["text"], qualname=rec["fn"]))
+    return findings

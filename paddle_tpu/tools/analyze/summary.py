@@ -1,0 +1,450 @@
+"""Pass 1 of the project analysis — per-file summaries + the summary DB.
+
+A :class:`FileSummary` is everything the project-level (pass-2) rules need
+from one file, extracted in a single walk over the shared node index and
+fully JSON-serializable: defs and call edges (with the lock/branch context
+of each call site), lock acquisitions with nesting context, store-key
+string literals, ``jax.jit``/``pjit`` install sites, signal/atexit
+handler registrations, identity-keyed cache sites, and the hot-path
+marker.  Suppression tables are NOT summarized: scoped scans report
+findings only for files that were parsed this run, so suppression
+application always has a live :class:`~.engine.FileContext`.
+
+The summary DB (:func:`load_db` / :func:`save_db`) caches summaries keyed
+by (mtime, size) so ``--changed-only`` rebuilds only what the working tree
+actually touched.  A corrupt or stale DB is silently discarded — the cache
+is an accelerator, never a correctness input.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import os
+from dataclasses import dataclass, field
+
+from .astutil import (COLLECTIVES, P2P, STORE_OPS, STORE_WRITE_OPS,
+                      branch_context, dotted, enclosing_class_name,
+                      enclosing_function, is_store_chain,
+                      joined_leading_text, parent, parents, terminal_name)
+
+SUMMARY_VERSION = 3
+
+# store-key roots the SK family knows (the families consolidated into
+# distributed/keyspace.py); a literal starting "<root>/" is a store key
+KEY_ROOTS = ("__wal", "__fence", "elastic", "serving", "pshare", "rpc")
+
+# the one module where raw key literals are legal
+KEYSPACE_FILE = "distributed/keyspace.py"
+
+# name fragments that mark a key expression as funneled through a
+# builder/prefix/scope helper (SK003 exempts these)
+_FUNNEL_FRAGMENTS = ("prefix", "scope", "key", "_k")
+
+_JIT_WRAPPERS = {"jit", "pjit"}
+
+_BLOCKING_TERMS = {"result"}  # future.result() while holding a lock
+
+
+@dataclass
+class FileSummary:
+    relpath: str
+    pkg_relpath: str
+    mtime: float = 0.0
+    size: int = 0
+    hot_file: bool = False
+    # qualname -> {"line": int, "class": str}
+    defs: dict = field(default_factory=dict)
+    # [{caller, callee, term, line, col, text, held, rank_gated}]
+    calls: list = field(default_factory=list)
+    # [{fn, lock, line, col, text, held}]
+    locks: list = field(default_factory=list)
+    # [{fn, kind, chain, line, col, text, held}] — lexical blocking ops
+    blocking: list = field(default_factory=list)
+    # [{fn, name, line}] — direct collective issue sites
+    collectives: list = field(default_factory=list)
+    # [{fn, root, text, line, col, write}]
+    store_keys: list = field(default_factory=list)
+    # [{fn, op, line, col, text, funneled, root}] — mutating store ops
+    store_writes: list = field(default_factory=list)
+    # [{fn, wrapper, line, col, text}]
+    jit_sites: list = field(default_factory=list)
+    # qualnames that call _note_program / on_compile
+    notes_compile: list = field(default_factory=list)
+    # [{kind: "signal"|"atexit", handler, line}]
+    handlers: list = field(default_factory=list)
+    # [{fn, line, col, text}] — id()-keyed cache key sites
+    idkey_sites: list = field(default_factory=list)
+    # builder name -> key root ("" outside the keyspace module)
+    key_builders: dict = field(default_factory=dict)
+
+    @property
+    def subsystem(self) -> str:
+        """Coarse ownership unit for SK002: the top-level package dir
+        (outside the package: the file's immediate parent dir)."""
+        if self.pkg_relpath:
+            rel = self.pkg_relpath
+            return rel.split("/", 1)[0] if "/" in rel else "<root>"
+        head = os.path.dirname(self.relpath)
+        return os.path.basename(head) or "<root>"
+
+    def to_json(self) -> dict:
+        return {
+            "version": SUMMARY_VERSION,
+            "relpath": self.relpath, "pkg_relpath": self.pkg_relpath,
+            "mtime": self.mtime, "size": self.size,
+            "hot_file": self.hot_file,
+            "defs": self.defs, "calls": self.calls, "locks": self.locks,
+            "blocking": self.blocking, "collectives": self.collectives,
+            "store_keys": self.store_keys,
+            "store_writes": self.store_writes,
+            "jit_sites": self.jit_sites,
+            "notes_compile": self.notes_compile,
+            "handlers": self.handlers, "idkey_sites": self.idkey_sites,
+            "key_builders": self.key_builders,
+        }
+
+    @classmethod
+    def from_json(cls, data: dict):
+        if data.get("version") != SUMMARY_VERSION:
+            raise ValueError("summary version mismatch")
+        kw = {k: v for k, v in data.items() if k != "version"}
+        return cls(**kw)
+
+
+# ---- extraction ------------------------------------------------------------
+
+
+def _canonical_lock(ctx, node) -> str:
+    """Stable project-wide id for a lock expression: ``self.X`` becomes
+    ``Class.X`` (the same lock object on every instance path through the
+    class); module-level names are file-scoped."""
+    chain = dotted(node)
+    if not chain:
+        return ""
+    cls = enclosing_class_name(node)
+    if chain.startswith("self."):
+        rest = chain[len("self."):]
+        return f"{cls}.{rest}" if cls else rest
+    if "." not in chain:
+        return f"{ctx.pkg_relpath or ctx.relpath}::{chain}"
+    return chain
+
+
+def is_lock_name(name: str) -> bool:
+    return "lock" in name.lower()
+
+
+def lock_is_exempt(lock_id: str) -> bool:
+    """Store-serialization locks exist precisely to bracket blocking store
+    round-trips — LK002 exempts them (``_store_lock`` attrs and any lock
+    owned by a ``*Store*`` class)."""
+    return "store" in lock_id.lower()
+
+
+def _held_locks(ctx, node):
+    """Canonical ids of the lock ``with``-blocks lexically enclosing
+    ``node`` (innermost last)."""
+    held = []
+    for p in parents(node):
+        if isinstance(p, (ast.With, ast.AsyncWith)):
+            for item in p.items:
+                expr = item.context_expr
+                # unwrap  with lock:   /   with lock.acquire_timeout(..):
+                target = expr.func if isinstance(expr, ast.Call) else expr
+                if isinstance(target, (ast.Name, ast.Attribute)) \
+                        and is_lock_name(terminal_name(target)):
+                    lid = _canonical_lock(ctx, target)
+                    if lid:
+                        held.append(lid)
+    held.reverse()
+    return held
+
+
+def _fn_qualname(ctx, node) -> str:
+    fn = enclosing_function(node)
+    while fn is not None and isinstance(fn, ast.Lambda):
+        fn = enclosing_function(fn)
+    if fn is None:
+        return "<module>"
+    return ctx.qualnames.get(fn, "<module>")
+
+
+def _store_write_funneled(key_arg) -> bool:
+    """True when a mutating store op's key expression visibly routes
+    through a builder/prefix/scope funnel (SK003's sanctioned shapes)."""
+    if isinstance(key_arg, ast.Call):
+        return True  # keyspace builder / self._k(...) funnel
+    if isinstance(key_arg, (ast.Name, ast.Attribute)):
+        return True  # a variable: built elsewhere, assumed funneled
+    if isinstance(key_arg, ast.JoinedStr):
+        for part in key_arg.values:
+            if not isinstance(part, ast.FormattedValue):
+                continue
+            for sub in ast.walk(part.value):
+                if isinstance(sub, ast.Call):
+                    t = terminal_name(sub.func).lower()
+                    if any(f in t for f in _FUNNEL_FRAGMENTS):
+                        return True
+                elif isinstance(sub, (ast.Name, ast.Attribute)):
+                    t = terminal_name(sub).lower() \
+                        if isinstance(sub, ast.Attribute) else sub.id.lower()
+                    if any(f in t for f in _FUNNEL_FRAGMENTS):
+                        return True
+    return False
+
+
+def _key_root(text: str) -> str:
+    """The known key-root of a literal's leading text, or "".  Only the
+    ``root/...`` spelling counts — a bare word ("elastic" as a mode
+    name) or a path string ("serving/engine.py") is not a store key."""
+    if text.endswith(".py"):
+        return ""
+    for root in KEY_ROOTS:
+        if text.startswith(root + "/"):
+            return root
+    return ""
+
+
+def _builder_roots(ctx):
+    """For the keyspace module: builder/constant name -> key root, read
+    off each def's returned (or assigned) leading string text."""
+    out = {}
+    for node in ctx.nodes:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Return) and sub.value is not None:
+                    root = _key_root(joined_leading_text(sub.value))
+                    if root:
+                        out[node.name] = root
+                        break
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            root = _key_root(joined_leading_text(node.value))
+            if root:
+                out[node.targets[0].id] = root
+    return out
+
+
+def summarize(ctx) -> FileSummary:
+    """Pass-1 extraction: one FileSummary from a parsed FileContext."""
+    try:
+        st = os.stat(ctx.path)
+        mtime, size = st.st_mtime, st.st_size
+    except OSError:
+        mtime, size = 0.0, 0
+    s = FileSummary(relpath=ctx.relpath, pkg_relpath=ctx.pkg_relpath,
+                    mtime=mtime, size=size, hot_file=ctx.hot_file)
+    for node, qual in ctx.qualnames.items():
+        s.defs[qual] = {"line": node.lineno,
+                        "class": enclosing_class_name(node)}
+    if ctx.pkg_relpath == KEYSPACE_FILE:
+        s.key_builders = _builder_roots(ctx)
+
+    notes = set()
+    # cheap pre-filter: rank-gating detection (branch_context walks every
+    # ancestor) only matters in files that mention a rank spelling at all
+    src_text = "\n".join(ctx.lines)
+    has_rank = "rank" in src_text
+    for node in ctx.nodes:
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            # items of ONE multi-item `with a_lock, b_lock:` acquire in
+            # listed order — earlier items are HELD for later ones (the
+            # one-line ABBA spelling deadlocks exactly like the nested
+            # one; _held_locks only sees enclosing Withs)
+            outer = _held_locks(ctx, node)
+            stmt_locks = []
+            for item in node.items:
+                expr = item.context_expr
+                target = expr.func if isinstance(expr, ast.Call) else expr
+                if isinstance(target, (ast.Name, ast.Attribute)) \
+                        and is_lock_name(terminal_name(target)):
+                    lid = _canonical_lock(ctx, target)
+                    if lid:
+                        s.locks.append({
+                            "fn": _fn_qualname(ctx, node),
+                            "lock": lid, "line": node.lineno,
+                            "col": node.col_offset,
+                            "text": ctx.src(node),
+                            "held": outer + stmt_locks})
+                        stmt_locks = stmt_locks + [lid]
+            continue
+        if not isinstance(node, ast.Call):
+            continue
+        term = terminal_name(node.func)
+        chain = dotted(node.func)
+        fn = _fn_qualname(ctx, node)
+        held = _held_locks(ctx, node)   # shared by every record below
+        rec_base = {"fn": fn, "line": node.lineno, "col": node.col_offset,
+                    "text": ctx.src(node)}
+
+        # ---- call edge (resolvable shapes only)
+        if chain:
+            if has_rank:
+                rank_if, _data_if, _exc = branch_context(node)
+            else:
+                rank_if = None
+            s.calls.append(dict(rec_base, caller=fn, callee=chain,
+                                term=term, held=held,
+                                rank_gated=rank_if is not None))
+
+        # ---- direct collective issue site
+        if term in COLLECTIVES or term in P2P:
+            s.collectives.append({"fn": fn, "name": term,
+                                  "line": node.lineno})
+
+        # ---- lexical blocking ops (LK002 leaves)
+        if term in COLLECTIVES or term in _BLOCKING_TERMS \
+                or (term in STORE_OPS and is_store_chain(chain)):
+            kind = "collective" if term in COLLECTIVES else (
+                "store" if term in STORE_OPS and is_store_chain(chain)
+                else "result")
+            # add(k, 0) is the counter-read idiom — still a network
+            # round-trip, still blocking: keep it
+            s.blocking.append(dict(rec_base, kind=kind, chain=chain or term,
+                                   held=held))
+
+        # ---- mutating store ops (SK002/SK003)
+        if term in STORE_WRITE_OPS and is_store_chain(chain) and node.args:
+            key_arg = node.args[0]
+            is_read = (term == "add" and len(node.args) > 1
+                       and isinstance(node.args[1], ast.Constant)
+                       and node.args[1].value == 0)
+            if not is_read:
+                s.store_writes.append(dict(
+                    rec_base, op=term,
+                    funneled=_store_write_funneled(key_arg),
+                    root=_key_root(joined_leading_text(key_arg))))
+
+        # ---- jit install sites (RC001)
+        if term in _JIT_WRAPPERS and (node.args or node.keywords):
+            s.jit_sites.append(dict(rec_base, wrapper=term))
+
+        # ---- compile-accounting sites
+        if term in ("_note_program", "on_compile"):
+            notes.add(fn)
+
+        # ---- handler registrations (LK003 roots)
+        if chain == "signal.signal" and len(node.args) >= 2:
+            h = dotted(node.args[1]) or terminal_name(node.args[1])
+            if h:
+                s.handlers.append({"kind": "signal", "handler": h,
+                                   "line": node.lineno})
+        elif chain == "atexit.register" and node.args:
+            h = dotted(node.args[0]) or terminal_name(node.args[0])
+            if h:
+                s.handlers.append({"kind": "atexit", "handler": h,
+                                   "line": node.lineno})
+
+        # ---- identity-keyed cache sites (RC002): id() flowing into the
+        # key of a cache-named container, or into a tuple built by a
+        # *key* helper (dispatch.py's _fwd_key shape).  Plain id()-keyed
+        # bookkeeping dicts (parameter maps etc.) hold their objects
+        # alive by construction and are not flagged.
+        if isinstance(node.func, ast.Name) and node.func.id == "id" \
+                and len(node.args) == 1:
+            hit = False
+            prev = node
+            for p in parents(node):
+                if isinstance(p, ast.Subscript):
+                    if prev is p.slice:  # came up through the index
+                        container = terminal_name(p.value) \
+                            if isinstance(p.value,
+                                          (ast.Name, ast.Attribute)) else ""
+                        hit = any(frag in container.lower() for frag in
+                                  ("cache", "fns", "programs", "compiled",
+                                   "memo", "seen", "blacklist"))
+                    break
+                if isinstance(p, ast.Tuple):
+                    encl = enclosing_function(node)
+                    name = getattr(encl, "name", "") or ""
+                    if "key" in name.lower():
+                        hit = True
+                        break
+                    prev = p
+                    continue
+                if isinstance(p, ast.stmt):
+                    break
+                prev = p
+            if hit:
+                s.idkey_sites.append(dict(rec_base))
+
+    # ---- store-key literals (SK001), any expression position
+    for node in ctx.nodes:
+        if isinstance(node, (ast.Constant, ast.JoinedStr)):
+            # only the outermost JoinedStr counts (its Constant parts are
+            # also in the node index); a bare string STATEMENT (docstring
+            # or comment-string) never reaches the wire — documenting the
+            # key layout must not trip SK001
+            if isinstance(parent(node), (ast.JoinedStr, ast.Expr)):
+                continue
+            text = joined_leading_text(node)
+            root = _key_root(text)
+            if root:
+                s.store_keys.append({
+                    "fn": _fn_qualname(ctx, node), "root": root,
+                    "text": ctx.src(node), "line": node.lineno,
+                    "col": node.col_offset})
+    s.notes_compile = sorted(notes)
+    return s
+
+
+# ---- summary DB ------------------------------------------------------------
+
+DB_VERSION = 2
+_ENV_DB = "PADDLE_TPU_LINT_CACHE"
+
+
+def default_db_path() -> str:
+    env = os.environ.get(_ENV_DB)
+    if env:
+        return env
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        ".summary_db.json")
+
+
+def load_db(path: str = None) -> dict:
+    """-> {relpath: FileSummary}. Corrupt/stale/missing -> {} (silent
+    full rebuild — the cache must never crash a scan)."""
+    path = path or default_db_path()
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            data = json.load(fh)
+        if data.get("version") != DB_VERSION:
+            return {}
+        out = {}
+        for rel, entry in data.get("files", {}).items():
+            out[rel] = FileSummary.from_json(entry)
+        return out
+    except Exception:
+        return {}
+
+
+def save_db(summaries: dict, path: str = None) -> None:
+    """Best-effort persist (atomic replace); failure never fails a scan."""
+    path = path or default_db_path()
+    try:
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump({"version": DB_VERSION,
+                       "files": {rel: s.to_json()
+                                 for rel, s in summaries.items()}}, fh)
+        os.replace(tmp, path)
+    except Exception:
+        pass
+
+
+def fresh(summary: FileSummary, path: str) -> bool:
+    """mtime+size freshness check for one cached summary."""
+    try:
+        st = os.stat(path)
+    except OSError:
+        return False
+    return st.st_mtime == summary.mtime and st.st_size == summary.size
+
+
+def reset_cache_state() -> None:
+    """Tests: drop any in-process memo (currently none — the DB is read
+    fresh per scan, so there is nothing to clear).  Deliberately does
+    NOT delete the file behind the env override: that may be an
+    operator's warm cache outside the repo; un-setting the variable is
+    what isolates tests (conftest does both)."""

@@ -105,3 +105,10 @@ def _seed():
         else:
             os.environ["PADDLE_TPU_FAULTS"] = saved_fault_env
     _fault._entries = saved_fault_entries
+    # tpu-lint summary-DB cache (ISSUE 15 --changed-only): a test that
+    # pointed PADDLE_TPU_LINT_CACHE at a scratch DB must not let it
+    # steer the next test's scan — un-setting the var is the isolation
+    # (the file itself may be an operator's warm cache: never deleted)
+    from paddle_tpu.tools.analyze import summary as _lint_summary
+    _lint_summary.reset_cache_state()
+    os.environ.pop("PADDLE_TPU_LINT_CACHE", None)

@@ -1,0 +1,26 @@
+# tpu-lint: hot-path
+"""tpu-lint fixture: sanctioned bounded-compile shapes — the install is
+accounted through _note_program/on_compile, and the identity key is
+pinned by a keepalive (with the reasoned suppression documenting it)."""
+import jax
+
+
+class GoodEngine:
+    def __init__(self, metrics):
+        self.metrics = metrics
+        self._programs = set()
+        self._keepalive = {}
+
+    def _note_program(self, key):
+        if key not in self._programs:
+            self._programs.add(key)
+            self.metrics.on_compile(len(self._programs))
+
+    def build_step(self, fn, key):
+        self._note_program(key)
+        return jax.jit(fn)
+
+    def cache_key(self, fn):
+        self._keepalive[id(fn)] = fn
+        # tpu-lint: ok[RC002] the line above pins fn in _keepalive for the entry's lifetime — its id cannot be recycled
+        return ("step", id(fn))

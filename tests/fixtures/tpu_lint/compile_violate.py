@@ -1,0 +1,15 @@
+# tpu-lint: hot-path
+"""tpu-lint fixture: bounded-compile violations (RC001 unaccounted jit
+install, RC002 identity-keyed cache)."""
+import jax
+
+
+class MiniEngine:
+    def __init__(self):
+        self._fns = {}
+
+    def build_step(self, fn):
+        return jax.jit(fn)                     # RC001: never counted
+
+    def install(self, fn, prog):
+        self._fns[("step", id(fn))] = prog     # RC002: recycled-id alias

@@ -1,0 +1,45 @@
+"""tpu-lint fixture: sanctioned locks-family shapes.
+
+Consistent nesting order everywhere, store round-trips bracketed only by
+their own store-serialization lock, handlers that do nothing but set a
+flag, and one deliberately-held round-trip carrying a reasoned
+suppression.
+"""
+import signal
+import threading
+
+_flag = threading.Event()
+
+
+class Registry:
+    def __init__(self, prefix):
+        self._prefix = prefix
+        self._lock = threading.Lock()
+        self._store_lock = threading.Lock()   # store-serialization: exempt
+
+    def publish(self, store, rec):
+        with self._store_lock:
+            store.set(f"{self._prefix}/eng", rec)   # its own lock + funnel
+
+    def snapshot(self, store):
+        with self._lock:
+            # tpu-lint: ok[LK002] one bounded heartbeat read per ttl/3; the lock only guards the beat bookkeeping
+            return store.get("eng")
+
+    def a(self):
+        with self._lock:
+            with self._store_lock:            # same order as b(): fine
+                return 1
+
+    def b(self):
+        with self._lock:
+            with self._store_lock:
+                return 2
+
+
+def _handler(signum, frame):
+    _flag.set()                               # flag only: never a lock
+
+
+def install():
+    signal.signal(signal.SIGTERM, _handler)

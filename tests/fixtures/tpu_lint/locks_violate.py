@@ -1,0 +1,39 @@
+"""tpu-lint fixture: every locks-family violation (LK001/LK002/LK003)."""
+import signal
+import threading
+
+
+class Scheduler:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._table_lock = threading.Lock()
+
+    def admit(self):
+        with self._lock:
+            with self._table_lock:        # order: _lock -> _table_lock
+                return 1
+
+    def evict(self):
+        with self._table_lock:
+            with self._lock:              # LK001: _table_lock -> _lock
+                return 2
+
+    def load(self, store):
+        with self._lock:
+            return store.get("roster")    # LK002: round-trip under _lock
+
+
+_state_lock = threading.Lock()
+
+
+def _drain():
+    with _state_lock:                     # LK003: signal-reachable lock
+        return 3
+
+
+def _handler(signum, frame):
+    _drain()
+
+
+def install():
+    signal.signal(signal.SIGTERM, _handler)

@@ -1,0 +1,6 @@
+"""tpu-lint fixture (SK002): control-plane subsystem writing the
+``elastic/`` root."""
+
+
+def publish_round(store, job, spec):
+    store.set(f"elastic/{job}/round", spec)

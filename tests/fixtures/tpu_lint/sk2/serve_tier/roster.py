@@ -1,0 +1,6 @@
+"""tpu-lint fixture (SK002): a second subsystem writing the SAME
+``elastic/`` root — the cross-subsystem collision class."""
+
+
+def claim_engine(store, job, eid):
+    store.set(f"elastic/{job}/engines/{eid}", b"mine")

@@ -1,0 +1,21 @@
+"""tpu-lint fixture: sanctioned store-key shapes — builder/prefix/scope
+funnels and the add(k, 0) counter-read idiom."""
+
+
+def rotate(store, store_scope, rank):
+    store.set(f"{store_scope()}/sig/{rank}", b"s")   # scope funnel
+
+
+class Member:
+    def __init__(self, prefix):
+        self._prefix = prefix
+
+    def _k(self, leaf):
+        return f"{self._prefix}/{leaf}"
+
+    def beat(self, store, rec):
+        store.set(self._k("beat"), rec)              # builder funnel
+        store.set(f"{self._prefix}/seen", b"1")      # prefix funnel
+
+    def head(self, store):
+        return store.add("seq", 0)                   # counter READ: clean

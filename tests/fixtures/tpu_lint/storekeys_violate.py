@@ -1,0 +1,7 @@
+"""tpu-lint fixture: store-keys violations (SK001 raw literal, SK003
+ad-hoc mutating key with no funnel)."""
+
+
+def announce(store, job, rank):
+    store.set(f"elastic/{job}/hosts/{rank}", b"1")      # SK001
+    store.set(f"mykeys/worker/{rank}", b"ready")        # SK003

@@ -1,0 +1,20 @@
+"""tpu-lint fixture (CO005 sanctioned shapes): un-gated helper calls,
+rank-gated RANKED P2P helpers, and a reasoned suppression."""
+from helper import ship_to_peer, sync_grads
+
+
+def always_sync(x):
+    return sync_grads(x)       # every rank reaches it: clean
+
+
+def stream_out(x, rank):
+    if rank == 0:
+        ship_to_peer(x, 1)     # p2p is rank-shaped by design: clean
+    return x
+
+
+def checkpoint_sync(x, rank, is_saver):
+    if rank == 0 and is_saver:
+        # tpu-lint: ok[CO005] the saver flag is all_reduce'd one step earlier; every rank computes the same predicate
+        sync_grads(x)
+    return x

@@ -1,0 +1,10 @@
+"""tpu-lint fixture (CO005): rank-gating a helper that reaches a
+collective two calls away — invisible to the per-file CO001, caught by
+the project call graph."""
+from helper import sync_grads
+
+
+def maybe_sync(x, rank):
+    if rank == 0:
+        sync_grads(x)          # CO005
+    return x

@@ -1,0 +1,20 @@
+"""tpu-lint fixture (CO005): helpers that do / do not reach a collective.
+
+``sync_grads`` transitively issues ``all_reduce`` — callers must not
+rank-gate it.  ``ship_to_peer`` only uses ranked p2p, which is expected
+to branch on rank.
+"""
+import paddle_tpu.distributed as dist
+
+
+def _reduce_all(x):
+    dist.all_reduce(x)
+    return x
+
+
+def sync_grads(x):
+    return _reduce_all(x)
+
+
+def ship_to_peer(x, dst_rank):
+    dist.send(x, dst=dst_rank)

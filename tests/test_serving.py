@@ -363,6 +363,78 @@ def test_scheduler_prefix_hit_skips_shared_head():
     assert all(alloc.refcount(p) == 1 for p in donor_pages)
 
 
+def test_scheduler_submit_not_blocked_by_slow_prefix_lookup():
+    """ISSUE 15 fix (tpu-lint LK002): a fleet SharedPrefixCache lookup is
+    a store round-trip (up to its fetch timeout); schedule() used to hold
+    the scheduler lock across it, stalling every submit()/queue_depth()
+    caller for the duration. The lookup now runs outside the lock."""
+    import threading
+    from paddle_tpu.serving import (BlockAllocator,
+                                    ContinuousBatchingScheduler)
+
+    class SlowCache:
+        def __init__(self):
+            self.entered = threading.Event()
+            self.release = threading.Event()
+
+        def lookup(self, prompt):
+            self.entered.set()
+            assert self.release.wait(5.0), "test never released the cache"
+            return [], 0
+
+        def record(self, n):
+            pass
+
+    pc = SlowCache()
+    sched = ContinuousBatchingScheduler(BlockAllocator(16), 2, 4, 64,
+                                        prefix_cache=pc)
+    sched.submit(_req(6))
+    t = threading.Thread(target=sched.schedule, daemon=True)
+    t.start()
+    assert pc.entered.wait(2.0)
+    # the engine thread is mid-"store fetch": producers must not stall
+    t0 = time.perf_counter()
+    sched.submit(_req(6), block=False)
+    depth = sched.queue_depth()   # in-admission head still queued: 2
+    elapsed = time.perf_counter() - t0
+    assert depth == 2 and elapsed < 0.5, \
+        f"submit stalled {elapsed:.2f}s behind the prefix lookup"
+    pc.release.set()
+    t.join(5.0)
+    assert not t.is_alive()
+
+
+def test_scheduler_admission_rechecks_head_after_unlocked_lookup():
+    """The lock is dropped around the prefix lookup, so a readmission
+    (eviction / migration fallback, possibly from another engine's
+    thread) can jump the queue head mid-lookup — admission must re-check
+    the head and admit the readmitted request first, never bypass it."""
+    from paddle_tpu.serving import (BlockAllocator,
+                                    ContinuousBatchingScheduler)
+
+    first, racer = _req(6), _req(6)
+
+    class RacingCache:
+        def __init__(self):
+            self.raced = False
+
+        def lookup(self, prompt):
+            if not self.raced:
+                self.raced = True
+                sched.readmit(racer)   # appendleft while lock is free
+            return [], 0
+
+        def record(self, n):
+            pass
+
+    sched = ContinuousBatchingScheduler(BlockAllocator(16), 2, 4, 64,
+                                        prefix_cache=RacingCache())
+    sched.submit(first)
+    admitted = sched.schedule()
+    assert [r.request_id for r in admitted] == \
+        [racer.request_id, first.request_id]
+
+
 def test_shared_prefix_workload_generator():
     """load.py satellite: one common system-prompt head + per-request
     tails, deterministic per seed (the hot engine and its cold twin must

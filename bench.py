@@ -1833,6 +1833,189 @@ def run_disagg_serving_bench():
     return sub, ok
 
 
+def _fleet_builder(cfg, kb):
+    """Engine factory every elastic leg shares: identical weights per
+    engine (per-engine re-seed — a fleet's replicas serve ONE model), so
+    re-dispatch/hedge continuations are greedy-token-identical."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTForCausalLM
+    from paddle_tpu.serving import ServingEngine
+
+    def build(engine_id):
+        paddle.seed(0)
+        m = GPTForCausalLM(cfg)
+        m.eval()
+        return ServingEngine(m, page_size=kb["page"],
+                             num_pages=kb["pool"],
+                             max_slots=kb["slots"],
+                             prefill_chunk=kb["chunk"],
+                             engine_id=engine_id)
+    return build
+
+
+def run_slo_autoscale_bench():
+    """SLO leg (ISSUE 16 tentpole (b)): a Poisson-shaped burst hits a
+    one-engine fleet; the autoscaler's queue-depth loop admits a warm
+    spare mid-burst — records ``serving_scaleup_to_first_token_s`` (time
+    from the spare entering rotation to its first served token) — then a
+    graceful ``remove_engine(migrate=True)`` drain must finish every
+    in-flight request: ``serving_drain_errors`` gates at zero."""
+    from paddle_tpu.serving.fleet import EngineAutoscaler, FleetRouter
+
+    device, cfg, kb = _serving_cfg_and_knobs()
+    prompts, _sids, new_tokens = _fleet_workload(cfg, kb)
+    build = _fleet_builder(cfg, kb)
+
+    router = FleetRouter()
+    router.add_engine(build("e0"), "e0")
+    router.engine("e0").warm_ragged()
+    router.start()
+    scaler = EngineAutoscaler(router, build, min_engines=1,
+                              max_engines=3, queue_high=2.0,
+                              queue_low=0.25, up_ticks=1, down_ticks=10,
+                              cooldown_s=2.0)
+    sub = {}
+    try:
+        frs = []
+        t_up = None
+        new_id = None
+
+        def _note(act):
+            nonlocal t_up, new_id
+            if act == "up" and t_up is None:
+                t_up = time.perf_counter()
+                new_id = scaler.events[-1]["engine"]
+
+        # open burst: 3 sessions' worth arrives faster than one engine
+        # drains, so the blended queue signal crosses queue_high
+        for i in range(24):
+            frs.append(router.submit(prompts[i % len(prompts)],
+                                     max_new_tokens=new_tokens,
+                                     timeout=600.0))
+            if i % 4 == 3:
+                _note(scaler.tick())
+        deadline = time.time() + 240
+        while any(not f.done() for f in frs) and time.time() < deadline:
+            _note(scaler.tick())
+            time.sleep(0.05)
+        burst_failed = sum(1 for f in frs
+                           if not f.done() or f.error is not None)
+        stf = None
+        if t_up is not None:
+            served = [f.t_first_token - t_up for f in frs
+                      if new_id in f.engine_ids
+                      and f.t_first_token is not None
+                      and f.t_first_token >= t_up]
+            if served:
+                stf = min(served)
+        # graceful drain: trickle traffic in flight while the spare
+        # leaves rotation — migration (recompute fallback built in)
+        # must land every request, with zero user-visible errors
+        tail = [router.submit(prompts[i % len(prompts)],
+                              max_new_tokens=new_tokens, timeout=600.0)
+                for i in range(6)]
+        if new_id is not None and new_id in router.handles():
+            router.remove_engine(new_id, migrate=True)
+            router.drop_engine(new_id)
+        deadline = time.time() + 120
+        while any(not f.done() for f in tail) and time.time() < deadline:
+            time.sleep(0.02)
+        drain_errors = sum(1 for f in tail
+                           if not f.done() or f.error is not None)
+        sub.update({
+            "serving_scaleup_to_first_token_s":
+                round(stf, 4) if stf is not None else None,
+            "serving_drain_errors": drain_errors + burst_failed,
+            "serving_autoscale_events": len(scaler.events),
+            "serving_autoscale_engine_added": new_id,
+        })
+        ok = (t_up is not None and stf is not None
+              and burst_failed == 0 and drain_errors == 0)
+        sub["serving_slo_leg_ok"] = bool(ok)
+        return sub, ok
+    finally:
+        scaler.close()
+        router.close()
+
+
+def run_serving_chaos_bench():
+    """Chaos twin (ISSUE 16 tentpole (d)): ``engine_die@serve_loop``
+    kills one of two engines mid-burst. The tracked request pinned to
+    the dying engine must re-dispatch and finish TOKEN-IDENTICAL to a
+    solo baseline; the autoscaler must strike the dead engine into
+    quarantine and admit a replacement (death -> strike -> re-dispatch
+    -> scale-up, the full injectable lifecycle)."""
+    from paddle_tpu.distributed import fault as _fault
+    from paddle_tpu.serving.fleet import EngineAutoscaler, FleetRouter
+
+    device, cfg, kb = _serving_cfg_and_knobs()
+    prompts, _sids, new_tokens = _fleet_workload(cfg, kb)
+    build = _fleet_builder(cfg, kb)
+
+    solo = build("solo")
+    tracked_prompt = prompts[0]
+    base = solo.generate(tracked_prompt, max_new_tokens=new_tokens)
+    solo.close()
+
+    router = FleetRouter()
+    router.add_engine(build("e0"), "e0")
+    router.add_engine(build("e1"), "e1")
+    for eid in ("e0", "e1"):
+        router.engine(eid).warm_ragged()
+    scaler = EngineAutoscaler(router, build, min_engines=2,
+                              max_engines=3, queue_high=1e9,
+                              queue_low=-1.0)  # lifecycle only, no SLO
+    sub = {}
+    os.environ["PADDLE_TPU_FAULT_ENGINE"] = "e0"
+    try:
+        router.start()
+        tracked = router.submit(tracked_prompt,
+                                max_new_tokens=new_tokens,
+                                timeout=600.0, engine="e0")
+        burst = [router.submit(prompts[(i % (len(prompts) - 1)) + 1],
+                               max_new_tokens=new_tokens, timeout=600.0)
+                 for i in range(10)]
+        # arm the kill only once the tracked request is mid-decode, so
+        # the re-dispatch genuinely carries emitted tokens across
+        deadline = time.time() + 60
+        while len(tracked.generated) < 2 and not tracked.done() \
+                and time.time() < deadline:
+            time.sleep(0.005)
+        _fault.set_fault_spec("engine_die@serve_loop:2")
+        all_reqs = [tracked] + burst
+        deadline = time.time() + 240
+        while (any(not f.done() for f in all_reqs)
+               or len(router.handles()) < 2) \
+                and time.time() < deadline:
+            scaler.tick()
+            time.sleep(0.05)
+        parity = (tracked.done() and tracked.error is None
+                  and list(tracked.generated) == list(base))
+        failed = sum(1 for f in all_reqs
+                     if not f.done() or f.error is not None)
+        struck = scaler.quarantine.quarantined()
+        live = [eid for eid, h in router.handles().items()
+                if h.healthy()]
+        sub.update({
+            "serving_chaos_parity_ok": bool(parity),
+            "serving_chaos_redispatches": router.redispatched,
+            "serving_chaos_requests_failed": failed,
+            "serving_chaos_quarantined": struck,
+            "serving_chaos_fleet_live": len(live),
+            "serving_chaos_replacement":
+                scaler.events[-1]["engine"] if scaler.events else None,
+        })
+        ok = (parity and failed == 0 and tracked.redispatches >= 1
+              and "e0" in struck and len(live) >= 2)
+        sub["serving_chaos_leg_ok"] = bool(ok)
+        return sub, ok
+    finally:
+        _fault.set_fault_spec(None)
+        os.environ.pop("PADDLE_TPU_FAULT_ENGINE", None)
+        scaler.close()
+        router.close()
+
+
 def main_serving_fleet():
     snap = _load_snapshot()
     merged = snap.setdefault("submetrics", {})
@@ -1851,6 +2034,24 @@ def main_serving_fleet():
     except Exception as e:
         merged.update({"serving_disagg_error": repr(e)[-300:],
                        "serving_disagg_leg_ok": False})
+        ok = False
+    # ISSUE 16 legs — each fails independently so a broken autoscaler
+    # never hides the chaos lifecycle rows (or any prior leg's keys)
+    try:
+        ssub, sok = run_slo_autoscale_bench()
+        merged.update(ssub)
+        ok = ok and sok
+    except Exception as e:
+        merged.update({"serving_slo_error": repr(e)[-300:],
+                       "serving_slo_leg_ok": False})
+        ok = False
+    try:
+        csub, cok = run_serving_chaos_bench()
+        merged.update(csub)
+        ok = ok and cok
+    except Exception as e:
+        merged.update({"serving_chaos_error": repr(e)[-300:],
+                       "serving_chaos_leg_ok": False})
         ok = False
     snap.setdefault("metric", "gpt_train_step_mfu")
     snap.setdefault("value", 0.0)

@@ -18,6 +18,7 @@ Four pieces, stdlib-only (importable by the launcher before jax loads):
        kind    := crash | hang | torn_write | store_drop | slow_io
                 | async_torn | commit_stall | desync
                 | node_die | agent_stall | store_die
+                | engine_die | engine_stall
        trigger := 1-based Nth matching hit that fires the fault
        rank    := only this process id injects (default: every rank;
                   node-scoped kinds filter by NODE ordinal — the agent
@@ -122,7 +123,8 @@ def describe_exit(rc) -> str:
 _KINDS = ("crash", "hang", "torn_write", "store_drop", "slow_io",
           "async_torn", "commit_stall", "desync",
           "node_die", "agent_stall", "store_die",
-          "coordinator_die", "wal_torn")
+          "coordinator_die", "wal_torn",
+          "engine_die", "engine_stall")
 # a site-less (wildcard) cooperative entry only fires at sites whose
 # callers honor the returned kind — anywhere else it would burn its
 # trigger silently; crash/hang/slow_io/commit_stall wildcards fire at
@@ -159,7 +161,20 @@ _WILDCARD_SITES = {"store_drop": ("store",), "torn_write": ("ckpt",),
                    # add), proving the on_failover gap-filler heals the
                    # un-replicated tail
                    "coordinator_die": ("coord_beat",),
-                   "wal_torn": ("replication",)}
+                   "wal_torn": ("replication",),
+                   # serving chaos kinds (ISSUE 16): ``engine_die`` is
+                   # cooperative at the serving engine's serve-loop site
+                   # — the engine enacts a serve-loop crash (its crash
+                   # containment marks the engine unhealthy, fails every
+                   # waiter, and the fleet router re-dispatches);
+                   # ``engine_stall`` executes a sleep there (the loop
+                   # freezes mid-traffic while the process lives — the
+                   # straggler case hedging and the stale-heartbeat
+                   # sweep must survive). PADDLE_TPU_FAULT_ENGINE can
+                   # name one engine_id so a multi-engine process kills
+                   # a chosen replica deterministically.
+                   "engine_die": ("serve_loop",),
+                   "engine_stall": ("serve_loop",)}
 
 _lock = threading.Lock()
 _entries: list | None = None  # parsed spec; None = not yet loaded from env
@@ -335,6 +350,9 @@ def maybe_inject(site: str):
         elif e.kind == "agent_stall":
             time.sleep(float(os.environ.get(
                 "PADDLE_TPU_FAULT_AGENT_STALL_S", "30.0")))
+        elif e.kind == "engine_stall":
+            time.sleep(float(os.environ.get(
+                "PADDLE_TPU_FAULT_ENGINE_STALL_S", "30.0")))
         else:
             result = e.kind
     return result

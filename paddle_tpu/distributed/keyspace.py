@@ -38,7 +38,8 @@ __all__ = [
     "WAL_SEQ", "WAL_ACKED", "FENCE_EPOCH",
     "wal_entry", "wal_claim", "wal_result", "wal_cursor", "fence_promo",
     "elastic_job", "elastic_node", "elastic_coord",
-    "fleet_registry", "fleet_engine_rpc", "page_share",
+    "fleet_registry", "fleet_engine_rpc", "fleet_engine_stream",
+    "fleet_quarantine", "fleet_autoscale", "page_share",
     "rpc_worker", "rpc_rank",
 ]
 
@@ -107,6 +108,28 @@ def fleet_engine_rpc(job, engine_id):
     """Store-RPC prefix for one remote engine (in/out streams, stop,
     stats)."""
     return f"serving/{job}/eng/{engine_id}"
+
+
+def fleet_engine_stream(job, engine_id):
+    """Per-token stream prefix for one remote engine (``tok_seq``
+    counter + ``tok/<n>`` batched token records): incremental tokens
+    cross the store so a remote client's ``on_token``/TTFT is real
+    instead of arriving with the batched completion (ISSUE 16)."""
+    return f"serving/{job}/eng/{engine_id}/stream"
+
+
+def fleet_quarantine(job):
+    """Serving-fleet quarantine ledger (JSON ``QuarantineList.to_dict``)
+    — registry scope, so a struck-out engine stays excluded across a
+    store failover exactly like a flaky NODE does on the training side
+    (the unified-membership half of ISSUE 16)."""
+    return f"serving/{job}/quarantine"
+
+
+def fleet_autoscale(job):
+    """Autoscaler state root (scale-event log + roster epoch) for one
+    serving job — registry scope: rides the WAL like membership."""
+    return f"serving/{job}/autoscale"
 
 
 def page_share(job):

@@ -1035,6 +1035,47 @@ class ServingEngine:
         self._wake.set()
         return req
 
+    def abort_request(self, req):
+        """Cancel one leg without firing its waiters (ISSUE 16 hedging:
+        the router duplicated this request on another engine and the
+        duplicate won — the loser's slot + pages free immediately, its
+        ``on_done`` never fires, and the winning leg owns the caller's
+        done event). Serialized against rounds so a mid-step slot/page
+        assignment can never be torn. Returns False when the leg already
+        reached a terminal state first (it finished fair and square —
+        its completion is the one the router keeps)."""
+        with self._step_lock:
+            if not self.scheduler.abort_request(req):
+                return False
+            if req in self._prefilling:
+                self._prefilling.remove(req)
+        return True
+
+    def prefetch_prefix(self, tokens):
+        """Warm this engine's prefix cache with a prompt head published
+        elsewhere in the fleet (router prefetch-on-affinity-spill): walk
+        the shared trie, import the remote pages into the LOCAL pool,
+        then drop the lookup references so the pages park indexed +
+        reclaimable — the session's next request here prefix-hits
+        locally instead of paying the import on its admission path.
+        -> number of pages imported (0 without a share client)."""
+        share = getattr(self.prefix, "share", None)
+        if share is None or self._closed or self._draining:
+            return 0
+        with self._step_lock:
+            t0 = share.remote_hit_tokens
+            # tpu-lint: ok[LK002] the store fetch is bounded by the share client's fetch timeout and the lock is required: lookup mutates allocator refcounts and imports pages into the pools, exactly like the admission-path lookup step() runs under this same lock
+            pages, _n = self.prefix.lookup(tokens)
+            if pages:
+                # lookup took one reader ref per page for an admission
+                # that is not happening — release them; the trie keeps
+                # the pages indexed (reclaimable, hit-ready)
+                self.kv.allocator.free(pages)
+            imported = (share.remote_hit_tokens - t0) // self.page_size
+        if imported:
+            self.metrics.on_prefetch_pages(imported)
+        return imported
+
     def generate(self, prompt_ids, timeout=120.0, **kw):
         """Synchronous helper: submit + drive (foreground when no serve
         thread is running) + wait. -> generated token list."""
@@ -1054,8 +1095,19 @@ class ServingEngine:
         self._thread.start()
 
     def _serve_loop(self):
+        from ..distributed.fault import maybe_inject as _inject
+        # serving chaos (ISSUE 16): PADDLE_TPU_FAULT_ENGINE narrows the
+        # serve_loop site to ONE engine id so a multi-engine process
+        # kills a chosen replica deterministically (the trigger counter
+        # is process-global; without the filter, whichever serve thread
+        # hit the site Nth would die)
+        target = os.environ.get("PADDLE_TPU_FAULT_ENGINE")
+        honored = target in (None, "") or target == str(self.engine_id)
         while not self._stop_evt.is_set():
             try:
+                if honored and _inject("serve_loop") == "engine_die":
+                    raise RuntimeError(
+                        "injected fault: engine_die@serve_loop")
                 if self.scheduler.has_work():
                     self.step()
                 else:

@@ -11,6 +11,14 @@ arxiv 2605.25645), store-backed engine registration/liveness
 (:class:`~.registry.EngineRegistry`) and a store-RPC transport for
 multi-process fleets (:mod:`~.remote`).
 
+ISSUE 16 makes the roster ELASTIC: :class:`~.autoscale.EngineAutoscaler`
+grows/shrinks the fleet against router-observed SLO signals (warm-spare
+admission, quarantine strikes for crashed engines, membership persisted
+through store failover), the router hedges stragglers onto a second
+engine (first finisher wins, loser aborted slot-and-pages-free), and the
+store-RPC transport streams tokens incrementally instead of batching
+them at completion.
+
     from paddle_tpu.serving.fleet import FleetRouter
     router = FleetRouter()
     router.add_engine(engine_a, "e0")
@@ -26,3 +34,4 @@ from .page_share import PageShareClient, SharedPrefixCache  # noqa: F401
 from .disagg import MigrationFailed, migrate_request  # noqa: F401
 from .registry import EngineRegistry  # noqa: F401
 from .remote import RemoteEngineHandle, serve_over_store  # noqa: F401
+from .autoscale import EngineAutoscaler  # noqa: F401

@@ -9,6 +9,12 @@ JSON record per ``ttl/3`` carrying its load gauges (queue depth, active
 slots, KV occupancy, prefix remote hits). The router/bench discover
 engines through the join log (the store has no key enumeration — same
 idiom as ``elastic.py``) and treat a stale heartbeat as engine loss.
+
+ISSUE 16 adds the quarantine ledger: the autoscaler strikes flaky
+engines into an ``elastic.QuarantineList`` and persists its
+``to_dict()`` JSON under ``serving/<job>/quarantine`` — registry scope,
+so the ledger rides the FailoverStore WAL and a struck-out engine stays
+excluded across a store failover exactly like a flaky training node.
 """
 from __future__ import annotations
 
@@ -124,6 +130,45 @@ class EngineRegistry:
     def close(self):
         for eid in list(self._beats):
             self.deregister(eid)
+
+    # ------------------------------------------------------- quarantine
+    def save_quarantine(self, quarantine, now=None):
+        """Persist the fleet's quarantine ledger (registry scope: the
+        JSON rides the WAL to the standby store)."""
+        self._set(keyspace.fleet_quarantine(self.job),
+                  json.dumps(quarantine.to_dict(now)))
+
+    def load_quarantine(self, quarantine, now=None):
+        """Restore ``quarantine`` from the persisted ledger (no-op when
+        none was ever saved). Ages re-anchor against ``now`` so a strike
+        window survives the wall-clock gap of a failover. -> bool
+        (whether a ledger existed)."""
+        key = keyspace.fleet_quarantine(self.job)
+        try:
+            if not self._check(key):
+                return False
+            state = json.loads(self._get(key, timeout=5))
+        except Exception:
+            return False
+        quarantine.restore(state, now)
+        return True
+
+    def save_autoscale(self, state):
+        """Persist the autoscaler's roster epoch + scale-event tail
+        (registry scope — a promoted standby store still knows the
+        fleet's intended size)."""
+        self._set(f"{keyspace.fleet_autoscale(self.job)}/state",
+                  json.dumps(state))
+
+    def load_autoscale(self):
+        """-> persisted autoscaler state dict, or None."""
+        key = f"{keyspace.fleet_autoscale(self.job)}/state"
+        try:
+            if not self._check(key):
+                return None
+            return json.loads(self._get(key, timeout=5))
+        except Exception:
+            return None
 
     # --------------------------------------------------------- discovery
     def joined(self):

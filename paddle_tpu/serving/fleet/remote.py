@@ -9,12 +9,24 @@ exactly ONE listening port.
 Protocol (keys under ``serving/<job>/eng/<eid>/``):
 
 * ``in_seq`` counter + ``in/<seq>`` JSON — submissions (the router's
-  client handle appends; the engine process tails);
+  client handle appends; the engine process tails). A record with
+  ``"abort": true`` cancels the named request silently — slot + pages
+  free, no completion published (the aborting client already dropped
+  the leg, so a late completion would find nobody anyway);
 * ``out_seq`` counter + ``out/<seq>`` JSON — completions (tokens or a
   typed, retryability-preserving error: ``QueueFull`` /
   ``EngineShuttingDown`` / ``EngineClosed`` rebuild client-side so the
   router's retry-elsewhere logic treats remote engines exactly like
   local ones);
+* ``stream/tok_seq`` counter + ``stream/tok/<n>`` JSON — incremental
+  token batches (ISSUE 16): every poll tick the server flushes the
+  tokens emitted since the last tick as ONE record
+  ``{"items": [[rid, [tokens...], fin], ...]}`` — at most one store
+  write per tick regardless of decode fan-out, and the client's
+  ``on_token``/TTFT reflect real emission time instead of arriving
+  with the batched completion. Completions replay only the tokens the
+  stream has not already surfaced, so the two channels compose without
+  duplicates in either order;
 * ``stop`` — graceful server exit (drain + final stats publish).
 
 Worker entry point (used by ``bench.py --serving-fleet``)::
@@ -22,10 +34,9 @@ Worker entry point (used by ``bench.py --serving-fleet``)::
     python -m paddle_tpu.serving.fleet.remote --store 127.0.0.1:6200 \
         --engine-id e0 --job bench --seed 0 [--role any] [--share]
 
-Per-request streaming does NOT cross the store (tokens land client-side
-at completion); per-engine TTFT/ITL tails come from the engine process's
-own labeled metrics JSONL (``--metrics-dir``), which is the fleet's
-observability story anyway.
+Per-engine TTFT/ITL tails still come from the engine process's own
+labeled metrics JSONL (``--metrics-dir``), which is the fleet's
+observability story.
 """
 from __future__ import annotations
 
@@ -73,13 +84,22 @@ def serve_over_store(engine, store, engine_id, job="fleet",
     CPU from the engine's own core, so the polls are deliberately lean:
     one ``in_seq`` read per tick, stop keys every few ticks."""
     prefix = keyspace.fleet_engine_rpc(job, engine_id)
+    stream_prefix = keyspace.fleet_engine_stream(job, engine_id)
     fleet_stop = f"{keyspace.fleet_registry(job)}/stop"
     done_lock = threading.Lock()
     done_queue = []          # results ready to publish
+    tok_lock = threading.Lock()
+    tok_buf = []             # (rid, token, fin) since the last flush
+    inflight = {}            # rid -> engine-side request (abort target)
 
     def on_done(req):
+        inflight.pop(req._rid, None)
         with done_lock:
             done_queue.append(_result_record(req._rid, req))
+
+    def on_token(req, token, fin):
+        with tok_lock:
+            tok_buf.append((req._rid, int(token), bool(fin)))
 
     consumed = 0
     tick = 0
@@ -103,18 +123,46 @@ def serve_over_store(engine, store, engine_id, job="fleet",
                 continue  # torn submission: the client will time out
             last_traffic = time.monotonic()
             rid = msg["rid"]
+            if msg.get("abort"):
+                req = inflight.pop(rid, None)
+                if req is not None:
+                    try:
+                        engine.abort_request(req)
+                    except Exception:
+                        pass
+                continue
             try:
                 req = GenerationRequest(
                     msg["prompt"],
                     max_new_tokens=int(msg.get("max_new_tokens", 16)),
                     eos_token_id=msg.get("eos_token_id"),
                     temperature=float(msg.get("temperature", 0.0)),
-                    top_k=msg.get("top_k"), on_done=on_done)
+                    top_k=msg.get("top_k"), on_token=on_token,
+                    on_done=on_done)
                 req._rid = rid
+                inflight[rid] = req
                 engine.submit_request(req, block=False)
             except Exception as e:
+                inflight.pop(rid, None)
                 with done_lock:
                     done_queue.append(_result_record(rid, error=e))
+        # per-token streaming: flush everything emitted since the last
+        # tick as ONE batched record — a store write per tick, not per
+        # token (and none at all on an idle tick)
+        with tok_lock:
+            toks, tok_buf[:] = list(tok_buf), []
+        if toks:
+            last_traffic = time.monotonic()
+            by_rid, order, fins = {}, [], {}
+            for rid, t, fin in toks:
+                if rid not in by_rid:
+                    by_rid[rid] = []
+                    order.append(rid)
+                by_rid[rid].append(t)
+                fins[rid] = fin
+            rec = {"items": [[r, by_rid[r], fins[r]] for r in order]}
+            seq = int(store.add(f"{stream_prefix}/tok_seq", 1))
+            store.set(f"{stream_prefix}/tok/{seq}", json.dumps(rec))
         with done_lock:
             ready, done_queue[:] = list(done_queue), []
         for rec in ready:
@@ -152,9 +200,28 @@ class _RemoteLeg:
         self.on_done = on_done
         self.migrate_hook = None
 
+    def _stream(self, tokens, fin):
+        """Adopt one incremental token batch from the stream channel
+        (poller thread): surfaces through ``on_token`` immediately, so
+        the fleet caller's TTFT/ITL reflect real emission time."""
+        cb = self.on_token
+        for i, t in enumerate(tokens):
+            self.generated.append(int(t))
+            if cb is not None:
+                try:
+                    cb(self, int(t), bool(fin) and i == len(tokens) - 1)
+                except Exception:
+                    pass
+
     def _complete(self, rec):
         err = rec.get("error")
-        self.generated = [int(t) for t in rec.get("tokens", [])]
+        tokens = [int(t) for t in rec.get("tokens", [])]
+        # the stream channel already surfaced self.generated[:start] —
+        # replay ONLY the tail the stream has not delivered yet (zero
+        # when streaming kept up; everything when the server predates
+        # the stream keys or the record raced ahead of the last batch)
+        start = len(self.generated)
+        self.generated = tokens
         self.queue_wait_s = float(rec.get("queue_wait_s", 0.0))
         self.evictions = int(rec.get("evictions", 0))
         cb = self.on_token
@@ -164,10 +231,10 @@ class _RemoteLeg:
             # fr.generated, which only this callback populates — a
             # drained engine's 30 emitted tokens must not be recomputed
             # (final=True only on a clean finish)
-            for i, t in enumerate(self.generated):
+            for i in range(start, len(tokens)):
                 try:
-                    cb(self, t,
-                       err is None and i == len(self.generated) - 1)
+                    cb(self, tokens[i],
+                       err is None and i == len(tokens) - 1)
                 except Exception:
                     pass
         if err is not None:
@@ -208,6 +275,8 @@ class RemoteEngineHandle:
         self._rec_cache = (0.0, None)   # (fetched_at, record)
         self._rec_ttl = float(record_ttl)
         self._prefix = keyspace.fleet_engine_rpc(job, self.engine_id)
+        self._stream_prefix = keyspace.fleet_engine_stream(
+            job, self.engine_id)
         self._submit_store = store_factory()
         self._poll_store = store_factory()
         self._poll_s = float(poll_s)
@@ -253,9 +322,17 @@ class RemoteEngineHandle:
         remote = _RemoteLeg(rid, leg.prompt_ids,
                             on_token=leg.on_token, on_done=leg.on_done)
         remote._handle_id = self.engine_id
-        remote._fleet = getattr(leg, "_fleet", None)
-        if remote._fleet is not None:
-            remote._fleet._leg = remote
+        fl = getattr(leg, "_fleet", None)
+        remote._fleet = fl
+        # re-point the fleet request at the wire-side leg that will
+        # actually stream/finish — in the SAME slot the original leg
+        # held (a hedge duplicate must never displace the primary)
+        if getattr(leg, "_hedge_base", None) is not None:
+            remote._hedge_base = leg._hedge_base
+            if fl is not None and fl._hedge is leg:
+                fl._hedge = remote
+        elif fl is not None and fl._leg is leg:
+            fl._leg = remote
         msg = {"rid": rid, "prompt": list(leg.prompt_ids),
                "max_new_tokens": leg.max_new_tokens,
                "eos_token_id": leg.eos_token_id,
@@ -266,6 +343,26 @@ class RemoteEngineHandle:
         self._submit_store.set(f"{self._prefix}/in/{seq}",
                                json.dumps(msg))
         return remote
+
+    def abort(self, leg):
+        """Silently cancel one in-flight leg (hedge loser). Dropping the
+        rid from ``_pending`` FIRST makes any late completion or stream
+        record for it a no-op — the caller owns the pending decrement
+        exactly when this returns True."""
+        rid = leg.request_id
+        with self._lock:
+            if self._pending.pop(rid, None) is None:
+                return False   # already completed: on_done owns it
+        try:
+            seq = int(self._submit_store.add(f"{self._prefix}/in_seq",
+                                             1))
+            self._submit_store.set(
+                f"{self._prefix}/in/{seq}",
+                json.dumps({"rid": rid, "abort": True}))
+        except Exception:
+            pass   # the engine still frees it at completion
+        leg.state = "aborted"
+        return True
 
     def start(self):
         pass  # the engine process runs its own serve loop
@@ -280,11 +377,27 @@ class RemoteEngineHandle:
     # ---- completion poller ---------------------------------------------
     def _poll_loop(self):
         consumed = 0
+        tok_consumed = 0
         tick = 0
         stale = 0
         while not self._stop.is_set():
             tick += 1
             try:
+                # token stream FIRST: within one tick a leg's streamed
+                # tokens surface before its completion, so the
+                # completion's replay tail is empty in the common case
+                thead = int(self._poll_store.add(
+                    f"{self._stream_prefix}/tok_seq", 0))
+                while tok_consumed < thead:
+                    tok_consumed += 1
+                    rec = json.loads(self._poll_store.get(
+                        f"{self._stream_prefix}/tok/{tok_consumed}",
+                        timeout=10))
+                    for rid, tokens, fin in rec.get("items", []):
+                        with self._lock:
+                            leg = self._pending.get(rid)
+                        if leg is not None:
+                            leg._stream(tokens, fin)
                 head = int(self._poll_store.add(
                     f"{self._prefix}/out_seq", 0))
                 while consumed < head:

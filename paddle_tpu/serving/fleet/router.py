@@ -23,6 +23,17 @@ behind it N engines (in-process replicas, or store-RPC remotes via
   emitted (the continuation re-prefills ``prompt + generated`` — greedy
   decode is token-identical), so a retryable ``EngineShuttingDown``
   surfaces to the *fleet*, not to the user;
+* **hedged stragglers** — with ``hedge_after_s`` set, ``hedge_sweep()``
+  duplicates a quiet request's leg on a second engine (the duplicate
+  re-prefills ``prompt + generated``, so greedy decode keeps it
+  token-identical); the first finisher wins, the loser is ABORTED —
+  slot + pages freed silently, its waiters never fired — and the
+  duplicate's tokens only surface on promotion, never interleaved;
+* **prefetch on affinity spill** — when a sticky session lands on a
+  NEW engine (its affine replica was too deep), the router pushes the
+  prompt's shared prefix pages there ahead of the prefill via the
+  cross-engine page-share transport, converting the spill's cold miss
+  into a remote hit;
 * **prefill/decode disaggregation** — engines registered with
   ``role="prefill"`` hand completed prefills to ``role="decode"``
   engines via :func:`.disagg.migrate_request` (KV page migration; the
@@ -40,6 +51,7 @@ import itertools
 import threading
 import time
 
+from ..metrics import ServingMetrics
 from ..scheduler import (EngineClosed, EngineShuttingDown,
                          GenerationRequest, QueueFull)
 from . import disagg as _disagg
@@ -90,6 +102,11 @@ class FleetRequest:
         self.t_done = None
         self._done = threading.Event()
         self._leg = None
+        self._hedge = None         # duplicate leg racing a straggler
+        # serializes token surfacing against hedge promotion: the splice
+        # in _promote_hedge must not interleave with a primary leg's
+        # concurrent _leg_token append
+        self._tok_lock = threading.Lock()
 
     # ---- engine-leg plumbing (router-internal) -------------------------
     def _attach(self, leg, engine_id):
@@ -100,11 +117,18 @@ class FleetRequest:
         self.state = "active"
 
     def _leg_token(self, leg, token, fin):
-        now = time.perf_counter()
-        if self.t_first_token is None:
-            self.t_first_token = now
-        self.token_times.append(now)
-        self.generated.append(int(token))
+        with self._tok_lock:
+            # only the PRIMARY leg surfaces tokens live — a hedge
+            # duplicate's tokens accumulate engine-side and surface in
+            # one splice if it wins (surfacing both would interleave two
+            # token streams into one callback sequence)
+            if leg is not self._leg:
+                return
+            now = time.perf_counter()
+            if self.t_first_token is None:
+                self.t_first_token = now
+            self.token_times.append(now)
+            self.generated.append(int(token))
         cb = self.on_token
         if cb is not None:
             try:
@@ -181,6 +205,11 @@ class LocalEngineHandle:
         leg._handle_id = self.engine_id
         return self.engine.submit_request(leg, block=False)
 
+    def abort(self, leg):
+        """Silently cancel one leg (hedge loser). True when the leg was
+        actually cancelled — its ``on_done`` will never fire."""
+        return self.engine.abort_request(leg)
+
     def start(self):
         self.engine.start()
 
@@ -197,7 +226,7 @@ class FleetRouter:
     MAX_AFFINITY = 4096
 
     def __init__(self, max_redispatch=3, registry=None,
-                 affinity_spill=4):
+                 affinity_spill=4, hedge_after_s=None):
         self._handles = {}
         self._affinity = {}        # head key -> engine_id (LRU order)
         self._lock = threading.Lock()
@@ -207,15 +236,31 @@ class FleetRouter:
         # spill to a second engine (where cross-engine prefix sharing
         # picks up the head) instead of dogpiling one replica
         self.affinity_spill = int(affinity_spill)
+        # a request quiet (no token) for this long is a straggler:
+        # hedge_sweep() duplicates its leg on a second engine. None
+        # disables hedging (the sweep still prunes finished requests).
+        self.hedge_after_s = None if hedge_after_s is None \
+            else float(hedge_after_s)
         self.registry = registry
         self.page_size = None
         self.cfg = None            # first engine's model config (loadgen)
+        self._inflight = {}        # request_id -> FleetRequest (live)
+        # prefetch runs on a side thread by default so the dispatch path
+        # never waits on a store round-trip; tests flip it synchronous
+        self._prefetch_async = True
         # fleet-level counters (bench/tests)
         self.dispatched = 0
         self.redispatched = 0
         self.migrations = 0
         self.saturated = 0
         self.affinity_hits = 0
+        self.hedges_fired = 0
+        self.hedges_won = 0
+        self.aborts = 0
+        self.prefetch_pages = 0
+        # unlabeled fleet-level frontend: hedge/abort counters belong to
+        # the DISPATCH tier, not to any one engine's labeled families
+        self.metrics = ServingMetrics(prefix_enabled=False)
 
     # ------------------------------------------------------------ roster
     def add_engine(self, engine, engine_id=None, role="any", handle=None):
@@ -315,6 +360,9 @@ class FleetRouter:
         first = True
         while True:
             if self._dispatch(fr, session=session, pin=engine):
+                if not fr.done():
+                    with self._lock:
+                        self._inflight[fr.request_id] = fr
                 return fr
             self.saturated += bool(first)
             first = False
@@ -330,6 +378,7 @@ class FleetRouter:
         remaining = fr.max_new_tokens - len(fr.generated)
         if remaining <= 0:       # redispatch raced the last token
             fr._finish(None)
+            self._untrack(fr)
             return True
         head = self._head_key(prompt, session)
         disagg = self._has_decode_pool()
@@ -350,22 +399,21 @@ class FleetRouter:
             # sides of the bookkeeping must already be in place
             fr._leg = leg
             with self._lock:
+                leg._pending_done = False   # fresh latch per attempt
                 h.pending += 1
             try:
                 # a remote handle substitutes its own wire-side leg —
                 # the returned object is the one that will finish
                 leg = h.submit(leg) or leg
-            except QueueFull:
-                with self._lock:
-                    h.pending = max(0, h.pending - 1)
+            except (QueueFull, EngineClosed):
+                # raced a full queue / shutdown: next candidate
+                self._dec_pending(leg, h)
                 continue
-            except EngineClosed:
-                with self._lock:
-                    h.pending = max(0, h.pending - 1)
-                continue  # raced a shutdown: next candidate
             with self._lock:
+                prev_aff = self._affinity.get(head) \
+                    if head is not None else None
                 if head is not None:
-                    if self._affinity.get(head) == h.engine_id:
+                    if prev_aff == h.engine_id:
                         self.affinity_hits += 1
                     self._affinity.pop(head, None)    # move to LRU tail
                     self._affinity[head] = h.engine_id
@@ -373,34 +421,76 @@ class FleetRouter:
                         del self._affinity[next(iter(self._affinity))]
                 self.dispatched += 1
             fr._attach(leg, h.engine_id)
+            if prev_aff is not None and prev_aff != h.engine_id:
+                # affinity SPILL: the session's pages live on prev_aff —
+                # push the shared prefix here before the prefill runs
+                self._prefetch_spill(h, prompt)
             return True
         return False
 
     # ----------------------------------------------------- leg lifecycle
+    def _dec_pending(self, leg, handle=None):
+        """Decrement the dispatching handle's in-flight count EXACTLY
+        once per leg attempt. Completion, abort, and re-dispatch can all
+        race to this on different threads — the per-leg latch (reset at
+        each dispatch attempt) makes the loser a no-op instead of a
+        double decrement that understates load forever."""
+        with self._lock:
+            if getattr(leg, "_pending_done", False):
+                return
+            leg._pending_done = True
+            h = handle
+            if h is None:
+                hid = getattr(leg, "_handle_id", None)
+                h = self._handles.get(hid) if hid is not None else None
+            if h is not None and h.pending > 0:
+                h.pending -= 1
+
+    def _untrack(self, fr):
+        with self._lock:
+            self._inflight.pop(fr.request_id, None)
+
     def _on_leg_done(self, leg):
         if leg.state != "migrating":
-            hid = getattr(leg, "_handle_id", None)
-            if hid is not None:
-                with self._lock:
-                    h = self._handles.get(hid)
-                    if h is not None and h.pending > 0:
-                        h.pending -= 1
+            self._dec_pending(leg)
         fr = getattr(leg, "_fleet", None)
-        if fr is None or fr.done() or leg is not fr._leg:
+        if fr is None or fr.done():
             return
         if leg.state == "migrating":
             return  # moved engines, not finished
+        if getattr(leg, "_hedge_base", None) is not None:
+            self._hedge_done(fr, leg)
+            return
+        if leg is not fr._leg:
+            return  # stale leg (already replaced by a promotion)
         fr._absorb(leg)
         if leg.error is None:
+            with self._lock:
+                hleg = fr._hedge
+                fr._hedge = None
             fr._finish(None)
+            self._untrack(fr)
+            if hleg is not None:
+                self._abort_leg(hleg)   # the duplicate lost the race
             return
-        err = leg.error
+        with self._lock:
+            has_hedge = fr._hedge is not None
+        if has_hedge:
+            # the primary died but its duplicate is still running with
+            # the full continuation — let the hedge carry the request
+            # instead of burning a re-dispatch on a third engine
+            fr._leg = None
+            return
+        self._redispatch_or_fail(fr, leg.error)
+
+    def _redispatch_or_fail(self, fr, err):
         handle = self._handles.get(fr.engine_id)
         retryable = isinstance(err, (EngineShuttingDown, EngineClosed,
                                      QueueFull)) \
             or (handle is not None and not handle.healthy())
         if not retryable or fr.redispatches >= self.max_redispatch:
             fr._finish(err)
+            self._untrack(fr)
             return
         fr.redispatches += 1
         self.redispatched += 1
@@ -414,8 +504,163 @@ class FleetRouter:
             if time.perf_counter() >= deadline:
                 fr._finish(FleetSaturated(
                     "re-dispatch found no engine with queue space"))
+                self._untrack(fr)
                 return
             time.sleep(0.02)
+
+    # ------------------------------------------------------------ hedging
+    def hedge_sweep(self, now=None):
+        """One pass over in-flight requests: prune the finished, hedge
+        the stragglers (quiet longer than ``hedge_after_s``). Returns the
+        number of hedges fired. Called from the autoscaler tick; tests
+        and headless routers call it directly."""
+        if now is None:
+            now = time.perf_counter()
+        fired = 0
+        with self._lock:
+            frs = list(self._inflight.values())
+        for fr in frs:
+            if fr.done():
+                self._untrack(fr)
+                continue
+            if self.hedge_after_s is None or fr._hedge is not None \
+                    or fr._leg is None:
+                continue
+            last = fr.token_times[-1] if fr.token_times else fr.t_submit
+            if now - last < self.hedge_after_s:
+                continue
+            if self._hedge(fr):
+                fired += 1
+        return fired
+
+    def _hedge(self, fr):
+        """Duplicate ``fr``'s leg on a second engine. -> bool (fired)."""
+        with fr._tok_lock:
+            base = len(fr.generated)
+            cont = fr.prompt_ids + fr.generated
+        remaining = fr.max_new_tokens - base
+        if remaining <= 0:
+            return False
+        exclude = (fr.engine_id,) if fr.engine_id is not None else ()
+        for h in self._candidates(stage="prefill", exclude=exclude):
+            hleg = GenerationRequest(
+                cont, max_new_tokens=remaining,
+                eos_token_id=fr.eos_token_id,
+                temperature=fr.temperature, top_k=fr.top_k,
+                on_token=fr._leg_token,    # dropped until promotion
+                on_done=self._on_leg_done)
+            hleg._fleet = fr
+            hleg._hedge_base = base
+            with self._lock:
+                if fr._hedge is not None or fr.done():
+                    return False
+                hleg._pending_done = False
+                h.pending += 1
+                fr._hedge = hleg
+            try:
+                hleg = h.submit(hleg) or hleg
+            except (QueueFull, EngineClosed):
+                self._dec_pending(hleg, h)
+                with self._lock:
+                    fr._hedge = None
+                continue
+            with self._lock:
+                fr._hedge = hleg   # remote handles substitute wire legs
+            self.hedges_fired += 1
+            self.metrics.on_hedge_fired()
+            return True
+        return False
+
+    def _hedge_done(self, fr, hleg):
+        with self._lock:
+            if hleg is not fr._hedge:
+                return             # superseded hedge — nothing to do
+            fr._hedge = None
+            primary = fr._leg
+            if hleg.error is None:
+                # freeze the primary's surfacing BEFORE the splice: any
+                # token it emits from here on hits the identity guard
+                fr._leg = None
+        if fr.done():
+            return
+        if hleg.error is not None:
+            # the hedge lost by failing; if the primary already died
+            # waiting on it, fall back to the normal re-dispatch path
+            if primary is None:
+                self._redispatch_or_fail(fr, hleg.error)
+            return
+        self._promote_hedge(fr, hleg)
+        self.hedges_won += 1
+        self.metrics.on_hedge_won()
+        if primary is not None:
+            self._abort_leg(primary)   # the original lost the race
+
+    def _promote_hedge(self, fr, hleg):
+        """The duplicate finished first: splice its tokens over the
+        primary's tail (greedy decode makes them identical where they
+        overlap) and finish the fleet request."""
+        base = hleg._hedge_base
+        with fr._tok_lock:
+            surfaced = len(fr.generated) - base   # primary tokens beyond
+            tail = [int(t) for t in hleg.generated[surfaced:]]
+            fr.generated[base:] = [int(t) for t in hleg.generated]
+            now = time.perf_counter()
+            for _ in tail:
+                if fr.t_first_token is None:
+                    fr.t_first_token = now
+                fr.token_times.append(now)
+        cb = fr.on_token
+        if cb is not None:
+            for i, t in enumerate(tail):
+                try:
+                    cb(fr, t, i == len(tail) - 1)
+                except Exception:
+                    pass
+        fr._attach(hleg, getattr(hleg, "_handle_id", fr.engine_id))
+        fr._absorb(hleg)
+        fr._finish(None)
+        self._untrack(fr)
+
+    def _abort_leg(self, leg):
+        """Silently cancel a hedge loser: its slot + pages free, its
+        ``on_done`` never fires — the aborter owns the pending
+        decrement. MUST run outside ``self._lock``: the engine abort
+        takes ``_step_lock``, and the migrate hook already establishes
+        the ``_step_lock -> router lock`` order."""
+        hid = getattr(leg, "_handle_id", None)
+        h = self._handles.get(hid) if hid is not None else None
+        if h is None or not hasattr(h, "abort"):
+            return
+        try:
+            cancelled = bool(h.abort(leg))
+        except Exception:
+            cancelled = False
+        if cancelled:
+            self._dec_pending(leg)
+            self.aborts += 1
+            self.metrics.on_abort()
+
+    def _prefetch_spill(self, handle, prompt):
+        """Pull the prompt's shared prefix pages onto ``handle``'s
+        engine (page-share import) so the spilled session's prefill
+        prefix-hits locally instead of missing cold."""
+        eng = getattr(handle, "engine", None)
+        if eng is None or getattr(eng.prefix, "share", None) is None:
+            return
+
+        def run():
+            try:
+                n = eng.prefetch_prefix(prompt)
+            except Exception:
+                return
+            if n:
+                with self._lock:
+                    self.prefetch_pages += n
+        if self._prefetch_async:
+            threading.Thread(target=run, daemon=True,
+                             name="fleet-prefetch").start()
+        else:
+            run()
 
     def _migrate_after_prefill(self, src_engine, leg):
         """``migrate_hook`` body: the prompt completed on a prefill
@@ -528,6 +773,26 @@ class FleetRouter:
         if h is not None:
             h.forced_down = True
 
+    def drop_engine(self, engine_id):
+        """Reap an ALREADY-DEAD engine from the roster (crashed serve
+        loop, lost process): no drain, no migration — its legs have
+        already failed through ``on_done`` re-dispatch. The graceful
+        path is ``remove_engine``."""
+        with self._lock:
+            h = self._handles.pop(engine_id, None)
+            for k in [k for k, v in self._affinity.items()
+                      if v == engine_id]:
+                del self._affinity[k]
+        if h is None:
+            return False
+        h.forced_down = True
+        if self.registry is not None:
+            try:
+                self.registry.deregister(engine_id)
+            except Exception:
+                pass
+        return True
+
     # ------------------------------------------------------------ helpers
     def start(self):
         for h in self.handles().values():
@@ -552,7 +817,7 @@ class FleetRouter:
             hs = dict(self._handles)
         return {
             "engines": {eid: {"healthy": h.healthy(), "role": h.role,
-                              "load": h.load()}
+                              "load": h.load(), "pending": h.pending}
                         for eid, h in hs.items()},
             "dispatched": self.dispatched,
             "redispatched": self.redispatched,
@@ -560,4 +825,9 @@ class FleetRouter:
             "saturated": self.saturated,
             "affinity_hits": self.affinity_hits,
             "affinity_sessions": len(self._affinity),
+            "hedges_fired": self.hedges_fired,
+            "hedges_won": self.hedges_won,
+            "aborts": self.aborts,
+            "prefetch_pages": self.prefetch_pages,
+            "inflight": len(self._inflight),
         }

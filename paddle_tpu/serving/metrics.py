@@ -38,6 +38,14 @@ unlabeled names. Fleet-only rows: ``serving_prefix_remote_hits`` /
 imports) and ``serving_migrations_{in,out}_total`` counters (page
 migration legs of the disaggregated fleet).
 
+**Elastic fleet rows** (ISSUE 16, emitted by the router/autoscaler
+through an unlabeled frontend): ``serving_hedges_{fired,won}_total``
+(speculative straggler duplication), ``serving_aborts_total`` (silently
+cancelled hedge losers), ``serving_prefetch_pages_total`` (prefix pages
+pushed ahead of traffic on affinity spill), and
+``serving_scale_events_total{direction=up|down}`` +
+``serving_fleet_engines`` (autoscaler lifecycle).
+
 Every hook is a no-op when the registry is off (one ``None`` check), so
 an un-instrumented engine pays nothing — same contract as the flight
 recorder and telemetry callbacks.
@@ -198,6 +206,47 @@ class ServingMetrics:
         if remote_hit_tokens is not None:
             self._gauge("serving_prefix_remote_hit_tokens").set(
                 remote_hit_tokens)
+
+    # ---- fleet lifecycle (ISSUE 16) ------------------------------------
+    def on_hedge_fired(self):
+        """The router duplicated a straggler leg on a second engine."""
+        reg = self._reg
+        if reg is None:
+            return
+        self._counter("serving_hedges_fired_total").inc()
+
+    def on_hedge_won(self):
+        """A hedge duplicate finished first (the original was aborted)."""
+        reg = self._reg
+        if reg is None:
+            return
+        self._counter("serving_hedges_won_total").inc()
+
+    def on_prefetch_pages(self, n_pages):
+        """Prefix pages pushed/imported ahead of traffic (router
+        prefetch-on-affinity-spill)."""
+        reg = self._reg
+        if reg is None:
+            return
+        self._counter("serving_prefetch_pages_total").inc(n_pages)
+
+    def on_abort(self):
+        """A leg was silently cancelled (hedge loser): slot + pages
+        freed, waiters never fired."""
+        reg = self._reg
+        if reg is None:
+            return
+        self._counter("serving_aborts_total").inc()
+
+    def on_scale_event(self, direction, n_engines):
+        """The autoscaler changed the fleet size (``direction`` is
+        "up" or "down"); the gauge tracks the resulting roster size."""
+        reg = self._reg
+        if reg is None:
+            return
+        self._counter("serving_scale_events_total",
+                      direction=str(direction)).inc()
+        self._gauge("serving_fleet_engines").set(n_engines)
 
     def on_prefill_chunk(self, n_tokens):
         reg = self._reg

@@ -368,6 +368,26 @@ class ContinuousBatchingScheduler:
         self._release(req)
         req.state = "migrating"
 
+    def abort_request(self, req):
+        """Cancel one leg SILENTLY: free its slot + pages (wherever it
+        is — queued, prefilling or active) without firing its waiters or
+        ``on_done``. The hedged-straggler loser of ISSUE 16: the caller
+        (router) owns the request's done event through a different
+        winning leg, so the loser must simply vanish from this engine.
+        Returns False when the request already reached a terminal state
+        (its ``on_done`` fired / will fire normally)."""
+        if req.state in ("finished", "failed", "migrating", "aborted"):
+            return False
+        with self._lock:
+            try:
+                self.waiting.remove(req)
+                self._space.notify_all()
+            except ValueError:
+                pass
+        self._release(req)
+        req.state = "aborted"
+        return True
+
     def _release(self, req):
         if req.pages:
             self.allocator.free(req.pages)

@@ -498,7 +498,443 @@ def test_engine_registry_liveness_over_store():
     del master
 
 
+# ------------------------------------------- abort + hedging (ISSUE 16)
+
+def test_engine_abort_frees_slot_and_pages(tiny_model):
+    """Scheduler/engine abort (the hedge loser's exit): slot + pages free
+    immediately with refcounts zeroed, waiters and ``on_done`` never
+    fire, terminal states refuse, and a co-resident request decodes
+    unperturbed."""
+    solo = _engine(tiny_model)
+    base = solo.generate([1, 2, 3, 4], max_new_tokens=4)
+    solo.close()
+    e = _engine(tiny_model)
+    alloc = e.kv.allocator
+    fired = []
+    victim = e.submit([5, 6, 7, 8, 9], max_new_tokens=8,
+                      on_done=lambda r: fired.append("victim"))
+    keeper = e.submit([1, 2, 3, 4], max_new_tokens=4,
+                      on_done=lambda r: fired.append("keeper"))
+    e.step()                      # prefill both
+    e.step()                      # one decode token each
+    assert victim.state == "active" and victim.pages
+    pages = list(victim.pages)
+    used_before = alloc.used_pages
+    assert e.abort_request(victim) is True
+    assert victim.state == "aborted"
+    assert victim.slot is None and not victim.pages
+    assert all(alloc.refcount(p) == 0 for p in pages)
+    assert alloc.used_pages < used_before
+    assert e.abort_request(victim) is False    # already gone: refused
+    with pytest.raises(TimeoutError):
+        victim.result(0.05)                    # waiters never fire
+    # a queued (never-admitted) leg aborts too: it just leaves the queue
+    q = e.submit([7, 7, 7, 7, 7], max_new_tokens=2)
+    assert q.state == "waiting"
+    assert e.abort_request(q) is True and q.state == "aborted"
+    _drive(e, until=keeper.done)
+    assert keeper.result(10) == base           # survivor token-identical
+    assert e.abort_request(keeper) is False    # finished fair and square
+    assert fired == ["keeper"]                 # on_done only for it
+    assert alloc.used_pages == 0 and len(e.scheduler.active) == 0
+    e.close()
+
+
+def test_hedged_straggler_first_finisher_wins(tiny_model):
+    """ISSUE 16 acceptance: a straggler's duplicate leg wins on a second
+    engine token-identically; the loser is aborted (slot + pages freed,
+    refcounts zero), the caller's stream has no duplicate or interleaved
+    tokens, and ``serving_hedges_{fired,won}_total`` export through the
+    observability registry."""
+    from paddle_tpu.observability import metrics as obsm
+    from paddle_tpu.serving.fleet import FleetRouter
+    rng = np.random.RandomState(21)
+    prompt = rng.randint(1, 250, 9).tolist()
+    solo = _engine(tiny_model)
+    base = solo.generate(prompt, max_new_tokens=6)
+    solo.close()
+    reg = obsm.enable(out_dir=None, interval_s=0)
+    try:
+        a = _engine(tiny_model, engine_id="e0")
+        b = _engine(tiny_model, engine_id="e1")
+        r = FleetRouter(hedge_after_s=0.2)
+        r.add_engine(a, "e0")
+        r.add_engine(b, "e1")
+        stream = []
+        fr = r.submit(prompt, max_new_tokens=6, engine="e0",
+                      on_token=lambda q, tok, fin: stream.append(tok))
+        a.step()
+        a.step()              # prefill + first decode token on e0
+        assert 0 < len(fr.generated) < 6
+        leg0 = fr._leg
+        pages0 = list(leg0.pages)
+        # e0 stalls (nobody steps it): the sweep duplicates the leg
+        assert r.hedge_sweep(now=fr.t_submit + 99.0) == 1
+        assert fr._hedge is not None and r.hedges_fired == 1
+        # idempotent while a duplicate is already in flight
+        assert r.hedge_sweep(now=fr.t_submit + 999.0) == 0
+        _drive(b, until=fr.done)   # only the hedge engine progresses
+        assert fr.result(10) == base          # token-identical winner
+        assert stream == base                 # no dupes, no interleave
+        assert fr.engine_id == "e1" and fr.engine_ids == ["e0", "e1"]
+        assert r.hedges_won == 1 and r.aborts == 1
+        # the loser vanished from e0: slot + pages freed, refcounts zero
+        assert leg0.state == "aborted"
+        assert len(a.scheduler.active) == 0
+        assert a.kv.allocator.used_pages == 0
+        assert all(a.kv.allocator.refcount(p) == 0 for p in pages0)
+        assert r.stats()["inflight"] == 0
+        hs = r.handles()
+        assert hs["e0"].pending == 0 and hs["e1"].pending == 0
+        c = reg.snapshot()["counters"]
+        assert c["serving_hedges_fired_total"] == 1
+        assert c["serving_hedges_won_total"] == 1
+        assert c["serving_aborts_total"] == 1
+        a.close()
+        b.close()
+    finally:
+        obsm.disable()
+
+
+def test_router_pending_decrements_exactly_once(tiny_model):
+    """Regression (ISSUE 16 bugfix): completion, abort and re-dispatch
+    can all race to the pending decrement on different threads — a
+    duplicate terminal delivery for the same leg must be a no-op, not a
+    second decrement that understates the engine's load forever."""
+    from paddle_tpu.serving.fleet import FleetRouter
+    e = _engine(tiny_model)
+    r = FleetRouter()
+    h = r.add_engine(e, "e0")
+    fa = r.submit([1, 2, 3, 4, 5], max_new_tokens=1)
+    fb = r.submit([9, 8, 7, 6, 5], max_new_tokens=6)
+    assert h.pending == 2
+    _drive(e, until=fa.done)
+    leg = fa._leg
+    assert h.pending == 1          # fb still in flight
+    r._on_leg_done(leg)            # duplicate delivery
+    r._on_leg_done(leg)
+    assert h.pending == 1          # latched: no double decrement
+    _drive(e, until=fb.done)
+    assert h.pending == 0
+    assert len(fa.result(5)) == 1 and len(fb.result(5)) == 6
+    e.close()
+
+
+# --------------------------------------------- autoscaling (ISSUE 16)
+
+def test_autoscaler_scale_up_down_hysteresis(tiny_model):
+    """SLO loop against a manual-stepped fleet: sustained pressure adds
+    a warm engine (after ``up_ticks``, never past ``max_engines``);
+    sustained idleness drains one back out (never below
+    ``min_engines``). Injected ``now`` keeps every decision
+    deterministic."""
+    from paddle_tpu.serving.fleet import EngineAutoscaler, FleetRouter
+    e0 = _engine(tiny_model, engine_id="e0", max_queue=16)
+    r = FleetRouter()
+    r.add_engine(e0, "e0")
+    spawned = []
+
+    def spawn(eid):
+        eng = _engine(tiny_model, engine_id=eid)
+        spawned.append(eng)
+        return eng
+
+    sc = EngineAutoscaler(r, spawn, min_engines=1, max_engines=2,
+                          queue_high=1.0, queue_low=0.5,
+                          up_ticks=2, down_ticks=2, cooldown_s=0.0,
+                          warm=False)
+    frs = [r.submit([1, 2, 3, 4, 5], max_new_tokens=2) for _ in range(4)]
+    assert sc.tick(now=1.0) is None        # hysteresis holds tick one
+    assert sc.tick(now=2.0) == "up"
+    assert set(r.handles()) == {"e0", "a0"} and sc.epoch == 1
+    assert sc.events[-1]["dir"] == "up"
+    assert sc.events[-1]["engine"] == "a0"
+    # at max_engines the bound holds no matter the pressure
+    assert sc.tick(now=3.0) is None and sc.tick(now=4.0) is None
+    assert len(r.handles()) == 2
+    _drive(e0, until=lambda: all(f.done() for f in frs))
+    for f in frs:
+        assert len(f.result(10)) == 2
+    # idle fleet: down_ticks quiet passes drain the spare back out
+    assert sc.tick(now=5.0) is None
+    assert sc.tick(now=6.0) == "down"
+    assert len(r.handles()) == 1 and sc.events[-1]["dir"] == "down"
+    assert sc.tick(now=7.0) is None        # at min_engines: floor holds
+    sc.close()
+    r.close()
+
+
+def test_autoscaler_quarantine_blocks_readmission(tiny_model):
+    """Death -> strike -> replacement: one serve-loop crash quarantines
+    the engine (threshold=1 — an engine process death is terminal), the
+    below-min replacement skips hysteresis, and the struck id is never
+    re-admitted inside the window — not by the replacement, not by a
+    later explicit scale-up."""
+    from paddle_tpu.serving.fleet import EngineAutoscaler, FleetRouter
+    spawned = {}
+
+    def spawn(eid):
+        eng = _engine(tiny_model, engine_id=eid)
+        spawned[eid] = eng
+        return eng
+
+    r = FleetRouter()
+    r.add_engine(spawn("a0"), "a0")
+    sc = EngineAutoscaler(r, spawn, min_engines=1, max_engines=3,
+                          id_prefix="a", warm=False, cooldown_s=0.0)
+    spawned["a0"].close()                   # abrupt engine death
+    assert sc.tick(now=1.0) == "up"         # strike + instant replacement
+    assert sc.quarantine.quarantined() == ["a0"]
+    assert set(r.handles()) == {"a1"}       # a0 reaped, id skipped
+    assert sc.events[-1]["dir"] == "up"
+    assert sc.events[-1]["engine"] == "a1"
+    assert sc.scale_up(now=2.0) == "a2"     # later growth skips it too
+    assert "a0" not in r.handles() and len(r.handles()) == 2
+    sc.close()
+    r.close()
+
+
+def test_fleet_membership_survives_store_failover():
+    """Quarantine ledger + autoscale epoch + join log all live under
+    registry-scope keys: the LogShipper replicates them to the standby,
+    and after the primary dies mid-scale-event a registry over the
+    promoted store still knows who is struck out and how big the fleet
+    meant to be (strike ages re-anchored across the takeover)."""
+    from paddle_tpu.distributed import FailoverStore, LogShipper
+    from paddle_tpu.distributed.elastic import QuarantineList
+    from paddle_tpu.distributed.tcp_store import TCPStore
+    from paddle_tpu.serving.fleet import EngineRegistry
+    p1, p2 = _free_port(), _free_port()
+    prim = TCPStore("127.0.0.1", p1, is_master=True, timeout=15)
+    standby = TCPStore("127.0.0.1", p2, is_master=True, timeout=15)
+    fs = FailoverStore(f"127.0.0.1:{p1},127.0.0.1:{p2}", timeout=15,
+                       connect_deadline=2.0)
+    sh = LogShipper(f"127.0.0.1:{p1}", f"127.0.0.1:{p2}", timeout=15)
+    reg = EngineRegistry(fs, job="t6")
+    reg.register("e0", heartbeat=False)
+    reg.register("e1", heartbeat=False)
+    q = QuarantineList(threshold=1)
+    assert q.record_failure("e1", now=100.0)
+    reg.save_quarantine(q, now=100.0)
+    reg.save_autoscale({"epoch": 3, "n_engines": 2})
+    assert sh.ship_once() > 0               # WAL pumped to the standby
+    prim.stop_server()                      # primary dies mid-event
+    reg2 = EngineRegistry(TCPStore("127.0.0.1", p2, timeout=15),
+                          job="t6")
+    q2 = QuarantineList(threshold=1)
+    assert reg2.load_quarantine(q2, now=200.0)
+    assert q2.is_quarantined("e1")          # still benched after takeover
+    state = reg2.load_autoscale()
+    assert state["epoch"] == 3 and state["n_engines"] == 2
+    assert reg2.joined() == ["e0", "e1"]    # join log rode the WAL too
+    standby.stop_server()
+
+
+# ------------------------------------------- serving chaos (ISSUE 16)
+
+def test_engine_fault_kinds_parse_and_target(tiny_model):
+    """``engine_die``/``engine_stall`` are cooperative at the serve-loop
+    site only (any other @site is a spec error); PADDLE_TPU_FAULT_ENGINE
+    narrows the kill to ONE engine id, so a multi-engine process loses
+    exactly the chosen replica while its neighbor keeps serving; a stall
+    freezes the loop without killing it."""
+    import os
+    from paddle_tpu.distributed import fault
+    with pytest.raises(ValueError):
+        fault.parse_fault_spec("engine_die@step:1")
+    with pytest.raises(ValueError):
+        fault.parse_fault_spec("engine_stall@ckpt:1")
+    a = _engine(tiny_model, engine_id="e0")
+    b = _engine(tiny_model, engine_id="e1")
+    os.environ["PADDLE_TPU_FAULT_ENGINE"] = "e1"
+    try:
+        fault.set_fault_spec("engine_die@serve_loop:1")
+        a.start()
+        b.start()
+        deadline = time.time() + 20
+        while b._loop_error is None and time.time() < deadline:
+            time.sleep(0.02)
+        assert b._loop_error is not None and b._closed   # target died
+        assert "engine_die" in str(b._loop_error)
+        assert a._loop_error is None and not a._closed   # bystander lives
+        assert len(a.generate([1, 2, 3], max_new_tokens=2)) == 2
+    finally:
+        fault.set_fault_spec(None)
+        os.environ.pop("PADDLE_TPU_FAULT_ENGINE", None)
+        a.close()
+        b.close()
+    os.environ["PADDLE_TPU_FAULT_ENGINE_STALL_S"] = "0.05"
+    c = _engine(tiny_model, engine_id="e2")
+    try:
+        fault.set_fault_spec("engine_stall@serve_loop:1")
+        c.start()
+        out = c.generate([4, 5, 6], max_new_tokens=2, timeout=30)
+        assert len(out) == 2     # the loop froze briefly, then resumed
+        assert c._loop_error is None
+    finally:
+        fault.set_fault_spec(None)
+        os.environ.pop("PADDLE_TPU_FAULT_ENGINE_STALL_S", None)
+        c.close()
+
+
+# ------------------------------- prefetch + streaming RPC (ISSUE 16)
+
+def test_router_prefetch_on_affinity_spill(tiny_model):
+    """When a sticky session spills off its deep affine replica, the
+    router pushes the shared prefix pages to the new engine AHEAD of the
+    prefill: the spilled request's admission prefix-hits locally and the
+    labeled ``serving_prefetch_pages_total`` counter attributes the
+    import to the receiving engine."""
+    from paddle_tpu.distributed.tcp_store import TCPStore
+    from paddle_tpu.observability import metrics as obsm
+    from paddle_tpu.serving.fleet import FleetRouter, PageShareClient
+    port = _free_port()
+    master = TCPStore("127.0.0.1", port, is_master=True)
+    reg = obsm.enable(out_dir=None, interval_s=0)
+    try:
+        shA = PageShareClient(TCPStore("127.0.0.1", port), "e0", job="t8")
+        shB = PageShareClient(TCPStore("127.0.0.1", port), "e1", job="t8")
+        ea = _engine(tiny_model, engine_id="e0", page_share=shA,
+                     registry=reg, max_queue=16)
+        eb = _engine(tiny_model, engine_id="e1", page_share=shB,
+                     registry=reg, max_queue=16)
+        r = FleetRouter()
+        r._prefetch_async = False        # deterministic: import inline
+        r.add_engine(ea, "e0")
+        r.add_engine(eb, "e1")
+        rng = np.random.RandomState(12)
+        head = rng.randint(1, 250, 8).tolist()   # 2 full shareable pages
+        s0 = r.submit(head + [7, 8], max_new_tokens=2, engine="e0")
+        _drive(ea, eb)
+        s0.result(10)
+        assert shA.published == 2        # head pages on the store index
+        # pile un-stepped work on the affine engine: the session spills
+        fillers = [r.submit(rng.randint(1, 250, 5).tolist(),
+                            max_new_tokens=4, engine="e0")
+                   for _ in range(5)]
+        fr = r.submit(head + [9, 9], max_new_tokens=2)
+        assert fr.engine_id == "e1"      # spilled off the deep replica
+        assert r.prefetch_pages == 2     # head pushed ahead of traffic
+        assert shB.remote_hit_tokens == 8
+        eb.step()
+        assert fr._leg.prefix_hit_tokens == 8   # admission hit LOCALLY
+        _drive(ea, eb)
+        assert len(fr.result(10)) == 2
+        for f in fillers:
+            assert len(f.result(10)) == 4
+        snap = reg.snapshot()
+        assert snap["counters"][
+            "serving_prefetch_pages_total{engine=e1}"] == 2
+        assert r.stats()["prefetch_pages"] == 2
+        ea.close()
+        eb.close()
+    finally:
+        obsm.disable()
+        del master
+
+
+def test_remote_streaming_and_abort_over_store(tiny_model):
+    """Store-RPC streaming (in-process twin of the @slow subprocess
+    roundtrip): tokens surface incrementally through the stream channel
+    with the completion replaying NO duplicates; a wire abort drains the
+    engine-side leg (slot + pages freed) and its waiters never fire."""
+    import threading
+    from paddle_tpu.distributed import keyspace
+    from paddle_tpu.distributed.tcp_store import TCPStore
+    from paddle_tpu.serving.fleet import (FleetRouter, RemoteEngineHandle,
+                                          serve_over_store)
+    port = _free_port()
+    master = TCPStore("127.0.0.1", port, is_master=True)
+    eng = _engine(tiny_model, engine_id="e0", max_queue=8)
+    eng.start()
+    server_store = TCPStore("127.0.0.1", port)
+    t = threading.Thread(target=serve_over_store,
+                         args=(eng, server_store, "e0"),
+                         kwargs={"job": "t9", "poll_s": 0.01},
+                         daemon=True)
+    t.start()
+    handle = RemoteEngineHandle(lambda: TCPStore("127.0.0.1", port),
+                                "e0", job="t9", poll_s=0.01)
+    r = FleetRouter()
+    r.add_engine(None, handle=handle)
+    r.page_size = 4
+    stream = []
+    fr = r.submit([5, 6, 7, 8], max_new_tokens=4,
+                  on_token=lambda q, tok, fin: stream.append((tok, fin)))
+    out = fr.result(60)
+    assert len(out) == 4
+    sp = keyspace.fleet_engine_stream("t9", "e0")
+    assert int(master.add(f"{sp}/tok_seq", 0)) >= 1   # stream channel ran
+    assert [tok for tok, _ in stream] == out          # no duplicates
+    assert [fin for _, fin in stream] == [False] * 3 + [True]
+    # abort mid-stream: wait for the first streamed token, then cancel
+    fr2 = r.submit([9, 8, 7, 6], max_new_tokens=48)
+    deadline = time.time() + 30
+    while not fr2.generated and time.time() < deadline:
+        time.sleep(0.005)
+    assert fr2.generated and not fr2.done()           # mid-decode
+    assert handle.abort(fr2._leg) is True
+    deadline = time.time() + 30
+    while (eng.scheduler.has_work() or eng.kv.allocator.used_pages) \
+            and time.time() < deadline:
+        time.sleep(0.01)
+    assert not eng.scheduler.has_work()
+    assert eng.kv.allocator.used_pages == 0           # loser drained
+    assert not fr2.done()                             # waiters silent
+    master.set(f"{keyspace.fleet_registry('t9')}/stop", b"1")
+    t.join(10)
+    assert not t.is_alive()
+    handle.close()
+    eng.close()
+    del master
+
+
 # ------------------------------------------------------------------- slow
+
+@pytest.mark.slow
+def test_autoscale_burst_soak(tiny_model):
+    """Elastic soak: a Poisson burst against a 1-engine fleet with the
+    autoscaler THREAD running — the roster grows under the burst, every
+    request lands, and the fleet drains back to the floor afterwards."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+    from paddle_tpu.serving import (ServingEngine, make_session_prompts,
+                                    run_poisson_load)
+    from paddle_tpu.serving.fleet import EngineAutoscaler, FleetRouter
+
+    def build(eid):
+        paddle.seed(7)
+        m = GPTForCausalLM(gpt_tiny())
+        m.eval()
+        return ServingEngine(m, page_size=4, num_pages=32, max_slots=2,
+                             attn_backend="xla", jit=False,
+                             engine_id=eid, max_queue=64)
+
+    e0 = build("e0")
+    r = FleetRouter(hedge_after_s=5.0)
+    r.add_engine(e0, "e0")
+    r.start()
+    sc = EngineAutoscaler(r, build, min_engines=1, max_engines=3,
+                          queue_high=1.5, queue_low=0.25, up_ticks=1,
+                          down_ticks=4, cooldown_s=0.5, interval_s=0.05,
+                          warm=False)
+    sc.start()
+    try:
+        prompts, _ = make_session_prompts(3, 8, head_len=8,
+                                          tail_len=(3, 6), vocab=250,
+                                          seed=13)
+        res = run_poisson_load(r, qps=400.0, prompts=prompts,
+                               max_new_tokens=8, timeout=120.0)
+        assert res["requests_failed"] == 0
+        assert any(ev["dir"] == "up" for ev in sc.events)
+        deadline = time.time() + 60
+        while len(r.handles()) > 1 and time.time() < deadline:
+            time.sleep(0.2)
+        assert len(r.handles()) == 1        # drained back to the floor
+    finally:
+        sc.close()
+        r.close()
+
 
 @pytest.mark.slow
 def test_fleet_concurrent_poisson_balanced(tiny_model):

@@ -2016,6 +2016,233 @@ def run_serving_chaos_bench():
         router.close()
 
 
+def run_router_chaos_bench(n_engines=2):
+    """Router-chaos twin (ISSUE 17 tentpole (c)): a PRIMARY front-door
+    process armed with ``router_die@route`` SIGKILLs itself mid-burst
+    over a 2-engine store-RPC fleet; the driver-side SHADOW watches the
+    lease go stale, adopts the ledger (re-attaching live legs off the
+    persisted cursors, re-dispatching orphans), and every request must
+    complete EXACTLY ONCE — zero client-visible errors, zero duplicated
+    or lost tokens, greedy token-identical to an unchaosed solo twin.
+    Records ``serving_router_failover_s`` (router death to adoption
+    complete) and ``serving_router_requests_replayed``, and exercises
+    the deposed-router fence (a revived primary's term is stale: its
+    next dispatch raises instead of split-braining)."""
+    import subprocess
+    import threading as _threading
+
+    from paddle_tpu.distributed.tcp_store import TCPStore
+    from paddle_tpu.serving.fleet import (EngineRegistry, FleetRouter,
+                                          RemoteEngineHandle,
+                                          RequestLedger, RouterClient,
+                                          RouterDeposedError,
+                                          RouterLease)
+    from paddle_tpu.serving.fleet.frontdoor import serve_router
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    device, cfg, kb = _serving_cfg_and_knobs()
+    prompts, _sids, new_tokens = _fleet_workload(cfg, kb)
+    n_req = 12
+    die_at = 6           # SIGKILL at the 6th routed request (mid-burst)
+
+    # unchaosed twin: the parity oracle for every chaos request
+    build = _fleet_builder(cfg, kb)
+    solo = build("solo")
+    base = [solo.generate(prompts[i % len(prompts)],
+                          max_new_tokens=new_tokens)
+            for i in range(n_req)]
+    solo.close()
+
+    import socket as _socket
+    with _socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    store_ep = f"127.0.0.1:{port}"
+    master = TCPStore("127.0.0.1", port, is_master=True)
+    env = _chaos_child_env(repo)
+    workers, primary = [], None
+    sub = {}
+    serve_thread = None
+    shadow = None
+    try:
+        for i in range(n_engines):
+            workers.append(subprocess.Popen(
+                [sys.executable, "-m",
+                 "paddle_tpu.serving.fleet.remote",
+                 "--store", store_ep, "--engine-id", f"e{i}",
+                 "--job", "bench", "--seed", "0",
+                 "--vocab", str(cfg.vocab_size),
+                 "--hidden", str(cfg.hidden_size),
+                 "--layers", str(cfg.num_layers),
+                 "--heads", str(cfg.num_heads),
+                 "--seq", str(cfg.max_seq_len),
+                 "--page", str(kb["page"]), "--pool", str(kb["pool"]),
+                 "--slots", str(kb["slots"]),
+                 "--chunk", str(kb["chunk"])],
+                env=env, cwd=repo, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True))
+        reg = EngineRegistry(TCPStore("127.0.0.1", port), job="bench")
+        deadline = time.time() + 300
+        while len(reg.engines()) < n_engines:
+            if time.time() > deadline or any(
+                    w.poll() is not None for w in workers):
+                tails = [w.communicate()[0][-500:] for w in workers
+                         if w.poll() is not None]
+                raise RuntimeError(
+                    f"fleet workers never registered: {tails}")
+            time.sleep(0.5)
+
+        penv = dict(env)
+        penv["PADDLE_TPU_FAULTS"] = f"router_die@route:{die_at}"
+        primary = subprocess.Popen(
+            [sys.executable, "-m",
+             "paddle_tpu.serving.fleet.frontdoor",
+             "--store", store_ep, "--job", "bench",
+             "--role", "primary", "--ttl", "1.0",
+             "--engines", ",".join(f"e{i}" for i in range(n_engines))],
+            env=penv, cwd=repo, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True)
+        plines = []
+        _threading.Thread(
+            target=lambda: plines.extend(primary.stdout),
+            daemon=True).start()
+
+        watch = RouterLease(TCPStore("127.0.0.1", port), job="bench",
+                            ttl=1.0)
+        deadline = time.time() + 120
+        while watch.read() is None:
+            if time.time() > deadline or primary.poll() is not None:
+                raise RuntimeError(
+                    f"primary router never leased: {plines[-5:]}")
+            time.sleep(0.1)
+
+        client = RouterClient(TCPStore("127.0.0.1", port), job="bench",
+                              resubmit_after=2.0)
+        rng = __import__("random").Random(23)
+        for i in range(n_req):
+            client.submit(f"req-{i}", prompts[i % len(prompts)],
+                          max_new_tokens=new_tokens)
+            time.sleep(rng.uniform(0.01, 0.06))  # Poisson-ish burst
+
+        # shadow: wait for the lease to go stale (the primary SIGKILLs
+        # itself at the die_at-th routed request), then adopt
+        grace = 3.0
+        deadline = time.time() + 240
+        while True:
+            if primary.poll() is not None:
+                break
+            if time.time() > deadline:
+                raise RuntimeError(
+                    f"primary never died: {plines[-5:]}")
+            time.sleep(0.05)
+        die_wall = None
+        for ln in plines:
+            if ln.startswith("ROUTER_DIE"):
+                die_wall = float(ln.split()[1])
+        while watch.stale_age() is None or watch.stale_age() < grace:
+            time.sleep(0.1)
+
+        t0 = time.monotonic()
+        ledger = RequestLedger(TCPStore("127.0.0.1", port), job="bench")
+        lease = RouterLease(TCPStore("127.0.0.1", port), job="bench",
+                            ttl=1.0)
+        term = lease.adopt()
+        shadow = FleetRouter(ledger=ledger, lease=lease)
+        for i in range(n_engines):
+            # defer_poll: adoption must attach every inherited rid
+            # BEFORE the history replay runs, or early stream records
+            # are dropped (rid unknown) and tails double-fire
+            shadow.add_engine(None, handle=RemoteEngineHandle(
+                lambda: TCPStore("127.0.0.1", port), f"e{i}",
+                job="bench",
+                registry=EngineRegistry(TCPStore("127.0.0.1", port),
+                                        job="bench"),
+                defer_poll=True))
+        adopted = shadow.adopt_from_ledger()
+        for h in shadow.handles().values():
+            h.start_polling()
+        adopt_done_wall = time.time()
+        failover_s = (adopt_done_wall - die_wall) \
+            if die_wall is not None else time.monotonic() - t0
+        serve_thread = _threading.Thread(
+            target=lambda: serve_router(
+                shadow, TCPStore("127.0.0.1", port), job="bench",
+                idle_timeout=300.0),
+            daemon=True)
+        serve_thread.start()
+
+        # every request completes exactly once: the streamed tokens the
+        # client saw must equal the terminal record AND the solo twin
+        results, streamed, failed = [], {}, 0
+        for i in range(n_req):
+            seen = streamed.setdefault(i, [])
+            try:
+                toks = client.result(f"req-{i}", timeout=240.0,
+                                     on_token=lambda t, fin, s=seen:
+                                     s.append(t))
+            except Exception:
+                toks, failed = None, failed + 1
+            results.append(toks)
+        exactly_once = all(
+            results[i] is not None and streamed[i] == results[i]
+            for i in range(n_req))
+        parity = all(results[i] == base[i] for i in range(n_req))
+
+        # terminal replay probe: resubmitting a finished id must answer
+        # from the journal without touching an engine
+        replay = shadow.submit(prompts[0], max_new_tokens=new_tokens,
+                               request_id="req-0")
+        replay_ok = (replay.done()
+                     and list(replay.generated) == results[0])
+
+        # deposed fence: a revived primary still holds the OLD term —
+        # its next dispatch must refuse, not split-brain
+        revived = RouterLease(TCPStore("127.0.0.1", port), job="bench",
+                              ttl=1.0)
+        revived.term = term - 1
+        r2 = FleetRouter(ledger=ledger, lease=revived)
+        fenced = False
+        try:
+            r2.submit(prompts[0], max_new_tokens=2, block=False,
+                      request_id="fence-probe")
+        except RouterDeposedError:
+            fenced = True
+
+        sub.update({
+            "serving_router_failover_s": round(failover_s, 3),
+            "serving_router_requests_replayed":
+                shadow.requests_replayed,
+            "serving_router_requests_adopted": adopted,
+            "serving_router_requests_failed": failed,
+            "serving_router_exactly_once_ok": bool(exactly_once),
+            "serving_router_parity_ok": bool(parity),
+            "serving_router_replay_ok": bool(replay_ok),
+            "serving_router_fence_ok": bool(fenced),
+            "serving_router_die_marker": die_wall is not None,
+        })
+        ok = (failed == 0 and exactly_once and parity and replay_ok
+              and fenced and die_wall is not None
+              and shadow.requests_replayed >= 1)
+        sub["serving_router_leg_ok"] = bool(ok)
+        return sub, ok
+    finally:
+        try:
+            master.set("serving/bench/stop", b"1")
+        except Exception:
+            pass
+        if serve_thread is not None:
+            serve_thread.join(30)
+        if shadow is not None:
+            for h in shadow.handles().values():
+                try:
+                    h.detach()
+                except Exception:
+                    pass
+        for w in workers + ([primary] if primary else []):
+            if w.poll() is None:
+                w.kill()
+
+
 def main_serving_fleet():
     snap = _load_snapshot()
     merged = snap.setdefault("submetrics", {})
@@ -2052,6 +2279,15 @@ def main_serving_fleet():
     except Exception as e:
         merged.update({"serving_chaos_error": repr(e)[-300:],
                        "serving_chaos_leg_ok": False})
+        ok = False
+    # ISSUE 17 router-chaos twin — independent like every other leg
+    try:
+        rsub, rok = run_router_chaos_bench()
+        merged.update(rsub)
+        ok = ok and rok
+    except Exception as e:
+        merged.update({"serving_router_error": repr(e)[-300:],
+                       "serving_router_leg_ok": False})
         ok = False
     snap.setdefault("metric", "gpt_train_step_mfu")
     snap.setdefault("value", 0.0)

@@ -19,6 +19,7 @@ Four pieces, stdlib-only (importable by the launcher before jax loads):
                 | async_torn | commit_stall | desync
                 | node_die | agent_stall | store_die
                 | engine_die | engine_stall
+                | router_die | router_stall
        trigger := 1-based Nth matching hit that fires the fault
        rank    := only this process id injects (default: every rank;
                   node-scoped kinds filter by NODE ordinal — the agent
@@ -124,7 +125,8 @@ _KINDS = ("crash", "hang", "torn_write", "store_drop", "slow_io",
           "async_torn", "commit_stall", "desync",
           "node_die", "agent_stall", "store_die",
           "coordinator_die", "wal_torn",
-          "engine_die", "engine_stall")
+          "engine_die", "engine_stall",
+          "router_die", "router_stall")
 # a site-less (wildcard) cooperative entry only fires at sites whose
 # callers honor the returned kind — anywhere else it would burn its
 # trigger silently; crash/hang/slow_io/commit_stall wildcards fire at
@@ -174,7 +176,18 @@ _WILDCARD_SITES = {"store_drop": ("store",), "torn_write": ("ckpt",),
                    # name one engine_id so a multi-engine process kills
                    # a chosen replica deterministically.
                    "engine_die": ("serve_loop",),
-                   "engine_stall": ("serve_loop",)}
+                   "engine_stall": ("serve_loop",),
+                   # durable front door (ISSUE 17): ``router_die`` is
+                   # cooperative at the serving router's route-loop
+                   # site — the front-door process enacts SIGKILL on
+                   # itself mid-dispatch (the shadow router adopts the
+                   # ledger and the in-flight legs); ``router_stall``
+                   # executes a sleep there (the lease goes stale while
+                   # the process lives — the shadow must adopt AND the
+                   # revived primary must hit the term fence, exiting
+                   # EXIT_DEPOSED instead of split-brain dispatching).
+                   "router_die": ("route",),
+                   "router_stall": ("route",)}
 
 _lock = threading.Lock()
 _entries: list | None = None  # parsed spec; None = not yet loaded from env
@@ -353,6 +366,9 @@ def maybe_inject(site: str):
         elif e.kind == "engine_stall":
             time.sleep(float(os.environ.get(
                 "PADDLE_TPU_FAULT_ENGINE_STALL_S", "30.0")))
+        elif e.kind == "router_stall":
+            time.sleep(float(os.environ.get(
+                "PADDLE_TPU_FAULT_ROUTER_STALL_S", "30.0")))
         else:
             result = e.kind
     return result

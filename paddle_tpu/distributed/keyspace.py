@@ -39,7 +39,8 @@ __all__ = [
     "wal_entry", "wal_claim", "wal_result", "wal_cursor", "fence_promo",
     "elastic_job", "elastic_node", "elastic_coord",
     "fleet_registry", "fleet_engine_rpc", "fleet_engine_stream",
-    "fleet_quarantine", "fleet_autoscale", "page_share",
+    "fleet_quarantine", "fleet_autoscale", "fleet_ledger",
+    "fleet_router", "page_share",
     "rpc_worker", "rpc_rank",
 ]
 
@@ -130,6 +131,26 @@ def fleet_autoscale(job):
     """Autoscaler state root (scale-event log + roster epoch) for one
     serving job — registry scope: rides the WAL like membership."""
     return f"serving/{job}/autoscale"
+
+
+def fleet_ledger(job):
+    """Durable request ledger root (ISSUE 17): ``seq`` counter +
+    ``idx/<n>`` request-id join-log + ``req/<rid>`` lifecycle records
+    (``accepted -> dispatched -> streaming -> terminal``). Registry
+    scope on purpose — every record rides the FailoverStore WAL, so a
+    promoted standby store still holds the exactly-once journal a
+    shadow router reconstructs from."""
+    return f"serving/{job}/ledger"
+
+
+def fleet_router(job):
+    """Serving front-door root (ISSUE 17): the router lease/term pair
+    (``lease`` JSON + ``term`` fence counter — same primary/shadow
+    protocol as ``elastic_coord``) plus the wire submission queue
+    (``in_seq`` counter + ``in/<n>`` records carrying client-supplied
+    request ids) and the ``stop`` key. Registry scope: a promoted
+    standby still sees the queue tail and the deposed term."""
+    return f"serving/{job}/router"
 
 
 def page_share(job):

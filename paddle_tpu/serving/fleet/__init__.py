@@ -19,6 +19,15 @@ engine (first finisher wins, loser aborted slot-and-pages-free), and the
 store-RPC transport streams tokens incrementally instead of batching
 them at completion.
 
+ISSUE 17 makes the front door DURABLE: a :class:`~.ledger.RequestLedger`
+journals every request lifecycle through the replicated store (client
+request ids are exactly-once keys — a retried terminal id replays the
+recorded result, an in-flight id attaches to the live leg), a
+:class:`~.ledger.RouterLease` term-fences primary/shadow routers, and
+:mod:`~.frontdoor` packages the pair as processes: a shadow adopts the
+ledger on lease expiry, re-attaching to engines' live legs off the
+persisted token cursors.
+
     from paddle_tpu.serving.fleet import FleetRouter
     router = FleetRouter()
     router.add_engine(engine_a, "e0")
@@ -35,3 +44,7 @@ from .disagg import MigrationFailed, migrate_request  # noqa: F401
 from .registry import EngineRegistry  # noqa: F401
 from .remote import RemoteEngineHandle, serve_over_store  # noqa: F401
 from .autoscale import EngineAutoscaler  # noqa: F401
+from .ledger import (  # noqa: F401
+    RequestLedger, RouterDeposedError, RouterLease,
+)
+from .frontdoor import RouterClient, serve_router  # noqa: F401

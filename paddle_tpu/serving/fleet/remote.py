@@ -91,11 +91,27 @@ def serve_over_store(engine, store, engine_id, job="fleet",
     tok_lock = threading.Lock()
     tok_buf = []             # (rid, token, fin) since the last flush
     inflight = {}            # rid -> engine-side request (abort target)
+    # server-side idempotency (ISSUE 17 satellite): a client whose
+    # submit timed out retries the SAME rid — without this cache the
+    # retry record spawned a second GenerationRequest and the engine
+    # generated twice. Bounded FIFO: old entries age out, and a rid old
+    # enough to have aged out is also old enough to be answered by the
+    # durable ledger instead.
+    finished = {}            # rid -> published result record
+    _FINISHED_CAP = 512
+
+    def _remember(rid, rec):
+        # caller holds done_lock
+        finished[rid] = rec
+        while len(finished) > _FINISHED_CAP:
+            del finished[next(iter(finished))]
 
     def on_done(req):
         inflight.pop(req._rid, None)
         with done_lock:
-            done_queue.append(_result_record(req._rid, req))
+            rec = _result_record(req._rid, req)
+            _remember(req._rid, rec)
+            done_queue.append(rec)
 
     def on_token(req, token, fin):
         with tok_lock:
@@ -131,6 +147,16 @@ def serve_over_store(engine, store, engine_id, job="fleet",
                     except Exception:
                         pass
                 continue
+            if rid in inflight:
+                continue     # duplicate of a live request: one leg only
+            with done_lock:
+                replay = finished.get(rid)
+                if replay is not None:
+                    # retry of a finished rid: republish the recorded
+                    # result instead of generating again
+                    done_queue.append(replay)
+            if replay is not None:
+                continue
             try:
                 req = GenerationRequest(
                     msg["prompt"],
@@ -145,7 +171,9 @@ def serve_over_store(engine, store, engine_id, job="fleet",
             except Exception as e:
                 inflight.pop(rid, None)
                 with done_lock:
-                    done_queue.append(_result_record(rid, error=e))
+                    rec = _result_record(rid, error=e)
+                    _remember(rid, rec)
+                    done_queue.append(rec)
         # per-token streaming: flush everything emitted since the last
         # tick as ONE batched record — a store write per tick, not per
         # token (and none at all on an idle tick)
@@ -188,7 +216,8 @@ class _RemoteLeg:
     router treats it exactly like a local leg (state/error/on_done/
     accounting), completed by the handle's poller thread."""
 
-    def __init__(self, rid, prompt, on_token=None, on_done=None):
+    def __init__(self, rid, prompt, on_token=None, on_done=None,
+                 skip=0):
         self.request_id = rid
         self.prompt_ids = list(prompt)
         self.generated = []
@@ -199,6 +228,13 @@ class _RemoteLeg:
         self.on_token = on_token
         self.on_done = on_done
         self.migrate_hook = None
+        # takeover re-attachment (ISSUE 17): a fresh handle's poller
+        # replays the engine's stream history from seq 0 — the first
+        # ``skip`` tokens were already surfaced to the client by the
+        # deposed router (the ledger's persisted cursor), so they
+        # rebuild ``generated`` silently; only the unstreamed tail
+        # fires callbacks. Zero for normal submissions.
+        self._skip = int(skip)
 
     def _stream(self, tokens, fin):
         """Adopt one incremental token batch from the stream channel
@@ -207,7 +243,7 @@ class _RemoteLeg:
         cb = self.on_token
         for i, t in enumerate(tokens):
             self.generated.append(int(t))
-            if cb is not None:
+            if cb is not None and len(self.generated) > self._skip:
                 try:
                     cb(self, int(t), bool(fin) and i == len(tokens) - 1)
                 except Exception:
@@ -219,8 +255,9 @@ class _RemoteLeg:
         # the stream channel already surfaced self.generated[:start] —
         # replay ONLY the tail the stream has not delivered yet (zero
         # when streaming kept up; everything when the server predates
-        # the stream keys or the record raced ahead of the last batch)
-        start = len(self.generated)
+        # the stream keys or the record raced ahead of the last batch).
+        # A re-attached leg additionally skips its pre-takeover cursor.
+        start = max(len(self.generated), self._skip)
         self.generated = tokens
         self.queue_wait_s = float(rec.get("queue_wait_s", 0.0))
         self.evictions = int(rec.get("evictions", 0))
@@ -265,7 +302,7 @@ class RemoteEngineHandle:
 
     def __init__(self, store_factory, engine_id, job="fleet",
                  registry=None, role="any", poll_s=0.04,
-                 record_ttl=0.2):
+                 record_ttl=0.2, defer_poll=False):
         self.engine_id = str(engine_id)
         self.role = role
         self.job = job
@@ -286,7 +323,21 @@ class RemoteEngineHandle:
         self._thread = threading.Thread(target=self._poll_loop,
                                         daemon=True,
                                         name=f"fleet-remote-{engine_id}")
-        self._thread.start()
+        # ISSUE 17: a takeover must ``attach()`` every adopted rid
+        # BEFORE the poller replays the stream/out history — a poller
+        # racing ahead drops the early stream records (rid unknown yet)
+        # and the completion's tail replay then double-fires the rest.
+        # defer_poll=True holds the replay until start_polling().
+        if not defer_poll:
+            self._thread.start()
+
+    def start_polling(self):
+        """Start the deferred history replay (after takeover attach)."""
+        if not self._thread.is_alive():
+            try:
+                self._thread.start()
+            except RuntimeError:
+                pass   # already started once
 
     # ---- router handle surface -----------------------------------------
     def healthy(self):
@@ -318,9 +369,16 @@ class RemoteEngineHandle:
     def submit(self, leg):
         """Ship one router leg (a GenerationRequest OR a prebuilt
         _RemoteLeg-shaped object) to the engine process."""
-        rid = f"{self.engine_id}-{id(leg)}-{time.monotonic_ns()}"
+        # the wire rid is STABLE per leg object: a retry after a submit
+        # timeout re-enqueues the same rid, and the server's finished
+        # cache / inflight check dedupes it instead of generating twice
+        rid = getattr(leg, "_wire_rid", None)
+        if rid is None:
+            rid = f"{self.engine_id}-{id(leg)}-{time.monotonic_ns()}"
+            leg._wire_rid = rid
         remote = _RemoteLeg(rid, leg.prompt_ids,
                             on_token=leg.on_token, on_done=leg.on_done)
+        remote._wire_rid = rid
         remote._handle_id = self.engine_id
         fl = getattr(leg, "_fleet", None)
         remote._fleet = fl
@@ -342,6 +400,23 @@ class RemoteEngineHandle:
         seq = int(self._submit_store.add(f"{self._prefix}/in_seq", 1))
         self._submit_store.set(f"{self._prefix}/in/{seq}",
                                json.dumps(msg))
+        return remote
+
+    def attach(self, rid, prompt, on_token=None, on_done=None,
+               fleet=None, skip=0):
+        """Adopt an in-flight wire leg after a router takeover (ISSUE
+        17): register the DEPOSED router's wire rid with this handle so
+        the poller's history replay (stream from seq 0, then the
+        completion) rebuilds the token list — surfacing only tokens
+        beyond ``skip``, the ledger's persisted cursor. No store write:
+        the engine process never learns the router changed."""
+        remote = _RemoteLeg(rid, prompt, on_token=on_token,
+                            on_done=on_done, skip=skip)
+        remote._handle_id = self.engine_id
+        remote._fleet = fleet
+        remote._wire_rid = rid
+        with self._lock:
+            self._pending[rid] = remote
         return remote
 
     def abort(self, leg):
@@ -366,6 +441,12 @@ class RemoteEngineHandle:
 
     def start(self):
         pass  # the engine process runs its own serve loop
+
+    def detach(self):
+        """Stop this handle's poller WITHOUT stopping the engine
+        process: a deposed or retiring router must leave the fleet
+        running for whoever routes next (ISSUE 17)."""
+        self._stop.set()
 
     def close(self):
         self._stop.set()

@@ -55,6 +55,7 @@ from ..metrics import ServingMetrics
 from ..scheduler import (EngineClosed, EngineShuttingDown,
                          GenerationRequest, QueueFull)
 from . import disagg as _disagg
+from .ledger import TERMINAL_STATES, RouterDeposedError, rebuild_error
 
 __all__ = ["FleetRouter", "FleetRequest", "FleetSaturated",
            "LocalEngineHandle"]
@@ -79,8 +80,13 @@ class FleetRequest:
     """
 
     def __init__(self, prompt_ids, max_new_tokens=16, eos_token_id=None,
-                 temperature=0.0, top_k=None, on_token=None):
-        self.request_id = f"fleet-{next(_fid)}"
+                 temperature=0.0, top_k=None, on_token=None,
+                 request_id=None):
+        # client-supplied ids are the exactly-once idempotency key
+        # (ISSUE 17): the same id resubmitted reaches the same request
+        # through the ledger, never a second generation
+        self.request_id = str(request_id) if request_id is not None \
+            else f"fleet-{next(_fid)}"
         self.prompt_ids = [int(t) for t in prompt_ids]
         self.max_new_tokens = int(max_new_tokens)
         self.eos_token_id = eos_token_id
@@ -105,8 +111,12 @@ class FleetRequest:
         self._hedge = None         # duplicate leg racing a straggler
         # serializes token surfacing against hedge promotion: the splice
         # in _promote_hedge must not interleave with a primary leg's
-        # concurrent _leg_token append
+        # concurrent _leg_token append. ISSUE 17 also claims the
+        # in-flight migration target and the ledger cursor under it.
         self._tok_lock = threading.Lock()
+        self._migrating_to = None  # dst engine of an in-flight migration
+        self._ledger_cursor = 0    # tokens already journaled
+        self._ledger_done = False  # terminal record written
 
     # ---- engine-leg plumbing (router-internal) -------------------------
     def _attach(self, leg, engine_id):
@@ -177,6 +187,16 @@ class LocalEngineHandle:
 
     remote = False
 
+    def find_leg(self, rid):
+        """Locate a live engine-side request by its id (router shadow
+        takeover re-attach; local legs carry int GenerationRequest
+        ids). None when the leg already finished or never arrived."""
+        s = self.engine.scheduler
+        for req in list(s.active.values()) + list(s.waiting):
+            if str(req.request_id) == str(rid):
+                return req
+        return None
+
     def __init__(self, engine, engine_id, role="any"):
         self.engine = engine
         self.engine_id = str(engine_id)
@@ -226,7 +246,8 @@ class FleetRouter:
     MAX_AFFINITY = 4096
 
     def __init__(self, max_redispatch=3, registry=None,
-                 affinity_spill=4, hedge_after_s=None):
+                 affinity_spill=4, hedge_after_s=None, ledger=None,
+                 lease=None):
         self._handles = {}
         self._affinity = {}        # head key -> engine_id (LRU order)
         self._lock = threading.Lock()
@@ -242,6 +263,13 @@ class FleetRouter:
         self.hedge_after_s = None if hedge_after_s is None \
             else float(hedge_after_s)
         self.registry = registry
+        # durable front door (ISSUE 17): the ledger journals every
+        # request lifecycle through the replicated store; the lease
+        # fences this router against a shadow takeover. Both optional —
+        # a ledger-less router keeps the pre-17 volatile behavior.
+        self._ledger = ledger
+        self.lease = lease
+        self._fenced = False
         self.page_size = None
         self.cfg = None            # first engine's model config (loadgen)
         self._inflight = {}        # request_id -> FleetRequest (live)
@@ -258,6 +286,9 @@ class FleetRouter:
         self.hedges_won = 0
         self.aborts = 0
         self.prefetch_pages = 0
+        self.requests_replayed = 0   # terminal ids answered off the journal
+        self.requests_attached = 0   # in-flight ids attached to live legs
+        self.requests_adopted = 0    # takeover adoptions from the ledger
         # unlabeled fleet-level frontend: hedge/abort counters belong to
         # the DISPATCH tier, not to any one engine's labeled families
         self.metrics = ServingMetrics(prefix_enabled=False)
@@ -347,15 +378,31 @@ class FleetRouter:
     # ------------------------------------------------------------ submit
     def submit(self, prompt_ids, max_new_tokens=16, eos_token_id=None,
                temperature=0.0, top_k=None, on_token=None, block=True,
-               timeout=10.0, session=None, engine=None):
+               timeout=10.0, session=None, engine=None, request_id=None):
         """Same surface as ``ServingEngine.submit`` (so the Poisson
         loadgen drives a fleet unchanged), plus ``session=`` (explicit
-        affinity key) and ``engine=`` (pin to one engine id — tests and
-        the bench's cross-engine warm path). -> :class:`FleetRequest`."""
+        affinity key), ``engine=`` (pin to one engine id — tests and
+        the bench's cross-engine warm path) and ``request_id=`` (the
+        client's exactly-once idempotency key, ISSUE 17: a terminal id
+        replays the recorded result without touching an engine, an
+        in-flight id attaches to the live request). ->
+        :class:`FleetRequest`."""
+        self._check_lease()
+        if self._ledger is not None and request_id is not None:
+            fr = self._resubmit(str(request_id), on_token)
+            if fr is not None:
+                return fr
         fr = FleetRequest(prompt_ids, max_new_tokens=max_new_tokens,
                           eos_token_id=eos_token_id,
                           temperature=temperature, top_k=top_k,
-                          on_token=on_token)
+                          on_token=on_token, request_id=request_id)
+        if self._ledger is not None:
+            # journal admission BEFORE the first placement: the record
+            # is the idempotency anchor a retry (or a shadow) finds
+            try:
+                self._ledger.accept(fr)
+            except Exception:
+                pass
         deadline = time.perf_counter() + (float(timeout) if block else 0.0)
         first = True
         while True:
@@ -372,13 +419,261 @@ class FleetRouter:
                     f"({len(self._handles)} engine(s))")
             time.sleep(0.005)
 
+    # ------------------------------------- exactly-once ledger (ISSUE 17)
+    def _check_lease(self):
+        """Dispatch-path fence: a deposed router must stop dispatching.
+        Between beats (ttl/3 cadence) this is one monotonic compare —
+        the term re-read only happens on the beat itself."""
+        if self._fenced:
+            raise RouterDeposedError(
+                "router fenced: a shadow holds the front-door lease")
+        lease = self.lease
+        if lease is None:
+            return
+        try:
+            lease.beat()
+        except RouterDeposedError:
+            self.fence()
+            raise
+
+    def fence(self):
+        """Stop dispatching permanently (deposed). Front-door processes
+        map this to the named exit ``EXIT_DEPOSED`` (76) — the same
+        yield-don't-split-brain contract as a deposed coordinator."""
+        self._fenced = True
+
+    def _resubmit(self, rid, on_token):
+        """Idempotent resubmission: the same request id reaches the
+        same request. -> FleetRequest, or None (novel id: caller
+        dispatches fresh)."""
+        with self._lock:
+            live = self._inflight.get(rid)
+        if live is not None:
+            # attach to the live leg — the original stream keeps its
+            # on_token; a second callback would double-deliver tokens
+            self.requests_attached += 1
+            return live
+        rec = self._ledger.lookup(rid)
+        if rec is None:
+            return None
+        if rec.get("state") in TERMINAL_STATES:
+            return self._replay_terminal(rec, on_token)
+        # non-terminal record with no live request: this incarnation
+        # never saw it (shadow-takeover edge, or a saturated submit the
+        # client retried) — adopt it off the journal now
+        return self._adopt_record(rec, on_token=on_token)
+
+    def _replay_terminal(self, rec, on_token=None):
+        """Rebuild a finished request from its terminal record:
+        byte-identical tokens (or the same typed error), no engine
+        touched."""
+        fr = FleetRequest(rec["prompt"],
+                          max_new_tokens=rec.get("max_new_tokens", 16),
+                          eos_token_id=rec.get("eos_token_id"),
+                          temperature=rec.get("temperature", 0.0),
+                          top_k=rec.get("top_k"), on_token=on_token,
+                          request_id=rec["rid"])
+        toks = [int(t) for t in rec.get("tokens", [])]
+        err = rebuild_error(rec.get("error"))
+        fr.engine_id = rec.get("engine_id")
+        fr.engine_ids = list(rec.get("engine_ids") or [])
+        fr.queue_wait_s = float(rec.get("queue_wait_s", 0.0))
+        fr.evictions = int(rec.get("evictions", 0))
+        now = time.perf_counter()
+        fr.generated = list(toks)
+        fr.token_times = [now] * len(toks)
+        if toks:
+            fr.t_first_token = now
+        fr._ledger_cursor = len(toks)
+        fr._ledger_done = True    # the record IS the journal: no rewrite
+        if on_token is not None:
+            for i, t in enumerate(toks):
+                try:
+                    on_token(fr, int(t),
+                             err is None and i == len(toks) - 1)
+                except Exception:
+                    pass
+        fr._finish(err)
+        self.requests_replayed += 1
+        self.metrics.on_router_replay()
+        return fr
+
+    def _adopt_record(self, rec, on_token=None):
+        """Reconstruct one non-terminal ledger record into a live
+        request: re-attach to the engine-side leg when its engine
+        survived, else re-dispatch a continuation carrying the surfaced
+        tokens (greedy token-identical, the existing re-dispatch
+        contract)."""
+        rid = rec["rid"]
+        fr = FleetRequest(rec["prompt"],
+                          max_new_tokens=rec.get("max_new_tokens", 16),
+                          eos_token_id=rec.get("eos_token_id"),
+                          temperature=rec.get("temperature", 0.0),
+                          top_k=rec.get("top_k"), on_token=on_token,
+                          request_id=rid)
+        toks = [int(t) for t in rec.get("tokens", [])]
+        now = time.perf_counter()
+        # tokens[:cursor] were already surfaced to the client by the
+        # deposed router — pre-seed them so only the unstreamed tail
+        # re-fires callbacks (no duplicate tokens)
+        fr.generated = list(toks)
+        fr.token_times = [now] * len(toks)
+        if toks:
+            fr.t_first_token = now
+        fr.engine_ids = list(rec.get("engine_ids") or [])
+        fr._ledger_cursor = len(toks)
+        with self._lock:
+            already = self._inflight.get(rid)
+            if already is not None:
+                return already    # raced another adopter: theirs wins
+            self._inflight[rid] = fr
+        eid = rec.get("engine_id")
+        leg_rid = rec.get("leg_rid")
+        h = self._handles.get(eid) if eid is not None else None
+        attached = False
+        if rec.get("state") in ("dispatched", "streaming") \
+                and h is not None and leg_rid is not None:
+            try:
+                attached = self._reattach(fr, h, leg_rid,
+                                          skip=len(toks))
+            except Exception:
+                attached = False
+        if not attached:
+            # its engine died with the router (or the leg never
+            # landed): fresh continuation leg on a healthy engine
+            deadline = time.perf_counter() + 1.0
+            while not self._dispatch(fr):
+                if time.perf_counter() >= deadline:
+                    self._finish_fr(fr, FleetSaturated(
+                        "ledger adoption found no engine with queue "
+                        "space"))
+                    break
+                time.sleep(0.02)
+        self.requests_adopted += 1
+        return fr
+
+    def _reattach(self, fr, h, leg_rid, skip=0):
+        """Adopt the engine-side leg of a takeover-inherited request.
+        Remote: register the wire rid with the handle — its poller's
+        history replay rebuilds the token list, surfacing only tokens
+        beyond ``skip`` (the persisted cursor). Local: re-point the
+        live GenerationRequest's callbacks under the engine step lock
+        and replay the unstreamed tail. -> bool (attached)."""
+        try:
+            if not h.healthy():
+                return False
+        except Exception:
+            return False
+        if getattr(h, "remote", False):
+            leg = h.attach(leg_rid, fr.prompt_ids,
+                           on_token=fr._leg_token,
+                           on_done=self._on_leg_done, fleet=fr,
+                           skip=skip)
+            with self._lock:
+                leg._pending_done = False
+                h.pending += 1
+            fr._attach(leg, h.engine_id)
+            return True
+        eng = getattr(h, "engine", None)
+        if eng is None or not hasattr(h, "find_leg"):
+            return False
+        leg = h.find_leg(leg_rid)
+        if leg is None:
+            return False           # finished engine-side: re-dispatch
+        # _step_lock -> router lock is the established order (the
+        # migrate hook set it); holding it freezes emission while the
+        # callbacks swing over, so no token is lost or doubled
+        with eng._step_lock:
+            tail = [int(t) for t in leg.generated[skip:]]
+            leg.on_token = fr._leg_token
+            leg.on_done = self._on_leg_done
+            leg._fleet = fr
+            leg._handle_id = h.engine_id
+            with self._lock:
+                leg._pending_done = False
+                h.pending += 1
+            fr._attach(leg, h.engine_id)
+            for i, t in enumerate(tail):
+                fr._leg_token(leg, t, False)
+        return True
+
+    def adopt_from_ledger(self):
+        """Shadow takeover: reconstruct the front door from the journal
+        — every non-terminal record becomes a live request again,
+        re-attached to its engine's live leg (unstreamed tail replayed
+        off the persisted cursor) or re-dispatched when its engine died
+        too. The roster must already be added (from the
+        ``EngineRegistry``); affinity rebuilds lazily from traffic.
+        -> number of requests adopted."""
+        led = self._ledger
+        if led is None:
+            return 0
+        before = self.requests_adopted
+        for rec in led.inflight_records():
+            self._adopt_record(rec)
+        return self.requests_adopted - before
+
+    def ledger_sweep(self):
+        """Batch the surfaced-token cursors into the journal: ONE store
+        write per request that emitted tokens since the last sweep —
+        never per token, so the token path stays store-free between
+        lifecycle transitions. Rides ``hedge_sweep`` (the autoscaler
+        tick) or the front-door loop."""
+        led = self._ledger
+        if led is None:
+            return 0
+        with self._lock:
+            frs = list(self._inflight.values())
+        wrote = 0
+        for fr in frs:
+            if fr.done():
+                continue
+            with fr._tok_lock:
+                toks = [int(t) for t in fr.generated]
+            if len(toks) <= fr._ledger_cursor:
+                continue
+            leg = fr._leg
+            leg_rid = getattr(leg, "request_id", None) \
+                if leg is not None else None
+            try:
+                led.streaming(fr, toks, leg_rid=leg_rid)
+                fr._ledger_cursor = len(toks)
+                wrote += 1
+            except Exception:
+                pass
+        return wrote
+
+    def _ledger_dispatched(self, fr, engine_id, leg):
+        led = self._ledger
+        if led is None or fr._ledger_done:
+            return
+        try:
+            led.dispatched(fr, engine_id,
+                           leg_rid=getattr(leg, "request_id", None))
+        except Exception:
+            pass
+
+    def _finish_fr(self, fr, error=None):
+        """Every terminal path funnels here: finish the caller's
+        handle, journal the durable result-of-record, then untrack —
+        in that order, so a retry arriving mid-finish finds either the
+        live request or the terminal record, never neither."""
+        fr._finish(error)
+        led = self._ledger
+        if led is not None and not fr._ledger_done:
+            fr._ledger_done = True
+            try:
+                led.terminal(fr)
+            except Exception:
+                pass
+        self._untrack(fr)
+
     def _dispatch(self, fr, session=None, pin=None, exclude=()):
         """One placement attempt over the candidate order. -> bool."""
         prompt = fr.prompt_ids + fr.generated
         remaining = fr.max_new_tokens - len(fr.generated)
         if remaining <= 0:       # redispatch raced the last token
-            fr._finish(None)
-            self._untrack(fr)
+            self._finish_fr(fr)
             return True
         head = self._head_key(prompt, session)
         disagg = self._has_decode_pool()
@@ -421,6 +716,7 @@ class FleetRouter:
                         del self._affinity[next(iter(self._affinity))]
                 self.dispatched += 1
             fr._attach(leg, h.engine_id)
+            self._ledger_dispatched(fr, h.engine_id, leg)
             if prev_aff is not None and prev_aff != h.engine_id:
                 # affinity SPILL: the session's pages live on prev_aff —
                 # push the shared prefix here before the prefill runs
@@ -468,8 +764,7 @@ class FleetRouter:
             with self._lock:
                 hleg = fr._hedge
                 fr._hedge = None
-            fr._finish(None)
-            self._untrack(fr)
+            self._finish_fr(fr)
             if hleg is not None:
                 self._abort_leg(hleg)   # the duplicate lost the race
             return
@@ -489,8 +784,7 @@ class FleetRouter:
                                      QueueFull)) \
             or (handle is not None and not handle.healthy())
         if not retryable or fr.redispatches >= self.max_redispatch:
-            fr._finish(err)
-            self._untrack(fr)
+            self._finish_fr(fr, err)
             return
         fr.redispatches += 1
         self.redispatched += 1
@@ -502,9 +796,8 @@ class FleetRouter:
         deadline = time.perf_counter() + 1.0
         while not self._dispatch(fr, exclude=(fr.engine_id,)):
             if time.perf_counter() >= deadline:
-                fr._finish(FleetSaturated(
+                self._finish_fr(fr, FleetSaturated(
                     "re-dispatch found no engine with queue space"))
-                self._untrack(fr)
                 return
             time.sleep(0.02)
 
@@ -531,6 +824,9 @@ class FleetRouter:
                 continue
             if self._hedge(fr):
                 fired += 1
+        # the ledger's cursor batching rides the same tick: one store
+        # write per request that streamed since the last sweep
+        self.ledger_sweep()
         return fired
 
     def _hedge(self, fr):
@@ -538,10 +834,17 @@ class FleetRouter:
         with fr._tok_lock:
             base = len(fr.generated)
             cont = fr.prompt_ids + fr.generated
+            # a disaggregation migration in flight moves the leg to
+            # _migrating_to (set under this same lock): a hedge placed
+            # THERE would duplicate the leg on its own engine, and one
+            # keyed only on the stale pre-migration engine_id could do
+            # the same a tick later — exclude both
+            migrating_to = fr._migrating_to
         remaining = fr.max_new_tokens - base
         if remaining <= 0:
             return False
-        exclude = (fr.engine_id,) if fr.engine_id is not None else ()
+        exclude = tuple(e for e in (fr.engine_id, migrating_to)
+                        if e is not None)
         for h in self._candidates(stage="prefill", exclude=exclude):
             hleg = GenerationRequest(
                 cont, max_new_tokens=remaining,
@@ -618,8 +921,7 @@ class FleetRouter:
                     pass
         fr._attach(hleg, getattr(hleg, "_handle_id", fr.engine_id))
         fr._absorb(hleg)
-        fr._finish(None)
-        self._untrack(fr)
+        self._finish_fr(fr)
 
     def _abort_leg(self, leg):
         """Silently cancel a hedge loser: its slot + pages free, its
@@ -673,19 +975,33 @@ class FleetRouter:
         cands = [c for c in cands if c.role == "decode"
                  and getattr(c, "engine", None) is not None]
         for dst in cands:
-            try:
-                outcome = _disagg.migrate_request(src_engine, dst.engine,
-                                                  leg)
-            except _disagg.MigrationFailed:
-                continue  # a detached leg retries the next candidate
-            if outcome == "skipped":
-                return False
-            self._move_pending(leg, dst)
-            self.migrations += 1
             if fr is not None:
-                fr.migrations += 1
-                fr._attach(leg, dst.engine_id)
-            return True
+                # publish the target BEFORE the pages move (under the
+                # same lock the hedge path reads): a hedge fired during
+                # the migration must not land on dst — it would race
+                # the arriving leg on its own engine. Cleared only
+                # AFTER _attach repoints engine_id at dst, so the
+                # exclusion never gaps.
+                with fr._tok_lock:
+                    fr._migrating_to = dst.engine_id
+            try:
+                try:
+                    outcome = _disagg.migrate_request(
+                        src_engine, dst.engine, leg)
+                except _disagg.MigrationFailed:
+                    continue  # a detached leg retries the next candidate
+                if outcome == "skipped":
+                    return False
+                self._move_pending(leg, dst)
+                self.migrations += 1
+                if fr is not None:
+                    fr.migrations += 1
+                    fr._attach(leg, dst.engine_id)
+                return True
+            finally:
+                if fr is not None:
+                    with fr._tok_lock:
+                        fr._migrating_to = None
         if leg.state == "migrating":
             # every candidate refused AFTER a failed attempt detached
             # the leg from the source — it must not dangle in no
@@ -830,4 +1146,8 @@ class FleetRouter:
             "aborts": self.aborts,
             "prefetch_pages": self.prefetch_pages,
             "inflight": len(self._inflight),
+            "requests_replayed": self.requests_replayed,
+            "requests_attached": self.requests_attached,
+            "requests_adopted": self.requests_adopted,
+            "fenced": self._fenced,
         }

@@ -238,6 +238,24 @@ class ServingMetrics:
             return
         self._counter("serving_aborts_total").inc()
 
+    def on_router_replay(self):
+        """An exactly-once replay: a resubmitted terminal request id was
+        answered from the ledger's recorded result (ISSUE 17) — no
+        engine touched."""
+        reg = self._reg
+        if reg is None:
+            return
+        self._counter("serving_router_requests_replayed_total").inc()
+
+    def on_router_failover(self, seconds):
+        """A shadow router adopted the front door; ``seconds`` is the
+        takeover wall time (lease-stale detection through ledger
+        adoption)."""
+        reg = self._reg
+        if reg is None:
+            return
+        self._gauge("serving_router_failover_s").set(float(seconds))
+
     def on_scale_event(self, direction, n_engines):
         """The autoscaler changed the fleet size (``direction`` is
         "up" or "down"); the gauge tracks the resulting roster size."""

@@ -1,0 +1,422 @@
+"""Durable front door (ISSUE 17) — request ledger, exactly-once
+resubmission, router lease fencing, shadow takeover.
+
+Fast tier-1 coverage for ``paddle_tpu/serving/fleet/ledger.py`` and the
+router's exactly-once machinery. Engines are ``jit=False`` and manually
+stepped where determinism matters; the full primary/shadow PROCESS
+failover (SIGKILL mid-burst, client-invisible takeover) is ``@slow``.
+"""
+import socket
+import threading
+import time
+
+import pytest
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+    paddle.seed(7)
+    m = GPTForCausalLM(gpt_tiny())
+    m.eval()
+    return m
+
+
+def _engine(model, **kw):
+    from paddle_tpu.serving import ServingEngine
+    kw.setdefault("page_size", 4)
+    kw.setdefault("num_pages", 32)
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("attn_backend", "xla")
+    kw.setdefault("jit", False)
+    return ServingEngine(model, **kw)
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+# -------------------------------------------------------------- ledger
+
+def test_exactly_once_terminal_replay_and_inflight_attach(tiny_model):
+    """The exactly-once contract on one router: resubmitting a TERMINAL
+    request id replays the recorded result byte-identical WITHOUT
+    touching an engine; resubmitting an IN-FLIGHT id attaches to the
+    live request instead of double-generating."""
+    from paddle_tpu.distributed.tcp_store import TCPStore
+    from paddle_tpu.serving.fleet import FleetRouter, RequestLedger
+    port = _free_port()
+    master = TCPStore("127.0.0.1", port, is_master=True)
+    led = RequestLedger(TCPStore("127.0.0.1", port), job="t17a")
+    eng = _engine(tiny_model, engine_id="e0")
+    r = FleetRouter(ledger=led)
+    r.add_engine(eng, "e0")
+    fr = r.submit([5, 6, 7, 8], max_new_tokens=4, request_id="rq-1")
+    while not fr.done():
+        eng.step()
+    out = fr.result(10)
+    assert led.lookup("rq-1")["state"] == "done"
+    dispatched_before = r.dispatched
+
+    # terminal replay: tokens identical, engine untouched, on_token
+    # refires the full stream with fin on the last token only
+    stream = []
+    fr2 = r.submit([5, 6, 7, 8], max_new_tokens=4, request_id="rq-1",
+                   on_token=lambda q, t, fin: stream.append((t, fin)))
+    assert fr2.done() and fr2.result(1) == out
+    assert fr2 is not fr
+    assert [t for t, _ in stream] == out
+    assert [f for _, f in stream] == [False] * 3 + [True]
+    assert r.dispatched == dispatched_before      # no engine touched
+    assert r.requests_replayed == 1
+
+    # in-flight attach: same id -> the SAME live FleetRequest
+    fr3 = r.submit([9, 8, 7, 6], max_new_tokens=6, request_id="rq-2")
+    assert not fr3.done()
+    fr4 = r.submit([9, 8, 7, 6], max_new_tokens=6, request_id="rq-2")
+    assert fr4 is fr3
+    assert r.requests_attached == 1
+    while not fr3.done():
+        eng.step()
+    assert len(fr3.result(10)) == 6
+    eng.close()
+    del master
+
+
+def test_ledger_records_survive_store_failover(tiny_model):
+    """Ledger records ride the FailoverStore WAL (registry scope): after
+    the primary store dies mid-request, a ledger over the promoted
+    standby still holds every lifecycle record — and a router replaying
+    the terminal one returns byte-identical tokens (the PR 16
+    roster-survives-failover test, pointed at the request journal)."""
+    from paddle_tpu.distributed import FailoverStore, LogShipper
+    from paddle_tpu.distributed.tcp_store import TCPStore
+    from paddle_tpu.serving.fleet import FleetRouter, RequestLedger
+    from paddle_tpu.serving.fleet.router import FleetRequest
+    p1, p2 = _free_port(), _free_port()
+    prim = TCPStore("127.0.0.1", p1, is_master=True, timeout=15)
+    standby = TCPStore("127.0.0.1", p2, is_master=True, timeout=15)
+    fs = FailoverStore(f"127.0.0.1:{p1},127.0.0.1:{p2}", timeout=15,
+                       connect_deadline=2.0)
+    sh = LogShipper(f"127.0.0.1:{p1}", f"127.0.0.1:{p2}", timeout=15)
+    led = RequestLedger(fs, job="t17b")
+
+    done_fr = FleetRequest([1, 2, 3], max_new_tokens=3,
+                           request_id="done-1")
+    led.accept(done_fr)
+    done_fr.generated = [11, 12, 13]
+    done_fr.engine_id = "e0"
+    done_fr.engine_ids = ["e0"]
+    done_fr._finish(None)
+    led.terminal(done_fr)
+
+    live_fr = FleetRequest([4, 5, 6], max_new_tokens=4,
+                           request_id="live-1")
+    led.accept(live_fr)
+    live_fr.generated = [21, 22]
+    led.dispatched(live_fr, "e0", leg_rid="w-9")
+
+    assert sh.ship_once() > 0                # WAL pumped to the standby
+    prim.stop_server()                       # primary dies mid-request
+
+    led2 = RequestLedger(TCPStore("127.0.0.1", p2, timeout=15),
+                         job="t17b")
+    assert led2.rids() == ["done-1", "live-1"]
+    rec = led2.lookup("live-1")
+    assert rec["state"] == "dispatched" and rec["leg_rid"] == "w-9"
+    assert rec["tokens"] == [21, 22] and rec["cursor"] == 2
+    inflight = led2.inflight_records()
+    assert [x["rid"] for x in inflight] == ["live-1"]
+
+    # replay off the promoted store: byte-identical, engine-free
+    r = FleetRouter(ledger=led2)
+    fr = r.submit([1, 2, 3], max_new_tokens=3, request_id="done-1")
+    assert fr.done() and fr.result(1) == [11, 12, 13]
+    assert r.dispatched == 0
+    standby.stop_server()
+
+
+def test_router_lease_term_fence_deposes_old_router(tiny_model):
+    """The lease term is the fence: a shadow's ``adopt()`` bump makes
+    the deposed router's next renewal raise, its router fences itself,
+    and every later dispatch refuses — a revived primary cannot
+    split-brain the fleet."""
+    from paddle_tpu.distributed.tcp_store import TCPStore
+    from paddle_tpu.serving.fleet import (FleetRouter, RequestLedger,
+                                          RouterDeposedError,
+                                          RouterLease)
+    port = _free_port()
+    master = TCPStore("127.0.0.1", port, is_master=True)
+    led = RequestLedger(TCPStore("127.0.0.1", port), job="t17c")
+    lease = RouterLease(TCPStore("127.0.0.1", port), job="t17c",
+                        ttl=0.2)
+    assert lease.acquire() == 1
+    eng = _engine(tiny_model, engine_id="e0")
+    r = FleetRouter(ledger=led, lease=lease)
+    r.add_engine(eng, "e0")
+    fr = r.submit([5, 6, 7], max_new_tokens=2, request_id="pre")
+    while not fr.done():
+        eng.step()
+    assert len(fr.result(10)) == 2
+
+    shadow = RouterLease(TCPStore("127.0.0.1", port), job="t17c",
+                         ttl=0.2)
+    assert shadow.adopt() == 2               # the fence moves
+    time.sleep(0.1)                          # next beat is due
+    with pytest.raises(RouterDeposedError):
+        r.submit([5, 6, 7], max_new_tokens=2, request_id="post")
+    assert r.stats()["fenced"] is True
+    # fenced is sticky: even a would-be replay refuses
+    with pytest.raises(RouterDeposedError):
+        r.submit([5, 6, 7], max_new_tokens=2, request_id="pre")
+    eng.close()
+    del master
+
+
+def test_shadow_adopts_inflight_from_ledger_local(tiny_model):
+    """Shadow takeover over LOCAL engines: the old router journals a
+    mid-request cursor and dies (simulated: never stepped again); the
+    shadow adopts the ledger, re-attaches to the engine's live leg via
+    ``find_leg``, and the client-visible stream contains every token
+    exactly once — the pre-takeover cursor's tokens are NOT refired."""
+    from paddle_tpu.distributed.tcp_store import TCPStore
+    from paddle_tpu.serving.fleet import FleetRouter, RequestLedger
+    port = _free_port()
+    master = TCPStore("127.0.0.1", port, is_master=True)
+    eng = _engine(tiny_model, engine_id="e0")
+
+    solo = _engine(tiny_model, engine_id="solo")
+    base = solo.generate([7, 6, 5, 4], max_new_tokens=6)
+    solo.close()
+
+    led1 = RequestLedger(TCPStore("127.0.0.1", port), job="t17d")
+    r1 = FleetRouter(ledger=led1)
+    r1.add_engine(eng, "e0")
+    fr1 = r1.submit([7, 6, 5, 4], max_new_tokens=6, request_id="mid-1")
+    while len(fr1.generated) < 3:
+        eng.step()
+    r1.ledger_sweep()                        # journal the cursor
+    rec = led1.lookup("mid-1")
+    assert rec["state"] == "streaming" and rec["cursor"] >= 3
+    cursor = rec["cursor"]
+    # r1 "dies" here: never consulted again (its fr1 keeps streaming
+    # engine-side, which is exactly the live-leg state a real takeover
+    # inherits)
+
+    led2 = RequestLedger(TCPStore("127.0.0.1", port), job="t17d")
+    r2 = FleetRouter(ledger=led2)
+    r2.add_engine(eng, "e0")
+    tail = []
+    # resubmitting the in-flight id IS the adoption trigger here (the
+    # shadow's adopt_from_ledger walks the same _adopt_record path):
+    # the record pre-seeds the cursor's tokens, find_leg re-points the
+    # live engine-side leg, and only the tail fires the new callback
+    fr2 = r2.submit([7, 6, 5, 4], max_new_tokens=6, request_id="mid-1",
+                    on_token=lambda q, t, fin: tail.append(t))
+    assert r2.requests_adopted == 1
+    assert fr2 is not fr1
+    while not fr2.done():
+        eng.step()
+    out = fr2.result(10)
+    assert out == base                       # greedy token-identical
+    # the adopter's stream surfaced ONLY the unstreamed tail: no
+    # duplicate of the cursor's tokens, no lost token
+    assert tail == base[cursor:]
+    assert led2.lookup("mid-1")["state"] == "done"
+    eng.close()
+    del master
+
+
+def test_adopt_redispatches_when_engine_died_too(tiny_model):
+    """A ledger record whose engine died WITH the router re-dispatches
+    as a continuation on a healthy engine (carrying the journaled
+    tokens), preserving greedy parity end to end."""
+    from paddle_tpu.distributed.tcp_store import TCPStore
+    from paddle_tpu.serving.fleet import FleetRouter, RequestLedger
+    from paddle_tpu.serving.fleet.router import FleetRequest
+    port = _free_port()
+    master = TCPStore("127.0.0.1", port, is_master=True)
+    solo = _engine(tiny_model, engine_id="solo")
+    base = solo.generate([3, 1, 4, 1], max_new_tokens=6)
+    solo.close()
+
+    led = RequestLedger(TCPStore("127.0.0.1", port), job="t17e")
+    # journal a mid-request record pointing at an engine that no longer
+    # exists ("gone"): the adopter must re-dispatch, not re-attach
+    ghost = FleetRequest([3, 1, 4, 1], max_new_tokens=6,
+                         request_id="orphan-1")
+    led.accept(ghost)
+    ghost.generated = list(base[:2])
+    led.dispatched(ghost, "gone", leg_rid="w-dead")
+
+    eng = _engine(tiny_model, engine_id="e0")
+    r = FleetRouter(ledger=led)
+    r.add_engine(eng, "e0")
+    assert r.adopt_from_ledger() == 1
+    fr = r.submit([3, 1, 4, 1], max_new_tokens=6, request_id="orphan-1")
+    while not fr.done():
+        eng.step()
+    assert fr.result(10) == base
+    assert fr.engine_ids[-1] == "e0"
+    eng.close()
+    del master
+
+
+def test_remote_reattach_defers_poll_until_attached(tiny_model):
+    """Regression: a takeover handle's poller must not replay the
+    store-RPC history before ``attach()`` registers the adopted rids —
+    records consumed early are dropped (rid unknown), and the
+    completion's tail replay then double-fires later tokens. With
+    ``defer_poll`` the shadow attaches first, then replays: the full
+    stream surfaces exactly once, byte-identical."""
+    import threading
+    from paddle_tpu.distributed import keyspace
+    from paddle_tpu.distributed.tcp_store import TCPStore
+    from paddle_tpu.serving.fleet import (FleetRouter, RemoteEngineHandle,
+                                          RequestLedger, serve_over_store)
+    port = _free_port()
+    master = TCPStore("127.0.0.1", port, is_master=True)
+    eng = _engine(tiny_model, engine_id="e0", max_queue=8)
+    base = eng.generate([6, 5, 4, 3], max_new_tokens=6)
+    t = threading.Thread(target=serve_over_store,
+                         args=(eng, TCPStore("127.0.0.1", port), "e0"),
+                         kwargs={"job": "t17g", "poll_s": 0.01},
+                         daemon=True)
+    t.start()           # engine NOT stepping yet: admissions only queue
+    led1 = RequestLedger(TCPStore("127.0.0.1", port), job="t17g")
+    h1 = RemoteEngineHandle(lambda: TCPStore("127.0.0.1", port), "e0",
+                            job="t17g", poll_s=0.01)
+    r1 = FleetRouter(ledger=led1)
+    r1.add_engine(None, handle=h1)
+    r1.page_size = 4
+    fr1 = r1.submit([6, 5, 4, 3], max_new_tokens=6, request_id="ra-1")
+    deadline = time.time() + 30
+    while not eng.scheduler.has_work() and time.time() < deadline:
+        time.sleep(0.01)
+    assert eng.scheduler.has_work()
+    # router 1 "dies" here with only the DISPATCH record journaled
+    # (cursor 0, no sweep ran): its poller goes silent like a SIGKILL
+    h1.detach()
+    rec = led1.lookup("ra-1")
+    assert rec["state"] == "dispatched" and rec["cursor"] == 0
+    # the engine now generates and publishes the ENTIRE history
+    # (stream batches + completion) with no router listening
+    eng.start()
+    rp = keyspace.fleet_engine_rpc("t17g", "e0")
+    deadline = time.time() + 60
+    while int(master.add(f"{rp}/out_seq", 0)) < 1 \
+            and time.time() < deadline:
+        time.sleep(0.01)
+    sp = keyspace.fleet_engine_stream("t17g", "e0")
+    assert int(master.add(f"{rp}/out_seq", 0)) >= 1
+    assert int(master.add(f"{sp}/tok_seq", 0)) >= 1
+    # shadow: fresh deferred handle, attach via adoption, THEN replay
+    led2 = RequestLedger(TCPStore("127.0.0.1", port), job="t17g")
+    h2 = RemoteEngineHandle(lambda: TCPStore("127.0.0.1", port), "e0",
+                            job="t17g", poll_s=0.01, defer_poll=True)
+    r2 = FleetRouter(ledger=led2)
+    r2.add_engine(None, handle=h2)
+    r2.page_size = 4
+    assert r2.adopt_from_ledger() == 1
+    h2.start_polling()
+    fr2 = r2.submit([6, 5, 4, 3], max_new_tokens=6, request_id="ra-1")
+    assert fr2.result(60) == base        # exactly once, byte-identical
+    assert led2.lookup("ra-1")["state"] == "done"
+    master.set(f"{keyspace.fleet_registry('t17g')}/stop", b"1")
+    t.join(10)
+    h2.detach()
+    eng.close()
+    del master
+
+
+# ------------------------------------------------- full process failover
+
+@pytest.mark.slow
+def test_router_process_failover_exactly_once(tiny_model):
+    """Chaos acceptance in miniature: a PRIMARY front-door process armed
+    with ``router_die@route`` SIGKILLs itself mid-burst; the SHADOW
+    process adopts the lease + ledger and every request completes
+    exactly once — zero client-visible errors, streams equal to the
+    unchaosed baselines, the ``ROUTER_DIE``/``ROUTER_ADOPTED`` markers
+    present, and the primary's exit is the injected SIGKILL."""
+    import os
+    import signal
+    import subprocess
+    import sys as _sys
+    from paddle_tpu.distributed import keyspace
+    from paddle_tpu.distributed.tcp_store import TCPStore
+    from paddle_tpu.serving.fleet import (EngineRegistry, RouterClient,
+                                          serve_over_store)
+    port = _free_port()
+    master = TCPStore("127.0.0.1", port, is_master=True)
+    eng = _engine(tiny_model, engine_id="e0", max_queue=16)
+    prompts = [[5, 6, 7, 8], [9, 8, 7, 6], [1, 2, 3, 4], [4, 4, 2, 2]]
+    base = [eng.generate(p, max_new_tokens=6) for p in prompts]
+    eng.start()
+    registry = EngineRegistry(TCPStore("127.0.0.1", port), job="t17f",
+                              ttl=5.0)
+    registry.register("e0", engine=eng, role="any")
+    t = threading.Thread(target=serve_over_store,
+                         args=(eng, TCPStore("127.0.0.1", port), "e0"),
+                         kwargs={"job": "t17f", "poll_s": 0.01},
+                         daemon=True)
+    t.start()
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith("PADDLE_TPU_")}
+    env.update({"JAX_PLATFORMS": "cpu",
+                "PYTHONPATH": os.pathsep.join(
+                    [repo] + [p for p in os.environ.get(
+                        "PYTHONPATH", "").split(os.pathsep) if p])})
+    penv = dict(env)
+    penv["PADDLE_TPU_FAULTS"] = "router_die@route:2"
+    cmd = [_sys.executable, "-m", "paddle_tpu.serving.fleet.frontdoor",
+           "--store", f"127.0.0.1:{port}", "--job", "t17f",
+           "--engines", "e0", "--ttl", "0.5"]
+    primary = subprocess.Popen(cmd + ["--role", "primary"], env=penv,
+                               stdout=subprocess.PIPE,
+                               stderr=subprocess.STDOUT, text=True)
+    shadow = subprocess.Popen(cmd + ["--role", "shadow",
+                                     "--grace", "1.5"], env=env,
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, text=True)
+    try:
+        from paddle_tpu.serving.fleet import RouterLease
+        client = RouterClient(TCPStore("127.0.0.1", port), job="t17f",
+                              resubmit_after=2.0)
+        watch = RouterLease(TCPStore("127.0.0.1", port), job="t17f")
+        deadline = time.time() + 120
+        while watch.read() is None:  # wait for the primary's lease
+            assert time.time() < deadline, "primary never leased"
+            time.sleep(0.2)
+        streams = [[] for _ in prompts]
+        for i, p in enumerate(prompts):
+            client.submit(f"rq-{i}", p, max_new_tokens=6)
+        results = [client.result(f"rq-{i}", timeout=120.0,
+                                 on_token=lambda tok, fin, s=streams[i]:
+                                 s.append(tok))
+                   for i in range(len(prompts))]
+        assert results == base                   # greedy parity, all 4
+        assert streams == base                   # exactly once, no dups
+        primary.wait(30)
+        assert primary.returncode == -signal.SIGKILL
+        pout = primary.stdout.read()
+        assert "ROUTER_DIE" in pout and "ROUTER_PRIMARY" in pout
+        # stop the shadow and confirm it adopted
+        master.set(f"{keyspace.fleet_router('t17f')}/stop", b"1")
+        sout, _ = shadow.communicate(timeout=60)
+        assert shadow.returncode == 0
+        assert "ROUTER_ADOPTED" in sout
+    finally:
+        for pr in (primary, shadow):
+            if pr.poll() is None:
+                pr.kill()
+        master.set(f"{keyspace.fleet_registry('t17f')}/stop", b"1")
+        t.join(10)
+        registry.close()
+        eng.close()
+        del master

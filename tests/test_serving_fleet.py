@@ -889,6 +889,116 @@ def test_remote_streaming_and_abort_over_store(tiny_model):
     del master
 
 
+# ----------------------------------- exactly-once dedupe (ISSUE 17)
+
+def test_remote_submit_retry_dedupes_by_rid(tiny_model):
+    """Regression (ISSUE 17 satellite): a store-RPC client whose submit
+    write landed but whose ack timed out retries the SAME wire rid —
+    before, the retry record spawned a second GenerationRequest and the
+    engine generated twice. The server now dedupes by rid in BOTH
+    windows: a duplicate of a LIVE request is ignored (one engine-side
+    leg), and a duplicate of a FINISHED one republishes the recorded
+    result without touching the engine."""
+    import json
+    import threading
+    from paddle_tpu.distributed import keyspace
+    from paddle_tpu.distributed.tcp_store import TCPStore
+    from paddle_tpu.serving.fleet import (FleetRouter, RemoteEngineHandle,
+                                          serve_over_store)
+    port = _free_port()
+    master = TCPStore("127.0.0.1", port, is_master=True)
+    eng = _engine(tiny_model, engine_id="e0", max_queue=8)
+    t = threading.Thread(target=serve_over_store,
+                         args=(eng, TCPStore("127.0.0.1", port), "e0"),
+                         kwargs={"job": "t10", "poll_s": 0.01},
+                         daemon=True)
+    t.start()           # engine NOT started yet: admissions only queue
+    handle = RemoteEngineHandle(lambda: TCPStore("127.0.0.1", port),
+                                "e0", job="t10", poll_s=0.01)
+    r = FleetRouter()
+    r.add_engine(None, handle=handle)
+    r.page_size = 4
+    stream = []
+    fr = r.submit([5, 6, 7, 8], max_new_tokens=4,
+                  on_token=lambda q, tok, fin: stream.append(tok))
+    rid = fr._leg._wire_rid
+    deadline = time.time() + 30
+    while not eng.scheduler.has_work() and time.time() < deadline:
+        time.sleep(0.01)
+    assert eng.scheduler.has_work()        # admitted engine-side
+    # the client's timeout-retry, at the wire: the SAME submission
+    # record enqueued a second time while the request is live
+    rp = keyspace.fleet_engine_rpc("t10", "e0")
+    dup = json.dumps({"rid": rid, "prompt": [5, 6, 7, 8],
+                      "max_new_tokens": 4, "eos_token_id": None,
+                      "temperature": 0.0, "top_k": None})
+    seq = int(master.add(f"{rp}/in_seq", 1))
+    master.set(f"{rp}/in/{seq}", dup)
+    # a probe BEHIND the duplicate proves the server consumed it: the
+    # wire log is processed in order, so once the probe is queued the
+    # dup has already been seen (and ignored — queue depth 2, not 3)
+    probe = r.submit([9, 8, 7, 6], max_new_tokens=2)
+    deadline = time.time() + 30
+    while eng.scheduler.queue_depth() < 2 and time.time() < deadline:
+        time.sleep(0.01)
+    assert eng.scheduler.queue_depth() == 2    # dup spawned no leg
+    eng.start()
+    out = fr.result(60)
+    assert len(out) == 4 and stream == out     # no doubled tokens
+    assert len(probe.result(60)) == 2
+    nout = int(master.add(f"{rp}/out_seq", 0))
+    recs = [json.loads(master.get(f"{rp}/out/{i}", timeout=10))
+            for i in range(1, nout + 1)]
+    assert len([x for x in recs if x["rid"] == rid]) == 1  # one result
+    # retry AFTER terminal (the torn-ack window): republished from the
+    # finished cache, byte-identical, and the engine never sees it
+    seq = int(master.add(f"{rp}/in_seq", 1))
+    master.set(f"{rp}/in/{seq}", dup)
+    deadline = time.time() + 30
+    while int(master.add(f"{rp}/out_seq", 0)) == nout \
+            and time.time() < deadline:
+        time.sleep(0.01)
+    recs = [json.loads(master.get(f"{rp}/out/{i}", timeout=10))
+            for i in range(1, int(master.add(f"{rp}/out_seq", 0)) + 1)]
+    mine = [x for x in recs if x["rid"] == rid]
+    assert len(mine) == 2                      # the replayed record
+    assert mine[0]["tokens"] == mine[1]["tokens"] == out
+    assert not eng.scheduler.has_work()        # never regenerated
+    master.set(f"{keyspace.fleet_registry('t10')}/stop", b"1")
+    t.join(10)
+    handle.close()
+    eng.close()
+    del master
+
+
+def test_hedge_excludes_inflight_migration_target(tiny_model):
+    """Regression (ISSUE 17 satellite): a hedge firing DURING a disagg
+    migration used to read only the stale pre-migration ``engine_id``
+    for its exclusion — the duplicate could land on the migration
+    TARGET and race the arriving leg on its own engine. The hedge now
+    takes the in-flight target under ``_tok_lock`` before leg
+    selection and excludes both ends of the move."""
+    from paddle_tpu.serving.fleet import FleetRouter
+    e0 = _engine(tiny_model, engine_id="e0")
+    e1 = _engine(tiny_model, engine_id="e1")
+    r = FleetRouter(hedge_after_s=0.01)
+    r.add_engine(e0, "e0")
+    r.add_engine(e1, "e1")
+    fr = r.submit([1, 2, 3, 4, 5], max_new_tokens=4, engine="e0")
+    with fr._tok_lock:
+        fr._migrating_to = "e1"    # mid-migration snapshot: e0 -> e1
+    assert r._hedge(fr) is False   # both ends excluded: nowhere legal
+    assert r.hedges_fired == 0 and fr._hedge is None
+    with fr._tok_lock:
+        fr._migrating_to = None    # move done: cleared after _attach
+    assert r._hedge(fr) is True    # no migration in flight: e1 is fair
+    assert fr._hedge is not None
+    assert fr._hedge._handle_id == "e1"
+    assert r.hedges_fired == 1
+    e0.close()
+    e1.close()
+
+
 # ------------------------------------------------------------------- slow
 
 @pytest.mark.slow

@@ -1145,6 +1145,160 @@ def run_controlplane_chaos():
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _run_guarded_legs(sub, legs):
+    """Run bench legs in order, merging each leg's rows into ``sub`` the
+    moment they exist: a later leg that raises records
+    ``<name>_error``/``<name>_leg_ok`` and keeps every prior leg's JSON
+    on the wire — the guard all the chaos/serving legs follow (asserted
+    by a unit test so new legs can't regress it). A leg can also report
+    a soft failure by returning ``<name>_ok: False`` among its rows.
+    Returns overall ok."""
+    ok = True
+    for name, fn in legs:
+        try:
+            rows = fn()
+            sub.update(rows)
+            if not rows.get(f"{name}_ok", True):
+                ok = False
+        except Exception as e:
+            sub.update({f"{name}_error": repr(e)[-300:],
+                        f"{name}_leg_ok": False})
+            ok = False
+    return ok
+
+
+def run_linalg_bench(n=512, block=64, p=16, world=2):
+    """``--linalg`` perf + parity leg: SUMMA sharded matmul on a
+    thread-per-rank world over a shared LocalExchange (the chaos twin
+    runs the same kernels under the real launcher) — wall-clock GFLOP/s
+    and the f64 relative residual against the numpy reference, the same
+    bound the in-run oracle gates on."""
+    import threading as _t
+
+    from paddle_tpu.distributed import dlinalg
+
+    rng = np.random.default_rng(7)
+    A_full = rng.standard_normal((n, n))
+    B_full = rng.standard_normal((n, p))
+    ex = dlinalg.LocalExchange()
+    results = [None] * world
+    errors = []
+
+    def target(r):
+        try:
+            A = dlinalg.ShardedMatrix.from_global(A_full, block,
+                                                  world=world, rank=r)
+            B = dlinalg.ShardedMatrix.from_global(B_full, block,
+                                                  world=world, rank=r)
+            results[r] = dlinalg.summa_matmul(A, B, ex, tag="bench")
+        except BaseException as e:  # noqa: BLE001 - re-raised below
+            errors.append(e)
+
+    threads = [_t.Thread(target=target, args=(r,), daemon=True)
+               for r in range(world)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(300)
+    wall = time.perf_counter() - t0
+    if errors:
+        raise errors[0]
+    if any(t.is_alive() for t in threads):
+        raise RuntimeError("linalg bench SPMD thread hung")
+    ref = dlinalg.matmul_reference(A_full, B_full)
+    C = np.zeros_like(ref)
+    for r in range(world):
+        for b in results[r].owned:
+            lo, hi = results[r].layout.row_range(b)
+            C[lo:hi] = results[r].block(b)
+    resid = float(np.linalg.norm(C - ref) / np.linalg.norm(ref))
+    # each rank runs every round, so the fleet's useful flops are the
+    # single product's 2*n*n*p — wall time already pays the duplication
+    gflops = 2.0 * n * n * p / wall / 1e9
+    _log(f"[bench] linalg: {gflops:.2f} GFLOP/s (world {world}), "
+         f"residual {resid:.2e}")
+    return {"linalg_gflops": round(gflops, 2),
+            "linalg_residual": resid,
+            "linalg_ok": resid < 1e-12}
+
+
+def run_linalg_chaos():
+    """``--linalg`` chaos twin: SIGKILL one of three elastic workers
+    mid-factorization (the dlinalg eigensolve under the real launcher);
+    the world-2 incarnation must reshard + resume from the last
+    committed panel with zero relaunch budget consumed and the residual
+    oracle must still pass. Records the kill -> first-resumed-panel
+    recovery time."""
+    import re
+    import shutil
+    import subprocess
+    import tempfile
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    workers_dir = os.path.join(repo, "tests", "workers")
+    if workers_dir not in sys.path:
+        sys.path.insert(0, workers_dir)
+    from ft_markers import free_port as _free_port
+    from ft_markers import read_worker_logs
+    worker = os.path.join(workers_dir, "dlinalg_worker.py")
+    tmp = tempfile.mkdtemp(prefix="pd_linalg_")
+    log_dir = os.path.join(tmp, "logs")
+    env = _chaos_child_env(repo)
+    env.update({
+        "PADDLE_TPU_CKPT_DIR": os.path.join(tmp, "ck"),
+        "PADDLE_TPU_FT_STORE_PORT": str(_free_port()),
+        "PADDLE_TPU_DLA_N": "96", "PADDLE_TPU_DLA_P": "4",
+        "PADDLE_TPU_DLA_BLOCK": "16",
+        "PADDLE_TPU_DLA_SLEEP_S": "0.05",
+        "PADDLE_TPU_DLA_KILL": "2:9",  # rank 2, mid-sweep-1
+    })
+    try:
+        r = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--np", "2:3", "--master", f"127.0.0.1:{_free_port()}",
+             "--elastic_port", str(_free_port()),
+             "--max_restarts", "0",   # a scale event must be FREE
+             "--terminate_grace", "5", "--log_dir", log_dir, worker],
+            env=env, capture_output=True, text=True, timeout=600, cwd=repo)
+        scaled = ("scale event" in r.stderr
+                  and "relaunching at world_size=2" in r.stderr)
+        kill = re.search(r"SELF_SIGKILL ([\d.]+)",
+                         read_worker_logs(log_dir, 2))
+        resumed = 0
+        first_panel = []
+        resid = None
+        for rank in (0, 1):
+            log = read_worker_logs(log_dir, rank)
+            round1 = log.split("WORLD 2", 1)
+            if len(round1) == 2:
+                if re.search(r"RESUMED step=\d+", round1[1]):
+                    resumed += 1
+                m = re.search(r"PANEL \d+ \d+ ([\d.]+)", round1[1])
+                if m:
+                    first_panel.append(float(m.group(1)))
+                d = re.search(r"DONE \d+ ([\d.eE+-]+)", round1[1])
+                if d:
+                    resid = float(d.group(1))
+        ok = (r.returncode == 0 and scaled and resumed == 2
+              and kill is not None and len(first_panel) == 2
+              and resid is not None and resid < 1e-6)
+        out = {"linalg_chaos_ok": ok}
+        if kill and first_panel:
+            out["linalg_recovery_s"] = round(
+                min(first_panel) - float(kill.group(1)), 3)
+        if resid is not None:
+            out["linalg_chaos_residual"] = resid
+        if not ok:
+            out["linalg_chaos_error"] = (
+                "rc=%d scaled=%s resumed=%d/2 resid=%s: %s" % (
+                    r.returncode, scaled, resumed, resid,
+                    r.stderr[-300:]))
+        return out
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def run_serving_bench(n_requests=None, qps=None):
     """``--serving`` leg: the continuous-batching engine under a Poisson
     OPEN-loop load (arrivals don't wait for the engine — tail latency is
@@ -2352,6 +2506,26 @@ def main_serving():
     return 0 if ok else 1
 
 
+def main_linalg():
+    """``--linalg``: distributed linear algebra rows (ISSUE 18) — the
+    in-process SUMMA perf/parity leg plus the elastic-SIGKILL chaos
+    twin, merged into the snapshot NEXT TO every legacy key."""
+    sub = {}
+    ok = _run_guarded_legs(sub, [("linalg", run_linalg_bench),
+                                 ("linalg_chaos", run_linalg_chaos)])
+    snap = _load_snapshot()
+    merged = snap.setdefault("submetrics", {})
+    merged.update(sub)
+    snap.setdefault("metric", "gpt_train_step_mfu")
+    snap.setdefault("value", 0.0)
+    snap.setdefault("unit", "%")
+    snap.setdefault("vs_baseline", 0.0)
+    if "TPU" in str(jax.devices()[0].device_kind):
+        _save_snapshot(snap)  # legacy rule: persist real-chip rows only
+    print(json.dumps(snap))
+    return 0 if ok else 1
+
+
 def main_chaos():
     sub = run_chaos_smoke()
     try:
@@ -2394,6 +2568,8 @@ def main():
         sys.exit(main_serving_fleet())
     if "--serving" in sys.argv:
         sys.exit(main_serving())
+    if "--linalg" in sys.argv:
+        sys.exit(main_linalg())
     if "--chaos" in sys.argv:
         sys.exit(main_chaos())
     # telemetry registry as the single source of truth for the rows that

@@ -22,10 +22,11 @@ from .parallel import DataParallel, shard_batch  # noqa: F401
 from . import fault  # noqa: F401
 from .fault import (  # noqa: F401
     Backoff, CheckpointLineage, EXIT_DESYNC, EXIT_FAULT, EXIT_HANG,
-    EXIT_PREEMPT, EXIT_WATCHDOG, describe_exit, exit_preempted,
-    install_preemption_handler, maybe_inject, preempted, retry,
-    set_fault_spec,
+    EXIT_ORACLE, EXIT_PREEMPT, EXIT_WATCHDOG, describe_exit,
+    exit_preempted, install_preemption_handler, maybe_inject, preempted,
+    preemption_scope, retry, set_fault_spec,
 )
+from . import dlinalg  # noqa: F401
 from . import flight_recorder  # noqa: F401
 from .flight_recorder import (  # noqa: F401
     CollectiveDesyncError, FlightRecorder,

@@ -67,13 +67,15 @@ import time
 
 __all__ = [
     "EXIT_FAULT", "EXIT_PREEMPT", "EXIT_WATCHDOG", "EXIT_HANG",
-    "EXIT_DESYNC", "EXIT_USAGE", "EXIT_DEPOSED", "EXIT_CAUSES",
+    "EXIT_DESYNC", "EXIT_USAGE", "EXIT_DEPOSED", "EXIT_ORACLE",
+    "EXIT_CAUSES",
     "describe_exit",
     "FaultEntry",
     "parse_fault_spec", "set_fault_spec", "maybe_inject", "fault_rank",
     "Backoff", "retry", "atomic_write", "atomic_write_bytes",
     "CheckpointLineage",
     "install_preemption_handler", "preempted", "exit_preempted",
+    "preemption_scope",
 ]
 
 EXIT_FAULT = 43      # injected crash — a real failure, consumes a restart
@@ -88,6 +90,9 @@ EXIT_USAGE = 64      # launcher flag combination rejected (EX_USAGE) —
 EXIT_DEPOSED = 76    # control-plane coordinator deposed (EX_PROTOCOL):
                      # a shadow took over the lease term; this instance
                      # yielded instead of split-braining the round
+EXIT_ORACLE = 47     # numerical-correctness oracle violated (dlinalg
+                     # residual/orthogonality gate): the answer is WRONG,
+                     # not just late — never auto-resumed, a human looks
 
 # The one copy of the worker exit-code -> human cause mapping (launcher
 # failure summaries, tests). Negative codes are death-by-signal and are
@@ -106,6 +111,8 @@ EXIT_CAUSES = {
                 "(see the hint printed above it)",
     EXIT_DEPOSED: "coordinator deposed — a shadow coordinator took over "
                   "the lease term; this instance yielded (writes fenced)",
+    EXIT_ORACLE: "numerical oracle violated — a dlinalg residual/"
+                 "orthogonality gate failed (silent corruption made loud)",
 }
 
 
@@ -126,7 +133,8 @@ _KINDS = ("crash", "hang", "torn_write", "store_drop", "slow_io",
           "node_die", "agent_stall", "store_die",
           "coordinator_die", "wal_torn",
           "engine_die", "engine_stall",
-          "router_die", "router_stall")
+          "router_die", "router_stall",
+          "panel_corrupt", "sweep_stall")
 # a site-less (wildcard) cooperative entry only fires at sites whose
 # callers honor the returned kind — anywhere else it would burn its
 # trigger silently; crash/hang/slow_io/commit_stall wildcards fire at
@@ -187,7 +195,19 @@ _WILDCARD_SITES = {"store_drop": ("store",), "torn_write": ("ckpt",),
                    # revived primary must hit the term fence, exiting
                    # EXIT_DEPOSED instead of split-brain dispatching).
                    "router_die": ("route",),
-                   "router_stall": ("route",)}
+                   "router_stall": ("route",),
+                   # distributed linear algebra (ISSUE 18):
+                   # ``panel_corrupt`` is cooperative at the dlinalg
+                   # panel site — the sweep driver enacts a bit-flip on
+                   # the panel it just computed (modelling silent memory
+                   # corruption after a fault), which the per-step
+                   # residual oracle must turn into a loud
+                   # OracleViolation / EXIT_ORACLE instead of a wrong
+                   # answer; ``sweep_stall`` executes a sleep at the
+                   # sweep boundary (the straggler-solver case the
+                   # launcher's terminate-grace path must cover).
+                   "panel_corrupt": ("linalg_panel",),
+                   "sweep_stall": ("linalg_sweep",)}
 
 _lock = threading.Lock()
 _entries: list | None = None  # parsed spec; None = not yet loaded from env
@@ -369,6 +389,9 @@ def maybe_inject(site: str):
         elif e.kind == "router_stall":
             time.sleep(float(os.environ.get(
                 "PADDLE_TPU_FAULT_ROUTER_STALL_S", "30.0")))
+        elif e.kind == "sweep_stall":
+            time.sleep(float(os.environ.get(
+                "PADDLE_TPU_FAULT_SWEEP_STALL_S", "30.0")))
         else:
             result = e.kind
     return result
@@ -521,6 +544,63 @@ def exit_preempted(save_fn=None):
     sys.stdout.flush()
     sys.stderr.flush()
     sys.exit(EXIT_PREEMPT)
+
+
+class preemption_scope:
+    """Scoped SIGTERM→drain→exit-75 watcher for non-hapi drivers.
+
+    ``Model.fit`` and ``ServingEngine`` each hand-wire
+    :func:`install_preemption_handler`; any other long-running driver
+    (the dlinalg sweep driver, future workloads) wants the same contract
+    without owning process-global signal state. This context manager
+    installs the handler on entry and restores the PREVIOUS SIGTERM
+    disposition, callback and flag state on exit, so scopes nest and a
+    library driver never clobbers its host application's handler.
+
+    With ``on_preempt`` the handler saves-and-exits from the signal
+    frame (callback mode — pass a function that snapshots only
+    already-committed state). Without it, poll :meth:`preempted` at
+    panel/step boundaries and call :meth:`exit` to save and leave with
+    ``EXIT_PREEMPT``.
+    """
+
+    def __init__(self, on_preempt=None):
+        self._on_preempt = on_preempt
+        self._prev_handler = None
+        self._prev_cb = None
+        self._was_set = False
+        self.installed = False
+
+    def __enter__(self):
+        global _preempt_cb
+        self._prev_cb = _preempt_cb
+        self._was_set = _preempt_event.is_set()
+        try:
+            self._prev_handler = signal.getsignal(signal.SIGTERM)
+        except (ValueError, OSError):
+            self._prev_handler = None
+        self.installed = install_preemption_handler(self._on_preempt)
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        global _preempt_cb
+        _preempt_cb = self._prev_cb
+        if not self._was_set:
+            _preempt_event.clear()
+        if self.installed and self._prev_handler is not None:
+            try:
+                signal.signal(signal.SIGTERM, self._prev_handler)
+            except (ValueError, OSError):
+                pass
+        return False
+
+    @staticmethod
+    def preempted() -> bool:
+        return preempted()
+
+    @staticmethod
+    def exit(save_fn=None):
+        exit_preempted(save_fn)
 
 
 # ------------------------------------------------------ checkpoint lineage

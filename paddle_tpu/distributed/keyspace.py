@@ -24,7 +24,11 @@ Families:
 * ``serving/<job>/...``  — serving fleet engine registry + store-RPC
                      submit/complete streams;
 * ``pshare/<job>/...``   — cross-engine page-share payload/index/lease;
-* ``rpc/...``      — distributed.rpc worker address book.
+* ``rpc/...``      — distributed.rpc worker address book;
+* ``dlinalg/<job>/...``  — distributed linear-algebra solver control
+                     plane (panel exchange, solver progress, barriers —
+                     registry scope: WAL-replicated so a promoted
+                     standby still holds in-flight panels).
 
 Leaf keys under a family prefix are built by the owning class via its
 ``_k``/prefix helper — those helpers must take their ROOT from here.
@@ -42,6 +46,7 @@ __all__ = [
     "fleet_quarantine", "fleet_autoscale", "fleet_ledger",
     "fleet_router", "page_share",
     "rpc_worker", "rpc_rank",
+    "dlinalg_job", "dlinalg_panels", "dlinalg_solver",
 ]
 
 # ---- FailoverStore WAL (``__``-internal: skips its own replication) -------
@@ -168,3 +173,27 @@ def rpc_worker(name):
 def rpc_rank(rank):
     """rank -> worker-name indirection."""
     return f"rpc/rank/{rank}"
+
+
+# ---- distributed linear algebra (ISSUE 18) --------------------------------
+
+def dlinalg_job(job):
+    """Solver control-plane root for one dlinalg job (progress records,
+    world roster). Registry scope: rides the FailoverStore WAL so a
+    promoted standby still knows the last committed panel."""
+    return f"dlinalg/{job}"
+
+
+def dlinalg_panels(job):
+    """Panel-exchange payload prefix (``StoreExchange._k`` appends
+    ``i<incarnation>/s<sweep>/<phase>/<tag>`` leaves). Panels are
+    immutable once published — re-publishing after a resume writes the
+    identical bytes, so replay over a store failover is idempotent."""
+    return f"dlinalg/{job}/panel"
+
+
+def dlinalg_solver(job):
+    """Solver synchronisation prefix (reduction scratch + barrier
+    names, suffixed by incarnation/sweep so an elastic world change
+    never meets a stale counter)."""
+    return f"dlinalg/{job}/solver"

@@ -31,7 +31,8 @@ SUMMARY_VERSION = 3
 
 # store-key roots the SK family knows (the families consolidated into
 # distributed/keyspace.py); a literal starting "<root>/" is a store key
-KEY_ROOTS = ("__wal", "__fence", "elastic", "serving", "pshare", "rpc")
+KEY_ROOTS = ("__wal", "__fence", "elastic", "serving", "pshare", "rpc",
+             "dlinalg")
 
 # the one module where raw key literals are legal
 KEYSPACE_FILE = "distributed/keyspace.py"

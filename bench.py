@@ -1145,6 +1145,125 @@ def run_controlplane_chaos():
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def run_integrity_chaos(epochs=2, batches=8):
+    """``--chaos`` integrity leg (ISSUE 19): the training integrity
+    guard under both of its fault models.
+
+    * loss-spike: a single-process guarded fit with one poisoned batch
+      (``loss_spike@batch``) + lineage — the MAD gate must trip, rewind
+      to the pre-spike snapshot and replay with the poisoned window
+      skipped, landing back near the clean twin's final loss. Records
+      the detect→rewind latency (``train_rewind_detect_s``) and rewind
+      count (``train_rewinds``).
+    * bitflip: a 3-rank launcher job with comm overlap + cross-rank
+      gradient fingerprints where rank 1's published bucket summary is
+      bit-flipped (``grad_bitflip@grad_fingerprint``) — the majority
+      vote must blame rank 1 (``integrity_blamed_rank``), strike it,
+      redo the step, and finish with LOSS lines EXACTLY matching a
+      clean twin (the flip hits the host copy, device math is intact).
+    """
+    import re
+    import shutil
+    import subprocess
+    import tempfile
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    workers_dir = os.path.join(repo, "tests", "workers")
+    if workers_dir not in sys.path:
+        sys.path.insert(0, workers_dir)
+    from ft_markers import free_port as _free_port
+    worker = os.path.join(workers_dir, "integrity_worker.py")
+    tmp = tempfile.mkdtemp(prefix="pd_integrity_")
+    base_env = _chaos_child_env(repo)
+    base_env.update({"PADDLE_TPU_IT_EPOCHS": str(epochs),
+                     "PADDLE_TPU_IT_BATCHES": str(batches)})
+
+    def _losses(text):
+        got = {}
+        for m in re.finditer(r"LOSS (\d+) ([\d.]+)", text):
+            got.setdefault(int(m.group(1)), set()).add(m.group(2))
+        return got
+
+    try:
+        out = {}
+        # -- loss-spike leg: poison batch 5, expect rewind + skip replay
+        env = dict(base_env,
+                   PADDLE_TPU_CKPT_DIR=os.path.join(tmp, "ck_spike"))
+        clean = subprocess.run([sys.executable, worker], env=env,
+                               capture_output=True, text=True,
+                               timeout=600, cwd=repo)
+        env = dict(base_env,
+                   PADDLE_TPU_CKPT_DIR=os.path.join(tmp, "ck_spike_f"))
+        env["PADDLE_TPU_FAULTS"] = "loss_spike@batch:5"
+        spiked = subprocess.run([sys.executable, worker], env=env,
+                                capture_output=True, text=True,
+                                timeout=600, cwd=repo)
+        rewinds = re.findall(r"INTEGRITY_REWIND n=\d+ to_step=\d+ "
+                             r"skip=\(\d+,\d+,\d+\) detect_s=([\d.]+)",
+                             spiked.stdout)
+        mf = re.search(r"FINAL_LOSS ([\d.]+)", spiked.stdout)
+        mc = re.search(r"FINAL_LOSS ([\d.]+)", clean.stdout)
+        fault_final = float(mf.group(1)) if mf else float("inf")
+        clean_final = float(mc.group(1)) if mc else float("inf")
+        # "parity": the replay excises the poisoned window, so the
+        # trajectory differs by those batches — near, not bit-equal
+        spike_ok = (clean.returncode == 0 and spiked.returncode == 0
+                    and len(rewinds) >= 1
+                    and fault_final <= max(2.0 * clean_final,
+                                           clean_final + 5.0))
+        out["train_rewinds"] = len(rewinds)
+        if rewinds:
+            out["train_rewind_detect_s"] = float(rewinds[0])
+        if not spike_ok:
+            out["integrity_spike_error"] = (
+                "clean_rc=%d fault_rc=%d rewinds=%d final=%s/%s: %s" % (
+                    clean.returncode, spiked.returncode, len(rewinds),
+                    fault_final, clean_final,
+                    (spiked.stdout + spiked.stderr)[-300:]))
+
+        # -- bitflip leg: 3 ranks, fingerprints on, flip rank 1's copy
+        def _launch(faults):
+            env = dict(base_env)
+            env.update({
+                "PADDLE_TPU_DP_OVERLAP": "1",
+                "PADDLE_TPU_IT_FINGERPRINTS": "1",
+                "PADDLE_TPU_FR_STORE": f"127.0.0.1:{_free_port()}",
+            })
+            if faults:
+                env["PADDLE_TPU_FAULTS"] = faults
+            log_dir = tempfile.mkdtemp(prefix="logs_", dir=tmp)
+            r = subprocess.run(
+                [sys.executable, "-m", "paddle_tpu.distributed.launch",
+                 "--nproc_per_node", "3", "--master",
+                 f"127.0.0.1:{_free_port()}", "--log_dir", log_dir,
+                 worker],
+                env=env, capture_output=True, text=True, timeout=600,
+                cwd=repo)
+            logs = "".join(
+                open(os.path.join(log_dir, f)).read()
+                for f in sorted(os.listdir(log_dir))
+                if f.startswith("workerlog"))
+            return r, logs
+
+        rc, clogs = _launch(None)
+        rf, flogs = _launch("grad_bitflip@grad_fingerprint:2%1")
+        blamed = re.findall(r"INTEGRITY_BLAME rank=(\d+)", flogs)
+        parity = _losses(flogs) == _losses(clogs) and bool(_losses(flogs))
+        flip_ok = (rc.returncode == 0 and rf.returncode == 0
+                   and blamed and set(blamed) == {"1"} and parity)
+        if blamed:
+            out["integrity_blamed_rank"] = int(blamed[0])
+        if not flip_ok:
+            out["integrity_bitflip_error"] = (
+                "clean_rc=%d fault_rc=%d blamed=%s parity=%s: %s" % (
+                    rc.returncode, rf.returncode, sorted(set(blamed)),
+                    parity, (flogs + rf.stderr)[-300:]))
+        out["integrity_ok"] = bool(spike_ok and flip_ok)
+        return out
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _run_guarded_legs(sub, legs):
     """Run bench legs in order, merging each leg's rows into ``sub`` the
     moment they exist: a later leg that raises records
@@ -2526,33 +2645,40 @@ def main_linalg():
     return 0 if ok else 1
 
 
+# name -> (leg fn, the ok-key _run_guarded_legs can't infer: the legs
+# predate its <name>_ok convention and their keys are already on the
+# wire in snapshots/dashboards)
+CHAOS_LEGS = (
+    ("chaos", run_chaos_smoke, "chaos_resume_ok"),
+    ("elastic", run_elastic_chaos, "elastic_scale_ok"),
+    ("hang", run_hang_chaos, "hang_postmortem_ok"),
+    ("node", run_node_chaos, "node_elastic_ok"),
+    ("controlplane", run_controlplane_chaos, "controlplane_ok"),
+    ("integrity", run_integrity_chaos, "integrity_ok"),
+)
+
+
 def main_chaos():
-    sub = run_chaos_smoke()
-    try:
-        sub.update(run_elastic_chaos())
-    except Exception as e:  # keep the smoke leg's numbers on the wire
-        sub.update({"elastic_scale_ok": False,
-                    "elastic_error": repr(e)[-300:]})
-    try:
-        sub.update(run_hang_chaos())
-    except Exception as e:
-        sub.update({"hang_postmortem_ok": False,
-                    "hang_error": repr(e)[-300:]})
-    try:
-        sub.update(run_node_chaos())
-    except Exception as e:  # prior legs' JSON stays on the wire
-        sub.update({"node_elastic_ok": False,
-                    "node_error": repr(e)[-300:]})
-    try:
-        sub.update(run_controlplane_chaos())
-    except Exception as e:  # prior legs' JSON stays on the wire
-        sub.update({"controlplane_ok": False,
-                    "controlplane_error": repr(e)[-300:]})
-    ok = bool(sub.get("chaos_resume_ok")) \
-        and bool(sub.get("elastic_scale_ok")) \
-        and bool(sub.get("hang_postmortem_ok")) \
-        and bool(sub.get("node_elastic_ok")) \
-        and bool(sub.get("controlplane_ok"))
+    # `bench.py --chaos <leg>[,<leg>...]` runs a subset (dev loop /
+    # targeted CI re-runs); bare `--chaos` runs the full gauntlet
+    sel = None
+    argv = sys.argv[1:]
+    if "--chaos" in argv:
+        nxt = argv[argv.index("--chaos") + 1:]
+        if nxt and not nxt[0].startswith("--"):
+            sel = set(nxt[0].split(","))
+            unknown = sel - {n for n, _, _ in CHAOS_LEGS}
+            if unknown:
+                _log("[bench] unknown chaos leg(s) %s (have: %s)" % (
+                    sorted(unknown), [n for n, _, _ in CHAOS_LEGS]))
+                return 2
+    legs = [(n, fn) for n, fn, _ in CHAOS_LEGS
+            if sel is None or n in sel]
+    sub = {}
+    ok = _run_guarded_legs(sub, legs)
+    picked = {n for n, _ in legs}
+    ok = ok and all(bool(sub.get(okkey))
+                    for n, _, okkey in CHAOS_LEGS if n in picked)
     print(json.dumps({
         "metric": "chaos_recovery_s",
         "value": sub.get("chaos_recovery_s", 0.0),

@@ -244,7 +244,7 @@ class Engine:
 
     def fit(self, train_data, epochs=1, batch_size=None, verbose=0,
             steps_per_epoch=None, lineage=None, snapshot_interval=None,
-            async_snapshot=False, loss_fetch_every=10):
+            async_snapshot=False, loss_fetch_every=10, integrity=None):
         """``lineage`` (CheckpointLineage or root path) makes this bare
         loop resumable exactly like ``hapi.Model.fit``: restore model /
         optimizer / RNG / position, skip already-consumed batches of the
@@ -253,7 +253,15 @@ class Engine:
 
         ``loss_fetch_every`` amortizes the blocking loss fetch (the host
         otherwise drains the device pipeline every step); the returned
-        history is exact — lazy losses resolve in one sync at fit end."""
+        history is exact — lazy losses resolve in one sync at fit end.
+
+        ``integrity`` arms the training integrity guard's HEALTH GATES +
+        rewind-and-skip on this loop (see ``distributed.integrity``) —
+        gradient fingerprints need the eager DP scheduler's
+        pre-collective payloads, which this always-staged step does not
+        expose (its psums are in-program), so ``fingerprints=True`` here
+        degrades to gates-only with a warning. Guard-on forces the
+        per-step loss fetch the amortized cadence otherwise avoids."""
         import numpy as np
         if self.strategy is None:
             self.prepare()
@@ -266,6 +274,13 @@ class Engine:
                 lineage, network=self.model, optimizer=self.optimizer,
                 interval=snapshot_interval, async_snapshot=async_snapshot)
             rt.restore()
+        guard = None
+        if integrity is not None and integrity is not False:
+            from ..integrity import make_guard
+            guard = make_guard(integrity)
+            guard.attach_fingerprints(self.model)
+            if rt is not None:
+                rt.ensure_baseline()  # rewind target before the first step
         # PADDLE_TPU_METRICS=1: the same per-step telemetry hapi fit gets
         # (step-time breakdown, tokens/sec, MFU) on this bare loop
         from ...observability import telemetry as _telemetry
@@ -273,8 +288,14 @@ class Engine:
         if tm is not None:
             tm.on_train_begin()
         history = []
+        it = rt.global_step if rt is not None else 0
         try:
-            for epoch in range(rt.epoch if rt is not None else 0, epochs):
+            # explicit epoch cursor: a guard rewind restores rt to an
+            # earlier epoch/step and the loop re-enters there
+            epoch = rt.epoch if rt is not None else 0
+            rewound = False
+            while epoch < epochs:
+                suspect = False  # guard flagged the latest step
                 if tm is not None:
                     tm.on_epoch_begin(epoch)
                 for i, batch in enumerate(train_data):
@@ -284,23 +305,48 @@ class Engine:
                         if rt.skip_batch(epoch, i):
                             continue
                         rt.poll_preempt(epoch, i)
+                    if guard is not None:
+                        batch = (batch[0], guard.maybe_poison(batch[1]),
+                                 *batch[2:])
                     if tm is not None:
                         tm.batch_ready(batch[0])
                     loss = self._step(*batch)
-                    if loss_fetch_every <= 1 or \
+                    if guard is not None or loss_fetch_every <= 1 or \
                             len(history) % loss_fetch_every == 0:
+                        # guard-on: the health gate scores every step's
+                        # host value (the documented cost of integrity=)
                         _telemetry.mark_sync_begin()
-                        history.append(float(np.asarray(loss.numpy())))
+                        loss = float(np.asarray(loss.numpy()))
+                        history.append(loss)
                     else:
                         history.append(loss)  # lazy: resolved at fit end
+                    if guard is not None:
+                        verdict = guard.observe_loss(loss, epoch, i, it)
+                        if verdict == "rewind":
+                            # raises IntegrityError when no lineage —
+                            # loud detection-only mode
+                            guard.rewind(rt, epoch, i)
+                            it = rt.global_step
+                            history.pop()  # the rewound-away loss
+                            rewound = True
+                            break
+                        suspect = verdict is not None
+                    it += 1
                     if tm is not None:
                         tm.on_train_batch_end(i)
                     if rt is not None:
-                        rt.step_done(epoch, i)
+                        rt.step_done(epoch, i, suspect=suspect)
                         if tm is not None:
                             tm.note_pause()  # ckpt time is not data wait
-                if rt is not None:
+                if rewound:
+                    rewound = False
+                    epoch = rt.epoch
+                    continue  # replay from the restored snapshot state
+                if rt is not None and not suspect:
+                    # a suspect tail must not snapshot possibly-corrupted
+                    # parameters as the epoch boundary
                     rt.epoch_done(epoch)
+                epoch += 1
         except BaseException:
             if rt is not None:
                 try:

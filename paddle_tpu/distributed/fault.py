@@ -68,6 +68,7 @@ import time
 __all__ = [
     "EXIT_FAULT", "EXIT_PREEMPT", "EXIT_WATCHDOG", "EXIT_HANG",
     "EXIT_DESYNC", "EXIT_USAGE", "EXIT_DEPOSED", "EXIT_ORACLE",
+    "EXIT_INTEGRITY",
     "EXIT_CAUSES",
     "describe_exit",
     "FaultEntry",
@@ -93,6 +94,11 @@ EXIT_DEPOSED = 76    # control-plane coordinator deposed (EX_PROTOCOL):
 EXIT_ORACLE = 47     # numerical-correctness oracle violated (dlinalg
                      # residual/orthogonality gate): the answer is WRONG,
                      # not just late — never auto-resumed, a human looks
+EXIT_INTEGRITY = 49  # training integrity guard verdict (distributed/
+                     # integrity.py): sustained loss/gradient anomaly
+                     # survived the in-process rewind-and-skip budget —
+                     # a restart would resume the same snapshot and
+                     # re-trip the guard, so the launcher does not
 
 # The one copy of the worker exit-code -> human cause mapping (launcher
 # failure summaries, tests). Negative codes are death-by-signal and are
@@ -113,6 +119,10 @@ EXIT_CAUSES = {
                   "the lease term; this instance yielded (writes fenced)",
     EXIT_ORACLE: "numerical oracle violated — a dlinalg residual/"
                  "orthogonality gate failed (silent corruption made loud)",
+    EXIT_INTEGRITY: "training integrity guard exhausted — sustained loss/"
+                    "gradient anomaly survived max_rewinds rewind-and-skip "
+                    "attempts (SDC, poisoned data or divergence: a human "
+                    "looks, restarts would loop)",
 }
 
 
@@ -134,7 +144,8 @@ _KINDS = ("crash", "hang", "torn_write", "store_drop", "slow_io",
           "coordinator_die", "wal_torn",
           "engine_die", "engine_stall",
           "router_die", "router_stall",
-          "panel_corrupt", "sweep_stall")
+          "panel_corrupt", "sweep_stall",
+          "grad_bitflip", "loss_spike")
 # a site-less (wildcard) cooperative entry only fires at sites whose
 # callers honor the returned kind — anywhere else it would burn its
 # trigger silently; crash/hang/slow_io/commit_stall wildcards fire at
@@ -207,7 +218,20 @@ _WILDCARD_SITES = {"store_drop": ("store",), "torn_write": ("ckpt",),
                    # sweep boundary (the straggler-solver case the
                    # launcher's terminate-grace path must cover).
                    "panel_corrupt": ("linalg_panel",),
-                   "sweep_stall": ("linalg_sweep",)}
+                   "sweep_stall": ("linalg_sweep",),
+                   # training integrity (ISSUE 19): ``grad_bitflip`` is
+                   # cooperative at the bucket-fingerprint site — the
+                   # fingerprinting rank perturbs the payload copy it is
+                   # about to summarize (the SDC bit-flip model: ONE rank
+                   # differs pre-collective where fingerprints must
+                   # agree), which the TrainingGuard must blame, strike
+                   # and redo; ``loss_spike`` is cooperative at the
+                   # guarded fit loop's batch site — the loop scales that
+                   # batch's labels so the step genuinely corrupts,
+                   # which the MAD health gate must catch and the
+                   # rewind-and-skip replay must excise.
+                   "grad_bitflip": ("grad_fingerprint",),
+                   "loss_spike": ("batch",)}
 
 _lock = threading.Lock()
 _entries: list | None = None  # parsed spec; None = not yet loaded from env

@@ -55,7 +55,7 @@ __all__ = [
     "wire_from_env",
     "next_group_seq", "current_group_seq", "reset_seqs", "incarnation",
     "note_store_incarnation", "note_fenced", "store_incarnation",
-    "store_scope", "dump", "dump_path", "watchdog_escalation",
+    "store_scope", "side_store", "dump", "dump_path", "watchdog_escalation",
     "collect_dumps", "rows_from_dumps", "blame_rows", "format_post_mortem",
 ]
 
@@ -430,6 +430,22 @@ def wire_from_env(timeout=30.0):
     if rec is None:
         return None
     return _side_store(rec, rec.rank, rec.world_size, timeout)
+
+
+def side_store(rank=0, world=1, timeout=30.0):
+    """Public side-channel accessor for subsystems that ride the
+    ``PADDLE_TPU_FR_STORE`` channel even when the recorder itself is
+    disabled — the integrity guard's gradient fingerprints publish under
+    ``store_scope() + "/gfp/..."`` keys (per-incarnation namespace, so
+    they rotate across restarts/failovers like every other side-channel
+    family). With a live recorder the connection is shared and cached on
+    it; without one a fresh connection is made per call, so callers keep
+    their own reference. Returns None when no endpoint is configured or
+    the store is unreachable."""
+    rec = _rec if _loaded else _load()
+    if rec is not None:
+        return _side_store(rec, rec.rank, rec.world_size, timeout)
+    return _side_store(None, int(rank), int(world), timeout)
 
 
 # -------------------------------------------------------- desync detection

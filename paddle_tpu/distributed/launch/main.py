@@ -57,8 +57,8 @@ import sys
 import time
 
 from .. import keyspace
-from ..fault import (EXIT_DEPOSED, EXIT_PREEMPT, EXIT_USAGE,
-                     describe_exit)
+from ..fault import (EXIT_DEPOSED, EXIT_INTEGRITY, EXIT_PREEMPT,
+                     EXIT_USAGE, describe_exit)
 
 __all__ = ["launch", "main", "CoordinatorDeposedError"]
 
@@ -1199,6 +1199,15 @@ def launch(argv=None):
             print(f"[launch] graceful preemption: resuming "
                   f"(preempt resume {preempt_restarts}, does not consume "
                   f"max_restarts)", file=sys.stderr)
+        elif rc == EXIT_INTEGRITY:
+            # a guard VERDICT, not an infra failure: a relaunch would
+            # resume the same snapshot and re-trip the same anomaly —
+            # restarting here is the loop EXIT_INTEGRITY exists to break
+            print("[launch] training integrity guard exhausted its "
+                  "rewind budget: not restarting (a relaunch would "
+                  "resume the same snapshot and re-trip)",
+                  file=sys.stderr)
+            return rc
         elif elastic is not None:
             # scale event: only hard-killed members (rc == -SIGKILL, the
             # lost-host signal) shed capacity.  A peer dying mid-collective

@@ -206,6 +206,10 @@ class BucketedGradSync:
         self._warned_traced_quant = False
         self.fired = 0            # eager async bucket collectives issued
         self.traced_fires = 0     # in-program bucket psums placed
+        # Optional integrity.GradFingerprints (ISSUE 19): publishes a
+        # pre-collective summary per eager bucket fire and verifies at
+        # backward end, BEFORE any leaf writeback. None = zero overhead.
+        self.integrity_hook = None
 
     # ------------------------------------------------------- hook protocol
     def active(self):
@@ -260,6 +264,12 @@ class BucketedGradSync:
         a mix of two steps. Drain the stale tasks (completes their ring
         entries; results discarded — they belong to the aborted walk)
         and start clean."""
+        h = self.integrity_hook
+        if h is not None:
+            # BEFORE the early return: the fingerprint round counter must
+            # bump on EVERY backward on every rank — including the redo
+            # backward after a mismatch — or ranks' store keys desync.
+            h.begin_round()
         if not (self._pending or self._tasks or self._absorbed):
             return
         stale, self._tasks = self._tasks, []
@@ -281,8 +291,19 @@ class BucketedGradSync:
                 self._fire(b, self._pending[bidx])
             self._pending.clear()
         tasks, self._tasks = self._tasks, []
-        for entries, task in tasks:
-            flat = task.wait()
+        h = self.integrity_hook
+        if h is None:
+            for entries, task in tasks:
+                flat = task.wait()
+                self._writeback(entries, flat)
+            return
+        # Integrity ordering: await EVERYTHING, then verify fingerprints,
+        # then write back. A mismatch raises out of backward before any
+        # leaf was finalized — parameters are still the synced pre-step
+        # values on every rank, so the step can simply be redone.
+        done = [(entries, task.wait()) for entries, task in tasks]
+        h.verify()
+        for entries, flat in done:
             self._writeback(entries, flat)
 
     # ---------------------------------------------------------- transports
@@ -430,6 +451,12 @@ class BucketedGradSync:
                            finalizer=lambda res: jax.block_until_ready(res))
         self.fired += 1
         self._tasks.append((metas, task))
+        h = self.integrity_hook
+        if h is not None:
+            # AFTER dispatch on purpose: the fingerprint summarizes the
+            # PRE-collective payload, and doing the host work here means
+            # the CRC overlaps the all-reduce already in flight.
+            h.on_bucket(bucket.index, flat)
 
     def _writeback(self, metas, flat):
         off = 0

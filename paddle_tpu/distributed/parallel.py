@@ -52,9 +52,17 @@ def shard_batch(tensor, group=None):
         # caller before staging) — just pin the layout
         placed = jax.lax.with_sharding_constraint(arr, sharding)
     elif jax.process_count() > 1:
-        import numpy as _np
-        placed = jax.make_array_from_process_local_data(
-            sharding, _np.asarray(arr))
+        if isinstance(arr, jax.Array) and not arr.is_fully_addressable:
+            # already assembled into a global array — this tensor went
+            # through shard_batch before (the in-place _data swap below
+            # makes re-entry possible: a guarded-step redo re-walks
+            # forward with the same batch tensor). Reassembly from
+            # local data is impossible AND unnecessary; keep it.
+            placed = arr
+        else:
+            import numpy as _np
+            placed = jax.make_array_from_process_local_data(
+                sharding, _np.asarray(arr))
     else:
         placed = jax.device_put(arr, sharding)
     if isinstance(tensor, Tensor):

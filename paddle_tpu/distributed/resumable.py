@@ -78,6 +78,10 @@ class ResumableTraining:
         self.step_in_epoch = 0
         self.global_step = 0
         self._last_saved_step = None
+        # Batch windows the integrity guard condemned: {(epoch, first,
+        # last)} — skipped on replay AND persisted in snapshot metadata
+        # so a later preemption-resume honors them (ISSUE 19).
+        self.skip_windows: set = set()
 
     # -- state composition --
     def state(self, epoch, step_in_epoch, global_step):
@@ -91,6 +95,13 @@ class ResumableTraining:
                  "world_size": int(getattr(self.lineage, "world_size", 1)
                                    or 1),
                  "rng": list(get_rng_state())}
+        # Versioned skip-window metadata, inserted BEFORE model/opt on
+        # purpose: load_state_dict fills the target in key order and
+        # raises KeyError for target keys an OLD snapshot lacks — these
+        # two fire that KeyError before any tensor is restored in place,
+        # so the back-compat retry in restore() starts from clean state.
+        state["skip_windows_v"] = 1
+        state["skip_windows"] = [list(w) for w in sorted(self.skip_windows)]
         if self.network is not None:
             state["model"] = self.network.state_dict()
         if self.optimizer is not None:
@@ -108,7 +119,18 @@ class ResumableTraining:
             # that restarts ahead of the first step drops them silently
             self.optimizer.materialize()
         target = self.state(0, 0, 0)
-        restored = self.lineage.load_latest(target)
+        try:
+            restored = self.lineage.load_latest(target)
+        except KeyError as e:
+            if "skip_windows" not in str(e):
+                raise
+            # Back-compat: a pre-integrity snapshot has no skip_windows
+            # metadata — retry against a target without the two fields
+            # (old snapshots load with an empty set).
+            target = self.state(0, 0, 0)
+            target.pop("skip_windows", None)
+            target.pop("skip_windows_v", None)
+            restored = self.lineage.load_latest(target)
         if restored is not None:
             if self.network is not None:
                 self.network.set_state_dict(target["model"])
@@ -121,6 +143,11 @@ class ResumableTraining:
             self.step_in_epoch = int(target["step_in_epoch"])
             self.global_step = int(target["global_step"])
             self._last_saved_step = self.global_step
+            # UNION-merge, not assign: rewind() registers its window
+            # before calling restore(), and the snapshot being restored
+            # predates that window — overwriting would lose it.
+            self.skip_windows |= {(int(e), int(a), int(b)) for e, a, b in
+                                  (target.get("skip_windows") or [])}
             old_world = int(target.get("world_size", 0) or 0)
             new_world = int(getattr(self.lineage, "world_size", 1) or 1)
             # ring marker: a post-mortem spanning the relaunch shows the
@@ -150,8 +177,47 @@ class ResumableTraining:
     # -- loop hooks --
     def skip_batch(self, epoch, step_in_epoch) -> bool:
         """True for batches the pre-restart incarnation already consumed
-        (the resumed epoch must not double-count its prefix)."""
-        return epoch == self.epoch and step_in_epoch < self.step_in_epoch
+        (the resumed epoch must not double-count its prefix) — or that
+        fall in a condemned skip window (the integrity guard's rewind
+        replay must excise the anomalous batches, and so must any later
+        preemption-resume that re-walks the same epoch)."""
+        if epoch == self.epoch and step_in_epoch < self.step_in_epoch:
+            return True
+        for e, a, b in self.skip_windows:
+            if e == epoch and a <= step_in_epoch <= b:
+                return True
+        return False
+
+    def add_skip_window(self, epoch, first_step, last_step):
+        """Condemn the batch window [first_step, last_step] of ``epoch``
+        (inclusive; persisted with the next snapshot)."""
+        self.skip_windows.add((int(epoch), int(first_step), int(last_step)))
+
+    def rewind(self, skip_window=None):
+        """In-process rewind to the newest verified snapshot, optionally
+        condemning a batch window first. Returns the restored global
+        step; the caller restarts its epoch loop from this object's
+        epoch/step_in_epoch state. The new window rides the NEXT snapshot
+        (interval/epoch/preempt) — restore() union-merges, so it survives
+        the state overwrite here."""
+        if skip_window is not None:
+            self.add_skip_window(*skip_window)
+        restored = self.restore()
+        if restored is None:
+            raise RuntimeError(
+                "rewind requested but the lineage holds no verified "
+                "snapshot to restore (call ensure_baseline() before "
+                "the first step)")
+        self._log(f"REWOUND global_step={self.global_step} "
+                  f"skip_windows={sorted(self.skip_windows)}")
+        return self.global_step
+
+    def ensure_baseline(self):
+        """Guarantee at least one snapshot exists — the guard's rewind
+        target when an anomaly trips before the first interval save.
+        No-op once anything has been saved or restored."""
+        if self._last_saved_step is None:
+            self._save(self.epoch, self.step_in_epoch, sync=True)
 
     def poll_preempt(self, epoch, step_in_epoch):
         """At a batch boundary: if SIGTERM arrived, synchronously save a
@@ -162,7 +228,8 @@ class ResumableTraining:
         self._log(f"PREEMPT_SAVED {self.global_step}")
         exit_preempted(lambda: self._save(epoch, step_in_epoch, sync=True))
 
-    def step_done(self, epoch, step_in_epoch, defer_to_epoch=False):
+    def step_done(self, epoch, step_in_epoch, defer_to_epoch=False,
+                  suspect=False):
         """One batch finished: bump counters; snapshot on the interval
         (resume point = the NEXT batch). Returns True if it saved.
 
@@ -172,13 +239,19 @@ class ResumableTraining:
         create a resume point AFTER the last batch but BEFORE the
         epoch-end processing (callbacks/eval), which a resume would then
         silently skip; ``epoch_done`` runs after those hooks, so its
-        snapshot is the hook-exact boundary."""
+        snapshot is the hook-exact boundary.
+
+        ``suspect``: the integrity guard flagged this step's loss as
+        anomalous — the parameters may already be corrupted, so the
+        interval snapshot is suppressed. Snapshotting a suspect step
+        would make the guard's own rewind target the corruption it is
+        trying to escape."""
         self.global_step += 1
         # pin the flight recorder's step number so hang/desync post-
         # mortems name the exact trainer step, not a heartbeat estimate
         _fr.note_step(self.global_step)
         if self.interval and self.global_step % self.interval == 0 \
-                and not defer_to_epoch:
+                and not defer_to_epoch and not suspect:
             self._save(epoch, step_in_epoch + 1)
             return True
         return False

@@ -107,6 +107,44 @@ class Model:
             self._update_metric(m, out, y)
         return metrics[0] if len(metrics) == 1 else metrics
 
+    def _guarded_step(self, guard, x, y, epoch, step):
+        """One train step under gradient-fingerprint verification. EAGER
+        on purpose: a staged step places in-program psums, leaving no
+        pre-collective host payload to fingerprint. A mismatch raises out
+        of ``backward()`` BEFORE any leaf writeback (parameters are still
+        the synced pre-step values on every rank), so after blame/strike
+        bookkeeping the step is simply redone — every rank sees the same
+        store records and redoes in lockstep."""
+        from ..distributed.integrity import GradFingerprintMismatch
+        from ..distributed.parallel import DataParallel, shard_batch
+        net, loss_fn, opt = self.network, self._loss, self._optimizer
+        if isinstance(net, DataParallel):
+            # forward() shard-batches the inputs itself; the labels meet
+            # the (global) output inside the loss, so they need the same
+            # dp-axis placement here on the eager path
+            y = shard_batch(y, net._group)
+        amp_level = getattr(self, "_amp_level", None)
+        while True:
+            if amp_level:
+                from ..amp import auto_cast
+                with auto_cast(level=amp_level, dtype="bfloat16"):
+                    out = net(x)
+                    loss = loss_fn(out, y)
+            else:
+                out = net(x)
+                loss = loss_fn(out, y)
+            try:
+                loss.backward()
+            except GradFingerprintMismatch as err:
+                guard.on_mismatch(err, epoch, step)  # raises past max_redos
+                opt.clear_grad()
+                continue
+            opt.step()
+            opt.clear_grad()
+            for m in self._metrics:
+                self._update_metric(m, out, y)
+            return loss
+
     # the ONE funnel for blocking loss fetches — the bounded-host-sync
     # regression test counts calls here, so a reintroduced per-step fetch
     # fails structurally instead of by wall clock
@@ -192,7 +230,7 @@ class Model:
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
             num_iters=None, lineage=None, snapshot_interval=None,
-            async_snapshot=False, loss_fetch_every=None):
+            async_snapshot=False, loss_fetch_every=None, integrity=None):
         """Reference: Model.fit (hapi/model.py:1756).
 
         ``loss_fetch_every`` amortizes the blocking device→host loss fetch:
@@ -219,7 +257,20 @@ class Model:
         epoch-keyed shuffle, identical across incarnations); a
         user-supplied DataLoader must provide that determinism for exact
         batch-skip resume (shuffle=False or a seeded/epoch-keyed
-        shuffle)."""
+        shuffle).
+
+        ``integrity`` (True / a dict of ``TrainingGuard`` knobs / a
+        guard instance) arms the training integrity guard
+        (``distributed.integrity``): per-step loss health gates
+        (median+MAD z-score with NaN/Inf folded in), optional
+        cross-rank gradient fingerprints with rank blame + step redo
+        under eager DP (``fingerprints=True`` — needs comm overlap and
+        ``PADDLE_TPU_FR_STORE``), and automatic rewind-and-skip through
+        ``lineage`` on a sustained anomaly. The guard needs the host
+        loss value every step, so it forces the blocking fetch the
+        amortized cadence otherwise avoids — a documented cost of
+        ``integrity=``; with it unset (the default) the loop is
+        structurally unchanged."""
         from .callbacks import Callback, ProgBarLogger
         cbs = _as_list(callbacks)
         if verbose and not any(isinstance(c, ProgBarLogger) for c in cbs):
@@ -244,6 +295,15 @@ class Model:
                 lineage, network=self.network, optimizer=self._optimizer,
                 interval=snapshot_interval, async_snapshot=async_snapshot)
             rt.restore()
+        guard = None
+        if integrity is not None and integrity is not False:
+            from ..distributed.integrity import make_guard
+            guard = make_guard(integrity)
+            guard.attach_fingerprints(self.network)
+            if rt is not None:
+                # a rewind target must exist even if an anomaly trips
+                # before the first interval snapshot
+                rt.ensure_baseline()
         history = {"loss": []}
         # amortized loss-fetch cadence: align with the tightest progress
         # logger so every PRINTED loss is fresh, never force a per-step
@@ -259,7 +319,12 @@ class Model:
         it = rt.global_step if rt is not None else 0
         done = False
         try:
-            for epoch in range(rt.epoch if rt is not None else 0, epochs):
+            # explicit epoch cursor (not a range): the integrity guard's
+            # rewind restores rt to an earlier epoch/step and the loop
+            # must re-enter there to replay with the window skipped
+            epoch = rt.epoch if rt is not None else 0
+            rewound = False
+            while epoch < epochs:
                 if done:
                     break
                 self.network.train()
@@ -275,6 +340,7 @@ class Model:
                     m.reset()
                 epoch_losses = []
                 shown_loss = None  # most recently FETCHED loss float
+                suspect = False    # guard flagged the latest step
                 for step, batch in enumerate(loader):
                     if rt is not None and rt.skip_batch(epoch, step):
                         continue  # consumed before the restart
@@ -284,11 +350,23 @@ class Model:
                     if rt is not None:
                         rt.poll_preempt(epoch, step)
                     x, y = batch[0], batch[1]
+                    if guard is not None:
+                        y = guard.maybe_poison(y)
                     if tm is not None:
                         tm.batch_ready(x)  # data wait ends here
                     for c in cbs:
                         c.on_train_batch_begin(step)
-                    loss = self.train_batch(x, y, sync=not lazy_loss)
+                    if guard is not None and guard.fingerprints_active():
+                        loss = self._guarded_step(guard, x, y, epoch, step)
+                    else:
+                        loss = self.train_batch(x, y, sync=not lazy_loss)
+                    if guard is not None and isinstance(loss, Tensor):
+                        # the health gate scores every step's HOST value:
+                        # integrity= pays the per-step fetch (documented
+                        # cost), through the one counted funnel
+                        _telemetry.mark_sync_begin()
+                        loss = self._fetch_scalar(loss)
+                        shown_loss = loss
                     if isinstance(loss, Tensor):
                         # lazy loss: fetch on the cadence, keep the device
                         # pipeline full in between. shown_loss None means
@@ -302,6 +380,14 @@ class Model:
                             shown_loss = loss
                     else:
                         shown_loss = loss
+                    if guard is not None:
+                        verdict = guard.observe_loss(loss, epoch, step, it)
+                        if verdict == "rewind":
+                            guard.rewind(rt, epoch, step)
+                            it = rt.global_step
+                            rewound = True
+                            break
+                        suspect = verdict is not None
                     epoch_losses.append(loss)
                     logs = {"loss": shown_loss}
                     for m in self._metrics:
@@ -314,14 +400,20 @@ class Model:
                             last = step + 1 == len(loader)
                         except TypeError:  # unsized iterable loader
                             last = False
-                        rt.step_done(epoch, step, defer_to_epoch=last)
+                        rt.step_done(epoch, step, defer_to_epoch=last,
+                                     suspect=suspect)
                         if tm is not None:
                             # a sync interval snapshot must not read as
                             # data wait in the next step's split
                             tm.note_pause()
+                if rewound:
+                    rewound = False
+                    epoch = rt.epoch
+                    continue  # replay from the restored snapshot state
                 if not epoch_losses:
                     if rt is not None and epoch == rt.epoch \
                             and rt.step_in_epoch > 0:
+                        epoch += 1
                         continue  # resumed exactly at this epoch's end
                     break
                 epoch_losses = self._resolve_losses(epoch_losses)
@@ -339,12 +431,15 @@ class Model:
                     c.on_epoch_end(epoch, logs)
                 if save_dir and (epoch + 1) % save_freq == 0:
                     self.save(f"{save_dir}/{epoch}")
-                if rt is not None and not done:
-                    # a num_iters cut mid-epoch must NOT snapshot the epoch as
-                    # complete — resuming would silently skip its tail
+                if rt is not None and not done and not suspect:
+                    # a num_iters cut mid-epoch must NOT snapshot the epoch
+                    # as complete — resuming would silently skip its tail;
+                    # a guard-suspect tail must not snapshot possibly-
+                    # corrupted parameters as the boundary either
                     rt.epoch_done(epoch)
                 if any(getattr(c, "stop_training", False) for c in cbs):
                     break
+                epoch += 1
         except BaseException:
             if rt is not None:
                 # drain the in-flight overlapped snapshot so the

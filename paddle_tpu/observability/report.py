@@ -125,6 +125,7 @@ def build_run_report(per_rank):
     collectives = {}
     serving_hists = {}     # (engine, name) -> merged histogram
     serving_scalars = {}   # engine -> {row: value} (counters + gauges)
+    integrity = {}         # anomalies by kind / rewinds / blamed ranks
     rank_windows = {}
     compute_ms_total = 0.0
     comm_us_total = 0.0
@@ -198,6 +199,16 @@ def build_run_report(per_rank):
                 row = serving_scalars.setdefault(eng, {})
                 k = f"requests_{st}"
                 row[k] = row.get(k, 0) + int(v)
+            elif name == "train_anomalies_total":
+                kinds = integrity.setdefault("anomalies", {})
+                k = labels.get("kind", "?")
+                kinds[k] = kinds.get(k, 0) + int(v)
+            elif name == "train_rewinds_total":
+                integrity["rewinds"] = integrity.get("rewinds", 0) + int(v)
+            elif name == "integrity_blames_total":
+                blamed = integrity.setdefault("blamed", {})
+                br = labels.get("rank", "?")
+                blamed[br] = blamed.get(br, 0) + int(v)
         # straggler windows: mean step time per inter-snapshot window,
         # stamped with the NEW snapshot's wall-clock ts. Cross-rank
         # alignment happens below by TIMESTAMP bucket, not snapshot
@@ -258,6 +269,8 @@ def build_run_report(per_rank):
               "collectives": coll_rows}
     if serving_rows:
         report["serving"] = serving_rows
+    if integrity:
+        report["integrity"] = integrity
     if compute_ms_total > 0:
         # host-visible (non-hidden) collective time vs compute time; the
         # device-truth overlap gauge (xplane-derived) wins when present
@@ -320,6 +333,18 @@ def format_run_report(report):
                     row.get("requests_ok", 0),
                     _fmt(row.get("ttft_ms_p99"), 2),
                     _fmt(row.get("itl_ms_p99"), 2)))
+    integ = report.get("integrity") or {}
+    if integ:
+        anomalies = integ.get("anomalies") or {}
+        an = ", ".join(f"{k}={v}" for k, v in sorted(anomalies.items())) \
+            or "none"
+        line = (f"[telemetry] integrity: anomalies {an}; "
+                f"rewinds {integ.get('rewinds', 0)}")
+        blamed = integ.get("blamed") or {}
+        if blamed:
+            line += "; blamed rank(s) " + ", ".join(
+                f"{r} (x{n})" for r, n in sorted(blamed.items()))
+        lines.append(line)
     if report.get("comm_overlap_pct") is not None:
         src = report.get("comm_overlap_source") or "device timeline"
         lines.append(f"[telemetry] comm/compute overlap: "

@@ -59,6 +59,14 @@ HOT_PATHS = {
         "BucketedGradSync.on_backward_end",
         "BucketedGradSync._fire",
     },
+    # integrity guard per-step hooks (ISSUE 19): run inside the guarded
+    # fit loop / backward walk, so any blocking fetch is step latency
+    "distributed/integrity.py": {
+        "TrainingGuard.observe_loss",
+        "TrainingGuard.maybe_poison",
+        "GradFingerprints.on_bucket",
+        "GradFingerprints.verify",
+    },
     "jit/api.py": {
         "StaticFunction.__call__",
         "StaticFunction._exec_whole_step",

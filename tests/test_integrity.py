@@ -1,0 +1,504 @@
+"""Training integrity guard (distributed/integrity.py, ISSUE 19): MAD
+health gates, cross-rank gradient fingerprints with majority-vote rank
+blame, and automatic rewind-and-skip through the checkpoint lineage.
+
+The chaos contract: ``grad_bitflip@grad_fingerprint:N%R`` on a 3-rank DP
+job must blame rank R, strike it into the quarantine, redo the step from
+the still-synced parameters and finish with losses EXACTLY matching a
+clean twin (the flip hits the host fingerprint copy only);
+``loss_spike@batch:N`` under a guarded fit must trip the MAD gate,
+rewind to the pre-spike snapshot and replay with the poisoned window
+skipped, landing back near the clean trajectory.
+"""
+import json
+import os
+import re
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed import fault
+from paddle_tpu.distributed import flight_recorder as flight
+from paddle_tpu.distributed import integrity
+from paddle_tpu.distributed.integrity import (
+    GradFingerprintMismatch, IntegrityError, MADWindow, TrainingGuard,
+    make_guard, verify_fingerprints)
+from paddle_tpu.distributed.resumable import ResumableTraining
+from paddle_tpu.io import Dataset
+from paddle_tpu.observability import metrics, report
+
+WORKERS = os.path.join(os.path.dirname(__file__), "workers")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if WORKERS not in sys.path:
+    sys.path.insert(0, WORKERS)
+from ft_markers import free_port as _free_port  # noqa: E402
+from ft_markers import read_worker_logs as _read_worker_logs  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _clean_state(monkeypatch):
+    monkeypatch.delenv("PADDLE_TPU_FAULTS", raising=False)
+    monkeypatch.delenv("PADDLE_TPU_FAULT_LEDGER", raising=False)
+    fault.set_fault_spec(None)
+    flight._reset_state()
+    yield
+    fault.set_fault_spec(None)
+    flight._reset_state()
+    metrics.disable()
+
+
+# ------------------------------------------------------------ health gate
+
+def test_mad_window_warmup_grace():
+    """No verdicts while the window has nothing to stand on — early
+    training legitimately moves fast."""
+    w = MADWindow(window=8, z_threshold=4.0, warmup=5)
+    for v in (100.0, 10.0, 1.0, 0.1, 50.0):  # wild, but inside warmup
+        assert w.observe(v) is False
+    assert w.last_z == 0.0
+
+
+def test_mad_window_trips_on_spike_and_excludes_it():
+    w = MADWindow(window=16, z_threshold=8.0, warmup=4)
+    for i in range(12):
+        assert w.observe(2.0 + 0.01 * (i % 3)) is False
+    assert w.observe(2000.0) is True            # the spike
+    assert w.last_z > 8.0
+    # the tripped value was NOT absorbed: the baseline stands and a
+    # normal value right after does not trip
+    assert w.observe(2.01) is False
+
+
+def test_mad_window_no_false_trip_on_lr_decay_drift():
+    """A smooth decaying loss (LR decay) drifts the median along with the
+    values — robust z stays far under the threshold."""
+    w = MADWindow(window=16, z_threshold=8.0, warmup=4)
+    for i in range(60):
+        assert w.observe(2.0 * 0.95 ** i) is False, f"step {i} z={w.last_z}"
+
+
+def test_mad_window_constant_baseline_fallback():
+    """MAD == 0 (converged/synthetic loss) must not divide by zero — a
+    genuinely different value still registers as huge."""
+    w = MADWindow(window=8, z_threshold=8.0, warmup=2)
+    for _ in range(6):
+        w.observe(1.0)
+    assert w.observe(1.5) is True
+    assert w.last_z > 1e4
+
+
+# ------------------------------------------------- fingerprint majorities
+
+def _fp(fp, injected=False):
+    return {"fp": fp, "injected": injected}
+
+
+def test_verify_fingerprints_majority_blames_minority():
+    blamed = verify_fingerprints({0: _fp("a"), 1: _fp("b"), 2: _fp("a")})
+    assert blamed == [1]
+
+
+def test_verify_fingerprints_agreement_and_single_voice():
+    assert verify_fingerprints({0: _fp("a"), 1: _fp("a")}) == []
+    assert verify_fingerprints({0: _fp("a")}) == []
+    assert verify_fingerprints({}) == []
+
+
+def test_verify_fingerprints_injected_group_loses_two_rank_tie():
+    """On a 2-rank world the perturbed rank would be a coin flip — the
+    injection marker breaks the tie deterministically (PR-3 rule)."""
+    blamed = verify_fingerprints({0: _fp("good"),
+                                  1: _fp("flipped", injected=True)})
+    assert blamed == [1]
+    # and symmetrically when the injected rank is rank 0
+    blamed = verify_fingerprints({0: _fp("flipped", injected=True),
+                                  1: _fp("good")})
+    assert blamed == [0]
+
+
+def test_verify_fingerprints_unmarked_tie_breaks_to_lowest_rank():
+    blamed = verify_fingerprints({0: _fp("a"), 1: _fp("b"),
+                                  2: _fp("a"), 3: _fp("b")})
+    assert blamed == [1, 3]  # the group holding rank 0 wins the tie
+
+
+# ----------------------------------------------------- fault grammar hook
+
+def test_fault_grammar_new_integrity_kinds():
+    es = fault.parse_fault_spec(
+        "grad_bitflip@grad_fingerprint:2%1,loss_spike@batch:5")
+    assert [e.key() for e in es] == [
+        "grad_bitflip@grad_fingerprint:2%1", "loss_spike@batch:5"]
+    # parse-time site validation: cooperative kinds at unhonored sites
+    # are configuration errors, not silent no-ops
+    with pytest.raises(ValueError):
+        fault.parse_fault_spec("loss_spike@ckpt:1")
+    with pytest.raises(ValueError):
+        fault.parse_fault_spec("grad_bitflip@step:1")
+
+
+def test_exit_integrity_registered():
+    assert fault.EXIT_INTEGRITY == 49
+    assert fault.EXIT_INTEGRITY in fault.EXIT_CAUSES
+    assert "integrity" in fault.describe_exit(fault.EXIT_INTEGRITY)
+    # distinct from every other reserved robustness exit code
+    codes = [fault.EXIT_FAULT, fault.EXIT_PREEMPT, fault.EXIT_WATCHDOG,
+             fault.EXIT_HANG, fault.EXIT_DESYNC, fault.EXIT_USAGE,
+             fault.EXIT_DEPOSED, fault.EXIT_ORACLE, fault.EXIT_INTEGRITY]
+    assert len(set(codes)) == len(codes)
+
+
+# ------------------------------------------------- skip-window persistence
+
+def test_skip_windows_roundtrip_through_snapshot(tmp_path):
+    rt = ResumableTraining(str(tmp_path / "ck"))
+    rt.add_skip_window(0, 4, 5)
+    rt.ensure_baseline()
+    rt.finalize()
+    rt2 = ResumableTraining(str(tmp_path / "ck"))
+    assert rt2.restore() is not None
+    assert rt2.skip_windows == {(0, 4, 5)}
+    # a later incarnation (e.g. a preemption-resume re-walking the same
+    # epoch) honors the condemned window
+    assert rt2.skip_batch(0, 4) and rt2.skip_batch(0, 5)
+    assert not rt2.skip_batch(0, 3) and not rt2.skip_batch(1, 4)
+
+
+def test_skip_windows_backcompat_old_snapshot(tmp_path):
+    """A pre-integrity snapshot (no skip_windows metadata) still loads —
+    with an empty window set."""
+    rt = ResumableTraining(str(tmp_path / "ck"))
+    old = rt.state(0, 0, 0)
+    del old["skip_windows"]
+    del old["skip_windows_v"]
+    rt.lineage.save(old, step=0)
+    rt.lineage.wait()
+    rt2 = ResumableTraining(str(tmp_path / "ck"))
+    assert rt2.restore() is not None
+    assert rt2.skip_windows == set()
+
+
+def test_rewind_union_merges_fresh_window(tmp_path):
+    """rewind() registers its window BEFORE restoring a snapshot that
+    predates it — the union-merge must keep the new window alive."""
+    rt = ResumableTraining(str(tmp_path / "ck"))
+    rt.ensure_baseline()   # snapshot with NO windows
+    rt.finalize()
+    got = rt.rewind(skip_window=(0, 2, 3))
+    assert got == 0
+    assert rt.skip_windows == {(0, 2, 3)}
+    assert rt.skip_batch(0, 2)
+
+
+def test_rewind_without_snapshot_raises(tmp_path):
+    rt = ResumableTraining(str(tmp_path / "ck"))
+    with pytest.raises(RuntimeError, match="no verified snapshot"):
+        rt.rewind(skip_window=(0, 0, 0))
+
+
+def test_step_done_suspect_suppresses_interval_snapshot(tmp_path):
+    """An anomaly-flagged step must NOT be interval-snapshotted — the
+    rewind target would BE the corruption."""
+    rt = ResumableTraining(str(tmp_path / "ck"), interval=1)
+    assert rt.step_done(0, 0, suspect=True) is False
+    assert rt._last_saved_step is None
+    assert rt.step_done(0, 1) is True           # healthy step saves
+
+
+# ------------------------------------------------------------- the guard
+
+def test_guard_streak_anomaly_then_rewind_verdict(tmp_path):
+    g = TrainingGuard(window=16, warmup=2, z_threshold=8.0,
+                      rewind_after=2, max_rewinds=1, verbose=False)
+    rt = ResumableTraining(str(tmp_path / "ck"))
+    rt.ensure_baseline()
+    step = 0
+    # genuine spread: a near-constant window would engage the MAD==0
+    # fallback scale and make ordinary noise register as anomalous
+    for v in (2.0, 2.2, 1.9, 2.1, 2.05):
+        assert g.observe_loss(v, 0, step, step) is None
+        step += 1
+    assert g.observe_loss(5000.0, 0, step, step) == "anomaly"
+    assert g.observe_loss(4000.0, 0, step + 1, step + 1) == "rewind"
+    assert g.anomalies == {"loss_spike": 2}
+    g.rewind(rt, 0, step + 1)
+    assert g.rewinds == 1
+    assert rt.skip_windows == {(0, step, step + 1)}  # the whole streak
+    assert g.last_rewind_detect_s is not None
+    # budget exhausted: the next rewind escalates
+    with pytest.raises(IntegrityError, match="max_rewinds"):
+        g.rewind(rt, 0, step + 2)
+
+
+def test_guard_nonfinite_bypasses_warmup():
+    g = TrainingGuard(warmup=50, rewind_after=3, verbose=False)
+    assert g.observe_loss(float("nan"), 0, 0, 0) == "anomaly"
+    assert g.observe_loss(float("inf"), 0, 1, 1) == "anomaly"
+    assert g.anomalies == {"nonfinite": 2}
+    # a healthy value resets the streak
+    assert g.observe_loss(1.0, 0, 2, 2) is None
+    assert g.observe_loss(float("nan"), 0, 3, 3) == "anomaly"
+
+
+def test_guard_rewind_without_lineage_is_loud():
+    g = TrainingGuard(warmup=0, rewind_after=1, verbose=False)
+    with pytest.raises(IntegrityError, match="no lineage"):
+        g.rewind(None, 0, 0)
+
+
+def test_guard_mismatch_blame_strike_and_redo_budget():
+    from paddle_tpu.distributed.elastic import QuarantineList
+    q = QuarantineList(threshold=2)
+    g = TrainingGuard(max_redos=2, quarantine=q, verbose=False)
+    err = GradFingerprintMismatch("diverged", blamed=[1], bucket=0)
+    g.on_mismatch(err, 0, 3)                    # redo 1
+    g.on_mismatch(err, 0, 3)                    # redo 2
+    assert g.blames == {1: 2}
+    assert q.is_quarantined("rank1")            # threshold=2 strikes
+    with pytest.raises(IntegrityError, match="persistent"):
+        g.on_mismatch(err, 0, 3)                # past max_redos
+    # a DIFFERENT step starts a fresh redo budget
+    g.on_mismatch(err, 0, 4)
+    assert g.anomalies["grad_bitflip"] == 4
+
+
+def test_make_guard_normalization():
+    assert make_guard(None) is None
+    assert make_guard(False) is None
+    assert isinstance(make_guard(True), TrainingGuard)
+    g = make_guard({"window": 4, "rewind_after": 7})
+    assert g.mad.window == 4 and g.rewind_after == 7
+    assert make_guard(g) is g
+    with pytest.raises(TypeError):
+        make_guard("yes")
+
+
+def test_attach_fingerprints_degrades_without_scheduler(capsys):
+    """fingerprints=True on a plain (non-DP / non-overlap) network falls
+    back to health gates with a warning instead of failing the fit."""
+    g = TrainingGuard(fingerprints=True, verbose=False)
+    g.attach_fingerprints(nn.Linear(4, 2))
+    assert not g.fingerprints_active()
+    assert "health gates only" in capsys.readouterr().err
+
+
+# ----------------------------------------------- fit wiring (structural)
+
+def _fit_model():
+    net = nn.Linear(16, 4)
+    model = paddle.Model(net)
+    opt = paddle.optimizer.SGD(learning_rate=0.05,
+                               parameters=net.parameters())
+    model.prepare(optimizer=opt, loss=nn.MSELoss())
+    return model
+
+
+def _ds(n_batches=12, bs=4):
+    X = np.random.RandomState(42).randn(n_batches * bs, 16).astype("float32")
+    Y = X @ np.random.RandomState(7).randn(16, 4).astype("float32")
+
+    class DS(Dataset):
+        def __getitem__(self, i):
+            return X[i], Y[i]
+
+        def __len__(self):
+            return len(X)
+
+    return DS()
+
+
+def test_guard_off_is_structurally_untouched(monkeypatch):
+    """integrity unset (the default): the fit loop must never construct a
+    guard NOR change its amortized fetch cadence — counted structurally,
+    the same way the bounded-host-sync regression is."""
+    from paddle_tpu.hapi.model import Model
+    calls = {"make_guard": 0, "scalar": 0, "batch": 0}
+    real_make = integrity.make_guard
+    real_scalar, real_batch = Model._fetch_scalar, Model._fetch_scalars
+
+    def count_make(arg):
+        calls["make_guard"] += 1
+        return real_make(arg)
+
+    def count_scalar(loss):
+        calls["scalar"] += 1
+        return real_scalar(loss)
+
+    def count_batch(losses):
+        calls["batch"] += 1
+        return real_batch(losses)
+
+    monkeypatch.setattr(integrity, "make_guard", count_make)
+    monkeypatch.setattr(Model, "_fetch_scalar", staticmethod(count_scalar))
+    monkeypatch.setattr(Model, "_fetch_scalars", staticmethod(count_batch))
+    model = _fit_model()
+    hist = model.fit(_ds(12), batch_size=4, epochs=1, shuffle=False,
+                     verbose=0, loss_fetch_every=4)
+    assert calls["make_guard"] == 0
+    # unchanged amortized cadence: 3 scalar fetches (steps 0,4,8) + ONE
+    # stacked epoch-end fetch — same bound as the guard-less perf test
+    assert calls["scalar"] == 3 and calls["batch"] == 1
+    assert np.isfinite(hist["loss"][0])
+
+
+def test_guard_on_forces_per_step_fetch(monkeypatch):
+    """integrity= pays the documented per-step host fetch (the gate
+    scores every step's host value)."""
+    from paddle_tpu.hapi.model import Model
+    calls = {"scalar": 0}
+    real_scalar = Model._fetch_scalar
+
+    def count_scalar(loss):
+        calls["scalar"] += 1
+        return real_scalar(loss)
+
+    monkeypatch.setattr(Model, "_fetch_scalar", staticmethod(count_scalar))
+    model = _fit_model()
+    g = TrainingGuard(warmup=100, verbose=False)  # gate never trips here
+    model.fit(_ds(8), batch_size=4, epochs=1, shuffle=False, verbose=0,
+              loss_fetch_every=4, integrity=g)
+    assert calls["scalar"] == 8
+    assert g.anomalies == {}
+
+
+def test_report_renders_integrity_section():
+    snap = {"ts": 1.0, "rank": 0, "seq": 0,
+            "counters": {"train_anomalies_total{kind=loss_spike}": 2,
+                         "train_anomalies_total{kind=nonfinite}": 1,
+                         "train_rewinds_total": 1,
+                         "integrity_blames_total{rank=1}": 3},
+            "gauges": {}, "histograms": {}}
+    rep = report.build_run_report({0: [snap]})
+    assert rep["integrity"]["anomalies"] == {"loss_spike": 2,
+                                             "nonfinite": 1}
+    assert rep["integrity"]["rewinds"] == 1
+    assert rep["integrity"]["blamed"] == {"1": 3}
+    text = report.format_run_report(rep)
+    assert "integrity: anomalies loss_spike=2, nonfinite=1" in text
+    assert "rewinds 1" in text and "blamed rank(s) 1 (x3)" in text
+
+
+# ------------------------------------------------------- chaos acceptance
+
+def test_loss_spike_rewind_and_skip_in_process(tmp_path):
+    """Acceptance: one poisoned batch under a guarded, lineage'd fit —
+    the gate trips on the corrupted model's losses, the guard rewinds to
+    the pre-spike snapshot and replays with the window skipped, and the
+    final loss lands back near the clean twin's."""
+    def run(poison):
+        fault.set_fault_spec("loss_spike@batch:5" if poison else None)
+        paddle.seed(0)
+        model = _fit_model()
+        g = TrainingGuard(window=16, warmup=3, z_threshold=8.0,
+                          rewind_after=2, max_rewinds=2, verbose=False)
+        hist = model.fit(_ds(8), batch_size=4, epochs=2, shuffle=False,
+                         verbose=0, lineage=str(tmp_path / f"ck{poison}"),
+                         snapshot_interval=1, integrity=g)
+        return hist["loss"][-1], g
+
+    clean_final, _ = run(poison=False)
+    fault_final, g = run(poison=True)
+    assert g.rewinds == 1
+    assert g.anomalies.get("loss_spike", 0) >= 2
+    # the replay excised the poisoned window, so trajectories differ by
+    # those batches — near-parity, not bit-equality
+    assert fault_final <= max(2.0 * clean_final, clean_final + 5.0), \
+        (fault_final, clean_final)
+
+
+@pytest.mark.slow
+def test_bitflip_blame_redo_and_exact_clean_parity(tmp_path):
+    """Acceptance: 3-rank DP with comm overlap + fingerprints; rank 1's
+    published bucket summary is bit-flipped. Every rank must blame rank
+    1, strike it into the quarantine, redo the step — and because the
+    flip hit only the HOST fingerprint copy, the redone run's losses
+    must match a clean twin EXACTLY."""
+    def run(tag, faults):
+        env = dict(os.environ)
+        for k in list(env):
+            if k.startswith(("PADDLE_TPU_", "PADDLE_TRAINER")):
+                del env[k]
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+            "PYTHONPATH": os.pathsep.join([REPO] + [
+                p for p in os.environ.get("PYTHONPATH", "").split(
+                    os.pathsep) if p and p != REPO]),
+            "PADDLE_TPU_DP_OVERLAP": "1",
+            "PADDLE_TPU_IT_FINGERPRINTS": "1",
+            "PADDLE_TPU_IT_EPOCHS": "2",
+            "PADDLE_TPU_IT_BATCHES": "6",
+            "PADDLE_TPU_FR_STORE": f"127.0.0.1:{_free_port()}",
+        })
+        if faults:
+            env["PADDLE_TPU_FAULTS"] = faults
+        log_dir = str(tmp_path / f"logs_{tag}")
+        r = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--nproc_per_node", "3", "--master",
+             f"127.0.0.1:{_free_port()}", "--log_dir", log_dir,
+             os.path.join(WORKERS, "integrity_worker.py")],
+            env=env, capture_output=True, text=True, timeout=600, cwd=REPO)
+        logs = "".join(_read_worker_logs(log_dir, rank)
+                       for rank in range(3))
+        return r, logs
+
+    rf, flogs = run("fault", "grad_bitflip@grad_fingerprint:2%1")
+    rc, clogs = run("clean", None)
+    assert rc.returncode == 0, rc.stdout + rc.stderr
+    assert rf.returncode == 0, flogs + rf.stderr
+
+    blames = re.findall(
+        r"INTEGRITY_BLAME rank=(\d+) bucket=\d+ strikes=\d+ "
+        r"struck=(\w+) quarantined=(\w+)", flogs)
+    assert len(blames) == 3, flogs          # every rank reached the verdict
+    assert {b[0] for b in blames} == {"1"}
+    assert all(b[1] == "True" for b in blames)
+    assert flogs.count("INTEGRITY_REDO") == 3
+
+    def losses(text):
+        got = {}
+        for m in re.finditer(r"LOSS (\d+) ([\d.]+)", text):
+            got.setdefault(int(m.group(1)), set()).add(m.group(2))
+        return got
+
+    got, ref = losses(flogs), losses(clogs)
+    assert got and got == ref, (got, ref)   # EXACT (string-level) parity
+
+
+@pytest.mark.slow
+def test_loss_spike_worker_markers_and_ledger(tmp_path):
+    """The subprocess twin of the in-process acceptance (what bench's
+    integrity leg runs): markers on stdout + the fired fault recorded in
+    the ledger before enactment."""
+    env = dict(os.environ)
+    for k in list(env):
+        if k.startswith(("PADDLE_TPU_", "PADDLE_TRAINER")):
+            del env[k]
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+        "PYTHONPATH": os.pathsep.join([REPO] + [
+            p for p in os.environ.get("PYTHONPATH", "").split(os.pathsep)
+            if p and p != REPO]),
+        "PADDLE_TPU_CKPT_DIR": str(tmp_path / "ck"),
+        "PADDLE_TPU_FAULTS": "loss_spike@batch:5",
+        "PADDLE_TPU_FAULT_LEDGER": str(tmp_path / "ledger.txt"),
+    })
+    r = subprocess.run(
+        [sys.executable, os.path.join(WORKERS, "integrity_worker.py")],
+        env=env, capture_output=True, text=True, timeout=600, cwd=REPO)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "INTEGRITY_POISON" in r.stdout
+    m = re.search(r"INTEGRITY_REWIND n=1 to_step=\d+ "
+                  r"skip=\((\d+),(\d+),(\d+)\) detect_s=[\d.]+", r.stdout)
+    assert m, r.stdout
+    assert "REWOUND" in r.stdout
+    mf = re.search(r"FINAL_LOSS ([\d.]+)", r.stdout)
+    assert mf and float(mf.group(1)) < 100.0, r.stdout
+    ledger = open(tmp_path / "ledger.txt").read()
+    assert "loss_spike@batch:5" in ledger

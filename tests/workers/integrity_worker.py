@@ -1,0 +1,124 @@
+"""Training-integrity chaos worker (tests/test_integrity.py, bench
+--chaos integrity leg).
+
+Trains a deterministic Linear regression through ``hapi.Model.fit`` with
+the integrity guard armed (``integrity=``). Two chaos modes, selected by
+the ``PADDLE_TPU_FAULTS`` spec the harness sets:
+
+* ``loss_spike@batch:N`` (single process + lineage): the guarded loop
+  scales one batch's labels, the MAD gate trips on the corrupted model's
+  elevated losses, and the guard rewinds to the last snapshot and
+  replays with the poisoned window skipped.
+* ``grad_bitflip@grad_fingerprint:N%R`` (3 ranks under the launcher,
+  ``PADDLE_TPU_DP_OVERLAP=1`` + ``PADDLE_TPU_FR_STORE``): rank R's
+  bucket fingerprint diverges, the majority blames it, strikes it into a
+  QuarantineList, and the step is redone from the still-synced params —
+  final losses must match a clean (no-fault) twin exactly.
+
+Markers on stdout (parsed by tests/bench): ``LOSS <n> <value>`` per
+executed batch (the guard forces a per-step fetch, so every value is
+fresh), the guard's own INTEGRITY_* lines, ``FINAL_LOSS <value>`` and
+``DONE <n>``.
+
+Env knobs: PADDLE_TPU_IT_EPOCHS / PADDLE_TPU_IT_BATCHES (loop shape),
+PADDLE_TPU_CKPT_DIR (optional: arms lineage + rewind),
+PADDLE_TPU_IT_INTERVAL (snapshot interval), PADDLE_TPU_IT_FINGERPRINTS=1
+(cross-rank fingerprints), PADDLE_TPU_IT_REWIND_AFTER /
+PADDLE_TPU_IT_MAX_REWINDS / PADDLE_TPU_IT_WARMUP (guard knobs),
+PADDLE_TPU_FT_STORE_PORT (checkpoint commit barrier, multi-process).
+"""
+import os
+import sys
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=1")
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed import fault
+from paddle_tpu.distributed.elastic import QuarantineList
+from paddle_tpu.hapi.callbacks import Callback
+from paddle_tpu.io import Dataset
+
+
+class _LossMarkers(Callback):
+    def __init__(self):
+        self.executed = 0
+
+    def on_train_batch_end(self, step, logs=None):
+        self.executed += 1
+        print(f"LOSS {self.executed} {logs['loss']:.8f}", flush=True)
+
+
+def main():
+    dist.init_parallel_env()
+    world = jax.process_count()
+    rank = jax.process_index()
+    print(f"WORLD {world}", flush=True)
+
+    epochs = int(os.environ.get("PADDLE_TPU_IT_EPOCHS", "2"))
+    n_batches = int(os.environ.get("PADDLE_TPU_IT_BATCHES", "8"))
+    per_rank = 4
+
+    paddle.seed(0)
+    n = n_batches * per_rank * world
+    X = np.random.RandomState(42).randn(n, 16).astype("float32")
+    Y = X @ np.random.RandomState(7).randn(16, 4).astype("float32")
+
+    class DS(Dataset):
+        def __getitem__(self, i):
+            return X[i], Y[i]
+
+        def __len__(self):
+            return len(X)
+
+    net = nn.Linear(16, 4)
+    model = paddle.Model(net)
+    opt = paddle.optimizer.SGD(learning_rate=0.05,
+                               parameters=net.parameters())
+    model.prepare(optimizer=opt, loss=nn.MSELoss())
+
+    lineage = None
+    ck = os.environ.get("PADDLE_TPU_CKPT_DIR")
+    if ck:
+        store = None
+        port = os.environ.get("PADDLE_TPU_FT_STORE_PORT")
+        if port and world > 1:
+            store = dist.TCPStore("127.0.0.1", int(port),
+                                  is_master=(rank == 0), world_size=world,
+                                  timeout=120)
+        lineage = fault.CheckpointLineage(ck, store=store,
+                                          world_size=world, rank=rank)
+
+    integ = {
+        "window": int(os.environ.get("PADDLE_TPU_IT_WINDOW", "16")),
+        "warmup": int(os.environ.get("PADDLE_TPU_IT_WARMUP", "3")),
+        "z_threshold": float(os.environ.get("PADDLE_TPU_IT_Z", "8.0")),
+        "rewind_after": int(os.environ.get(
+            "PADDLE_TPU_IT_REWIND_AFTER", "2")),
+        "max_rewinds": int(os.environ.get(
+            "PADDLE_TPU_IT_MAX_REWINDS", "2")),
+        "quarantine": QuarantineList(threshold=1),
+    }
+    if os.environ.get("PADDLE_TPU_IT_FINGERPRINTS") == "1":
+        integ["fingerprints"] = True
+        integ["fingerprint_stride"] = 1  # tiny model: sample = the bucket
+
+    cb = _LossMarkers()
+    history = model.fit(
+        DS(), batch_size=per_rank * world, epochs=epochs, shuffle=False,
+        verbose=0, callbacks=[cb], lineage=lineage,
+        snapshot_interval=int(os.environ.get("PADDLE_TPU_IT_INTERVAL", "1")),
+        integrity=integ)
+    print(f"FINAL_LOSS {history['loss'][-1]:.8f}", flush=True)
+    print(f"DONE {cb.executed}", flush=True)
+    sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

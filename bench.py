@@ -2026,6 +2026,17 @@ def run_fleet_serving_bench(n_engines=2):
             if row.get("itl_ms_p99") is not None:
                 sub[f"serving_fleet_{eng}_itl_ms_p99"] = round(
                     row["itl_ms_p99"], 2)
+        # phase-attributed latency breakdown (ISSUE 20): the same
+        # boundaries the request trace stamps, aggregated per engine —
+        # rides ALONGSIDE the legacy ttft/itl keys, never replaces them
+        for eng, phrow in sorted((rep.get("serving_phases")
+                                  or {}).items()):
+            if eng == "-":
+                continue
+            for phase, st in sorted(phrow.items()):
+                if st.get("p99_ms") is not None:
+                    sub[f"serving_fleet_{eng}_phase_{phase}"
+                        "_ms_p99"] = round(st["p99_ms"], 2)
         ok = (fleet["requests_failed"] == 0
               and single["requests_failed"] == 0
               and remote_hits > 0

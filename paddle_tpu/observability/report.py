@@ -124,6 +124,7 @@ def build_run_report(per_rank):
     ranks = {}
     collectives = {}
     serving_hists = {}     # (engine, name) -> merged histogram
+    serving_phases = {}    # (engine, phase) -> merged histogram
     serving_scalars = {}   # engine -> {row: value} (counters + gauges)
     integrity = {}         # anomalies by kind / rewinds / blamed ranks
     rank_windows = {}
@@ -179,6 +180,14 @@ def build_run_report(per_rank):
                 skey = (labels.get("engine", "-"), name)
                 serving_hists[skey] = _merge_hist(
                     serving_hists.get(skey), h)
+                continue
+            if name == "serving_phase_ms":
+                # per-lifecycle-phase latency (ISSUE 20): the aggregate
+                # view of the request-trace phase boundaries
+                pkey = (labels.get("engine", "-"),
+                        labels.get("phase", "?"))
+                serving_phases[pkey] = _merge_hist(
+                    serving_phases.get(pkey), h)
                 continue
             if name != "collective_latency_us":
                 continue
@@ -264,11 +273,21 @@ def build_run_report(per_rank):
     for eng, scal in serving_scalars.items():
         serving_rows.setdefault(eng, {}).update(scal)
 
+    phase_rows = {}
+    for (eng, phase), h in sorted(serving_phases.items()):
+        row = phase_rows.setdefault(eng, {})
+        row[phase] = {"count": h.get("count", 0),
+                      "mean_ms": hist_mean(h),
+                      "p50_ms": hist_quantile(h, 0.5),
+                      "p99_ms": hist_quantile(h, 0.99)}
+
     report = {"ranks": ranks, "slowest_rank": slowest,
               "straggler_windows": straggler_counts,
               "collectives": coll_rows}
     if serving_rows:
         report["serving"] = serving_rows
+    if phase_rows:
+        report["serving_phases"] = phase_rows
     if integrity:
         report["integrity"] = integrity
     if compute_ms_total > 0:
@@ -333,6 +352,30 @@ def format_run_report(report):
                     row.get("requests_ok", 0),
                     _fmt(row.get("ttft_ms_p99"), 2),
                     _fmt(row.get("itl_ms_p99"), 2)))
+    phases = report.get("serving_phases") or {}
+    if phases:
+        lines.append("[telemetry]   serving phase latency "
+                     "(p50/p99 ms):")
+        for eng, row in sorted(phases.items()):
+            cells = "  ".join(
+                f"{ph}={_fmt(st.get('p50_ms'), 1)}/"
+                f"{_fmt(st.get('p99_ms'), 1)}"
+                for ph, st in sorted(row.items()))
+            lines.append(f"[telemetry]     {eng:<10} {cells}")
+    slo = report.get("slo_attribution") or []
+    if slo:
+        lines.append("[telemetry]   slowest traced requests "
+                     "(phase-attributed, ms):")
+        for r in slo:
+            cells = "  ".join(
+                f"{c}={_fmt(r.get(c + '_ms'), 1)}"
+                for c in ("queue_wait", "prefill", "decode", "route")
+                if r.get(c + "_ms") is not None)
+            flags = ",".join(r.get("flags") or []) or "-"
+            lines.append(
+                f"[telemetry]     {r['trace'][:18]:<18} "
+                f"e2e={_fmt(r.get('e2e_ms'), 1):<9} {cells}  "
+                f"[{flags}]")
     integ = report.get("integrity") or {}
     if integ:
         anomalies = integ.get("anomalies") or {}
@@ -366,6 +409,16 @@ def main(argv=None):
         return 2
     log_dir = argv[0]
     report = build_run_report(read_rank_snapshots(log_dir))
+    try:
+        # per-request SLO attribution (ISSUE 20): when the log dir also
+        # holds exported request traces, fold the top slowest into the
+        # report — the aggregate phase tails above, the culprits below
+        from . import trace_report as _tr
+        rows = _tr.build_request_rows(_tr.load_events(log_dir))
+        if rows:
+            report["slo_attribution"] = _tr.rows_to_report(rows, top=5)
+    except Exception:
+        pass
     if "--json" in argv:
         print(json.dumps(report, indent=1, default=str))
         return 0

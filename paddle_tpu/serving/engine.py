@@ -78,6 +78,7 @@ import numpy as np
 from ..core.autograd import no_grad
 from ..core.tensor import Tensor
 from ..inference import pick_bucket
+from ..observability import tracing as _trc
 from . import decode as _decode
 from .ragged_attention import (ab_compare_ragged as _ab_compare_ragged,
                                pad_total_tokens as _pad_total_tokens,
@@ -488,6 +489,10 @@ class ServingEngine:
         decode rows + prefill chunks (budget-bounded FIFO, chunk-boundary
         semantics identical to the bucketed chunk step) into ONE flat
         launch. -> decode tokens emitted."""
+        # the ONE tracing gate of the round (standing contract: off =
+        # one check, no allocation, no call)
+        tr = _trc._TR if _trc._loaded else _trc._load()
+        t0 = time.time() if tr is not None else 0.0
         admitted = self.scheduler.schedule()
         for req in admitted:
             self.metrics.on_admit(req)
@@ -595,6 +600,20 @@ class ServingEngine:
         if spent:
             self._chunk_tokens += spent
             self.metrics.on_prefill_chunk(spent)
+        if tr is not None:
+            now = time.time()
+            # engine-lane round span: batched, ONE per round, row counts
+            # in args (the waterfall's decode cadence)
+            tr.add("decode_round", t0, now - t0, cat="serving",
+                   args={"decode_rows": len(by_slot),
+                         "prefill_rows": len(plan) - len(decode_rows),
+                         "prefill_tokens": spent})
+            for req, take, _ in plan[len(decode_rows):]:
+                if req.trace is not None:
+                    _trc.req_event(req.trace, "prefill_chunk", t0,
+                                   now - t0,
+                                   args={"tokens": take,
+                                         "cached": req.num_cached})
         self._decode_tokens += len(by_slot)
         return len(by_slot)
 
@@ -614,6 +633,10 @@ class ServingEngine:
         req.emit(tok)
         if first:
             self.metrics.on_first_token(req)
+            if req.trace is not None:
+                _trc.req_event(req.trace, "first_token", time.time(), 0.0,
+                               args={"ttft_ms": (req.t_first_token -
+                                                 req.t_submit) * 1e3})
         self.metrics.on_token(req)
         req.state = "active"
         if req in self._prefilling:
@@ -746,6 +769,8 @@ class ServingEngine:
         pending = self._prefilling
         if not pending:
             return 0
+        tr = _trc._TR if _trc._loaded else _trc._load()
+        t0 = time.time() if tr is not None else 0.0
         cap = self.prefill_chunk
         # never take more rows than the largest batch bucket can carry
         # (pick_bucket clamps DOWN to its largest entry; a batch wider
@@ -803,6 +828,14 @@ class ServingEngine:
             self._finish_prompt(req, prompts[i], _select_token(row, req))
         self._chunk_tokens += spent
         self.metrics.on_prefill_chunk(spent)
+        if tr is not None:
+            now = time.time()
+            for i, req in enumerate(batch):
+                if req.trace is not None:
+                    _trc.req_event(req.trace, "prefill_chunk", t0,
+                                   now - t0,
+                                   args={"tokens": int(lens[i]),
+                                         "cached": req.num_cached})
         return spent
 
     def _prefill_batch(self, reqs, seq_bucket):
@@ -811,6 +844,8 @@ class ServingEngine:
         row's first `len` K/V rows are exact. Jitted per bucket pair —
         prompts of different lengths share the bucket's one program."""
         n = len(reqs)
+        tr = _trc._TR if _trc._loaded else _trc._load()
+        t0 = time.time() if tr is not None else 0.0
         # strict: the caller split the round by the largest batch bucket,
         # so a clamp-down here could only mean indexing past the pad
         nb = pick_bucket(n, self.prefill_batch_buckets, strict=True)
@@ -831,6 +866,10 @@ class ServingEngine:
                 self.kv.write_prefill(layer, ks[layer][i],
                                       vs[layer][i], req.pages, ln)
             req.num_cached = ln
+            if tr is not None and req.trace is not None:
+                _trc.req_event(req.trace, "prefill_chunk", t0,
+                               time.time() - t0,
+                               args={"tokens": ln, "dense": True})
             # tpu-lint: ok[HS002] designed sync: host-side sampling consumes this logit row once per prefilled request
             row = np.asarray(logits_arr[i, ln - 1])
             self._finish_prompt(req, prompts[i], _select_token(row, req))
@@ -838,6 +877,8 @@ class ServingEngine:
     # ---------------------------------------------------------- decode step
     def _decode_once(self, active):
         self._note_program(("decode",))
+        tr = _trc._TR if _trc._loaded else _trc._load()
+        t0 = time.time() if tr is not None else 0.0
         S, maxp = self.max_slots, self.max_pages
         tokens = np.zeros(S, np.int32)
         positions = np.zeros(S, np.int32)
@@ -874,6 +915,9 @@ class ServingEngine:
                 req, tt[-1] - tt[-2] if len(tt) >= 2 else None)
         for req in finished:
             self.metrics.on_finish(req)
+        if tr is not None:
+            tr.add("decode_round", t0, time.time() - t0, cat="serving",
+                   args={"decode_rows": len(by_slot)})
         self._decode_tokens += len(by_slot)
         return len(by_slot)
 

@@ -48,6 +48,7 @@ import threading
 import time
 
 from ...distributed.elastic import QuarantineList
+from ...observability import tracing as _trc
 
 __all__ = ["EngineAutoscaler"]
 
@@ -170,6 +171,12 @@ class EngineAutoscaler:
               "n_engines": n_after, "epoch": self.epoch}
         self.events.append(ev)
         self.router.metrics.on_scale_event(direction, n_after)
+        # fleet-lane trace mark: scale events land in the SAME merged
+        # timeline as the request waterfalls, so "p99 spiked here"
+        # lines up with "the roster shrank here" (no-op when off)
+        _trc.add_complete(f"scale_{direction}", ev["t"], 0.0,
+                          cat="fleet", args={"engine": eid,
+                                             "n_engines": n_after})
         if self.registry is not None:
             try:
                 self.registry.save_autoscale(

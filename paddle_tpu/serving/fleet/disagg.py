@@ -32,7 +32,9 @@ One migration is:
 from __future__ import annotations
 
 import sys
+import time
 
+from ...observability import tracing as _trc
 from ..kv_cache import OutOfPages
 from ..scheduler import EngineClosed, OutOfSlots
 
@@ -54,6 +56,8 @@ def migrate_request(src, dst, req):
     :class:`MigrationFailed` when the target cannot take it at all. The
     request object itself moves — callers keep their handle.
     """
+    ctx = getattr(req, "trace", None)
+    t0 = time.time() if ctx is not None else 0.0
     with src._step_lock:
         if req.state == "migrating":
             # a PRIOR migrate attempt already detached it from the
@@ -77,10 +81,23 @@ def migrate_request(src, dst, req):
         # toward each other would deadlock their serve threads (each
         # holds its own step lock while taking the other's)
         req.migrate_hook = None
+    def _span(outcome, tokens):
+        if ctx is None:
+            return
+        now = time.time()
+        _trc.req_event(ctx, "kv_migrate", t0, now - t0,
+                       args={"outcome": outcome, "tokens": tokens,
+                             "src": getattr(src, "engine_id", None),
+                             "dst": getattr(dst, "engine_id", None)})
+        m = getattr(dst, "metrics", None)
+        if m is not None:
+            m.on_phase("migrate", now - t0)
+
     if payload is not None:
         ks, vs, length = payload
         try:
             dst.adopt_request(req, ks, vs, length)
+            _span("migrated", int(length))
             return "migrated"
         except (OutOfPages, OutOfSlots):
             pass  # fall through to the recompute queue
@@ -89,6 +106,7 @@ def migrate_request(src, dst, req):
                 f"target engine refused adoption: {e}") from e
     try:
         dst.readmit_request(req)
+        _span("recompute", 0)
         return "recompute"
     except EngineClosed as e:
         raise MigrationFailed(

@@ -49,6 +49,7 @@ from collections import deque
 
 from ...distributed import fault as _fault
 from ...distributed import keyspace
+from ...observability import tracing as _trc
 from .ledger import (RequestLedger, RouterDeposedError, RouterLease,
                      TERMINAL_STATES, rebuild_error)
 from .router import FleetRouter, FleetSaturated
@@ -80,16 +81,32 @@ class RouterClient:
         self._sent = {}          # rid -> wire msg (for resubmission)
 
     def submit(self, rid, prompt_ids, max_new_tokens=16,
-               eos_token_id=None, temperature=0.0, top_k=None):
+               eos_token_id=None, temperature=0.0, top_k=None,
+               engine=None):
         """Enqueue one request under the client-chosen ``rid``.
-        Calling this twice with the same rid is safe by design."""
+        Calling this twice with the same rid is safe by design.
+        ``engine=`` pins the request to one engine id (tests and warm
+        benches); the trace context is minted HERE — the true front of
+        the waterfall — and rides the wire msg so router/engine spans
+        land under the same trace id (ISSUE 20)."""
+        trace = _trc.mint_context()   # None when tracing is off
+        t0 = time.time() if trace is not None else 0.0
         msg = {"rid": str(rid), "prompt": [int(t) for t in prompt_ids],
                "max_new_tokens": int(max_new_tokens),
                "eos_token_id": eos_token_id,
                "temperature": temperature, "top_k": top_k}
+        if engine is not None:
+            msg["engine"] = str(engine)
+        if trace is not None:
+            msg["trace"] = trace
         with self._lock:
             self._sent[str(rid)] = msg
         self._enqueue(msg)
+        if trace is not None:
+            _trc.req_event(trace, "client_submit", t0,
+                           time.time() - t0,
+                           args={"rid": str(rid),
+                                 "prompt_tokens": len(msg["prompt"])})
         return str(rid)
 
     def _enqueue(self, msg):
@@ -220,7 +237,9 @@ def serve_router(router, store, job="fleet", poll_s=0.03,
                               temperature=float(
                                   msg.get("temperature", 0.0)),
                               top_k=msg.get("top_k"), block=False,
-                              request_id=msg.get("rid"))
+                              request_id=msg.get("rid"),
+                              engine=msg.get("engine"),
+                              trace=msg.get("trace"))
             except FleetSaturated:
                 retry.append(msg)   # every queue full: retry next tick
             except RouterDeposedError:

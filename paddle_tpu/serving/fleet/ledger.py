@@ -122,13 +122,20 @@ class RequestLedger:
 
     @staticmethod
     def _base_record(fr):
-        return {"rid": str(fr.request_id),
-                "prompt": [int(t) for t in fr.prompt_ids],
-                "max_new_tokens": int(fr.max_new_tokens),
-                "eos_token_id": fr.eos_token_id,
-                "temperature": fr.temperature, "top_k": fr.top_k,
-                "engine_id": fr.engine_id,
-                "engine_ids": list(fr.engine_ids)}
+        rec = {"rid": str(fr.request_id),
+               "prompt": [int(t) for t in fr.prompt_ids],
+               "max_new_tokens": int(fr.max_new_tokens),
+               "eos_token_id": fr.eos_token_id,
+               "temperature": fr.temperature, "top_k": fr.top_k,
+               "engine_id": fr.engine_id,
+               "engine_ids": list(fr.engine_ids)}
+        # trace context rides the journal so a shadow that adopts or
+        # replays this request keeps stamping the SAME trace id — the
+        # waterfall survives router failover (ISSUE 20)
+        trace = getattr(fr, "trace", None)
+        if trace is not None:
+            rec["trace"] = trace
+        return rec
 
     def accept(self, fr):
         """Journal admission (state ``accepted``) and append the rid to

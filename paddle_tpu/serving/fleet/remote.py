@@ -42,11 +42,13 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import threading
 import time
 
 from ...distributed import keyspace
+from ...observability import tracing as _trc
 from ..scheduler import (EngineClosed, EngineShuttingDown,
                          GenerationRequest, QueueFull)
 
@@ -158,14 +160,23 @@ def serve_over_store(engine, store, engine_id, job="fleet",
             if replay is not None:
                 continue
             try:
+                # trace context off the wire: the SAME trace id the
+                # router journaled, so this process's spans merge into
+                # the one cross-process waterfall (ISSUE 20). None when
+                # the submitter traced nothing — zero-overhead path.
+                trace = msg.get("trace")
                 req = GenerationRequest(
                     msg["prompt"],
                     max_new_tokens=int(msg.get("max_new_tokens", 16)),
                     eos_token_id=msg.get("eos_token_id"),
                     temperature=float(msg.get("temperature", 0.0)),
                     top_k=msg.get("top_k"), on_token=on_token,
-                    on_done=on_done)
+                    on_done=on_done, trace=trace)
                 req._rid = rid
+                if trace is not None:
+                    _trc.req_event(trace, "rpc_submit", time.time(),
+                                   0.0, args={"rid": rid,
+                                              "engine": engine_id})
                 inflight[rid] = req
                 engine.submit_request(req, block=False)
             except Exception as e:
@@ -191,6 +202,17 @@ def serve_over_store(engine, store, engine_id, job="fleet",
             rec = {"items": [[r, by_rid[r], fins[r]] for r in order]}
             seq = int(store.add(f"{stream_prefix}/tok_seq", 1))
             store.set(f"{stream_prefix}/tok/{seq}", json.dumps(rec))
+            tr = _trc._TR if _trc._loaded else _trc._load()
+            if tr is not None:
+                now = time.time()
+                for r in order:
+                    req = inflight.get(r)
+                    ctx = getattr(req, "trace", None) \
+                        if req is not None else None
+                    if ctx is not None:
+                        _trc.req_event(ctx, "stream_flush", now, 0.0,
+                                       args={"tokens": len(by_rid[r]),
+                                             "seq": seq})
         with done_lock:
             ready, done_queue[:] = list(done_queue), []
         for rec in ready:
@@ -228,6 +250,7 @@ class _RemoteLeg:
         self.on_token = on_token
         self.on_done = on_done
         self.migrate_hook = None
+        self.trace = None        # propagated from the router leg
         # takeover re-attachment (ISSUE 17): a fresh handle's poller
         # replays the engine's stream history from seq 0 — the first
         # ``skip`` tokens were already surfaced to the client by the
@@ -395,6 +418,12 @@ class RemoteEngineHandle:
                "max_new_tokens": leg.max_new_tokens,
                "eos_token_id": leg.eos_token_id,
                "temperature": leg.temperature, "top_k": leg.top_k}
+        trace = getattr(leg, "trace", None)
+        if trace is not None:
+            # the trace context crosses the store-RPC wire: the engine
+            # process stamps its spans under the SAME trace id
+            remote.trace = trace
+            msg["trace"] = trace
         with self._lock:
             self._pending[rid] = remote
         seq = int(self._submit_store.add(f"{self._prefix}/in_seq", 1))
@@ -540,6 +569,14 @@ def main(argv=None):
     p.add_argument("--rank", type=int, default=0)
     p.add_argument("--ttl", type=float, default=5.0)
     p.add_argument("--idle-timeout", type=float, default=300.0)
+    p.add_argument("--trace-dir", default=None,
+                   help="enable request tracing; export "
+                        "trace.<engine-id>.json here (ISSUE 20)")
+    p.add_argument("--trace-sample", type=float, default=None,
+                   help="tail-sampling keep rate (PADDLE_TPU_TRACE_"
+                        "SAMPLE) for uninteresting traces")
+    p.add_argument("--trace-slow-ms", type=float, default=None,
+                   help="keep traces slower than this e2e threshold")
     args = p.parse_args(argv)
 
     import paddle_tpu as paddle
@@ -556,6 +593,20 @@ def main(argv=None):
     if args.metrics_dir:
         reg = obsm.enable(out_dir=args.metrics_dir, interval_s=0,
                           rank=args.rank)
+    tracing = None
+    if args.trace_dir:
+        # sampling knobs must be in the environment BEFORE start():
+        # the buffer resolves them once, at construction
+        if args.trace_sample is not None:
+            os.environ["PADDLE_TPU_TRACE_SAMPLE"] = \
+                str(args.trace_sample)
+        if args.trace_slow_ms is not None:
+            os.environ["PADDLE_TPU_TRACE_SLOW_MS"] = \
+                str(args.trace_slow_ms)
+        from paddle_tpu.observability import tracing
+        tracing.start(path=os.path.join(
+            args.trace_dir, f"trace.{args.engine_id}.json"),
+            rank=args.rank)
 
     paddle.seed(args.seed)
     cfg = GPTConfig(vocab_size=args.vocab, hidden_size=args.hidden,
@@ -594,6 +645,8 @@ def main(argv=None):
         registry.close()
         if reg is not None:
             reg.flush()
+        if tracing is not None:
+            tracing.stop()   # export trace.<engine-id>.json
     print(f"[fleet] engine {args.engine_id} stopped", flush=True)
     return 0
 

@@ -51,6 +51,7 @@ import itertools
 import threading
 import time
 
+from ...observability import tracing as _trc
 from ..metrics import ServingMetrics
 from ..scheduler import (EngineClosed, EngineShuttingDown,
                          GenerationRequest, QueueFull)
@@ -81,12 +82,18 @@ class FleetRequest:
 
     def __init__(self, prompt_ids, max_new_tokens=16, eos_token_id=None,
                  temperature=0.0, top_k=None, on_token=None,
-                 request_id=None):
+                 request_id=None, trace=None):
         # client-supplied ids are the exactly-once idempotency key
         # (ISSUE 17): the same id resubmitted reaches the same request
         # through the ledger, never a second generation
         self.request_id = str(request_id) if request_id is not None \
             else f"fleet-{next(_fid)}"
+        # distributed trace context (ISSUE 20): minted at the front door
+        # (or by the router when it IS the front door), journaled with
+        # the ledger record, copied onto every engine leg. None when
+        # tracing is off — the hot-path hooks gate on this attribute.
+        self.trace = trace
+        self._hedged = False       # ever hedged (tail-sampling verdict)
         self.prompt_ids = [int(t) for t in prompt_ids]
         self.max_new_tokens = int(max_new_tokens)
         self.eos_token_id = eos_token_id
@@ -139,6 +146,12 @@ class FleetRequest:
                 self.t_first_token = now
             self.token_times.append(now)
             self.generated.append(int(token))
+            n = len(self.generated)
+        if self.trace is not None:
+            # per-token stream delivery: the instant this token surfaced
+            # to the caller's stream in the router process
+            _trc.req_event(self.trace, "stream_token", time.time(), 0.0,
+                           args={"i": n, "fin": bool(fin)})
         cb = self.on_token
         if cb is not None:
             try:
@@ -378,7 +391,8 @@ class FleetRouter:
     # ------------------------------------------------------------ submit
     def submit(self, prompt_ids, max_new_tokens=16, eos_token_id=None,
                temperature=0.0, top_k=None, on_token=None, block=True,
-               timeout=10.0, session=None, engine=None, request_id=None):
+               timeout=10.0, session=None, engine=None, request_id=None,
+               trace=None):
         """Same surface as ``ServingEngine.submit`` (so the Poisson
         loadgen drives a fleet unchanged), plus ``session=`` (explicit
         affinity key), ``engine=`` (pin to one engine id — tests and
@@ -392,21 +406,37 @@ class FleetRouter:
             fr = self._resubmit(str(request_id), on_token)
             if fr is not None:
                 return fr
+        if trace is None:
+            # the router is the front door here: mint the trace context
+            # itself (None when tracing is off — one call, no allocation)
+            trace = _trc.mint_context()
         fr = FleetRequest(prompt_ids, max_new_tokens=max_new_tokens,
                           eos_token_id=eos_token_id,
                           temperature=temperature, top_k=top_k,
-                          on_token=on_token, request_id=request_id)
+                          on_token=on_token, request_id=request_id,
+                          trace=trace)
         if self._ledger is not None:
             # journal admission BEFORE the first placement: the record
             # is the idempotency anchor a retry (or a shadow) finds
+            t_led = time.time() if trace is not None else 0.0
             try:
                 self._ledger.accept(fr)
             except Exception:
                 pass
+            if trace is not None:
+                _trc.req_event(trace, "ledger_accept", t_led,
+                               time.time() - t_led,
+                               args={"rid": fr.request_id})
         deadline = time.perf_counter() + (float(timeout) if block else 0.0)
         first = True
+        t_route = time.time() if trace is not None else 0.0
         while True:
             if self._dispatch(fr, session=session, pin=engine):
+                if trace is not None:
+                    dur = time.time() - t_route
+                    _trc.req_event(trace, "route", t_route, dur,
+                                   args={"engine": fr.engine_id})
+                    self.metrics.on_phase("route", dur)
                 if not fr.done():
                     with self._lock:
                         self._inflight[fr.request_id] = fr
@@ -472,7 +502,8 @@ class FleetRouter:
                           eos_token_id=rec.get("eos_token_id"),
                           temperature=rec.get("temperature", 0.0),
                           top_k=rec.get("top_k"), on_token=on_token,
-                          request_id=rec["rid"])
+                          request_id=rec["rid"],
+                          trace=rec.get("trace"))
         toks = [int(t) for t in rec.get("tokens", [])]
         err = rebuild_error(rec.get("error"))
         fr.engine_id = rec.get("engine_id")
@@ -493,6 +524,9 @@ class FleetRouter:
                              err is None and i == len(toks) - 1)
                 except Exception:
                     pass
+        if fr.trace is not None:
+            _trc.req_event(fr.trace, "ledger_replay", time.time(), 0.0,
+                           args={"rid": fr.request_id})
         fr._finish(err)
         self.requests_replayed += 1
         self.metrics.on_router_replay()
@@ -510,7 +544,7 @@ class FleetRouter:
                           eos_token_id=rec.get("eos_token_id"),
                           temperature=rec.get("temperature", 0.0),
                           top_k=rec.get("top_k"), on_token=on_token,
-                          request_id=rid)
+                          request_id=rid, trace=rec.get("trace"))
         toks = [int(t) for t in rec.get("tokens", [])]
         now = time.perf_counter()
         # tokens[:cursor] were already surfaced to the client by the
@@ -666,6 +700,22 @@ class FleetRouter:
                 led.terminal(fr)
             except Exception:
                 pass
+        ctx = fr.trace
+        if ctx is not None:
+            _trc.req_event(ctx, "fleet_done", time.time(), 0.0,
+                           args={"rid": fr.request_id,
+                                 "state": fr.state,
+                                 "engines": list(fr.engine_ids),
+                                 "hedged": fr._hedged})
+            # the router owns the request end-to-end, so ITS terminal is
+            # the tail-sampling decision point: retain the trace when
+            # the request was interesting (error/hedge/evict/migrate),
+            # slow, or explicitly sampled
+            _trc.finish_request(
+                ctx, dur_s=(fr.t_done - fr.t_submit)
+                if fr.t_done is not None else None,
+                error=error is not None, hedged=fr._hedged,
+                evicted=fr.evictions > 0, migrated=fr.migrations > 0)
         self._untrack(fr)
 
     def _dispatch(self, fr, session=None, pin=None, exclude=()):
@@ -684,7 +734,7 @@ class FleetRouter:
                 eos_token_id=fr.eos_token_id,
                 temperature=fr.temperature, top_k=fr.top_k,
                 on_token=fr._leg_token,
-                on_done=self._on_leg_done)
+                on_done=self._on_leg_done, trace=fr.trace)
             leg._fleet = fr
             if disagg and h.role == "prefill":
                 leg.migrate_hook = self._migrate_after_prefill
@@ -716,6 +766,10 @@ class FleetRouter:
                         del self._affinity[next(iter(self._affinity))]
                 self.dispatched += 1
             fr._attach(leg, h.engine_id)
+            if fr.trace is not None:
+                _trc.req_event(fr.trace, "dispatch", time.time(), 0.0,
+                               args={"engine": h.engine_id,
+                                     "redispatches": fr.redispatches})
             self._ledger_dispatched(fr, h.engine_id, leg)
             if prev_aff is not None and prev_aff != h.engine_id:
                 # affinity SPILL: the session's pages live on prev_aff —
@@ -766,6 +820,12 @@ class FleetRouter:
                 fr._hedge = None
             self._finish_fr(fr)
             if hleg is not None:
+                if fr.trace is not None:
+                    _trc.req_event(
+                        fr.trace, "hedge_lost", time.time(), 0.0,
+                        args={"winner": fr.engine_id,
+                              "loser": getattr(hleg, "_handle_id",
+                                               None)})
                 self._abort_leg(hleg)   # the duplicate lost the race
             return
         with self._lock:
@@ -851,7 +911,7 @@ class FleetRouter:
                 eos_token_id=fr.eos_token_id,
                 temperature=fr.temperature, top_k=fr.top_k,
                 on_token=fr._leg_token,    # dropped until promotion
-                on_done=self._on_leg_done)
+                on_done=self._on_leg_done, trace=fr.trace)
             hleg._fleet = fr
             hleg._hedge_base = base
             with self._lock:
@@ -871,6 +931,11 @@ class FleetRouter:
                 fr._hedge = hleg   # remote handles substitute wire legs
             self.hedges_fired += 1
             self.metrics.on_hedge_fired()
+            fr._hedged = True      # hedged traces are always retained
+            if fr.trace is not None:
+                _trc.req_event(fr.trace, "hedge_fired", time.time(), 0.0,
+                               args={"engine": h.engine_id,
+                                     "base_tokens": base})
             return True
         return False
 
@@ -895,6 +960,12 @@ class FleetRouter:
         self._promote_hedge(fr, hleg)
         self.hedges_won += 1
         self.metrics.on_hedge_won()
+        if fr.trace is not None:
+            _trc.req_event(fr.trace, "hedge_won", time.time(), 0.0,
+                           args={"winner": getattr(hleg, "_handle_id",
+                                                   fr.engine_id),
+                                 "loser": getattr(primary, "_handle_id",
+                                                  None)})
         if primary is not None:
             self._abort_leg(primary)   # the original lost the race
 
@@ -912,6 +983,16 @@ class FleetRouter:
                 if fr.t_first_token is None:
                     fr.t_first_token = now
                 fr.token_times.append(now)
+        if fr.trace is not None and tail:
+            # the splice IS the delivery instant for a hedge winner's
+            # tokens — they surface to the caller all at once here, not
+            # through _leg_token
+            t_now = time.time()
+            for i in range(len(tail)):
+                _trc.req_event(fr.trace, "stream_token", t_now, 0.0,
+                               args={"i": base + surfaced + i + 1,
+                                     "fin": i == len(tail) - 1,
+                                     "spliced": True})
         cb = fr.on_token
         if cb is not None:
             for i, t in enumerate(tail):
@@ -941,6 +1022,10 @@ class FleetRouter:
             self._dec_pending(leg)
             self.aborts += 1
             self.metrics.on_abort()
+            ctx = getattr(leg, "trace", None)
+            if ctx is not None:
+                _trc.req_event(ctx, "leg_abort", time.time(), 0.0,
+                               args={"engine": hid})
 
     def _prefetch_spill(self, handle, prompt):
         """Pull the prompt's shared prefix pages onto ``handle``'s

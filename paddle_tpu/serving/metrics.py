@@ -19,6 +19,10 @@ runs share the JSONL snapshot/report plumbing with training. Names:
   page gauges
 * ``serving_prefill_chunk_tokens_total`` — chunk-tokens processed by the
   budgeted chunked-prefill interleave
+* ``serving_phase_ms{phase=queue_wait|prefill|decode|route|migrate}`` —
+  per-lifecycle-phase latency histograms (ISSUE 20): the SAME phase
+  boundaries the distributed request trace stamps, so the aggregate
+  tails and the per-request waterfalls are two views of one measurement
 * ``serving_compiles_total`` — counter: every shape-specialized callable
   the engine installs (ragged token pad, prefill/chunk bucket pair,
   decode step); ``serving_distinct_programs`` — gauge: how many are live
@@ -101,10 +105,23 @@ class ServingMetrics:
     def _hist(self, name):
         return self._reg.histogram(name, **self._labels)
 
+    def on_phase(self, phase, dur_s):
+        """One lifecycle-phase latency sample for the
+        ``serving_phase_ms{phase=...}`` family (ISSUE 20) — fed at the
+        same boundaries the request trace stamps."""
+        reg = self._reg
+        if reg is None or dur_s is None:
+            return
+        reg.histogram("serving_phase_ms", **self._labels,
+                      phase=str(phase)).observe(max(0.0, dur_s) * 1e3)
+
     def on_admit(self, req):
         reg = self._reg
         if reg is None or req.t_admit is None:
             return
+        t_enq = getattr(req, "t_enqueue", None)
+        if t_enq is not None:
+            self.on_phase("queue_wait", req.t_admit - t_enq)
         # request-level prefix hit/miss: counted on the FIRST admission
         # only — an evicted request re-hitting its own cached head on
         # readmission must not inflate the hit rate (the recompute it
@@ -124,6 +141,8 @@ class ServingMetrics:
         ttft = req.ttft_s()
         if ttft is not None:
             self._hist("serving_ttft_ms").observe(ttft * 1e3)
+        if req.t_admit is not None and req.t_first_token is not None:
+            self.on_phase("prefill", req.t_first_token - req.t_admit)
 
     def on_token(self, req, dt_s=None):
         reg = self._reg
@@ -180,6 +199,8 @@ class ServingMetrics:
         if req.t_done is not None:
             self._hist("serving_e2e_ms").observe(
                 (req.t_done - req.t_submit) * 1e3)
+            if req.t_first_token is not None:
+                self.on_phase("decode", req.t_done - req.t_first_token)
         now = time.perf_counter()
         self._finish_times.append(now)
         self._trim(self._finish_times, now)

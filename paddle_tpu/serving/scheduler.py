@@ -25,10 +25,12 @@ owns prefill/decode and calls :meth:`schedule` / :meth:`complete_step`.
 from __future__ import annotations
 
 import itertools
+import os
 import threading
 import time
 from collections import deque
 
+from ..observability import tracing as _trc
 from .kv_cache import OutOfPages, pages_for
 
 __all__ = ["GenerationRequest", "ContinuousBatchingScheduler",
@@ -58,6 +60,13 @@ class EngineShuttingDown(EngineClosed):
 
 
 _rid = itertools.count()
+# Fallback request-id namespace: in a fleet, two engine PROCESSES each
+# minting rids from a bare per-process counter would alias (same rid on
+# two engines corrupts merged traces, metrics labels and ledger keys).
+# The pid-derived high component keeps the fallback an int — rng() folds
+# request_id into its seed arithmetic — while making cross-process
+# collision impossible for live pids (mod the 2^20 namespace).
+_RID_NS = (os.getpid() & 0xFFFFF) << 20
 
 
 class GenerationRequest:
@@ -71,9 +80,15 @@ class GenerationRequest:
 
     def __init__(self, prompt_ids, max_new_tokens=16, eos_token_id=None,
                  temperature=0.0, top_k=None, seed=0, on_token=None,
-                 request_id=None, on_done=None):
+                 request_id=None, on_done=None, trace=None):
         self.request_id = request_id if request_id is not None \
-            else next(_rid)
+            else (_RID_NS + next(_rid))
+        # distributed trace context ({"tid", "ps"} dict, or None): minted
+        # at the front door / scheduler submit, propagated over the fleet
+        # wire. None whenever tracing is off — every hot-path hook gates
+        # on this one attribute, which is what keeps tracing-off
+        # structurally free (no allocation, no call).
+        self.trace = trace
         self.prompt_ids = [int(t) for t in prompt_ids]
         if not self.prompt_ids:
             raise ValueError("empty prompt")
@@ -140,6 +155,8 @@ class GenerationRequest:
         self.state = "failed" if error is not None else "finished"
         self.error = error
         self.t_done = time.perf_counter()
+        if self.trace is not None:
+            self._trace_terminal(error)
         self._done.set()
         cb = self.on_done
         if cb is not None:
@@ -147,6 +164,33 @@ class GenerationRequest:
                 cb(self)
             except Exception:
                 pass  # a broken observer must not stall the engine
+
+    def _trace_terminal(self, error):
+        """Lifecycle spans at the terminal state plus (for a request this
+        process owns outright) the tail-sampling verdict. Fleet legs
+        carry ``_fleet`` and leave the verdict to the router, which alone
+        knows about hedging and the end-to-end latency. Durations are
+        perf_counter deltas anchored backward from the wall clock the
+        trace buffer stamps."""
+        ctx, now = self.trace, time.time()
+        if self.t_admit is not None and self.t_first_token is not None:
+            back = self.t_done - self.t_admit
+            _trc.req_event(ctx, "prefill", now - back,
+                           self.t_first_token - self.t_admit,
+                           args={"prompt": len(self.prompt_ids),
+                                 "prefix_hit": self.prefix_hit_tokens})
+        if self.t_first_token is not None:
+            dur = self.t_done - self.t_first_token
+            _trc.req_event(ctx, "decode", now - dur, dur,
+                           args={"tokens": len(self.generated)})
+        _trc.req_event(ctx, "request_done", now, 0.0,
+                       args={"rid": str(self.request_id),
+                             "state": self.state,
+                             "evictions": self.evictions})
+        if getattr(self, "_fleet", None) is None:
+            _trc.finish_request(ctx, dur_s=self.t_done - self.t_submit,
+                                error=error is not None,
+                                evicted=self.evictions > 0)
 
     def hit_stop(self):
         """Generation-complete test: token budget or eos."""
@@ -228,6 +272,15 @@ class ContinuousBatchingScheduler:
                 raise QueueFull(
                     f"waiting queue at capacity ({self.max_queue})")
             self.waiting.append(req)
+        if req.trace is None:
+            # single funnel for engine-local traces: a request arriving
+            # without a fleet-minted context gets its own (None when
+            # tracing is off — one call, no allocation)
+            req.trace = _trc.mint_context()
+        if req.trace is not None:
+            _trc.req_event(req.trace, "enqueue", time.time(), 0.0,
+                           args={"rid": str(req.request_id),
+                                 "depth": len(self.waiting)})
         return req
 
     def queue_depth(self):
@@ -288,7 +341,21 @@ class ContinuousBatchingScheduler:
             req.queue_wait_s += req.t_admit - req.t_enqueue
             self.active[req.slot] = req
             admitted.append(req)
+            if req.trace is not None:
+                self._trace_admit(req)
         return admitted
+
+    def _trace_admit(self, req):
+        """queue_wait span (anchored backward from now) + prefix-hit
+        marker for one just-admitted request."""
+        now = time.time()
+        wait = req.t_admit - req.t_enqueue
+        _trc.req_event(req.trace, "queue_wait", now - wait, wait,
+                       args={"slot": req.slot,
+                             "evictions": req.evictions})
+        if req.prefix_hit_tokens:
+            _trc.req_event(req.trace, "prefix_hit", now, 0.0,
+                           args={"tokens": req.prefix_hit_tokens})
 
     def ensure_decode_capacity(self):
         """Before a decode step: every active request writing token
@@ -329,6 +396,10 @@ class ContinuousBatchingScheduler:
         self._release(req)
         req.evictions += 1
         self.total_evictions += 1
+        if req.trace is not None:
+            _trc.req_event(req.trace, "evicted", time.time(), 0.0,
+                           args={"evictions": req.evictions,
+                                 "generated": len(req.generated)})
         self.readmit(req)
 
     def readmit(self, req):
@@ -340,6 +411,9 @@ class ContinuousBatchingScheduler:
         req.state = "waiting"
         req.num_cached = 0
         req.t_enqueue = time.perf_counter()
+        if req.trace is not None:
+            _trc.req_event(req.trace, "readmit", time.time(), 0.0,
+                           args={"generated": len(req.generated)})
         with self._lock:
             self.waiting.appendleft(req)
 
@@ -386,6 +460,14 @@ class ContinuousBatchingScheduler:
                 pass
         self._release(req)
         req.state = "aborted"
+        ctx = req.trace
+        if ctx is not None:
+            _trc.req_event(ctx, "aborted", time.time(), 0.0,
+                           args={"generated": len(req.generated)})
+            if getattr(req, "_fleet", None) is None:
+                # a locally-owned abort is its own terminal state; fleet
+                # legs leave the verdict to the router's _finish_fr
+                _trc.finish_request(ctx, aborted=True)
         return True
 
     def _release(self, req):

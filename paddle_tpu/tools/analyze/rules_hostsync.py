@@ -34,6 +34,13 @@ HOT_PATHS = {
         "ContinuousBatchingScheduler.schedule",
         "ContinuousBatchingScheduler.ensure_decode_capacity",
         "ContinuousBatchingScheduler.complete_step",
+        # request-trace hook sites (ISSUE 20): stamped inside the
+        # scheduling/finish path, so they must never block or transfer
+        "ContinuousBatchingScheduler._trace_admit",
+        "ContinuousBatchingScheduler._evict",
+        "ContinuousBatchingScheduler.readmit",
+        "GenerationRequest.finish",
+        "GenerationRequest._trace_terminal",
     },
     "serving/engine.py": {
         "ServingEngine.step",
@@ -46,6 +53,17 @@ HOT_PATHS = {
         "ServingEngine._serve_loop",
         "ServingEngine.snapshot_kv",
         "ServingEngine.adopt_request",
+        "ServingEngine._finish_prompt",
+    },
+    # request-trace buffer feeds (ISSUE 20): called from the scheduler
+    # round, the serve loop and the router dispatch path
+    "observability/tracing.py": {
+        "TraceBuffer.add",
+        "TraceBuffer.req_add",
+        "TraceBuffer.req_finish",
+        "req_event",
+        "finish_request",
+        "mint_context",
     },
     # fleet migration path (router.py designates itself whole-file via
     # the in-file hot-path marker)

@@ -1,0 +1,415 @@
+"""Per-request distributed tracing units (ISSUE 20).
+
+Covers the tracing buffer's tail-based sampling machinery, the
+truncation marker (satellite: the buffer used to stop recording
+silently at the cap), the pid-namespaced request-id fallback
+(satellite: per-process counters aliased across engines), the
+structural zero-overhead contract for tracing OFF, tracing-ON greedy
+parity, and the ``trace_report`` CLI. The multi-process fleet soak
+(cross-process waterfalls) lives in test_serving_fleet.py.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from paddle_tpu.observability import tracing
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+    paddle.seed(7)
+    m = GPTForCausalLM(gpt_tiny())
+    m.eval()
+    return m
+
+
+# ------------------------------------------------- satellite: truncation
+
+def test_truncation_marker_and_drop_counter(monkeypatch, tmp_path):
+    """At _MAX_EVENTS the buffer drops — but visibly: one over-cap
+    metadata marker, a dropped counter, and the registry metric."""
+    from paddle_tpu.observability import metrics as obsm
+    monkeypatch.setattr(tracing, "_MAX_EVENTS", 4)
+    reg = obsm.enable(out_dir=str(tmp_path), interval_s=0)
+    buf = tracing.start(path=str(tmp_path / "t.json"), rank=0)
+    for i in range(9):
+        buf.add(f"ev{i}", i * 1.0, 0.5)
+    assert buf.dropped == 5
+    doc = buf.to_dict()
+    marks = [e for e in doc["traceEvents"]
+             if e.get("name") == "trace_truncated"]
+    assert len(marks) == 1           # first drop only, not per drop
+    assert marks[0]["ph"] == "M"
+    assert marks[0]["args"]["at_events"] == 4
+    assert doc["droppedEvents"] == 5
+    snap = reg.snapshot()
+    assert snap["counters"]["trace_events_dropped_total"] == 5
+    tracing.stop()
+
+
+def test_request_events_respect_cap(monkeypatch, tmp_path):
+    """Kept request traces flushing into a full buffer count their lost
+    events instead of silently vanishing."""
+    monkeypatch.setattr(tracing, "_MAX_EVENTS", 2)
+    buf = tracing.start(path=str(tmp_path / "t.json"), rank=0)
+    ctx = tracing.mint_context()
+    for i in range(6):
+        tracing.req_event(ctx, f"s{i}", i * 1.0, 0.1)
+    assert tracing.finish_request(ctx, error=True) is True
+    # lane-name M event + 1 span fit (cap 2); marker is over-cap
+    assert buf.dropped >= 4
+
+
+# ---------------------------------------------------- tail-based sampling
+
+def test_tail_sampling_keep_and_drop(tmp_path):
+    buf = tracing.start(path=str(tmp_path / "t.json"), rank=0)
+    assert buf.sample_rate is None  # env unset by conftest scrub
+
+    def n_request_events():
+        return sum(1 for e in buf.events
+                   if (e.get("args") or {}).get("trace"))
+
+    # uninteresting + no sampling -> dropped before export
+    ctx = tracing.mint_context()
+    tracing.req_event(ctx, "queue_wait", 1.0, 0.5)
+    assert tracing.finish_request(ctx, dur_s=0.01) is False
+    assert n_request_events() == 0
+    assert buf.req_traces_dropped == 1
+    # each interesting flag retains on its own
+    for kw in ({"error": True}, {"hedged": True}, {"evicted": True},
+               {"aborted": True}, {"migrated": True}):
+        c = tracing.mint_context()
+        tracing.req_event(c, "queue_wait", 1.0, 0.5)
+        assert tracing.finish_request(c, **kw) is True, kw
+    assert n_request_events() == 5
+    tracing.stop()
+
+
+def test_tail_sampling_slow_threshold(monkeypatch, tmp_path):
+    monkeypatch.setenv("PADDLE_TPU_TRACE_SLOW_MS", "100")
+    buf = tracing.start(path=str(tmp_path / "t.json"), rank=0)
+    assert buf.slow_ms == 100.0
+    fast, slow = tracing.mint_context(), tracing.mint_context()
+    tracing.req_event(fast, "decode", 1.0, 0.01)
+    tracing.req_event(slow, "decode", 1.0, 0.5)
+    assert tracing.finish_request(fast, dur_s=0.05) is False
+    assert tracing.finish_request(slow, dur_s=0.5) is True
+    tracing.stop()
+
+
+def test_sampled_is_deterministic_per_trace_id():
+    """Every process hashes the same trace id to the same verdict —
+    the cross-process agreement needs no wire bits."""
+    assert tracing.sampled("anything", 1.0) is True
+    assert tracing.sampled("anything", 0.0) is False
+    assert tracing.sampled("anything", None) is False
+    ids = [os.urandom(8).hex() for _ in range(400)]
+    verdicts = {t: tracing.sampled(t, 0.5) for t in ids}
+    assert {tracing.sampled(t, 0.5) for t in ids for _ in range(2)} \
+        <= {True, False}
+    for t, v in verdicts.items():
+        assert tracing.sampled(t, 0.5) is v   # stable on re-ask
+    kept = sum(verdicts.values())
+    assert 80 < kept < 320   # roughly half, loose bound
+
+
+def test_verdict_gates_late_events(tmp_path):
+    """Post-verdict events follow the decision: dropped traces stay
+    dropped, kept traces keep accepting (hedge_lost after fleet_done),
+    and a later keep upgrades only future events."""
+    buf = tracing.start(path=str(tmp_path / "t.json"), rank=0)
+
+    def names():
+        return [e["name"] for e in buf.events
+                if (e.get("args") or {}).get("trace")]
+
+    kept = tracing.mint_context()
+    tracing.req_event(kept, "route", 1.0, 0.1)
+    assert tracing.finish_request(kept, hedged=True) is True
+    tracing.req_event(kept, "hedge_lost", 2.0, 0.0)   # late, lands
+    assert names() == ["route", "hedge_lost"]
+
+    dropped = tracing.mint_context()
+    tracing.req_event(dropped, "route", 1.0, 0.1)
+    assert tracing.finish_request(dropped) is False
+    tracing.req_event(dropped, "leg_abort", 2.0, 0.0)  # late, vanishes
+    assert names() == ["route", "hedge_lost"]
+    # a second, interesting terminal (e.g. the router after an engine
+    # leg already dropped) upgrades the verdict for future events
+    assert tracing.finish_request(dropped, error=True) is True
+    tracing.req_event(dropped, "ledger_replay", 3.0, 0.0)
+    assert names() == ["route", "hedge_lost", "ledger_replay"]
+    tracing.stop()
+
+
+def test_undecided_traces_flush_at_export(tmp_path):
+    path = str(tmp_path / "t.json")
+    tracing.start(path=path, rank=0)
+    ctx = tracing.mint_context()
+    tracing.req_event(ctx, "queue_wait", 1.0, 0.5)   # never finished
+    tracing.stop()
+    doc = json.load(open(path))
+    assert any(e.get("name") == "queue_wait"
+               for e in doc["traceEvents"])
+
+
+def test_mint_context_none_when_off():
+    assert tracing.mint_context() is None
+    # and the feeds are no-ops for a None ctx
+    tracing.req_event(None, "x", 0.0, 0.0)
+    assert tracing.finish_request(None) is False
+
+
+# -------------------------------------- satellite: rid fallback namespace
+
+def test_fallback_rid_is_pid_namespaced():
+    """Two engine PROCESSES minting fallback rids must never alias:
+    the high bits carry the pid component, the low bits the counter."""
+    from paddle_tpu.serving.scheduler import GenerationRequest, _RID_NS
+    a = GenerationRequest([1, 2])
+    b = GenerationRequest([1, 2])
+    assert _RID_NS == (os.getpid() & 0xFFFFF) << 20
+    assert a.request_id >> 20 == os.getpid() & 0xFFFFF
+    assert a.request_id != b.request_id
+    assert isinstance(a.request_id, int)   # rng() seed arithmetic
+    # explicit ids pass through untouched
+    assert GenerationRequest([1], request_id="r1").request_id == "r1"
+
+
+# -------------------------------------------- structural zero-overhead
+
+def test_tracing_off_structurally_zero_overhead(tiny_model, monkeypatch):
+    """Tracing OFF: the scheduler round and the serve loop make ZERO
+    calls into the tracing feeds and allocate ZERO trace state — the
+    counting-dict convention. One module gate check per round is the
+    entire budget."""
+    calls = {"req_event": 0, "finish_request": 0, "add": 0,
+             "req_add": 0}
+
+    def count(key, ret=None):
+        def h(*a, **k):
+            calls[key] += 1
+            return ret
+        return h
+
+    monkeypatch.setattr(tracing, "req_event", count("req_event"))
+    monkeypatch.setattr(tracing, "finish_request",
+                        count("finish_request", False))
+    monkeypatch.setattr(tracing.TraceBuffer, "add", count("add"))
+    monkeypatch.setattr(tracing.TraceBuffer, "req_add",
+                        count("req_add"))
+    from tests.test_serving import _engine
+    eng = _engine(tiny_model)
+    # direct-step path
+    r1 = eng.submit([1, 2, 3, 4], max_new_tokens=3)
+    while not r1.done():
+        eng.step()
+    assert r1.trace is None          # no context ever minted
+    # serve-loop path
+    eng.start()
+    r2 = eng.submit([5, 6, 7], max_new_tokens=3)
+    assert len(r2.result(30)) == 3
+    eng.close()
+    assert calls == {"req_event": 0, "finish_request": 0, "add": 0,
+                     "req_add": 0}
+
+
+def test_tracing_on_greedy_parity(tiny_model, tmp_path):
+    """The traced twin generates token-identical output — tracing
+    observes the round, never perturbs it."""
+    from tests.test_serving import _engine
+    prompt = [3, 1, 4, 1, 5, 9, 2, 6]
+    eng = _engine(tiny_model)
+    base = eng.generate(prompt, max_new_tokens=6)
+    eng.close()
+    path = str(tmp_path / "trace.0.json")
+    tracing.start(path=path, rank=0)
+    eng2 = _engine(tiny_model)
+    traced = eng2.generate(prompt, max_new_tokens=6)
+    req = eng2.submit(prompt, max_new_tokens=6)
+    while not req.done():
+        eng2.step()
+    assert req.trace is not None
+    eng2.close()
+    tracing.stop()
+    assert traced == base
+    assert req.result(1) == base
+    doc = json.load(open(path))
+    names = {e["name"] for e in doc["traceEvents"]
+             if (e.get("args") or {}).get("trace")}
+    # the full local lifecycle is spanned (sampling: slow/err flags off,
+    # but undecided-at-export traces flush — generate()'s finished trace
+    # was dropped, the un-finished twin would flush; the engine decides
+    # at terminal, so assert via an explicitly sampled run instead)
+    assert {"enqueue", "queue_wait"} <= names or names == set()
+
+
+def test_sampled_run_exports_full_lifecycle(tiny_model, monkeypatch,
+                                            tmp_path):
+    """PADDLE_TPU_TRACE_SAMPLE=1.0 retains every trace: the exported
+    lifecycle covers submit -> admit -> prefill -> decode -> done, plus
+    the engine-lane decode_round spans."""
+    monkeypatch.setenv("PADDLE_TPU_TRACE_SAMPLE", "1.0")
+    from tests.test_serving import _engine
+    path = str(tmp_path / "trace.0.json")
+    tracing.start(path=path, rank=0)
+    eng = _engine(tiny_model)
+    eng.generate([2, 7, 1, 8], max_new_tokens=4)
+    eng.close()
+    tracing.stop()
+    doc = json.load(open(path))
+    req_names = {e["name"] for e in doc["traceEvents"]
+                 if (e.get("args") or {}).get("trace")}
+    assert {"enqueue", "queue_wait", "prefill_chunk", "first_token",
+            "prefill", "decode", "request_done"} <= req_names
+    eng_names = {e["name"] for e in doc["traceEvents"]
+                 if e.get("cat") == "serving"}
+    assert "decode_round" in eng_names
+    rounds = [e for e in doc["traceEvents"]
+              if e["name"] == "decode_round"]
+    assert all("decode_rows" in (e.get("args") or {}) for e in rounds)
+
+
+# -------------------------------------------------- phase histogram feed
+
+def test_serving_phase_ms_family(tiny_model, monkeypatch, tmp_path):
+    from paddle_tpu.observability import metrics as obsm
+    from paddle_tpu.observability.report import build_run_report
+    from tests.test_serving import _engine
+    reg = obsm.enable(out_dir=str(tmp_path), interval_s=0)
+    eng = _engine(tiny_model, registry=reg, engine_id="e7")
+    eng.generate([1, 2, 3, 4, 5], max_new_tokens=3)
+    eng.close()
+    snap = reg.snapshot()
+    keys = {k for k in snap["histograms"]
+            if k.startswith("serving_phase_ms")}
+    assert "serving_phase_ms{engine=e7,phase=queue_wait}" in keys
+    assert "serving_phase_ms{engine=e7,phase=prefill}" in keys
+    assert "serving_phase_ms{engine=e7,phase=decode}" in keys
+    reg.flush()
+    rep = build_run_report(
+        __import__("paddle_tpu.observability.report",
+                   fromlist=["read_rank_snapshots"])
+        .read_rank_snapshots(str(tmp_path)))
+    phases = rep["serving_phases"]["e7"]
+    assert {"queue_wait", "prefill", "decode"} <= set(phases)
+    assert phases["decode"]["count"] == 1
+
+
+# ------------------------------------------------------ trace_report CLI
+
+def _synthetic_trace(path, tid="feedbeef", pid=0, t0=1000.0):
+    us = 1e6
+    evs = [
+        {"name": "client_submit", "ph": "X", "pid": pid, "tid": 1,
+         "ts": t0 * us, "dur": 0.001 * us, "cat": "request",
+         "args": {"trace": tid, "rid": "r1"}},
+        {"name": "queue_wait", "ph": "X", "pid": pid, "tid": 1,
+         "ts": (t0 + 0.01) * us, "dur": 0.02 * us, "cat": "request",
+         "args": {"trace": tid}},
+        {"name": "prefill", "ph": "X", "pid": pid + 1, "tid": 1,
+         "ts": (t0 + 0.03) * us, "dur": 0.05 * us, "cat": "request",
+         "args": {"trace": tid}},
+        {"name": "decode", "ph": "X", "pid": pid + 1, "tid": 1,
+         "ts": (t0 + 0.08) * us, "dur": 0.1 * us, "cat": "request",
+         "args": {"trace": tid}},
+        {"name": "hedge_fired", "ph": "X", "pid": pid, "tid": 1,
+         "ts": (t0 + 0.09) * us, "dur": 0.0, "cat": "request",
+         "args": {"trace": tid, "engine": "e1"}},
+        {"name": "stream_token", "ph": "X", "pid": pid, "tid": 1,
+         "ts": (t0 + 0.1) * us, "dur": 0.0, "cat": "request",
+         "args": {"trace": tid, "i": 0}},
+        {"name": "fleet_done", "ph": "X", "pid": pid, "tid": 1,
+         "ts": (t0 + 0.18) * us, "dur": 0.0, "cat": "request",
+         "args": {"trace": tid, "state": "finished", "hedged": True}},
+    ]
+    with open(path, "w") as f:
+        json.dump({"traceEvents": evs}, f)
+
+
+def test_trace_report_rows_and_flags(tmp_path):
+    from paddle_tpu.observability import trace_report as tr
+    _synthetic_trace(tmp_path / "trace.0.json")
+    rows = tr.build_request_rows(tr.load_events(str(tmp_path)))
+    assert set(rows) == {"feedbeef"}
+    r = rows["feedbeef"]
+    assert r["procs"] == 2                 # cross-process waterfall
+    assert r["tokens"] == 1
+    assert "hedged" in r["flags"]
+    assert r["phases"]["queue_wait"] == pytest.approx(20.0, abs=1e-6)
+    assert r["phases"]["prefill"] == pytest.approx(50.0, abs=1e-6)
+    assert r["phases"]["decode"] == pytest.approx(100.0, abs=1e-6)
+    assert r["e2e_ms"] == pytest.approx(180.0, abs=1e-3)
+    rep = tr.rows_to_report(rows, top=3)
+    assert rep[0]["trace"] == "feedbeef"
+    assert rep[0]["decode_ms"] == pytest.approx(100.0, abs=1e-3)
+    text = tr.format_request_rows(rows)
+    assert "feedbeef" in text and "hedged" in text
+
+
+def test_trace_report_cli(tmp_path):
+    _synthetic_trace(tmp_path / "trace.0.json")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    out = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.observability.trace_report",
+         str(tmp_path), "--top", "5"],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert "feedbeef" in out.stdout
+    assert "slowest" in out.stdout
+    js = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.observability.trace_report",
+         str(tmp_path / "trace.0.json"), "--json"],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert js.returncode == 0, js.stderr
+    assert json.loads(js.stdout)[0]["trace"] == "feedbeef"
+    # empty dir: exit 1, not a crash
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    no = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.observability.trace_report",
+         str(empty)],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert no.returncode == 1
+
+
+def test_trace_report_dedups_merged_copy(tmp_path):
+    """A log dir typically holds BOTH the per-process trace files and
+    the merge_profiles output built from them; the same event must not
+    count twice even though the merge rewrote its pid."""
+    from paddle_tpu.observability import trace_report as tr
+    _synthetic_trace(tmp_path / "trace.0.json")
+    src = json.load(open(tmp_path / "trace.0.json"))["traceEvents"]
+    merged = [{**e, "pid": 7} for e in src]   # merge rewrites pids
+    with open(tmp_path / "merged.json", "w") as f:
+        json.dump({"traceEvents": merged}, f)
+    rows = tr.build_request_rows(tr.load_events(str(tmp_path)))
+    r = rows["feedbeef"]
+    assert r["phases"]["prefill"] == pytest.approx(50.0, abs=1e-6)
+    assert r["phases"]["decode"] == pytest.approx(100.0, abs=1e-6)
+    assert r["tokens"] == 1
+    assert r["events"] == 7
+
+
+def test_report_cli_slo_attribution_section(tmp_path):
+    """report.py folds the trace files in the log dir into the
+    slo_attribution section next to the metrics-derived sections."""
+    from paddle_tpu.observability import report as obsrep
+    _synthetic_trace(tmp_path / "trace.0.json")
+    rep = {"ranks": {0: {"snapshots": 1, "steps": 0}}}
+    # the section is built in main(); drive the builder directly
+    from paddle_tpu.observability import trace_report as tr
+    rows = tr.build_request_rows(tr.load_events(str(tmp_path)))
+    rep["slo_attribution"] = tr.rows_to_report(rows, top=5)
+    text = obsrep.format_run_report(rep)
+    assert "slowest traced requests" in text
+    assert "feedbeef" in text

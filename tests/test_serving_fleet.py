@@ -1145,3 +1145,193 @@ def test_remote_engine_over_store_roundtrip(tmp_path):
         if proc.poll() is None:
             proc.kill()
     del master
+
+
+@pytest.mark.slow
+def test_fleet_tracing_soak_cross_process_waterfalls(tmp_path,
+                                                     monkeypatch):
+    """ISSUE 20 acceptance: router (this process) + two engine worker
+    processes, tracing on everywhere, merged into ONE Perfetto trace.
+    A hedged, an evicted-and-readmitted and a prefix-hit request each
+    show a complete cross-process waterfall (submit -> ledger -> route
+    -> queue -> prefill -> decode -> stream) under a single trace id —
+    and every stream is token-identical to its untraced twin."""
+    import json
+    import os
+    import signal
+    import subprocess
+    import sys as _sys
+    import threading
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import keyspace
+    from paddle_tpu.distributed.tcp_store import TCPStore
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+    from paddle_tpu.observability import tracing
+    from paddle_tpu.profiler import merge_profiler_results
+    from paddle_tpu.serving.fleet import (EngineRegistry, FleetRouter,
+                                          RemoteEngineHandle,
+                                          RequestLedger, RouterClient,
+                                          serve_router)
+
+    # untraced twin FIRST: the fleet engines are seed-3 clones of this
+    # local engine, so its greedy streams are the parity baselines
+    paddle.seed(3)
+    cfg = GPTConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                    num_heads=4, max_seq_len=64, dropout=0.0)
+    twin_model = GPTForCausalLM(cfg)
+    twin_model.eval()
+    from paddle_tpu.serving import ServingEngine
+    twin = ServingEngine(twin_model, page_size=4, num_pages=32,
+                         max_slots=2, attn_backend="xla", jit=False)
+    p_pre = [11, 12, 13, 14, 15, 16, 17, 18, 19]       # 2 full pages +1
+    p_ev1 = list(range(21, 33))                        # 12 tokens
+    p_ev2 = list(range(101, 113))
+    p_hdg = [41, 42, 43, 44, 45, 46]
+    base = {"pre": twin.generate(p_pre, max_new_tokens=4),
+            "ev1": twin.generate(p_ev1, max_new_tokens=8),
+            "ev2": twin.generate(p_ev2, max_new_tokens=8),
+            "hdg": twin.generate(p_hdg, max_new_tokens=4)}
+    twin.close()
+
+    port = _free_port()
+    master = TCPStore("127.0.0.1", port, is_master=True)
+    td = str(tmp_path / "traces")
+    os.makedirs(td, exist_ok=True)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("PADDLE_TPU_", "PADDLE_TRAINER"))}
+    env.pop("JAX_COMPILATION_CACHE_DIR", None)
+    env.update({"JAX_PLATFORMS": "cpu", "PYTHONPATH": repo})
+    common = [_sys.executable, "-m", "paddle_tpu.serving.fleet.remote",
+              "--store", f"127.0.0.1:{port}", "--job", "t20",
+              "--seed", "3", "--vocab", "256", "--hidden", "64",
+              "--layers", "2", "--heads", "4", "--seq", "64",
+              "--page", "4", "--slots", "2",
+              "--trace-dir", td, "--trace-sample", "1.0"]
+    workers = {
+        # e0: roomy pool — the prefix-hit pair and hedge target
+        "e0": subprocess.Popen(
+            common + ["--engine-id", "e0", "--pool", "32",
+                      "--rank", "1"],
+            env=env, cwd=repo, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True),
+        # e1: starved pool — two concurrent requests MUST evict
+        "e1": subprocess.Popen(
+            common + ["--engine-id", "e1", "--pool", "10",
+                      "--rank", "2"],
+            env=env, cwd=repo, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True),
+    }
+    router_trace = str(tmp_path / "trace.router.json")
+    serve_thread = None
+    try:
+        reg = EngineRegistry(TCPStore("127.0.0.1", port), job="t20",
+                             ttl=30.0)
+        deadline = time.time() + 300
+        while len(reg.engines()) < 2:
+            for eid, w in workers.items():
+                assert w.poll() is None, \
+                    (eid, w.communicate()[0][-1500:])
+            assert time.time() < deadline, "workers never registered"
+            time.sleep(0.5)
+
+        # tracing ON in the router process (tail-sampling keeps all:
+        # the env knob must precede start() — resolved at construction)
+        monkeypatch.setenv("PADDLE_TPU_TRACE_SAMPLE", "1.0")
+        tracing.start(path=router_trace, rank=0)
+
+        router = FleetRouter(
+            hedge_after_s=0.5,
+            ledger=RequestLedger(TCPStore("127.0.0.1", port),
+                                 job="t20"))
+        for eid in ("e0", "e1"):
+            router.add_engine(None, handle=RemoteEngineHandle(
+                lambda: TCPStore("127.0.0.1", port), eid, job="t20",
+                registry=EngineRegistry(TCPStore("127.0.0.1", port),
+                                        job="t20", ttl=30.0)))
+        serve_thread = threading.Thread(
+            target=serve_router,
+            args=(router, TCPStore("127.0.0.1", port)),
+            kwargs={"job": "t20", "poll_s": 0.01}, daemon=True)
+        serve_thread.start()
+        client = RouterClient(TCPStore("127.0.0.1", port), job="t20",
+                              resubmit_after=10.0)
+
+        # --- scenario 1: prefix hit (same prompt twice, pinned e0)
+        client.submit("rq-pre0", p_pre, max_new_tokens=4, engine="e0")
+        assert client.result("rq-pre0", timeout=120.0) == base["pre"]
+        client.submit("rq-pre1", p_pre, max_new_tokens=4, engine="e0")
+        assert client.result("rq-pre1", timeout=120.0) == base["pre"]
+
+        # --- scenario 2: eviction + readmission (concurrent, e1)
+        client.submit("rq-ev1", p_ev1, max_new_tokens=8, engine="e1")
+        client.submit("rq-ev2", p_ev2, max_new_tokens=8, engine="e1")
+        assert client.result("rq-ev1", timeout=180.0) == base["ev1"]
+        assert client.result("rq-ev2", timeout=180.0) == base["ev2"]
+
+        # --- scenario 3: hedge (e1 frozen -> straggler -> e0 wins)
+        os.kill(workers["e1"].pid, signal.SIGSTOP)
+        try:
+            client.submit("rq-hdg", p_hdg, max_new_tokens=4,
+                          engine="e1")
+            assert client.result("rq-hdg", timeout=120.0) == base["hdg"]
+        finally:
+            os.kill(workers["e1"].pid, signal.SIGCONT)
+        assert router.hedges_fired >= 1 and router.hedges_won >= 1
+        time.sleep(1.0)   # let e1 drain the stale leg + its abort
+
+        master.set(f"{keyspace.fleet_registry('t20')}/stop", b"1")
+        for eid, w in workers.items():
+            assert w.wait(120) == 0, (eid, w.stdout.read()[-1500:])
+        serve_thread.join(30)
+        for h in router.handles().values():
+            h.detach()
+        assert tracing.stop() == router_trace
+
+        # --- merge all three processes into ONE trace
+        merged = merge_profiler_results(
+            [router_trace, os.path.join(td, "trace.e0.json"),
+             os.path.join(td, "trace.e1.json")],
+            out_path=str(tmp_path / "merged.json"),
+            labels=["router", "e0", "e1"])
+        evs = merged["traceEvents"]
+
+        tids = {}
+        for rid in ("rq-pre1", "rq-ev1", "rq-ev2", "rq-hdg"):
+            tids[rid] = client._sent[rid]["trace"]["tid"]
+        assert len(set(tids.values())) == 4   # distinct ids per request
+
+        def lane(tid):
+            return [e for e in evs
+                    if (e.get("args") or {}).get("trace") == tid]
+
+        WATERFALL = {"client_submit", "ledger_accept", "route",
+                     "queue_wait", "first_token", "decode",
+                     "stream_token"}
+        for rid, tid in tids.items():
+            es = lane(tid)
+            names = {e["name"] for e in es}
+            assert WATERFALL <= names, (rid, sorted(names))
+            assert "prefill" in names or "prefill_chunk" in names, rid
+            assert len({e["pid"] for e in es}) >= 2, \
+                (rid, "waterfall is not cross-process")
+        # scenario markers under their single trace ids
+        pre = {e["name"] for e in lane(tids["rq-pre1"])}
+        assert "prefix_hit" in pre
+        ev = {e["name"] for e in lane(tids["rq-ev1"])} \
+            | {e["name"] for e in lane(tids["rq-ev2"])}
+        assert {"evicted", "readmit"} <= ev
+        hdg = {e["name"] for e in lane(tids["rq-hdg"])}
+        assert {"hedge_fired", "hedge_won"} <= hdg
+    finally:
+        for w in workers.values():
+            try:
+                os.kill(w.pid, signal.SIGCONT)
+            except Exception:
+                pass
+            if w.poll() is None:
+                w.kill()
+        master.set(f"{keyspace.fleet_registry('t20')}/stop", b"1")
+        if serve_thread is not None:
+            serve_thread.join(10)
+    del master
